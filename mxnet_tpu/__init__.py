@@ -1,0 +1,18 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+Apache MXNet 1.0.0 (reference: MaureenZOU/mxnet), rebuilt on JAX/XLA/Pallas.
+
+Layer map (SURVEY.md §7.1): the reference's dependency engine, memory planner
+and CUDA kernels are replaced by XLA compilation; NDArray wraps jax.Array;
+Symbol graphs lower to single jitted XLA programs; KVStore data-parallelism
+becomes in-program ICI collectives over a jax.sharding.Mesh.
+"""
+
+__version__ = "1.0.0"
+
+from .base import MXNetError, AttrScope, NameManager, Prefix
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd

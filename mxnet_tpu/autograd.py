@@ -1,0 +1,275 @@
+"""Imperative autograd — the tape (reference: src/imperative/imperative.cc,
+python/mxnet/autograd.py).
+
+The reference records NNVM nodes with AGInfo during eager execution
+(Imperative::RecordOp, imperative.cc:182) and replays a gradient graph on
+Backward (imperative.cc:361). Here the tape is a DAG of :class:`TapeNode`s,
+each holding the ``jax.vjp`` closure of the op it recorded — JAX builds the
+transposed computation, so Backward is a reverse-topological walk calling the
+stored vjp closures and accumulating cotangents into marked variables
+(MarkVariables analog, imperative.cc:112).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "set_recording",
+    "set_training",
+]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.train_mode = False
+    return _state
+
+
+def is_recording():
+    """Whether the tape is active (reference: autograd.py:160)."""
+    return _st().recording
+
+
+def is_training():
+    """Whether ops run in train mode (reference: autograd.py:168)."""
+    return _st().train_mode
+
+
+def set_recording(is_record):
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    st = _st()
+    prev = st.train_mode
+    st.train_mode = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    """with-scope flipping recording/train flags (reference: autograd.py:93)."""
+
+    def __init__(self, is_record, train_mode_):
+        self._enter_record = is_record
+        self._enter_train = train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.train_mode)
+        if self._enter_record is not None:
+            st.recording = self._enter_record
+        if self._enter_train is not None:
+            st.train_mode = self._enter_train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.train_mode = self._prev
+
+
+def record(train_mode=True):
+    """Scope: record ops for autograd (reference: autograd.py:93)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Scope: stop recording (reference: autograd.py:126)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    """Scope: train mode without recording (reference: autograd.py:151)."""
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    """Scope: predict mode (reference: autograd.py:165)."""
+    return _RecordingStateScope(None, False)
+
+
+class TapeNode:
+    """One recorded op: vjp closure + graph linkage (AGInfo analog)."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_shapes", "out_dtypes", "name")
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of NDArray (strong refs keep the graph alive)
+        self.n_outputs = n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.name = name
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers to arrays (reference: autograd.py:197 / imperative.cc:112)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradient, req in zip(variables, gradients, grad_reqs):
+        var._mark_variable(gradient, req)
+
+
+def _collect_graph(head_arrays):
+    """Reverse-reachable tape nodes from heads, in topological order."""
+    topo = []
+    visited = set()
+
+    def visit(node):
+        if node is None or id(node) in visited:
+            return
+        visited.add(id(node))
+        for inp in node.inputs:
+            visit(inp._autograd_node)
+        topo.append(node)
+
+    for arr in head_arrays:
+        visit(arr._autograd_node)
+    return topo
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # pylint: disable=redefined-outer-name
+    """Run backward from heads, accumulating into marked variables' ``.grad``
+    (reference: autograd.py:243 → Imperative::Backward imperative.cc:361)."""
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads must match in length")
+
+    topo = _collect_graph(heads)
+    if not topo and not any(h._autograd_marked for h in heads):
+        raise MXNetError(
+            "cannot differentiate: no recorded computation reaches the heads "
+            "(did you run inside autograd.record()?)"
+        )
+
+    # cotangents keyed by (id(node), out_index)
+    cot = {}
+    leaf_grads = {}  # id(NDArray) -> accumulated jnp array
+
+    def seed(arr, g):
+        gval = g._data if g is not None else jnp.ones(arr.shape, dtype=arr._data.dtype)
+        node = arr._autograd_node
+        if node is not None:
+            k = (id(node), arr._autograd_index)
+            cot[k] = cot[k] + gval if k in cot else gval
+        elif arr._autograd_marked:
+            lid = id(arr)
+            leaf_grads[lid] = leaf_grads[lid] + gval if lid in leaf_grads else gval
+            leaf_grads.setdefault("_arr%d" % lid, arr)
+
+    for arr, g in zip(heads, head_grads):
+        seed(arr, g)
+
+    import jax
+
+    for node in reversed(topo):
+        cots = []
+        any_seen = False
+        for i in range(node.n_outputs):
+            k = (id(node), i)
+            if k in cot:
+                cots.append(cot.pop(k))
+                any_seen = True
+            elif node.out_dtypes[i] == jax.dtypes.float0:
+                cots.append(np.zeros(node.out_shapes[i], dtype=jax.dtypes.float0))
+            else:
+                cots.append(jnp.zeros(node.out_shapes[i], dtype=node.out_dtypes[i]))
+        if not any_seen:
+            continue
+        if node.vjp_fn is None:
+            raise MXNetError("graph already freed; call backward(retain_graph=True) "
+                             "to backprop twice")
+        in_grads = node.vjp_fn(tuple(cots))
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or g.dtype == jax.dtypes.float0:
+                continue
+            if inp._autograd_node is not None:
+                k = (id(inp._autograd_node), inp._autograd_index)
+                cot[k] = cot[k] + g if k in cot else g
+            elif inp._autograd_marked:
+                lid = id(inp)
+                leaf_grads[lid] = leaf_grads[lid] + g if lid in leaf_grads else g
+                leaf_grads.setdefault("_arr%d" % lid, inp)
+
+    # write into .grad respecting grad_req
+    for lid, g in list(leaf_grads.items()):
+        if isinstance(lid, str):
+            continue
+        arr = leaf_grads["_arr%d" % lid]
+        req = arr._autograd_marked
+        if req == "null" or arr.grad is None:
+            continue
+        if req == "add":
+            arr.grad._set_data(arr.grad._data + g.astype(arr.grad._data.dtype))
+        else:  # write
+            arr.grad._set_data(g.astype(arr.grad._data.dtype))
+
+    if not retain_graph:
+        for node in topo:
+            node.vjp_fn = None
+        for arr in heads:
+            arr._autograd_node = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):  # pylint: disable=redefined-outer-name
+    """Return gradients of heads w.r.t. variables (reference: autograd.py:270).
+
+    ``create_graph`` (higher-order grad) is not yet supported on the eager
+    tape; use symbolic/jit paths for higher-order derivatives.
+    """
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True not yet supported")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(v.grad, v._autograd_marked) for v in variables]
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import _from_data
+
+    tmp_grads = [
+        _from_data(jnp.zeros(v.shape, dtype=v._data.dtype)) for v in variables
+    ]
+    for v, g in zip(variables, tmp_grads):
+        v._mark_variable(g, "write")
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    finally:
+        for v, (og, om) in zip(variables, saved):
+            v._grad = og
+            v._autograd_marked = om
+    return tmp_grads
+
+
+def get_symbol(x):
+    raise NotImplementedError("autograd.get_symbol is not supported on the TPU build")

@@ -1,0 +1,159 @@
+"""Core shared plumbing: error type, name manager, attribute scope.
+
+Re-provides the roles of the reference's ``python/mxnet/base.py`` (MXNetError,
+handle types, ctypes glue) and ``python/mxnet/name.py`` / ``python/mxnet/attribute.py``.
+The TPU build is process-native Python over JAX — there is no C ABI boundary, so
+"handles" are plain Python objects and ``check_call`` disappears.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["MXNetError", "NameManager", "Prefix", "AttrScope", "string_types"]
+
+string_types = (str,)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by mxnet_tpu (reference: python/mxnet/base.py:71)."""
+
+
+class _NullType:
+    """Placeholder for missing kwarg values (reference: python/mxnet/base.py:52)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+_thread_state = threading.local()
+
+
+class NameManager:
+    """Auto-naming for symbols, ``with``-scoped (reference: python/mxnet/name.py:24).
+
+    Assigns ``<op>N`` style unique names when the user does not provide one.
+    """
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    @staticmethod
+    def current():
+        stack = getattr(_thread_state, "name_stack", None)
+        if not stack:
+            _thread_state.name_stack = [NameManager()]
+        return _thread_state.name_stack[-1]
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(_thread_state, "name_stack"):
+            _thread_state.name_stack = [NameManager()]
+        _thread_state.name_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _thread_state.name_stack.pop()
+
+
+class Prefix(NameManager):
+    """NameManager that prepends a prefix (reference: python/mxnet/name.py:70)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+class AttrScope:
+    """``with``-scope attaching attributes (e.g. ``ctx_group``, ``lr_mult``) to
+    symbols created inside it (reference: python/mxnet/attribute.py:24)."""
+
+    def __init__(self, **kwargs):
+        for _, v in kwargs.items():
+            if not isinstance(v, string_types):
+                raise ValueError("Attributes need to be string")
+        self._attr = kwargs
+        self._old = None
+
+    @staticmethod
+    def current():
+        stack = getattr(_thread_state, "attr_stack", None)
+        if not stack:
+            _thread_state.attr_stack = [AttrScope()]
+        return _thread_state.attr_stack[-1]
+
+    def get(self, attr):
+        """Merge scope attrs into user attrs (user wins)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(_thread_state, "attr_stack"):
+            _thread_state.attr_stack = [AttrScope()]
+        merged = AttrScope()
+        merged._attr = dict(_thread_state.attr_stack[-1]._attr, **self._attr)
+        _thread_state.attr_stack.append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _thread_state.attr_stack.pop()
+
+
+# dtype name <-> numpy dtype mapping (reference: python/mxnet/base.py uses
+# mshadow type codes; here names are the canonical currency)
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype spec (str/np.dtype/type, incl. 'bfloat16') to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_DTYPE_ALIASES.get(dtype, dtype))
+
+
+def dtype_name(dtype):
+    """Canonical string name of a dtype."""
+    return np.dtype(dtype).name if not isinstance(dtype, str) else dtype

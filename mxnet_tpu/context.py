@@ -1,0 +1,133 @@
+"""Device context — the user-facing placement handle.
+
+Mirrors the reference's ``python/mxnet/context.py`` (Context, cpu(), gpu(),
+current_context) but resolves onto JAX devices: ``cpu(i)`` maps to host CPU
+devices; ``gpu(i)`` / ``tpu(i)`` map to the i-th accelerator chip reported by
+``jax.devices()``. On a CPU-only test environment (JAX_PLATFORMS=cpu with
+``--xla_force_host_platform_device_count=N``) accelerator contexts resolve onto
+the virtual CPU devices, which is exactly how the reference's multi-device
+tests map ctx groups onto cpu(0)/cpu(1) (tests/python/unittest/test_multi_device_exec.py).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_gpus"]
+
+_thread_state = threading.local()
+
+
+class Context:
+    """Device context (reference: python/mxnet/context.py:23).
+
+    Works as a ``with`` scope setting the default context for array creation.
+    """
+
+    # mirror the reference's devtype codes; 'tpu' gets a new code
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __repr__(self):
+        return self.__str__()
+
+    def __enter__(self):
+        if not hasattr(_thread_state, "ctx_stack"):
+            _thread_state.ctx_stack = []
+        _thread_state.ctx_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _thread_state.ctx_stack.pop()
+
+    # --- JAX resolution -------------------------------------------------
+    def jax_device(self):
+        """The jax.Device this context resolves to."""
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = jax.devices("cpu")
+        else:
+            devs = _accelerator_devices()
+        if self.device_id >= len(devs):
+            raise ValueError(
+                "%s out of range: only %d %s device(s) visible"
+                % (self, len(devs), self.device_type)
+            )
+        return devs[self.device_id]
+
+
+def _accelerator_devices():
+    """Non-CPU jax devices, falling back to (possibly virtualized) CPU devices.
+
+    The fallback makes gpu()/tpu() contexts usable in the CPU test harness where
+    --xla_force_host_platform_device_count provides N virtual devices.
+    """
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return devs if devs else jax.devices("cpu")
+
+
+def cpu(device_id=0):
+    """Return a CPU context (reference: python/mxnet/context.py:131)."""
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Return an accelerator context. On this build 'gpu' is an alias for the
+    TPU chip so that reference scripts written against ``mx.gpu(i)`` run
+    unmodified (north-star requirement)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context."""
+    return Context("tpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def num_gpus():
+    """Number of accelerator chips visible (reference exposes mx.context.num_gpus
+    in later versions; used by tests/examples to skip)."""
+    import jax
+
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or len(
+        jax.devices("cpu")
+    )
+
+
+def current_context():
+    """Default context (reference: python/mxnet/context.py:216)."""
+    stack = getattr(_thread_state, "ctx_stack", None)
+    if stack:
+        return stack[-1]
+    return Context("cpu", 0)

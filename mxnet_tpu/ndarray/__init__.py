@@ -1,0 +1,22 @@
+"""NDArray package: the imperative frontend (reference: python/mxnet/ndarray/).
+
+Importing this package triggers op registration and generates the ``nd.*``
+function surface from the registry (codegen-at-import, the reference's
+ndarray/register.py:168 pattern).
+"""
+from .. import ops as _ops  # noqa: F401  (registers all operators)
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concatenate, moveaxis, waitall)
+from . import op
+from . import _internal
+from .register import populate_namespaces as _populate
+
+_populate(op, _internal)
+
+# expose generated ops at package level: nd.relu, nd.FullyConnected, ...
+globals().update(
+    {k: v for k, v in op.__dict__.items() if not k.startswith("__")}
+)
+
+from .utils import save, load  # noqa: E402

@@ -1,0 +1,2 @@
+"""Namespace populated with generated internal (underscore) op functions
+(reference: python/mxnet/ndarray/_internal.py)."""

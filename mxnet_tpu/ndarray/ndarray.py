@@ -1,0 +1,496 @@
+"""NDArray — the imperative value type, a facade over ``jax.Array``.
+
+Reference: include/mxnet/ndarray.h (C++ NDArray: storage chunk + engine var +
+autograd entry) and python/mxnet/ndarray/ndarray.py:3415. Here the "chunk" is
+an immutable ``jax.Array``; MXNet's in-place mutation (``a[:] = x``, ``+=``,
+aux-state updates) becomes rebinding ``_data`` to a new functional value —
+the versioned-buffer design SURVEY.md §7.3 calls for. The dependency engine's
+read/write ordering is inherited from JAX's async dispatch: ops return
+immediately, ``wait_to_read``/``asnumpy`` are ``block_until_ready`` sync
+points (engine WaitForVar analog, src/engine/threaded_engine.cc:356).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "moveaxis", "waitall", "imports_done"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _ctx_of(data):
+    """Derive a Context from a jax.Array's committed device."""
+    try:
+        dev = list(data.devices())[0]
+    except Exception:  # uncommitted/traced
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("gpu", dev.id)
+
+
+def _from_data(data, ctx=None):
+    """Wrap a raw jax array into NDArray without copy."""
+    arr = NDArray.__new__(NDArray)
+    arr._data = data
+    arr._ctx = ctx
+    arr._grad = None
+    arr._autograd_node = None
+    arr._autograd_index = 0
+    arr._autograd_marked = None
+    return arr
+
+
+class NDArray:
+    """Multi-dimensional array on a device (reference: ndarray.py NDArray)."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_autograd_node", "_autograd_index",
+                 "_autograd_marked", "__weakref__")
+
+    def __init__(self, source_array, ctx=None, dtype=None):
+        import jax
+
+        ctx = ctx or current_context()
+        npa = np.asarray(source_array, dtype=np_dtype(dtype))
+        self._data = jax.device_put(npa, ctx.jax_device())
+        self._ctx = ctx
+        self._grad = None
+        self._autograd_node = None
+        self._autograd_index = 0
+        self._autograd_marked = None
+
+    # --- core properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype) if self._data.dtype.name != "bfloat16" else self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is None:
+            self._ctx = _ctx_of(self._data)
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def T(self):
+        return _from_data(self._data.T)
+
+    @property
+    def grad(self):
+        """Gradient buffer attached by :meth:`attach_grad`."""
+        return self._grad
+
+    @property
+    def stype(self):
+        return "default"
+
+    # --- data movement / sync --------------------------------------------
+    def asnumpy(self):
+        """Copy to a numpy array, blocking (engine WaitForVar analog)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def astype(self, dtype, copy=True):
+        d = self._data.astype(np_dtype(dtype))
+        return _from_data(d, self._ctx)
+
+    def copyto(self, other):
+        """Copy into another NDArray (in-place write) or onto a Context."""
+        import jax
+
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            other._set_data(
+                jax.device_put(self._data, other.context.jax_device()).astype(
+                    other._data.dtype
+                )
+            )
+            return other
+        if isinstance(other, Context):
+            return _from_data(jax.device_put(self._data, other.jax_device()), other)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def copy(self):
+        return _from_data(self._data + 0, self._ctx)
+
+    def as_in_context(self, context):
+        if self.context == context:
+            return self
+        return self.copyto(context)
+
+    # --- mutation (rebind) ------------------------------------------------
+    def _set_data(self, data):
+        """Rebind to a new functional value — the mutation primitive."""
+        self._data = data
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        elif not np.isscalar(value):
+            value = np.asarray(value)
+        if isinstance(key, slice) and key == slice(None):
+            jnp = _jnp()
+            self._set_data(jnp.broadcast_to(value, self.shape).astype(self._data.dtype))
+        else:
+            self._set_data(self._data.at[key].set(value))
+
+    def __getitem__(self, key):
+        from .register import record_apply
+
+        if isinstance(key, NDArray):
+            key = key._data
+        return record_apply(lambda x: x[key], [self], name="index")[0]
+
+    # --- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Attach a zero-initialized gradient buffer (reference: ndarray.py attach_grad)."""
+        jnp = _jnp()
+        grad_arr = _from_data(jnp.zeros(self.shape, dtype=self._data.dtype), self._ctx)
+        self._mark_variable(grad_arr, grad_req)
+
+    def _mark_variable(self, grad_arr, grad_req):
+        self._grad = grad_arr
+        self._autograd_marked = grad_req
+        self._autograd_node = None  # marked arrays are leaves
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        return _from_data(self._data, self._ctx)
+
+    # --- shape ops (thin sugar over registered ops) ------------------------
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        from .register import record_apply
+
+        # support 0 (copy dim) and -1 (infer) codes like the reference Reshape
+        shape = _fix_reshape(self.shape, shape)
+        return record_apply(lambda x: x.reshape(shape), [self], name="reshape")[0]
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1))
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        axes = axes or None
+        from .register import record_apply
+
+        jnp = _jnp()
+        return record_apply(lambda x: jnp.transpose(x, axes or None), [self],
+                            name="transpose")[0]
+
+    def expand_dims(self, axis):
+        from .register import record_apply
+
+        jnp = _jnp()
+        return record_apply(lambda x: jnp.expand_dims(x, axis), [self],
+                            name="expand_dims")[0]
+
+    def squeeze(self, axis=None):
+        from .register import record_apply
+
+        jnp = _jnp()
+        return record_apply(lambda x: jnp.squeeze(x, axis), [self], name="squeeze")[0]
+
+    # --- reductions / misc sugar -------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return self._invoke("sum", axis=_ax(axis), keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._invoke("mean", axis=_ax(axis), keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._invoke("max", axis=_ax(axis), keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._invoke("min", axis=_ax(axis), keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._invoke("argmax", axis=None if axis is None else int(axis),
+                            keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._invoke("argmin", axis=None if axis is None else int(axis),
+                            keepdims=keepdims)
+
+    def abs(self):
+        return self._invoke("abs")
+
+    def clip(self, a_min, a_max):
+        return self._invoke("clip", a_min=a_min, a_max=a_max)
+
+    def _invoke(self, opname, **kwargs):
+        from . import op as _op
+
+        return getattr(_op, opname)(self, **kwargs)
+
+    # --- python protocol ----------------------------------------------------
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements "
+                         "is ambiguous.")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()),
+            "x".join(map(str, self.shape)),
+            self.context,
+        )
+
+    # --- arithmetic --------------------------------------------------------
+    def _binop(self, other, op_name, scalar_op_name, reverse=False):
+        from . import op as _op
+        from . import _internal
+
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return getattr(_op, op_name)(a, b)
+        if np.isscalar(other) or isinstance(other, (np.generic,)):
+            f = getattr(_internal, scalar_op_name)
+            return f(self, scalar=float(other))
+        raise TypeError("type %s not supported" % str(type(other)))
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", "_rminus_scalar", reverse=True) \
+            if isinstance(other, NDArray) else self._binop(other, "broadcast_sub", "_rminus_scalar")
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", "_rdiv_scalar", reverse=True) \
+            if isinstance(other, NDArray) else self._binop(other, "broadcast_div", "_rdiv_scalar")
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, other):
+        return self._binop(other, "broadcast_mod", "_mod_scalar")
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return self._binop(other, "broadcast_power", "_rpower_scalar", reverse=True) \
+            if isinstance(other, NDArray) else self._binop(other, "broadcast_power", "_rpower_scalar")
+
+    def __neg__(self):
+        return self._binop(-1.0, "broadcast_mul", "_mul_scalar")
+
+    def __abs__(self):
+        return self.abs()
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._binop(other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._binop(other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        self._set_data(res._data.astype(self._data.dtype))
+        return self
+
+    def __isub__(self, other):
+        res = self.__sub__(other)
+        self._set_data(res._data.astype(self._data.dtype))
+        return self
+
+    def __imul__(self, other):
+        res = self.__mul__(other)
+        self._set_data(res._data.astype(self._data.dtype))
+        return self
+
+    def __itruediv__(self, other):
+        res = self.__truediv__(other)
+        self._set_data(res._data.astype(self._data.dtype))
+        return self
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return (int(axis),)
+
+
+def _fix_reshape(cur_shape, shape):
+    """Support MXNet reshape codes 0 (keep dim) alongside numpy -1."""
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(cur_shape[i])
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+# --- creation functions (reference: ndarray.py zeros/ones/array/...) --------
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference: ndarray.py:2407)."""
+    if isinstance(source_array, NDArray):
+        dtype = source_array.dtype if dtype is None else np_dtype(dtype)
+        return NDArray(source_array.asnumpy(), ctx=ctx, dtype=dtype)
+    return NDArray(source_array, ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    import jax
+
+    jnp = _jnp()
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    d = jax.device_put(
+        jnp.zeros(shape, dtype=np_dtype(dtype) or np.float32), ctx.jax_device()
+    )
+    return _from_data(d, ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    import jax
+
+    jnp = _jnp()
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    d = jax.device_put(
+        jnp.ones(shape, dtype=np_dtype(dtype) or np.float32), ctx.jax_device()
+    )
+    return _from_data(d, ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    import jax
+
+    jnp = _jnp()
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    d = jax.device_put(
+        jnp.full(shape, val, dtype=np_dtype(dtype) or np.float32), ctx.jax_device()
+    )
+    return _from_data(d, ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    import jax
+
+    jnp = _jnp()
+    ctx = ctx or current_context()
+    a = jnp.arange(start, stop, step, dtype=np_dtype(dtype) or np.float32)
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return _from_data(jax.device_put(a, ctx.jax_device()), ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    jnp = _jnp()
+    return _from_data(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def moveaxis(tensor, source, destination):
+    jnp = _jnp()
+    return _from_data(jnp.moveaxis(tensor._data, source, destination))
+
+
+def waitall():
+    """Block until all async work completes (reference: Engine::WaitForAll)."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def imports_done():
+    """Hook point: called once op codegen has populated the namespaces."""

@@ -1,0 +1,2 @@
+"""Namespace populated with generated op functions at import
+(reference: python/mxnet/ndarray/op.py)."""

@@ -1,0 +1,50 @@
+"""NDArray save/load (reference: src/ndarray/ndarray.cc:835 NDArray::Save/Load,
+python/mxnet/ndarray/utils.py).
+
+The reference's format is a dmlc::Stream binary (magic + stype + shape + ctx +
+dtype + raw bytes, dict-of-name→array container). Here the container is a
+``.npz``-compatible archive with the same dict/list semantics: ``save`` of a
+list stores keys ``arr_0..N``; of a dict stores the names. A reference-format
+binary loader can be added for checkpoint back-compat (tracked gap).
+"""
+from __future__ import annotations
+
+import zipfile
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load"]
+
+_LIST_PREFIX = "__mxlist__"
+
+
+def save(fname, data):
+    """Save a list or str-keyed dict of NDArrays (reference: mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        npd = {"%s%d" % (_LIST_PREFIX, i): a.asnumpy() for i, a in enumerate(data)}
+    elif isinstance(data, dict):
+        npd = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise ValueError("data needs to either be a NDArray, list of NDArray or "
+                         "a dict of str to NDArray")
+    # pass a file object so numpy does not append ".npz" — checkpoint file
+    # names must match what the caller asked for (model.py save_checkpoint)
+    with open(fname, "wb") as f:
+        np.savez(f, **npd)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` (reference: mx.nd.load)."""
+    try:
+        npz = np.load(fname, allow_pickle=False)
+    except (zipfile.BadZipFile, ValueError) as e:
+        raise IOError("cannot parse %r as an NDArray archive: %s" % (fname, e))
+    keys = list(npz.keys())
+    if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+        keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))
+        return [array(npz[k]) for k in keys]
+    return {k: array(npz[k]) for k in keys}

@@ -1,0 +1,19 @@
+"""Operator library — registration side.
+
+Importing this package registers every op into :mod:`.registry`; the
+``mx.nd.*`` / ``mx.sym.*`` namespaces are then generated from the registry
+(reference pattern: python/mxnet/ndarray/register.py codegen-at-import over
+the NNVM registry).
+"""
+from . import registry
+from .registry import OP_REGISTRY, get_op, list_ops, register_op
+
+# op definition modules — import order is registration order only
+from . import elemwise
+from . import broadcast_reduce
+from . import matrix
+from . import init_ops
+from . import indexing
+from . import nn
+from . import optimizer_ops
+from . import random_ops

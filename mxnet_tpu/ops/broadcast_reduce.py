@@ -1,0 +1,191 @@
+"""Broadcasting binary ops and axis reductions.
+
+Reference: src/operator/tensor/elemwise_binary_broadcast_op_*.cc and
+broadcast_reduce_op_{value,index}.cc. Broadcasting and reduction both lower to
+single XLA HLO ops; the reference's hand-written reduce kernels and workspace
+logic are the compiler's job here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Bool, Float, Int, Shape, Str
+from .registry import register_op, alias_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _bcast_infer(attrs, in_shapes, aux_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return None
+    out = tuple(np.broadcast_shapes(a, b))
+    return ([a, b], [out], aux_shapes)
+
+
+def _register_broadcast_binary():
+    jnp = _jnp()
+    table = {
+        "broadcast_add": lambda a, b: a + b,
+        "broadcast_sub": lambda a, b: a - b,
+        "broadcast_mul": lambda a, b: a * b,
+        "broadcast_div": lambda a, b: a / b,
+        "broadcast_mod": lambda a, b: jnp.mod(a, b),
+        "broadcast_power": lambda a, b: jnp.power(a, b),
+        "broadcast_maximum": lambda a, b: jnp.maximum(a, b),
+        "broadcast_minimum": lambda a, b: jnp.minimum(a, b),
+        "broadcast_hypot": lambda a, b: jnp.hypot(a, b),
+        "broadcast_equal": lambda a, b: (a == b).astype(a.dtype),
+        "broadcast_not_equal": lambda a, b: (a != b).astype(a.dtype),
+        "broadcast_greater": lambda a, b: (a > b).astype(a.dtype),
+        "broadcast_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+        "broadcast_lesser": lambda a, b: (a < b).astype(a.dtype),
+        "broadcast_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    }
+    for name, f in table.items():
+        def fn(attrs, a, b, _f=f):
+            return _f(a, b)
+
+        register_op(name, fn, num_inputs=2, input_names=["lhs", "rhs"],
+                    infer_shape=_bcast_infer)
+    alias_op("broadcast_add", "broadcast_plus")
+    alias_op("broadcast_sub", "broadcast_minus")
+
+
+def _norm_axes(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reduce_infer(attrs, in_shapes, aux_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return None
+    axes = _norm_axes(attrs.axis, len(s), attrs.exclude)
+    if attrs.keepdims:
+        out = tuple(1 if i in axes else d for i, d in enumerate(s))
+    else:
+        out = tuple(d for i, d in enumerate(s) if i not in axes)
+    return ([s], [out], aux_shapes)
+
+
+_REDUCE_PARAMS = {
+    "axis": Shape(default=None),
+    "keepdims": Bool(default=False),
+    "exclude": Bool(default=False),
+}
+
+
+def _register_reductions():
+    jnp = _jnp()
+    table = {
+        "sum": lambda x, ax, kd: jnp.sum(x, axis=ax, keepdims=kd),
+        "mean": lambda x, ax, kd: jnp.mean(x, axis=ax, keepdims=kd),
+        "prod": lambda x, ax, kd: jnp.prod(x, axis=ax, keepdims=kd),
+        "nansum": lambda x, ax, kd: jnp.nansum(x, axis=ax, keepdims=kd),
+        "nanprod": lambda x, ax, kd: jnp.nanprod(x, axis=ax, keepdims=kd),
+        "max": lambda x, ax, kd: jnp.max(x, axis=ax, keepdims=kd),
+        "min": lambda x, ax, kd: jnp.min(x, axis=ax, keepdims=kd),
+    }
+    for name, f in table.items():
+        def fn(attrs, x, _f=f):
+            axes = _norm_axes(attrs.axis, x.ndim, attrs.exclude)
+            return _f(x, axes, attrs.keepdims)
+
+        register_op(name, fn, params=dict(_REDUCE_PARAMS), num_inputs=1,
+                    infer_shape=_reduce_infer)
+    alias_op("sum", "sum_axis")
+    alias_op("max", "max_axis")
+    alias_op("min", "min_axis")
+
+    def norm(attrs, x):
+        return jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,))
+
+    register_op("norm", norm, num_inputs=1,
+                infer_shape=lambda attrs, i, a: ([i[0]], [(1,)], a) if i[0] else None,
+                doc="L2 norm over all elements (reference: broadcast_reduce_op_value.cc norm)")
+
+
+def _register_arg_reductions():
+    """argmax/argmin (reference: broadcast_reduce_op_index.cc). MXNet returns
+    float32 indices; we preserve that quirk for parity."""
+    jnp = _jnp()
+
+    def _arg_infer(attrs, in_shapes, aux_shapes):
+        (s,) = in_shapes
+        if s is None:
+            return None
+        if attrs.axis is None:
+            out = (1,) if not attrs.keepdims else tuple(1 for _ in s)
+        else:
+            ax = attrs.axis % len(s)
+            if attrs.keepdims:
+                out = tuple(1 if i == ax else d for i, d in enumerate(s))
+            else:
+                out = tuple(d for i, d in enumerate(s) if i != ax)
+        return ([s], [out], aux_shapes)
+
+    for name, f in (("argmax", jnp.argmax), ("argmin", jnp.argmin)):
+        def fn(attrs, x, _f=f):
+            if attrs.axis is None:
+                out = _f(x.reshape(-1)).astype(jnp.float32)
+                return out.reshape((1,) * x.ndim) if attrs.keepdims else out.reshape((1,))
+            return _f(x, axis=attrs.axis, keepdims=attrs.keepdims).astype(jnp.float32)
+
+        register_op(name, fn,
+                    params={"axis": Int(default=None), "keepdims": Bool(default=False)},
+                    num_inputs=1, infer_shape=_arg_infer,
+                    infer_dtype=lambda attrs, i, a: (i, ["float32"], a))
+
+    def argmax_channel(attrs, x):
+        return jnp.argmax(x, axis=-1).astype(jnp.float32)
+
+    register_op("argmax_channel", argmax_channel, num_inputs=1,
+                infer_shape=lambda attrs, i, a: ([i[0]], [i[0][:-1]], a) if i[0] else None,
+                infer_dtype=lambda attrs, i, a: (i, ["float32"], a))
+
+
+def _register_broadcast_shape_ops():
+    jnp = _jnp()
+
+    def broadcast_to(attrs, x):
+        # 0 in target shape means "keep input dim" (reference broadcast_to)
+        tgt = tuple(d if t == 0 else t for d, t in zip(x.shape, attrs.shape))
+        return jnp.broadcast_to(x, tgt)
+
+    register_op("broadcast_to", broadcast_to, params={"shape": Shape()},
+                num_inputs=1,
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else
+                    ([i[0]], [tuple(d if t == 0 else t
+                                    for d, t in zip(i[0], attrs.shape))], a)))
+
+    def broadcast_axis(attrs, x):
+        tgt = list(x.shape)
+        axes = attrs.axis if isinstance(attrs.axis, tuple) else (attrs.axis,)
+        sizes = attrs.size if isinstance(attrs.size, tuple) else (attrs.size,)
+        for ax, sz in zip(axes, sizes):
+            tgt[ax] = sz
+        return jnp.broadcast_to(x, tuple(tgt))
+
+    register_op("broadcast_axis", broadcast_axis,
+                params={"axis": Shape(default=()), "size": Shape(default=())},
+                num_inputs=1)
+    alias_op("broadcast_axis", "broadcast_axes")
+
+
+_register_broadcast_binary()
+_register_reductions()
+_register_arg_reductions()
+_register_broadcast_shape_ops()

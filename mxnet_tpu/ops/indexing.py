@@ -1,0 +1,104 @@
+"""Indexing ops: take/Embedding/one_hot/pick/gather-style
+(reference: src/operator/tensor/indexing_op.cc). On TPU these are XLA
+gather/scatter — the reference's hand CUDA kernels (AddTakeGrad etc.) become
+the transpose of gather, which XLA derives automatically.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .registry import register_op, alias_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _register():
+    jnp = _jnp()
+
+    def take(attrs, a, indices):
+        idx = indices.astype(jnp.int32)
+        if attrs.mode == "clip":
+            idx = jnp.clip(idx, 0, a.shape[attrs.axis] - 1)
+        elif attrs.mode == "wrap":
+            idx = jnp.mod(idx, a.shape[attrs.axis])
+        return jnp.take(a, idx, axis=attrs.axis)
+
+    def take_infer(attrs, in_shapes, aux_shapes):
+        a, idx = in_shapes
+        if a is None or idx is None:
+            return None
+        ax = attrs.axis % len(a)
+        out = a[:ax] + tuple(idx) + a[ax + 1:]
+        return ([a, idx], [out], aux_shapes)
+
+    register_op("take", take,
+                params={"axis": Int(default=0),
+                        "mode": Enum(["clip", "wrap", "raise"], default="clip")},
+                num_inputs=2, input_names=["a", "indices"], infer_shape=take_infer)
+
+    def embedding(attrs, data, weight):
+        idx = jnp.clip(data.astype(jnp.int32), 0, attrs.input_dim - 1)
+        return jnp.take(weight, idx, axis=0)
+
+    def embedding_infer(attrs, in_shapes, aux_shapes):
+        d, w = in_shapes
+        if d is None:
+            return None
+        w = (attrs.input_dim, attrs.output_dim)
+        return ([d, w], [tuple(d) + (attrs.output_dim,)], aux_shapes)
+
+    register_op("Embedding", embedding,
+                params={"input_dim": Int(), "output_dim": Int(),
+                        "dtype": DType(default="float32")},
+                num_inputs=2, input_names=["data", "weight"],
+                infer_shape=embedding_infer,
+                doc="Embedding lookup → XLA gather (reference: indexing_op.cc "
+                    "Embedding; grad is scatter-add instead of AddTakeGrad)")
+
+    def one_hot(attrs, indices):
+        import jax
+
+        out = jax.nn.one_hot(indices.astype(jnp.int32), attrs.depth,
+                             dtype=jnp.float32)
+        return out * (attrs.on_value - attrs.off_value) + attrs.off_value
+
+    register_op("one_hot", one_hot,
+                params={"depth": Int(), "on_value": Float(default=1.0),
+                        "off_value": Float(default=0.0),
+                        "dtype": DType(default="float32")},
+                num_inputs=1, input_names=["indices"],
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else ([i[0]], [tuple(i[0]) + (attrs.depth,)], a)))
+
+    def pick(attrs, data, index):
+        ax = (attrs.axis if attrs.axis is not None else data.ndim - 1) % data.ndim
+        idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+        idx_exp = jnp.expand_dims(idx, ax) if idx.ndim < data.ndim else idx
+        out = jnp.take_along_axis(data, idx_exp.astype(jnp.int32), axis=ax)
+        if not attrs.keepdims:
+            out = jnp.squeeze(out, axis=ax)
+        return out
+
+    def pick_infer(attrs, in_shapes, aux_shapes):
+        d, idx = in_shapes
+        if d is None:
+            return None
+        ax = (attrs.axis if attrs.axis is not None else len(d) - 1) % len(d)
+        out = tuple(x for i, x in enumerate(d) if i != ax)
+        if attrs.keepdims:
+            out = tuple(1 if i == ax else x for i, x in enumerate(d))
+        return ([d, out if idx is None else idx], [out], aux_shapes)
+
+    register_op("pick", pick,
+                params={"axis": Int(default=-1), "keepdims": Bool(default=False)},
+                num_inputs=2, input_names=["data", "index"],
+                infer_shape=pick_infer)
+    alias_op("pick", "choose_element_0index")
+
+
+_register()

@@ -1,0 +1,71 @@
+"""Creation ops (reference: src/operator/tensor/init_op.cc — _zeros/_ones/
+_full/_arange). These back ``mx.sym.zeros``-style symbols and internal graph
+nodes; the eager ``mx.nd.zeros`` fast path lives in ndarray.py."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import np_dtype
+from .param import Bool, Float, Int, Shape, Str, DType
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _register():
+    jnp = _jnp()
+
+    def _zeros(attrs):
+        return jnp.zeros(attrs.shape, dtype=np_dtype(attrs.dtype))
+
+    register_op("_zeros", _zeros,
+                params={"shape": Shape(default=()), "ctx": Str(default=""),
+                        "dtype": DType(default="float32")},
+                num_inputs=0, input_names=[],
+                infer_shape=lambda attrs, i, a: ([], [tuple(attrs.shape)], a),
+                infer_dtype=lambda attrs, i, a: ([], [attrs.dtype], a))
+
+    def _ones(attrs):
+        return jnp.ones(attrs.shape, dtype=np_dtype(attrs.dtype))
+
+    register_op("_ones", _ones,
+                params={"shape": Shape(default=()), "ctx": Str(default=""),
+                        "dtype": DType(default="float32")},
+                num_inputs=0, input_names=[],
+                infer_shape=lambda attrs, i, a: ([], [tuple(attrs.shape)], a),
+                infer_dtype=lambda attrs, i, a: ([], [attrs.dtype], a))
+
+    def _full(attrs):
+        return jnp.full(attrs.shape, attrs.value, dtype=np_dtype(attrs.dtype))
+
+    register_op("_full", _full,
+                params={"shape": Shape(default=()), "ctx": Str(default=""),
+                        "dtype": DType(default="float32"), "value": Float()},
+                num_inputs=0, input_names=[],
+                infer_shape=lambda attrs, i, a: ([], [tuple(attrs.shape)], a),
+                infer_dtype=lambda attrs, i, a: ([], [attrs.dtype], a))
+
+    def _arange(attrs):
+        stop = attrs.stop
+        a = jnp.arange(attrs.start, stop, attrs.step, dtype=np_dtype(attrs.dtype))
+        if attrs.repeat != 1:
+            a = jnp.repeat(a, attrs.repeat)
+        return a
+
+    def _arange_shape(attrs, i, a):
+        n = len(np.arange(attrs.start, attrs.stop, attrs.step)) * attrs.repeat
+        return ([], [(n,)], a)
+
+    register_op("_arange", _arange,
+                params={"start": Float(default=0.0), "stop": Float(default=None),
+                        "step": Float(default=1.0), "repeat": Int(default=1),
+                        "ctx": Str(default=""), "dtype": DType(default="float32")},
+                num_inputs=0, input_names=[], infer_shape=_arange_shape,
+                infer_dtype=lambda attrs, i, a: ([], [attrs.dtype], a))
+
+
+_register()

@@ -1,0 +1,367 @@
+"""Matrix / shape-manipulation ops.
+
+Reference: src/operator/tensor/matrix_op.cc (Reshape/Flatten/transpose/slice/
+dot/batch_dot/clip/repeat/tile/reverse/Concat/SliceChannel...). ``dot`` and
+``batch_dot`` lower to XLA DotGeneral — the MXU path; everything else is
+metadata-only or a cheap data movement XLA handles natively.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .registry import register_op, alias_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# --- reshape family ---------------------------------------------------------
+
+def _apply_reshape_codes(cur, shape, reverse=False):
+    """Implement MXNet Reshape's special codes 0, -1, -2, -3, -4
+    (reference: matrix_op.cc ReshapeShape)."""
+    if reverse:
+        cur = tuple(reversed(cur))
+        shape = tuple(reversed(shape))
+    out = []
+    i = 0  # index into cur
+    si = 0
+    while si < len(shape):
+        s = shape[si]
+        if s == 0:
+            out.append(cur[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(cur[i:]); i = len(cur)
+        elif s == -3:
+            out.append(cur[i] * cur[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[si + 1], shape[si + 2]
+            d = cur[i]
+            if a == -1:
+                a = d // b
+            if b == -1:
+                b = d // a
+            out.extend([a, b]); i += 1; si += 2
+        else:
+            out.append(int(s)); i += 1
+        si += 1
+    if out.count(-1) > 1:
+        raise MXNetError("more than one -1 in reshape")
+    if reverse:
+        out = list(reversed(out))
+    return tuple(out)
+
+
+def _register_reshape():
+    jnp = _jnp()
+
+    def reshape(attrs, x):
+        tgt = _apply_reshape_codes(x.shape, attrs.shape, attrs.reverse)
+        return x.reshape(tgt)
+
+    def reshape_infer(attrs, in_shapes, aux_shapes):
+        (s,) = in_shapes
+        if s is None:
+            return None
+        tgt = list(_apply_reshape_codes(s, attrs.shape, attrs.reverse))
+        if -1 in tgt:
+            known = int(np.prod([d for d in tgt if d != -1])) or 1
+            tgt[tgt.index(-1)] = int(np.prod(s)) // known
+        return ([s], [tuple(tgt)], aux_shapes)
+
+    register_op("Reshape", reshape,
+                params={"shape": Shape(default=()), "reverse": Bool(default=False),
+                        "target_shape": Shape(default=None),
+                        "keep_highest": Bool(default=False)},
+                num_inputs=1, infer_shape=reshape_infer)
+    alias_op("Reshape", "reshape")
+
+    def flatten(attrs, x):
+        return x.reshape((x.shape[0], int(np.prod(x.shape[1:])) if x.ndim > 1 else 1))
+
+    register_op("Flatten", flatten, num_inputs=1,
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else
+                    ([i[0]], [(i[0][0], int(np.prod(i[0][1:])) if len(i[0]) > 1 else 1)], a)))
+    alias_op("Flatten", "flatten")
+
+    def expand_dims(attrs, x):
+        return jnp.expand_dims(x, attrs.axis)
+
+    register_op("expand_dims", expand_dims, params={"axis": Int()}, num_inputs=1)
+
+    def transpose(attrs, x):
+        axes = attrs.axes if attrs.axes else None
+        return jnp.transpose(x, axes)
+
+    register_op("transpose", transpose, params={"axes": Shape(default=())},
+                num_inputs=1)
+
+    def swapaxis(attrs, x):
+        return jnp.swapaxes(x, attrs.dim1, attrs.dim2)
+
+    register_op("SwapAxis", swapaxis,
+                params={"dim1": Int(default=0), "dim2": Int(default=0)}, num_inputs=1)
+    alias_op("SwapAxis", "swapaxes")
+
+    def cast(attrs, x):
+        from ..base import np_dtype
+
+        return x.astype(np_dtype(attrs.dtype))
+
+    register_op("Cast", cast, params={"dtype": DType()}, num_inputs=1,
+                infer_dtype=lambda attrs, i, a: (i, [attrs.dtype], a))
+    alias_op("Cast", "cast")
+
+
+# --- slicing ----------------------------------------------------------------
+
+def _register_slice():
+    jnp = _jnp()
+
+    def _slice_bounds(shape, begin, end, step=None):
+        idx = []
+        for i, d in enumerate(shape):
+            b = begin[i] if i < len(begin) and begin[i] is not None else 0
+            e = end[i] if i < len(end) and end[i] is not None else d
+            s = 1
+            if step and i < len(step) and step[i] is not None:
+                s = step[i]
+            idx.append(slice(b, e, s))
+        return tuple(idx)
+
+    def slice_op(attrs, x):
+        return x[_slice_bounds(x.shape, attrs.begin, attrs.end, attrs.step)]
+
+    def slice_infer(attrs, in_shapes, aux_shapes):
+        (s,) = in_shapes
+        if s is None:
+            return None
+        out = tuple(len(range(*sl.indices(d)))
+                    for sl, d in zip(_slice_bounds(s, attrs.begin, attrs.end,
+                                                   attrs.step), s))
+        return ([s], [out], aux_shapes)
+
+    register_op("slice", slice_op,
+                params={"begin": Shape(), "end": Shape(), "step": Shape(default=None)},
+                num_inputs=1, infer_shape=slice_infer)
+    alias_op("slice", "crop")
+
+    def slice_axis(attrs, x):
+        ax = attrs.axis % x.ndim
+        end = attrs.end if attrs.end is not None else x.shape[ax]
+        idx = [slice(None)] * x.ndim
+        idx[ax] = slice(attrs.begin, end)
+        return x[tuple(idx)]
+
+    register_op("slice_axis", slice_axis,
+                params={"axis": Int(), "begin": Int(default=0), "end": Int(default=None)},
+                num_inputs=1)
+
+    def reverse(attrs, x):
+        return jnp.flip(x, axis=attrs.axis)
+
+    register_op("reverse", reverse, params={"axis": Shape()}, num_inputs=1,
+                infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a))
+    alias_op("reverse", "flip")
+
+    def repeat(attrs, x):
+        return jnp.repeat(x, attrs.repeats, axis=attrs.axis)
+
+    register_op("repeat", repeat,
+                params={"repeats": Int(), "axis": Int(default=None)}, num_inputs=1)
+
+    def tile(attrs, x):
+        return jnp.tile(x, attrs.reps)
+
+    register_op("tile", tile, params={"reps": Shape()}, num_inputs=1)
+
+    def clip(attrs, x):
+        return jnp.clip(x, attrs.a_min, attrs.a_max)
+
+    register_op("clip", clip, params={"a_min": Float(), "a_max": Float()},
+                num_inputs=1, infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else ([i[0]], [i[0]], a)))
+
+
+# --- dot --------------------------------------------------------------------
+
+def _register_dot():
+    jnp = _jnp()
+
+    def dot(attrs, a, b):
+        if attrs.transpose_a:
+            a = a.T if a.ndim == 2 else jnp.moveaxis(a, 0, -1)
+        if attrs.transpose_b:
+            b = b.T if b.ndim == 2 else jnp.moveaxis(b, -1, 0)
+        return jnp.dot(a, b)
+
+    def dot_infer(attrs, in_shapes, aux_shapes):
+        a, b = in_shapes
+        if a is None or b is None:
+            return None
+        ash = tuple(reversed(a)) if attrs.transpose_a else a
+        bsh = tuple(reversed(b)) if attrs.transpose_b else b
+        out = ash[:-1] + bsh[1:]
+        return ([a, b], [out], aux_shapes)
+
+    register_op("dot", dot,
+                params={"transpose_a": Bool(default=False),
+                        "transpose_b": Bool(default=False)},
+                num_inputs=2, input_names=["lhs", "rhs"], infer_shape=dot_infer,
+                doc="Dot product → XLA DotGeneral on the MXU "
+                    "(reference: src/operator/tensor/dot.cc)")
+
+    def batch_dot(attrs, a, b):
+        if attrs.transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if attrs.transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    register_op("batch_dot", batch_dot,
+                params={"transpose_a": Bool(default=False),
+                        "transpose_b": Bool(default=False)},
+                num_inputs=2, input_names=["lhs", "rhs"])
+
+
+# --- concat / split / stack--------------------------------------------------
+
+def _register_concat_split():
+    jnp = _jnp()
+
+    def concat(attrs, *xs):
+        return jnp.concatenate(xs, axis=attrs.dim)
+
+    def concat_infer(attrs, in_shapes, aux_shapes):
+        if any(s is None for s in in_shapes):
+            return None
+        d = attrs.dim
+        out = list(in_shapes[0])
+        out[d] = sum(s[d] for s in in_shapes)
+        return (list(in_shapes), [tuple(out)], aux_shapes)
+
+    register_op("Concat", concat,
+                params={"num_args": Int(default=1), "dim": Int(default=1)},
+                num_inputs=lambda attrs: attrs.num_args,
+                input_names=lambda attrs: ["arg%d" % i for i in range(attrs.num_args)],
+                infer_shape=concat_infer)
+    alias_op("Concat", "concat")
+
+    def slice_channel(attrs, x):
+        ax = attrs.axis % x.ndim
+        parts = jnp.split(x, attrs.num_outputs, axis=ax)
+        if attrs.squeeze_axis:
+            parts = [jnp.squeeze(p, axis=ax) for p in parts]
+        return tuple(parts)
+
+    register_op("SliceChannel", slice_channel,
+                params={"num_outputs": Int(), "axis": Int(default=1),
+                        "squeeze_axis": Bool(default=False)},
+                num_inputs=1, num_outputs=lambda attrs: attrs.num_outputs)
+    alias_op("SliceChannel", "split")
+
+    def stack(attrs, *xs):
+        return jnp.stack(xs, axis=attrs.axis)
+
+    register_op("stack", stack,
+                params={"num_args": Int(default=1), "axis": Int(default=0)},
+                num_inputs=lambda attrs: attrs.num_args,
+                input_names=lambda attrs: ["arg%d" % i for i in range(attrs.num_args)])
+
+    def where(attrs, cond, a, b):
+        return jnp.where(cond != 0, a, b)
+
+    register_op("where", where, num_inputs=3,
+                input_names=["condition", "x", "y"])
+
+
+# --- zeros_like etc ---------------------------------------------------------
+
+def _register_like_ops():
+    jnp = _jnp()
+
+    register_op("zeros_like", lambda attrs, x: jnp.zeros_like(x), num_inputs=1)
+    register_op("ones_like", lambda attrs, x: jnp.ones_like(x), num_inputs=1)
+
+    def reshape_like(attrs, a, b):
+        return a.reshape(b.shape)
+
+    register_op("reshape_like", reshape_like, num_inputs=2,
+                input_names=["lhs", "rhs"])
+
+
+# --- ordering ---------------------------------------------------------------
+
+def _register_ordering():
+    """topk/sort/argsort (reference: src/operator/tensor/ordering_op.cc).
+    XLA sort replaces the cub/thrust device kernels."""
+    jnp = _jnp()
+
+    def sort(attrs, x):
+        ax = x.ndim - 1 if attrs.axis is None else attrs.axis
+        y = jnp.sort(x, axis=ax)
+        return y if attrs.is_ascend else jnp.flip(y, axis=ax)
+
+    register_op("sort", sort,
+                params={"axis": Int(default=-1), "is_ascend": Bool(default=True)},
+                num_inputs=1)
+
+    def argsort(attrs, x):
+        ax = x.ndim - 1 if attrs.axis is None else attrs.axis
+        y = jnp.argsort(x, axis=ax)
+        if not attrs.is_ascend:
+            y = jnp.flip(y, axis=ax)
+        return y.astype(jnp.float32)
+
+    register_op("argsort", argsort,
+                params={"axis": Int(default=-1), "is_ascend": Bool(default=True)},
+                num_inputs=1, infer_dtype=lambda attrs, i, a: (i, ["float32"], a))
+
+    def topk(attrs, x):
+        ax = x.ndim - 1 if attrs.axis is None else attrs.axis % x.ndim
+        k = attrs.k
+        xm = jnp.moveaxis(x, ax, -1)
+        if attrs.is_ascend:
+            vals, idx = jax_lax_topk(-xm, k)
+            vals = -vals
+        else:
+            vals, idx = jax_lax_topk(xm, k)
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+        if attrs.ret_typ == "value":
+            return vals
+        if attrs.ret_typ == "indices":
+            return idx
+        if attrs.ret_typ == "both":
+            return (vals, idx)
+        # mask
+        raise MXNetError("topk ret_typ=mask not supported yet")
+
+    def jax_lax_topk(x, k):
+        import jax
+
+        return jax.lax.top_k(x, k)
+
+    register_op("topk", topk,
+                params={"axis": Int(default=-1), "k": Int(default=1),
+                        "ret_typ": Enum(["value", "indices", "mask", "both"],
+                                        default="indices"),
+                        "is_ascend": Bool(default=False)},
+                num_inputs=1,
+                num_outputs=lambda attrs: 2 if attrs.ret_typ == "both" else 1)
+
+
+_register_reshape()
+_register_slice()
+_register_dot()
+_register_concat_split()
+_register_like_ops()
+_register_ordering()

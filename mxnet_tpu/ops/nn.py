@@ -1,0 +1,735 @@
+"""Neural-network layer ops — the MXU-bound kernels.
+
+Reference: src/operator/{fully_connected,convolution,pooling,activation,
+batch_norm,dropout,softmax_output,leaky_relu,...}-inl.h (legacy
+OperatorProperty style, SURVEY.md §2.4). Implementations are jax.lax
+convolutions/reductions that XLA tiles onto the MXU; cuDNN algorithm
+selection, workspace management and layout conversion all disappear — XLA
+owns them. Loss heads (SoftmaxOutput, *RegressionOutput, MakeLoss) use
+``jax.custom_vjp`` to reproduce MXNet's semantics of ignoring the incoming
+head gradient and injecting the loss gradient directly
+(src/operator/softmax_output-inl.h backward).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..base import MXNetError
+from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .registry import register_op, alias_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# --- FullyConnected ---------------------------------------------------------
+
+def _register_fc():
+    jnp = _jnp()
+
+    def fully_connected(attrs, data, weight, *rest):
+        x = data.reshape((data.shape[0], -1)) if attrs.flatten else data
+        y = jnp.dot(x, weight.T)
+        if not attrs.no_bias:
+            y = y + rest[0]
+        return y
+
+    def fc_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        in_dim = int(np.prod(d[1:])) if attrs.flatten else d[-1]
+        w = (attrs.num_hidden, in_dim)
+        shapes = [d, w] + ([] if attrs.no_bias else [(attrs.num_hidden,)])
+        out = (d[0], attrs.num_hidden) if attrs.flatten else d[:-1] + (attrs.num_hidden,)
+        return (shapes, [out], aux_shapes)
+
+    register_op(
+        "FullyConnected", fully_connected,
+        params={"num_hidden": Int(), "no_bias": Bool(default=False),
+                "flatten": Bool(default=True)},
+        num_inputs=lambda attrs: 2 if attrs.no_bias else 3,
+        input_names=lambda attrs: ["data", "weight"] + ([] if attrs.no_bias else ["bias"]),
+        infer_shape=fc_infer,
+        doc="y = x·Wᵀ + b on the MXU (reference: src/operator/fully_connected-inl.h; "
+            "weight layout (num_hidden, in_dim) preserved)")
+
+
+# --- Convolution ------------------------------------------------------------
+
+def _conv_dims(nd):
+    """Dimension-number strings for N-d convolution in MXNet's NC... layout."""
+    spatial = "DHW"[-nd:]
+    return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+
+
+def _register_conv():
+    import jax
+
+    jnp = _jnp()
+
+    def convolution(attrs, data, weight, *rest):
+        nd = len(attrs.kernel)
+        stride = attrs.stride or (1,) * nd
+        dilate = attrs.dilate or (1,) * nd
+        pad = attrs.pad or (0,) * nd
+        out = jax.lax.conv_general_dilated(
+            data, weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=_conv_dims(nd),
+            feature_group_count=attrs.num_group,
+        )
+        if not attrs.no_bias:
+            out = out + rest[0].reshape((1, -1) + (1,) * nd)
+        return out
+
+    def conv_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        nd = len(attrs.kernel)
+        stride = attrs.stride or (1,) * nd
+        dilate = attrs.dilate or (1,) * nd
+        pad = attrs.pad or (0,) * nd
+        c = d[1]
+        w = (attrs.num_filter, c // attrs.num_group) + tuple(attrs.kernel)
+        spatial = tuple(
+            (d[2 + i] + 2 * pad[i] - dilate[i] * (attrs.kernel[i] - 1) - 1) // stride[i] + 1
+            for i in range(nd))
+        out = (d[0], attrs.num_filter) + spatial
+        shapes = [d, w] + ([] if attrs.no_bias else [(attrs.num_filter,)])
+        return (shapes, [out], aux_shapes)
+
+    register_op(
+        "Convolution", convolution,
+        params={"kernel": Shape(), "stride": Shape(default=()),
+                "dilate": Shape(default=()), "pad": Shape(default=()),
+                "num_filter": Int(), "num_group": Int(default=1),
+                "workspace": Int(default=1024), "no_bias": Bool(default=False),
+                "cudnn_tune": Str(default=None), "cudnn_off": Bool(default=False),
+                "layout": Str(default=None)},
+        num_inputs=lambda attrs: 2 if attrs.no_bias else 3,
+        input_names=lambda attrs: ["data", "weight"] + ([] if attrs.no_bias else ["bias"]),
+        infer_shape=conv_infer,
+        doc="N-d convolution → XLA ConvGeneralDilated on the MXU (reference: "
+            "src/operator/convolution-inl.h; cudnn_* params accepted and ignored)")
+
+    def deconvolution(attrs, data, weight, *rest):
+        nd = len(attrs.kernel)
+        stride = attrs.stride or (1,) * nd
+        pad = attrs.pad or (0,) * nd
+        adj = attrs.adj or (0,) * nd
+        # transposed conv = lhs-dilated conv with flipped kernel semantics;
+        # conv_transpose handles it directly
+        out = jax.lax.conv_transpose(
+            data, weight,
+            strides=stride,
+            padding=[(p, p - a) for p, a in zip(pad, adj)],
+            dimension_numbers=_conv_dims(nd),
+            transpose_kernel=True,
+        )
+        if not attrs.no_bias:
+            out = out + rest[0].reshape((1, -1) + (1,) * nd)
+        return out
+
+    def deconv_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        nd = len(attrs.kernel)
+        stride = attrs.stride or (1,) * nd
+        pad = attrs.pad or (0,) * nd
+        adj = attrs.adj or (0,) * nd
+        c = d[1]
+        w = (c, attrs.num_filter // attrs.num_group) + tuple(attrs.kernel)
+        spatial = tuple(
+            stride[i] * (d[2 + i] - 1) + attrs.kernel[i] - 2 * pad[i] + adj[i]
+            for i in range(nd))
+        out = (d[0], attrs.num_filter) + spatial
+        shapes = [d, w] + ([] if attrs.no_bias else [(attrs.num_filter,)])
+        return (shapes, [out], aux_shapes)
+
+    register_op(
+        "Deconvolution", deconvolution,
+        params={"kernel": Shape(), "stride": Shape(default=()),
+                "dilate": Shape(default=()), "pad": Shape(default=()),
+                "adj": Shape(default=()), "target_shape": Shape(default=()),
+                "num_filter": Int(), "num_group": Int(default=1),
+                "workspace": Int(default=512), "no_bias": Bool(default=True),
+                "cudnn_tune": Str(default=None), "cudnn_off": Bool(default=False),
+                "layout": Str(default=None)},
+        num_inputs=lambda attrs: 2 if attrs.no_bias else 3,
+        input_names=lambda attrs: ["data", "weight"] + ([] if attrs.no_bias else ["bias"]),
+        infer_shape=deconv_infer,
+        doc="Transposed convolution (reference: src/operator/deconvolution-inl.h)")
+
+
+# --- Pooling ----------------------------------------------------------------
+
+def _register_pool():
+    import jax
+
+    jnp = _jnp()
+
+    def _pool_pads(in_sizes, kernel, stride, pad, convention):
+        pads = []
+        for i, n in enumerate(in_sizes):
+            k, s, p = kernel[i], stride[i], pad[i]
+            if convention == "full":
+                out = int(np.ceil((n + 2 * p - k) / s)) + 1
+                need = (out - 1) * s + k - n - 2 * p
+                pads.append((p, p + max(0, need)))
+            else:
+                pads.append((p, p))
+        return pads
+
+    def pooling(attrs, data):
+        nd = len(attrs.kernel) if attrs.kernel else data.ndim - 2
+        kernel = attrs.kernel if not attrs.global_pool else data.shape[2:]
+        stride = (attrs.stride or (1,) * nd) if not attrs.global_pool else (1,) * nd
+        pad = (attrs.pad or (0,) * nd) if not attrs.global_pool else (0,) * nd
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        pads = [(0, 0), (0, 0)] + _pool_pads(data.shape[2:], kernel, stride, pad,
+                                             attrs.pooling_convention)
+        if attrs.pool_type == "max":
+            init = -jnp.inf
+            out = jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+        elif attrs.pool_type in ("avg", "sum"):
+            out = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
+            if attrs.pool_type == "avg":
+                out = out / float(np.prod(kernel))
+        else:
+            raise MXNetError("unknown pool_type %r" % attrs.pool_type)
+        return out
+
+    def pool_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        if attrs.global_pool:
+            return ([d], [d[:2] + (1,) * (len(d) - 2)], aux_shapes)
+        nd = len(attrs.kernel)
+        stride = attrs.stride or (1,) * nd
+        pad = attrs.pad or (0,) * nd
+        spatial = []
+        for i in range(nd):
+            n, k, s, p = d[2 + i], attrs.kernel[i], stride[i], pad[i]
+            if attrs.pooling_convention == "full":
+                spatial.append(int(np.ceil((n + 2 * p - k) / s)) + 1)
+            else:
+                spatial.append((n + 2 * p - k) // s + 1)
+        return ([d], [d[:2] + tuple(spatial)], aux_shapes)
+
+    register_op(
+        "Pooling", pooling,
+        params={"kernel": Shape(default=()), "pool_type": Enum(["max", "avg", "sum"],
+                                                               default="max"),
+                "global_pool": Bool(default=False),
+                "pooling_convention": Enum(["valid", "full"], default="valid"),
+                "stride": Shape(default=()), "pad": Shape(default=()),
+                "cudnn_off": Bool(default=False)},
+        num_inputs=1, infer_shape=pool_infer,
+        doc="Max/avg/sum pooling → XLA ReduceWindow (reference: "
+            "src/operator/pooling-inl.h; avg divides by kernel size incl. padding)")
+
+
+# --- Activations ------------------------------------------------------------
+
+def _register_act():
+    import jax
+
+    jnp = _jnp()
+
+    def activation(attrs, x):
+        t = attrs.act_type
+        if t == "relu":
+            return jnp.maximum(x, 0)
+        if t == "sigmoid":
+            return jax.nn.sigmoid(x)
+        if t == "tanh":
+            return jnp.tanh(x)
+        if t == "softrelu":
+            return jax.nn.softplus(x)
+        raise MXNetError("unknown act_type %r" % t)
+
+    register_op("Activation", activation,
+                params={"act_type": Enum(["relu", "sigmoid", "tanh", "softrelu"])},
+                num_inputs=1,
+                infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a),
+                doc="Activation (reference: src/operator/activation-inl.h)")
+
+    def leaky_relu(attrs, x, *rest):
+        t = attrs.act_type
+        if t == "leaky":
+            return jnp.where(x > 0, x, attrs.slope * x)
+        if t == "elu":
+            return jnp.where(x > 0, x, attrs.slope * (jnp.exp(x) - 1))
+        if t == "prelu":
+            gamma = rest[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+            return jnp.where(x > 0, x, gamma * x)
+        raise MXNetError("act_type %r not supported" % t)
+
+    def lrelu_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        if attrs.act_type == "prelu":
+            return ([d, (d[1],)], [d], aux_shapes)
+        return ([d], [d], aux_shapes)
+
+    register_op("LeakyReLU", leaky_relu,
+                params={"act_type": Enum(["rrelu", "leaky", "prelu", "elu"],
+                                         default="leaky"),
+                        "slope": Float(default=0.25),
+                        "lower_bound": Float(default=0.125),
+                        "upper_bound": Float(default=0.334)},
+                num_inputs=lambda attrs: 2 if attrs.act_type == "prelu" else 1,
+                input_names=lambda attrs: (["data", "gamma"]
+                                           if attrs.act_type == "prelu" else ["data"]),
+                infer_shape=lrelu_infer,
+                doc="Leaky/PReLU/ELU (reference: src/operator/leaky_relu-inl.h)")
+
+    def softmax(attrs, x):
+        import jax
+
+        z = x / attrs.temperature if attrs.temperature != 1.0 else x
+        return jax.nn.softmax(z, axis=attrs.axis)
+
+    register_op("softmax", softmax,
+                params={"axis": Int(default=-1), "temperature": Float(default=1.0)},
+                num_inputs=1,
+                infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a))
+
+    def log_softmax(attrs, x):
+        import jax
+
+        z = x / attrs.temperature if attrs.temperature != 1.0 else x
+        return jax.nn.log_softmax(z, axis=attrs.axis)
+
+    register_op("log_softmax", log_softmax,
+                params={"axis": Int(default=-1), "temperature": Float(default=1.0)},
+                num_inputs=1,
+                infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a))
+
+    def softmax_activation(attrs, x):
+        import jax
+
+        axis = 1 if attrs.mode == "channel" else -1
+        return jax.nn.softmax(x, axis=axis)
+
+    register_op("SoftmaxActivation", softmax_activation,
+                params={"mode": Enum(["instance", "channel"], default="instance")},
+                num_inputs=1)
+
+
+# --- BatchNorm --------------------------------------------------------------
+
+def _register_bn():
+    jnp = _jnp()
+
+    def batch_norm(attrs, data, gamma, beta, aux=(), is_train=False):
+        moving_mean, moving_var = aux
+        ax = attrs.axis
+        red_axes = tuple(i for i in range(data.ndim) if i != ax)
+        bshape = tuple(-1 if i == ax else 1 for i in range(data.ndim))
+        g = jnp.ones_like(gamma) if attrs.fix_gamma else gamma
+        if is_train and not attrs.use_global_stats:
+            mean = jnp.mean(data, axis=red_axes)
+            var = jnp.var(data, axis=red_axes)
+            import jax
+
+            m = attrs.momentum
+            new_mean = m * moving_mean + (1 - m) * jax.lax.stop_gradient(mean)
+            new_var = m * moving_var + (1 - m) * jax.lax.stop_gradient(var)
+            new_aux = (new_mean, new_var)
+        else:
+            mean, var = moving_mean, moving_var
+            new_aux = (moving_mean, moving_var)
+        out = (data - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + attrs.eps)
+        out = out * g.reshape(bshape) + beta.reshape(bshape)
+        if attrs.output_mean_var:
+            return (out, mean, var), new_aux
+        return (out,), new_aux
+
+    def bn_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        c = (d[attrs.axis],)
+        outs = [d] + ([c, c] if attrs.output_mean_var else [])
+        return ([d, c, c], outs, [c, c])
+
+    register_op(
+        "BatchNorm", batch_norm,
+        params={"eps": Float(default=1e-3), "momentum": Float(default=0.9),
+                "fix_gamma": Bool(default=True),
+                "use_global_stats": Bool(default=False),
+                "output_mean_var": Bool(default=False), "axis": Int(default=1),
+                "cudnn_off": Bool(default=False)},
+        num_inputs=3, input_names=["data", "gamma", "beta"],
+        aux_names=["moving_mean", "moving_var"],
+        num_outputs=lambda attrs: 3 if attrs.output_mean_var else 1,
+        infer_shape=bn_infer, needs_is_train=True,
+        doc="Batch normalization with moving-stat aux states (reference: "
+            "src/operator/batch_norm-inl.h; 5 in/out incl. aux, SURVEY.md §2.4)")
+
+
+# --- Dropout ----------------------------------------------------------------
+
+def _register_dropout():
+    import jax
+
+    jnp = _jnp()
+
+    def dropout(attrs, x, is_train=False, rng=None):
+        if not is_train or attrs.p <= 0.0:
+            return x
+        keep = 1.0 - attrs.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    register_op("Dropout", dropout,
+                params={"p": Float(default=0.5),
+                        "mode": Enum(["training", "always"], default="training")},
+                num_inputs=1, needs_is_train=True, needs_rng=True,
+                infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a),
+                doc="Inverted dropout via stateless PRNG (reference: "
+                    "src/operator/dropout-inl.h)")
+
+
+# --- loss heads (custom vjp: MXNet semantics ignore incoming head grad) -----
+
+@functools.lru_cache(maxsize=None)
+def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
+                       preserve_shape, normalization, out_grad_flag):
+    import jax
+    import jax.numpy as jnp
+
+    def _axis(data):
+        if preserve_shape:
+            return data.ndim - 1
+        return 1 if data.ndim > 1 else 0
+
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.softmax(data, axis=_axis(data))
+
+    def fwd(data, label):
+        out = jax.nn.softmax(data, axis=_axis(data))
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        ax = _axis(out)
+        if label.shape == out.shape:
+            onehot = label
+            valid = jnp.ones(label.shape[:1], dtype=out.dtype)
+        else:
+            lab = label.astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, out.shape[ax], axis=ax, dtype=out.dtype)
+            valid = jnp.ones(lab.shape, dtype=out.dtype)
+            if use_ignore:
+                keep = (lab != int(ignore_label)).astype(out.dtype)
+                valid = keep
+                bshape = list(label.shape)
+                bshape.insert(ax, 1)
+                onehot = onehot * keep.reshape(bshape)
+        grad = (out * (onehot.sum(axis=ax, keepdims=True)
+                       if use_ignore and label.shape != out.shape else 1.0)
+                - onehot) * grad_scale
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            grad = grad / jnp.maximum(valid.sum(), 1.0)
+        if out_grad_flag:
+            grad = grad * g
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _register_loss_heads():
+    import jax
+
+    jnp = _jnp()
+
+    def softmax_output(attrs, data, label):
+        f = _softmax_output_fn(attrs.grad_scale, attrs.ignore_label,
+                               attrs.multi_output, attrs.use_ignore,
+                               attrs.preserve_shape, attrs.normalization,
+                               attrs.out_grad)
+        return f(data, label)
+
+    def so_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        if attrs.preserve_shape or attrs.multi_output:
+            lab = d[:1] + d[2:]
+        else:
+            lab = d[:1]
+        return ([d, in_shapes[1] or lab], [d], aux_shapes)
+
+    register_op(
+        "SoftmaxOutput", softmax_output,
+        params={"grad_scale": Float(default=1.0), "ignore_label": Float(default=-1.0),
+                "multi_output": Bool(default=False), "use_ignore": Bool(default=False),
+                "preserve_shape": Bool(default=False),
+                "normalization": Enum(["null", "batch", "valid"], default="null"),
+                "out_grad": Bool(default=False), "smooth_alpha": Float(default=0.0)},
+        num_inputs=2, input_names=["data", "label"], infer_shape=so_infer,
+        doc="Softmax + implicit cross-entropy gradient; backward injects "
+            "(p - onehot)·scale ignoring the head gradient (reference: "
+            "src/operator/softmax_output-inl.h)")
+    alias_op("SoftmaxOutput", "Softmax")
+
+    @functools.lru_cache(maxsize=None)
+    def _regression_fn(kind, grad_scale):
+        @jax.custom_vjp
+        def f(data, label):
+            if kind == "logistic":
+                return jax.nn.sigmoid(data)
+            return data
+
+        def fwd(data, label):
+            return f(data, label), (data, label)
+
+        def bwd(res, g):
+            data, label = res
+            pred = jax.nn.sigmoid(data) if kind == "logistic" else data
+            lab = label.reshape(pred.shape)
+            if kind == "mae":
+                grad = jnp.sign(pred - lab)
+            else:
+                grad = pred - lab
+            num_out = float(np.prod(pred.shape[1:])) or 1.0
+            return (grad * (grad_scale / num_out)).astype(pred.dtype), jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def _make_reg(name, kind):
+        def reg(attrs, data, label):
+            return _regression_fn(kind, attrs.grad_scale)(data, label)
+
+        register_op(name, reg, params={"grad_scale": Float(default=1.0)},
+                    num_inputs=2, input_names=["data", "label"],
+                    infer_shape=lambda attrs, i, a: (
+                        None if i[0] is None else ([i[0], i[1] or i[0]], [i[0]], a)),
+                    doc="(reference: src/operator/regression_output-inl.h)")
+
+    _make_reg("LinearRegressionOutput", "linear")
+    _make_reg("LogisticRegressionOutput", "logistic")
+    _make_reg("MAERegressionOutput", "mae")
+
+    @functools.lru_cache(maxsize=None)
+    def _make_loss_fn(grad_scale, normalization):
+        @jax.custom_vjp
+        def f(data):
+            return data
+
+        def fwd(data):
+            return data, (data.shape, data.dtype)
+
+        def bwd(res, g):
+            shape, dtype = res
+            grad = jnp.full(shape, grad_scale, dtype=dtype)
+            if normalization == "batch":
+                grad = grad / shape[0]
+            return (grad,)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def make_loss(attrs, data):
+        return _make_loss_fn(attrs.grad_scale, attrs.normalization)(data)
+
+    register_op("MakeLoss", make_loss,
+                params={"grad_scale": Float(default=1.0),
+                        "valid_thresh": Float(default=0.0),
+                        "normalization": Enum(["null", "batch", "valid"],
+                                              default="null")},
+                num_inputs=1,
+                infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a),
+                doc="Gradient source: d(out)/d(in)=grad_scale, ignores head grad "
+                    "(reference: src/operator/make_loss-inl.h)")
+    alias_op("MakeLoss", "make_loss")
+
+    def softmax_cross_entropy(attrs, data, label):
+        logp = jax.nn.log_softmax(data, axis=-1)
+        lab = label.astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+        return jnp.sum(nll).reshape((1,))
+
+    register_op("softmax_cross_entropy", softmax_cross_entropy,
+                num_inputs=2, input_names=["data", "label"],
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else ([i[0], (i[0][0],)], [(1,)], a)),
+                doc="(reference: src/operator/loss_binary_op.cc)")
+
+
+# --- normalization extras ---------------------------------------------------
+
+def _register_norm_extras():
+    import jax
+
+    jnp = _jnp()
+
+    def l2_normalization(attrs, x):
+        if attrs.mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif attrs.mode == "channel":
+            axes = (1,)
+        else:  # spatial
+            axes = tuple(range(2, x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + attrs.eps)
+        return x / norm
+
+    register_op("L2Normalization", l2_normalization,
+                params={"eps": Float(default=1e-10),
+                        "mode": Enum(["instance", "spatial", "channel"],
+                                     default="instance")},
+                num_inputs=1,
+                doc="(reference: src/operator/l2_normalization-inl.h)")
+
+    def lrn(attrs, x):
+        # cross-channel local response normalization
+        sq = jnp.square(x)
+        pad = attrs.nsize // 2
+        sq_pad = jnp.pad(sq, [(0, 0), (pad, pad)] + [(0, 0)] * (x.ndim - 2))
+        window = sum(sq_pad[:, i:i + x.shape[1]] for i in range(attrs.nsize))
+        return x / jnp.power(attrs.knorm + attrs.alpha * window / attrs.nsize,
+                             attrs.beta)
+
+    register_op("LRN", lrn,
+                params={"alpha": Float(default=1e-4), "beta": Float(default=0.75),
+                        "knorm": Float(default=2.0), "nsize": Int()},
+                num_inputs=1,
+                doc="(reference: src/operator/lrn-inl.h)")
+
+    def instance_norm(attrs, x, gamma, beta):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean) / jnp.sqrt(var + attrs.eps)) * gamma.reshape(bshape) \
+            + beta.reshape(bshape)
+
+    register_op("InstanceNorm", instance_norm,
+                params={"eps": Float(default=1e-3)},
+                num_inputs=3, input_names=["data", "gamma", "beta"],
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else
+                    ([i[0], (i[0][1],), (i[0][1],)], [i[0]], a)),
+                doc="(reference: src/operator/instance_norm-inl.h)")
+
+    def pad_op(attrs, x):
+        pw = attrs.pad_width
+        pads = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+        if attrs.mode == "constant":
+            return jnp.pad(x, pads, constant_values=attrs.constant_value)
+        if attrs.mode == "edge":
+            return jnp.pad(x, pads, mode="edge")
+        return jnp.pad(x, pads, mode="reflect")
+
+    register_op("Pad", pad_op,
+                params={"mode": Enum(["constant", "edge", "reflect"],
+                                     default="constant"),
+                        "pad_width": Shape(), "constant_value": Float(default=0.0)},
+                num_inputs=1,
+                doc="(reference: src/operator/pad-inl.h)")
+    alias_op("Pad", "pad")
+
+
+# --- sequence ops -----------------------------------------------------------
+
+def _register_sequence():
+    jnp = _jnp()
+
+    def _seq_mask_arr(data, seq_len, use_len):
+        # data layout (T, N, ...) — time-major like the reference
+        T = data.shape[0]
+        if not use_len or seq_len is None:
+            return jnp.ones((T, data.shape[1]), dtype=data.dtype)
+        t = jnp.arange(T)[:, None]
+        return (t < seq_len[None, :].astype(jnp.int32)).astype(data.dtype)
+
+    def sequence_mask(attrs, data, *rest):
+        seq = rest[0] if rest else None
+        mask = _seq_mask_arr(data, seq, attrs.use_sequence_length)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+        return data * mask + attrs.value * (1 - mask)
+
+    register_op("SequenceMask", sequence_mask,
+                params={"use_sequence_length": Bool(default=False),
+                        "value": Float(default=0.0), "axis": Int(default=0)},
+                num_inputs=lambda attrs: 2 if attrs.use_sequence_length else 1,
+                input_names=lambda attrs: (["data", "sequence_length"]
+                                           if attrs.use_sequence_length else ["data"]),
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else
+                    ([i[0]] + ([(i[0][1],)] if attrs.use_sequence_length else []),
+                     [i[0]], a)),
+                doc="(reference: src/operator/sequence_mask-inl.h)")
+
+    def sequence_last(attrs, data, *rest):
+        if attrs.use_sequence_length and rest:
+            idx = rest[0].astype(jnp.int32) - 1
+            return jnp.take_along_axis(
+                data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+            )[0]
+        return data[-1]
+
+    register_op("SequenceLast", sequence_last,
+                params={"use_sequence_length": Bool(default=False),
+                        "axis": Int(default=0)},
+                num_inputs=lambda attrs: 2 if attrs.use_sequence_length else 1,
+                input_names=lambda attrs: (["data", "sequence_length"]
+                                           if attrs.use_sequence_length else ["data"]),
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else
+                    ([i[0]] + ([(i[0][1],)] if attrs.use_sequence_length else []),
+                     [i[0][1:]], a)),
+                doc="(reference: src/operator/sequence_last-inl.h)")
+
+    def sequence_reverse(attrs, data, *rest):
+        if attrs.use_sequence_length and rest:
+            T = data.shape[0]
+            seq = rest[0].astype(jnp.int32)
+            t = jnp.arange(T)[:, None]
+            rev_idx = jnp.where(t < seq[None, :], seq[None, :] - 1 - t, t)
+            return jnp.take_along_axis(
+                data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+        return jnp.flip(data, axis=0)
+
+    register_op("SequenceReverse", sequence_reverse,
+                params={"use_sequence_length": Bool(default=False),
+                        "axis": Int(default=0)},
+                num_inputs=lambda attrs: 2 if attrs.use_sequence_length else 1,
+                input_names=lambda attrs: (["data", "sequence_length"]
+                                           if attrs.use_sequence_length else ["data"]),
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else
+                    ([i[0]] + ([(i[0][1],)] if attrs.use_sequence_length else []),
+                     [i[0]], a)),
+                doc="(reference: src/operator/sequence_reverse-inl.h)")
+
+
+_register_fc()
+_register_conv()
+_register_pool()
+_register_act()
+_register_bn()
+_register_dropout()
+_register_loss_heads()
+_register_norm_extras()
+_register_sequence()

@@ -1,0 +1,159 @@
+"""Fused optimizer update ops.
+
+Reference: src/operator/optimizer_op.cc (-inl.h) — sgd_update, sgd_mom_update,
+mp_sgd(_mom)_update (fp16 master weights → here bf16), adam_update,
+rmsprop(alex)_update, ftrl_update. Update-as-one-fused-op is exactly the right
+TPU pattern too (SURVEY.md §2.4): each update is a single XLA kernel over the
+whole parameter. Optimizer state tensors (mom/mean/var/...) are declared as
+mutable aux states so the imperative invoke rebinds them in place, matching
+the reference ops' in-place state mutation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Bool, Float, Int, Shape
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+_COMMON = {
+    "lr": Float(),
+    "wd": Float(default=0.0),
+    "rescale_grad": Float(default=1.0),
+    "clip_gradient": Float(default=-1.0),
+}
+
+
+def _prep_grad(jnp, attrs, grad):
+    g = grad * attrs.rescale_grad
+    if attrs.clip_gradient is not None and attrs.clip_gradient > 0:
+        g = jnp.clip(g, -attrs.clip_gradient, attrs.clip_gradient)
+    return g
+
+
+def _register():
+    jnp = _jnp()
+
+    def sgd_update(attrs, weight, grad):
+        g = _prep_grad(jnp, attrs, grad)
+        return weight - attrs.lr * (g + attrs.wd * weight)
+
+    register_op("sgd_update", sgd_update, params=dict(_COMMON),
+                num_inputs=2, input_names=["weight", "grad"],
+                doc="w -= lr*(rescale*clip(grad) + wd*w) "
+                    "(reference: optimizer_op-inl.h SGDUpdate)")
+
+    def sgd_mom_update(attrs, weight, grad, aux=()):
+        (mom,) = aux
+        g = _prep_grad(jnp, attrs, grad)
+        new_mom = attrs.momentum * mom - attrs.lr * (g + attrs.wd * weight)
+        return (weight + new_mom,), (new_mom,)
+
+    register_op("sgd_mom_update", sgd_mom_update,
+                params=dict(_COMMON, momentum=Float(default=0.0)),
+                num_inputs=2, input_names=["weight", "grad"], aux_names=["mom"],
+                doc="momentum SGD (reference: optimizer_op-inl.h SGDMomUpdate)")
+
+    def mp_sgd_update(attrs, weight, grad, aux=()):
+        (weight32,) = aux
+        g = _prep_grad(jnp, attrs, grad).astype(weight32.dtype)
+        new_w32 = weight32 - attrs.lr * (g + attrs.wd * weight32)
+        return (new_w32.astype(weight.dtype),), (new_w32,)
+
+    register_op("mp_sgd_update", mp_sgd_update, params=dict(_COMMON),
+                num_inputs=2, input_names=["weight", "grad"],
+                aux_names=["weight32"],
+                doc="multi-precision SGD: bf16/fp16 weight, fp32 master copy "
+                    "(reference: optimizer_op-inl.h MP_SGDUpdate)")
+
+    def mp_sgd_mom_update(attrs, weight, grad, aux=()):
+        mom, weight32 = aux
+        g = _prep_grad(jnp, attrs, grad).astype(weight32.dtype)
+        new_mom = attrs.momentum * mom - attrs.lr * (g + attrs.wd * weight32)
+        new_w32 = weight32 + new_mom
+        return (new_w32.astype(weight.dtype),), (new_mom, new_w32)
+
+    register_op("mp_sgd_mom_update", mp_sgd_mom_update,
+                params=dict(_COMMON, momentum=Float(default=0.0)),
+                num_inputs=2, input_names=["weight", "grad"],
+                aux_names=["mom", "weight32"])
+
+    def adam_update(attrs, weight, grad, aux=()):
+        mean, var = aux
+        g = _prep_grad(jnp, attrs, grad) + attrs.wd * weight
+        new_mean = attrs.beta1 * mean + (1 - attrs.beta1) * g
+        new_var = attrs.beta2 * var + (1 - attrs.beta2) * jnp.square(g)
+        new_w = weight - attrs.lr * new_mean / (jnp.sqrt(new_var) + attrs.epsilon)
+        return (new_w,), (new_mean, new_var)
+
+    register_op("adam_update", adam_update,
+                params=dict(_COMMON, beta1=Float(default=0.9),
+                            beta2=Float(default=0.999),
+                            epsilon=Float(default=1e-8),
+                            lazy_update=Bool(default=False)),
+                num_inputs=2, input_names=["weight", "grad"],
+                aux_names=["mean", "var"],
+                doc="Adam step, bias correction applied by the python Optimizer "
+                    "via lr scaling as in the reference (optimizer_op-inl.h AdamUpdate)")
+
+    def rmsprop_update(attrs, weight, grad, aux=()):
+        (n,) = aux
+        g = _prep_grad(jnp, attrs, grad) + attrs.wd * weight
+        new_n = (1 - attrs.gamma1) * jnp.square(g) + attrs.gamma1 * n
+        new_w = weight - attrs.lr * g / jnp.sqrt(new_n + attrs.epsilon)
+        return (new_w,), (new_n,)
+
+    register_op("rmsprop_update", rmsprop_update,
+                params=dict(_COMMON, gamma1=Float(default=0.95),
+                            epsilon=Float(default=1e-8),
+                            clip_weights=Float(default=-1.0)),
+                num_inputs=2, input_names=["weight", "grad"], aux_names=["n"],
+                doc="(reference: optimizer_op-inl.h RMSPropUpdate)")
+
+    def rmspropalex_update(attrs, weight, grad, aux=()):
+        n, g_state, delta = aux
+        g = _prep_grad(jnp, attrs, grad) + attrs.wd * weight
+        new_n = (1 - attrs.gamma1) * jnp.square(g) + attrs.gamma1 * n
+        new_g = (1 - attrs.gamma1) * g + attrs.gamma1 * g_state
+        new_delta = attrs.gamma2 * delta - attrs.lr * g / jnp.sqrt(
+            new_n - jnp.square(new_g) + attrs.epsilon)
+        return (weight + new_delta,), (new_n, new_g, new_delta)
+
+    register_op("rmspropalex_update", rmspropalex_update,
+                params=dict(_COMMON, gamma1=Float(default=0.95),
+                            gamma2=Float(default=0.9),
+                            epsilon=Float(default=1e-8),
+                            clip_weights=Float(default=-1.0)),
+                num_inputs=2, input_names=["weight", "grad"],
+                aux_names=["n", "g", "delta"],
+                doc="RMSProp (Graves) (reference: optimizer_op-inl.h)")
+
+    def ftrl_update(attrs, weight, grad, aux=()):
+        z, n = aux
+        g = _prep_grad(jnp, attrs, grad)
+        new_n = n + jnp.square(g)
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / attrs.lr
+        new_z = z + g - sigma * weight
+        new_w = jnp.where(
+            jnp.abs(new_z) > attrs.lamda1,
+            -(new_z - jnp.sign(new_z) * attrs.lamda1)
+            / ((attrs.beta + jnp.sqrt(new_n)) / attrs.lr + attrs.wd),
+            0.0,
+        )
+        return (new_w,), (new_z, new_n)
+
+    register_op("ftrl_update", ftrl_update,
+                params=dict(_COMMON, lamda1=Float(default=0.01),
+                            beta=Float(default=1.0)),
+                num_inputs=2, input_names=["weight", "grad"],
+                aux_names=["z", "n"],
+                doc="(reference: optimizer_op-inl.h FtrlUpdate)")
+
+
+_register()

@@ -1,0 +1,175 @@
+"""Declarative op-parameter system — the ``dmlc::Parameter`` analog.
+
+The reference declares every op's kwargs as a ``dmlc::Parameter`` struct with
+typed fields, defaults, ranges and enums (``DMLC_DECLARE_FIELD``, e.g.
+src/operator/rnn-inl.h:95-120), which surface as Python keyword args through
+registry codegen. Here the same declaration is a dict of :class:`Field`
+instances. Every field can parse from the MXNet string form (as stored in
+symbol attrs / nnvm JSON) *and* from native Python values, and can serialize
+back to the canonical string so saved symbol JSON round-trips.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+
+__all__ = [
+    "Field",
+    "Int",
+    "Float",
+    "Bool",
+    "Str",
+    "Shape",
+    "Enum",
+    "DType",
+    "required",
+    "parse_params",
+    "params_to_str_dict",
+]
+
+
+class _Required:
+    def __repr__(self):
+        return "required"
+
+
+required = _Required()
+
+
+class Field:
+    """One declared parameter field (DMLC_DECLARE_FIELD analog)."""
+
+    def __init__(self, default=required, doc=""):
+        self.default = default
+        self.doc = doc
+
+    def parse(self, v):
+        raise NotImplementedError
+
+    def to_str(self, v):
+        return str(v)
+
+
+class Int(Field):
+    def parse(self, v):
+        if v is None or v == "None":
+            return None
+        if isinstance(v, str):
+            v = ast.literal_eval(v)
+        return int(v)
+
+
+class Float(Field):
+    def parse(self, v):
+        if v is None or v == "None":
+            return None
+        if isinstance(v, str):
+            v = ast.literal_eval(v)
+        return float(v)
+
+
+class Bool(Field):
+    def parse(self, v):
+        if isinstance(v, str):
+            lv = v.strip().lower()
+            if lv in ("true", "1"):
+                return True
+            if lv in ("false", "0"):
+                return False
+            raise MXNetError("cannot parse bool from %r" % v)
+        return bool(v)
+
+
+class Str(Field):
+    def parse(self, v):
+        return None if v is None or v == "None" else str(v)
+
+
+class Shape(Field):
+    """Tuple-of-int field, parses '(2, 2)', '2', '[2,2]', None."""
+
+    def __init__(self, default=required, doc="", allow_none=True):
+        super().__init__(default, doc)
+        self.allow_none = allow_none
+
+    def parse(self, v):
+        if v is None:
+            return None
+        if isinstance(v, str):
+            s = v.strip()
+            if s in ("None", ""):
+                return None
+            v = ast.literal_eval(s)
+        if isinstance(v, (int, np.integer)):
+            return (int(v),)
+        return tuple(int(x) for x in v)
+
+    def to_str(self, v):
+        if v is None:
+            return "None"
+        return "(" + ", ".join(str(int(x)) for x in v) + ")"
+
+
+class Enum(Field):
+    def __init__(self, values, default=required, doc=""):
+        super().__init__(default, doc)
+        self.values = tuple(values)
+
+    def parse(self, v):
+        if v is None:
+            return None
+        v = str(v)
+        if v not in self.values:
+            raise MXNetError("invalid enum value %r, expected one of %s" % (v, self.values))
+        return v
+
+
+class DType(Field):
+    """Dtype field holding the canonical string name ('float32', ...)."""
+
+    def parse(self, v):
+        if v is None or v == "None":
+            return None
+        if isinstance(v, str):
+            return str(np.dtype(np_dtype(v)).name) if v != "bfloat16" else "bfloat16"
+        return str(np.dtype(v).name)
+
+
+def parse_params(fields, kwargs, op_name=""):
+    """Parse user kwargs against declared fields → plain dict of typed values.
+
+    Unknown keys raise (matching dmlc::Parameter strictness); generic symbol
+    attrs (``__`` prefixed, e.g. ``__ctx_group__``) are ignored here — the
+    symbol layer keeps those separately.
+    """
+    out = {}
+    for k, f in fields.items():
+        if k in kwargs:
+            try:
+                out[k] = f.parse(kwargs[k])
+            except (ValueError, SyntaxError) as e:
+                raise MXNetError(
+                    "%s: cannot parse param %s=%r: %s" % (op_name, k, kwargs[k], e)
+                )
+        elif f.default is required:
+            raise MXNetError("%s: missing required param %r" % (op_name, k))
+        else:
+            out[k] = f.default
+    for k in kwargs:
+        if k not in fields and not (k.startswith("__") and k.endswith("__")):
+            raise MXNetError("%s: unknown param %r" % (op_name, k))
+    return out
+
+
+def params_to_str_dict(fields, params):
+    """Serialize parsed params back to the MXNet string-attr form for JSON."""
+    out = {}
+    for k, f in fields.items():
+        v = params.get(k, f.default)
+        if v is required:
+            continue
+        out[k] = f.to_str(v)
+    return out

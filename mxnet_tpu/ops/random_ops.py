@@ -1,0 +1,153 @@
+"""Random sampling ops over the stateless JAX PRNG.
+
+Reference: src/operator/random/sample_op.cc — uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial (+ _like
+variants) and sample_multinomial_op.cc. Each invocation consumes a fresh
+subkey from the global seed state (mxnet_tpu/random.py) — the kRandom
+resource-pool analog (src/resource.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import np_dtype
+from .param import Bool, Float, Int, Shape, Str, DType
+from .registry import register_op, alias_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _nullary_params(extra):
+    p = {"shape": Shape(default=()), "ctx": Str(default=""),
+         "dtype": DType(default=None)}
+    p.update(extra)
+    return p
+
+
+def _reg_sampler(name, sample, extra_params, aliases=()):
+    def fn(attrs, rng=None):
+        dtype = np_dtype(attrs.dtype) or np.float32
+        return sample(attrs, rng, tuple(attrs.shape), dtype)
+
+    register_op(name, fn, params=_nullary_params(extra_params),
+                num_inputs=0, input_names=[], needs_rng=True,
+                infer_shape=lambda attrs, i, a: ([], [tuple(attrs.shape)], a),
+                infer_dtype=lambda attrs, i, a: ([], [attrs.dtype or "float32"], a))
+    for a in aliases:
+        alias_op(name, a)
+
+
+def _register():
+    import jax
+
+    jnp = _jnp()
+
+    _reg_sampler(
+        "_random_uniform",
+        lambda attrs, rng, shape, dtype: jax.random.uniform(
+            rng, shape, dtype=dtype, minval=attrs.low, maxval=attrs.high),
+        {"low": Float(default=0.0), "high": Float(default=1.0)},
+        aliases=["uniform", "random_uniform"])
+
+    _reg_sampler(
+        "_random_normal",
+        lambda attrs, rng, shape, dtype: attrs.loc + attrs.scale
+        * jax.random.normal(rng, shape, dtype=dtype),
+        {"loc": Float(default=0.0), "scale": Float(default=1.0)},
+        aliases=["normal", "random_normal"])
+
+    _reg_sampler(
+        "_random_gamma",
+        lambda attrs, rng, shape, dtype: attrs.beta
+        * jax.random.gamma(rng, attrs.alpha, shape, dtype=dtype),
+        {"alpha": Float(default=1.0), "beta": Float(default=1.0)},
+        aliases=["random_gamma"])
+
+    _reg_sampler(
+        "_random_exponential",
+        lambda attrs, rng, shape, dtype: jax.random.exponential(
+            rng, shape, dtype=dtype) / attrs.lam,
+        {"lam": Float(default=1.0)},
+        aliases=["random_exponential"])
+
+    _reg_sampler(
+        "_random_poisson",
+        lambda attrs, rng, shape, dtype: jax.random.poisson(
+            rng, attrs.lam, shape).astype(dtype),
+        {"lam": Float(default=1.0)},
+        aliases=["random_poisson"])
+
+    def _neg_binomial(attrs, rng, shape, dtype):
+        # NB(k, p): Gamma-Poisson mixture
+        k1, k2 = jax.random.split(rng)
+        lam = jax.random.gamma(k1, attrs.k, shape) * (1 - attrs.p) / attrs.p
+        return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+    _reg_sampler("_random_negative_binomial", _neg_binomial,
+                 {"k": Int(default=1), "p": Float(default=1.0)},
+                 aliases=["random_negative_binomial"])
+
+    def _gen_neg_binomial(attrs, rng, shape, dtype):
+        k1, k2 = jax.random.split(rng)
+        r = 1.0 / attrs.alpha
+        beta = attrs.alpha * attrs.mu
+        lam = jax.random.gamma(k1, r, shape) * beta
+        return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+    _reg_sampler("_random_generalized_negative_binomial", _gen_neg_binomial,
+                 {"mu": Float(default=1.0), "alpha": Float(default=1.0)},
+                 aliases=["random_generalized_negative_binomial"])
+
+    # --- _like variants ----------------------------------------------------
+    def uniform_like(attrs, data, rng=None):
+        return jax.random.uniform(rng, data.shape, dtype=data.dtype,
+                                  minval=attrs.low, maxval=attrs.high)
+
+    register_op("_random_uniform_like", uniform_like,
+                params={"low": Float(default=0.0), "high": Float(default=1.0)},
+                num_inputs=1, needs_rng=True)
+
+    def normal_like(attrs, data, rng=None):
+        return attrs.loc + attrs.scale * jax.random.normal(
+            rng, data.shape, dtype=data.dtype)
+
+    register_op("_random_normal_like", normal_like,
+                params={"loc": Float(default=0.0), "scale": Float(default=1.0)},
+                num_inputs=1, needs_rng=True)
+
+    # --- multinomial -------------------------------------------------------
+    def sample_multinomial(attrs, data, rng=None):
+        # data: (..., k) probabilities, rows sum to 1
+        logits = jnp.log(jnp.maximum(data, 1e-30))
+        out_shape = data.shape[:-1] + ((attrs.shape[0],) if attrs.shape else ())
+        n = attrs.shape[0] if attrs.shape else 1
+        samples = jax.random.categorical(rng, logits, axis=-1,
+                                         shape=(n,) + data.shape[:-1])
+        samples = jnp.moveaxis(samples, 0, -1)
+        if not attrs.shape:
+            samples = samples.reshape(data.shape[:-1])
+        out = samples.astype(np_dtype(attrs.dtype))
+        if attrs.get_prob:
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1),
+                samples.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+                axis=-1).reshape(out.shape)
+            return (out, logp)
+        return out
+
+    register_op("_sample_multinomial", sample_multinomial,
+                params={"shape": Shape(default=()), "get_prob": Bool(default=False),
+                        "dtype": DType(default="int32")},
+                num_inputs=1, needs_rng=True,
+                num_outputs=lambda attrs: 2 if attrs.get_prob else 1,
+                infer_dtype=lambda attrs, i, a: (
+                    i, [attrs.dtype] + (["float32"] if attrs.get_prob else []), a),
+                doc="(reference: src/operator/random/sample_multinomial_op.h)")
+    alias_op("_sample_multinomial", "sample_multinomial")
+
+
+_register()

@@ -1,0 +1,261 @@
+"""The operator registry — TPU-native replacement for the NNVM op registry.
+
+In the reference every op registers name, parameter struct, shape/type
+inference, and FCompute kernels into ``dmlc::Registry``/NNVM
+(src/operator/, include/mxnet/op_attr_types.h:185-264); Python then generates
+``mx.nd.*`` / ``mx.sym.*`` functions from that registry at import
+(python/mxnet/ndarray/register.py:168). Here an op registers:
+
+- ``name`` + parameter ``Field`` dict (param.py),
+- a pure JAX forward function (jnp/lax/pallas) — the FCompute analog, which
+  XLA fuses/schedules/buffers instead of the reference's dependency engine,
+- optional shape/dtype inference used for symbolic partial inference
+  (backfilling unbound weight shapes the way infer_graph_attr_pass.cc does),
+- flags for is_train / RNG / mutable aux state (BatchNorm moving stats —
+  the FStatefulCompute + aux-state analog).
+
+Both the imperative (``mx.nd``) and symbolic (``mx.sym``) frontends are
+generated from this one registry, mirroring the reference's single-registry
+design. Gradients come from JAX autodiff; loss heads (SoftmaxOutput etc.)
+supply ``jax.custom_vjp`` internally to reproduce MXNet's
+ignore-head-gradient semantics.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..base import MXNetError
+from .param import parse_params, params_to_str_dict
+
+__all__ = ["OpDef", "OpAttrs", "register_op", "get_op", "list_ops", "OP_REGISTRY"]
+
+OP_REGISTRY = {}
+
+
+class OpAttrs:
+    """Parsed, hashable op attributes with attribute access."""
+
+    __slots__ = ("_d", "key")
+
+    def __init__(self, d):
+        self._d = d
+        self.key = tuple(sorted(d.items(), key=lambda kv: kv[0]))
+
+    def __getattr__(self, k):
+        try:
+            return self._d[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __getitem__(self, k):
+        return self._d[k]
+
+    def get(self, k, default=None):
+        return self._d.get(k, default)
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, OpAttrs) and self.key == other.key
+
+    def __repr__(self):
+        return "OpAttrs(%r)" % (self._d,)
+
+
+def _resolve(v, attrs):
+    return v(attrs) if callable(v) else v
+
+
+class OpDef:
+    """One registered operator."""
+
+    def __init__(
+        self,
+        name,
+        fn,
+        params=None,
+        num_inputs=1,
+        input_names=None,
+        num_outputs=1,
+        aux_names=(),
+        infer_shape=None,
+        infer_dtype=None,
+        needs_rng=False,
+        needs_is_train=False,
+        hint=None,
+        doc="",
+        visible=True,
+    ):
+        self.name = name
+        self.fn = fn
+        self.params = params or {}
+        self.num_inputs = num_inputs
+        self._input_names = input_names
+        self.num_outputs = num_outputs
+        self.aux_names = aux_names
+        self.infer_shape = infer_shape
+        self.infer_dtype = infer_dtype
+        self.needs_rng = needs_rng
+        self.needs_is_train = needs_is_train
+        self.hint = hint or (name.strip("_").lower())
+        self.doc = doc
+        self.visible = visible
+
+    # --- attr handling ---------------------------------------------------
+    def parse_attrs(self, kwargs):
+        return OpAttrs(parse_params(self.params, kwargs, self.name))
+
+    def attrs_to_str_dict(self, attrs):
+        return params_to_str_dict(self.params, attrs._d)
+
+    def get_num_inputs(self, attrs):
+        return _resolve(self.num_inputs, attrs)
+
+    def get_num_outputs(self, attrs):
+        return _resolve(self.num_outputs, attrs)
+
+    def get_input_names(self, attrs):
+        if self._input_names is None:
+            n = self.get_num_inputs(attrs)
+            return ["data"] if n == 1 else ["data%d" % i for i in range(n)]
+        return list(_resolve(self._input_names, attrs))
+
+    def get_aux_names(self, attrs):
+        return list(_resolve(self.aux_names, attrs))
+
+    # --- execution -------------------------------------------------------
+    def apply(self, attrs, inputs, aux=(), is_train=False, rng=None):
+        """Normalized call: returns (outputs_tuple, new_aux_tuple).
+
+        ``inputs``/``aux`` are raw JAX arrays. This is the single entry point
+        used by the eager frontend, the autograd tape, and the graph executor.
+        """
+        kw = {}
+        if self.needs_is_train:
+            kw["is_train"] = is_train
+        if self.needs_rng:
+            kw["rng"] = rng
+        if self.get_aux_names(attrs):
+            out = self.fn(attrs, *inputs, aux=tuple(aux), **kw)
+            outputs, new_aux = out
+        else:
+            outputs = self.fn(attrs, *inputs, **kw)
+            new_aux = tuple(aux)
+        if not isinstance(outputs, (tuple, list)):
+            outputs = (outputs,)
+        return tuple(outputs), tuple(new_aux)
+
+    # --- inference -------------------------------------------------------
+    def default_infer_shape(self, attrs, in_shapes, aux_shapes):
+        """Shape inference by abstract evaluation (jax.eval_shape) when every
+        input shape is known — the common case; ops that must backfill unbound
+        weight shapes (FullyConnected, Convolution, ...) register explicit
+        ``infer_shape`` instead (infer_graph_attr_pass.cc analog)."""
+        import jax
+
+        if any(s is None for s in in_shapes) or any(s is None for s in aux_shapes):
+            return None
+        ins = [jax.ShapeDtypeStruct(s, np.float32) for s in in_shapes]
+        auxs = [jax.ShapeDtypeStruct(s, np.float32) for s in aux_shapes]
+        rng = (
+            jax.ShapeDtypeStruct((2,), np.uint32) if self.needs_rng else None
+        )
+        outs, new_aux = jax.eval_shape(
+            lambda i, a, r: self.apply(attrs, i, a, is_train=True, rng=r),
+            tuple(ins),
+            tuple(auxs),
+            rng,
+        )
+        return (
+            list(in_shapes),
+            [tuple(o.shape) for o in outs],
+            [tuple(a.shape) for a in new_aux] if aux_shapes else list(aux_shapes),
+        )
+
+    def run_infer_shape(self, attrs, in_shapes, aux_shapes=()):
+        in_shapes = list(in_shapes)
+        aux_shapes = list(aux_shapes)
+        if self.infer_shape is not None:
+            res = self.infer_shape(attrs, in_shapes, aux_shapes)
+            if res is not None and len(res) == 2:  # allow (in, out) shorthand
+                res = (res[0], res[1], aux_shapes)
+            return res
+        return self.default_infer_shape(attrs, in_shapes, aux_shapes)
+
+    def run_infer_dtype(self, attrs, in_dtypes, aux_dtypes=()):
+        if self.infer_dtype is not None:
+            res = self.infer_dtype(attrs, list(in_dtypes), list(aux_dtypes))
+            if res is not None and len(res) == 2:
+                res = (res[0], res[1], list(aux_dtypes))
+            return res
+        # default: all same as first known input dtype
+        known = [d for d in list(in_dtypes) + list(aux_dtypes) if d is not None]
+        if not known:
+            return None
+        d = known[0]
+        n_out = self.get_num_outputs(attrs)
+        return (
+            [x if x is not None else d for x in in_dtypes],
+            [d] * n_out,
+            [x if x is not None else d for x in aux_dtypes],
+        )
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register_op(name, fn=None, **kwargs):
+    """Register an operator. Usable directly or as a decorator."""
+
+    def _do(f):
+        if name in OP_REGISTRY:
+            raise MXNetError("op %r already registered" % name)
+        opdef = OpDef(name, f, **kwargs)
+        OP_REGISTRY[name] = opdef
+        return opdef
+
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get_op(name):
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator %r is not registered" % name)
+
+
+def list_ops():
+    return sorted(OP_REGISTRY)
+
+
+def alias_op(name, alias, visible=True):
+    """Register an additional name for an existing op (the reference uses
+    add_alias, e.g. 'flatten'/'Flatten')."""
+    opdef = get_op(name)
+    if alias in OP_REGISTRY:
+        raise MXNetError("op %r already registered" % alias)
+    OP_REGISTRY[alias] = opdef
+    return opdef
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(opdef, attrs, is_train, n_in, n_aux):
+    """Compiled eager kernel for one (op, attrs, mode) — XLA replaces the
+    reference's per-op mshadow/cuDNN kernel dispatch."""
+    import jax
+
+    def f(inputs, aux, rng):
+        return opdef.apply(attrs, inputs, aux, is_train=is_train, rng=rng)
+
+    return jax.jit(f)
+
+
+def eager_call(opdef, attrs, input_datas, aux_datas=(), is_train=False, rng=None):
+    """Run one op eagerly on raw JAX arrays, compiled and cached."""
+    f = _jitted(opdef, attrs, bool(is_train), len(input_datas), len(aux_datas))
+    return f(tuple(input_datas), tuple(aux_datas), rng)

@@ -1,0 +1,43 @@
+"""Global random state — stateful facade over stateless JAX PRNG.
+
+The reference seeds per-device mshadow PRNGs via ``mx.random.seed`` →
+``MXRandomSeed`` (src/resource.cc kRandom pool; python/mxnet/random.py:433).
+JAX PRNG is stateless keys; to preserve the MXNet API we hold one global key
+and split off a fresh subkey for every random op invocation. SURVEY.md §2.2
+flags this as a real semantic change: sequences differ from the reference,
+but seeding still gives run-to-run determinism, which is all the reference's
+tests rely on.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key"]
+
+_state = threading.local()
+
+
+def _get_key():
+    key = getattr(_state, "key", None)
+    if key is None:
+        import jax
+
+        key = jax.random.PRNGKey(0)
+        _state.key = key
+    return key
+
+
+def seed(seed_state):
+    """Seed the global PRNG (reference: python/mxnet/random.py:433 mx.random.seed)."""
+    import jax
+
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split off a fresh subkey, advancing the global state."""
+    import jax
+
+    key, sub = jax.random.split(_get_key())
+    _state.key = key
+    return sub
