@@ -16,3 +16,8 @@ from . import ndarray
 from . import ndarray as nd
 from . import random
 from . import autograd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor

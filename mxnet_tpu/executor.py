@@ -1,0 +1,291 @@
+"""Executor — symbolic graph execution as single compiled XLA programs.
+
+Reference: src/executor/graph_executor.cc (GraphExecutor) and
+include/mxnet/executor.h. The reference binds a graph into per-node engine
+ops (InitCachedOps, graph_executor.cc:1226) with memory planning and bulk
+segments; here the entire forward (and forward+backward for training) DAG is
+lowered into ONE ``jax.jit`` program — the "whole-graph-to-one-XLA-program"
+design that SURVEY.md §7.3(6) names as the performance requirement. Gradient
+construction (the nnvm::pass::Gradient analog, graph_executor.cc:303) is
+``jax.vjp`` over the lowered function; memory planning, inplace and bulk
+execution are XLA buffer assignment and fusion.
+
+Forward in train mode computes outputs, updated aux states AND gradients in
+one fused program (seeded with ones — loss-head ops ignore the seed via their
+custom_vjp, reproducing MXNet's head-gradient semantics); ``backward()`` then
+just applies the stashed gradients according to grad_req. An explicit
+``backward(out_grads)`` recompiles with real seeds.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context
+
+__all__ = ["Executor"]
+
+
+class _GraphProgram:
+    """Compiled evaluation plan for one Symbol."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.topo = [n for n in symbol.topo_nodes() if not n.is_variable]
+        self.rng_nodes = [n for n in self.topo
+                          if n.opdef().needs_rng]
+        args, aux = symbol._classify_vars()
+        self.arg_names = [n.name for n in args]
+        self.aux_names = [n.name for n in aux]
+        self._jit_cache = {}
+
+    # --- raw graph evaluation (traced under jit) --------------------------
+    def _eval(self, arg_d, aux_d, rngs, is_train):
+        env = {}
+        aux_updates = {}
+        rng_i = [0]
+
+        def get_entry(e):
+            n, i = e
+            if n.is_variable:
+                if n.name in arg_d:
+                    return arg_d[n.name]
+                return aux_d[n.name]
+            return env[(id(n), i)]
+
+        for node in self.topo:
+            opdef = node.opdef()
+            attrs = node.parsed_attrs()
+            n_main = node.num_main_inputs()
+            ins = [get_entry(e) for e in node.inputs[:n_main]]
+            auxs = [get_entry(e) for e in node.inputs[n_main:]]
+            rng = None
+            if opdef.needs_rng:
+                rng = rngs[rng_i[0]]
+                rng_i[0] += 1
+            outs, new_aux = opdef.apply(attrs, ins, auxs, is_train=is_train,
+                                        rng=rng)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+            for e, nv in zip(node.inputs[n_main:], new_aux):
+                src, _ = e
+                if src.is_variable:
+                    aux_updates[src.name] = nv
+        outputs = tuple(get_entry(e) for e in self.symbol._outputs)
+        return outputs, aux_updates
+
+    # --- compiled entry points --------------------------------------------
+    def infer_fn(self):
+        import jax
+
+        if "infer" not in self._jit_cache:
+            def f(arg_d, aux_d, rngs):
+                outs, _ = self._eval(arg_d, aux_d, rngs, False)
+                return outs
+
+            self._jit_cache["infer"] = jax.jit(f)
+        return self._jit_cache["infer"]
+
+    def train_fn(self, grad_names):
+        """One fused program: outputs + aux updates + grads w.r.t. grad_names."""
+        import jax
+
+        key = ("train", tuple(grad_names))
+        if key not in self._jit_cache:
+            def f(nograd_d, grad_d, aux_d, rngs, seeds):
+                def inner(gd):
+                    merged = dict(nograd_d)
+                    merged.update(gd)
+                    outs, aux_upd = self._eval(merged, aux_d, rngs, True)
+                    return tuple(outs), aux_upd
+
+                outs, vjp, aux_upd = jax.vjp(inner, grad_d, has_aux=True)
+                grads = vjp(tuple(seeds))[0]
+                return outs, aux_upd, grads
+
+            self._jit_cache[key] = jax.jit(f)
+        return self._jit_cache[key]
+
+
+class Executor:
+    """Bound executor (reference: include/mxnet/executor.h:53, executor.py)."""
+
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
+                 shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._prog = (shared_exec._prog if shared_exec is not None
+                      and shared_exec._symbol is symbol else _GraphProgram(symbol))
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self.grad_req = dict(grad_req)
+        self.aux_dict = dict(aux_states or {})
+        self._arg_names = self._prog.arg_names
+        self._aux_names = self._prog.aux_names
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        self.outputs = []
+        self._stashed_grads = None
+        self._monitor_callback = None
+
+    # --- properties mirroring the reference -------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # --- execution ----------------------------------------------------------
+    def _rng_keys(self):
+        from . import random as _random
+
+        return tuple(_random.next_key() for _ in self._prog.rng_nodes)
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (reference: GraphExecutor::Forward, graph_executor.cc:81).
+
+        In train mode this runs the fused forward+backward XLA program and
+        stashes gradients for the subsequent :meth:`backward` call.
+        """
+        from .ndarray.ndarray import _from_data
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %r in forward" % k)
+            self.arg_dict[k]._set_data(
+                v._data.astype(self.arg_dict[k]._data.dtype))
+
+        arg_d = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_d = {n: self.aux_dict[n]._data for n in self._aux_names}
+        rngs = self._rng_keys()
+
+        if not is_train:
+            outs = self._prog.infer_fn()(arg_d, aux_d, rngs)
+            self._stashed_grads = None
+        else:
+            import jax.numpy as jnp
+
+            grad_names = tuple(n for n in self._arg_names
+                               if self.grad_req.get(n, "null") != "null")
+            nograd_d = {n: v for n, v in arg_d.items() if n not in grad_names}
+            grad_d = {n: arg_d[n] for n in grad_names}
+            # seed ones: loss heads ignore it (custom_vjp); matches MXNet's
+            # backward()-without-head-grads convention
+            seeds = self._ones_seeds(arg_d, aux_d, rngs)
+            outs, aux_upd, grads = self._prog.train_fn(grad_names)(
+                nograd_d, grad_d, aux_d, rngs, seeds)
+            for n, nv in aux_upd.items():
+                self.aux_dict[n]._set_data(nv)
+            self._stashed_grads = grads
+        self.outputs = [_from_data(o) for o in outs]
+        return self.outputs
+
+    def _ones_seeds(self, arg_d, aux_d, rngs):
+        """Ones cotangents matching the outputs' abstract shapes/dtypes."""
+        import jax
+        import jax.numpy as jnp
+
+        key = tuple((n, tuple(v.shape), str(v.dtype))
+                    for n, v in sorted(arg_d.items()))
+        cache = self._prog._jit_cache.setdefault("seed_specs", {})
+        if key not in cache:
+            specs = jax.eval_shape(self._prog.infer_fn(), arg_d, aux_d, rngs)
+            cache[key] = [(s.shape, s.dtype) for s in specs]
+        return tuple(jnp.ones(s, dtype=d) for s, d in cache[key])
+
+    def backward(self, out_grads=None, is_train=True):
+        """Apply gradients into grad arrays per grad_req (reference:
+        GraphExecutor::Backward, graph_executor.cc:94)."""
+        if out_grads is not None:
+            from .ndarray.ndarray import NDArray
+
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            arg_d = {n: self.arg_dict[n]._data for n in self._arg_names}
+            aux_d = {n: self.aux_dict[n]._data for n in self._aux_names}
+            grad_names = tuple(n for n in self._arg_names
+                               if self.grad_req.get(n, "null") != "null")
+            nograd_d = {n: v for n, v in arg_d.items() if n not in grad_names}
+            grad_d = {n: arg_d[n] for n in grad_names}
+            seeds = tuple(g._data for g in out_grads)
+            _, _, grads = self._prog.train_fn(grad_names)(
+                nograd_d, grad_d, aux_d, self._rng_keys(), seeds)
+        else:
+            if self._stashed_grads is None:
+                raise MXNetError("backward() called without a prior "
+                                 "forward(is_train=True)")
+            grads = self._stashed_grads
+        for n, g in grads.items():
+            req = self.grad_req.get(n, "null")
+            garr = self.grad_dict.get(n)
+            if req == "null" or garr is None:
+                continue
+            if req == "add":
+                garr._set_data(garr._data + g.astype(garr._data.dtype))
+            else:
+                garr._set_data(g.astype(garr._data.dtype))
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    # --- utilities -----------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """(reference: executor.py:235)"""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the arguments"
+                                 % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise ValueError("Find name \"%s\" that is not in the "
+                                     "auxiliary states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new input shapes (reference:
+        executor.py:376). jit shape-signature caching makes this cheap —
+        the program object (and its compile cache) is shared."""
+        from . import ndarray as nd
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args, new_grads = {}, {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(shape):
+                new_args[name] = old
+                if name in self.grad_dict:
+                    new_grads[name] = self.grad_dict[name]
+            else:
+                new_args[name] = nd.zeros(shape, ctx=self._ctx, dtype=old.dtype)
+                if name in self.grad_dict:
+                    new_grads[name] = nd.zeros(shape, ctx=self._ctx,
+                                               dtype=old.dtype)
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(shape) else \
+                nd.zeros(shape, ctx=self._ctx, dtype=old.dtype)
+        ex = Executor(self._symbol, self._ctx, new_args, new_grads,
+                      self.grad_req, new_aux, shared_exec=self)
+        return ex
+
+    def set_monitor_callback(self, callback):
+        """Install a per-output monitor (reference: MXExecutorSetMonitorCallback;
+        executes an uncompiled node-by-node pass when used via debug tools)."""
+        self._monitor_callback = callback

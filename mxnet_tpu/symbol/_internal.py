@@ -1,0 +1,2 @@
+"""Namespace populated with generated internal symbol op functions
+(reference: python/mxnet/symbol/_internal.py)."""

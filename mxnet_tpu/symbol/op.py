@@ -1,0 +1,2 @@
+"""Namespace populated with generated symbol op functions at import
+(reference: python/mxnet/symbol/op.py)."""

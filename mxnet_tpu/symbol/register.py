@@ -1,0 +1,88 @@
+"""``mx.sym.*`` codegen from the op registry.
+
+Reference: python/mxnet/symbol/register.py:202 — generates a composing
+function per registered op. Each generated function creates a graph node;
+missing tensor inputs become auto-named variables (``fc1_weight`` style),
+matching MXNet's NameManager behavior.
+"""
+from __future__ import annotations
+
+from ..base import AttrScope, MXNetError, NameManager
+from ..ops.registry import OP_REGISTRY
+from .symbol import Symbol, _Node
+
+__all__ = ["populate_namespaces"]
+
+
+def make_symbol(opdef, args, kwargs):
+    name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+
+    sym_kwargs = {}
+    attr_kwargs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            sym_kwargs[k] = v
+        else:
+            attr_kwargs[k] = v
+
+    if "num_args" in opdef.params and "num_args" not in attr_kwargs:
+        attr_kwargs["num_args"] = len(args) + len(sym_kwargs)
+
+    attrs = opdef.parse_attrs(attr_kwargs)
+    str_attrs = opdef.attrs_to_str_dict(attrs)
+    input_names = opdef.get_input_names(attrs)
+    aux_names = opdef.get_aux_names(attrs)
+    all_names = input_names + aux_names
+
+    name = NameManager.current().get(name, opdef.hint)
+
+    entries = [None] * len(all_names)
+    for i, s in enumerate(args):
+        if not isinstance(s, Symbol):
+            raise TypeError("%s: positional input %d must be Symbol, got %s"
+                            % (opdef.name, i, type(s)))
+        entries[i] = s
+    for k, v in sym_kwargs.items():
+        if k not in all_names:
+            raise MXNetError("%s: unknown input %r (inputs: %s)"
+                             % (opdef.name, k, all_names))
+        entries[all_names.index(k)] = v
+
+    inputs = []
+    for slot, s in enumerate(entries):
+        if s is None:
+            # auto-create a variable for the unbound input (reference behavior:
+            # symbol composition creates <name>_<input> variables)
+            from .symbol import var
+
+            s = var("%s_%s" % (name, all_names[slot]))
+        if len(s._outputs) != 1:
+            raise MXNetError("%s: input %d is a multi-output symbol; select an "
+                             "output first" % (opdef.name, slot))
+        inputs.append(s._outputs[0])
+
+    user_attrs = AttrScope.current().get(attr)
+    node = _Node(opdef.name, name, attrs=str_attrs, user_attrs=user_attrs,
+                 inputs=inputs)
+    n_out = opdef.get_num_outputs(attrs)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_sym_func(opdef):
+    def sym_fn(*args, **kwargs):
+        return make_symbol(opdef, args, kwargs)
+
+    sym_fn.__name__ = opdef.name
+    sym_fn.__qualname__ = opdef.name
+    sym_fn.__doc__ = opdef.doc or ("%s (TPU-native symbol op)" % opdef.name)
+    return sym_fn
+
+
+def populate_namespaces(op_module, internal_module):
+    for name, opdef in OP_REGISTRY.items():
+        fn = _make_sym_func(opdef)
+        if name.startswith("_"):
+            setattr(internal_module, name, fn)
+        else:
+            setattr(op_module, name, fn)

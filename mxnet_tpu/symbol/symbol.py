@@ -1,0 +1,561 @@
+"""Symbol — the declarative graph frontend.
+
+Reference: python/mxnet/symbol/symbol.py:2792 and the NNVM Symbol/Graph it
+wraps (SURVEY.md §2.1). Here a Symbol is a lightweight DAG of :class:`_Node`s
+with string attrs; the nnvm JSON serialization format is preserved for
+checkpoint parity (save/tojson ↔ load/fromjson round-trips with reference
+files). "bind" does NOT build an engine-op graph — the executor lowers the
+whole DAG into one jitted XLA program (SURVEY.md §7.1: PlanMemory/inplace/
+bulk-exec all become XLA's buffer assignment and fusion).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import AttrScope, MXNetError, NameManager
+from ..ops.registry import OP_REGISTRY, get_op
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "user_attrs", "inputs", "_attrs_cache")
+
+    def __init__(self, op, name, attrs=None, user_attrs=None, inputs=()):
+        self.op = op  # str op name or None for variable
+        self.name = name
+        self.attrs = dict(attrs or {})  # op params, string form
+        self.user_attrs = dict(user_attrs or {})  # ctx_group, lr_mult, __shape__...
+        self.inputs = list(inputs)  # list of (node, out_index)
+        self._attrs_cache = None
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def opdef(self):
+        return get_op(self.op)
+
+    def parsed_attrs(self):
+        if self._attrs_cache is None:
+            self._attrs_cache = self.opdef().parse_attrs(self.attrs)
+        return self._attrs_cache
+
+    def num_main_inputs(self):
+        if self.is_variable:
+            return 0
+        return self.opdef().get_num_inputs(self.parsed_attrs())
+
+
+class Symbol:
+    """A (multi-)output symbolic expression (reference: symbol.py Symbol)."""
+
+    def __init__(self, outputs):
+        # list of (node, out_index)
+        self._outputs = list(outputs)
+
+    # --- basic introspection ---------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        if len(self._outputs) == 1:
+            return "<Symbol %s>" % self._outputs[0][0].name
+        return "<Symbol group [%s]>" % ", ".join(n.name for n, _ in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                return Symbol([self._outputs[names.index(index)]])
+            raise ValueError("cannot find output %r" % index)
+        return Symbol([self._outputs[index]])
+
+    def get_internals(self):
+        """Symbol grouping every internal output (reference: symbol.py:556)."""
+        entries = []
+        for node in self.topo_nodes():
+            if node.is_variable:
+                entries.append((node, 0))
+            else:
+                nout = node.opdef().get_num_outputs(node.parsed_attrs())
+                entries.extend((node, i) for i in range(nout))
+        return Symbol(entries)
+
+    def get_children(self):
+        nodes = []
+        seen = set()
+        for node, _ in self._outputs:
+            for inp, idx in node.inputs:
+                if id((inp, idx)) in seen:
+                    continue
+                nodes.append((inp, idx))
+        return Symbol(nodes) if nodes else None
+
+    # --- traversal ---------------------------------------------------------
+    def topo_nodes(self):
+        """All nodes in DFS post-order (stable; inputs before consumers)."""
+        order = []
+        visited = set()
+
+        def visit(node):
+            if id(node) in visited:
+                return
+            visited.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _classify_vars(self):
+        """Split variable nodes into (args, aux) by the input slot they feed
+        (slots beyond num_inputs are aux states — ListAuxiliaryStates analog)."""
+        aux_ids = set()
+        for node in self.topo_nodes():
+            if node.is_variable:
+                continue
+            n_main = node.num_main_inputs()
+            for slot, (inp, _) in enumerate(node.inputs):
+                if slot >= n_main and inp.is_variable:
+                    aux_ids.add(id(inp))
+        args, aux = [], []
+        for node in self.topo_nodes():
+            if node.is_variable:
+                (aux if id(node) in aux_ids else args).append(node)
+        return args, aux
+
+    def list_arguments(self):
+        """Names of input variables, in graph order (reference: symbol.py:736)."""
+        args, _ = self._classify_vars()
+        return [n.name for n in args]
+
+    def list_auxiliary_states(self):
+        """Names of auxiliary-state variables (reference: symbol.py:820)."""
+        _, aux = self._classify_vars()
+        return [n.name for n in aux]
+
+    def list_outputs(self):
+        """Output entry names, ``<node>_output`` style (reference: symbol.py:754)."""
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+            else:
+                nout = node.opdef().get_num_outputs(node.parsed_attrs())
+                if nout == 1:
+                    names.append(node.name + "_output")
+                else:
+                    names.append("%s_output%d" % (node.name, idx))
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in self.topo_nodes() if n.is_variable]
+
+    # --- attrs -------------------------------------------------------------
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            node = self._outputs[0][0]
+            return node.user_attrs.get(key, node.attrs.get(key))
+        return None
+
+    def attr_dict(self):
+        out = {}
+        for node in self.topo_nodes():
+            d = dict(node.attrs)
+            d.update(node.user_attrs)
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node.user_attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # --- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Full shape inference (reference: symbol.py:996 → fixed-point
+        InferAttr in src/executor/infer_graph_attr_pass.cc)."""
+        res = self.infer_shape_partial(*args, **kwargs)
+        arg_shapes, out_shapes, aux_shapes = res
+        if arg_shapes and any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            raise MXNetError("cannot fully infer shapes; undetermined args: %s"
+                             % missing)
+        return res
+
+    def infer_shape_partial(self, *args, **kwargs):
+        known = self._build_known(args, kwargs, self.list_arguments())
+        entry_shape, var_shape = {}, {}
+        for name, shape in known.items():
+            var_shape[name] = tuple(shape) if shape else None
+        topo = self.topo_nodes()
+        # also honor __shape__ attr on variables (used by sym.var(shape=...))
+        for node in topo:
+            if node.is_variable and "__shape__" in node.user_attrs:
+                from ..ops.param import Shape as _ShapeField
+
+                var_shape.setdefault(node.name,
+                                     _ShapeField().parse(node.user_attrs["__shape__"]))
+
+        for _ in range(3):  # fixed-point; DAG converges fast
+            changed = False
+            for node in topo:
+                if node.is_variable:
+                    continue
+                attrs = node.parsed_attrs()
+                opdef = node.opdef()
+                n_main = node.num_main_inputs()
+
+                def entry_get(e):
+                    n, i = e
+                    if n.is_variable:
+                        return var_shape.get(n.name)
+                    return entry_shape.get((id(n), i))
+
+                in_shapes = [entry_get(e) for e in node.inputs[:n_main]]
+                aux_shapes = [entry_get(e) for e in node.inputs[n_main:]]
+                try:
+                    res = opdef.run_infer_shape(attrs, in_shapes, aux_shapes)
+                except Exception as e:
+                    raise MXNetError("infer_shape error in %s(%s): %s"
+                                     % (node.op, node.name, e))
+                if res is None:
+                    continue
+                new_in, new_out, new_aux = res
+                for e, s in zip(node.inputs, list(new_in) + list(new_aux)):
+                    if s is None:
+                        continue
+                    n, i = e
+                    if n.is_variable:
+                        if var_shape.get(n.name) is None:
+                            var_shape[n.name] = tuple(s)
+                            changed = True
+                    elif entry_shape.get((id(n), i)) is None:
+                        entry_shape[(id(n), i)] = tuple(s)
+                        changed = True
+                for i, s in enumerate(new_out):
+                    if s is not None and entry_shape.get((id(node), i)) is None:
+                        entry_shape[(id(node), i)] = tuple(s)
+                        changed = True
+            if not changed:
+                break
+
+        args_list, aux_list = self._classify_vars()
+        arg_shapes = [var_shape.get(n.name) for n in args_list]
+        aux_shapes_out = [var_shape.get(n.name) for n in aux_list]
+        out_shapes = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                out_shapes.append(var_shape.get(node.name))
+            else:
+                out_shapes.append(entry_shape.get((id(node), idx)))
+        return arg_shapes, out_shapes, aux_shapes_out
+
+    def infer_type(self, *args, **kwargs):
+        """Dtype inference; defaults mirror the reference (float32 baseline)."""
+        known = self._build_known(args, kwargs, self.list_arguments())
+        var_t = {k: np.dtype(v).name if v is not None else None
+                 for k, v in known.items()}
+        entry_t = {}
+        topo = self.topo_nodes()
+        for _ in range(3):
+            changed = False
+            for node in topo:
+                if node.is_variable:
+                    continue
+                attrs = node.parsed_attrs()
+                opdef = node.opdef()
+                n_main = node.num_main_inputs()
+
+                def entry_get(e):
+                    n, i = e
+                    return var_t.get(n.name) if n.is_variable else entry_t.get((id(n), i))
+
+                in_t = [entry_get(e) for e in node.inputs[:n_main]]
+                aux_t = [entry_get(e) for e in node.inputs[n_main:]]
+                res = opdef.run_infer_dtype(attrs, in_t, aux_t)
+                if res is None:
+                    continue
+                new_in, new_out, new_aux = res
+                for e, t in zip(node.inputs, list(new_in) + list(new_aux)):
+                    n, i = e
+                    if t is None:
+                        continue
+                    if n.is_variable:
+                        if var_t.get(n.name) is None:
+                            var_t[n.name] = t
+                            changed = True
+                    elif entry_t.get((id(n), i)) is None:
+                        entry_t[(id(n), i)] = t
+                        changed = True
+                for i, t in enumerate(new_out):
+                    if t is not None and entry_t.get((id(node), i)) is None:
+                        entry_t[(id(node), i)] = t
+                        changed = True
+            if not changed:
+                break
+        args_list, aux_list = self._classify_vars()
+        # default float32 for anything still unknown (reference behavior)
+        arg_types = [np.dtype(var_t.get(n.name) or "float32") for n in args_list]
+        aux_types = [np.dtype(var_t.get(n.name) or "float32") for n in aux_list]
+        out_types = []
+        for node, idx in self._outputs:
+            t = (var_t.get(node.name) if node.is_variable
+                 else entry_t.get((id(node), idx)))
+            out_types.append(np.dtype(t or "float32"))
+        return arg_types, out_types, aux_types
+
+    @staticmethod
+    def _build_known(args, kwargs, names):
+        known = {}
+        if args:
+            for name, v in zip(names, args):
+                if v is not None:
+                    known[name] = v
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = v
+        return known
+
+    # --- serialization ------------------------------------------------------
+    def tojson(self):
+        """nnvm-format JSON (reference: src/c_api/c_api_symbolic.cc
+        MXSymbolSaveToJSON; format of nnvm::Graph JSON)."""
+        topo = self.topo_nodes()
+        nid = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            attrs = dict(n.attrs)
+            attrs.update(n.user_attrs)
+            entry = {
+                "op": "null" if n.is_variable else n.op,
+                "name": n.name,
+                "inputs": [[nid[id(src)], idx, 0] for src, idx in n.inputs],
+            }
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        graph = {
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(topo) if n.is_variable],
+            "node_row_ptr": list(range(len(topo) + 1)),
+            "heads": [[nid[id(n)], idx, 0] for n, idx in self._outputs],
+            "attrs": {"mxnet_version": ["int", 10000]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # --- binding ------------------------------------------------------------
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_arg_names=None, shared_exec=None, shared_buffer=None,
+                    **kwargs):
+        """Allocate arrays by shape inference and bind (reference:
+        symbol.py:1254 → GraphExecutor::Init, graph_executor.cc:956)."""
+        from ..executor import Executor
+        from .. import ndarray as nd
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_types, _, aux_types = self.infer_type(
+            **{k: v for k, v in (type_dict or {}).items()})
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        args = {}
+        for name, shape, t in zip(arg_names, arg_shapes, arg_types):
+            args[name] = nd.zeros(shape, ctx=ctx, dtype=t)
+        args_grad = {}
+        reqs = _normalize_grad_req(grad_req, arg_names)
+        for name, shape, t in zip(arg_names, arg_shapes, arg_types):
+            if reqs[name] != "null":
+                args_grad[name] = nd.zeros(shape, ctx=ctx, dtype=t)
+        aux_states = {
+            name: nd.zeros(shape, ctx=ctx, dtype=t)
+            for name, shape, t in zip(aux_names, aux_shapes, aux_types)
+        }
+        return Executor(self, ctx, args, args_grad, reqs, aux_states,
+                        shared_exec=shared_exec)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        """Bind existing arrays (reference: symbol.py:1518 → Executor::Bind)."""
+        from ..executor import Executor
+
+        arg_names = self.list_arguments()
+        args = _name_arrays(args, arg_names, "args")
+        if args_grad is None:
+            args_grad = {}
+        else:
+            args_grad = _name_arrays(args_grad, arg_names, "args_grad",
+                                     allow_missing=True)
+        aux_states = _name_arrays(aux_states or {}, self.list_auxiliary_states(),
+                                  "aux_states")
+        reqs = _normalize_grad_req(grad_req, arg_names)
+        for name in arg_names:
+            if name not in args_grad:
+                reqs = dict(reqs)
+                reqs[name] = "null"
+        return Executor(self, ctx, args, args_grad, reqs, aux_states,
+                        shared_exec=shared_exec)
+
+    # --- eval ---------------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs, args_grad=None, grad_req="null")
+        return ex.forward(is_train=False)
+
+    # --- operators -----------------------------------------------------------
+    def _binop(self, other, op_name, scalar_op, reverse=False):
+        from . import _internal, op as _op
+
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return getattr(_op, op_name)(a, b)
+        if np.isscalar(other):
+            return getattr(_internal, scalar_op)(self, scalar=float(other))
+        raise TypeError("type %s not supported" % type(other))
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", "_rminus_scalar")
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", "_rdiv_scalar")
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    # comparison helpers used in tests
+    def __eq__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binop(other, "broadcast_equal", "_equal_scalar")
+        return NotImplemented
+
+    __hash__ = object.__hash__
+
+
+def _normalize_grad_req(grad_req, arg_names):
+    if isinstance(grad_req, str):
+        return {n: grad_req for n in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(arg_names, grad_req))
+    out = {n: "null" for n in arg_names}
+    out.update(grad_req)
+    return out
+
+
+def _name_arrays(arrays, names, what, allow_missing=False):
+    if isinstance(arrays, dict):
+        return dict(arrays)
+    arrays = list(arrays)
+    if len(arrays) != len(names) and not allow_missing:
+        raise MXNetError("%s length %d != expected %d (%s)"
+                         % (what, len(arrays), len(names), names))
+    return {n: a for n, a in zip(names, arrays) if a is not None}
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference: symbol.py:2519 mx.sym.Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    user_attrs = AttrScope.current().get(attr)
+    user_attrs = dict(user_attrs)
+    if shape is not None:
+        user_attrs["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        user_attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        user_attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        user_attrs["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        user_attrs["__init__"] = init
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            user_attrs[k] = str(v)
+    node = _Node(None, name, user_attrs=user_attrs)
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (reference: symbol.py:2576)."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load_json(json_str):
+    """Load a symbol from nnvm JSON (reference: MXSymbolCreateFromJSON);
+    accepts both 1.0 'attrs' and pre-0.9 'param' node layouts
+    (src/nnvm/legacy_json_util.cc role)."""
+    graph = json.loads(json_str)
+    raw_nodes = graph["nodes"]
+    nodes = []
+    for rn in raw_nodes:
+        op = rn["op"]
+        attrs = rn.get("attrs", rn.get("param", {})) or {}
+        inputs = [(nodes[nid], idx) for nid, idx, *_ in rn["inputs"]]
+        if op == "null":
+            node = _Node(None, rn["name"], user_attrs=attrs, inputs=inputs)
+        else:
+            opdef = get_op(op)
+            known = {k: v for k, v in attrs.items() if k in opdef.params}
+            extra = {k: v for k, v in attrs.items() if k not in opdef.params}
+            node = _Node(op, rn["name"], attrs=known, user_attrs=extra,
+                         inputs=inputs)
+        nodes.append(node)
+    heads = [(nodes[nid], idx) for nid, idx, *_ in graph["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
