@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic-data training throughput
+(images/sec) on the attached accelerator, vs the reference's published
+P100 number (BASELINE.md §2: 181.53 img/s, docs/faq/perf.md:180-187).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The whole train step (fwd+bwd+allreduce+SGD) is one XLA program
+(mxnet_tpu.parallel.ShardedTrainer); bf16 compute with fp32 BN statistics is
+the TPU analog of the reference's fp16 path (SURVEY.md §7.3(6)).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_S = 181.53  # ResNet-50 train bs32, P100 (docs/faq/perf.md)
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "32"))
+    n_warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    n_iters = int(os.environ.get("BENCH_ITERS", "20"))
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+
+    devices = jax.devices()
+    mesh = make_mesh({"dp": len(devices)}, devices=devices)
+
+    symbol = get_resnet(num_classes=1000, num_layers=50)
+    trainer = ShardedTrainer(
+        symbol, mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        dtype=dtype)
+
+    shapes = {"data": (batch_size, 3, 224, 224),
+              "softmax_label": (batch_size,)}
+    state = trainer.init(shapes)
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(0, 1, shapes["data"]).astype(np.float32)
+    label = rng.randint(0, 1000, batch_size).astype(np.float32)
+    batch = trainer.shard_batch({"data": data, "softmax_label": label})
+
+    for _ in range(n_warmup):
+        state, outs = trainer.step(state, batch)
+    np.asarray(outs[0])  # D2H fetch: block_until_ready alone does not
+    # flush the remote-tunnel execution queue
+
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, outs = trainer.step(state, batch)
+    # each step consumes the previous step's donated params, so fetching the
+    # last output forces the whole chain to completion
+    np.asarray(outs[0])
+    dt = time.perf_counter() - t0
+
+    img_s = batch_size * n_iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

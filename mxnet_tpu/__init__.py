@@ -9,6 +9,13 @@ becomes in-program ICI collectives over a jax.sharding.Mesh.
 
 __version__ = "1.0.0"
 
+# MXNet supports float64 end-to-end (per-dtype test tolerances, fp64 ground
+# truth in check_consistency — reference test_utils.py:1203); JAX needs x64
+# opt-in. Weak typing keeps float32 as the working default on TPU.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
 from .base import MXNetError, AttrScope, NameManager, Prefix
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 
@@ -21,3 +28,25 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import executor
 from .executor import Executor
+
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from . import module
+from . import module as mod
+from . import monitor
+from .monitor import Monitor
+from . import profiler
+from . import visualization
+from . import visualization as viz
+from . import parallel
+from . import models
+from . import test_utils

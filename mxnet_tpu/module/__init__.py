@@ -1,0 +1,6 @@
+"""Module API (reference: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module
+from .executor_group import DataParallelExecutorGroup
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule

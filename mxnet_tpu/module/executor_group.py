@@ -1,0 +1,316 @@
+"""DataParallelExecutorGroup (reference:
+python/mxnet/module/executor_group.py:99, executor_manager.py:31).
+
+One bound Executor per context; the batch is split along the batch axis
+(workload-weighted `_split_input_slice`), each replica runs its own compiled
+XLA program asynchronously (jax async dispatch gives the overlap the
+reference gets from the dependency engine), and gradient aggregation happens
+in KVStore/psum afterwards. On a TPU mesh the preferred layout is instead ONE
+sharded executor under pjit (mxnet_tpu.parallel); this group exists for
+context-list parity.
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..io import DataDesc
+
+_SliceRange = namedtuple("_SliceRange", ["start", "stop"])
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Workload-weighted batch split (reference: executor_manager.py:31)."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [round(work_load * batch_size / total_work_load)
+                      for work_load in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _load_general(data, targets, major_axis):
+    """Scatter batch slices to per-device arrays (reference:
+    executor_group.py:65)."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                if major_axis == 0 or major_axis is None:
+                    d_src[slice_idx].copyto(d_dst)
+                else:
+                    src_np = d_src.asnumpy()
+                    idx = [slice(None)] * src_np.ndim
+                    idx[major_axis] = slice_idx
+                    d_dst._set_data(nd.array(src_np[tuple(idx)])._data)
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Gather per-device outputs (reference: executor_group.py:merge)."""
+    rets = []
+    for tensors, axis in zip(outputs, major_axis):
+        if axis >= 0 and len(tensors) > 1:
+            rets.append(nd.concatenate(tensors, axis=axis))
+        else:
+            rets.append(tensors[0])
+    return rets
+
+
+class DataParallelExecutorGroup:
+    """Replica manager for multi-context data parallelism."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=logging, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        if not for_training:
+            grad_req = "null"
+
+        data_names = [x[0] for x in data_shapes]
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = ("null" if k in self.fixed_param_names
+                                        else grad_req)
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            assert len(grad_req) == len(self.arg_names)
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = ("null" if k in self.fixed_param_names
+                                        else "write")
+                elif k in data_names:
+                    self.grad_req[k] = "write" if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+            self.grad_req.update(grad_req)
+        else:
+            raise ValueError("grad_req must be one of str, list, tuple, or "
+                             "dict.")
+
+        self._shared_group = shared_group
+        self.execs = []
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.output_layouts = [
+            DataDesc.get_batch_axis(self.symbol[i].attr("__layout__"))
+            for i in range(len(self.symbol.list_outputs()))]
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """(reference: executor_group.py:decide_slices)"""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(x, "layout", "NCHW"))
+                      for x in data_shapes]
+        for (name, shape), axis in zip(data_shapes, major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, \
+                    ("all data must have the same batch size: batch_size = %d"
+                     ", but %s has shape %s" % (self.batch_size, name, shape))
+            else:
+                self.batch_size = batch_size
+                self.slices = _split_input_slice(self.batch_size,
+                                                 self.workload)
+        return major_axis
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        """Bind one executor per context (reference: executor_group.py:302)."""
+        self.batch_size = None
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None:
+            self.label_layouts = self.decide_slices(label_shapes)
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes,
+                                                  shared_group))
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.data_names = [i.name for i in self.data_shapes]
+        if label_shapes is not None:
+            self.label_names = [i.name for i in self.label_shapes]
+        self._collect_arrays()
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and label_shapes == self.label_shapes:
+            return
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        """(reference: executor_group.py:_sliced_shape)"""
+        sliced_shapes = []
+        for desc, axis in zip(shapes, major_axis):
+            shape = list(desc.shape)
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced_shapes.append(DataDesc(desc.name, tuple(shape),
+                                          getattr(desc, "dtype", np.float32),
+                                          getattr(desc, "layout", "NCHW")))
+        return sliced_shapes
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        """simple_bind the i-th replica (reference: executor_group.py:562)."""
+        shared_exec = None if shared_group is None else shared_group.execs[i]
+        context = self.contexts[i]
+        shared_data_arrays = {}
+        input_shapes = dict(
+            [(x.name, x.shape)
+             for x in self._sliced_shape(data_shapes, i, self.data_layouts)])
+        if label_shapes is not None:
+            input_shapes.update(
+                [(x.name, x.shape)
+                 for x in self._sliced_shape(label_shapes, i,
+                                             self.label_layouts)])
+        executor = self.symbol.simple_bind(
+            ctx=context, grad_req=self.grad_req, shared_exec=shared_exec,
+            **input_shapes)
+        return executor
+
+    def _collect_arrays(self):
+        """(reference: executor_group.py:_collect_arrays)"""
+        self.data_arrays = [
+            [(self.slices[i], e.arg_dict[name]) for i, e in
+             enumerate(self.execs)]
+            for name, _ in self.data_shapes]
+        if self.label_shapes is not None:
+            self.label_arrays = [
+                [(self.slices[i], e.arg_dict[name]) for i, e in
+                 enumerate(self.execs)]
+                for name, _ in self.label_shapes]
+        else:
+            self.label_arrays = None
+        self.param_arrays = [
+            [exec_.arg_dict[name] for exec_ in self.execs]
+            for name in self.param_names if name in self.arg_names]
+        if self.for_training:
+            self.grad_arrays = [
+                [exec_.grad_dict.get(name) for exec_ in self.execs]
+                for name in self.param_names if name in self.arg_names]
+        else:
+            self.grad_arrays = None
+        data_names = [x[0] for x in self.data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [
+                [exec_.grad_dict.get(name) for exec_ in self.execs]
+                for name in data_names]
+        else:
+            self.input_grad_arrays = None
+        self.aux_arrays = [
+            [exec_.aux_dict[name] for exec_ in self.execs]
+            for name in self.aux_names]
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        """(reference: executor_group.py:set_params)"""
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Merge per-device params back (reference: executor_group.py:get_params)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(b.as_in_context(block[0].context)
+                         for b in block) / len(block)
+            weight.astype(arg_params[name].dtype).copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(b.as_in_context(block[0].context)
+                         for b in block) / len(block)
+            weight.astype(aux_params[name].dtype).copyto(aux_params[name])
+
+    def forward(self, data_batch, is_train=None):
+        """Scatter + per-replica forward (reference: executor_group.py:394)."""
+        _load_general(data_batch.data, self.data_arrays, 0)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_arrays is not None and data_batch.label:
+            _load_general(data_batch.label, self.label_arrays, 0)
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def get_output_shapes(self):
+        outputs = self.execs[0].outputs
+        shapes = [out.shape for out in outputs]
+        concat_shapes = []
+        for key, the_shape, axis in zip(self.symbol.list_outputs(), shapes,
+                                        self.output_layouts):
+            the_shape = list(the_shape)
+            if axis >= 0:
+                the_shape[axis] = self.batch_size
+            concat_shapes.append((key, tuple(the_shape)))
+        return concat_shapes
+
+    def get_outputs(self, merge_multi_context=True):
+        """(reference: executor_group.py:get_outputs)"""
+        outputs = [[exec_.outputs[i] for exec_ in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            out_axes = [axis if axis is not None and axis >= 0 else 0
+                        for axis in self.output_layouts]
+            outputs = _merge_multi_context(outputs, out_axes)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            return _merge_multi_context(self.input_grad_arrays,
+                                        [0] * len(self.input_grad_arrays))
+        return self.input_grad_arrays
+
+    def backward(self, out_grads=None):
+        """(reference: executor_group.py:526)"""
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        for i, exec_ in enumerate(self.execs):
+            out_grads_slice = None
+            if out_grads is not None:
+                out_grads_slice = []
+                for grad in out_grads:
+                    og = grad[self.slices[i]]
+                    out_grads_slice.append(og.as_in_context(self.contexts[i]))
+            exec_.backward(out_grads=out_grads_slice)
+
+    def update_metric(self, eval_metric, labels):
+        """(reference: executor_group.py:555)"""
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = []
+            for label in labels:
+                if label.shape[0] == self.batch_size:
+                    labels_slice.append(label[islice])
+                else:
+                    labels_slice.append(label)
+            eval_metric.update(labels_slice, texec.outputs)

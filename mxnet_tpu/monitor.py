@@ -1,0 +1,86 @@
+"""Monitor — executor-level tensor spy (reference: python/mxnet/monitor.py:33,
+src/executor/graph_executor.cc:199 ExecuteMonCallback).
+
+The reference installs a C callback fired per output entry; here the
+executor exposes its outputs (and optionally interior node values) after each
+forward, and the monitor applies a stat function to tensors whose names match
+the pattern. ``jax.debug.callback`` is the in-jit analog when interior values
+are needed; the default mode spies bound executor outputs + arguments."""
+from __future__ import annotations
+
+import logging
+import re
+from math import sqrt
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect stats on matching tensors each step (reference: monitor.py:33)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """returns |x|/size(x), async execution."""
+                return x.abs().sum() / x.size
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        """Attach to an executor (reference: monitor.py:install)."""
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch (reference: monitor.py:tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Collect stats from installed executors (reference: monitor.py:toc)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_outputs(), exe.outputs):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in exe.arg_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in exe.aux_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log (reference: monitor.py:toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

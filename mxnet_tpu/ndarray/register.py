@@ -29,16 +29,19 @@ def _cot_dtype(dtype):
     return jax.dtypes.float0
 
 
-def _record(f, input_arrays, name):
+def _record(f, input_arrays, name, datas=None):
     """Run ``f`` over raw inputs with vjp capture; returns (outs, new_aux).
 
     ``f``: (raw jax arrays...) -> ((outputs...), (new_aux...))
+    ``datas``: pre-normalized raw arrays (device-gathered); defaults to the
+    arrays' own data.
     """
     import jax
 
     from .. import autograd
 
-    datas = tuple(a._data for a in input_arrays)
+    if datas is None:
+        datas = tuple(a._data for a in input_arrays)
     outs, vjp_fn, new_aux = jax.vjp(lambda *xs: f(*xs), *datas, has_aux=True)
     node = autograd.TapeNode(
         vjp_fn,
@@ -124,14 +127,17 @@ def invoke(opdef, args, kwargs):
 
     is_train = autograd.is_training()
     rng = _random.next_key() if opdef.needs_rng else None
-    main_datas = tuple(a._data for a in main)
-    aux_datas = tuple(a._data for a in aux)
+    from ..ops.registry import normalize_device_placement
+
+    normalized = normalize_device_placement(
+        tuple(a._data for a in main) + tuple(a._data for a in aux))
+    main_datas, aux_datas = normalized[:len(main)], normalized[len(main):]
 
     if autograd.is_recording():
         def f(*xs):
             return opdef.apply(attrs, xs, aux_datas, is_train=is_train, rng=rng)
 
-        outs, new_aux, node = _record(f, main, opdef.name)
+        outs, new_aux, node = _record(f, main, opdef.name, datas=main_datas)
         results = []
         for i, o in enumerate(outs):
             arr = _from_data(o)

@@ -277,6 +277,17 @@ def _register_concat_split():
                 input_names=lambda attrs: ["arg%d" % i for i in range(attrs.num_args)])
 
     def where(attrs, cond, a, b):
+        # MXNet semantics (src/operator/tensor/control_flow_op.h): cond is
+        # either the same shape as x/y, or 1-D of length x.shape[0]
+        # selecting whole rows. Anything else is an error — do NOT fall
+        # back to numpy trailing-axis broadcasting.
+        if cond.shape != a.shape:
+            if not (cond.ndim == 1 and a.ndim >= 1
+                    and cond.shape[0] == a.shape[0]):
+                raise ValueError(
+                    "where: condition shape %s must equal x shape %s or be "
+                    "1-D of length x.shape[0]" % (cond.shape, a.shape))
+            cond = cond.reshape((-1,) + (1,) * (a.ndim - 1))
         return jnp.where(cond != 0, a, b)
 
     register_op("where", where, num_inputs=3,

@@ -538,13 +538,12 @@ def _register_loss_heads():
             return data
 
         def fwd(data):
-            return data, (data.shape, data.dtype)
+            return data, None
 
-        def bwd(res, g):
-            shape, dtype = res
-            grad = jnp.full(shape, grad_scale, dtype=dtype)
+        def bwd(_, g):
+            grad = jnp.full_like(g, grad_scale)
             if normalization == "batch":
-                grad = grad / shape[0]
+                grad = grad / g.shape[0]
             return (grad,)
 
         f.defvjp(fwd, bwd)

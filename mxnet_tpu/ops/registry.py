@@ -255,7 +255,36 @@ def _jitted(opdef, attrs, is_train, n_in, n_aux):
     return jax.jit(f)
 
 
+def _is_single_device(x):
+    get = getattr(x, "devices", None)
+    return get is not None and len(get()) == 1
+
+
+def normalize_device_placement(arrays):
+    """Gather single-device arrays that span several devices onto the first
+    single-device array's device — the analog of the reference auto-inserting
+    _CrossDeviceCopy nodes (graph_executor.cc:317-421) before an op that
+    spans devices. Mesh-sharded (multi-device) arrays are left untouched:
+    their layouts belong to the parallel layer and must not be gathered."""
+    import jax
+
+    devs = set()
+    for x in arrays:
+        if _is_single_device(x):
+            devs |= x.devices()
+    if len(devs) <= 1:
+        return tuple(arrays)
+    target = next(d for x in arrays if _is_single_device(x)
+                  for d in x.devices())
+    return tuple(jax.device_put(x, target) if _is_single_device(x) else x
+                 for x in arrays)
+
+
 def eager_call(opdef, attrs, input_datas, aux_datas=(), is_train=False, rng=None):
     """Run one op eagerly on raw JAX arrays, compiled and cached."""
+    n_in = len(input_datas)
+    normalized = normalize_device_placement(tuple(input_datas) +
+                                            tuple(aux_datas))
+    input_datas, aux_datas = normalized[:n_in], normalized[n_in:]
     f = _jitted(opdef, attrs, bool(is_train), len(input_datas), len(aux_datas))
     return f(tuple(input_datas), tuple(aux_datas), rng)

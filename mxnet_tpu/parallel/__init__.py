@@ -1,0 +1,13 @@
+"""Parallelism over a jax.sharding.Mesh (SURVEY.md §5.8: the TPU-native
+replacement for the whole KVStore comm table).
+
+The reference scales by replica Executors + KVStore reduce (CommDevice P2P,
+NCCL, ps-lite). Here the entire data-parallel training step — forward,
+backward, gradient all-reduce, optimizer update — is ONE XLA program
+compiled over a device Mesh: batch sharded on the 'dp' axis, params
+replicated, XLA's sharding propagation inserting the ICI all-reduces that
+KVStore push/pull performed explicitly. Multi-host (the ps-lite analog) is
+the same program under jax.distributed initialization.
+"""
+from .mesh import make_mesh, data_parallel_sharding, replicated_sharding
+from .trainer import ShardedTrainer

@@ -1,0 +1,45 @@
+"""Device mesh helpers (reference analog: the device lists KVStore/Module
+juggle — src/kvstore/comm.h round-robin buffer placement — replaced by an
+explicit jax.sharding.Mesh)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["make_mesh", "data_parallel_sharding", "replicated_sharding"]
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh. ``axes`` maps axis name → size, e.g. {'dp': 8} or
+    {'dp': 4, 'mp': 2}; -1 for one axis means "all remaining devices"."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError("mesh needs %d devices, only %d available"
+                         % (total, len(devices)))
+    dev_array = np.array(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def data_parallel_sharding(mesh, axis="dp"):
+    """NamedSharding splitting dim 0 over the data-parallel mesh axis."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh):
+    """Fully-replicated NamedSharding (the parameter layout for pure DP)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,214 @@
+"""ShardedTrainer — the whole training step as one sharded XLA program.
+
+This is the performance-critical path SURVEY.md §7.3(6) calls out: no per-op
+dispatch, no explicit KVStore push/pull — forward + backward + all-reduce +
+fused optimizer update compile into a single ``jax.jit`` over a Mesh. It is
+the TPU-native equivalent of:
+
+- DataParallelExecutorGroup replica forward/backward
+  (python/mxnet/module/executor_group.py:394-554),
+- KVStore 'device' gradient reduce (src/kvstore/comm.h:482 CommDevice),
+- the fused optimizer update ops (src/operator/optimizer_op.cc),
+
+with XLA sharding propagation emitting the ICI collectives that CommDevice
+performed as explicit P2P copies.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..executor import _GraphProgram
+from ..ops.registry import get_op
+
+__all__ = ["ShardedTrainer"]
+
+# optimizer name → (update op, aux state names in op order)
+_FUSED_OPT = {
+    "sgd": ("sgd_update", ()),
+    "sgd_mom": ("sgd_mom_update", ("mom",)),
+    "adam": ("adam_update", ("mean", "var")),
+}
+
+
+class ShardedTrainer:
+    """Compile a Symbol's training step over a device mesh.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        Loss-headed training symbol (e.g. ...SoftmaxOutput).
+    mesh : jax.sharding.Mesh
+        Mesh with a data-parallel axis (default name 'dp').
+    optimizer : str
+        'sgd' (momentum>0 selects sgd_mom) or 'adam'.
+    optimizer_params : dict
+        lr/wd/momentum/... forwarded to the fused update op.
+    data_names / label_names : input variable names (sharded on dp).
+    dtype : computation dtype for params/activations (np.float32 or bf16).
+    """
+
+    def __init__(self, symbol, mesh, optimizer="sgd", optimizer_params=None,
+                 data_names=("data",), label_names=("softmax_label",),
+                 dp_axis="dp", dtype=np.float32):
+        import jax
+
+        self.symbol = symbol
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.dtype = dtype
+        self._prog = _GraphProgram(symbol)
+        self._input_names = [n for n in (*data_names, *label_names)
+                             if n in self._prog.arg_names]
+        self.param_names = [n for n in self._prog.arg_names
+                            if n not in self._input_names]
+        self.aux_names = list(self._prog.aux_names)
+
+        opt_params = dict(optimizer_params or {})
+        self._lr = opt_params.pop("learning_rate", opt_params.pop("lr", 0.01))
+        self._user_rescale = "rescale_grad" in opt_params
+        momentum = opt_params.get("momentum", 0.0)
+        if optimizer == "sgd" and momentum > 0:
+            optimizer = "sgd_mom"
+        elif optimizer == "sgd":
+            opt_params.pop("momentum", None)
+        if optimizer not in _FUSED_OPT:
+            raise MXNetError("ShardedTrainer supports %s; got %r"
+                             % (sorted(_FUSED_OPT), optimizer))
+        op_name, state_names = _FUSED_OPT[optimizer]
+        self._opt_opdef = get_op(op_name)
+        self._opt_state_names = state_names
+        # parse once with a placeholder lr to validate + fill defaults; the
+        # live (possibly scheduled) lr is spliced in as a traced scalar
+        self._opt_defaults = dict(
+            self._opt_opdef.parse_attrs(dict(opt_params, lr=0.0))._d)
+        self._label_set = set(label_names)
+        self._step_fn = None
+
+        from .mesh import data_parallel_sharding, replicated_sharding
+        self._dp_sharding = data_parallel_sharding(mesh, dp_axis)
+        self._rep_sharding = replicated_sharding(mesh)
+
+    # --- state initialization --------------------------------------------
+    def init(self, data_shapes, initializer=None, seed=0):
+        """Allocate replicated params/aux and zero optimizer state.
+
+        ``data_shapes``: dict name→GLOBAL batch shape for data+label inputs.
+        Returns the state dict used by :meth:`step`.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..initializer import Xavier, InitDesc
+
+        initializer = initializer or Xavier(rnd_type="gaussian",
+                                            factor_type="in", magnitude=2)
+        if not self._user_rescale:
+            # Module convention: rescale_grad = 1/global_batch_size
+            # (python/mxnet/module/module.py:init_optimizer)
+            batch = next(iter(data_shapes.values()))[0]
+            self._opt_defaults["rescale_grad"] = 1.0 / float(batch)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**data_shapes)
+        shapes = dict(zip(self._prog.arg_names, arg_shapes))
+        aux_shape_d = dict(zip(self.aux_names, aux_shapes))
+
+        np.random.seed(seed)
+        params = {}
+        for name in self.param_names:
+            buf = np.zeros(shapes[name], dtype=np.float32)
+            initializer(InitDesc(name), buf)
+            params[name] = jax.device_put(buf.astype(self.dtype),
+                                          self._rep_sharding)
+        aux = {}
+        for name in self.aux_names:
+            fill = 1.0 if name.endswith("_var") or name.endswith("var") else 0.0
+            if name.endswith("moving_var"):
+                fill = 1.0
+            aux[name] = jax.device_put(
+                jnp.full(aux_shape_d[name], fill, dtype=np.float32),
+                self._rep_sharding)
+        opt_state = {
+            name: tuple(jax.device_put(jnp.zeros(shapes[name],
+                                                 dtype=np.float32),
+                                       self._rep_sharding)
+                        for _ in self._opt_state_names)
+            for name in self.param_names}
+        return {"params": params, "aux": aux, "opt": opt_state, "step": 0}
+
+    def shard_batch(self, arrays):
+        """Place host arrays onto the mesh, batch-sharded along dp."""
+        import jax
+
+        return {k: jax.device_put(np.asarray(v) if k in self._label_set
+                                  else np.asarray(v, dtype=self.dtype),
+                                  self._dp_sharding)
+                for k, v in arrays.items()}
+
+    # --- the compiled step -------------------------------------------------
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        prog = self._prog
+        opt_opdef = self._opt_opdef
+        from ..ops.registry import OpAttrs
+
+        def step(params, aux, opt_state, batch, lr, step_i):
+            # lr is a traced scalar so LR schedules don't recompile
+            opt_attrs = OpAttrs(dict(self._opt_defaults, lr=lr))
+            rng_base = jax.random.fold_in(jax.random.PRNGKey(0), step_i)
+            rngs = tuple(jax.random.fold_in(rng_base, i)
+                         for i in range(len(prog.rng_nodes)))
+
+            def loss_fn(p):
+                arg_d = dict(batch)
+                arg_d.update(p)
+                outs, aux_upd = prog._eval(arg_d, aux, rngs, True)
+                return tuple(outs), aux_upd
+
+            outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
+            seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp(seeds)[0]
+
+            new_params = {}
+            new_opt = {}
+            for name in self.param_names:
+                w, g = params[name], grads[name]
+                states = opt_state[name]
+                (new_w,), new_states = opt_opdef.apply(
+                    opt_attrs, (w, g.astype(w.dtype)), states)
+                new_params[name] = new_w
+                new_opt[name] = tuple(new_states)
+            new_aux = dict(aux)
+            new_aux.update(aux_upd)
+            return new_params, new_aux, new_opt, outs
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def step(self, state, batch):
+        """Run one training step; returns (new_state, outputs).
+
+        ``batch``: dict of sharded arrays from :meth:`shard_batch`."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        lr = self._lr(state["step"]) if callable(self._lr) else self._lr
+        params, aux, opt, outs = self._step_fn(
+            state["params"], state["aux"], state["opt"], batch,
+            np.float32(lr), np.int32(state["step"]))
+        return ({"params": params, "aux": aux, "opt": opt,
+                 "step": state["step"] + 1}, outs)
+
+    # --- inference ----------------------------------------------------------
+    def forward_fn(self):
+        """Compiled inference forward over the mesh (batch-sharded)."""
+        import jax
+
+        prog = self._prog
+
+        def fwd(params, aux, batch):
+            arg_d = dict(batch)
+            arg_d.update(params)
+            outs = prog._eval(arg_d, aux, (), False)[0]
+            return outs
+
+        return jax.jit(fwd)

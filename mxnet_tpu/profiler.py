@@ -1,0 +1,46 @@
+"""Profiler facade (reference: python/mxnet/profiler.py:27-55,
+src/engine/profiler.cc).
+
+The reference's engine profiler emits chrome://tracing JSON per engine op;
+the TPU analog is the JAX/XLA profiler (XPlane → TensorBoard / perfetto
+trace). The mx.profiler API is kept: set_config(filename) + set_state
+('run'/'stop') wraps jax.profiler.start_trace/stop_trace; dump_profile stops
+and flushes the trace directory."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile"]
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """(reference: profiler.py:profiler_set_config)"""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """(reference: profiler.py:profiler_set_state); 'run' starts a JAX trace,
+    'stop' ends it."""
+    import jax
+
+    if state == "run" and not _state["running"]:
+        trace_dir = os.path.splitext(_state["filename"])[0] + "_trace"
+        jax.profiler.start_trace(trace_dir)
+        _state["running"] = True
+        _state["trace_dir"] = trace_dir
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def dump_profile():
+    """(reference: profiler.py:dump_profile)"""
+    profiler_set_state("stop")
+
+
+# aliased modern names
+set_config = profiler_set_config
+set_state = profiler_set_state

@@ -84,6 +84,76 @@ class Symbol:
             raise ValueError("cannot find output %r" % index)
         return Symbol([self._outputs[index]])
 
+    def __call__(self, *args, **kwargs):
+        """Compose: substitute this symbol's free variables with other
+        symbols' outputs (reference: symbol.py Symbol.__call__/_compose —
+        nnvm Symbol::Compose). Positional args bind in list_arguments()
+        order; kwargs bind by variable name. Returns a new Symbol; this one
+        is unchanged (the reference mutates in place — a copy is safer and
+        observationally equivalent for the documented pattern)."""
+        kwargs.pop("name", None)
+        for a in args:
+            if not isinstance(a, Symbol):
+                raise TypeError("compose expects Symbol arguments")
+        for k, v in kwargs.items():
+            if not isinstance(v, Symbol):
+                raise TypeError("compose expects Symbol keyword arguments")
+        arg_names = self.list_arguments()
+        mapping = {}
+        if args:
+            if len(args) > len(arg_names):
+                raise ValueError("too many positional arguments to compose")
+            for name, s in zip(arg_names, args):
+                mapping[name] = s
+        for k, v in kwargs.items():
+            if k in mapping:
+                raise ValueError("duplicate binding for %r" % k)
+            mapping[k] = v
+        for s in mapping.values():
+            if len(s._outputs) != 1:
+                raise ValueError("can only compose with single-output symbols")
+        replace = {}
+        matched = set()
+        for node in self.topo_nodes():
+            if node.is_variable and node.name in mapping:
+                replace[id(node)] = mapping[node.name]._outputs[0]
+                matched.add(node.name)
+        unmatched = set(mapping) - matched
+        if unmatched:
+            raise ValueError(
+                "compose: keyword argument(s) %s do not match any free "
+                "variable of this symbol (arguments: %s)"
+                % (sorted(unmatched), arg_names))
+        if not replace:
+            return Symbol(list(self._outputs))
+        memo = {}
+
+        def rebuild(node):
+            if id(node) in replace:
+                return replace[id(node)]
+            if id(node) in memo:
+                return (memo[id(node)], None)
+            if node.is_variable:
+                memo[id(node)] = node
+                return (node, None)
+            new_inputs = []
+            for inp, idx in node.inputs:
+                rep = rebuild(inp)
+                if rep[1] is not None:  # replaced entry carries out index
+                    new_inputs.append(rep)
+                else:
+                    new_inputs.append((rep[0], idx))
+            new_node = _Node(node.op, node.name, node.attrs, node.user_attrs,
+                             new_inputs)
+            memo[id(node)] = new_node
+            return (new_node, None)
+
+        new_outputs = []
+        for node, idx in self._outputs:
+            rep = rebuild(node)
+            new_outputs.append(rep if rep[1] is not None else (rep[0], idx))
+        return Symbol(new_outputs)
+
     def get_internals(self):
         """Symbol grouping every internal output (reference: symbol.py:556)."""
         entries = []

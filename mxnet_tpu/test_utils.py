@@ -1,0 +1,502 @@
+"""Testing utilities (reference: python/mxnet/test_utils.py, 1540 LoC).
+
+Ports the reference's numeric-test harness (SURVEY.md §4): per-dtype
+tolerances, ``assert_almost_equal``, finite-difference
+``check_numeric_gradient``, ``check_symbolic_forward/backward`` against numpy
+closures, and ``check_consistency`` (same symbol across contexts/dtypes — the
+reference's GPU-vs-CPU pattern reused as TPU-vs-CPU)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from . import ndarray as nd
+from . import symbol as sym
+from .ndarray import NDArray
+
+_rng = np.random.RandomState(1234)
+
+default_dtype = np.float32
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    from . import context as ctx_mod
+    ctx_mod._thread_state.ctx_stack = [ctx]
+
+
+def default_rtols():
+    """(reference: test_utils.py per-dtype tolerances)"""
+    return {np.dtype(np.float16): 1e-2,
+            np.dtype(np.float32): 1e-4,
+            np.dtype(np.float64): 1e-5,
+            np.dtype(np.bool_): 0,
+            np.dtype(np.int32): 0,
+            np.dtype(np.int64): 0,
+            np.dtype(np.uint8): 0}
+
+
+def default_atols():
+    return {np.dtype(np.float16): 1e-1,
+            np.dtype(np.float32): 1e-3,
+            np.dtype(np.float64): 1e-20,
+            np.dtype(np.bool_): 0,
+            np.dtype(np.int32): 0,
+            np.dtype(np.int64): 0,
+            np.dtype(np.uint8): 0}
+
+
+def get_tolerance(arr, rtol, tols):
+    if rtol is not None:
+        return rtol
+    dtype = np.dtype(arr.dtype)
+    return tols.get(dtype, 1e-4)
+
+
+def random_arrays(*shapes):
+    """Generate random float64 arrays (reference: test_utils.py:random_arrays)."""
+    arrays = [np.array(_rng.randn(), dtype=np.float64) if len(s) == 0
+              else _rng.randn(*s).astype(np.float64) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def random_sample(population, k):
+    """Sample k items without replacement (reference: test_utils.py)."""
+    population_copy = population[:]
+    np.random.shuffle(population_copy)
+    return population_copy[0:k]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None):
+    """(reference: test_utils.py:254 — sparse stypes map to dense on TPU)"""
+    arr = nd.array(_rng.uniform(-1, 1, shape), dtype=dtype or default_dtype)
+    return arr
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """(reference: test_utils.py:np_reduce)"""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """(reference: test_utils.py:find_max_violation)"""
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.argmax(violation)
+    idx = np.unravel_index(loc, violation.shape)
+    return idx, np.max(violation)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """(reference: test_utils.py:467)"""
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    if isinstance(b, NDArray):
+        b = b.asnumpy()
+    a = np.asarray(a)
+    b = np.asarray(b)
+    rtol = get_tolerance(a, rtol, default_rtols())
+    atol = get_tolerance(a, atol, default_atols())
+    if np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    index, rel = find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f.  Location of maximum "
+        "error:%s, %s=%f, %s=%f"
+        % (rel, rtol, atol, str(index), names[0], a[index], names[1], b[index]))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """(reference: test_utils.py:assert_exception)"""
+    try:
+        f(*args, **kwargs)
+        assert False
+    except exception_type:
+        return
+
+
+def simple_forward(sym_inst, ctx=None, is_train=False, **inputs):
+    """(reference: test_utils.py:simple_forward)"""
+    ctx = ctx or default_context()
+    inputs = {k: nd.array(v) for k, v in inputs.items()}
+    exe = sym_inst.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(sym_inst, location, ctx, dtype=default_dtype):
+    """(reference: test_utils.py:_parse_location)"""
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(sym_inst.list_arguments()):
+            raise ValueError(
+                "Symbol arguments and keys of the given location do not match."
+                "symbol args:%s, location.keys():%s"
+                % (str(set(sym_inst.list_arguments())),
+                   str(set(location.keys()))))
+    else:
+        location = {k: v for k, v in
+                    zip(sym_inst.list_arguments(), location)}
+    location = {k: v.as_in_context(ctx) if isinstance(v, NDArray)
+                else nd.array(np.asarray(v), ctx=ctx, dtype=dtype)
+                for k, v in location.items()}
+    return location
+
+
+def _parse_aux_states(sym_inst, aux_states, ctx, dtype=default_dtype):
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            if set(aux_states.keys()) != set(sym_inst.list_auxiliary_states()):
+                raise ValueError("Symbol aux_states names and given aux_states "
+                                 "do not match.")
+        elif isinstance(aux_states, (list, tuple)):
+            aux_names = sym_inst.list_auxiliary_states()
+            aux_states = {k: v for k, v in zip(aux_names, aux_states)}
+        aux_states = {k: nd.array(np.asarray(v), ctx=ctx, dtype=dtype)
+                      for k, v in aux_states.items()}
+    return aux_states
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=default_dtype):
+    """Finite-difference gradients (reference: test_utils.py:numeric_grad)."""
+    approx_grads = {k: np.zeros(v.shape, dtype=dtype)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k]._set_data(nd.array(v, dtype=dtype)._data)
+    location = {k: np.array(v, order="C") for k, v in location.items()}
+    for k, v in location.items():
+        if v.dtype.kind != "f":
+            continue
+        old_value = v.copy()
+        for i in range(int(np.prod(v.shape)) if v.shape else 1):
+            # +eps
+            v.ravel()[i] = old_value.ravel()[i] + eps / 2.0
+            executor.arg_dict[k]._set_data(nd.array(v, dtype=dtype)._data)
+            executor.forward(is_train=use_forward_train)
+            f_peps = sum(np.sum(out.asnumpy()) for out in executor.outputs)
+            # -eps
+            v.ravel()[i] = old_value.ravel()[i] - eps / 2.0
+            executor.arg_dict[k]._set_data(nd.array(v, dtype=dtype)._data)
+            executor.forward(is_train=use_forward_train)
+            f_neps = sum(np.sum(out.asnumpy()) for out in executor.outputs)
+            approx_grads[k].ravel()[i] = (f_peps - f_neps) / eps
+            v.ravel()[i] = old_value.ravel()[i]
+        # reset
+        executor.arg_dict[k]._set_data(nd.array(old_value, dtype=dtype)._data)
+    return approx_grads
+
+
+def check_numeric_gradient(sym_inst, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True, ctx=None,
+                           grad_stype_dict=None, dtype=default_dtype):
+    """Finite differences vs symbolic backward
+    (reference: test_utils.py:check_numeric_gradient)."""
+    assert dtype in (np.float16, np.float32, np.float64)
+    if ctx is None:
+        ctx = default_context()
+
+    def random_projection(shape):
+        plain = _rng.rand(*shape) + 0.1
+        return plain
+
+    location = _parse_location(sym_inst, location, ctx, dtype=dtype)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux_states = _parse_aux_states(sym_inst, aux_states, ctx, dtype=dtype)
+    if aux_states is not None:
+        aux_npy = {k: v.asnumpy() for k, v in aux_states.items()}
+    else:
+        aux_npy = None
+
+    if grad_nodes is None:
+        grad_nodes = sym_inst.list_arguments()
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_nodes = list(grad_nodes)
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, dict):
+        grad_req = grad_nodes.copy()
+        grad_nodes = grad_nodes.keys()
+    else:
+        raise ValueError
+
+    input_shape = {k: v.shape for k, v in location.items()}
+    _, out_shape, _ = sym_inst.infer_shape(**input_shape)
+    proj = sym.Variable("__random_proj")
+    out = sym.sum(sym_inst * proj)
+    out = sym.MakeLoss(out)
+
+    location = dict(location, __random_proj=nd.array(
+        random_projection(out_shape[0]), ctx=ctx, dtype=dtype))
+    args_grad_npy = {k: _rng.normal(0, 0.01, size=location[k].shape)
+                     for k in grad_nodes}
+    args_grad = {k: nd.array(v, ctx=ctx, dtype=dtype)
+                 for k, v in args_grad_npy.items()}
+
+    grad_req_all = {k: "null" for k in out.list_arguments()}
+    grad_req_all.update(grad_req)
+    grad_req_all["__random_proj"] = "null"
+    executor = out.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req_all, aux_states=aux_states)
+
+    executor.forward(is_train=True)
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    numeric_gradients = numeric_grad(
+        executor, location_npy, aux_npy, eps=numeric_eps,
+        use_forward_train=use_forward_train, dtype=dtype)
+
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        if grad_req[name] == "write":
+            assert_almost_equal(fd_grad, sym_grad, rtol, atol,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(fd_grad, sym_grad - args_grad_npy[name], rtol,
+                                atol,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], sym_grad, rtol, atol,
+                                ("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+        else:
+            raise ValueError
+
+
+def check_symbolic_forward(sym_inst, location, expected, rtol=1E-4, atol=None,
+                           aux_states=None, ctx=None, dtype=default_dtype,
+                           equal_nan=False):
+    """Forward vs expected numpy (reference:
+    test_utils.py:check_symbolic_forward)."""
+    assert dtype in (np.float16, np.float32, np.float64)
+    if ctx is None:
+        ctx = default_context()
+    location = _parse_location(sym_inst, location, ctx, dtype=dtype)
+    aux_states = _parse_aux_states(sym_inst, aux_states, ctx, dtype=dtype)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym_inst.list_outputs()]
+    executor = sym_inst.bind(ctx, args=location, args_grad=None,
+                             grad_req="null", aux_states=aux_states)
+    executor.forward(is_train=False)
+    outputs = [x.asnumpy() for x in executor.outputs]
+    for output_name, expect, output in zip(sym_inst.list_outputs(), expected,
+                                           outputs):
+        assert_almost_equal(expect, output, rtol, atol,
+                            ("EXPECTED_%s" % output_name,
+                             "FORWARD_%s" % output_name),
+                            equal_nan=equal_nan)
+    return executor.outputs
+
+
+def check_symbolic_backward(sym_inst, location, out_grads, expected,
+                            rtol=1e-5, atol=None, aux_states=None,
+                            grad_req="write", ctx=None, grad_stypes=None,
+                            equal_nan=False, dtype=default_dtype):
+    """Backward vs expected numpy (reference:
+    test_utils.py:check_symbolic_backward)."""
+    assert dtype in (np.float16, np.float32, np.float64)
+    if ctx is None:
+        ctx = default_context()
+    location = _parse_location(sym_inst, location, ctx, dtype=dtype)
+    aux_states = _parse_aux_states(sym_inst, aux_states, ctx, dtype=dtype)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym_inst.list_arguments(), expected)}
+    args_grad_npy = {k: _rng.normal(size=location[k].shape)
+                     for k in expected}
+    args_grad_data = {k: nd.array(v, ctx=ctx, dtype=dtype)
+                      for k, v in args_grad_npy.items()}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym_inst.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = {k: v for k, v in zip(sym_inst.list_arguments(), grad_req)}
+    executor = sym_inst.bind(ctx, args=location, args_grad=args_grad_data,
+                             grad_req=grad_req, aux_states=aux_states)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [nd.array(np.asarray(v), ctx=ctx, dtype=dtype)
+                     for v in out_grads]
+    elif isinstance(out_grads, dict):
+        out_grads = [nd.array(np.asarray(out_grads[k]), ctx=ctx, dtype=dtype)
+                     for k in sym_inst.list_outputs()]
+    else:
+        assert out_grads is None
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()
+             if v is not None}
+    for name in expected:
+        if grad_req[name] == "write":
+            assert_almost_equal(expected[name], grads[name], rtol, atol,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name),
+                                equal_nan=equal_nan)
+        elif grad_req[name] == "add":
+            assert_almost_equal(expected[name] + args_grad_npy[name],
+                                grads[name], rtol, atol,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name),
+                                equal_nan=equal_nan)
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], grads[name], rtol, atol,
+                                ("EXPECTED_%s" % name, "BACKWARD_%s" % name),
+                                equal_nan=equal_nan)
+        else:
+            raise ValueError
+    return args_grad_data
+
+
+def check_consistency(sym_inst, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None, equal_nan=False):
+    """Run one symbol under several (ctx, dtype) configs and cross-check
+    outputs + gradients (reference: test_utils.py:1203). The reference's
+    GPU-vs-CPU consistency pattern, reused as virtual-device consistency."""
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1,
+               np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5,
+               np.dtype(np.uint8): 0,
+               np.dtype(np.int32): 0}
+    elif isinstance(tol, numbers.Number):
+        tol = {np.dtype(np.float16): tol,
+               np.dtype(np.float32): tol,
+               np.dtype(np.float64): tol,
+               np.dtype(np.uint8): tol,
+               np.dtype(np.int32): tol}
+
+    assert len(ctx_list) > 1
+    if isinstance(sym_inst, sym.Symbol):
+        sym_list = [sym_inst] * len(ctx_list)
+    else:
+        sym_list = sym_inst
+
+    output_points = None
+    arg_np = None
+    exe_list = []
+    for s, ctx in zip(sym_list, ctx_list):
+        ctx = dict(ctx)
+        the_ctx = ctx.pop("ctx")
+        type_dict = ctx.pop("type_dict", {})
+        dtype = list(type_dict.values())[0] if type_dict else np.float32
+        shapes = ctx
+        exe = s.simple_bind(the_ctx, grad_req=grad_req, **shapes)
+        if arg_np is None:
+            arg_np = {name: np.random.normal(0.0, scale, size=arr.shape)
+                      for name, arr in exe.arg_dict.items()}
+            if arg_params:
+                arg_np.update({k: v.asnumpy() if isinstance(v, NDArray) else v
+                               for k, v in arg_params.items()})
+        for name, arr in exe.arg_dict.items():
+            arr._set_data(nd.array(arg_np[name], dtype=arr.dtype)._data)
+        exe_list.append(exe)
+
+    # forward + backward all
+    dtypes = [np.dtype(e.outputs[0].dtype if e.outputs else np.float32)
+              for e in exe_list]
+    for exe in exe_list:
+        exe.forward(is_train=(grad_req != "null"))
+        if grad_req != "null":
+            exe.backward()
+
+    # ground truth = highest precision
+    gt_idx = int(np.argmax([np.finfo(d).precision if d.kind == "f" else 0
+                            for d in dtypes]))
+    gt = exe_list[gt_idx]
+    for i, exe in enumerate(exe_list):
+        if i == gt_idx:
+            continue
+        rtol = tol.get(dtypes[i], 1e-3)
+        for o_gt, o in zip(gt.outputs, exe.outputs):
+            assert_almost_equal(o.asnumpy(), o_gt.asnumpy(), rtol=rtol,
+                                atol=rtol, equal_nan=equal_nan)
+        if grad_req != "null":
+            for name in gt.grad_dict:
+                if gt.grad_dict[name] is None or exe.grad_dict.get(name) is None:
+                    continue
+                assert_almost_equal(exe.grad_dict[name].asnumpy(),
+                                    gt.grad_dict[name].asnumpy(), rtol=rtol,
+                                    atol=rtol, equal_nan=equal_nan)
+    return [e.outputs for e in exe_list]
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """No-egress stub (reference: test_utils.py:download). Raises unless the
+    file already exists locally."""
+    import os
+    fname = fname or url.split("/")[-1]
+    if dirname:
+        fname = os.path.join(dirname, fname)
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    raise IOError("download unavailable in this environment: %s" % url)
+
+
+def get_mnist():
+    """Synthetic MNIST-shaped dataset (reference: test_utils.py:get_mnist
+    downloads the real one; offline here, so deterministic synthetic digits
+    with a learnable class structure are generated instead)."""
+    rng = np.random.RandomState(42)
+    n_train, n_test = 6000, 1000
+    templates = rng.uniform(0, 1, (10, 1, 28, 28)).astype(np.float32)
+
+    def make(n):
+        labels = rng.randint(0, 10, n)
+        imgs = templates[labels] + rng.normal(0, 0.3, (n, 1, 28, 28)) \
+            .astype(np.float32)
+        return np.clip(imgs, 0, 1).astype(np.float32), \
+            labels.astype(np.float32)
+
+    train_data, train_label = make(n_train)
+    test_data, test_label = make(n_test)
+    return {"train_data": train_data, "train_label": train_label,
+            "test_data": test_data, "test_label": test_label}

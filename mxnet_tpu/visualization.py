@@ -1,0 +1,211 @@
+"""Network visualization (reference: python/mxnet/visualization.py, 355 LoC):
+print_summary (layer table with param counts) and plot_network (graphviz)."""
+from __future__ import annotations
+
+import json
+
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Layer-table summary (reference: visualization.py:47)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = set(conf["heads"][0] if conf["heads"]
+                and isinstance(conf["heads"][0], list) else [])
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name
+                        if input_node["op"] != "null":
+                            key += "_output"
+                        if key in shape_dict:
+                            shape = shape_dict[key][1:]
+                            pre_filter = pre_filter + int(shape[0]) if shape \
+                                else pre_filter
+        cur_param = 0
+        attrs = node.get("attrs", node.get("param", {})) or {}
+        if op == "Convolution":
+            num_filter = int(attrs["num_filter"])
+            cur_param = pre_filter * num_filter
+            for k in _parse_tuple(attrs.get("kernel", "()")):
+                cur_param *= k
+            if attrs.get("no_bias", "False") not in ("True", "true", "1"):
+                cur_param += num_filter
+        elif op == "FullyConnected":
+            num_hidden = int(attrs["num_hidden"])
+            if attrs.get("no_bias", "False") in ("True", "true", "1"):
+                cur_param = pre_filter * num_hidden
+            else:
+                cur_param = (pre_filter + 1) * num_hidden
+        elif op == "BatchNorm":
+            key = node["name"] + "_output"
+            if show_shape:
+                num_filter = shape_dict[key][1]
+                cur_param = int(num_filter) * 2
+        elif op == "Embedding":
+            cur_param = int(attrs["input_dim"]) * int(attrs["output_dim"])
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [node["name"] + "(" + op + ")",
+                  "x".join([str(x) for x in out_shape]),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"]
+                if op != "null":
+                    key += "_output"
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print("Total params: {params}".format(params=total_params[0]))
+    print("_" * line_length)
+    return total_params[0]
+
+
+def _parse_tuple(s):
+    s = s.strip("()[] ")
+    if not s:
+        return ()
+    return tuple(int(x) for x in s.split(",") if x.strip())
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the symbol (reference: visualization.py:192).
+    Requires the optional ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    if node_attrs:
+        node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = node.get("attrs", {}) or {}
+        label = name
+        if op == "null":
+            if name.endswith("_weight") or name.endswith("_bias") or \
+                    name.endswith("_gamma") or name.endswith("_beta") or \
+                    name.endswith("_moving_mean") or name.endswith("_moving_var"):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            attr = dict(node_attr, fillcolor="#8dd3c7")
+        elif op == "Convolution":
+            label = "Convolution\n%s/%s, %s" % (
+                attrs.get("kernel", "?"), attrs.get("stride", "(1,1)"),
+                attrs.get("num_filter", "?"))
+            attr = dict(node_attr, fillcolor="#fb8072")
+        elif op == "FullyConnected":
+            label = "FullyConnected\n%s" % attrs.get("num_hidden", "?")
+            attr = dict(node_attr, fillcolor="#fb8072")
+        elif op == "BatchNorm":
+            attr = dict(node_attr, fillcolor="#bebada")
+        elif op == "Activation" or op == "LeakyReLU":
+            label = "%s\n%s" % (op, attrs.get("act_type", ""))
+            attr = dict(node_attr, fillcolor="#ffffb3")
+        elif op == "Pooling":
+            label = "Pooling\n%s, %s/%s" % (
+                attrs.get("pool_type", "?"), attrs.get("kernel", "?"),
+                attrs.get("stride", "(1,1)"))
+            attr = dict(node_attr, fillcolor="#80b1d3")
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attr = dict(node_attr, fillcolor="#fdb462")
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attr = dict(node_attr, fillcolor="#fccde5")
+        else:
+            attr = dict(node_attr, fillcolor="#b3de69")
+        dot.node(name=name, label=label, **attr)
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = node["inputs"]
+        for item in inputs:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attr = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = input_name
+                if input_node["op"] != "null":
+                    key += "_output"
+                if key in shape_dict:
+                    shape = shape_dict[key][1:]
+                    attr["label"] = "x".join([str(x) for x in shape])
+            dot.edge(tail_name=name, head_name=input_name, **attr)
+    return dot

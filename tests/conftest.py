@@ -1,0 +1,24 @@
+"""Test harness config: force JAX onto CPU with 8 virtual devices so
+multi-device (mesh/sharding) paths are exercised without TPU hardware —
+the analog of the reference running multi-device tests by mapping ctx
+groups onto cpu(0)/cpu(1) (tests/python/unittest/test_multi_device_exec.py).
+
+Overrides any ambient JAX_PLATFORMS (e.g. the axon TPU tunnel): unit tests
+must be hermetic and fast; the real chip is exercised by bench.py.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# pytest plugins (jaxtyping) may import jax before this conftest runs, baking
+# in the ambient JAX_PLATFORMS; override through the config as well — safe as
+# long as no backend has been initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    "test harness expected 8 virtual CPU devices, got %s" % jax.devices())

@@ -1,0 +1,101 @@
+"""Autograd tape (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_grad():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x) * 2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * np.exp(x.asnumpy()), rtol=1e-4)
+
+
+def test_multiple_inputs():
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad, [4.0])
+    assert_almost_equal(b.grad, [2.0])
+
+
+def test_training_modes():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+    with ag.pause():
+        assert not ag.is_recording()
+
+
+def test_detach():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    # d z / d x = y (detached), not 4x
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_retain_graph():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert_almost_equal(x.grad, g1)
+
+
+def test_grad_with_head_gradient():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(mx.nd.array([1.0, 2.0, 3.0]))
+    assert_almost_equal(x.grad, [2.0, 8.0, 18.0])
+
+
+def test_mark_variables():
+    x = mx.nd.array([1.0, 2.0])
+    grad_x = mx.nd.zeros((2,))
+    ag.mark_variables([x], [grad_x])
+    with ag.record():
+        y = (x * 2).sum()
+    ag.backward([y])
+    assert_almost_equal(grad_x, [2.0, 2.0])
+
+
+def test_autograd_pause_inside_record():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        with ag.pause():
+            z = y * 2  # not recorded
+        w = y + 1
+    w.backward()
+    assert_almost_equal(x.grad, [6.0])

@@ -1,0 +1,90 @@
+"""Data iterators (reference: tests/python/unittest/test_io.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter, ResizeIter, PrefetchingIter, DataBatch
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    assert batches[-1].data[0].shape == (5, 2)
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 4
+
+
+def test_ndarray_iter_reset():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    it = NDArrayIter(data, batch_size=5)
+    n1 = len(list(it))
+    it.reset()
+    n2 = len(list(it))
+    assert n1 == n2 == 2
+
+
+def test_ndarray_iter_provide():
+    data = np.zeros((10, 3, 4, 4), dtype=np.float32)
+    label = np.zeros((10,), dtype=np.float32)
+    it = NDArrayIter(data, label, batch_size=2)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (2, 3, 4, 4)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_ndarray_iter_dict_input():
+    it = NDArrayIter({"a": np.zeros((10, 2), dtype=np.float32),
+                      "b": np.ones((10, 3), dtype=np.float32)},
+                     batch_size=5)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    base = NDArrayIter(data, batch_size=5)
+    it = ResizeIter(base, size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(20, 2).astype(np.float32)
+    base = NDArrayIter(data, batch_size=5)
+    it = PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(12, 3).astype(np.float32)
+    label = np.arange(12).astype(np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                       batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-5)

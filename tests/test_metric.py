@@ -1,0 +1,80 @@
+"""Metrics (reference: tests/python/unittest/test_metric.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_accuracy():
+    m = mx.metric.create("acc")
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 2])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 0.5) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [1.0]])
+    m = mx.metric.create("mse")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - ((0.25 + 1.0) / 2)) < 1e-6
+    m = mx.metric.create("mae")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.75) < 1e-6
+    m = mx.metric.create("rmse")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - np.sqrt(0.625)) < 1e-6
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_composite():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names and "mse" in names
+
+
+def test_custom_metric():
+    def double_acc(label, pred):
+        return 2.0
+
+    m = mx.metric.np(double_acc)
+    m.update([mx.nd.array([1])], [mx.nd.array([[0.1, 0.9]])])
+    assert m.get()[1] == 2.0
+
+
+def test_f1():
+    m = mx.metric.create("f1")
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=0 -> p=0.5 r=1 f1=2/3
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_loss_metric():
+    m = mx.metric.create("loss")
+    m.update(None, [mx.nd.array([1.0, 3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6

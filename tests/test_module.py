@@ -1,0 +1,161 @@
+"""Module API + convergence (reference: tests/python/unittest/test_module.py,
+tests/python/train/test_mlp.py, test_conv.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _two_class_data(n=512, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    w = rng.uniform(-1, 1, (d,))
+    y = (x @ w > 0).astype(np.float32)
+    return x, y
+
+
+def _mlp_sym(num_hidden=32, num_classes=2):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_mlp_convergence():
+    """The minimum end-to-end slice (SURVEY.md §7.2 stage 3):
+    Module.fit must converge (analog of tests/python/train/test_mlp.py)."""
+    x, y = _two_class_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(x, y, batch_size=64)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_multi_device():
+    """Data-parallel over two (virtual) devices — the
+    DataParallelExecutorGroup + KVStore 'local' path
+    (reference: tests/python/unittest/test_multi_device_exec.py)."""
+    x, y = _two_class_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(x, y, batch_size=64)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=6, optimizer="sgd", kvstore="local",
+            optimizer_params={"learning_rate": 0.5}, eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_conv_convergence():
+    """LeNet-style conv net (analog of tests/python/train/test_conv.py)."""
+    rng = np.random.RandomState(0)
+    n = 256
+    templates = rng.uniform(0, 1, (2, 1, 8, 8)).astype(np.float32)
+    y = rng.randint(0, 2, n).astype(np.float32)
+    x = (templates[y.astype(int)]
+         + rng.normal(0, 0.3, (n, 1, 8, 8)).astype(np.float32))
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    act = mx.sym.Activation(conv, act_type="relu")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, num_hidden=2, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(train, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict():
+    x, y = _two_class_data(128)
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    out = mod.predict(train)
+    assert out.shape == (128, 2)
+
+
+def test_module_checkpoint(tmp_path):
+    x, y = _two_class_data(128)
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd")
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+
+    loaded = mx.mod.Module.load(prefix, 1)
+    loaded.bind(data_shapes=train.provide_data,
+                label_shapes=train.provide_label)
+    arg1, _ = mod.get_params()
+    arg2, _ = loaded.get_params()
+    for k in arg1:
+        assert_almost_equal(arg1[k], arg2[k].asnumpy())
+
+
+def test_module_get_set_params():
+    x, y = _two_class_data(64)
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    args, auxs = mod.get_params()
+    args["fc1_weight"] += 1
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    assert_almost_equal(args2["fc1_weight"], args["fc1_weight"].asnumpy())
+
+
+def test_module_input_grads():
+    x, y = _two_class_data(32)
+    train = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label, inputs_need_grad=True)
+    mod.init_params()
+    batch = next(iter(train))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    igrads = mod.get_input_grads()
+    assert igrads[0].shape == (32, 10)
+    assert np.abs(igrads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    """(reference: tests/python/train/test_bucketing.py pattern)"""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataDesc, DataBatch
+    mod.bind(data_shapes=[DataDesc("data", (8, 10))],
+             label_shapes=[DataDesc("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    rng = np.random.RandomState(0)
+    for key in [10, 5, 10]:
+        batch = DataBatch(
+            data=[mx.nd.array(rng.rand(8, key).astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 4, 8).astype(np.float32))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (8, key))],
+            provide_label=[DataDesc("softmax_label", (8,))], pad=0)
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets.keys()) == {10, 5}

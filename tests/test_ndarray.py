@@ -1,0 +1,136 @@
+"""NDArray basics (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert a.asnumpy().sum() == 0
+
+    b = mx.nd.ones((2, 2), dtype=np.float64)
+    assert b.dtype == np.float64
+    assert b.asnumpy().sum() == 4
+
+    c = mx.nd.full((2, 3), 7)
+    assert (c.asnumpy() == 7).all()
+
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    assert d.asnumpy()[1, 1] == 4
+
+
+def test_ndarray_elementwise():
+    np.random.seed(0)
+    a_np = np.random.rand(4, 5).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32) + 0.1
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal(a + b, a_np + b_np)
+    assert_almost_equal(a - b, a_np - b_np)
+    assert_almost_equal(a * b, a_np * b_np)
+    assert_almost_equal(a / b, a_np / b_np)
+    assert_almost_equal(a + 2, a_np + 2)
+    assert_almost_equal(2 - a, 2 - a_np)
+    assert_almost_equal(a ** 2, a_np ** 2)
+    assert_almost_equal(-a, -a_np)
+
+
+def test_ndarray_inplace():
+    a = mx.nd.ones((3,))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a /= 2
+    assert (a.asnumpy() == 3).all()
+    a -= 1
+    assert (a.asnumpy() == 2).all()
+
+
+def test_ndarray_indexing():
+    a_np = np.arange(24).reshape(4, 6).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a[1], a_np[1])
+    assert_almost_equal(a[1:3], a_np[1:3])
+    a[0] = 0
+    a_np[0] = 0
+    assert_almost_equal(a, a_np)
+    a[1:2] = 5
+    a_np[1:2] = 5
+    assert_almost_equal(a, a_np)
+
+
+def test_ndarray_reshape_transpose():
+    a_np = np.arange(24).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert a.reshape((2, -1)).shape == (2, 12)
+    b = a.reshape((4, 6))
+    assert_almost_equal(b.T, a_np.reshape(4, 6).T)
+    assert b.transpose().shape == (6, 4)
+
+
+def test_ndarray_reductions():
+    a_np = np.random.rand(3, 4, 5).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a.sum(), a_np.sum())
+    assert_almost_equal(a.mean(axis=1), a_np.mean(axis=1))
+    assert_almost_equal(a.max(axis=2), a_np.max(axis=2))
+    assert_almost_equal(a.min(), a_np.min())
+    assert int(a.argmax().asnumpy()) == a_np.argmax()
+
+
+def test_ndarray_dtype_conversion():
+    a = mx.nd.ones((3,), dtype=np.float32)
+    b = a.astype(np.float16)
+    assert b.dtype == np.float16
+    c = a.astype(np.int32)
+    assert c.dtype == np.int32
+
+
+def test_ndarray_copy_context():
+    a = mx.nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert (a.asnumpy() == 1).all()
+    assert (b.asnumpy() == 2).all()
+    c = a.as_in_context(mx.cpu(1))
+    assert c.context == mx.cpu(1)
+    assert_almost_equal(c, a.asnumpy())
+
+
+def test_ndarray_save_load(tmp_path):
+    fname = str(tmp_path / "nd.params")
+    d = {"w": mx.nd.ones((2, 3)), "b": mx.nd.zeros((5,))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"].asnumpy())
+
+
+def test_ndarray_comparison():
+    a = mx.nd.array([1, 2, 3])
+    b = mx.nd.array([2, 2, 2])
+    assert_almost_equal(a == b, np.array([0, 1, 0], dtype=np.float32))
+    assert_almost_equal(a > b, np.array([0, 0, 1], dtype=np.float32))
+    assert_almost_equal(a <= b, np.array([1, 1, 0], dtype=np.float32))
+
+
+def test_ndarray_concatenate():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    c = mx.nd.concatenate([a, b], axis=1)
+    assert c.shape == (2, 6)
+
+
+def test_ndarray_scalar_ops():
+    a = mx.nd.array([4.0])
+    assert a.asscalar() == 4.0
+    assert float(a) == 4.0
+    assert int(a) == 4

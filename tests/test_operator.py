@@ -1,0 +1,302 @@
+"""Operator numerics vs numpy + finite differences
+(reference: tests/python/unittest/test_operator.py, 4673 LoC)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward)
+
+
+def test_fully_connected():
+    np.random.seed(0)
+    x = np.random.rand(8, 10).astype(np.float32)
+    w = np.random.rand(5, 10).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    expected = x @ w.T + b
+    check_symbolic_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [expected], rtol=1e-4, atol=1e-4)
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b},
+                           numeric_eps=1e-2, rtol=5e-2, atol=1e-2)
+
+
+def test_activation():
+    x = np.array([[-1.0, 0.0, 2.0]], dtype=np.float32)
+    data = mx.sym.Variable("data")
+    for act, fn in [("relu", lambda v: np.maximum(v, 0)),
+                    ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                    ("tanh", np.tanh),
+                    ("softrelu", lambda v: np.log1p(np.exp(v)))]:
+        s = mx.sym.Activation(data, act_type=act)
+        check_symbolic_forward(s, {"data": x}, [fn(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_leaky_relu():
+    x = np.array([[-2.0, -0.5, 0.0, 3.0]], dtype=np.float32)
+    data = mx.sym.Variable("data")
+    s = mx.sym.LeakyReLU(data, act_type="leaky", slope=0.1)
+    expected = np.where(x > 0, x, 0.1 * x)
+    check_symbolic_forward(s, {"data": x}, [expected])
+
+
+def test_convolution_forward():
+    np.random.seed(0)
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 8, 8))
+    assert out_shapes[0] == (2, 4, 6, 6)
+    w = np.random.rand(*arg_shapes[1]).astype(np.float32) * 0.1
+    b = np.random.rand(*arg_shapes[2]).astype(np.float32)
+
+    # direct numpy conv reference
+    from numpy.lib.stride_tricks import sliding_window_view
+    windows = sliding_window_view(x, (3, 3), axis=(2, 3))  # (2,3,6,6,3,3)
+    expected = np.einsum("bchwij,fcij->bfhw", windows, w) + \
+        b.reshape(1, -1, 1, 1)
+    check_symbolic_forward(conv, {"data": x, "conv_weight": w, "conv_bias": b},
+                           [expected], rtol=1e-3, atol=1e-3)
+
+
+def test_convolution_options():
+    data = mx.sym.Variable("data")
+    # stride + pad
+    conv = mx.sym.Convolution(data, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              num_filter=8)
+    _, out_shapes, _ = conv.infer_shape(data=(1, 3, 32, 32))
+    assert out_shapes[0] == (1, 8, 16, 16)
+    # dilate
+    conv = mx.sym.Convolution(data, kernel=(3, 3), dilate=(2, 2), num_filter=2)
+    _, out_shapes, _ = conv.infer_shape(data=(1, 1, 9, 9))
+    assert out_shapes[0] == (1, 2, 5, 5)
+    # grouped
+    conv = mx.sym.Convolution(data, kernel=(1, 1), num_filter=4, num_group=2)
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(1, 4, 5, 5))
+    assert arg_shapes[1] == (4, 2, 1, 1)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    data = mx.sym.Variable("data")
+    pool = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expected = np.array([[[[5, 7], [13, 15]]]], dtype=np.float32)
+    check_symbolic_forward(pool, {"data": x}, [expected])
+    pool = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expected = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], dtype=np.float32)
+    check_symbolic_forward(pool, {"data": x}, [expected])
+    pool = mx.sym.Pooling(data, global_pool=True, pool_type="max", kernel=(2, 2))
+    check_symbolic_forward(pool, {"data": x},
+                           [np.array([[[[15]]]], dtype=np.float32)])
+
+
+def test_batchnorm_inference_and_training():
+    np.random.seed(0)
+    x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False, eps=1e-3)
+    # train-mode: batch statistics
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expected = ((x - mean.reshape(1, -1, 1, 1))
+                / np.sqrt(var.reshape(1, -1, 1, 1) + 1e-3)
+                * gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1))
+    exe = bn.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                  "bn_gamma": mx.nd.array(gamma),
+                                  "bn_beta": mx.nd.array(beta)},
+                  aux_states={"bn_moving_mean": mx.nd.zeros((3,)),
+                              "bn_moving_var": mx.nd.ones((3,))},
+                  grad_req="null")
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, expected, rtol=1e-2, atol=1e-2)
+    # moving stats must have been updated toward batch stats
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(mm, 0)
+
+
+def test_softmax():
+    x = np.random.rand(3, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    s = mx.sym.softmax(data)
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    expected = e / e.sum(axis=-1, keepdims=True)
+    check_symbolic_forward(s, {"data": x}, [expected])
+
+
+def test_softmax_output_gradient():
+    """SoftmaxOutput backward = (softmax - onehot) * scale / normalization."""
+    np.random.seed(0)
+    x = np.random.rand(4, 3).astype(np.float32)
+    label = np.array([0, 2, 1, 1], dtype=np.float32)
+    data = mx.sym.Variable("data")
+    lab = mx.sym.Variable("softmax_label")
+    s = mx.sym.SoftmaxOutput(data, lab, name="softmax")
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    p = e / e.sum(axis=-1, keepdims=True)
+    onehot = np.zeros_like(p)
+    onehot[np.arange(4), label.astype(int)] = 1
+    exe = s.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                 "softmax_label": mx.nd.array(label)},
+                 args_grad={"data": mx.nd.zeros((4, 3))},
+                 grad_req={"data": "write", "softmax_label": "null"})
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, p, rtol=1e-4, atol=1e-5)
+    exe.backward()
+    assert_almost_equal(exe.grad_dict["data"], p - onehot, rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_elemwise_broadcast_ops():
+    a_np = np.random.rand(2, 1, 3).astype(np.float32)
+    b_np = np.random.rand(2, 4, 3).astype(np.float32)
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    for name, npfn in [("broadcast_add", np.add), ("broadcast_mul", np.multiply),
+                       ("broadcast_sub", np.subtract),
+                       ("broadcast_div", np.divide),
+                       ("broadcast_maximum", np.maximum),
+                       ("broadcast_minimum", np.minimum)]:
+        s = getattr(mx.sym, name)(a, b)
+        check_symbolic_forward(s, {"a": a_np, "b": b_np + 0.1},
+                               [npfn(a_np, b_np + 0.1)])
+
+
+def test_reduce_ops():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    check_symbolic_forward(mx.sym.sum(data, axis=1), {"data": x},
+                           [x.sum(axis=1)])
+    check_symbolic_forward(mx.sym.mean(data, axis=(0, 2)), {"data": x},
+                           [x.mean(axis=(0, 2))])
+    check_symbolic_forward(mx.sym.max(data, axis=2, keepdims=True),
+                           {"data": x}, [x.max(axis=2, keepdims=True)])
+    check_symbolic_forward(mx.sym.prod(data, axis=0), {"data": x},
+                           [x.prod(axis=0)])
+
+
+def test_matrix_ops():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    check_symbolic_forward(mx.sym.dot(a, b), {"a": a_np, "b": b_np},
+                           [a_np @ b_np], rtol=1e-4)
+    x_np = np.random.rand(2, 3, 4).astype(np.float32)
+    y_np = np.random.rand(2, 4, 5).astype(np.float32)
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    check_symbolic_forward(mx.sym.batch_dot(x, y), {"x": x_np, "y": y_np},
+                           [np.einsum("bij,bjk->bik", x_np, y_np)], rtol=1e-4)
+
+
+def test_transpose_reshape_ops():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    check_symbolic_forward(mx.sym.transpose(data, axes=(2, 0, 1)),
+                           {"data": x}, [x.transpose(2, 0, 1)])
+    check_symbolic_forward(mx.sym.Reshape(data, shape=(6, 4)),
+                           {"data": x}, [x.reshape(6, 4)])
+    check_symbolic_forward(mx.sym.Flatten(data), {"data": x},
+                           [x.reshape(2, 12)])
+    check_symbolic_forward(mx.sym.expand_dims(data, axis=1),
+                           {"data": x}, [x[:, None]])
+
+
+def test_slice_concat_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    data = mx.sym.Variable("data")
+    check_symbolic_forward(
+        mx.sym.slice_axis(data, axis=1, begin=1, end=3),
+        {"data": x}, [x[:, 1:3]])
+    a_np = np.ones((2, 3), dtype=np.float32)
+    b_np = np.zeros((2, 3), dtype=np.float32)
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    check_symbolic_forward(mx.sym.Concat(a, b, dim=0, num_args=2),
+                           {"a": a_np, "b": b_np},
+                           [np.concatenate([a_np, b_np], axis=0)])
+
+
+def test_embedding():
+    np.random.seed(0)
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([[1, 3], [5, 9]], dtype=np.float32)
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=10, output_dim=4, name="emb")
+    check_symbolic_forward(emb, {"data": idx, "emb_weight": w},
+                           [w[idx.astype(int)]])
+
+
+def test_dropout_modes():
+    x = np.ones((100, 100), dtype=np.float32)
+    data = mx.sym.Variable("data")
+    drop = mx.sym.Dropout(data, p=0.5)
+    exe = drop.bind(mx.cpu(), args={"data": mx.nd.array(x)}, grad_req="null")
+    # inference: identity
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out, x)
+    # train: ~half dropped, scaled by 2
+    out = exe.forward(is_train=True)[0].asnumpy()
+    frac_zero = (out == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    nz = out[out != 0]
+    assert_almost_equal(nz, np.full_like(nz, 2.0))
+
+
+def test_where():
+    cond = np.array([1, 0], dtype=np.float32)
+    a_np = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    b_np = np.array([[5, 6], [7, 8]], dtype=np.float32)
+    c, a, b = (mx.sym.Variable(n) for n in "cab")
+    s = mx.sym.where(c, a, b)
+    check_symbolic_forward(s, {"c": cond, "a": a_np, "b": b_np},
+                           [np.array([[1, 2], [7, 8]], dtype=np.float32)])
+
+
+def test_ordering_ops():
+    x = np.array([[3, 1, 2], [6, 5, 4]], dtype=np.float32)
+    data = mx.sym.Variable("data")
+    check_symbolic_forward(mx.sym.sort(data, axis=1), {"data": x},
+                           [np.sort(x, axis=1)])
+    check_symbolic_forward(mx.sym.argsort(data, axis=1), {"data": x},
+                           [np.argsort(x, axis=1).astype(np.float32)])
+    check_symbolic_forward(mx.sym.argmax(data, axis=1), {"data": x},
+                           [np.argmax(x, axis=1).astype(np.float32)])
+    topk = mx.sym.topk(data, k=2, axis=1, ret_typ="value")
+    check_symbolic_forward(topk, {"data": x},
+                           [np.sort(x, axis=1)[:, ::-1][:, :2]])
+
+
+def test_numeric_gradient_elemwise():
+    np.random.seed(0)
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    data = mx.sym.Variable("data")
+    for s in [mx.sym.exp(data), mx.sym.log(data), mx.sym.sqrt(data),
+              mx.sym.tanh(data), mx.sym.square(data)]:
+        check_numeric_gradient(s, {"data": x}, numeric_eps=1e-2, rtol=5e-2,
+                               atol=2e-2)
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)  # (seq, batch, feat)
+    length = np.array([2, 4], dtype=np.float32)
+    data = mx.sym.Variable("data")
+    seq_len = mx.sym.Variable("seq_len")
+    s = mx.sym.SequenceMask(data, seq_len, use_sequence_length=True)
+    expected = x.copy()
+    expected[2:, 0] = 0
+    check_symbolic_forward(s, {"data": x, "seq_len": length}, [expected])
+    s = mx.sym.SequenceLast(data, seq_len, use_sequence_length=True)
+    expected_last = np.stack([x[1, 0], x[3, 1]])
+    check_symbolic_forward(s, {"data": x, "seq_len": length}, [expected_last])
+
+
+def test_make_loss_grad():
+    x = np.random.rand(3, 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    loss = mx.sym.MakeLoss(mx.sym.square(data))
+    exe = loss.bind(mx.cpu(), args={"data": mx.nd.array(x)},
+                    args_grad={"data": mx.nd.zeros(x.shape)})
+    exe.forward(is_train=True)
+    exe.backward()
+    assert_almost_equal(exe.grad_dict["data"], 2 * x, rtol=1e-4)

@@ -1,0 +1,130 @@
+"""Optimizers vs numpy references
+(reference: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(opt, w0, grads, index=0):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(index, w)
+    for g in grads:
+        opt.update(index, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(10).astype(np.float32)
+    grads = [rng.rand(10).astype(np.float32) for _ in range(5)]
+    lr, wd = 0.1, 0.01
+    got = _run_steps(mx.opt.SGD(learning_rate=lr, wd=wd, rescale_grad=1.0),
+                     w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - lr * (g + wd * w)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.rand(8).astype(np.float32)
+    grads = [rng.rand(8).astype(np.float32) for _ in range(5)]
+    lr, wd, mom = 0.1, 0.001, 0.9
+    got = _run_steps(mx.opt.SGD(learning_rate=lr, wd=wd, momentum=mom,
+                                rescale_grad=1.0), w0, grads)
+    w = w0.copy()
+    v = np.zeros_like(w)
+    for g in grads:
+        v = mom * v - lr * (g + wd * w)
+        w = w + v
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.rand(6).astype(np.float32)
+    grads = [rng.rand(6).astype(np.float32) for _ in range(4)]
+    lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-8, 0.0
+    got = _run_steps(mx.opt.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                                 epsilon=eps, wd=wd, rescale_grad=1.0),
+                     w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_runs():
+    rng = np.random.RandomState(3)
+    w0 = rng.rand(6).astype(np.float32)
+    grads = [rng.rand(6).astype(np.float32) for _ in range(4)]
+    got = _run_steps(mx.opt.RMSProp(learning_rate=0.01, rescale_grad=1.0),
+                     w0, grads)
+    assert np.isfinite(got).all()
+    got_c = _run_steps(mx.opt.RMSProp(learning_rate=0.01, centered=True,
+                                      rescale_grad=1.0), w0, grads)
+    assert np.isfinite(got_c).all()
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, dtype=np.float32)
+    grads = [np.array([10.0, -10.0, 0.5], dtype=np.float32)]
+    got = _run_steps(mx.opt.SGD(learning_rate=1.0, rescale_grad=1.0,
+                                clip_gradient=1.0), w0, grads)
+    assert_almost_equal(got, [-1.0, 1.0, -0.5])
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(1) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(1) == 1.0
+    assert abs(msched(6) - 0.1) < 1e-12
+    assert abs(msched(16) - 0.01) < 1e-12
+
+    psched = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(psched(50) - 0.5) < 1e-12
+
+
+def test_updater_and_registry():
+    opt = mx.opt.create("sgd", learning_rate=0.1, rescale_grad=1.0)
+    upd = mx.opt.get_updater(opt)
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,))
+    upd(0, g, w)
+    assert_almost_equal(w, np.full(4, 0.9, dtype=np.float32))
+
+
+def test_wd_mult_bias_default():
+    """Bias params get wd_mult=0 by default (reference behavior)."""
+    opt = mx.opt.create("sgd", learning_rate=0.1, wd=1.0, rescale_grad=1.0,
+                        param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    assert opt._get_wd(0) == 1.0
+    assert opt._get_wd(1) == 0.0
+
+
+def test_multi_precision_sgd():
+    rng = np.random.RandomState(4)
+    w0 = rng.rand(5).astype(np.float16)
+    g = rng.rand(5).astype(np.float16)
+    opt = mx.opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True,
+                     rescale_grad=1.0)
+    w = mx.nd.array(w0, dtype=np.float16)
+    state = opt.create_state(0, w)
+    assert isinstance(state, tuple)
+    assert state[1].dtype == np.float32
+    opt.update(0, w, mx.nd.array(g, dtype=np.float16), state)
+    assert w.dtype == np.float16

@@ -1,0 +1,101 @@
+"""Symbol composition / inference / serialization
+(reference: tests/python/unittest/test_symbol.py, test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(32, 100))
+    assert dict(zip(out.list_arguments(), arg_shapes))["fc1_weight"] == (10, 100)
+    assert out_shapes[0] == (32, 2)
+
+
+def test_infer_shape_partial():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None or len(out_shapes) == 1
+
+
+def test_infer_type():
+    out = _mlp()
+    arg_types, out_types, _ = out.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in arg_types)
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    loaded = mx.sym.load_json(js)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.tojson() == js
+
+
+def test_symbol_compose():
+    net1 = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(net1, name="fc1", num_hidden=10)
+    net2 = mx.sym.Variable("data2")
+    net2 = mx.sym.FullyConnected(net2, name="fc2", num_hidden=10)
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "fc2_weight" in args
+
+
+def test_symbol_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    outputs = internals.list_outputs()
+    assert "fc1_output" in outputs
+    fc1_out = internals["fc1_output"]
+    assert fc1_out.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_grouping():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    grouped = mx.sym.Group([a + b, a * b])
+    assert len(grouped.list_outputs()) == 2
+
+
+def test_symbol_attr():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    assert data.attr("mood") == "angry"
+    op = mx.sym.Convolution(data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__lr_mult__": "2"})
+    assert op.attr("__lr_mult__") == "2"
+
+
+def test_symbol_arithmetic_exec():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2 * a + b ** 2
+    exe = c.bind(mx.cpu(), args={"a": mx.nd.array([1.0, 2.0]),
+                                 "b": mx.nd.array([3.0, 4.0])})
+    out = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [11.0, 20.0])
+
+
+def test_symbol_save_load(tmp_path):
+    out = _mlp()
+    fname = str(tmp_path / "sym.json")
+    out.save(fname)
+    loaded = mx.sym.load(fname)
+    assert loaded.list_arguments() == out.list_arguments()
