@@ -49,4 +49,5 @@ from . import visualization
 from . import visualization as viz
 from . import parallel
 from . import models
+from . import gluon
 from . import test_utils

@@ -1,0 +1,10 @@
+"""Gluon — the imperative/hybrid frontend (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo

@@ -1,0 +1,489 @@
+"""Block / HybridBlock / SymbolBlock (reference: python/mxnet/gluon/block.py:619).
+
+``HybridBlock.hybridize()`` is where the TPU design shines: the reference's
+CachedOp replays a traced graph as per-op engine pushes
+(src/imperative/cached_op.cc); here the traced Symbol lowers to ONE jitted
+XLA program per input-shape signature (the jax.jit shape-signature cache is
+the exact analog of CachedOp's GetForwardGraph memoization,
+cached_op.cc:171), with autograd captured through jax.vjp.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..ndarray.register import record_apply
+from .. import symbol as sym_mod
+from ..symbol import Symbol
+from .. import autograd
+from ..context import Context, cpu, current_context
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Name/param scoping (reference: block.py:_BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix + params for new Block."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                if not hasattr(_naming, "counter"):
+                    _naming.counter = {}
+                count = _naming.counter.get(hint, 0)
+                _naming.counter[hint] = count + 1
+                prefix = "%s%d_" % (hint, count)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (reference: block.py:121)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+        self._reg_params = {}
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            ["  ({key}): {block}".format(
+                key=key, block=repr(block).replace("\n", "\n  "))
+             for key, block in self.__dict__.items()
+             if isinstance(block, Block)])
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Register parameters and children blocks."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from "
+                                "{type1} to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+            if isinstance(existing, Block):
+                for i, c in enumerate(self._children):
+                    if c is existing:
+                        self._children[i] = value
+            elif isinstance(value, Block):
+                self.register_child(value)
+        elif isinstance(value, Block):
+            self.register_child(value)
+        if isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """(reference: block.py:name_scope)"""
+        return self._scope
+
+    @property
+    def params(self):
+        """Parameters of this Block only (not children)."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this Block and children
+        (reference: block.py:collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children:
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def save_params(self, filename):
+        """(reference: block.py:239)"""
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        """(reference: block.py:load_params)"""
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    def register_child(self, block):
+        """(reference: block.py:register_child)"""
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """(reference: block.py:initialize)"""
+        from ..initializer import Uniform
+
+        self.collect_params().initialize(init or Uniform(), ctx, verbose,
+                                         force_reinit)
+
+    def hybridize(self, active=True):
+        """(reference: block.py:hybridize)"""
+        for cld in self._children:
+            cld.hybridize(active)
+
+    def cast(self, dtype):
+        """(reference: block.py:cast)"""
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """Block with dual imperative/symbolic forward (reference: block.py:319)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cached_graph = ()
+        self._cached_op = None
+        self._active = False
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s. If you are using Sequential, please try "
+                "HybridSequential instead." % (str(block), str(type(block))))
+        super().register_child(block)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True):
+        self._active = active
+        self._clear_cached_op()
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_op = None
+
+    def _get_graph(self, *args):
+        """Trace hybrid_forward with Symbols (reference: block.py:_build_cache
+        graph step). Nested list args (RNN cell states) are flattened to one
+        Variable per leaf and regrouped for the trace (_flatten/_regroup,
+        reference block.py)."""
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(list(args))
+            inputs = [sym_mod.Variable("data%d" % i)
+                      for i in range(len(flat_args))]
+            grouped = _regroup(iter(inputs), self._in_format)
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            with self.name_scope():
+                out = self.hybrid_forward(sym_mod, *grouped, **params)
+            if isinstance(out, (list, tuple)):
+                out = _flatten_syms(out)
+            self._cached_graph = inputs, out
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        """Infer unknown Parameter shapes from a sample input
+        (reference: block.py:460 + _infer_attrs)."""
+        inputs, out = self._get_graph(*args)
+        args, _ = _flatten(list(args))
+        arg_shapes, _, aux_shapes = out.infer_shape_partial(
+            **{i.name: j.shape for i, j in zip(inputs, args)})
+        sdict = {i: j for i, j in zip(out.list_arguments(), arg_shapes)}
+        sdict.update({name: shape for name, shape in
+                      zip(out.list_auxiliary_states(), aux_shapes)})
+        for name, param in self.collect_params().items():
+            if name in sdict and sdict[name] is not None:
+                param.shape = sdict[name]
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred: %s" % e)
+
+    def _build_cache(self, *args):
+        """(reference: block.py:378 — here the CachedOp is a jitted whole-graph
+        program over (inputs, params))."""
+        inputs, out = self._get_graph(*args)
+        from ..executor import _GraphProgram
+
+        prog = _GraphProgram(out)
+        input_names = [i.name for i in inputs]
+        params = self.collect_params()
+        # map graph arg order → (is_input, index/param)
+        plan = []
+        for name in prog.arg_names:
+            if name in input_names:
+                plan.append(("input", input_names.index(name)))
+            else:
+                plan.append(("param", params[name]))
+        aux_params = [params[name] for name in prog.aux_names]
+        self._cached_op = (prog, plan, aux_params, {})
+
+    def _call_cached_op(self, *args):
+        """(reference: block.py:412 + MXInvokeCachedOpEx). One jitted program
+        produces outputs AND aux-state updates (BN moving stats); under
+        autograd the same program runs under jax.vjp via the tape."""
+        from ..ndarray.register import _record
+        from ..ndarray.ndarray import _from_data
+
+        if self._cached_op is None:
+            self._build_cache(*args)
+        prog, plan, aux_params, jit_cache = self._cached_op
+        flat_args, _ = _flatten(list(args))
+        ctx = flat_args[0].context
+        arrays = []
+        for kind, v in plan:
+            if kind == "input":
+                arrays.append(flat_args[v])
+            else:
+                arrays.append(v.data(ctx))
+        aux_arrays = [p.data(ctx) for p in aux_params]
+        is_train = autograd.is_training()
+        n_args = len(arrays)
+        rngs = tuple(_next_keys(len(prog.rng_nodes)))
+
+        import jax
+
+        if is_train not in jit_cache:
+            def raw(xs, auxs, rng_keys, _train=is_train):
+                arg_d = dict(zip(prog.arg_names, xs))
+                aux_d = dict(zip(prog.aux_names, auxs))
+                o, aux_upd = prog._eval(arg_d, aux_d, rng_keys, _train)
+                return (tuple(o),
+                        tuple(aux_upd.get(n, aux_d[n])
+                              for n in prog.aux_names))
+
+            jit_cache[is_train] = jax.jit(raw)
+        compiled = jit_cache[is_train]
+
+        all_arrays = arrays + aux_arrays
+        if autograd.is_recording():
+            # one TapeNode for the whole block — the _CachedOp-records-as-one-
+            # node behavior (cached_op.cc:401); forward AND vjp run compiled
+            def f(*xs):
+                return compiled(xs[:n_args], xs[n_args:], rngs)
+
+            raw_outs, new_aux, node = _record(f, all_arrays, self.name)
+            outs = []
+            for i, o in enumerate(raw_outs):
+                arr = _from_data(o)
+                arr._autograd_node = node
+                arr._autograd_index = i
+                outs.append(arr)
+        else:
+            raw_outs, new_aux = compiled(
+                tuple(a._data for a in arrays),
+                tuple(a._data for a in aux_arrays), rngs)
+            outs = [_from_data(o) for o in raw_outs]
+        if is_train:
+            for p, v in zip(aux_params, new_aux):
+                for arr in p._data.values():
+                    arr._set_data(v)
+        if len(prog.symbol._outputs) == 1:
+            return outs[0]
+        return outs
+
+    def forward(self, x, *args):
+        """Dual dispatch (reference: block.py:499-523)."""
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    for _, param in self.collect_params().items():
+                        param._finish_deferred_init()
+                    return self._call_cached_op(x, *args)
+            ctx = x.context
+            try:
+                params = {i: j.data(ctx) for i, j in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, param in self._reg_params.items():
+                    param._finish_deferred_init()
+                params = {i: j.data(ctx) for i, j in self._reg_params.items()}
+            return self.hybrid_forward(nd, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """Override: forward using ``F`` (mx.nd or mx.sym)."""
+        raise NotImplementedError
+
+
+def _next_keys(n):
+    from .. import random as _random
+
+    return [_random.next_key() for _ in range(n)]
+
+
+def _flatten(args):
+    """Flatten nested list/tuple of NDArrays (reference: block.py:_flatten)."""
+    flat = []
+    fmts = []
+    for a in args:
+        if isinstance(a, (list, tuple)):
+            f, fmt = _flatten(list(a))
+            flat.extend(f)
+            fmts.append(fmt)
+        else:
+            flat.append(a)
+            fmts.append(0)
+    return flat, fmts
+
+
+def _regroup(flat_iter, fmts):
+    """Inverse of _flatten (reference: block.py:_regroup)."""
+    out = []
+    for fmt in fmts:
+        if fmt == 0:
+            out.append(next(flat_iter))
+        else:
+            out.append(_regroup(flat_iter, fmt))
+    return out
+
+
+def _flatten_syms(out):
+    """Group a (possibly nested) output structure into one Symbol."""
+    flat, _ = _flatten(list(out) if isinstance(out, (list, tuple)) else [out])
+    return sym_mod.Group(flat) if len(flat) > 1 else flat[0]
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap an existing Symbol as a Block (reference: block.py:537)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, Symbol) and len(inputs.list_outputs()) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+
+        syms, _ = _flatten(list(inputs))
+        out = outputs
+        input_names = set()
+        for i in syms:
+            assert len(i.get_internals().list_outputs()) == 1, \
+                "Input symbols must be variable, but %s is an output of " \
+                "operators" % str(i)
+            input_names.add(i.name)
+
+        for i in out.list_arguments():
+            if i not in input_names:
+                self.params.get(i, allow_deferred_init=True)
+        for i in out.list_auxiliary_states():
+            if i not in input_names:
+                self.params.get(i, grad_req="null", allow_deferred_init=True)
+
+        self._cached_graph = syms, out
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, param in self.collect_params().items():
+                    param._finish_deferred_init()
+                return self._call_cached_op(x, *args)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        input_names = [i.name for i in self._cached_graph[0]]
+        kwargs = dict(zip(input_names, [x] + list(args)))
+        return self._cached_graph[1](**kwargs)
+
+    def _clear_cached_op(self):
+        tmp = self._cached_graph
+        super()._clear_cached_op()
+        self._cached_graph = tmp
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,96 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py:240).
+
+The reference uses multiprocessing workers + CPUShared POSIX-shm NDArrays
+for zero-copy IPC. On TPU the decode/augment work is host-side numpy; a
+thread pool gives the same overlap without pickling (numpy releases the GIL
+for the heavy codec work), and the batch lands on device once per step —
+``num_workers`` maps to the thread pool size.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray as nd
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py:default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    """Mini-batch loader over a Dataset (reference: dataloader.py:DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn(
+                    [self._dataset[idx] for idx in batch])
+            return
+
+        def _load(b):
+            return self._batchify_fn([self._dataset[idx] for idx in b])
+
+        # bounded prefetch: keep ~2×workers batches in flight (the reference
+        # keeps 2*num_workers batches queued, dataloader.py:_MultiWorkerIter)
+        from collections import deque
+
+        depth = 2 * self._num_workers
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            pending = deque()
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(depth):
+                    pending.append(pool.submit(_load, next(it)))
+            except StopIteration:
+                it = None
+            while pending:
+                fut = pending.popleft()
+                if it is not None:
+                    try:
+                        pending.append(pool.submit(_load, next(it)))
+                    except StopIteration:
+                        it = None
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,46 @@
+"""Vision model zoo (reference: python/mxnet/gluon/model_zoo/vision/).
+
+``pretrained=True`` requires local weight files (offline environment —
+reference downloads via model_store.py sha1-verified URLs).
+"""
+from .resnet import *
+from .vgg import *
+from .alexnet import *
+from .mobilenet import *
+from .squeezenet import *
+from .resnet import get_resnet, resnet18_v1, resnet34_v1, resnet50_v1, \
+    resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2, \
+    resnet101_v2, resnet152_v2
+from .vgg import get_vgg, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, \
+    vgg16_bn, vgg19_bn
+from .alexnet import alexnet
+from .mobilenet import get_mobilenet, mobilenet1_0, mobilenet0_75, \
+    mobilenet0_5, mobilenet0_25
+from .squeezenet import squeezenet1_0, squeezenet1_1
+
+_models = {}
+
+
+def _register_models():
+    import sys
+    mod = sys.modules[__name__]
+    for name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+                 "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+                 "resnet101_v2", "resnet152_v2", "vgg11", "vgg13", "vgg16",
+                 "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+                 "alexnet", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5",
+                 "mobilenet0_25", "squeezenet1_0", "squeezenet1_1"]:
+        _models[name] = getattr(mod, name)
+
+
+_register_models()
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (reference: model_zoo/__init__.py:get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            "Model %s is not supported. Available options are\n\t%s" % (
+                name, "\n\t".join(sorted(_models.keys()))))
+    return _models[name](**kwargs)

@@ -1,0 +1,456 @@
+"""Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py:676).
+
+Keeps the reference's deferred-initialization contract (shape may contain 0s
+until the first forward infers it) and the per-context data/grad replica API
+(`list_data`/`list_grad`). On TPU the interesting multi-device layout is a
+sharded jax.Array over a Mesh rather than replica lists — `list_data` serves
+the context-list compatibility surface.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..context import Context, cpu, current_context
+from .. import autograd
+from ..initializer import InitDesc
+from .. import initializer as init
+
+__all__ = ["Parameter", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    """A trainable parameter (reference: parameter.py:Parameter)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        if stype != "default" or grad_stype != "default":
+            # sparse storage maps to dense on TPU (SURVEY.md §7.3(3))
+            self._stype = stype
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null"), \
+            "grad_req must be one of 'write', 'add', or 'null', but got '%s'" % req
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null" and self._grad is not None:
+            self._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return list(arr_dict.values())[0]
+                ctx = current_context()
+            if ctx in arr_dict:
+                return arr_dict[ctx]
+            # context-relaxed lookup (same type, any id)
+            for c, v in arr_dict.items():
+                if c.device_type == ctx.device_type:
+                    return v
+            raise RuntimeError(
+                "Parameter %s was not initialized on context %s. It was only "
+                "initialized on %s." % (self.name, str(ctx),
+                                        str(list(arr_dict.keys()))))
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters." %
+                self.name)
+        raise RuntimeError(
+            "Parameter %s has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with Block.collect_params() "
+            "instead of Block.params because the later does not include "
+            "Parameters of nested child Blocks" % self.name)
+
+    def _load_init(self, data, ctx):
+        """(reference: parameter.py:_load_init)"""
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                assert self_dim == 0 or self_dim == data_dim, \
+                    "Failed loading Parameter %s from saved params: shape " \
+                    "incompatible expacted %s vs saved %s" % (
+                        self.name, str(self.shape), str(data.shape))
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                assert ctx is None or set(ctx) == set(self._deferred_init[1]), \
+                    "Failed to load Parameter %s on %s because it was " \
+                    "previous initialized on %s." % (
+                        self.name, str(ctx), str(self.list_ctx()))
+                ctx = self._deferred_init[1]
+            elif ctx is None:
+                ctx = [cpu()]
+            self._init_impl(data, ctx)
+        else:
+            assert ctx is None or set(ctx) == set(self.list_ctx()), \
+                "Failed to load Parameter %s on %s because it was " \
+                "previous initialized on %s." % (
+                    self.name, str(ctx), str(self.list_ctx()))
+            self.set_data(data)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        """(reference: parameter.py:_finish_deferred_init)"""
+        if not self._deferred_init:
+            return
+        init_, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if isinstance(init_, str):
+            init_ = init.create(init_)
+        if isinstance(default_init, str):
+            default_init = init.create(default_init)
+        assert self.shape is not None and np.prod(self.shape) > 0, \
+            "Cannot initialize Parameter %s because it has invalid shape: %s. " \
+            "Please specify in_units, in_channels, etc for `Block`s." % (
+                self.name, str(self.shape))
+        with autograd.pause():
+            if data is None:
+                buf = np.zeros(self.shape, dtype=self.dtype)
+                (init_ if init_ is not None else default_init)(
+                    InitDesc(self.name, {"__init__": ""}), buf)
+                data = nd.array(buf, dtype=self.dtype)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        """Set data on every context (reference: parameter.py:_init_impl)."""
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(np.asarray(data), dtype=self.dtype)
+        self.shape = data.shape
+        self._ctx_list = list(ctx_list)
+        self._data = {c: data.as_in_context(c) for c in self._ctx_list}
+        self._init_grad()
+
+    def _init_grad(self):
+        """(reference: parameter.py:_init_grad)"""
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = {c: nd.zeros(self.shape, ctx=c, dtype=self.dtype)
+                      for c in self._ctx_list}
+        for c in self._ctx_list:
+            autograd.mark_variables([self._data[c]], [self._grad[c]],
+                                    self.grad_req)
+
+    def _reduce(self):
+        """Average over contexts (reference: parameter.py:_reduce)."""
+        block = self.list_data()
+        if len(block) == 1:
+            return block[0].copy()
+        data = sum(w.as_in_context(cpu()) for w in block) / len(block)
+        return data
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """(reference: parameter.py:initialize)"""
+        from ..initializer import Uniform
+
+        default_init = default_init or Uniform()
+        if self._data is not None and not force_reinit:
+            warnings.warn("Parameter %s is already initialized, ignoring. "
+                          "Set force_reinit=True to re-initialize." % self.name,
+                          stacklevel=2)
+            return
+        self._data = self._grad = None
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self.shape is None or np.prod(self.shape) <= 0:
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError("Cannot initialize Parameter %s because it has "
+                             "invalid shape: %s." % (self.name, str(self.shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        """(reference: parameter.py:reset_ctx)"""
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._reduce()
+            with autograd.pause():
+                self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init_, _, default_init, data = self._deferred_init
+            self._deferred_init = (init_, ctx, default_init, data)
+        else:
+            raise ValueError("Cannot reset context for Parameter %s because it "
+                             "has not been initialized." % self.name)
+
+    def set_data(self, data):
+        """(reference: parameter.py:set_data)"""
+        assert self._data is not None, \
+            "Parameter %s has not been initialized" % self.name
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(np.asarray(data), dtype=self.dtype)
+        for c, arr in self._data.items():
+            arr._set_data(data.as_in_context(c)._data)
+
+    def data(self, ctx=None):
+        """(reference: parameter.py:data)"""
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        """(reference: parameter.py:grad)"""
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because grad_req="
+                "'null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because grad_req="
+                "'null'" % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        """(reference: parameter.py:list_ctx)"""
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter %s has not been initialized"
+                               % self.name)
+        return self._ctx_list
+
+    def zero_grad(self):
+        """(reference: parameter.py:zero_grad)"""
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g._set_data(nd.zeros(g.shape, ctx=g.context, dtype=g.dtype)._data)
+
+    def var(self):
+        """Symbol view for hybrid trace (reference: parameter.py:var)."""
+        from .. import symbol as sym
+
+        if self._var is None:
+            self._var = sym.Variable(self.name, shape=self.shape,
+                                     lr_mult=self.lr_mult,
+                                     wd_mult=self.wd_mult)
+        return self._var
+
+    def cast(self, dtype):
+        """(reference: parameter.py:cast)"""
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = {c: v.astype(dtype) for c, v in self._data.items()}
+            if self._grad is not None:
+                self._grad = {c: v.astype(dtype)
+                              for c, v in self._grad.items()}
+                for c in self._ctx_list:
+                    autograd.mark_variables([self._data[c]], [self._grad[c]],
+                                            self.grad_req)
+
+
+class ParameterDict:
+    """Name-scoped dict of Parameters (reference: parameter.py:ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}  # insertion-ordered
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            [repr(v).replace("\n", "\n  ") for v in self.values()]))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._shared._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get-or-create (reference: parameter.py:ParameterDict.get)."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and \
+                            len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 == 0:
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param.shape = tuple(inferred_shape)
+                            continue
+                    assert v is None or v == existing, \
+                        "Cannot retrieve Parameter %s because desired " \
+                        "attribute does not match with stored for attribute " \
+                        "%s: desired %s vs stored %s." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def update(self, other):
+        """(reference: parameter.py:ParameterDict.update)"""
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name %s" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """(reference: parameter.py:ParameterDict.initialize)"""
+        from ..initializer import Uniform
+
+        default = Uniform()
+        if init is not None and not isinstance(init, str) and \
+                not callable(init):
+            raise TypeError("init must be an Initializer, callable or None")
+        if isinstance(init, str):
+            from .. import initializer as init_mod
+            init = init_mod.create(init)
+        if verbose and init is not None:
+            init.set_verbosity(verbose=verbose)
+        for v in self.values():
+            v.initialize(None, ctx, init if init is not None else default,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        """(reference: parameter.py:ParameterDict.save)"""
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix %s is to be striped before saving, but Parameter "
+                    "%s does not start with %s." % (
+                        strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        """(reference: parameter.py:ParameterDict.load)"""
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is %s but Parameters name %s does not " \
+                    "start with %s" % (restore_prefix, name, restore_prefix)
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]
+                    if k.startswith(("arg:", "aux:")) else restore_prefix + k: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter %s is missing in file %s" % (
+                        name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter %s loaded from file %s is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

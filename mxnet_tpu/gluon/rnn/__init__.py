@@ -1,0 +1,3 @@
+"""Gluon RNN (reference: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *

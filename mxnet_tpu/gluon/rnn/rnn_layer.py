@@ -1,0 +1,245 @@
+"""Gluon RNN layers (reference: python/mxnet/gluon/rnn/rnn_layer.py:519).
+
+The reference dispatches to the fused cuDNN RNN op on GPU and unfuses to
+cell-by-cell on CPU (rnn_layer.py:101). Here the fused ``RNN`` op
+(ops/rnn.py, lax.scan) is the only path — it compiles equally for TPU and
+CPU, so no unfuse fallback is needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import Block
+from ..parameter import Parameter
+from ...ops.rnn import rnn_param_size
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(Block):
+    """Base layer (reference: rnn_layer.py:_RNNLayer)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                self._register_param("%s%d_i2h_weight" % (j, i),
+                                     shape=(ng * nh, ni),
+                                     init=i2h_weight_initializer)
+                self._register_param("%s%d_h2h_weight" % (j, i),
+                                     shape=(ng * nh, nh),
+                                     init=h2h_weight_initializer)
+                self._register_param("%s%d_i2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=_b(i2h_bias_initializer))
+                self._register_param("%s%d_h2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=_b(h2h_bias_initializer))
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _unfuse(self):
+        """Build the equivalent stacked cells (reference: rnn_layer.py:_unfuse)."""
+        from . import rnn_cell as cell_mod
+
+        get_cell = {
+            "rnn_relu": lambda **kw: cell_mod.RNNCell(
+                self._hidden_size, activation="relu", **kw),
+            "rnn_tanh": lambda **kw: cell_mod.RNNCell(
+                self._hidden_size, activation="tanh", **kw),
+            "lstm": lambda **kw: cell_mod.LSTMCell(self._hidden_size, **kw),
+            "gru": lambda **kw: cell_mod.GRUCell(self._hidden_size, **kw),
+        }[self._mode]
+
+        stack = cell_mod.SequentialRNNCell(prefix=self.prefix,
+                                           params=self.collect_params())
+        with stack.name_scope():
+            ni = self._input_size
+            for i in range(self._num_layers):
+                kwargs = {
+                    "input_size": ni,
+                    "i2h_weight_initializer": self._i2h_weight_initializer,
+                    "h2h_weight_initializer": self._h2h_weight_initializer,
+                    "i2h_bias_initializer": self._i2h_bias_initializer,
+                    "h2h_bias_initializer": self._h2h_bias_initializer}
+                if self._dir == 2:
+                    stack.add(cell_mod.BidirectionalCell(
+                        get_cell(prefix="l%d_" % i, **kwargs),
+                        get_cell(prefix="r%d_" % i, **kwargs)))
+                else:
+                    stack.add(get_cell(prefix="l%d_" % i, **kwargs))
+                if self._dropout > 0 and i != self._num_layers - 1:
+                    stack.add(cell_mod.DropoutCell(self._dropout))
+                ni = self._hidden_size * self._dir
+        return stack
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """(reference: rnn_layer.py:begin_state)"""
+        if func is None:
+            func = nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            info = dict(info)
+            info.pop("__layout__", None)
+            info.update(kwargs)
+            try:
+                states.append(func(name="%sh0_%d" % (self.prefix, i), **info))
+            except TypeError:
+                states.append(func(**info))
+        return states
+
+    def forward(self, inputs, states=None):
+        """(reference: rnn_layer.py:forward — always the fused path here)"""
+        batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.context)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        for state, info in zip(states, self.state_info(batch_size)):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    "Invalid recurrent state shape. Expecting %s, got %s." % (
+                        str(info["shape"]), str(state.shape)))
+        if self._input_size == 0:
+            # finish deferred init now that the input feature size is known
+            for name in ("l", "r")[:self._dir]:
+                p = getattr(self, "%s0_i2h_weight" % name)
+                p.shape = (self._gates * self._hidden_size, inputs.shape[2])
+            for p in self.collect_params().values():
+                p._finish_deferred_init()
+            self._input_size = inputs.shape[2]
+        out = self._forward_kernel(inputs, states)
+        return out[0] if skip_states else out
+
+    def _forward_kernel(self, inputs, states):
+        """Pack params flat + call fused RNN op (reference:
+        rnn_layer.py:_forward_kernel)."""
+        if self._layout == "NTC":
+            inputs = nd.swapaxes(inputs, dim1=0, dim2=1)
+        ctx = inputs.context
+        params = []
+        for t in ("weight", "bias"):
+            for i in range(self._num_layers):
+                for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                    for k in ("i2h", "h2h"):
+                        p = getattr(self, "%s%d_%s_%s" % (j, i, k, t))
+                        params.append(p.data(ctx).reshape((-1,)))
+        params = nd.concatenate(params, axis=0)
+
+        rnn_args = [inputs, params] + list(states)
+        outputs = nd.RNN(*rnn_args, state_size=self._hidden_size,
+                         num_layers=self._num_layers,
+                         bidirectional=self._dir == 2, p=self._dropout,
+                         state_outputs=True, mode=self._mode)
+        if self._mode == "lstm":
+            outputs, states = outputs[0], [outputs[1], outputs[2]]
+        else:
+            outputs, states = outputs[0], [outputs[1]]
+        if self._layout == "NTC":
+            outputs = nd.swapaxes(outputs, dim1=0, dim2=1)
+        return outputs, states
+
+
+from ..utils import _to_initializer as _b  # noqa: E402
+
+
+class RNN(_RNNLayer):
+    """Elman RNN layer (reference: rnn_layer.py:RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """LSTM layer (reference: rnn_layer.py:LSTM) — BASELINE config #4."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """GRU layer (reference: rnn_layer.py:GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -161,6 +161,12 @@ class One(Initializer):
         self._fill(arr, 1.0)
 
 
+# the reference registers these under both names ("zeros" alias via
+# mx.init.Zero.__init__ docstring usage in Gluon layers)
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+
+
 @register
 class Constant(Initializer):
     def __init__(self, value=0.0):

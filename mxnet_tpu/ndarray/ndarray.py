@@ -473,13 +473,19 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
 
 
 def concatenate(arrays, axis=0, always_copy=True):
-    jnp = _jnp()
-    return _from_data(jnp.concatenate([a._data for a in arrays], axis=axis))
+    # route through the Concat op so the autograd tape records it
+    from . import op as _op
+
+    return _op.Concat(*arrays, dim=axis, num_args=len(arrays))
 
 
 def moveaxis(tensor, source, destination):
+    from .register import record_apply
+
     jnp = _jnp()
-    return _from_data(jnp.moveaxis(tensor._data, source, destination))
+    return record_apply(
+        lambda x: jnp.moveaxis(x, source, destination), [tensor],
+        name="moveaxis")[0]
 
 
 def waitall():

@@ -17,3 +17,5 @@ from . import indexing
 from . import nn
 from . import optimizer_ops
 from . import random_ops
+from . import rnn
+from . import contrib

@@ -1,0 +1,117 @@
+"""Contrib ops (reference: src/operator/contrib/).
+
+First resident: CTCLoss (reference: src/operator/contrib/ctc_loss.cc, which
+vendors warp-ctc). Here the standard log-space alpha recursion runs as a
+``lax.scan`` over time — a compiler-friendly scan the MXU/VPU pipeline
+handles natively, replacing the hand-written CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Bool, Enum, Float, Int
+from .registry import register_op, alias_op
+
+
+def _register_ctc():
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.special import logsumexp
+
+    NEG_INF = -1e30
+
+    def ctc_loss(attrs, data, label, *length_inputs):
+        """data: (T, N, C) pre-softmax activations; label: (N, L).
+        blank_label='first': blank index 0, labels 1..C-1, 0-padding.
+        blank_label='last': blank index C-1, labels 0..C-2, -1-padding.
+        Optional data_lengths (N,) / label_lengths (N,) inputs are gated by
+        use_data_lengths / use_label_lengths (reference: ctc_loss.cc)."""
+        T, N, C = data.shape
+        L = label.shape[1]
+        S = 2 * L + 1
+        blank = 0 if attrs.blank_label == "first" else C - 1
+
+        li = list(length_inputs)
+        data_len = li.pop(0).astype(jnp.int32) if attrs.use_data_lengths \
+            else jnp.full((N,), T, dtype=jnp.int32)
+        lab = label.astype(jnp.int32)  # (N, L)
+        if attrs.use_label_lengths:
+            lab_len = li.pop(0).astype(jnp.int32)
+        elif attrs.blank_label == "first":
+            lab_len = jnp.sum((lab > 0).astype(jnp.int32), axis=1)
+        else:
+            lab_len = jnp.sum((lab >= 0).astype(jnp.int32), axis=1)
+
+        logp = jax.nn.log_softmax(data, axis=2)  # (T, N, C)
+        # extended sequence: blank, l1, blank, l2, ..., blank   (N, S)
+        ext = jnp.full((N, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(jnp.clip(lab, 0, C - 1))
+        s_idx = jnp.arange(S)
+        valid = s_idx[None, :] < (2 * lab_len[:, None] + 1)  # (N, S)
+
+        # skip from s-2 only when ext[s] is a label differing from ext[s-2]
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                         constant_values=blank)[:, :S]
+        is_label = (s_idx[None, :] % 2) == 1
+        can_skip = is_label & (ext != ext_m2)  # (N, S)
+
+        def emit(t):
+            # logp of ext symbols at time t: (N, S)
+            return jnp.take_along_axis(logp[t], ext, axis=1)
+
+        alpha0 = jnp.full((N, S), NEG_INF)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, emit(0)[:, 1],
+                                               NEG_INF))
+        alpha0 = jnp.where(valid, alpha0, NEG_INF)
+
+        def step(alpha, t):
+            a = alpha
+            a1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                         constant_values=NEG_INF)[:, :S]
+            a2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                         constant_values=NEG_INF)[:, :S]
+            a2 = jnp.where(can_skip, a2, NEG_INF)
+            merged = logsumexp(jnp.stack([a, a1, a2], axis=0), axis=0)
+            new = merged + emit(t)
+            new = jnp.where(valid, new, NEG_INF)
+            # samples whose sequence already ended keep their alpha frozen
+            new = jnp.where((t < data_len)[:, None], new, alpha)
+            return new, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # total prob: last blank or last label of the TRUE-length sequence
+        last = 2 * lab_len  # index of final blank
+        aT_last = jnp.take_along_axis(alphaT, last[:, None], axis=1)[:, 0]
+        aT_prev = jnp.take_along_axis(
+            alphaT, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        aT_prev = jnp.where(lab_len > 0, aT_prev, NEG_INF)
+        loss = -logsumexp(jnp.stack([aT_last, aT_prev], axis=0), axis=0)
+        return loss
+
+    def ctc_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        return (list(in_shapes), [(d[1],)], aux_shapes)
+
+    register_op(
+        "_contrib_CTCLoss", ctc_loss,
+        params={"use_data_lengths": Bool(default=False),
+                "use_label_lengths": Bool(default=False),
+                "blank_label": Enum(["first", "last"], default="first")},
+        num_inputs=lambda attrs: (2 + int(attrs.use_data_lengths)
+                                  + int(attrs.use_label_lengths)),
+        input_names=lambda attrs: (
+            ["data", "label"]
+            + (["data_lengths"] if attrs.use_data_lengths else [])
+            + (["label_lengths"] if attrs.use_label_lengths else [])),
+        infer_shape=ctc_infer,
+        doc="CTC alignment loss via log-space alpha recursion in lax.scan "
+            "(reference: src/operator/contrib/ctc_loss.cc; blank index 0, "
+            "labels 0-padded)")
+    alias_op("_contrib_CTCLoss", "ctc_loss")
+    alias_op("_contrib_CTCLoss", "contrib_ctc_loss", visible=False)
+
+
+_register_ctc()

@@ -1,0 +1,228 @@
+"""Fused multi-layer RNN op (reference: src/operator/rnn-inl.h +
+cudnn_rnn-inl.h — the reference's RNN op is cuDNN/GPU-only, rnn.cc:33).
+
+TPU-native realization: per-layer ``lax.scan`` over time with the gate
+matmuls batched onto the MXU. The packed flat parameter layout follows the
+reference's FusedRNNCell convention (python/mxnet/rnn/rnn_cell.py
+FusedRNNCell.unpack_weights): per layer, per direction: W_i2h (G*H, I),
+W_h2h (G*H, H); then all biases b_i2h (G*H), b_h2h (G*H). Gate order:
+LSTM i,f,c,o; GRU r,z,o.
+
+Layout: data (T, N, I) ("TNC"), states (L*D, N, H).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Bool, Enum, Float, Int, Shape
+from .registry import register_op
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, state_size, input_size, mode,
+                   bidirectional=False):
+    """Total packed parameter count (reference: FusedRNNCell._num_gates &
+    cudnn weight-space size)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (in_size + state_size + 2)
+    return size
+
+
+def _layer_offsets(num_layers, state_size, input_size, mode, bidirectional):
+    """Compute (weight, bias) slices into the flat parameter vector:
+    all weights first (layer-major, direction-minor), then all biases."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    weights = []
+    off = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else H * dirs
+        for d in range(dirs):
+            w_i2h = (off, gates * H, in_size)
+            off += gates * H * in_size
+            w_h2h = (off, gates * H, H)
+            off += gates * H * H
+            weights.append((w_i2h, w_h2h))
+    biases = []
+    for layer in range(num_layers):
+        for d in range(dirs):
+            b_i2h = (off, gates * H)
+            off += gates * H
+            b_h2h = (off, gates * H)
+            off += gates * H
+            biases.append((b_i2h, b_h2h))
+    return weights, biases, off
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+
+    def _cell_step(mode, H):
+        if mode == "lstm":
+            def step(carry, gin):
+                h, c = carry
+                i, f, g, o = jnp.split(gin, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                f = jax.nn.sigmoid(f)
+                g = jnp.tanh(g)
+                o = jax.nn.sigmoid(o)
+                new_c = f * c + i * g
+                new_h = o * jnp.tanh(new_c)
+                return (new_h, new_c), new_h
+            return step
+        if mode == "gru":
+            def step(carry, gin_pair):
+                (h,) = carry
+                gi, gh = gin_pair  # i2h part and h2h part kept separate
+                ir, iz, inn = jnp.split(gi, 3, axis=-1)
+                hr, hz, hn = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(inn + r * hn)
+                new_h = (1 - z) * n + z * h
+                return (new_h,), new_h
+            return step
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(carry, gin):
+            (h,) = carry
+            new_h = act(gin)
+            return (new_h,), new_h
+        return step
+
+    def _run_direction(mode, x, w_i2h, w_h2h, b_i2h, b_h2h, h0, c0, reverse):
+        """One layer, one direction. x: (T, N, I) → (T, N, H)."""
+        H = h0.shape[-1]
+        # all-timestep input projection in one batched matmul (MXU-friendly)
+        gi_all = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h
+        if reverse:
+            gi_all = gi_all[::-1]
+
+        if mode == "gru":
+            def scan_fn(carry, gi):
+                (h,) = carry
+                gh = h @ w_h2h.T + b_h2h
+                return _cell_step(mode, H)(carry, (gi, gh))
+            carry0 = (h0,)
+        elif mode == "lstm":
+            def scan_fn(carry, gi):
+                h, c = carry
+                gin = gi + h @ w_h2h.T + b_h2h
+                return _cell_step(mode, H)(carry, gin)
+            carry0 = (h0, c0)
+        else:
+            def scan_fn(carry, gi):
+                (h,) = carry
+                gin = gi + h @ w_h2h.T + b_h2h
+                return _cell_step(mode, H)(carry, gin)
+            carry0 = (h0,)
+
+        carryT, ys = jax.lax.scan(scan_fn, carry0, gi_all)
+        if reverse:
+            ys = ys[::-1]
+        hT = carryT[0]
+        cT = carryT[1] if mode == "lstm" else None
+        return ys, hT, cT
+
+    def rnn(attrs, data, parameters, state, *rest, is_train=False, rng=None):
+        mode = attrs.mode
+        H = attrs.state_size
+        L = attrs.num_layers
+        bidir = attrs.bidirectional
+        dirs = 2 if bidir else 1
+        T, N, I = data.shape
+        state_cell = rest[0] if mode == "lstm" else None
+
+        weights, biases, total = _layer_offsets(L, H, I, mode, bidir)
+        gates = _GATES[mode]
+
+        def w(i):
+            (wo, r, c), (ho, hr, hc) = weights[i]
+            return (jax.lax.dynamic_slice(parameters, (wo,), (r * c,))
+                    .reshape(r, c),
+                    jax.lax.dynamic_slice(parameters, (ho,), (hr * hc,))
+                    .reshape(hr, hc))
+
+        def b(i):
+            (io, ilen), (ho, hlen) = biases[i]
+            return (jax.lax.dynamic_slice(parameters, (io,), (ilen,)),
+                    jax.lax.dynamic_slice(parameters, (ho,), (hlen,)))
+
+        x = data
+        h_outs = []
+        c_outs = []
+        for layer in range(L):
+            ys_dirs = []
+            for d in range(dirs):
+                idx = layer * dirs + d
+                w_i2h, w_h2h = w(idx)
+                b_i2h, b_h2h = b(idx)
+                h0 = state[idx]
+                c0 = state_cell[idx] if mode == "lstm" else None
+                ys, hT, cT = _run_direction(mode, x, w_i2h, w_h2h, b_i2h,
+                                            b_h2h, h0, c0, reverse=(d == 1))
+                ys_dirs.append(ys)
+                h_outs.append(hT)
+                if mode == "lstm":
+                    c_outs.append(cT)
+            x = ys_dirs[0] if dirs == 1 else jnp.concatenate(ys_dirs, axis=-1)
+            if is_train and attrs.p > 0 and layer < L - 1 and rng is not None:
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(rng, layer), 1 - attrs.p, x.shape)
+                x = jnp.where(keep, x / (1 - attrs.p), 0)
+
+        outs = [x]
+        if attrs.state_outputs:
+            outs.append(jnp.stack(h_outs))
+            if mode == "lstm":
+                outs.append(jnp.stack(c_outs))
+        return tuple(outs)
+
+    def rnn_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        T, N, I = d
+        H = attrs.state_size
+        L = attrs.num_layers
+        dirs = 2 if attrs.bidirectional else 1
+        psize = rnn_param_size(L, H, I, attrs.mode, attrs.bidirectional)
+        shapes = [d, (psize,), (L * dirs, N, H)]
+        if attrs.mode == "lstm":
+            shapes.append((L * dirs, N, H))
+        outs = [(T, N, H * dirs)]
+        if attrs.state_outputs:
+            outs.append((L * dirs, N, H))
+            if attrs.mode == "lstm":
+                outs.append((L * dirs, N, H))
+        return (shapes, outs, aux_shapes)
+
+    register_op(
+        "RNN", rnn,
+        params={"state_size": Int(), "num_layers": Int(),
+                "mode": Enum(["rnn_relu", "rnn_tanh", "lstm", "gru"]),
+                "bidirectional": Bool(default=False),
+                "p": Float(default=0.0),
+                "state_outputs": Bool(default=False),
+                "pkeep_": Float(default=1.0),
+                "lstm_q_": Bool(default=False)},
+        num_inputs=lambda attrs: 4 if attrs.mode == "lstm" else 3,
+        input_names=lambda attrs: (
+            ["data", "parameters", "state"] +
+            (["state_cell"] if attrs.mode == "lstm" else [])),
+        num_outputs=lambda attrs: (
+            (3 if attrs.mode == "lstm" else 2) if attrs.state_outputs else 1),
+        infer_shape=rnn_infer, needs_is_train=True, needs_rng=True,
+        doc="Fused multi-layer (bi)directional RNN/LSTM/GRU as lax.scan with "
+            "batched MXU gate matmuls (reference: src/operator/rnn-inl.h:45, "
+            "cudnn_rnn-inl.h; GPU-only there, TPU-native here)")
+
+
+_register()

@@ -263,26 +263,46 @@ class Symbol:
         InferAttr in src/executor/infer_graph_attr_pass.cc)."""
         res = self.infer_shape_partial(*args, **kwargs)
         arg_shapes, out_shapes, aux_shapes = res
-        if arg_shapes and any(s is None for s in arg_shapes):
+        if arg_shapes and any(s is None or 0 in s for s in arg_shapes):
             missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
-                       if s is None]
+                       if s is None or 0 in s]
             raise MXNetError("cannot fully infer shapes; undetermined args: %s"
                              % missing)
         return res
 
     def infer_shape_partial(self, *args, **kwargs):
         known = self._build_known(args, kwargs, self.list_arguments())
+
+        def _norm(shape):
+            # MXNet convention: a 0 dim means "unknown" (deferred init);
+            # such shapes must not be treated as concrete
+            if shape is None or 0 in tuple(shape):
+                return None
+            return tuple(shape)
+
         entry_shape, var_shape = {}, {}
+        # partial (0-dim-containing) declared shapes, kept separately so
+        # deferred-init layers still see e.g. (channels, 0, kh, kw)
+        partial_var = {}
         for name, shape in known.items():
-            var_shape[name] = tuple(shape) if shape else None
+            if shape:
+                var_shape[name] = _norm(shape)
+                if var_shape[name] is None:
+                    partial_var[name] = tuple(shape)
+            else:
+                var_shape[name] = None
         topo = self.topo_nodes()
         # also honor __shape__ attr on variables (used by sym.var(shape=...))
         for node in topo:
             if node.is_variable and "__shape__" in node.user_attrs:
                 from ..ops.param import Shape as _ShapeField
 
-                var_shape.setdefault(node.name,
-                                     _ShapeField().parse(node.user_attrs["__shape__"]))
+                raw = _ShapeField().parse(node.user_attrs["__shape__"])
+                s = _norm(raw)
+                if s is not None:
+                    var_shape.setdefault(node.name, s)
+                elif raw:
+                    partial_var.setdefault(node.name, tuple(raw))
 
         for _ in range(3):  # fixed-point; DAG converges fast
             changed = False
@@ -327,9 +347,57 @@ class Symbol:
             if not changed:
                 break
 
+        # second pass with partial (0-containing) shapes: ops whose infer
+        # handles 0-dims (FC, Conv...) backfill partially-known weight shapes
+        # the way nnvm does for deferred init (e.g. (num_filter, 0, kh, kw))
+        if partial_var:
+            partial_entry = {}
+            for node in topo:
+                if node.is_variable:
+                    continue
+                attrs = node.parsed_attrs()
+                opdef = node.opdef()
+                n_main = node.num_main_inputs()
+
+                def entry_get_p(e):
+                    n, i = e
+                    if n.is_variable:
+                        return var_shape.get(n.name) or \
+                            partial_var.get(n.name)
+                    return entry_shape.get((id(n), i)) or \
+                        partial_entry.get((id(n), i))
+
+                in_shapes = [entry_get_p(e) for e in node.inputs[:n_main]]
+                aux_sh = [entry_get_p(e) for e in node.inputs[n_main:]]
+                try:
+                    res = opdef.run_infer_shape(attrs, in_shapes, aux_sh)
+                except Exception:
+                    continue
+                if res is None:
+                    continue
+                new_in, new_out, new_aux = res
+
+                def _sane(s):
+                    # derived dims computed from 0-placeholders can go
+                    # negative; clamp back to "unknown"
+                    return tuple(max(0, int(d)) for d in s)
+
+                for e, s in zip(node.inputs, list(new_in) + list(new_aux)):
+                    n, i = e
+                    if s is None:
+                        continue
+                    if n.is_variable and var_shape.get(n.name) is None:
+                        partial_var.setdefault(n.name, _sane(s))
+                for i, s in enumerate(new_out):
+                    if s is not None and \
+                            entry_shape.get((id(node), i)) is None:
+                        partial_entry[(id(node), i)] = _sane(s)
+
         args_list, aux_list = self._classify_vars()
-        arg_shapes = [var_shape.get(n.name) for n in args_list]
-        aux_shapes_out = [var_shape.get(n.name) for n in aux_list]
+        arg_shapes = [var_shape.get(n.name) or partial_var.get(n.name)
+                      for n in args_list]
+        aux_shapes_out = [var_shape.get(n.name) or partial_var.get(n.name)
+                          for n in aux_list]
         out_shapes = []
         for node, idx in self._outputs:
             if node.is_variable:
@@ -532,6 +600,20 @@ class Symbol:
 
     def __pow__(self, other):
         return self._binop(other, "broadcast_power", "_power_scalar")
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal",
+                           "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal",
+                           "_lesser_equal_scalar")
 
     def __neg__(self):
         return self.__mul__(-1.0)

@@ -1,0 +1,183 @@
+"""Gluon Block/Parameter/Trainer/nn (reference:
+tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0), mx.cpu(1)])
+    assert len(p.list_data()) == 2
+    assert len(p.list_grad()) == 2
+    assert p.data(mx.cpu(1)).context == mx.cpu(1)
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.var().name == "weight"
+
+    p.reset_ctx(ctx=[mx.cpu(1), mx.cpu(2)])
+    assert set(c.device_id for c in p.list_ctx()) == {1, 2}
+
+
+def test_paramdict(tmp_path):
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    fname = str(tmp_path / "test.params")
+    params.save(fname)
+    params.load(fname, mx.cpu())
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(8)
+    net.initialize()
+    # shape unknown until first forward
+    with pytest.raises(gluon.DeferredInitializationError):
+        net.weight.data()
+    out = net(mx.nd.ones((4, 3)))
+    assert out.shape == (4, 8)
+    assert net.weight.shape == (8, 3)
+
+
+def test_hybridize_consistency():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(3, 10).astype(np.float32))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(y_imp, y_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_autograd_matches_imperative():
+    np.random.seed(0)
+
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(2))
+        return net
+
+    x = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        net.collect_params().initialize(mx.init.One())
+        if hybrid:
+            net.hybridize()
+        with mx.autograd.record():
+            y = net(x).sum()
+        y.backward()
+        grads.append(net[0].weight.grad().asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_converges():
+    np.random.seed(0)
+    x = np.random.uniform(-1, 1, (256, 10)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (10,))
+    y = (x @ w > 0).astype(np.float32)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(15):
+        with mx.autograd.record():
+            out = net(mx.nd.array(x))
+            loss = loss_fn(out, mx.nd.array(y))
+        loss.backward()
+        trainer.step(x.shape[0])
+    preds = net(mx.nd.array(x)).asnumpy().argmax(axis=1)
+    assert (preds == y).mean() > 0.9
+
+
+def test_conv_bn_pool_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(),
+                nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    out = net(mx.nd.ones((2, 1, 8, 8)))
+    assert out.shape == (2, 3)
+
+
+def test_block_save_load(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(5), nn.Dense(3))
+    net.initialize(mx.init.Uniform(0.1))
+    x = mx.nd.ones((1, 4))
+    y1 = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(5), nn.Dense(3))
+    net2.load_params(fname, ctx=mx.cpu())
+    np.testing.assert_allclose(net2(x).asnumpy(), y1, rtol=1e-6)
+
+
+def test_embedding_block():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array([1, 2, 5]))
+    assert out.shape == (3, 4)
+
+
+def test_lambda_blocks():
+    net = nn.Sequential()
+    net.add(nn.HybridLambda("exp"))
+    net.add(nn.Lambda(lambda x: x * 2))
+    out = net(mx.nd.zeros((2, 2)))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 2.0), rtol=1e-6)
+
+
+def test_model_zoo_forward():
+    for name, shape in [("resnet18_v1", (1, 3, 32, 32)),
+                        ("resnet18_v2", (1, 3, 32, 32)),
+                        ("mobilenet0_25", (1, 3, 32, 32)),
+                        ("squeezenet1_1", (1, 3, 64, 64))]:
+        net = gluon.model_zoo.get_model(name, classes=10)
+        net.initialize(mx.init.Xavier())
+        out = net(mx.nd.ones(shape))
+        assert out.shape == (1, 10), name
+
+
+def test_symbol_block():
+    data = mx.sym.Variable("data")
+    out_sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    blk = gluon.SymbolBlock(out_sym, data)
+    blk.collect_params().initialize(mx.init.One())
+    out = blk(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 4)
+    # One() pattern-dispatches *_bias to zero (reference Initializer.__call__)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 3.0), rtol=1e-5)
+
+
+def test_split_and_load():
+    arrs = gluon.utils.split_and_load(np.arange(8).reshape(8, 1),
+                                      [mx.cpu(0), mx.cpu(1)])
+    assert len(arrs) == 2
+    assert arrs[0].shape == (4, 1)
+    assert arrs[1].context == mx.cpu(1)
+
+
+def test_clip_global_norm():
+    arrs = [mx.nd.ones((3,)) * 3, mx.nd.ones((2,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrs, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
+    assert abs(total - 1.0) < 1e-5
+    assert norm > 1.0

@@ -1,0 +1,72 @@
+"""Gluon data API (reference: tests/python/unittest/test_gluon_data.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_array_dataset():
+    x = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10)
+    ds = gluon.data.ArrayDataset(x, y)
+    assert len(ds) == 10
+    item = ds[3]
+    np.testing.assert_allclose(item[0], x[3])
+    assert item[1] == 3
+
+
+def test_dataset_transform():
+    ds = gluon.data.SimpleDataset(list(range(5))).transform(lambda x: x * 2)
+    assert ds[2] == 4
+    ds_first = gluon.data.ArrayDataset(
+        np.arange(4).astype(np.float32), np.arange(4)) \
+        .transform_first(lambda x: x + 100)
+    assert ds_first[1][0] == 101
+    assert ds_first[1][1] == 1
+
+
+def test_samplers():
+    seq = list(gluon.data.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gluon.data.RandomSampler(5))
+    assert sorted(rnd) == [0, 1, 2, 3, 4]
+    bs = gluon.data.BatchSampler(gluon.data.SequentialSampler(7), 3, "keep")
+    batches = list(bs)
+    assert [len(b) for b in batches] == [3, 3, 1]
+    bs = gluon.data.BatchSampler(gluon.data.SequentialSampler(7), 3,
+                                 "discard")
+    assert [len(b) for b in list(bs)] == [3, 3]
+
+
+def test_dataloader():
+    x = np.random.rand(20, 4).astype(np.float32)
+    y = np.arange(20).astype(np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 4
+    data, label = batches[0]
+    assert data.shape == (5, 4)
+    assert label.shape == (5,)
+    np.testing.assert_allclose(data.asnumpy(), x[:5], rtol=1e-6)
+
+
+def test_dataloader_shuffle_workers():
+    ds = gluon.data.ArrayDataset(np.arange(32).astype(np.float32))
+    loader = gluon.data.DataLoader(ds, batch_size=8, shuffle=True,
+                                   num_workers=2)
+    seen = np.concatenate([b.asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == list(range(32))
+
+
+def test_synthetic_vision_dataset():
+    ds = gluon.data.vision.SyntheticImageDataset(num_samples=50,
+                                                 num_classes=5)
+    assert len(ds) == 50
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= label < 5
+    loader = gluon.data.DataLoader(ds, batch_size=10)
+    data, labels = next(iter(loader))
+    assert data.shape == (10, 28, 28, 1)

@@ -1,0 +1,125 @@
+"""Gluon RNN cells + layers (reference: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_rnn_cell_unroll():
+    cell = gluon.rnn.RNNCell(100, prefix="rnn_")
+    cell.collect_params().initialize()
+    inputs = [mx.nd.ones((10, 50)) for _ in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    assert len(outputs) == 3
+    assert outputs[0].shape == (10, 100)
+
+
+def test_lstm_cell():
+    cell = gluon.rnn.LSTMCell(100, prefix="rnn_")
+    cell.collect_params().initialize()
+    inputs = [mx.nd.ones((10, 50)) for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert len(outputs) == 3
+    assert len(states) == 2
+    assert states[0].shape == (10, 100)
+
+
+def test_gru_cell():
+    cell = gluon.rnn.GRUCell(100, prefix="rnn_")
+    cell.collect_params().initialize()
+    inputs = [mx.nd.ones((10, 50)) for _ in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    assert outputs[0].shape == (10, 100)
+
+
+def test_stacked_cells():
+    cell = gluon.rnn.SequentialRNNCell()
+    for _ in range(2):
+        cell.add(gluon.rnn.LSTMCell(20))
+    cell.collect_params().initialize()
+    inputs = [mx.nd.ones((4, 10)) for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert outputs[-1].shape == (4, 20)
+    assert len(states) == 4  # 2 cells × (h, c)
+
+
+def test_residual_cell():
+    cell = gluon.rnn.ResidualCell(gluon.rnn.GRUCell(50, prefix="rnn_"))
+    cell.collect_params().initialize()
+    inputs = [mx.nd.ones((10, 50)) for _ in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    assert outputs[0].shape == (10, 50)
+
+
+def test_bidirectional_cell():
+    cell = gluon.rnn.BidirectionalCell(
+        gluon.rnn.LSTMCell(16, prefix="l_"),
+        gluon.rnn.LSTMCell(16, prefix="r_"))
+    cell.collect_params().initialize()
+    inputs = [mx.nd.ones((4, 8)) for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert outputs[0].shape == (4, 32)
+
+
+def test_lstm_layer_matches_cells():
+    """Fused LSTM layer output == cell-by-cell unroll with shared weights
+    (the reference's fused/unfused equivalence, rnn_layer.py:_unfuse)."""
+    np.random.seed(0)
+    T, N, I, H = 4, 2, 3, 5
+    layer = gluon.rnn.LSTM(H, num_layers=1, input_size=I)
+    layer.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(T, N, I).astype(np.float32))
+    fused_out = layer(x)
+
+    stack = layer._unfuse()
+    inputs = [x[t] for t in range(T)]  # list of (N, I) steps, NTC convention
+    cell_out, _ = stack.unroll(T, inputs, merge_outputs=False)
+    for t in range(T):
+        np.testing.assert_allclose(fused_out[t].asnumpy(),
+                                   cell_out[t].asnumpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_rnn_layers_shapes():
+    for layer, state_n in [(gluon.rnn.RNN(8, 2), 1),
+                           (gluon.rnn.LSTM(8, 2), 2),
+                           (gluon.rnn.GRU(8, 2), 1)]:
+        layer.initialize()
+        x = mx.nd.ones((5, 3, 4))
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+        states = layer.begin_state(3)
+        out, new_states = layer(x, states)
+        assert len(new_states) == state_n
+        assert new_states[0].shape == (2, 3, 8)
+
+
+def test_bidirectional_layer():
+    layer = gluon.rnn.LSTM(8, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.ones((5, 3, 4))
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+
+
+def test_ntc_layout():
+    layer = gluon.rnn.GRU(8, layout="NTC")
+    layer.initialize()
+    x = mx.nd.ones((3, 5, 4))  # (N, T, C)
+    out = layer(x)
+    assert out.shape == (3, 5, 8)
+
+
+def test_lstm_gradient_flows():
+    layer = gluon.rnn.LSTM(6, num_layers=1, input_size=4)
+    layer.initialize()
+    x = mx.nd.ones((3, 2, 4))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    g = layer.l0_i2h_weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0

@@ -1,0 +1,113 @@
+"""Gluon losses vs references (reference: tests/python/unittest/test_loss.py).
+CTC is validated against torch.nn.CTCLoss (ground truth available offline)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_l2_l1():
+    pred = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.nd.array([[1.5, 2.0], [3.0, 3.0]])
+    l2 = gluon.loss.L2Loss()
+    out = l2(pred, label).asnumpy()
+    expected = 0.5 * ((pred.asnumpy() - label.asnumpy()) ** 2).mean(axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+    l1 = gluon.loss.L1Loss()
+    out = l1(pred, label).asnumpy()
+    expected = np.abs(pred.asnumpy() - label.asnumpy()).mean(axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_softmax_ce():
+    np.random.seed(0)
+    pred = np.random.rand(4, 5).astype(np.float32)
+    label = np.array([0, 2, 4, 1], dtype=np.float32)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = loss(mx.nd.array(pred), mx.nd.array(label)).asnumpy()
+    logp = pred - pred.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    expected = -logp[np.arange(4), label.astype(int)]
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_sigmoid_bce():
+    np.random.seed(0)
+    pred = np.random.randn(3, 4).astype(np.float32)
+    label = (np.random.rand(3, 4) > 0.5).astype(np.float32)
+    loss = gluon.loss.SigmoidBCELoss()
+    out = loss(mx.nd.array(pred), mx.nd.array(label)).asnumpy()
+    p = 1 / (1 + np.exp(-pred))
+    expected = -(label * np.log(p) + (1 - label) * np.log(1 - p)).mean(axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+
+def test_kl_div():
+    np.random.seed(0)
+    pred = np.random.rand(3, 4).astype(np.float32)
+    pred = pred / pred.sum(1, keepdims=True)
+    label = np.random.rand(3, 4).astype(np.float32)
+    label = label / label.sum(1, keepdims=True)
+    loss = gluon.loss.KLDivLoss(from_logits=True)
+    out = loss(mx.nd.array(np.log(pred)), mx.nd.array(label)).asnumpy()
+    expected = (label * (np.log(label) - np.log(pred))).mean(axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+
+def test_huber_hinge():
+    pred = mx.nd.array([[0.5], [2.0]])
+    label = mx.nd.array([[0.0], [0.0]])
+    huber = gluon.loss.HuberLoss(rho=1.0)
+    out = huber(pred, label).asnumpy()
+    np.testing.assert_allclose(out, [0.5 * 0.25, 1.5], rtol=1e-5)
+
+    hinge = gluon.loss.HingeLoss()
+    pred = mx.nd.array([[0.3], [2.0]])
+    label = mx.nd.array([[1.0], [1.0]])
+    out = hinge(pred, label).asnumpy()
+    np.testing.assert_allclose(out, [0.7, 0.0], rtol=1e-5)
+
+
+def test_loss_gradient():
+    pred = mx.nd.array([[1.0, 2.0]])
+    pred.attach_grad()
+    label = mx.nd.array([0])
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with mx.autograd.record():
+        loss = loss_fn(pred, label)
+    loss.backward()
+    p = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    expected = p - np.array([1.0, 0.0])
+    np.testing.assert_allclose(pred.grad.asnumpy()[0], expected, rtol=1e-4)
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    np.random.seed(0)
+    T, N, C, L = 10, 3, 6, 4
+    logits = np.random.randn(T, N, C).astype(np.float32)
+    # labels: 1..C-1 (0 is blank), variable lengths with 0 padding
+    label_lens = [4, 2, 3]
+    labels = np.zeros((N, L), dtype=np.float32)
+    for i, ln in enumerate(label_lens):
+        labels[i, :ln] = np.random.randint(1, C, ln)
+
+    out = mx.nd.ctc_loss(mx.nd.array(logits), mx.nd.array(labels))
+
+    t_logp = torch.log_softmax(torch.tensor(logits), dim=2)
+    t_loss = torch.nn.CTCLoss(blank=0, reduction="none")(
+        t_logp, torch.tensor(labels[labels > 0].astype(np.int64)),
+        torch.full((N,), T, dtype=torch.long),
+        torch.tensor(label_lens, dtype=torch.long))
+    np.testing.assert_allclose(out.asnumpy(), t_loss.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_ctc_loss_block():
+    loss = gluon.loss.CTCLoss(layout="NTC")
+    pred = mx.nd.array(np.random.randn(2, 8, 5).astype(np.float32))
+    label = mx.nd.array([[1, 2, 0, 0], [3, 4, 2, 0]])
+    out = loss(pred, label)
+    assert out.shape == (2,)
+    assert np.isfinite(out.asnumpy()).all()
