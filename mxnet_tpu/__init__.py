@@ -50,4 +50,5 @@ from . import visualization as viz
 from . import parallel
 from . import models
 from . import gluon
+from . import rnn
 from . import test_utils

@@ -31,6 +31,8 @@ __all__ = ["Executor"]
 class _GraphProgram:
     """Compiled evaluation plan for one Symbol."""
 
+    _INIT_OPS = ("_zeros", "_ones", "_full")
+
     def __init__(self, symbol):
         self.symbol = symbol
         self.topo = [n for n in symbol.topo_nodes() if not n.is_variable]
@@ -39,13 +41,53 @@ class _GraphProgram:
         args, aux = symbol._classify_vars()
         self.arg_names = [n.name for n in args]
         self.aux_names = [n.name for n in aux]
+        # init-op nodes with 0 (unknown) dims in their declared shape: their
+        # real shape comes from graph inference at bind time — the nnvm
+        # backward-shape-flow behavior RNN begin_state zeros rely on
+        self._deferred_init_nodes = [
+            n for n in self.topo
+            if n.op in self._INIT_OPS
+            and 0 in tuple(n.parsed_attrs().get("shape", ()))]
+        self._init_shape_cache = {}
         self._jit_cache = {}
+
+    def _resolve_init_shapes(self, arg_shapes):
+        """Infer concrete shapes for deferred init-op nodes given the bound
+        argument shapes (memoized per shape signature)."""
+        key = tuple(sorted((k, tuple(v)) for k, v in arg_shapes.items()))
+        if key in self._init_shape_cache:
+            return self._init_shape_cache[key]
+        internals = self.symbol.get_internals()
+        names = internals.list_outputs()
+        entries = internals._outputs
+        try:
+            _, out_shapes, _ = internals.infer_shape_partial(**arg_shapes)
+        except Exception:
+            out_shapes = [None] * len(entries)
+        by_id = {}
+        for (node, idx), shape in zip(entries, out_shapes):
+            if shape is not None and idx == 0:
+                by_id[id(node)] = tuple(shape)
+        overrides = {}
+        for n in self._deferred_init_nodes:
+            shape = by_id.get(id(n))
+            if shape is None or 0 in shape:
+                raise MXNetError(
+                    "cannot infer shape for %s node %r with declared shape "
+                    "%s" % (n.op, n.name, n.parsed_attrs().get("shape")))
+            overrides[id(n)] = shape
+        self._init_shape_cache[key] = overrides
+        return overrides
 
     # --- raw graph evaluation (traced under jit) --------------------------
     def _eval(self, arg_d, aux_d, rngs, is_train):
         env = {}
         aux_updates = {}
         rng_i = [0]
+        overrides = {}
+        if self._deferred_init_nodes:
+            overrides = self._resolve_init_shapes(
+                {k: tuple(v.shape) for k, v in arg_d.items()})
 
         def get_entry(e):
             n, i = e
@@ -58,6 +100,10 @@ class _GraphProgram:
         for node in self.topo:
             opdef = node.opdef()
             attrs = node.parsed_attrs()
+            if id(node) in overrides:
+                from .ops.registry import OpAttrs
+
+                attrs = OpAttrs(dict(attrs._d, shape=overrides[id(node)]))
             n_main = node.num_main_inputs()
             ins = [get_entry(e) for e in node.inputs[:n_main]]
             auxs = [get_entry(e) for e in node.inputs[n_main:]]

@@ -23,6 +23,25 @@ def _bcast_infer(attrs, in_shapes, aux_shapes):
     a, b = in_shapes
     if a is None or b is None:
         return None
+    if len(a) == len(b) and (0 in a or 0 in b):
+        # partial dims (0 = unknown, nnvm convention): merge per-dim, treating
+        # a known non-1 dim as authoritative, and backfill unknown input dims
+        # from the merged shape (same-shape assumption, as nnvm does)
+        out = []
+        for x, y in zip(a, b):
+            if x == 0:
+                out.append(y)
+            elif y == 0 or x == y:
+                out.append(x)
+            elif x == 1 or y == 1:
+                out.append(max(x, y))
+            else:
+                raise ValueError("incompatible broadcast dims %s vs %s"
+                                 % (a, b))
+        out = tuple(out)
+        new_a = tuple(o if x == 0 else x for x, o in zip(a, out))
+        new_b = tuple(o if y == 0 else y for y, o in zip(b, out))
+        return ([new_a, new_b], [out], aux_shapes)
     out = tuple(np.broadcast_shapes(a, b))
     return ([a, b], [out], aux_shapes)
 

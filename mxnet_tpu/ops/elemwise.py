@@ -22,14 +22,23 @@ def _jnp():
 
 
 def _elemwise_infer(n_in, n_out=1):
-    """Same-shape inference with backfill: any known input shape fixes the rest
-    (matches ElemwiseShape in src/operator/elemwise_op_common.h)."""
+    """Same-shape inference with per-dim merge backfill: known dims of any
+    input fix the rest (matches ElemwiseShape in
+    src/operator/elemwise_op_common.h with 0-dim wildcards)."""
 
     def infer(attrs, in_shapes, aux_shapes):
-        known = next((s for s in in_shapes if s is not None), None)
-        if known is None:
+        merged = None
+        for s in in_shapes:
+            if s is None:
+                continue
+            if merged is None:
+                merged = tuple(s)
+            elif len(s) == len(merged):
+                merged = tuple(a if a != 0 else b
+                               for a, b in zip(merged, s))
+        if merged is None:
             return None
-        return ([known] * len(in_shapes), [known] * n_out, aux_shapes)
+        return ([merged] * len(in_shapes), [merged] * n_out, aux_shapes)
 
     return infer
 

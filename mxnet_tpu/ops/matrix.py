@@ -262,10 +262,23 @@ def _register_concat_split():
             parts = [jnp.squeeze(p, axis=ax) for p in parts]
         return tuple(parts)
 
+    def slice_channel_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        ax = attrs.axis % len(d)
+        piece = d[ax] // attrs.num_outputs if d[ax] else 0
+        if attrs.squeeze_axis:
+            out = d[:ax] + d[ax + 1:]
+        else:
+            out = d[:ax] + (piece,) + d[ax + 1:]
+        return ([d], [tuple(out)] * attrs.num_outputs, aux_shapes)
+
     register_op("SliceChannel", slice_channel,
                 params={"num_outputs": Int(), "axis": Int(default=1),
                         "squeeze_axis": Bool(default=False)},
-                num_inputs=1, num_outputs=lambda attrs: attrs.num_outputs)
+                num_inputs=1, num_outputs=lambda attrs: attrs.num_outputs,
+                infer_shape=slice_channel_infer)
     alias_op("SliceChannel", "split")
 
     def stack(attrs, *xs):

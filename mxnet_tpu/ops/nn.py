@@ -49,13 +49,24 @@ def _register_fc():
         out = (d[0], attrs.num_hidden) if attrs.flatten else d[:-1] + (attrs.num_hidden,)
         return (shapes, [out], aux_shapes)
 
+    def fc_infer_backward(attrs, out_shapes, in_shapes):
+        # nnvm FullyConnectedShape assigns the batch dim from the output
+        # (needed so RNN begin-state zeros gain their batch size)
+        o = out_shapes[0] if out_shapes else None
+        d = in_shapes[0]
+        if o is None or not o or o[0] == 0 or d is None or not d:
+            return None
+        if attrs.flatten:
+            return [(o[0],) + tuple(d[1:])] + list(in_shapes[1:])
+        return [tuple(d[:-1]) + (d[-1],)] + list(in_shapes[1:])
+
     register_op(
         "FullyConnected", fully_connected,
         params={"num_hidden": Int(), "no_bias": Bool(default=False),
                 "flatten": Bool(default=True)},
         num_inputs=lambda attrs: 2 if attrs.no_bias else 3,
         input_names=lambda attrs: ["data", "weight"] + ([] if attrs.no_bias else ["bias"]),
-        infer_shape=fc_infer,
+        infer_shape=fc_infer, infer_backward=fc_infer_backward,
         doc="y = x·Wᵀ + b on the MXU (reference: src/operator/fully_connected-inl.h; "
             "weight layout (num_hidden, in_dim) preserved)")
 

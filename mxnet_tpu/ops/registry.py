@@ -82,6 +82,7 @@ class OpDef:
         num_outputs=1,
         aux_names=(),
         infer_shape=None,
+        infer_backward=None,
         infer_dtype=None,
         needs_rng=False,
         needs_is_train=False,
@@ -97,6 +98,10 @@ class OpDef:
         self.num_outputs = num_outputs
         self.aux_names = aux_names
         self.infer_shape = infer_shape
+        # optional backward shape flow: (attrs, out_shapes, in_shapes) →
+        # updated in_shapes (nnvm ops like FullyConnected assign batch from
+        # the output shape; needed for RNN begin-state zeros)
+        self.infer_backward = infer_backward
         self.infer_dtype = infer_dtype
         self.needs_rng = needs_rng
         self.needs_is_train = needs_is_train

@@ -1,0 +1,5 @@
+"""Pre-Gluon symbolic RNN toolkit (reference: python/mxnet/rnn/, 1.76k LoC)
+— the surface BASELINE config #4 (lstm_bucketing) uses with BucketingModule."""
+from .rnn_cell import *
+from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
+from .io import BucketSentenceIter, encode_sentences

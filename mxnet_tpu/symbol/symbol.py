@@ -20,6 +20,29 @@ from ..ops.registry import OP_REGISTRY, get_op
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
 
+def _merge_shape(old, new, what=""):
+    """Merge two partial shapes (0 = unknown dim, nnvm convention)."""
+    if old is None:
+        return tuple(new)
+    if new is None:
+        return tuple(old)
+    if len(old) != len(new):
+        # rank conflict: prefer the newly inferred rank if old was a bare
+        # placeholder, else error
+        raise MXNetError("shape rank mismatch for %s: %s vs %s"
+                         % (what, old, new))
+    out = []
+    for a, b in zip(old, new):
+        if a == 0:
+            out.append(b)
+        elif b == 0 or a == b:
+            out.append(a)
+        else:
+            raise MXNetError("shape mismatch for %s: %s vs %s"
+                             % (what, old, new))
+    return tuple(out)
+
+
 class _Node:
     """One graph node: an op application or a variable (op=None)."""
 
@@ -271,40 +294,32 @@ class Symbol:
         return res
 
     def infer_shape_partial(self, *args, **kwargs):
+        """Partial shape inference with per-dim unknowns: a 0 dim means
+        "unknown" (nnvm convention, src/executor/infer_graph_attr_pass.cc).
+        Shapes are merged dim-by-dim to a fixed point, so e.g. an RNN state
+        seeded as (0, H) gains its batch from a consumer while keeping H."""
         known = self._build_known(args, kwargs, self.list_arguments())
 
-        def _norm(shape):
-            # MXNet convention: a 0 dim means "unknown" (deferred init);
-            # such shapes must not be treated as concrete
-            if shape is None or 0 in tuple(shape):
-                return None
-            return tuple(shape)
-
         entry_shape, var_shape = {}, {}
-        # partial (0-dim-containing) declared shapes, kept separately so
-        # deferred-init layers still see e.g. (channels, 0, kh, kw)
-        partial_var = {}
         for name, shape in known.items():
-            if shape:
-                var_shape[name] = _norm(shape)
-                if var_shape[name] is None:
-                    partial_var[name] = tuple(shape)
-            else:
-                var_shape[name] = None
+            if shape is not None:
+                var_shape[name] = tuple(shape)
         topo = self.topo_nodes()
-        # also honor __shape__ attr on variables (used by sym.var(shape=...))
+        # honor __shape__ attr on variables (sym.var(shape=...))
         for node in topo:
             if node.is_variable and "__shape__" in node.user_attrs:
                 from ..ops.param import Shape as _ShapeField
 
-                raw = _ShapeField().parse(node.user_attrs["__shape__"])
-                s = _norm(raw)
-                if s is not None:
-                    var_shape.setdefault(node.name, s)
-                elif raw:
-                    partial_var.setdefault(node.name, tuple(raw))
+                raw = tuple(_ShapeField().parse(node.user_attrs["__shape__"]))
+                if raw:
+                    var_shape[node.name] = _merge_shape(
+                        var_shape.get(node.name), raw, node.name)
 
-        for _ in range(3):  # fixed-point; DAG converges fast
+        def known_only(s):
+            # ops with only default (eval_shape) inference need concrete dims
+            return s if (s is not None and 0 not in s) else None
+
+        for _ in range(8):  # fixed point; partial dims may take extra sweeps
             changed = False
             for node in topo:
                 if node.is_variable:
@@ -321,83 +336,72 @@ class Symbol:
 
                 in_shapes = [entry_get(e) for e in node.inputs[:n_main]]
                 aux_shapes = [entry_get(e) for e in node.inputs[n_main:]]
+                if opdef.infer_shape is None:
+                    in_shapes = [known_only(s) for s in in_shapes]
+                    aux_shapes = [known_only(s) for s in aux_shapes]
                 try:
                     res = opdef.run_infer_shape(attrs, in_shapes, aux_shapes)
                 except Exception as e:
-                    raise MXNetError("infer_shape error in %s(%s): %s"
-                                     % (node.op, node.name, e))
+                    if opdef.infer_shape is not None and (
+                            any(s is not None and 0 in s
+                                for s in in_shapes + aux_shapes)):
+                        # explicit infer choked on a partial shape; retry
+                        # with unknowns masked out
+                        try:
+                            res = opdef.run_infer_shape(
+                                attrs, [known_only(s) for s in in_shapes],
+                                [known_only(s) for s in aux_shapes])
+                        except Exception as e2:
+                            raise MXNetError(
+                                "infer_shape error in %s(%s): %s"
+                                % (node.op, node.name, e2))
+                    else:
+                        raise MXNetError("infer_shape error in %s(%s): %s"
+                                         % (node.op, node.name, e))
                 if res is None:
                     continue
                 new_in, new_out, new_aux = res
-                for e, s in zip(node.inputs, list(new_in) + list(new_aux)):
+
+                def put_entry(e, s):
+                    nonlocal changed
                     if s is None:
-                        continue
+                        return
+                    s = tuple(max(0, int(d)) for d in s)
                     n, i = e
                     if n.is_variable:
-                        if var_shape.get(n.name) is None:
-                            var_shape[n.name] = tuple(s)
+                        merged = _merge_shape(var_shape.get(n.name), s,
+                                              n.name)
+                        if merged != var_shape.get(n.name):
+                            var_shape[n.name] = merged
                             changed = True
-                    elif entry_shape.get((id(n), i)) is None:
-                        entry_shape[(id(n), i)] = tuple(s)
-                        changed = True
+                    else:
+                        merged = _merge_shape(entry_shape.get((id(n), i)), s,
+                                              "%s[%d]" % (n.name, i))
+                        if merged != entry_shape.get((id(n), i)):
+                            entry_shape[(id(n), i)] = merged
+                            changed = True
+
+                for e, s in zip(node.inputs, list(new_in) + list(new_aux)):
+                    put_entry(e, s)
                 for i, s in enumerate(new_out):
-                    if s is not None and entry_shape.get((id(node), i)) is None:
-                        entry_shape[(id(node), i)] = tuple(s)
-                        changed = True
+                    put_entry((node, i), s)
+
+                if opdef.infer_backward is not None:
+                    n_out = opdef.get_num_outputs(attrs)
+                    outs = [entry_shape.get((id(node), i))
+                            for i in range(n_out)]
+                    back = opdef.infer_backward(
+                        attrs, outs,
+                        [entry_get(e) for e in node.inputs[:n_main]])
+                    if back is not None:
+                        for e, s in zip(node.inputs[:n_main], back):
+                            put_entry(e, s)
             if not changed:
                 break
 
-        # second pass with partial (0-containing) shapes: ops whose infer
-        # handles 0-dims (FC, Conv...) backfill partially-known weight shapes
-        # the way nnvm does for deferred init (e.g. (num_filter, 0, kh, kw))
-        if partial_var:
-            partial_entry = {}
-            for node in topo:
-                if node.is_variable:
-                    continue
-                attrs = node.parsed_attrs()
-                opdef = node.opdef()
-                n_main = node.num_main_inputs()
-
-                def entry_get_p(e):
-                    n, i = e
-                    if n.is_variable:
-                        return var_shape.get(n.name) or \
-                            partial_var.get(n.name)
-                    return entry_shape.get((id(n), i)) or \
-                        partial_entry.get((id(n), i))
-
-                in_shapes = [entry_get_p(e) for e in node.inputs[:n_main]]
-                aux_sh = [entry_get_p(e) for e in node.inputs[n_main:]]
-                try:
-                    res = opdef.run_infer_shape(attrs, in_shapes, aux_sh)
-                except Exception:
-                    continue
-                if res is None:
-                    continue
-                new_in, new_out, new_aux = res
-
-                def _sane(s):
-                    # derived dims computed from 0-placeholders can go
-                    # negative; clamp back to "unknown"
-                    return tuple(max(0, int(d)) for d in s)
-
-                for e, s in zip(node.inputs, list(new_in) + list(new_aux)):
-                    n, i = e
-                    if s is None:
-                        continue
-                    if n.is_variable and var_shape.get(n.name) is None:
-                        partial_var.setdefault(n.name, _sane(s))
-                for i, s in enumerate(new_out):
-                    if s is not None and \
-                            entry_shape.get((id(node), i)) is None:
-                        partial_entry[(id(node), i)] = _sane(s)
-
         args_list, aux_list = self._classify_vars()
-        arg_shapes = [var_shape.get(n.name) or partial_var.get(n.name)
-                      for n in args_list]
-        aux_shapes_out = [var_shape.get(n.name) or partial_var.get(n.name)
-                          for n in aux_list]
+        arg_shapes = [var_shape.get(n.name) for n in args_list]
+        aux_shapes_out = [var_shape.get(n.name) for n in aux_list]
         out_shapes = []
         for node, idx in self._outputs:
             if node.is_variable:

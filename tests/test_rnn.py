@@ -1,0 +1,152 @@
+"""Symbolic mx.rnn toolkit (reference: tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rnn_cell_symbolic():
+    cell = mx.rnn.RNNCell(100, prefix="rnn_")
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_lstm_cell_symbolic():
+    cell = mx.rnn.LSTMCell(100, prefix="rnn_", forget_bias=1.0)
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_gru_cell_symbolic():
+    cell = mx.rnn.GRUCell(100, prefix="rnn_")
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_stacked_and_bidirectional():
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.LSTMCell(16, prefix="l0_"))
+    cell.add(mx.rnn.LSTMCell(16, prefix="l1_"))
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(3, data, merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(8, 3, 10))
+    assert outs[0] == (8, 3, 16)
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.GRUCell(16, prefix="l_"),
+                                  mx.rnn.GRUCell(16, prefix="r_"))
+    outputs, _ = bi.unroll(3, mx.sym.Variable("data"), merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(8, 3, 10))
+    assert outs[0] == (8, 3, 32)
+
+
+def test_residual_zoneout_dropout():
+    cell = mx.rnn.ResidualCell(mx.rnn.GRUCell(50, prefix="rnn_"))
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(t0_data=(10, 50), t1_data=(10, 50))
+    assert outs == [(10, 50), (10, 50)]
+
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(16, prefix="rnn_"), 0.1, 0.1)
+    outputs, _ = cell.unroll(2, [mx.sym.Variable("t%d_d" % i)
+                                 for i in range(2)])
+
+    cell = mx.rnn.DropoutCell(0.5)
+    outputs, _ = cell.unroll(2, mx.sym.Variable("data"), merge_outputs=True)
+
+
+def test_fused_rnn_cell_unroll():
+    """FusedRNNCell emits the lax.scan RNN op and matches the unfused stack
+    numerically (the reference's fused/unfused contract)."""
+    np.random.seed(0)
+    T, N, I, H = 4, 2, 3, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                get_next_state=True, prefix="lstm_")
+    outputs, states = fused.unroll(T, mx.sym.Variable("data"),
+                                   merge_outputs=True)
+    arg_shapes, out_shapes, _ = outputs.infer_shape(data=(N, T, I))
+    assert out_shapes[0] == (N, T, H)
+
+    x = np.random.rand(N, T, I).astype(np.float32)
+    psize = dict(zip(outputs.list_arguments(), arg_shapes))["lstm_parameters"]
+    params = np.random.uniform(-0.1, 0.1, psize).astype(np.float32)
+    exe = outputs.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                       "lstm_parameters": mx.nd.array(params)})
+    fused_out = exe.forward()[0].asnumpy()
+
+    # unfused stack with the same (unpacked) weights
+    stack = fused.unfuse()
+    u_out, _ = stack.unroll(T, mx.sym.Variable("data"), merge_outputs=True)
+    args = fused.unpack_weights({"lstm_parameters": mx.nd.array(params)})
+    args["data"] = mx.nd.array(x)
+    exe2 = u_out.bind(mx.cpu(), args=args)
+    unfused_out = exe2.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="gru",
+                                bidirectional=True, prefix="gru_")
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size(2, 8, 4, "gru", True)
+    params = mx.nd.array(np.random.rand(psize).astype(np.float32))
+    unpacked = fused.unpack_weights({"gru_parameters": params})
+    assert "gru_parameters" not in unpacked
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["gru_parameters"].asnumpy(),
+                               params.asnumpy(), rtol=1e-6)
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5], [1, 2, 3, 4, 5, 6, 7],
+                 [2, 3, 4]] * 10
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[3, 7],
+                                   invalid_label=0)
+    assert it.default_bucket_key == 7
+    batches = list(it)
+    assert len(batches) > 0
+    for b in batches:
+        assert b.bucket_key in (3, 7)
+        assert b.data[0].shape == (4, b.bucket_key)
+        assert b.label[0].shape == (4, b.bucket_key)
+    # label is data shifted left by one
+    it.reset()
+    b = next(it)
+    d = b.data[0].asnumpy()
+    l = b.label[0].asnumpy()
+    np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+
+
+def test_encode_sentences():
+    sents = [["a", "b", "c"], ["b", "c", "d"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(vocab) >= 4
+    assert coded[0][1] == coded[1][0]  # "b" same id
+
+
+def test_begin_state_zeros_batch_inference():
+    """zeros begin-states with batch 0 get their batch from graph inference
+    at bind (nnvm backward shape flow, the RNN training prerequisite)."""
+    cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(3, data, merge_outputs=True)
+    exe = outputs.simple_bind(mx.cpu(), data=(8, 3, 4))
+    out = exe.forward()[0]
+    assert out.shape == (8, 3, 16)
