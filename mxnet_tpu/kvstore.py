@@ -63,9 +63,18 @@ class KVStore:
 
     def _reduce(self, vlist):
         """Sum per-device pushed values — CommDevice::Reduce analog
-        (src/kvstore/comm.h:512); one XLA add-n instead of P2P copies."""
+        (src/kvstore/comm.h:512); one XLA add-n instead of P2P copies.
+        Row-sparse pushes merge-sum by index union (ReduceSumCPUExSerial
+        analog, comm.h:335)."""
+        from .ndarray.sparse import RowSparseNDArray, rsp_add
+
         if len(vlist) == 1:
             return vlist[0].copy()
+        if any(isinstance(v, RowSparseNDArray) for v in vlist):
+            merged = vlist[0]
+            for v in vlist[1:]:
+                merged = rsp_add(merged, v)
+            return merged
         return nd.add_n(*vlist)
 
     def push(self, key, value, priority=0):
@@ -77,7 +86,10 @@ class KVStore:
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._data[k])
             else:
-                self._data[k] += merged
+                # reference semantics: push REPLACES the stored value with the
+                # merged result (src/kvstore/kvstore_local.h PushImpl);
+                # accumulating would corrupt update_on_kvstore=False training
+                self._data[k] = merged
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
@@ -90,10 +102,42 @@ class KVStore:
                 src.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Dense fallback: row_sparse storage maps to dense on TPU
-        (SURVEY.md §7.3(3)); pulls the full value."""
+        """Pull only the requested rows as row_sparse (reference:
+        KVStoreDist::PullRowSparseImpl kvstore_dist.h:258 — per-row-id
+        server fetch; here a gather from the stored value)."""
+        from .ndarray.sparse import (BaseSparseNDArray, RowSparseNDArray,
+                                     row_sparse_array, sparse_retain)
+
         assert out is not None
-        self.pull(key, out=out, priority=priority)
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return
+        keys, outs = _ctype_key_value(key, out)
+        if not isinstance(row_ids, (tuple, list)):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rids in zip(keys, outs, row_ids):
+            if k not in self._data:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            src = self._data[k]
+            rid_list = rids if isinstance(rids, (tuple, list)) else [rids]
+            if len(rid_list) == 1 and len(olist) > 1:
+                rid_list = rid_list * len(olist)
+            for o, rid in zip(olist, rid_list):
+                import numpy as _np
+
+                want = _np.unique(_np.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid,
+                    dtype=_np.int64).reshape(-1))
+                if isinstance(src, RowSparseNDArray):
+                    res = sparse_retain(src, want)
+                else:
+                    rows = src.asnumpy()[want]
+                    res = row_sparse_array((rows, want), shape=src.shape,
+                                           ctx=src.context)
+                if isinstance(o, BaseSparseNDArray):
+                    res.copyto(o)
+                else:
+                    o._set_data(res._dense_nd()._data.astype(o._data.dtype))
 
     # --- optimizer wiring (reference: kvstore.py:set_optimizer) ------------
     def set_optimizer(self, optimizer):

@@ -58,7 +58,8 @@ def _register_fc():
             return None
         if attrs.flatten:
             return [(o[0],) + tuple(d[1:])] + list(in_shapes[1:])
-        return [tuple(d[:-1]) + (d[-1],)] + list(in_shapes[1:])
+        # leading dims come from the output so unknown batch dims resolve
+        return [tuple(o[:-1]) + (d[-1],)] + list(in_shapes[1:])
 
     register_op(
         "FullyConnected", fully_connected,

@@ -95,6 +95,9 @@ def test_fused_rnn_cell_unroll():
     stack = fused.unfuse()
     u_out, _ = stack.unroll(T, mx.sym.Variable("data"), merge_outputs=True)
     args = fused.unpack_weights({"lstm_parameters": mx.nd.array(params)})
+    # unpack produces per-gate names; the stacked LSTMCell binds the
+    # gate-concatenated i2h/h2h blobs, so re-pack at the cell level
+    args = stack.pack_weights(args)
     args["data"] = mx.nd.array(x)
     exe2 = u_out.bind(mx.cpu(), args=args)
     unfused_out = exe2.forward()[0].asnumpy()
