@@ -174,15 +174,27 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
     cot = {}
     leaf_grads = {}  # id(NDArray) -> accumulated jnp array
 
+    def acc(a, b):
+        """Accumulate cotangents; row-sparse tangents (ndarray/sparse.py
+        _RspTangent) merge sparsely via _rsp_add, mixed sparse+dense
+        densifies."""
+        if a is None:
+            return b
+        if hasattr(a, "_rsp_add"):
+            return a._rsp_add(b)
+        if hasattr(b, "_rsp_add"):
+            return b._rsp_add(a)
+        return a + b
+
     def seed(arr, g):
         gval = g._data if g is not None else jnp.ones(arr.shape, dtype=arr._data.dtype)
         node = arr._autograd_node
         if node is not None:
             k = (id(node), arr._autograd_index)
-            cot[k] = cot[k] + gval if k in cot else gval
+            cot[k] = acc(cot.get(k), gval)
         elif arr._autograd_marked:
             lid = id(arr)
-            leaf_grads[lid] = leaf_grads[lid] + gval if lid in leaf_grads else gval
+            leaf_grads[lid] = acc(leaf_grads.get(lid), gval)
             leaf_grads.setdefault("_arr%d" % lid, arr)
 
     for arr, g in zip(heads, head_grads):
@@ -207,16 +219,18 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
         if node.vjp_fn is None:
             raise MXNetError("graph already freed; call backward(retain_graph=True) "
                              "to backprop twice")
+        # interior jax vjps need dense arrays; sparse tangents densify here
+        cots = [c.densify() if hasattr(c, "densify") else c for c in cots]
         in_grads = node.vjp_fn(tuple(cots))
         for inp, g in zip(node.inputs, in_grads):
             if g is None or g.dtype == jax.dtypes.float0:
                 continue
             if inp._autograd_node is not None:
                 k = (id(inp._autograd_node), inp._autograd_index)
-                cot[k] = cot[k] + g if k in cot else g
+                cot[k] = acc(cot.get(k), g)
             elif inp._autograd_marked:
                 lid = id(inp)
-                leaf_grads[lid] = leaf_grads[lid] + g if lid in leaf_grads else g
+                leaf_grads[lid] = acc(leaf_grads.get(lid), g)
                 leaf_grads.setdefault("_arr%d" % lid, inp)
 
     # write into .grad respecting grad_req
@@ -227,6 +241,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
         req = arr._autograd_marked
         if req == "null" or arr.grad is None:
             continue
+        if hasattr(g, "to_rsp"):  # _RspTangent
+            from .ndarray.sparse import RowSparseNDArray, rsp_add
+
+            if isinstance(arr.grad, RowSparseNDArray):
+                rsp = g.to_rsp(arr.grad.context)
+                if req == "add":
+                    rsp = rsp_add(arr.grad, rsp)
+                rsp.copyto(arr.grad)
+                continue
+            g = g.densify()
         if req == "add":
             arr.grad._set_data(arr.grad._data + g.astype(arr.grad._data.dtype))
         else:  # write

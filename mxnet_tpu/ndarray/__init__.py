@@ -20,3 +20,6 @@ globals().update(
 )
 
 from .utils import save, load  # noqa: E402
+from . import sparse  # noqa: E402
+from .sparse import (BaseSparseNDArray, RowSparseNDArray,  # noqa: E402
+                     CSRNDArray)

@@ -176,6 +176,13 @@ class NDArray:
     # --- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """Attach a zero-initialized gradient buffer (reference: ndarray.py attach_grad)."""
+        if stype is not None and stype != "default":
+            from .sparse import zeros as sparse_zeros
+
+            self._mark_variable(
+                sparse_zeros(stype, self.shape, ctx=self._ctx,
+                             dtype=self._data.dtype), grad_req)
+            return
         jnp = _jnp()
         grad_arr = _from_data(jnp.zeros(self.shape, dtype=self._data.dtype), self._ctx)
         self._mark_variable(grad_arr, grad_req)

@@ -1,0 +1,671 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference: include/mxnet/ndarray.h:59-143 (storage_type_ + aux tensors in the
+chunk), python/mxnet/ndarray/sparse.py (CSRNDArray/RowSparseNDArray, 1281
+LoC), src/operator/tensor/cast_storage-inl.h, dot-inl.h, sparse_retain.
+
+TPU-first design: a sparse array is (values, aux-index arrays) — the same
+decomposition as the reference's chunk aux tensors — but the compute path is
+gather/scatter + ``jax.ops.segment_sum``, which XLA lowers to efficient
+one-hot matmuls / dynamic-slice loops on TPU, instead of CPU/GPU pointer
+kernels. Conversions that need value-dependent shapes (nonzero-row discovery)
+run eagerly on host — acceptable because cast_storage at a storage boundary
+is a data-layout step, not a jit-hot op (the reference's FComputeEx dispatch
+boundary plays the same role, src/common/exec_utils.h:46-127).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..context import current_context
+from .ndarray import NDArray, _from_data, array as _dense_array
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+    "cast_storage", "sparse_retain", "square_sum", "dot",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base for sparse storage (reference: sparse.py BaseSparseNDArray).
+
+    ``_data`` holds the packed values tensor; ``_aux`` the index tensors
+    (the reference keeps both in one storage chunk, ndarray.h:110-143).
+    """
+
+    __slots__ = ("_sshape", "_aux")
+
+    def __init__(self, *a, **kw):  # constructed via helpers, not directly
+        raise NotImplementedError("use row_sparse_array/csr_matrix")
+
+    # --- shape/dtype reflect the logical dense tensor ---------------------
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def size(self):
+        return int(np.prod(self._sshape)) if self._sshape else 1
+
+    @property
+    def ndim(self):
+        return len(self._sshape)
+
+    @property
+    def data(self):
+        """The values tensor (reference: sparse.py .data)."""
+        return _from_data(self._data, self._ctx)
+
+    def _aux_data(self, i):
+        return _from_data(self._aux[i], self._ctx)
+
+    @property
+    def num_aux(self):
+        return len(self._aux)
+
+    # --- dense interop ----------------------------------------------------
+    def asnumpy(self):
+        return np.asarray(self._to_dense_raw())
+
+    def astype(self, dtype, copy=True):
+        out = self._clone()
+        out._data = self._data.astype(np_dtype(dtype))
+        return out
+
+    def copy(self):
+        return self._clone()
+
+    def copyto(self, other):
+        import jax
+
+        from ..context import Context
+
+        if isinstance(other, Context):
+            out = self._clone()
+            out._data = jax.device_put(self._data, other.jax_device())
+            out._aux = tuple(jax.device_put(a, other.jax_device())
+                             for a in self._aux)
+            out._ctx = other
+            return out
+        if isinstance(other, BaseSparseNDArray):
+            if other.stype != self.stype:
+                raise MXNetError("copyto stype mismatch: %s vs %s"
+                                 % (self.stype, other.stype))
+            other._data = self._data
+            other._aux = self._aux
+            other._sshape = self._sshape
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(_jnp().asarray(self._to_dense_raw()).astype(
+                other._data.dtype))
+            return other
+        raise TypeError("copyto does not support %s" % type(other))
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, slice) and key == slice(None):
+            if isinstance(value, BaseSparseNDArray):
+                value.copyto(self)
+                return
+            if isinstance(value, NDArray):
+                value = value.asnumpy()
+            src = array(np.asarray(value), stype=self.stype,
+                        dtype=self._data.dtype)
+            src.copyto(self)
+            return
+        raise MXNetError("%s only supports [:] assignment" % type(self).__name__)
+
+    def __getitem__(self, key):
+        raise MXNetError("%s does not support slicing; tostype('default') "
+                         "first" % type(self).__name__)
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(map(str, self._sshape)),
+                                  self.context)
+
+    # elementwise falls back to dense (reference: storage-fallback trampoline
+    # src/common/exec_utils.h CastNonDefaultStorage); rsp+rsp stays sparse
+    def _dense_nd(self):
+        return _from_data(_jnp().asarray(self._to_dense_raw()), self._ctx)
+
+    @staticmethod
+    def _densify_operand(x):
+        return x._dense_nd() if isinstance(x, BaseSparseNDArray) else x
+
+    def __add__(self, other):
+        if isinstance(self, RowSparseNDArray) and \
+                isinstance(other, RowSparseNDArray):
+            return rsp_add(self, other)
+        return self._dense_nd() + self._densify_operand(other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._dense_nd() - self._densify_operand(other)
+
+    def __rsub__(self, other):
+        return self._densify_operand(other) - self._dense_nd()
+
+    def __mul__(self, other):
+        if np.isscalar(other):
+            out = self._clone()
+            out._data = self._data * other
+            return out
+        return self._dense_nd() * self._densify_operand(other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        if np.isscalar(other):
+            return self.__mul__(1.0 / other)
+        return self._dense_nd() / self._densify_operand(other)
+
+    def __rtruediv__(self, other):
+        return self._densify_operand(other) / self._dense_nd()
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __pow__(self, other):
+        return self._dense_nd() ** self._densify_operand(other)
+
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        if isinstance(res, RowSparseNDArray):
+            res.copyto(self)
+            return self
+        raise MXNetError("in-place add on %s with dense result; use "
+                         "tostype('default')" % type(self).__name__)
+
+    def __eq__(self, other):
+        return self._dense_nd() == self._densify_operand(other)
+
+    def __ne__(self, other):
+        return self._dense_nd() != self._densify_operand(other)
+
+    __hash__ = object.__hash__
+
+
+def _sparse_new(cls, values, aux, shape, ctx):
+    arr = cls.__new__(cls)
+    arr._data = values
+    arr._aux = tuple(aux)
+    arr._sshape = tuple(int(s) for s in shape)
+    arr._ctx = ctx
+    arr._grad = None
+    arr._autograd_node = None
+    arr._autograd_index = 0
+    arr._autograd_marked = None
+    return arr
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: (indices[K], values[K, ...row dims]) with sorted
+    unique row ids (reference: sparse.py RowSparseNDArray; ndarray.h
+    kRowSparseStorage)."""
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return self._aux_data(0)
+
+    def _clone(self):
+        return _sparse_new(RowSparseNDArray, self._data, self._aux,
+                           self._sshape, self._ctx)
+
+    def _to_dense_raw(self):
+        jnp = _jnp()
+        dense = jnp.zeros(self._sshape, dtype=self._data.dtype)
+        if self._aux[0].shape[0] == 0:
+            return dense
+        return dense.at[self._aux[0]].set(self._data)
+
+    def retain(self, indices):
+        return sparse_retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix: (indptr[rows+1], indices[nnz],
+    values[nnz]) (reference: sparse.py CSRNDArray; ndarray.h kCSRStorage)."""
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return self._aux_data(1)
+
+    @property
+    def indptr(self):
+        return self._aux_data(0)
+
+    def _clone(self):
+        return _sparse_new(CSRNDArray, self._data, self._aux, self._sshape,
+                           self._ctx)
+
+    def _row_ids_raw(self):
+        """Expand indptr to a per-nnz row-id vector (host, eager)."""
+        indptr = np.asarray(self._aux[0])
+        return np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+
+    def _to_dense_raw(self):
+        jnp = _jnp()
+        dense = jnp.zeros(self._sshape, dtype=self._data.dtype)
+        if self._data.shape[0] == 0:
+            return dense
+        rows = _jnp().asarray(self._row_ids_raw())
+        return dense.at[rows, self._aux[1]].set(self._data)
+
+
+# --- constructors ----------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Build a RowSparseNDArray from (data, indices) or a dense source
+    (reference: sparse.py row_sparse_array)."""
+    import jax
+
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                          else data, dtype=np_dtype(dtype))
+        indices = np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                             else indices, dtype=np.int64).reshape(-1)
+        order = np.argsort(indices)
+        indices, data = indices[order], data[order]
+        if shape is None:
+            top = int(indices.max()) + 1 if indices.size else 0
+            shape = (top,) + data.shape[1:]
+        jd = jax.device_put(data, ctx.jax_device())
+        ji = jax.device_put(indices, ctx.jax_device())
+        return _sparse_new(RowSparseNDArray, jd, (ji,), shape, ctx)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.copy()
+    if isinstance(arg1, NDArray):
+        arg1 = arg1.asnumpy()
+    return cast_storage(_dense_array(np.asarray(arg1, dtype=np_dtype(dtype)),
+                                     ctx=ctx), "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Build a CSRNDArray from (data, indices, indptr) or a dense source
+    (reference: sparse.py csr_matrix)."""
+    import jax
+
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                          else data, dtype=np_dtype(dtype)).reshape(-1)
+        indices = np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                             else indices, dtype=np.int64).reshape(-1)
+        indptr = np.asarray(indptr.asnumpy() if isinstance(indptr, NDArray)
+                            else indptr, dtype=np.int64).reshape(-1)
+        if shape is None:
+            cols = int(indices.max()) + 1 if indices.size else 0
+            shape = (len(indptr) - 1, cols)
+        jd = jax.device_put(data, ctx.jax_device())
+        return _sparse_new(
+            CSRNDArray, jd,
+            (jax.device_put(indptr, ctx.jax_device()),
+             jax.device_put(indices, ctx.jax_device())), shape, ctx)
+    if isinstance(arg1, CSRNDArray):
+        return arg1.copy()
+    if isinstance(arg1, NDArray):
+        arg1 = arg1.asnumpy()
+    return cast_storage(_dense_array(np.asarray(arg1, dtype=np_dtype(dtype)),
+                                     ctx=ctx), "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """All-zero sparse array (reference: sparse.py zeros; src/operator/tensor/
+    init_op.cc _zeros FComputeEx)."""
+    import jax
+
+    from . import ndarray as _nd_mod
+
+    if stype == "default":
+        from .ndarray import zeros as dzeros
+
+        return dzeros(shape, ctx=ctx, dtype=dtype)
+    ctx = ctx or current_context()
+    dt = np_dtype(dtype)
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        vals = jax.device_put(np.zeros((0,) + tuple(shape[1:]), dtype=dt),
+                              ctx.jax_device())
+        idx = jax.device_put(np.zeros((0,), dtype=np.int64), ctx.jax_device())
+        return _sparse_new(RowSparseNDArray, vals, (idx,), shape, ctx)
+    if stype == "csr":
+        vals = jax.device_put(np.zeros((0,), dtype=dt), ctx.jax_device())
+        indptr = jax.device_put(np.zeros((shape[0] + 1,), dtype=np.int64),
+                                ctx.jax_device())
+        idx = jax.device_put(np.zeros((0,), dtype=np.int64), ctx.jax_device())
+        return _sparse_new(CSRNDArray, vals, (indptr, idx), shape, ctx)
+    raise MXNetError("unknown storage type %r" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, stype="default", ctx=None, dtype=None):
+    """Dense/sparse-aware array constructor (reference: sparse.py array)."""
+    if stype == "default":
+        return _dense_array(source_array, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse":
+        return row_sparse_array(source_array, ctx=ctx, dtype=dtype)
+    if stype == "csr":
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown storage type %r" % stype)
+
+
+# --- storage conversion (reference: cast_storage-inl.h) --------------------
+
+def cast_storage(arr, stype):
+    """Convert between dense / row_sparse / csr storage."""
+    if arr.stype == stype:
+        return arr.copy()
+    if stype == "default":
+        if isinstance(arr, BaseSparseNDArray):
+            return arr._dense_nd()
+        return arr.copy()
+    # source → dense numpy → target (nonzero discovery is host-side; the
+    # reference's GPU kernels do the same mark/prefix-sum dance on device)
+    dense = arr.asnumpy()
+    ctx = arr.context
+    import jax
+
+    if stype == "row_sparse":
+        if dense.ndim < 1:
+            raise MXNetError("row_sparse needs ndim >= 1")
+        nz = np.flatnonzero(
+            np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))
+        vals = jax.device_put(dense[nz], ctx.jax_device())
+        idx = jax.device_put(nz.astype(np.int64), ctx.jax_device())
+        return _sparse_new(RowSparseNDArray, vals, (idx,), dense.shape, ctx)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr storage is 2-D only")
+        rows, cols = np.nonzero(dense)
+        indptr = np.zeros(dense.shape[0] + 1, dtype=np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr)
+        return _sparse_new(
+            CSRNDArray,
+            jax.device_put(dense[rows, cols], ctx.jax_device()),
+            (jax.device_put(indptr, ctx.jax_device()),
+             jax.device_put(cols.astype(np.int64), ctx.jax_device())),
+            dense.shape, ctx)
+    raise MXNetError("unknown storage type %r" % stype)
+
+
+def sparse_retain(arr, indices):
+    """Keep only the requested rows of a RowSparseNDArray (reference:
+    src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects row_sparse storage")
+    want = np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                      else indices, dtype=np.int64).reshape(-1)
+    have = np.asarray(arr._aux[0])
+    keep = np.isin(have, want)
+    import jax
+
+    vals = arr._data[_jnp().asarray(np.flatnonzero(keep))]
+    idx = jax.device_put(have[keep], arr.context.jax_device())
+    return _sparse_new(RowSparseNDArray, vals, (idx,), arr._sshape,
+                       arr.context)
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """sum(x**2) touching only stored values (reference: src/operator/tensor/
+    square_sum-inl.h — the fused rsp norm used by sparse lars/wd)."""
+    if not isinstance(arr, BaseSparseNDArray):
+        raise MXNetError("square_sum expects sparse storage")
+    jnp = _jnp()
+    if axis is None:
+        return _from_data(jnp.sum(arr._data.astype(np.float32) ** 2))
+    if isinstance(arr, RowSparseNDArray) and axis in (1, (1,)):
+        vals = jnp.sum(arr._data.reshape(arr._data.shape[0], -1) ** 2, axis=1)
+        if keepdims:
+            vals = vals[:, None]
+            shape = (arr._sshape[0], 1)
+        else:
+            shape = (arr._sshape[0],)
+        return _sparse_new(RowSparseNDArray, vals, (arr._aux[0],), shape,
+                           arr.context)
+    return _from_data(jnp.sum(jnp.asarray(arr._to_dense_raw()) ** 2,
+                              axis=axis, keepdims=keepdims))
+
+
+# --- sparse dot (reference: src/operator/tensor/dot-inl.h) -----------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr · dense → dense, csrᵀ · dense → row_sparse.
+
+    TPU path: per-nnz gather + ``segment_sum`` (XLA scatter-add), the
+    reference's DotCsrDnsDns/DotCsrDnsRsp kernels without pointer chasing."""
+    import jax
+
+    jnp = _jnp()
+    if not isinstance(lhs, CSRNDArray):
+        from . import op as _op  # dense fallback
+
+        a = lhs._dense_nd() if isinstance(lhs, BaseSparseNDArray) else lhs
+        b = rhs._dense_nd() if isinstance(rhs, BaseSparseNDArray) else rhs
+        return _op.dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+    if transpose_b:
+        raise MXNetError("dot(csr, dns, transpose_b=True) unsupported "
+                         "(matches reference dot-inl.h)")
+    dense_rhs = rhs._dense_nd() if isinstance(rhs, BaseSparseNDArray) else rhs
+    vals, cols = lhs._data, lhs._aux[1]
+    rows = jnp.asarray(lhs._row_ids_raw())
+    r = dense_rhs._data
+    if r.ndim == 1:
+        r = r[:, None]
+    if not transpose_a:
+        # out[i] = Σ_nnz(row==i) v · rhs[col]
+        contrib = vals[:, None] * r[cols]
+        out = jax.ops.segment_sum(contrib, rows,
+                                  num_segments=lhs._sshape[0])
+        if dense_rhs._data.ndim == 1:
+            out = out[:, 0]
+        return _from_data(out, lhs.context)
+    # csrᵀ·dns: out[col] += v · rhs[row]; result is row-sparse over cols
+    contrib = vals[:, None] * r[rows]
+    dense_out = jnp.zeros((lhs._sshape[1], r.shape[1]),
+                          dtype=contrib.dtype).at[cols].add(contrib)
+    nz_rows = np.unique(np.asarray(cols))
+    idx = jnp.asarray(nz_rows)
+    return _sparse_new(RowSparseNDArray, dense_out[idx], (idx,),
+                       (lhs._sshape[1], r.shape[1]), lhs.context)
+
+
+# --- rsp arithmetic helpers (used by kvstore reduce / optimizer) -----------
+
+def rsp_add(a, b):
+    """Merge-sum two RowSparseNDArrays (reference: ReduceSumCPUExSerial,
+    src/kvstore/comm.h:335)."""
+    if not (isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray)):
+        raise MXNetError("rsp_add expects row_sparse operands")
+    jnp = _jnp()
+    ia, ib = np.asarray(a._aux[0]), np.asarray(b._aux[0])
+    union = np.union1d(ia, ib)  # sorted, so positions come from searchsorted
+    out = jnp.zeros((len(union),) + tuple(a._sshape[1:]),
+                    dtype=a._data.dtype)
+    if len(ia):
+        out = out.at[jnp.asarray(np.searchsorted(union, ia))].add(a._data)
+    if len(ib):
+        out = out.at[jnp.asarray(np.searchsorted(union, ib))].add(
+            b._data.astype(a._data.dtype))
+    import jax
+
+    idx = jax.device_put(union.astype(np.int64), a.context.jax_device())
+    return _sparse_new(RowSparseNDArray, out, (idx,), a._sshape, a.context)
+
+
+# --- lazy sparse optimizer updates (reference: src/operator/optimizer_op.cc
+# SGDUpdateRspImpl / AdamUpdateRspImpl / FtrlUpdateRspImpl: only rows present
+# in the row_sparse gradient are touched — "lazy update" semantics) ----------
+
+def _grad_rows(grad, rescale_grad, clip_gradient):
+    jnp = _jnp()
+    g = grad._data * np.float32(rescale_grad)
+    if clip_gradient is not None:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return grad._aux[0], g
+
+
+def sgd_update_rsp(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=None):
+    idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    rows = w[idx]
+    rows = rows - lr * (g.astype(rows.dtype) + wd * rows)
+    weight._set_data(w.at[idx].set(rows))
+
+
+def sgd_mom_update_rsp(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=None):
+    idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
+    w, m = weight._data, mom._data
+    w_rows, m_rows = w[idx], m[idx]
+    m_rows = momentum * m_rows - lr * (g.astype(w.dtype) + wd * w_rows)
+    mom._set_data(m.at[idx].set(m_rows))
+    weight._set_data(w.at[idx].set(w_rows + m_rows))
+
+
+def adam_update_rsp(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                    epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=None):
+    jnp = _jnp()
+    idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    w_rows = w[idx]
+    g = g.astype(w.dtype) + wd * w_rows
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * g * g
+    mean._set_data(mean._data.at[idx].set(m_rows))
+    var._set_data(var._data.at[idx].set(v_rows))
+    weight._set_data(w.at[idx].set(
+        w_rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)))
+
+
+def ftrl_update_rsp(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=None):
+    jnp = _jnp()
+    idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    g = g.astype(w.dtype)
+    n_rows, z_rows, w_rows = n._data[idx], z._data[idx], w[idx]
+    n_new = n_rows + g * g
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_rows)) / lr
+    z_new = z_rows + g - sigma * w_rows
+    w_new = jnp.where(
+        jnp.abs(z_new) > lamda1,
+        -(z_new - jnp.sign(z_new) * lamda1)
+        / ((beta + jnp.sqrt(n_new)) / lr + wd),
+        jnp.zeros_like(w_rows))
+    n._set_data(n._data.at[idx].set(n_new))
+    z._set_data(z._data.at[idx].set(z_new))
+    weight._set_data(w.at[idx].set(w_new))
+
+
+# --- sparse-gradient embedding (reference: src/operator/tensor/
+# indexing_op.cc SparseEmbedding — backward emits a row_sparse grad so
+# large-vocab tables never materialize a dense gradient) ---------------------
+
+class _RspTangent:
+    """Row-sparse cotangent flowing through the autograd tape.
+
+    Duck-typed against jnp arrays in autograd.backward via ``_rsp_add`` /
+    ``densify``; leaf writes into a RowSparseNDArray grad buffer keep it
+    sparse, anything else densifies."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices  # jax int array (K,)
+        self.values = values    # jax (K, *row)
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def _rsp_add(self, other):
+        if other is None:
+            return self
+        if isinstance(other, _RspTangent):
+            jnp = _jnp()
+            return _RspTangent(
+                jnp.concatenate([self.indices, other.indices]),
+                jnp.concatenate([self.values,
+                                 other.values.astype(self.values.dtype)]),
+                self.shape)
+        return self.densify() + other
+
+    __add__ = __radd__ = _rsp_add
+
+    def densify(self):
+        jnp = _jnp()
+        return jnp.zeros(self.shape, dtype=self.values.dtype).at[
+            self.indices].add(self.values)
+
+    def to_rsp(self, ctx):
+        """Collapse duplicate indices and wrap as RowSparseNDArray."""
+        import jax
+
+        jnp = _jnp()
+        host_idx = np.asarray(self.indices)
+        uniq = np.unique(host_idx)
+        seg = jnp.asarray(np.searchsorted(uniq, host_idx))
+        vals = jax.ops.segment_sum(self.values, seg, num_segments=len(uniq))
+        idx = jax.device_put(uniq.astype(np.int64), ctx.jax_device())
+        return _sparse_new(RowSparseNDArray, vals, (idx,), self.shape, ctx)
+
+
+def sparse_embedding(data, weight, input_dim=None, output_dim=None, **_):
+    """Embedding lookup whose weight gradient is row_sparse.
+
+    Forward is the same XLA gather as dense Embedding; the hand-built tape
+    node returns an ``_RspTangent`` for the weight instead of a dense
+    scatter-add (reference: indexing_op.cc SparseEmbedding backward)."""
+    from .. import autograd
+
+    jnp = _jnp()
+    idx_flat = data._data.astype(np.int32).reshape(-1)
+    out_data = weight._data[idx_flat].reshape(
+        tuple(data.shape) + (weight.shape[1],))
+    out = _from_data(out_data, weight.context)
+    if autograd.is_recording():
+        w_shape = weight.shape
+
+        def vjp_fn(cots):
+            cot = cots[0].reshape((-1, w_shape[1]))
+            return (None, _RspTangent(idx_flat, cot, w_shape))
+
+        node = autograd.TapeNode(
+            vjp_fn, [data, weight], 1, [tuple(out_data.shape)],
+            [out_data.dtype], name="SparseEmbedding")
+        out._autograd_node = node
+        out._autograd_index = 0
+    return out

@@ -1,0 +1,217 @@
+"""Sparse storage tests — ported slice of the reference's
+tests/python/unittest/test_sparse_ndarray.py and test_sparse_operator.py
+patterns (creation/round-trip, cast_storage, retain, dot, optimizer lazy
+updates, sparse embedding grad, kvstore row_sparse_pull)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.uniform(-1, 1, shape).astype(np.float32)
+    mask = rng.uniform(0, 1, shape) < density
+    return (d * mask).astype(np.float32)
+
+
+def test_rsp_creation_roundtrip():
+    dense = _rand_dense((6, 4))
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (6, 4)
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    # (data, indices) form
+    rsp2 = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), [4, 1]), shape=(5, 3))
+    out = rsp2.asnumpy()
+    assert out.shape == (5, 3)
+    assert out[1].sum() == 3 and out[4].sum() == 3 and out.sum() == 6
+    # indices come back sorted
+    np.testing.assert_array_equal(rsp2.indices.asnumpy(), [1, 4])
+
+
+def test_csr_creation_roundtrip():
+    dense = _rand_dense((5, 7), seed=1)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    import scipy.sparse as sps
+
+    ref = sps.csr_matrix(dense)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), ref.indptr)
+    np.testing.assert_array_equal(csr.indices.asnumpy(), ref.indices)
+    np.testing.assert_allclose(csr.data.asnumpy(), ref.data)
+
+
+def test_cast_storage_all_pairs():
+    dense = _rand_dense((4, 5), seed=2)
+    dn = mx.nd.array(dense)
+    for stype, back in [("row_sparse", "default"), ("csr", "default")]:
+        sp = sparse.cast_storage(dn, stype)
+        assert sp.stype == stype
+        rt = sparse.cast_storage(sp, back)
+        assert rt.stype == "default"
+        np.testing.assert_allclose(rt.asnumpy(), dense)
+    # csr ↔ rsp via dense
+    csr = sparse.cast_storage(dn, "csr")
+    rsp = sparse.cast_storage(csr, "row_sparse")
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+
+
+def test_zeros_and_setitem():
+    z = sparse.zeros("row_sparse", (3, 2))
+    assert z.asnumpy().sum() == 0
+    z[:] = sparse.row_sparse_array(np.ones((3, 2), np.float32))
+    np.testing.assert_allclose(z.asnumpy(), 1.0)
+    zc = sparse.zeros("csr", (3, 2))
+    assert zc.indptr.shape == (4,)
+    assert zc.asnumpy().sum() == 0
+
+
+def test_sparse_retain():
+    dense = np.zeros((6, 2), np.float32)
+    dense[[1, 3, 5]] = [[1, 1], [3, 3], [5, 5]]
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.sparse_retain(rsp, np.array([3, 5]))
+    out = kept.asnumpy()
+    assert out[3, 0] == 3 and out[5, 0] == 5 and out[1, 0] == 0
+
+
+def test_csr_dot():
+    lhs = _rand_dense((4, 6), seed=3)
+    rhs = np.random.RandomState(4).uniform(-1, 1, (6, 3)).astype(np.float32)
+    csr = sparse.csr_matrix(lhs)
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), lhs @ rhs, rtol=1e-5,
+                               atol=1e-6)
+    # transpose_a → row_sparse result
+    outT = sparse.dot(csr, mx.nd.array(np.random.RandomState(5).uniform(
+        -1, 1, (4, 2)).astype(np.float32)), transpose_a=True)
+    assert outT.stype == "row_sparse"
+    assert outT.shape == (6, 2)
+
+
+def test_rsp_add_and_arith():
+    a = sparse.row_sparse_array((np.ones((2, 3), np.float32), [0, 2]),
+                                shape=(5, 3))
+    b = sparse.row_sparse_array((2 * np.ones((2, 3), np.float32), [2, 4]),
+                                shape=(5, 3))
+    c = a + b
+    assert c.stype == "row_sparse"
+    out = c.asnumpy()
+    assert out[0, 0] == 1 and out[2, 0] == 3 and out[4, 0] == 2
+    # scalar math keeps sparsity; dense math densifies with the right shape
+    assert (a * 2).stype == "row_sparse"
+    assert (a * 2).asnumpy()[2, 1] == 2
+    d = a - b
+    assert d.stype == "default" and d.shape == (5, 3)
+    assert (a + mx.nd.ones((5, 3))).shape == (5, 3)
+
+
+def test_square_sum():
+    dense = _rand_dense((6, 3), seed=6)
+    rsp = sparse.row_sparse_array(dense)
+    np.testing.assert_allclose(sparse.square_sum(rsp).asnumpy(),
+                               (dense ** 2).sum(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("opt_name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("ftrl", {"learning_rate": 0.1}),
+])
+def test_sparse_optimizer_matches_dense_on_touched_rows(opt_name, kwargs):
+    """Lazy sparse update == dense update restricted to gradient rows when
+    every row is touched (reference test_sparse_operator.py pattern)."""
+    shape = (6, 4)
+    rng = np.random.RandomState(7)
+    w0 = rng.uniform(-1, 1, shape).astype(np.float32)
+    g0 = rng.uniform(-1, 1, shape).astype(np.float32)
+
+    opt_d = mx.optimizer.create(opt_name, **kwargs)
+    opt_s = mx.optimizer.create(opt_name, **kwargs)
+    w_d, w_s = mx.nd.array(w0), mx.nd.array(w0)
+    s_d = opt_d.create_state(0, w_d)
+    s_s = opt_s.create_state(0, w_s)
+    g_rsp = sparse.row_sparse_array((g0, np.arange(shape[0])), shape=shape)
+    opt_d.update(0, w_d, mx.nd.array(g0), s_d)
+    opt_s.update(0, w_s, g_rsp, s_s)
+    np.testing.assert_allclose(w_s.asnumpy(), w_d.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+    # untouched rows stay untouched (lazy semantics)
+    w_lazy = mx.nd.array(w0)
+    opt_l = mx.optimizer.create(opt_name, **kwargs)
+    s_l = opt_l.create_state(0, w_lazy)
+    part = sparse.row_sparse_array((g0[:2], [0, 1]), shape=shape)
+    opt_l.update(0, w_lazy, part, s_l)
+    np.testing.assert_array_equal(w_lazy.asnumpy()[2:], w0[2:])
+    assert not np.allclose(w_lazy.asnumpy()[:2], w0[:2])
+
+
+def test_sparse_embedding_grad():
+    vocab, dim = 10, 4
+    rng = np.random.RandomState(8)
+    weight = mx.nd.array(rng.uniform(-1, 1, (vocab, dim)).astype(np.float32))
+    data = mx.nd.array(np.array([[1, 3], [3, 7]], np.float32))
+    weight.attach_grad(stype="row_sparse")
+    with autograd.record():
+        out = sparse.sparse_embedding(data, weight, input_dim=vocab,
+                                      output_dim=dim)
+        loss = out * 2
+    loss.backward()
+    g = weight.grad
+    assert g.stype == "row_sparse"
+    np.testing.assert_array_equal(np.asarray(g.indices.asnumpy()), [1, 3, 7])
+    dense_g = g.asnumpy()
+    np.testing.assert_allclose(dense_g[3], 4.0)   # row 3 hit twice × cot 2
+    np.testing.assert_allclose(dense_g[1], 2.0)
+    np.testing.assert_allclose(dense_g[0], 0.0)
+
+
+def test_sparse_embedding_dense_grad_buffer():
+    """Sparse tangent densifies into a dense grad buffer."""
+    vocab, dim = 6, 3
+    weight = mx.nd.array(np.ones((vocab, dim), np.float32))
+    data = mx.nd.array(np.array([2, 2, 4], np.float32))
+    weight.attach_grad()
+    with autograd.record():
+        out = sparse.sparse_embedding(data, weight, input_dim=vocab,
+                                      output_dim=dim)
+    out.backward()
+    g = weight.grad.asnumpy()
+    np.testing.assert_allclose(g[2], 2.0)
+    np.testing.assert_allclose(g[4], 1.0)
+    np.testing.assert_allclose(g[0], 0.0)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    shape = (8, 3)
+    w = np.arange(24, dtype=np.float32).reshape(shape)
+    kv.init("emb", mx.nd.array(w))
+    out = sparse.zeros("row_sparse", shape)
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([2, 5]))
+    res = out.asnumpy()
+    np.testing.assert_allclose(res[2], w[2])
+    np.testing.assert_allclose(res[5], w[5])
+    assert res[0].sum() == 0 and res[7].sum() == 0
+
+
+def test_kvstore_rsp_push():
+    kv = mx.kv.create("local")
+    shape = (6, 2)
+    kv.init("w", sparse.zeros("row_sparse", shape))
+    a = sparse.row_sparse_array((np.ones((1, 2), np.float32), [1]),
+                                shape=shape)
+    b = sparse.row_sparse_array((np.ones((1, 2), np.float32), [4]),
+                                shape=shape)
+    kv.push("w", [a, b])
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    res = out.asnumpy()
+    assert res[1, 0] == 1 and res[4, 0] == 1 and res.sum() == 4
