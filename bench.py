@@ -3,11 +3,16 @@
 (images/sec) on the attached accelerator, vs the reference's published
 P100 number (BASELINE.md §2: 181.53 img/s, docs/faq/perf.md:180-187).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+``value`` is the bs32 protocol number (reference measurement protocol,
+docs/faq/perf.md:144-187); extra keys report the large-batch capability
+number and MFU so perf is judged at the chip's capability, not just
+against a 2017 GPU.
 
-The whole train step (fwd+bwd+allreduce+SGD) is one XLA program
-(mxnet_tpu.parallel.ShardedTrainer); bf16 compute with fp32 BN statistics is
-the TPU analog of the reference's fp16 path (SURVEY.md §7.3(6)).
+TPU-first choices: the whole train step (fwd+bwd+SGD) is one XLA program
+(mxnet_tpu.parallel.ShardedTrainer); channels-last (NHWC) graph so conv
+channels ride the 128-lane MXU dimension; bf16 compute with fp32 BN
+statistics (the TPU analog of the reference's fp16 path, SURVEY.md §7.3(6)).
 """
 import json
 import os
@@ -20,57 +25,86 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMG_S = 181.53  # ResNet-50 train bs32, P100 (docs/faq/perf.md)
 
+# fwd+bwd model FLOPs per image (2*MACs * 3 for fwd+dgrad+wgrad), ResNet-50
+# at 224x224: ~4.09 GFLOP forward
+FLOPS_PER_IMG = 3 * 4.089e9
 
-def main():
+_PEAK_BF16 = {
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v5p": 459e12, "TPU v4": 275e12, "TPU v6e": 918e12,
+}
+
+
+def _bench_one(batch_size, layout, dtype, n_iters):
     import jax
 
-    import mxnet_tpu as mx
     from mxnet_tpu.models import get_resnet
     from mxnet_tpu.parallel import ShardedTrainer, make_mesh
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "32"))
-    n_warmup = int(os.environ.get("BENCH_WARMUP", "5"))
-    n_iters = int(os.environ.get("BENCH_ITERS", "20"))
-    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
-
     devices = jax.devices()
     mesh = make_mesh({"dp": len(devices)}, devices=devices)
-
-    symbol = get_resnet(num_classes=1000, num_layers=50)
+    symbol = get_resnet(num_classes=1000, num_layers=50, layout=layout)
     trainer = ShardedTrainer(
         symbol, mesh, optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         dtype=dtype)
 
-    shapes = {"data": (batch_size, 3, 224, 224),
-              "softmax_label": (batch_size,)}
+    data_shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
+                  else (batch_size, 224, 224, 3))
+    shapes = {"data": data_shape, "softmax_label": (batch_size,)}
     state = trainer.init(shapes)
 
     rng = np.random.RandomState(0)
-    data = rng.uniform(0, 1, shapes["data"]).astype(np.float32)
+    data = rng.uniform(0, 1, data_shape).astype(np.float32)
     label = rng.randint(0, 1000, batch_size).astype(np.float32)
     batch = trainer.shard_batch({"data": data, "softmax_label": label})
 
-    for _ in range(n_warmup):
-        state, outs = trainer.step(state, batch)
-    np.asarray(outs[0])  # D2H fetch: block_until_ready alone does not
-    # flush the remote-tunnel execution queue
-
+    # The whole timed loop is ONE XLA program (lax.scan over steps): a
+    # single dispatch + a value-bearing D2H fetch the backend cannot skip.
+    # Host-loop timing is unreliable on the remote-tunnel backend (fetching
+    # only the tail of a donated chain under-reports; per-step fetches add
+    # ~90ms RTT per step and over-report). The scan result depends on every
+    # step, so wall-clock / n_iters is the true per-step cost (+ one RTT,
+    # amortized by n_iters).
+    state, outs = trainer.multi_step(state, batch, n_iters)  # compile+warm
+    np.asarray(outs[-1])
     t0 = time.perf_counter()
-    for _ in range(n_iters):
-        state, outs = trainer.step(state, batch)
-    # each step consumes the previous step's donated params, so fetching the
-    # last output forces the whole chain to completion
-    np.asarray(outs[0])
+    state, outs = trainer.multi_step(state, batch, n_iters)
+    assert np.isfinite(np.asarray(outs[-1])).all()
     dt = time.perf_counter() - t0
+    return batch_size * n_iters / dt
 
-    img_s = batch_size * n_iters / dt
-    print(json.dumps({
+
+def main():
+    import jax
+
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+    big_bs = int(os.environ.get("BENCH_BIG_BATCH", "512"))
+
+    # peak table is bf16; MFU is only meaningful for the bf16 protocol
+    peak = (_PEAK_BF16.get(jax.devices()[0].device_kind)
+            if dtype == np.dtype("bfloat16") else None)
+
+    img_s_32 = _bench_one(32, layout, dtype,
+                          int(os.environ.get("BENCH_ITERS", "200")))
+    img_s_big = _bench_one(big_bs, layout, dtype,
+                           int(os.environ.get("BENCH_ITERS_BIG", "40")))
+
+    result = {
         "metric": "resnet50_train_img_per_sec",
-        "value": round(img_s, 2),
+        "value": round(img_s_32, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+        "vs_baseline": round(img_s_32 / BASELINE_IMG_S, 3),
+        "protocol": "bs32 %s %s single chip" % (dtype.name, layout),
+        "capability_img_per_sec": round(img_s_big, 2),
+        "capability_batch": big_bs,
+        "device": jax.devices()[0].device_kind,
+    }
+    if peak:
+        result["mfu_bs32"] = round(img_s_32 * FLOPS_PER_IMG / peak, 4)
+        result["mfu_capability"] = round(img_s_big * FLOPS_PER_IMG / peak, 4)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

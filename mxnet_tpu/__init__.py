@@ -52,3 +52,8 @@ from . import models
 from . import gluon
 from . import rnn
 from . import test_utils
+from . import operator
+from .operator import _install_frontends as _iff
+
+_iff()
+del _iff

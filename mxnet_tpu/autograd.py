@@ -108,17 +108,25 @@ def predict_mode():
 
 
 class TapeNode:
-    """One recorded op: vjp closure + graph linkage (AGInfo analog)."""
+    """One recorded op: vjp closure + graph linkage (AGInfo analog).
 
-    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_shapes", "out_dtypes", "name")
+    ``prim_fn`` is the pure primal (raw arrays → ((outs...), (aux...)));
+    kept so create_graph can re-derive a vjp whose dependence on the primal
+    INPUTS is visible to a second tape pass (a stored vjp closure hides the
+    input dependence inside opaque residuals)."""
 
-    def __init__(self, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes, name=""):
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_shapes", "out_dtypes",
+                 "name", "prim_fn")
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes,
+                 name="", prim_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of NDArray (strong refs keep the graph alive)
         self.n_outputs = n_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
         self.name = name
+        self.prim_fn = prim_fn
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -263,19 +271,132 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
             arr._autograd_node = None
 
 
+def _run_backward_symbolic(heads, head_grads):
+    """Backward where every cotangent is itself a recorded NDArray, so the
+    produced gradients carry tape nodes and can be differentiated again
+    (create_graph=True; reference: imperative.cc:361 Backward is_record path).
+
+    Each node's vjp is re-derived from its stored primal (prim_fn) with the
+    primal inputs as live tape inputs — a stored vjp closure would hide the
+    input dependence and make second derivatives silently zero."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import _from_data
+    from .ndarray.register import record_apply
+
+    topo = _collect_graph(heads)
+    cot = {}   # (id(node), out_idx) -> NDArray cotangent
+    leaf = {}  # id(arr) -> NDArray grad
+
+    def acc(a, b):
+        return b if a is None else a + b
+
+    def seed(arr, g):
+        gval = g if g is not None else _from_data(
+            jnp.ones(arr.shape, dtype=arr._data.dtype))
+        node = arr._autograd_node
+        if node is not None:
+            k = (id(node), arr._autograd_index)
+            cot[k] = acc(cot.get(k), gval)
+        elif arr._autograd_marked:
+            leaf[id(arr)] = acc(leaf.get(id(arr)), gval)
+
+    for arr, g in zip(heads, head_grads):
+        seed(arr, g)
+
+    for node in reversed(topo):
+        has_any = any((id(node), i) in cot for i in range(node.n_outputs))
+        if not has_any:
+            continue
+        if node.prim_fn is None:
+            raise MXNetError(
+                "create_graph=True needs the primal for node %r; this node "
+                "(custom tape entry) does not support higher-order grad"
+                % node.name)
+        cot_arrays, inexact_pos = [], []
+        for i in range(node.n_outputs):
+            if node.out_dtypes[i] == jax.dtypes.float0:
+                continue
+            c = cot.pop((id(node), i), None)
+            if c is None:
+                c = _from_data(jnp.zeros(node.out_shapes[i],
+                                         dtype=node.out_dtypes[i]))
+            inexact_pos.append(i)
+            cot_arrays.append(c)
+        n_in = len(node.inputs)
+
+        def bwd_raw(*flat, _prim=node.prim_fn, _n_in=n_in,
+                    _pos=tuple(inexact_pos), _shs=tuple(node.out_shapes)):
+            xs, cs = flat[:_n_in], flat[_n_in:]
+            outs, vjp_fn, _ = jax.vjp(lambda *a: _prim(*a), *xs,
+                                      has_aux=True)
+            full, ci = [], 0
+            for i, o in enumerate(outs):
+                if i in _pos:
+                    full.append(cs[ci].astype(o.dtype))
+                    ci += 1
+                else:
+                    full.append(np.zeros(_shs[i], dtype=jax.dtypes.float0))
+            gs = vjp_fn(tuple(full))
+            return tuple(
+                jnp.zeros(x.shape, x.dtype)
+                if (g is None or g.dtype == jax.dtypes.float0) else g
+                for g, x in zip(gs, xs))
+
+        in_grads = record_apply(bwd_raw, list(node.inputs) + cot_arrays,
+                                name=node.name + "_bwd")[:n_in]
+        for inp, g in zip(node.inputs, in_grads):
+            if not np.issubdtype(np.dtype(inp._data.dtype)
+                                 if inp._data.dtype.name != "bfloat16"
+                                 else np.float32, np.inexact) \
+                    and inp._data.dtype.name != "bfloat16":
+                continue  # no gradient flow into integer inputs
+            if inp._autograd_node is not None:
+                k = (id(inp._autograd_node), inp._autograd_index)
+                cot[k] = acc(cot.get(k), g)
+            elif inp._autograd_marked:
+                leaf[id(inp)] = acc(leaf.get(id(inp)), g)
+    return leaf
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):  # pylint: disable=redefined-outer-name
     """Return gradients of heads w.r.t. variables (reference: autograd.py:270).
 
-    ``create_graph`` (higher-order grad) is not yet supported on the eager
-    tape; use symbolic/jit paths for higher-order derivatives.
-    """
+    ``create_graph=True`` records the backward pass itself, so the returned
+    gradients can be differentiated again (reference: imperative.cc:361)."""
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise NotImplementedError("create_graph=True not yet supported")
     if isinstance(variables, NDArray):
         variables = [variables]
+    if create_graph:
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import _from_data
+
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        elif isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+        if len(heads) != len(head_grads):
+            raise MXNetError("heads and head_grads must match in length")
+        saved_marks = [(v._grad, v._autograd_marked) for v in variables]
+        for v in variables:
+            if not v._autograd_marked:
+                v._autograd_marked = "write"
+        try:
+            with _RecordingStateScope(True, train_mode):
+                leaf = _run_backward_symbolic(heads, head_grads)
+        finally:
+            for v, (og, om) in zip(variables, saved_marks):
+                v._grad = og
+                v._autograd_marked = om
+        return [leaf.get(id(v)) if leaf.get(id(v)) is not None else
+                _from_data(jnp.zeros(v.shape, dtype=v._data.dtype))
+                for v in variables]
     saved = [(v.grad, v._autograd_marked) for v in variables]
     import jax.numpy as jnp
 
@@ -293,6 +414,61 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             v._grad = og
             v._autograd_marked = om
     return tmp_grads
+
+
+class Function:
+    """User-defined differentiable function (reference: autograd.py:364
+    Function, backed by MXCustomFunctionRecord / c_api_function.cc).
+
+    Subclass with ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)``; both run with autograd paused, and the
+    pair is recorded as a single tape node so the custom backward replaces
+    the traced vjp."""
+
+    def forward(self, *inputs):
+        raise NotImplementedError()
+
+    def backward(self, *output_grads):
+        raise NotImplementedError()
+
+    def save_for_backward(self, *arrays):
+        self.saved_tensors = arrays
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _from_data
+        from .ndarray.register import _cot_dtype
+
+        with pause():
+            outputs = self.forward(*inputs)
+        ret_tuple = isinstance(outputs, tuple)
+        outs = outputs if ret_tuple else (outputs,)
+        if is_recording():
+            def vjp_fn(cots, _self=self):
+                with pause():
+                    igrads = _self.backward(
+                        *[_from_data(c) for c in cots])
+                if not isinstance(igrads, tuple):
+                    igrads = (igrads,)
+                if len(igrads) != len(inputs):
+                    raise MXNetError(
+                        "%s.backward must return %d input grads, got %d"
+                        % (type(_self).__name__, len(inputs), len(igrads)))
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in igrads)
+
+            node = TapeNode(
+                vjp_fn, list(inputs), len(outs),
+                [tuple(o.shape) for o in outs],
+                [_cot_dtype(o._data.dtype) for o in outs],
+                name=type(self).__name__)
+            wrapped = []
+            for i, o in enumerate(outs):
+                o2 = _from_data(o._data, o._ctx)
+                o2._autograd_node = node
+                o2._autograd_index = i
+                wrapped.append(o2)
+            outs = tuple(wrapped)
+        return outs if ret_tuple else outs[0]
 
 
 def get_symbol(x):

@@ -67,11 +67,17 @@ class Context:
 
     # --- JAX resolution -------------------------------------------------
     def jax_device(self):
-        """The jax.Device this context resolves to."""
+        """The jax.Device this context resolves to.
+
+        Contexts address LOCAL devices: in a multi-process (jax.distributed)
+        job each worker's mx.cpu(0)/mx.gpu(0) is its own process-local
+        device, matching the reference where each PS worker owns its own
+        GPUs (kvstore_dist.h) — global devices are only touched by
+        collectives."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            devs = [d for d in jax.local_devices(backend="cpu")]
         else:
             devs = _accelerator_devices()
         if self.device_id >= len(devs):
@@ -90,8 +96,8 @@ def _accelerator_devices():
     """
     import jax
 
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs if devs else jax.devices("cpu")
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return devs if devs else [d for d in jax.local_devices(backend="cpu")]
 
 
 def cpu(device_id=0):

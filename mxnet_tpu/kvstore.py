@@ -14,6 +14,7 @@ via the same facade.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 from .base import MXNetError
@@ -42,6 +43,34 @@ def _ctype_key_value(keys, vals):
     return [keys], [list(vals)]
 
 
+def _ensure_distributed():
+    """Initialize jax.distributed from the launcher's env (tools/launch.py
+    analog of the reference's DMLC_ROLE/DMLC_PS_ROOT_URI role system,
+    src/kvstore/kvstore_dist.h + ps-lite Van)."""
+    import jax
+
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    nworkers = os.environ.get("MXTPU_NUM_WORKERS")
+    worker_id = os.environ.get("MXTPU_WORKER_ID")
+    if coord is None:
+        raise MXNetError(
+            "dist_* KVStore needs jax.distributed: either call "
+            "jax.distributed.initialize() yourself or launch workers with "
+            "tools/launch.py (sets MXTPU_COORDINATOR/MXTPU_NUM_WORKERS/"
+            "MXTPU_WORKER_ID)")
+    try:
+        # CPU fake-cluster path (tests/nightly dist pattern); harmless no-op
+        # name on TPU backends where collectives ride ICI/DCN natively
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coord, num_processes=int(nworkers),
+                               process_id=int(worker_id))
+
+
 class KVStore:
     """Key-value store for parameter synchronization."""
 
@@ -52,6 +81,15 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._barrier_count = 0
+        self._dist = kv_type.startswith("dist")
+        if self._dist:
+            if "async" in kv_type:
+                raise MXNetError(
+                    "dist_async is not supported: the TPU build is "
+                    "allreduce-based (synchronous); the reference's "
+                    "per-push server updates (kvstore_dist_server.h:422) "
+                    "have no straggler-tolerant analog here")
+            _ensure_distributed()
 
     # --- basic ops (reference: kvstore.py init/push/pull) -----------------
     def init(self, key, value):
@@ -77,12 +115,28 @@ class KVStore:
             return merged
         return nd.add_n(*vlist)
 
+    def _global_reduce(self, merged):
+        """Sum the locally-merged value across all worker processes — the
+        dist_sync server-side accumulate (kvstore_dist_server.h:261-312)
+        expressed as an allreduce; every worker then applies the identical
+        update, so weights stay bit-identical across workers."""
+        from jax.experimental import multihost_utils
+
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if isinstance(merged, BaseSparseNDArray):
+            merged = merged._dense_nd()  # variable-nnz across workers
+        stacked = multihost_utils.process_allgather(merged._data)
+        return nd.array(stacked.sum(axis=0), dtype=merged._data.dtype)
+
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._data:
                 raise MXNetError("key %r has not been initialized" % (k,))
             merged = self._reduce(vlist)
+            if self._dist and self.num_workers > 1:
+                merged = self._global_reduce(merged)
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._data[k])
             else:
@@ -152,6 +206,15 @@ class KVStore:
     set_updater = _set_updater
 
     def set_gradient_compression(self, compression_params):
+        ctype = (compression_params or {}).get("type")
+        if ctype not in (None, "none"):
+            # explicit failure beats silently training uncompressed
+            # (reference: src/kvstore/gradient_compression.h 2-bit +
+            # error-feedback; not implemented on the TPU build)
+            raise MXNetError(
+                "gradient compression %r is not implemented; on TPU the "
+                "allreduce rides ICI where 2-bit quantization is not "
+                "profitable" % ctype)
         self._compression_params = compression_params
 
     # --- distributed attributes (reference: kvstore.py rank/num_workers) ---
@@ -167,6 +230,13 @@ class KVStore:
 
     def _barrier(self):
         self._barrier_count += 1
+        if self._dist and self.num_workers > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                "kvstore_barrier_%d" % self._barrier_count)
+
+    barrier = _barrier
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"

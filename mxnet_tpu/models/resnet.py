@@ -13,94 +13,100 @@ _WORKSPACE = 256
 
 
 def residual_unit(data, num_filter, stride, dim_match, name,
-                  bottle_neck=True, memonger=False):
+                  bottle_neck=True, memonger=False, layout="NCHW"):
     """One pre-activation residual unit (reference: resnet.py:residual_unit)."""
+    bn_axis = 3 if layout == "NHWC" else 1
     if bottle_neck:
-        bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=_BN_MOM,
+        bn1 = sym.BatchNorm(data, axis=bn_axis, fix_gamma=False, eps=2e-5, momentum=_BN_MOM,
                             name=name + "_bn1")
         act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
-        conv1 = sym.Convolution(act1, num_filter=int(num_filter * 0.25),
+        conv1 = sym.Convolution(act1, layout=layout, num_filter=int(num_filter * 0.25),
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
                                 no_bias=True, workspace=_WORKSPACE,
                                 name=name + "_conv1")
-        bn2 = sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5,
+        bn2 = sym.BatchNorm(conv1, axis=bn_axis, fix_gamma=False, eps=2e-5,
                             momentum=_BN_MOM, name=name + "_bn2")
         act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
-        conv2 = sym.Convolution(act2, num_filter=int(num_filter * 0.25),
+        conv2 = sym.Convolution(act2, layout=layout, num_filter=int(num_filter * 0.25),
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
                                 no_bias=True, workspace=_WORKSPACE,
                                 name=name + "_conv2")
-        bn3 = sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5,
+        bn3 = sym.BatchNorm(conv2, axis=bn_axis, fix_gamma=False, eps=2e-5,
                             momentum=_BN_MOM, name=name + "_bn3")
         act3 = sym.Activation(bn3, act_type="relu", name=name + "_relu3")
-        conv3 = sym.Convolution(act3, num_filter=num_filter, kernel=(1, 1),
+        conv3 = sym.Convolution(act3, layout=layout, num_filter=num_filter, kernel=(1, 1),
                                 stride=(1, 1), pad=(0, 0), no_bias=True,
                                 workspace=_WORKSPACE, name=name + "_conv3")
         if dim_match:
             shortcut = data
         else:
-            shortcut = sym.Convolution(act1, num_filter=num_filter,
+            shortcut = sym.Convolution(act1, layout=layout, num_filter=num_filter,
                                        kernel=(1, 1), stride=stride,
                                        no_bias=True, workspace=_WORKSPACE,
                                        name=name + "_sc")
         return conv3 + shortcut
-    bn1 = sym.BatchNorm(data, fix_gamma=False, momentum=_BN_MOM, eps=2e-5,
+    bn1 = sym.BatchNorm(data, axis=bn_axis, fix_gamma=False, momentum=_BN_MOM, eps=2e-5,
                         name=name + "_bn1")
     act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
-    conv1 = sym.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
+    conv1 = sym.Convolution(act1, layout=layout, num_filter=num_filter, kernel=(3, 3),
                             stride=stride, pad=(1, 1), no_bias=True,
                             workspace=_WORKSPACE, name=name + "_conv1")
-    bn2 = sym.BatchNorm(conv1, fix_gamma=False, momentum=_BN_MOM, eps=2e-5,
+    bn2 = sym.BatchNorm(conv1, axis=bn_axis, fix_gamma=False, momentum=_BN_MOM, eps=2e-5,
                         name=name + "_bn2")
     act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
-    conv2 = sym.Convolution(act2, num_filter=num_filter, kernel=(3, 3),
+    conv2 = sym.Convolution(act2, layout=layout, num_filter=num_filter, kernel=(3, 3),
                             stride=(1, 1), pad=(1, 1), no_bias=True,
                             workspace=_WORKSPACE, name=name + "_conv2")
     if dim_match:
         shortcut = data
     else:
-        shortcut = sym.Convolution(act1, num_filter=num_filter, kernel=(1, 1),
+        shortcut = sym.Convolution(act1, layout=layout, num_filter=num_filter, kernel=(1, 1),
                                    stride=stride, no_bias=True,
                                    workspace=_WORKSPACE, name=name + "_sc")
     return conv2 + shortcut
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, memonger=False):
-    """Full network (reference: resnet.py:resnet)."""
+           bottle_neck=True, memonger=False, layout="NCHW"):
+    """Full network (reference: resnet.py:resnet).
+
+    ``layout="NHWC"`` builds the channels-last graph (data (N,H,W,C)) —
+    the TPU-native layout: channels ride the 128-lane dim into the MXU.
+    """
+    bn_axis = 3 if layout == "NHWC" else 1
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable("data")
     (nchannel, height, width) = image_shape
-    data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=_BN_MOM,
+    data = sym.BatchNorm(data, axis=bn_axis, fix_gamma=True, eps=2e-5, momentum=_BN_MOM,
                          name="bn_data")
     if height <= 32:  # cifar-style stem
-        body = sym.Convolution(data, num_filter=filter_list[0], kernel=(3, 3),
+        body = sym.Convolution(data, layout=layout, num_filter=filter_list[0], kernel=(3, 3),
                                stride=(1, 1), pad=(1, 1), no_bias=True,
                                name="conv0", workspace=_WORKSPACE)
     else:  # imagenet stem
-        body = sym.Convolution(data, num_filter=filter_list[0], kernel=(7, 7),
+        body = sym.Convolution(data, layout=layout, num_filter=filter_list[0], kernel=(7, 7),
                                stride=(2, 2), pad=(3, 3), no_bias=True,
                                name="conv0", workspace=_WORKSPACE)
-        body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+        body = sym.BatchNorm(body, axis=bn_axis, fix_gamma=False, eps=2e-5,
                              momentum=_BN_MOM, name="bn0")
         body = sym.Activation(body, act_type="relu", name="relu0")
-        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+        body = sym.Pooling(body, layout=layout, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
                            pool_type="max")
 
     for i in range(num_stages):
         body = residual_unit(
             body, filter_list[i + 1], (1 if i == 0 else 2, 1 if i == 0 else 2),
             False, name="stage%d_unit%d" % (i + 1, 1),
-            bottle_neck=bottle_neck, memonger=memonger)
+            bottle_neck=bottle_neck, memonger=memonger, layout=layout)
         for j in range(units[i] - 1):
             body = residual_unit(body, filter_list[i + 1], (1, 1), True,
                                  name="stage%d_unit%d" % (i + 1, j + 2),
-                                 bottle_neck=bottle_neck, memonger=memonger)
-    bn1 = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=_BN_MOM,
+                                 bottle_neck=bottle_neck, memonger=memonger, layout=layout)
+    bn1 = sym.BatchNorm(body, axis=bn_axis, fix_gamma=False, eps=2e-5, momentum=_BN_MOM,
                         name="bn1")
     relu1 = sym.Activation(bn1, act_type="relu", name="relu1")
-    pool1 = sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
+    pool1 = sym.Pooling(relu1, layout=layout, global_pool=True, kernel=(7, 7),
                         pool_type="avg", name="pool1")
     flat = sym.Flatten(pool1)
     fc1 = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
@@ -108,7 +114,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 
 
 def get_resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               **kwargs):
+               layout="NCHW", **kwargs):
     """(reference: resnet.py:get_symbol) Depth → unit schedule."""
     (nchannel, height, width) = image_shape
     if height <= 28:
@@ -140,4 +146,4 @@ def get_resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
         units = units_map[num_layers]
     return resnet(units=units, num_stages=num_stages, filter_list=filter_list,
                   num_classes=num_classes, image_shape=image_shape,
-                  bottle_neck=bottle_neck, **kwargs)
+                  bottle_neck=bottle_neck, layout=layout, **kwargs)

@@ -50,6 +50,7 @@ def _record(f, input_arrays, name, datas=None):
         [tuple(o.shape) for o in outs],
         [_cot_dtype(o.dtype) for o in outs],
         name=name,
+        prim_fn=f,
     )
     return outs, new_aux, node
 

@@ -1,0 +1,250 @@
+"""Custom operator framework — the user escape hatch for python-defined ops.
+
+Reference: python/mxnet/operator.py (CustomOp :418, CustomOpProp :464,
+register :598) backed by src/operator/custom/custom.cc, which calls back into
+the frontend on a dedicated thread. The TPU analog: the python body runs as a
+host callback (``jax.pure_callback``) inside the compiled program, with
+``jax.custom_vjp`` routing the backward to ``CustomOp.backward`` — so custom
+ops compose with jit/symbolic executors exactly like the reference's async
+Custom op composes with the engine.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_custom_op_prop"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom imperative kernels (reference: operator.py:418)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the request type
+        (reference: operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Operator properties: names/shapes/types (reference: operator.py:464)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type`` (reference:
+    operator.py:598 register)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_custom_op_prop(op_type, config_json="{}"):
+    """Instantiate the registered prop with its keyword config."""
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(
+            "Custom op_type %r not registered (known: %s)"
+            % (op_type, sorted(_CUSTOM_REGISTRY)))
+    kwargs = json.loads(config_json) if config_json else {}
+    # the reference passes user kwargs as strings to the prop ctor
+    return _CUSTOM_REGISTRY[op_type](**kwargs)
+
+
+# --- the registered Custom op (used by nd.Custom / sym.Custom) --------------
+
+def _register_custom_opdef():
+    import jax
+
+    from .ops.registry import register_op
+
+    def _n_inputs(attrs):
+        prop = get_custom_op_prop(attrs.op_type, attrs.config)
+        return len(prop.list_arguments())
+
+    def _n_outputs(attrs):
+        prop = get_custom_op_prop(attrs.op_type, attrs.config)
+        return len(prop.list_outputs())
+
+    def _input_names(attrs):
+        prop = get_custom_op_prop(attrs.op_type, attrs.config)
+        return prop.list_arguments()
+
+    def custom_fn(attrs, *inputs, is_train=False):
+        from .ndarray.ndarray import array as nd_array, zeros as nd_zeros
+
+        prop = get_custom_op_prop(attrs.op_type, attrs.config)
+        in_shapes = [tuple(x.shape) for x in inputs]
+        _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+        in_dtypes = [np.dtype(x.dtype) for x in inputs]
+        _, out_dtypes, _ = prop.infer_type(in_dtypes)
+        out_sds = [jax.ShapeDtypeStruct(tuple(s), d)
+                   for s, d in zip(out_shapes, out_dtypes)]
+        in_sds = [jax.ShapeDtypeStruct(s, d)
+                  for s, d in zip(in_shapes, in_dtypes)]
+        train_flag = bool(is_train)
+
+        def host_forward(*xs):
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            in_nd = [nd_array(np.asarray(x)) for x in xs]
+            out_nd = [nd_zeros(tuple(s), dtype=d)
+                      for s, d in zip(out_shapes, out_dtypes)]
+            op.forward(train_flag, ["write"] * len(out_nd), in_nd, out_nd, [])
+            return tuple(o.asnumpy().astype(d)
+                         for o, d in zip(out_nd, out_dtypes))
+
+        def host_backward(xs, ys, cots):
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            in_nd = [nd_array(np.asarray(x)) for x in xs]
+            out_nd = [nd_array(np.asarray(y)) for y in ys]
+            ograd_nd = [nd_array(np.asarray(c)) for c in cots]
+            igrad_nd = [nd_zeros(s, dtype=d)
+                        for s, d in zip(in_shapes, in_dtypes)]
+            op.backward(["write"] * len(igrad_nd), ograd_nd, in_nd, out_nd,
+                        igrad_nd, [])
+            return tuple(g.asnumpy().astype(d)
+                         for g, d in zip(igrad_nd, in_dtypes))
+
+        @jax.custom_vjp
+        def run(*xs):
+            out = jax.pure_callback(host_forward, tuple(out_sds), *xs)
+            return tuple(out)
+
+        def run_fwd(*xs):
+            outs = run(*xs)
+            return outs, (xs, outs)
+
+        def run_bwd(res, cots):
+            xs, ys = res
+            gs = jax.pure_callback(
+                lambda xs_, ys_, cs_: host_backward(xs_, ys_, cs_),
+                tuple(in_sds), xs, ys, tuple(cots))
+            return tuple(gs)
+
+        run.defvjp(run_fwd, run_bwd)
+        return run(*inputs)
+
+    def custom_infer_shape(attrs, in_shapes, aux_shapes):
+        if any(s is None for s in in_shapes):
+            return None
+        prop = get_custom_op_prop(attrs.op_type, attrs.config)
+        ins, outs, auxs = prop.infer_shape([list(s) for s in in_shapes])
+        return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+                [tuple(s) for s in auxs])
+
+    from .ops.param import Str
+
+    register_op(
+        "Custom", custom_fn,
+        params={"op_type": Str(), "config": Str(default="{}")},
+        num_inputs=_n_inputs, input_names=_input_names,
+        num_outputs=_n_outputs,
+        infer_shape=custom_infer_shape,
+        needs_is_train=True,
+        doc="Python custom op via host callback + custom_vjp (reference: "
+            "src/operator/custom/custom.cc; python/mxnet/operator.py:418)")
+
+
+_register_custom_opdef()
+
+
+def custom_call_kwargs(kwargs):
+    """Split user kwargs into the Custom op's (op_type, config) attrs —
+    the frontend packs arbitrary ctor kwargs as JSON (the reference passes
+    them as string key/values through the C API)."""
+    op_type = kwargs.pop("op_type")
+    tensor_kwargs = {}
+    config = {}
+    for k, v in list(kwargs.items()):
+        from .ndarray.ndarray import NDArray
+
+        if isinstance(v, NDArray) or k in ("out", "name"):
+            tensor_kwargs[k] = v
+        else:
+            config[k] = v
+    return dict(op_type=op_type, config=json.dumps(config), **tensor_kwargs)
+
+
+def _install_frontends():
+    """Wrap the generated nd.Custom / sym.Custom so arbitrary prop-ctor
+    kwargs are packed into the JSON ``config`` attr (the reference forwards
+    them as C-API string key/values, operator.py:598)."""
+    from . import ndarray as nd_pkg
+    from . import symbol as sym_pkg
+
+    raw_nd = nd_pkg.Custom
+    raw_sym = sym_pkg.Custom
+
+    def nd_custom(*args, **kwargs):
+        return raw_nd(*args, **custom_call_kwargs(kwargs))
+
+    def sym_custom(*args, **kwargs):
+        op_type = kwargs.pop("op_type")
+        passthrough = {}
+        config = {}
+        for k, v in list(kwargs.items()):
+            if k in ("name", "attr") or hasattr(v, "list_arguments"):
+                passthrough[k] = v
+            else:
+                config[k] = v
+        return raw_sym(*args, op_type=op_type, config=json.dumps(config),
+                       **passthrough)
+
+    nd_custom.__doc__ = raw_nd.__doc__
+    sym_custom.__doc__ = raw_sym.__doc__
+    nd_pkg.Custom = nd_custom
+    nd_pkg.op.Custom = nd_custom
+    sym_pkg.Custom = sym_custom

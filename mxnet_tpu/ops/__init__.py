@@ -19,3 +19,4 @@ from . import optimizer_ops
 from . import random_ops
 from . import rnn
 from . import contrib
+from .. import operator as _operator  # noqa: F401  (registers Custom)

@@ -74,10 +74,22 @@ def _register_fc():
 
 # --- Convolution ------------------------------------------------------------
 
-def _conv_dims(nd):
-    """Dimension-number strings for N-d convolution in MXNet's NC... layout."""
+def _conv_dims(nd, layout=None):
+    """Dimension-number strings for N-d convolution.
+
+    Default is MXNet's NC... layout; channels-last layouts (NWC/NHWC/NDHWC,
+    the reference Convolution's ``layout`` param) map channels onto the TPU
+    lane dimension so the MXU consumes them without relayout — the
+    performance-critical choice on TPU (weights are then spatial-major
+    ...IO, the XLA-native HWIO)."""
     spatial = "DHW"[-nd:]
+    if layout and layout.endswith("C"):
+        return ("N" + spatial + "C", spatial + "IO", "N" + spatial + "C")
     return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+
+
+def _is_channels_last(attrs):
+    return bool(attrs.layout) and attrs.layout.endswith("C")
 
 
 def _register_conv():
@@ -90,16 +102,19 @@ def _register_conv():
         stride = attrs.stride or (1,) * nd
         dilate = attrs.dilate or (1,) * nd
         pad = attrs.pad or (0,) * nd
+        channels_last = _is_channels_last(attrs)
         out = jax.lax.conv_general_dilated(
             data, weight,
             window_strides=stride,
             padding=[(p, p) for p in pad],
             rhs_dilation=dilate,
-            dimension_numbers=_conv_dims(nd),
+            dimension_numbers=_conv_dims(nd, attrs.layout),
             feature_group_count=attrs.num_group,
         )
         if not attrs.no_bias:
-            out = out + rest[0].reshape((1, -1) + (1,) * nd)
+            bshape = ((1,) * (nd + 1) + (-1,)) if channels_last \
+                else ((1, -1) + (1,) * nd)
+            out = out + rest[0].reshape(bshape)
         return out
 
     def conv_infer(attrs, in_shapes, aux_shapes):
@@ -110,12 +125,19 @@ def _register_conv():
         stride = attrs.stride or (1,) * nd
         dilate = attrs.dilate or (1,) * nd
         pad = attrs.pad or (0,) * nd
-        c = d[1]
-        w = (attrs.num_filter, c // attrs.num_group) + tuple(attrs.kernel)
+        channels_last = _is_channels_last(attrs)
+        c = d[-1] if channels_last else d[1]
+        if channels_last:
+            w = tuple(attrs.kernel) + (c // attrs.num_group, attrs.num_filter)
+            sp_in = d[1:-1]
+        else:
+            w = (attrs.num_filter, c // attrs.num_group) + tuple(attrs.kernel)
+            sp_in = d[2:]
         spatial = tuple(
-            (d[2 + i] + 2 * pad[i] - dilate[i] * (attrs.kernel[i] - 1) - 1) // stride[i] + 1
+            (sp_in[i] + 2 * pad[i] - dilate[i] * (attrs.kernel[i] - 1) - 1) // stride[i] + 1
             for i in range(nd))
-        out = (d[0], attrs.num_filter) + spatial
+        out = ((d[0],) + spatial + (attrs.num_filter,)) if channels_last \
+            else ((d[0], attrs.num_filter) + spatial)
         shapes = [d, w] + ([] if attrs.no_bias else [(attrs.num_filter,)])
         return (shapes, [out], aux_shapes)
 
@@ -204,13 +226,21 @@ def _register_pool():
 
     def pooling(attrs, data):
         nd = len(attrs.kernel) if attrs.kernel else data.ndim - 2
-        kernel = attrs.kernel if not attrs.global_pool else data.shape[2:]
+        channels_last = _is_channels_last(attrs)
+        sp_in = data.shape[1:-1] if channels_last else data.shape[2:]
+        kernel = attrs.kernel if not attrs.global_pool else sp_in
         stride = (attrs.stride or (1,) * nd) if not attrs.global_pool else (1,) * nd
         pad = (attrs.pad or (0,) * nd) if not attrs.global_pool else (0,) * nd
-        window = (1, 1) + tuple(kernel)
-        strides = (1, 1) + tuple(stride)
-        pads = [(0, 0), (0, 0)] + _pool_pads(data.shape[2:], kernel, stride, pad,
-                                             attrs.pooling_convention)
+        sp_pads = _pool_pads(sp_in, kernel, stride, pad,
+                             attrs.pooling_convention)
+        if channels_last:
+            window = (1,) + tuple(kernel) + (1,)
+            strides = (1,) + tuple(stride) + (1,)
+            pads = [(0, 0)] + sp_pads + [(0, 0)]
+        else:
+            window = (1, 1) + tuple(kernel)
+            strides = (1, 1) + tuple(stride)
+            pads = [(0, 0), (0, 0)] + sp_pads
         if attrs.pool_type == "max":
             init = -jnp.inf
             out = jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
@@ -226,19 +256,26 @@ def _register_pool():
         d = in_shapes[0]
         if d is None:
             return None
+        channels_last = _is_channels_last(attrs)
         if attrs.global_pool:
+            if channels_last:
+                return ([d], [(d[0],) + (1,) * (len(d) - 2) + (d[-1],)],
+                        aux_shapes)
             return ([d], [d[:2] + (1,) * (len(d) - 2)], aux_shapes)
         nd = len(attrs.kernel)
         stride = attrs.stride or (1,) * nd
         pad = attrs.pad or (0,) * nd
+        sp_in = d[1:-1] if channels_last else d[2:]
         spatial = []
         for i in range(nd):
-            n, k, s, p = d[2 + i], attrs.kernel[i], stride[i], pad[i]
+            n, k, s, p = sp_in[i], attrs.kernel[i], stride[i], pad[i]
             if attrs.pooling_convention == "full":
                 spatial.append(int(np.ceil((n + 2 * p - k) / s)) + 1)
             else:
                 spatial.append((n + 2 * p - k) // s + 1)
-        return ([d], [d[:2] + tuple(spatial)], aux_shapes)
+        out = ((d[0],) + tuple(spatial) + (d[-1],)) if channels_last \
+            else (d[:2] + tuple(spatial))
+        return ([d], [out], aux_shapes)
 
     register_op(
         "Pooling", pooling,
@@ -247,6 +284,7 @@ def _register_pool():
                 "global_pool": Bool(default=False),
                 "pooling_convention": Enum(["valid", "full"], default="valid"),
                 "stride": Shape(default=()), "pad": Shape(default=()),
+                "layout": Str(default=None),
                 "cudnn_off": Bool(default=False)},
         num_inputs=1, infer_shape=pool_infer,
         doc="Max/avg/sum pooling → XLA ReduceWindow (reference: "

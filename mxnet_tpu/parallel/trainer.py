@@ -145,7 +145,7 @@ class ShardedTrainer:
                 for k, v in arrays.items()}
 
     # --- the compiled step -------------------------------------------------
-    def _build_step(self):
+    def _step_body(self):
         import jax
         import jax.numpy as jnp
 
@@ -177,13 +177,71 @@ class ShardedTrainer:
                 states = opt_state[name]
                 (new_w,), new_states = opt_opdef.apply(
                     opt_attrs, (w, g.astype(w.dtype)), states)
-                new_params[name] = new_w
+                # keep the carried weight dtype stable (bf16 weights with
+                # fp32 optimizer state = the mp_sgd master-copy pattern,
+                # src/operator/optimizer_op.cc mp_sgd_update)
+                new_params[name] = new_w.astype(w.dtype)
                 new_opt[name] = tuple(new_states)
             new_aux = dict(aux)
             new_aux.update(aux_upd)
             return new_params, new_aux, new_opt, outs
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    def _build_step(self):
+        import jax
+
+        return jax.jit(self._step_body(), donate_argnums=(0, 1, 2))
+
+    def _build_multi_step(self, n_steps):
+        """n_steps training steps as ONE XLA program via lax.scan — the
+        TPU-native training loop: no host round-trip per step (the engine
+        bulk-segment idea, graph_executor.cc:1345 InitOpSegs, taken to its
+        XLA conclusion). Returns (new_state_parts, last_outs)."""
+        import jax
+
+        body = self._step_body()
+
+        def multi(params, aux, opt_state, batch, lrs, step0):
+            def scan_body(carry, lr):
+                params, aux, opt_state, i = carry
+                params, aux, opt_state, outs = body(
+                    params, aux, opt_state, batch, lr, i)
+                import jax.numpy as jnp
+
+                # carry a per-step scalar (not the full output tensor) so
+                # the stacked result stays tiny but still depends on the
+                # whole step's compute
+                return (params, aux, opt_state, i + 1), jnp.mean(
+                    outs[0].astype(jnp.float32))
+
+            (params, aux, opt_state, _), losses = jax.lax.scan(
+                scan_body, (params, aux, opt_state, step0), lrs)
+            return params, aux, opt_state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def multi_step(self, state, batch, n_steps):
+        """Run ``n_steps`` steps on one batch in a single dispatch; returns
+        (new_state, per-step first-output-mean stack). LR schedules are
+        honored per step (the schedule is evaluated on host and fed to the
+        scan as a per-step vector)."""
+        import numpy as np
+
+        key = ("multi", n_steps)
+        if not hasattr(self, "_multi_fns"):
+            self._multi_fns = {}
+        if key not in self._multi_fns:
+            self._multi_fns[key] = self._build_multi_step(n_steps)
+        step0 = state["step"]
+        lrs = np.asarray(
+            [self._lr(step0 + i) if callable(self._lr) else self._lr
+             for i in range(n_steps)], dtype=np.float32)
+        params, aux, opt, outs = self._multi_fns[key](
+            state["params"], state["aux"], state["opt"], batch,
+            lrs, np.int32(step0))
+        return ({"params": params, "aux": aux, "opt": opt,
+                 "step": step0 + n_steps}, outs)
 
     def step(self, state, batch):
         """Run one training step; returns (new_state, outputs).

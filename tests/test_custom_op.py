@@ -1,0 +1,144 @@
+"""Custom op framework + autograd.Function + higher-order grad tests
+(reference: tests/python/unittest/test_operator.py:test_custom_op and
+test_autograd.py higher-order patterns)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+@mx.operator.register("test_sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+@mx.operator.register("test_scaled_add")
+class ScaledAddProp(mx.operator.CustomOpProp):
+    """Two-input op with a constructor kwarg (tests the config plumbing)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        prop = self
+
+        class ScaledAdd(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            in_data[0] + prop.scale * in_data[1])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0])
+                self.assign(in_grad[1], req[1], prop.scale * out_grad[0])
+
+        return ScaledAdd()
+
+
+def test_custom_op_forward_backward_nd():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = mx.nd.Custom(x, op_type="test_sqr")
+    np.testing.assert_allclose(y.asnumpy(), [[1, 4], [9, 16]])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="test_sqr")
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[2, 4], [6, 8]])
+
+
+def test_custom_op_symbolic():
+    data = mx.sym.Variable("data")
+    s = mx.sym.Custom(data, op_type="test_sqr", name="sqr")
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    exe = s.bind(mx.cpu(), args={"data": x},
+                 args_grad={"data": mx.nd.zeros((2, 2))})
+    out = exe.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), [[1, 4], [9, 16]])
+    exe.backward(mx.nd.ones((2, 2)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               [[2, 4], [6, 8]])
+
+
+def test_custom_op_kwargs_and_two_inputs():
+    a = mx.nd.array(np.ones((2, 3), np.float32))
+    b = mx.nd.array(np.full((2, 3), 2.0, np.float32))
+    out = mx.nd.Custom(a, b, op_type="test_scaled_add", scale=3.0)
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = mx.nd.Custom(a, b, op_type="test_scaled_add", scale=3.0)
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 1.0)
+    np.testing.assert_allclose(b.grad.asnumpy(), 3.0)
+
+
+def test_autograd_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array(np.random.RandomState(0).uniform(-2, 2, (5,)))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    xs = x.asnumpy()
+    expect = 1 / (1 + np.exp(-xs)) * (1 - 1 / (1 + np.exp(-xs)))
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_higher_order_grad():
+    """d²/dx² of x³ = 6x via create_graph (reference: imperative.cc:361)."""
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (dy_dx,) = autograd.grad(y, [x], create_graph=True)
+        # first derivative checked inside the recorded scope
+    np.testing.assert_allclose(dy_dx.asnumpy(), 3 * x.asnumpy() ** 2,
+                               rtol=1e-5)
+    dy_dx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(), rtol=1e-5)
+
+
+def test_higher_order_grad_chain():
+    """grad of (grad(f)·v) — the Hessian-vector pattern."""
+    x = mx.nd.array(np.array([0.5, -1.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(x) * x
+        (g,) = autograd.grad(y, [x], create_graph=True)
+        s = g * mx.nd.array(np.array([1.0, 2.0], np.float32))
+    s.backward()
+    xs = x.asnumpy()
+    # f = x e^x; f' = (1+x)e^x; f'' = (2+x)e^x; grad(s) = v * f''
+    expect = np.array([1.0, 2.0]) * (2 + xs) * np.exp(xs)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-4)

@@ -1,0 +1,88 @@
+"""Distributed KVStore fake-cluster test — the reference's
+tests/nightly/dist_sync_kvstore.py pattern: N local processes (here wired by
+jax.distributed over the CPU backend instead of ps-lite ZMQ), asserting
+dist_sync push/pull semantics and sync-SGD parity with single-process."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from launch import launch_local  # noqa: E402
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %(repo)r)
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == %(n)d, (rank, nw)
+    shape = (3, 2)
+
+    # push/pull: sum across workers (dist_sync accumulate semantics)
+    kv.init("w", mx.nd.ones(shape))
+    kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = sum(r + 1 for r in range(nw))
+    assert np.allclose(out.asnumpy(), expect), (rank, out.asnumpy())
+
+    # updater path: sync-SGD parity with the single-process result
+    kv2 = mx.kv.create("dist_sync")
+    kv2.init("p", mx.nd.ones(shape))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, rescale_grad=1.0)
+    kv2.set_optimizer(opt)
+    kv2.push("p", mx.nd.ones(shape) * (rank + 1))
+    got = mx.nd.zeros(shape)
+    kv2.pull("p", out=got)
+    # merged grad = sum(rank+1); sgd: w - lr*merged
+    expect_w = 1.0 - 0.1 * expect
+    assert np.allclose(got.asnumpy(), expect_w, atol=1e-6), (
+        rank, got.asnumpy(), expect_w)
+
+    kv._barrier()
+    print("WORKER_OK", rank)
+""")
+
+
+@pytest.mark.parametrize("n", [2])
+def test_dist_sync_fake_cluster(n):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    script = _WORKER % {"repo": repo, "n": n}
+    procs = launch_local(n, [sys.executable, "-c", script])
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outputs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (i, out)
+        assert "WORKER_OK" in out
+
+
+def test_dist_async_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("dist_async")
+
+
+def test_gradient_compression_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
+def test_dist_without_launcher_raises():
+    env_backup = {k: os.environ.pop(k) for k in
+                  ("MXTPU_COORDINATOR", "MXTPU_NUM_WORKERS",
+                   "MXTPU_WORKER_ID") if k in os.environ}
+    try:
+        with pytest.raises(mx.MXNetError):
+            mx.kv.create("dist_sync")
+    finally:
+        os.environ.update(env_backup)

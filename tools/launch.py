@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Launch a distributed training job as N local worker processes.
+
+Reference: tools/launch.py (dmlc tracker: spawns scheduler/servers/workers
+with DMLC_ROLE env). The TPU build is allreduce-based — no separate server
+role — so the launcher spawns ``-n`` identical workers wired together via
+jax.distributed (MXTPU_COORDINATOR / MXTPU_NUM_WORKERS / MXTPU_WORKER_ID,
+consumed by mxnet_tpu.kvstore._ensure_distributed). ``--launcher local``
+is the reference's fake-cluster test mode (tests/nightly/dist_sync_kvstore
+pattern: N processes on localhost).
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def clean_env(base=None):
+    """Strip single-chip tunnel variables that would hijack worker processes
+    (TPU cluster auto-detection overrides explicit jax.distributed args)."""
+    env = dict(base if base is not None else os.environ)
+    for k in list(env):
+        if k.startswith(("TPU_", "PALLAS_", "AXON_")):
+            env.pop(k)
+    pythonpath = env.get("PYTHONPATH", "")
+    parts = [p for p in pythonpath.split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
+def launch_local(n, command, env_extra=None, platform="cpu"):
+    """Spawn n local worker processes; returns the Popen list."""
+    port = _free_port()
+    procs = []
+    for i in range(n):
+        env = clean_env()
+        env.update(env_extra or {})
+        env["JAX_PLATFORMS"] = platform
+        env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % port
+        env["MXTPU_NUM_WORKERS"] = str(n)
+        env["MXTPU_WORKER_ID"] = str(i)
+        procs.append(subprocess.Popen(
+            command, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    return procs
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=["local"], default="local",
+                        help="only 'local' (fake cluster); multi-host "
+                             "launches use the cluster scheduler's own "
+                             "process manager + jax.distributed auto-init")
+    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    procs = launch_local(args.num_workers, args.command,
+                         platform=args.platform)
+    rc = 0
+    for i, p in enumerate(procs):
+        out, _ = p.communicate()
+        sys.stdout.write("---- worker %d (rc=%d) ----\n%s\n"
+                         % (i, p.returncode, out.decode()))
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
