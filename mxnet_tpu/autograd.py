@@ -259,7 +259,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
                 rsp.copyto(arr.grad)
                 continue
             g = g.densify()
-        if req == "add":
+        from .ndarray.sparse import BaseSparseNDArray
+
+        if isinstance(arr.grad, BaseSparseNDArray):
+            # dense cotangent into a sparse grad buffer: cast through the
+            # buffer's storage type instead of corrupting _data/_aux
+            # (reference keeps stype through dispatch,
+            # src/operator/tensor/cast_storage-inl.h)
+            from .ndarray.ndarray import _from_data
+            from .ndarray.sparse import cast_storage
+
+            dense = _from_data(g.astype(arr.grad.dtype), arr.grad.context)
+            if req == "add":
+                dense = _from_data(
+                    arr.grad._to_dense_raw() + dense._data, arr.grad.context)
+            cast_storage(dense, arr.grad.stype).copyto(arr.grad)
+        elif req == "add":
             arr.grad._set_data(arr.grad._data + g.astype(arr.grad._data.dtype))
         else:  # write
             arr.grad._set_data(g.astype(arr.grad._data.dtype))

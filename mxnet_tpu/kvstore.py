@@ -138,6 +138,15 @@ class KVStore:
             if self._dist and self.num_workers > 1:
                 merged = self._global_reduce(merged)
             if self._updater is not None:
+                from .ndarray.sparse import BaseSparseNDArray
+
+                if isinstance(self._data[k], BaseSparseNDArray):
+                    # the updater's lazy-row path indexes the weight by
+                    # absolute row id, which is only valid for dense
+                    # storage — densify the stored value first (reference
+                    # servers keep dense weights too,
+                    # kvstore_dist_server.h DataHandleDefault)
+                    self._data[k] = self._data[k]._dense_nd()
                 self._updater(_updater_key(k), merged, self._data[k])
             else:
                 # reference semantics: push REPLACES the stored value with the
@@ -153,7 +162,16 @@ class KVStore:
                 raise MXNetError("key %r has not been initialized" % (k,))
             src = self._data[k]
             for o in olist:
-                src.copyto(o)
+                from .ndarray.sparse import BaseSparseNDArray, cast_storage
+
+                if isinstance(o, BaseSparseNDArray) and \
+                        not isinstance(src, BaseSparseNDArray):
+                    # dense stored value into a sparse out needs a storage
+                    # cast; raw copyto would write dense _data under stale
+                    # sparse _aux indices
+                    cast_storage(src, o.stype).copyto(o)
+                else:
+                    src.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows as row_sparse (reference:
@@ -185,9 +203,22 @@ class KVStore:
                 if isinstance(src, RowSparseNDArray):
                     res = sparse_retain(src, want)
                 else:
-                    rows = src.asnumpy()[want]
-                    res = row_sparse_array((rows, want), shape=src.shape,
-                                           ctx=src.context)
+                    # device-side gather of just the requested rows — no
+                    # full-table D2H (the dist analog pulls per-row keys,
+                    # kvstore_dist.h:258); `want` is sorted/unique already
+                    import jax.numpy as _jnp
+
+                    from .ndarray.sparse import _sparse_new
+
+                    if len(want) and (want[0] < 0 or
+                                      want[-1] >= src.shape[0]):
+                        raise MXNetError(
+                            "row_ids out of range for key %r: [%d, %d] vs "
+                            "%d rows" % (k, want[0], want[-1], src.shape[0]))
+                    rows = src._data[_jnp.asarray(want)]
+                    res = _sparse_new(RowSparseNDArray, rows,
+                                      (_jnp.asarray(want),), src.shape,
+                                      src.context)
                 if isinstance(o, BaseSparseNDArray):
                     res.copyto(o)
                 else:

@@ -119,3 +119,29 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+def convert_conv_weight_layout(weight, direction="ref_to_tpu"):
+    """Exchange channels-last Convolution weights with reference NHWC graphs.
+
+    The reference's ``layout='NHWC'`` Convolution keeps weights as
+    (num_filter, kernel..., C/group) while this framework stores channels-last
+    weights spatial-major as HWIO (kernel..., C/group, num_filter) so the
+    contraction feeds the MXU without a transpose. ``direction`` is
+    ``'ref_to_tpu'`` or ``'tpu_to_ref'``.
+    """
+    import numpy as np
+
+    from .ndarray import array as _nd_array
+
+    a = weight.asnumpy() if hasattr(weight, "asnumpy") else np.asarray(weight)
+    if a.ndim < 3:
+        raise ValueError("conv weight must be at least 3-d, got %s" % (a.shape,))
+    if direction == "ref_to_tpu":      # (O, spatial..., I) → (spatial..., I, O)
+        perm = tuple(range(1, a.ndim)) + (0,)
+    elif direction == "tpu_to_ref":    # (spatial..., I, O) → (O, spatial..., I)
+        perm = (a.ndim - 1,) + tuple(range(a.ndim - 1))
+    else:
+        raise ValueError("direction must be 'ref_to_tpu' or 'tpu_to_ref'")
+    out = np.ascontiguousarray(a.transpose(perm))
+    return _nd_array(out) if hasattr(weight, "asnumpy") else out

@@ -534,8 +534,21 @@ def _grad_rows(grad, rescale_grad, clip_gradient):
     return grad._aux[0], g
 
 
+def _check_dense_weight(weight):
+    # the row updates below index weight._data by absolute row id, which is
+    # only valid for default (dense) storage; a RowSparseNDArray weight's
+    # _data is the packed nonzero-row block, so absolute ids would hit the
+    # wrong rows (or out of bounds)
+    if isinstance(weight, BaseSparseNDArray):
+        raise MXNetError(
+            "sparse optimizer updates require a dense (default-storage) "
+            "weight; got stype=%r — densify the stored value first"
+            % weight.stype)
+
+
 def sgd_update_rsp(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                    clip_gradient=None):
+    _check_dense_weight(weight)
     idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
     w = weight._data
     rows = w[idx]
@@ -545,6 +558,7 @@ def sgd_update_rsp(weight, grad, lr, wd=0.0, rescale_grad=1.0,
 
 def sgd_mom_update_rsp(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                        rescale_grad=1.0, clip_gradient=None):
+    _check_dense_weight(weight)
     idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
     w, m = weight._data, mom._data
     w_rows, m_rows = w[idx], m[idx]
@@ -553,10 +567,34 @@ def sgd_mom_update_rsp(weight, grad, mom, lr, momentum=0.0, wd=0.0,
     weight._set_data(w.at[idx].set(w_rows + m_rows))
 
 
+def mp_sgd_update_rsp(weight, grad, mom, master, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=None):
+    """Multi-precision lazy SGD on row_sparse gradients: the fp32 master
+    copy's touched rows are updated (with momentum when ``mom`` is given)
+    and cast back into the low-precision weight (reference:
+    src/operator/optimizer_op.cc MP_SGDMomUpdateRspImpl)."""
+    _check_dense_weight(weight)
+    idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
+    w32 = master._data
+    w_rows = w32[idx]
+    step = g.astype(w32.dtype) + wd * w_rows
+    if mom is not None:
+        m = mom._data
+        m_rows = momentum * m[idx] - lr * step
+        mom._set_data(m.at[idx].set(m_rows))
+        w_rows = w_rows + m_rows
+    else:
+        w_rows = w_rows - lr * step
+    master._set_data(w32.at[idx].set(w_rows))
+    weight._set_data(
+        weight._data.at[idx].set(w_rows.astype(weight.dtype)))
+
+
 def adam_update_rsp(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                     epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                     clip_gradient=None):
     jnp = _jnp()
+    _check_dense_weight(weight)
     idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
     w = weight._data
     w_rows = w[idx]
@@ -572,6 +610,7 @@ def adam_update_rsp(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
 def ftrl_update_rsp(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=None):
     jnp = _jnp()
+    _check_dense_weight(weight)
     idx, g = _grad_rows(grad, rescale_grad, clip_gradient)
     w = weight._data
     g = g.astype(w.dtype)

@@ -153,7 +153,12 @@ def _register_conv():
         input_names=lambda attrs: ["data", "weight"] + ([] if attrs.no_bias else ["bias"]),
         infer_shape=conv_infer,
         doc="N-d convolution → XLA ConvGeneralDilated on the MXU (reference: "
-            "src/operator/convolution-inl.h; cudnn_* params accepted and ignored)")
+            "src/operator/convolution-inl.h; cudnn_* params accepted and "
+            "ignored). LAYOUT DEVIATION: with a channels-last layout (NHWC/"
+            "NDHWC) weights are spatial-major HWIO (kernel..., C/group, "
+            "num_filter), not the reference's (num_filter, kernel..., C) — "
+            "use mxnet_tpu.model.convert_conv_weight_layout to exchange "
+            "checkpoints with reference NHWC graphs")
 
     def deconvolution(attrs, data, weight, *rest):
         nd = len(attrs.kernel)
@@ -383,17 +388,26 @@ def _register_act():
 # --- BatchNorm --------------------------------------------------------------
 
 def _register_bn():
+    import jax.lax
+
     jnp = _jnp()
+    jax_rsqrt = jax.lax.rsqrt
 
     def batch_norm(attrs, data, gamma, beta, aux=(), is_train=False):
+        # statistics and normalization run in fp32 regardless of activation
+        # dtype (bf16 batch stats lose precision; fp32 moving stats would
+        # otherwise promote the whole downstream graph to fp32 in eval
+        # mode); the output is cast back so convs stay on the bf16 MXU
+        # path. XLA fuses the up/down casts into the elementwise chain.
         moving_mean, moving_var = aux
         ax = attrs.axis
         red_axes = tuple(i for i in range(data.ndim) if i != ax)
         bshape = tuple(-1 if i == ax else 1 for i in range(data.ndim))
         g = jnp.ones_like(gamma) if attrs.fix_gamma else gamma
+        x32 = data.astype(jnp.float32)
         if is_train and not attrs.use_global_stats:
-            mean = jnp.mean(data, axis=red_axes)
-            var = jnp.var(data, axis=red_axes)
+            mean = jnp.mean(x32, axis=red_axes)
+            var = jnp.var(x32, axis=red_axes)
             import jax
 
             m = attrs.momentum
@@ -403,9 +417,14 @@ def _register_bn():
         else:
             mean, var = moving_mean, moving_var
             new_aux = (moving_mean, moving_var)
-        out = (data - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + attrs.eps)
-        out = out * g.reshape(bshape) + beta.reshape(bshape)
+        out = (x32 - mean.reshape(bshape)) * jax_rsqrt(
+            var.reshape(bshape) + attrs.eps)
+        out = out * g.astype(jnp.float32).reshape(bshape) \
+            + beta.astype(jnp.float32).reshape(bshape)
+        out = out.astype(data.dtype)
         if attrs.output_mean_var:
+            # mean/var outputs stay fp32 (reference AccReal semantics,
+            # batch_norm-inl.h) even for low-precision activations
             return (out, mean, var), new_aux
         return (out,), new_aux
 

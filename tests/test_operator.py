@@ -300,3 +300,22 @@ def test_make_loss_grad():
     exe.forward(is_train=True)
     exe.backward()
     assert_almost_equal(exe.grad_dict["data"], 2 * x, rtol=1e-4)
+
+
+def test_batchnorm_eval_keeps_dtype():
+    # eval-mode BN must not promote bf16 activations to fp32 via the fp32
+    # moving stats (that silently turned every downstream conv into fp32)
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    opdef = get_op("BatchNorm")
+    attrs = opdef.parse_attrs({"fix_gamma": "False", "eps": 1e-5})
+    x = jnp.ones((2, 3, 4, 4), jnp.bfloat16)
+    gamma = jnp.ones((3,), jnp.bfloat16)
+    beta = jnp.zeros((3,), jnp.bfloat16)
+    aux = (jnp.zeros((3,), jnp.float32), jnp.ones((3,), jnp.float32))
+    (out,), _ = opdef.fn(attrs, x, gamma, beta, aux=aux, is_train=False)
+    assert out.dtype == jnp.bfloat16
+    (out_t,), _ = opdef.fn(attrs, x, gamma, beta, aux=aux, is_train=True)
+    assert out_t.dtype == jnp.bfloat16
