@@ -104,6 +104,14 @@ def main():
     if peak:
         result["mfu_bs32"] = round(img_s_32 * FLOPS_PER_IMG / peak, 4)
         result["mfu_capability"] = round(img_s_big * FLOPS_PER_IMG / peak, 4)
+        # measured ceilings for this chip (PERF_NOTES.md): 8192^3 bf16
+        # matmul sustains 128.6 TF/s (65% of spec) and bf16 HBM streams
+        # 442 GB/s (54% of spec); ResNet-50 at ~82 flops/byte is
+        # bandwidth-bound on this part, roofline ~2950 img/s
+        result["mfu_vs_measured_matmul_peak"] = round(
+            max(img_s_32, img_s_big) * FLOPS_PER_IMG / 128.6e12, 4)
+        result["roofline_img_per_sec"] = 2950
+        result["vs_roofline"] = round(max(img_s_32, img_s_big) / 2950.0, 3)
     print(json.dumps(result))
 
 

@@ -1,0 +1,63 @@
+"""Runtime flag surface (reference: docs/faq/env_var.md — the MXNET_* env
+layer; dmlc::GetEnv call sites e.g. src/executor/graph_executor.cc:282).
+
+The reference reads ``MXNET_*`` environment variables at points of use; this
+module is the equivalent single place to look flags up. Flags are read from
+the environment on first access and can be overridden programmatically with
+:func:`set_flag` (tests use this).
+
+Flags currently honored:
+
+``MXNET_CONV_SPACE_TO_DEPTH`` (default 1)
+    Rewrite stride-2 channels-last stem convolutions with few input
+    channels (e.g. ResNet's 7x7/2 on RGB) into a space-to-depth conv so
+    the contraction feeds the MXU's 128 lanes instead of wasting them on
+    a 3-channel input. Purely an implementation rewrite — weight shapes
+    and numerics (up to bf16 rounding) are unchanged.
+
+``MXNET_BACKWARD_DO_MIRROR`` (default 0)
+    Recompute-instead-of-store for backward (reference:
+    graph_executor.cc:282-296): wraps the forward in ``jax.checkpoint``
+    so activations are rematerialized in backward, trading FLOPs for
+    HBM footprint.
+
+``MXNET_EXEC_DISABLE_JIT`` (default 0)
+    Debug switch: run graph programs eagerly (op-by-op) instead of one
+    compiled XLA program — the analog of MXNET_ENGINE_TYPE=NaiveEngine
+    for hunting numeric/tracing bugs.
+"""
+import os
+
+__all__ = ["get_flag", "set_flag", "flag_doc"]
+
+_overrides = {}
+
+_DEFAULTS = {
+    "MXNET_CONV_SPACE_TO_DEPTH": 1,
+    "MXNET_BACKWARD_DO_MIRROR": 0,
+    "MXNET_EXEC_DISABLE_JIT": 0,
+}
+
+
+def get_flag(name, default=None):
+    """Integer-valued flag: override > environment > default."""
+    if name in _overrides:
+        return _overrides[name]
+    if default is None:
+        default = _DEFAULTS.get(name, 0)
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def set_flag(name, value):
+    """Programmatic override (set to None to clear)."""
+    if value is None:
+        _overrides.pop(name, None)
+    else:
+        _overrides[name] = int(value)
+
+
+def flag_doc():
+    return __doc__

@@ -162,16 +162,7 @@ class KVStore:
                 raise MXNetError("key %r has not been initialized" % (k,))
             src = self._data[k]
             for o in olist:
-                from .ndarray.sparse import BaseSparseNDArray, cast_storage
-
-                if isinstance(o, BaseSparseNDArray) and \
-                        not isinstance(src, BaseSparseNDArray):
-                    # dense stored value into a sparse out needs a storage
-                    # cast; raw copyto would write dense _data under stale
-                    # sparse _aux indices
-                    cast_storage(src, o.stype).copyto(o)
-                else:
-                    src.copyto(o)
+                src.copyto(o)  # NDArray.copyto casts storage when needed
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows as row_sparse (reference:
@@ -200,6 +191,11 @@ class KVStore:
                 want = _np.unique(_np.asarray(
                     rid.asnumpy() if isinstance(rid, NDArray) else rid,
                     dtype=_np.int64).reshape(-1))
+                if len(want) and (want[0] < 0 or
+                                  want[-1] >= src.shape[0]):
+                    raise MXNetError(
+                        "row_ids out of range for key %r: [%d, %d] vs "
+                        "%d rows" % (k, want[0], want[-1], src.shape[0]))
                 if isinstance(src, RowSparseNDArray):
                     res = sparse_retain(src, want)
                 else:
@@ -210,11 +206,6 @@ class KVStore:
 
                     from .ndarray.sparse import _sparse_new
 
-                    if len(want) and (want[0] < 0 or
-                                      want[-1] >= src.shape[0]):
-                        raise MXNetError(
-                            "row_ids out of range for key %r: [%d, %d] vs "
-                            "%d rows" % (k, want[0], want[-1], src.shape[0]))
                     rows = src._data[_jnp.asarray(want)]
                     res = _sparse_new(RowSparseNDArray, rows,
                                       (_jnp.asarray(want),), src.shape,

@@ -132,6 +132,17 @@ class NDArray:
         if isinstance(other, NDArray):
             if other is self:
                 return other
+            from .sparse import BaseSparseNDArray, cast_storage
+
+            if isinstance(other, BaseSparseNDArray) and \
+                    not isinstance(self, BaseSparseNDArray):
+                # dense into sparse storage requires a cast; a raw _set_data
+                # would leave stale aux indices under a full dense values
+                # tensor (reference: CastStorageDispatch, common/utils.h)
+                src = self.astype(other.dtype) \
+                    if self.dtype != other.dtype else self
+                cast_storage(src, other.stype).copyto(other)
+                return other
             other._set_data(
                 jax.device_put(self._data, other.context.jax_device()).astype(
                     other._data.dtype

@@ -97,20 +97,63 @@ def _register_conv():
 
     jnp = _jnp()
 
+    def _s2d_conv(data, weight, kernel, pad):
+        """Space-to-depth rewrite of a 2-d stride-2 channels-last conv with
+        few input channels (the classic ResNet 7x7/2 RGB stem): a C-channel
+        input wastes 125 of the MXU's 128 lanes, and — worse — when the
+        input itself needs a gradient (e.g. a learnable BatchNorm on raw
+        data, as in the reference resnet symbol) the dgrad runs at full
+        224x224 resolution with 3 output features. Folding each 2x2 spatial
+        phase into channels quarters the spatial extent and 4x's the
+        contraction depth; the weight is reshaped in-graph so the logical
+        (kH, kW, C, F) parameter (and its gradient) is unchanged.
+        """
+        N, H, W, C = data.shape
+        kh, kw = kernel
+        ph, pw = pad
+        K2h, K2w = (kh + 1) // 2, (kw + 1) // 2
+        out_h = (H + 2 * ph - kh) // 2 + 1
+        out_w = (W + 2 * pw - kw) // 2 + 1
+        Yh, Yw = out_h + K2h - 1, out_w + K2w - 1
+        if 2 * Yh - H - ph < 0 or 2 * Yw - W - pw < 0:
+            return None  # degenerate extent; caller falls back
+        x = jnp.pad(data, ((0, 0), (ph, 2 * Yh - H - ph),
+                           (pw, 2 * Yw - W - pw), (0, 0)))
+        x = x.reshape(N, Yh, 2, Yw, 2, C).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(N, Yh, Yw, 4 * C)
+        w = jnp.pad(weight, ((0, 2 * K2h - kh), (0, 2 * K2w - kw),
+                             (0, 0), (0, 0)))
+        F = w.shape[-1]
+        w = w.reshape(K2h, 2, K2w, 2, C, F).transpose(0, 2, 1, 3, 4, 5)
+        w = w.reshape(K2h, K2w, 4 * C, F)
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
     def convolution(attrs, data, weight, *rest):
         nd = len(attrs.kernel)
         stride = attrs.stride or (1,) * nd
         dilate = attrs.dilate or (1,) * nd
         pad = attrs.pad or (0,) * nd
         channels_last = _is_channels_last(attrs)
-        out = jax.lax.conv_general_dilated(
-            data, weight,
-            window_strides=stride,
-            padding=[(p, p) for p in pad],
-            rhs_dilation=dilate,
-            dimension_numbers=_conv_dims(nd, attrs.layout),
-            feature_group_count=attrs.num_group,
-        )
+        from ..config import get_flag
+
+        if (channels_last and nd == 2 and tuple(stride) == (2, 2)
+                and tuple(dilate) == (1, 1) and attrs.num_group == 1
+                and data.shape[-1] <= 4 and min(attrs.kernel) >= 2
+                and get_flag("MXNET_CONV_SPACE_TO_DEPTH")):
+            out = _s2d_conv(data, weight, tuple(attrs.kernel), tuple(pad))
+        else:
+            out = None
+        if out is None:
+            out = jax.lax.conv_general_dilated(
+                data, weight,
+                window_strides=stride,
+                padding=[(p, p) for p in pad],
+                rhs_dilation=dilate,
+                dimension_numbers=_conv_dims(nd, attrs.layout),
+                feature_group_count=attrs.num_group,
+            )
         if not attrs.no_bias:
             bshape = ((1,) * (nd + 1) + (-1,)) if channels_last \
                 else ((1, -1) + (1,) * nd)
@@ -393,38 +436,101 @@ def _register_bn():
     jnp = _jnp()
     jax_rsqrt = jax.lax.rsqrt
 
+    @functools.lru_cache(maxsize=None)
+    def _bn_train_core(ndim, ax, eps, fix_gamma):
+        """Training-mode BN as a custom vjp with the minimum HBM traffic:
+        forward = one fused stats pass (sum, sum-of-squares) + one
+        normalize pass; backward = one fused reduce pass (dbeta, dgamma)
+        + one elementwise pass with the closed-form input gradient.
+        jax's autodiff of the naive formula materializes several extra
+        full-tensor passes (measured ~2.5x slower on TPU at ResNet sizes).
+        Statistics accumulate in fp32 for any activation dtype (the
+        reference's AccReal, batch_norm-inl.h)."""
+        import jax
+
+        red_axes = tuple(i for i in range(ndim) if i != ax)
+        bshape = tuple(-1 if i == ax else 1 for i in range(ndim))
+
+        def stats(x32):
+            s1 = jnp.sum(x32, axis=red_axes)
+            s2 = jnp.sum(x32 * x32, axis=red_axes)
+            n = np.prod([1] + [jnp.shape(x32)[i] for i in red_axes])
+            mean = s1 / n
+            var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            return mean, var, float(n)
+
+        @jax.custom_vjp
+        def core(x, gamma, beta):
+            x32 = x.astype(jnp.float32)
+            mean, var, _ = stats(x32)
+            ivar = jax_rsqrt(var + eps)
+            g32 = (jnp.ones_like(gamma) if fix_gamma else gamma).astype(
+                jnp.float32)
+            out = (x32 - mean.reshape(bshape)) * ivar.reshape(bshape) \
+                * g32.reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
+            return out.astype(x.dtype), mean, var
+
+        def core_fwd(x, gamma, beta):
+            outs = core(x, gamma, beta)
+            _, mean, var = outs
+            ivar = jax_rsqrt(var + eps)
+            return outs, (x, gamma, mean, ivar)
+
+        def core_bwd(res, cots):
+            # mean/var cotangents are dropped, matching the reference's
+            # BNBackward which differentiates only through the out entry
+            x, gamma, mean, ivar = res
+            go = cots[0].astype(jnp.float32)
+            x32 = x.astype(jnp.float32)
+            xhat = (x32 - mean.reshape(bshape)) * ivar.reshape(bshape)
+            dbeta = jnp.sum(go, axis=red_axes)
+            dgamma = jnp.sum(go * xhat, axis=red_axes)
+            n = np.prod([1] + [jnp.shape(x)[i] for i in red_axes])
+            g32 = (jnp.ones_like(gamma) if fix_gamma else gamma).astype(
+                jnp.float32)
+            dx = (g32.reshape(bshape) * ivar.reshape(bshape)
+                  * (go - (dbeta.reshape(bshape)
+                           + xhat * dgamma.reshape(bshape)) / n)
+                  ).astype(x.dtype)
+            dgamma_out = (jnp.zeros_like(dgamma) if fix_gamma
+                          else dgamma).astype(gamma.dtype)
+            return dx, dgamma_out, dbeta.astype(gamma.dtype)
+
+        core.defvjp(core_fwd, core_bwd)
+        return core
+
     def batch_norm(attrs, data, gamma, beta, aux=(), is_train=False):
-        # statistics and normalization run in fp32 regardless of activation
-        # dtype (bf16 batch stats lose precision; fp32 moving stats would
-        # otherwise promote the whole downstream graph to fp32 in eval
-        # mode); the output is cast back so convs stay on the bf16 MXU
-        # path. XLA fuses the up/down casts into the elementwise chain.
+        # fp32 statistics with the output cast back to the activation
+        # dtype: bf16 stats lose precision, and fp32 moving stats would
+        # otherwise promote the whole downstream graph to fp32 in eval.
         moving_mean, moving_var = aux
-        ax = attrs.axis
-        red_axes = tuple(i for i in range(data.ndim) if i != ax)
+        ax = attrs.axis if attrs.axis >= 0 else data.ndim + attrs.axis
         bshape = tuple(-1 if i == ax else 1 for i in range(data.ndim))
-        g = jnp.ones_like(gamma) if attrs.fix_gamma else gamma
-        x32 = data.astype(jnp.float32)
         if is_train and not attrs.use_global_stats:
-            mean = jnp.mean(x32, axis=red_axes)
-            var = jnp.var(x32, axis=red_axes)
             import jax
 
+            core = _bn_train_core(data.ndim, ax, attrs.eps,
+                                  bool(attrs.fix_gamma))
+            out, mean, var = core(data, gamma, beta)
             m = attrs.momentum
             new_mean = m * moving_mean + (1 - m) * jax.lax.stop_gradient(mean)
             new_var = m * moving_var + (1 - m) * jax.lax.stop_gradient(var)
-            new_aux = (new_mean, new_var)
+            # preserve the caller's moving-stat dtype (a cast('bfloat16')
+            # net must not silently re-promote its aux to fp32)
+            new_aux = (new_mean.astype(moving_mean.dtype),
+                       new_var.astype(moving_var.dtype))
         else:
             mean, var = moving_mean, moving_var
             new_aux = (moving_mean, moving_var)
-        out = (x32 - mean.reshape(bshape)) * jax_rsqrt(
-            var.reshape(bshape) + attrs.eps)
-        out = out * g.astype(jnp.float32).reshape(bshape) \
-            + beta.astype(jnp.float32).reshape(bshape)
-        out = out.astype(data.dtype)
+            g = jnp.ones_like(gamma) if attrs.fix_gamma else gamma
+            x32 = data.astype(jnp.float32)
+            out = (x32 - mean.reshape(bshape)) * jax_rsqrt(
+                var.reshape(bshape) + attrs.eps)
+            out = out * g.astype(jnp.float32).reshape(bshape) \
+                + beta.astype(jnp.float32).reshape(bshape)
+            out = out.astype(data.dtype)
         if attrs.output_mean_var:
-            # mean/var outputs stay fp32 (reference AccReal semantics,
-            # batch_norm-inl.h) even for low-precision activations
+            # mean/var outputs stay fp32 (reference AccReal semantics)
             return (out, mean, var), new_aux
         return (out,), new_aux
 

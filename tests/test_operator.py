@@ -319,3 +319,30 @@ def test_batchnorm_eval_keeps_dtype():
     assert out.dtype == jnp.bfloat16
     (out_t,), _ = opdef.fn(attrs, x, gamma, beta, aux=aux, is_train=True)
     assert out_t.dtype == jnp.bfloat16
+
+
+def test_conv_space_to_depth_parity():
+    # the s2d stem rewrite (MXNET_CONV_SPACE_TO_DEPTH) must be numerically
+    # identical to the direct convolution for every eligible geometry
+    import jax.numpy as jnp
+
+    from mxnet_tpu import config
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op("Convolution")
+    rng = np.random.RandomState(0)
+    for (k, p, H, C) in [((7, 7), (3, 3), 32, 3), ((3, 3), (1, 1), 16, 3),
+                         ((5, 5), (2, 2), 20, 4)]:
+        attrs = op.parse_attrs({"kernel": str(k), "stride": "(2,2)",
+                                "pad": str(p), "num_filter": "8",
+                                "no_bias": "True", "layout": "NHWC"})
+        x = jnp.asarray(rng.randn(2, H, H, C).astype(np.float32))
+        w = jnp.asarray(rng.randn(k[0], k[1], C, 8).astype(np.float32))
+        config.set_flag("MXNET_CONV_SPACE_TO_DEPTH", 1)
+        y1 = op.fn(attrs, x, w)
+        config.set_flag("MXNET_CONV_SPACE_TO_DEPTH", 0)
+        y0 = op.fn(attrs, x, w)
+        config.set_flag("MXNET_CONV_SPACE_TO_DEPTH", None)
+        assert y1.shape == y0.shape
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-4, atol=1e-4)
