@@ -37,6 +37,9 @@ from . import lr_scheduler
 from . import metric
 from . import callback
 from . import io
+from . import recordio
+from . import image
+from . import config
 from . import kvstore as kv
 from . import kvstore
 from . import model

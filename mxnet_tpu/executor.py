@@ -25,6 +25,34 @@ import numpy as np
 from .base import MXNetError
 from .context import Context
 
+
+def _maybe_jit(f):
+    """jax.jit unless MXNET_EXEC_DISABLE_JIT is set — the debug analog of
+    MXNET_ENGINE_TYPE=NaiveEngine (reference: src/engine/naive_engine.cc:36,
+    the serial engine the threaded engine's own error message recommends
+    for bug hunts)."""
+    import jax
+
+    from .config import get_flag
+
+    if get_flag("MXNET_EXEC_DISABLE_JIT"):
+        return f
+    return jax.jit(f)
+
+
+def _maybe_mirror(loss_fn):
+    """Wrap the forward in jax.checkpoint when MXNET_BACKWARD_DO_MIRROR is
+    set: activations are rematerialized during backward instead of stored —
+    the reference's memory-mirroring pass (graph_executor.cc:282-296,
+    docs/faq/env_var.md MXNET_BACKWARD_DO_MIRROR) expressed as remat."""
+    import jax
+
+    from .config import get_flag
+
+    if get_flag("MXNET_BACKWARD_DO_MIRROR"):
+        return jax.checkpoint(loss_fn)
+    return loss_fn
+
 __all__ = ["Executor"]
 
 
@@ -131,7 +159,7 @@ class _GraphProgram:
                 outs, _ = self._eval(arg_d, aux_d, rngs, False)
                 return outs
 
-            self._jit_cache["infer"] = jax.jit(f)
+            self._jit_cache["infer"] = _maybe_jit(f)
         return self._jit_cache["infer"]
 
     def train_fn(self, grad_names):
@@ -147,11 +175,12 @@ class _GraphProgram:
                     outs, aux_upd = self._eval(merged, aux_d, rngs, True)
                     return tuple(outs), aux_upd
 
+                inner = _maybe_mirror(inner)
                 outs, vjp, aux_upd = jax.vjp(inner, grad_d, has_aux=True)
                 grads = vjp(tuple(seeds))[0]
                 return outs, aux_upd, grads
 
-            self._jit_cache[key] = jax.jit(f)
+            self._jit_cache[key] = _maybe_jit(f)
         return self._jit_cache[key]
 
 

@@ -332,7 +332,9 @@ class HybridBlock(Block):
                         tuple(aux_upd.get(n, aux_d[n])
                               for n in prog.aux_names))
 
-            jit_cache[is_train] = jax.jit(raw)
+            from ..executor import _maybe_jit
+
+            jit_cache[is_train] = _maybe_jit(raw)
         compiled = jit_cache[is_train]
 
         all_arrays = arrays + aux_arrays

@@ -1,0 +1,3 @@
+"""Image pipeline package (reference: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from . import image  # noqa: F401

@@ -1,0 +1,638 @@
+"""Host-side image pipeline: decode, resize/crop/color augmenters, and
+ImageIter (reference: python/mxnet/image/image.py:1244 — the pure-python
+pipeline over recordio/raw files; the C++ twin is
+src/io/iter_image_recordio_2.cc with src/io/image_aug_default.cc).
+
+Design: augmentation is host-side numpy/PIL work (the TPU analog of the
+reference's OpenCV-on-CPU decode threads); images flow as HWC numpy arrays
+(uint8 in, float32 after CastAug) and are batched to the device in one
+transfer per batch. Random state comes from module-level numpy RandomState
+seeded by mxnet_tpu.random.seed for reproducibility.
+"""
+import logging
+import numbers
+import os
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import io as _io
+from .. import ndarray as nd
+from .. import recordio
+
+__all__ = [
+    "imread", "imdecode", "imresize", "scale_down", "resize_short",
+    "fixed_crop", "random_crop", "center_crop", "random_size_crop",
+    "color_normalize",
+    "Augmenter", "SequentialAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+    "RandomOrderAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "HueJitterAug", "ColorJitterAug", "LightingAug",
+    "ColorNormalizeAug", "RandomGrayAug", "HorizontalFlipAug", "CastAug",
+    "CreateAugmenter", "ImageIter",
+]
+
+
+def _pil():
+    from PIL import Image
+
+    return Image
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an encoded image (JPEG/PNG bytes) to an HWC uint8 array
+    (reference: image.py:85 imdecode — cv2 there, PIL here; to_rgb matches
+    the reference's BGR→RGB conversion semantics: True yields RGB)."""
+    import io as _pyio
+
+    Image = _pil()
+    img = Image.open(_pyio.BytesIO(bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        return np.asarray(img)[:, :, None]
+    img = img.convert("RGB")
+    arr = np.asarray(img)
+    if not to_rgb:
+        arr = arr[:, :, ::-1]
+    return arr
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read and decode an image file (reference: image.py:44)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+_PIL_INTERP = {}
+
+
+def _interp_method(interp, sizes=()):
+    """Map the reference's cv2 interp codes (0 nearest, 1 bilinear,
+    2 area/box, 3 bicubic, 4 lanczos, 9 auto, 10 random) to PIL resamples
+    (reference: image.py:174 _get_interp_method)."""
+    Image = _pil()
+    table = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BOX,
+             3: Image.BICUBIC, 4: Image.LANCZOS}
+    if interp == 9:
+        if sizes:
+            oh, ow, nh, nw = sizes
+            interp = 1 if nh > oh and nw > ow else 3 if nh < oh and nw < ow else 2
+        else:
+            interp = 2
+    if interp == 10:
+        interp = pyrandom.randint(0, 4)
+    if interp not in table:
+        raise MXNetError("Unknown interp method %d" % interp)
+    return table[interp]
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (w, h) (reference: image.py imresize op)."""
+    Image = _pil()
+    arr = np.asarray(src)
+    dt = arr.dtype
+    im = Image.fromarray(arr.astype(np.uint8) if dt != np.uint8 else arr)
+    out = np.asarray(im.resize(
+        (w, h), _interp_method(interp, (arr.shape[0], arr.shape[1], h, w))))
+    return out.astype(dt) if dt != np.uint8 else out
+
+
+def scale_down(src_size, size):
+    """Scale requested crop down to fit the source (reference: image.py:139)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the SHORT edge becomes ``size`` (reference: image.py:229)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop a fixed region, optionally resizing (reference: image.py:291)."""
+    out = np.asarray(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of `size` (scaled down if needed); returns
+    (image, (x0, y0, w, h)) (reference: image.py:323)."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop (reference: image.py:362)."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop, the Inception-style augmentation
+    (reference: image.py:435)."""
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std channelwise (reference: image.py:411)."""
+    src = np.asarray(src, dtype=np.float32) - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+# --- augmenter classes (reference: image.py:482-883) ------------------------
+
+class Augmenter(object):
+    """Image augmentation base; ``dumps`` serializes for logging
+    (reference: image.py:482)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, np.ndarray):
+                kwargs[k] = v.tolist()
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Short-edge resize (reference: image.py:531)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Exact-size resize ignoring aspect (reference: image.py:551)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    """Apply sub-augmenters in random order (reference: image.py:639)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        order = list(self.ts)
+        pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return np.asarray(src, np.float32) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        src = np.asarray(src, np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray.mean() * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        src = np.asarray(src, np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference: image.py:729)."""
+
+    _yiq = np.array([[0.299, 0.587, 0.114],
+                     [0.596, -0.274, -0.321],
+                     [0.211, -0.523, 0.311]], np.float32)
+    _yiq_inv = np.array([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        src = np.asarray(src, np.float32)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      np.float32)
+        t = self._yiq_inv @ bt @ self._yiq
+        return src @ t.T
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference: image.py:786)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return np.asarray(src, np.float32) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            src = np.broadcast_to(
+                (np.asarray(src, np.float32) * self._coef).sum(
+                    axis=2, keepdims=True), src.shape)
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            src = np.asarray(src)[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return np.asarray(src, dtype=self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmenter list (reference: image.py:885) —
+    resize → crop → mirror → cast → color jitter → lighting → gray →
+    normalize, same ordering and defaults."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+        assert mean.shape[0] in (1, 3)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+        assert std.shape[0] in (1, 3)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec files or image lists with augmenters and
+    ``num_parts``/``part_index`` sharding (reference: image.py:999 ImageIter;
+    the distributed sharding mirrors iter_image_recordio_2.cc:78).
+
+    Yields DataBatch with data in NCHW float32 (``data_shape`` is CHW).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.path_root = path_root
+        self.dtype = dtype
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+
+        if path_imgrec:
+            if path_imgidx is None:
+                guess = os.path.splitext(path_imgrec)[0] + ".idx"
+                path_imgidx = guess if os.path.exists(guess) else None
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        if path_imglist:
+            imglist_d = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], dtype=np.float32)
+                    imglist_d[int(parts[0])] = (label, parts[-1])
+            self.imglist = imglist_d
+            self.seq = list(imglist_d.keys())
+        elif isinstance(imglist, list):
+            imglist_d = {}
+            for i, entry in enumerate(imglist):
+                label = np.array(entry[0], dtype=np.float32).reshape(-1)
+                imglist_d[i] = (label, entry[1])
+            self.imglist = imglist_d
+            self.seq = list(imglist_d.keys())
+
+        if num_parts > 1:
+            assert 0 <= part_index < num_parts
+            if self.seq is None:
+                raise MXNetError("sharding requires an index (.idx) or list")
+            n_per = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n_per:(part_index + 1) * n_per]
+
+        self.shuffle = shuffle
+        if shuffle and self.seq is None:
+            raise MXNetError(
+                "shuffle=True needs random access: provide path_imgidx (an "
+                ".idx next to the .rec) or an image list")
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError("last_batch_handle must be pad/discard/"
+                             "roll_over, got %r" % (last_batch_handle,))
+        if last_batch_handle == "roll_over":
+            raise MXNetError("last_batch_handle='roll_over' is not "
+                             "supported by ImageIter (reference semantics "
+                             "only defined for NDArrayIter)")
+        self.aug_list = (CreateAugmenter(data_shape, **kwargs)
+                         if aug_list is None else aug_list)
+        self.cur = 0
+        self._allow_read = True
+        self.last_batch_handle = last_batch_handle
+        self.num_image = len(self.seq) if self.seq is not None else None
+        self._cache_data = None
+        self.provide_data = [_io.DataDesc("data",
+                                          (batch_size,) + self.data_shape,
+                                          dtype)]
+        label_shape = ((batch_size,) if label_width == 1
+                       else (batch_size, label_width))
+        self.provide_label = [_io.DataDesc("softmax_label", label_shape,
+                                           "float32")]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Return (label, decoded HWC image) for the next sample."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, imdecode(img)
+                return self.imglist[idx][0], imdecode(img)
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, imdecode(img)
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width),
+                               np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, data = self.next_sample()
+                data = self.augmentation_transform(data)
+                self.check_valid_image(data)
+                if data.ndim == 2:
+                    data = data[:, :, None]
+                batch_data[i] = data
+                lab = np.asarray(label, np.float32).reshape(-1)
+                batch_label[i, :len(lab[:self.label_width])] = \
+                    lab[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0 or self.last_batch_handle == "discard":
+                raise
+        pad = self.batch_size - i
+        data_nchw = np.ascontiguousarray(
+            batch_data.transpose(0, 3, 1, 2)).astype(self.dtype)
+        label_out = (batch_label[:, 0] if self.label_width == 1
+                     else batch_label)
+        return _io.DataBatch(data=[nd.array(data_nchw)],
+                             label=[nd.array(label_out)], pad=pad,
+                             index=None)
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3, with "
+                             "dimensions CxHxW")
+
+    def check_valid_image(self, data):
+        if data.shape[0] == 0:
+            raise RuntimeError("Data shape is wrong")
+
+    def imdecode(self, s):
+        return imdecode(s)
+
+    def read_image(self, fname):
+        path = os.path.join(self.path_root, fname) if self.path_root else fname
+        return imread(path)
+
+    def augmentation_transform(self, data):
+        for aug in self.aug_list:
+            data = aug(data)
+        return data

@@ -23,7 +23,8 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
-           "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
+           "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -186,20 +187,54 @@ class PrefetchingIter(DataIter):
         self._start_threads()
 
     def _start_threads(self):
+        stop, queues = self._stop, self._queues
+
+        def put(q, item):
+            # bounded put that aborts on shutdown so producer threads never
+            # sit blocked inside native code at interpreter teardown
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer(i):
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batch = self.iters[i].next()
                 except StopIteration:
-                    self._queues[i].put(None)
+                    put(queues[i], None)
                     return
-                self._queues[i].put(batch)
+                if not put(queues[i], batch):
+                    return
 
         self._threads = [threading.Thread(target=producer, args=(i,),
                                           daemon=True)
                          for i in range(self.n_iter)]
         for t in self._threads:
             t.start()
+
+    def close(self):
+        """Stop producer threads (also runs at gc/exit)."""
+        self._stop.set()
+        for q in self._queues:
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=5)
+        self._threads = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -221,19 +256,13 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         # drain, stop producers, reset children, restart
-        self._stop.set()
-        for q in self._queues:
-            while True:
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-        for t in self._threads:
-            t.join(timeout=5)
+        depth = self._queues[0].maxsize if self._queues else 2
+        self.close()
         for i in self.iters:
             i.reset()
         self._stop = threading.Event()
-        self._queues = [queue.Queue(maxsize=2) for _ in range(self.n_iter)]
+        self._queues = [queue.Queue(maxsize=depth)
+                        for _ in range(self.n_iter)]
         self._start_threads()
 
     def next(self):
@@ -426,3 +455,36 @@ class CSVIter(NDArrayIter):
                 label = label.reshape(label.shape[:-1])
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle="discard")
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
+                    shuffle=False, rand_crop=False, rand_mirror=False,
+                    resize=0, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=1.0, std_g=1.0, std_b=1.0, label_width=1,
+                    num_parts=1, part_index=0, preprocess_threads=4,
+                    prefetch_buffer=4, dtype="float32", **kwargs):
+    """Factory mirroring the C++ ImageRecordIter registration
+    (reference: src/io/iter_image_recordio_2.cc:50 ImageRecordIOParser2 +
+    MXNET_REGISTER_IO_ITER(ImageRecordIter); python surface io.py:762
+    MXDataIter): a record-file image source with the default augmenter
+    stack, distributed num_parts/part_index sharding, and a
+    double-buffered prefetch thread (iter_prefetcher.h:47).
+
+    Returns a PrefetchingIter wrapping an image.ImageIter.
+    """
+    from .image import ImageIter
+
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = None
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = np.array([std_r, std_g, std_b], np.float32)
+    inner = ImageIter(
+        batch_size=batch_size, data_shape=tuple(data_shape),
+        label_width=label_width, path_imgrec=path_imgrec,
+        path_imgidx=path_imgidx, shuffle=shuffle, part_index=part_index,
+        num_parts=num_parts, dtype=dtype, resize=resize,
+        rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
+        **kwargs)
+    return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)

@@ -1,0 +1,66 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded over ctypes — the TPU build's equivalent of the
+reference's compiled core (dmlc recordio framing, src/io/).
+
+Build artifacts are cached next to the sources; when no compiler is
+available the callers fall back to pure-Python implementations, so the
+package never hard-fails.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(name):
+    src = os.path.join(_HERE, name + ".cc")
+    so = os.path.join(_HERE, "lib%s.so" % name)
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-std=c++14", "-fPIC", "-shared", src, "-o", so]
+        subprocess.run(cmd, check=True, capture_output=True)
+    return so
+
+
+def load(name):
+    """Load (building if needed) the named native library; None if the
+    toolchain is unavailable."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        try:
+            lib = ctypes.CDLL(_build(name))
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+            lib = None
+        _LIBS[name] = lib
+        return lib
+
+
+def recordio_lib():
+    lib = load("recordio")
+    if lib is not None and not getattr(lib, "_rio_typed", False):
+        LL = ctypes.c_longlong
+        P = ctypes.c_void_p
+        lib.rio_open.restype = P
+        lib.rio_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.rio_close.argtypes = [P]
+        lib.rio_tell.restype = LL
+        lib.rio_tell.argtypes = [P]
+        lib.rio_seek.restype = ctypes.c_int
+        lib.rio_seek.argtypes = [P, LL]
+        lib.rio_scan.restype = LL
+        lib.rio_scan.argtypes = [P, ctypes.POINTER(LL), LL]
+        lib.rio_read.restype = LL
+        lib.rio_read.argtypes = [P, ctypes.c_char_p, LL]
+        lib.rio_read_at.restype = LL
+        lib.rio_read_at.argtypes = [P, LL, ctypes.c_char_p, LL]
+        lib.rio_write.restype = LL
+        lib.rio_write.argtypes = [P, ctypes.c_char_p, LL, LL]
+        lib.rio_flush.restype = ctypes.c_int
+        lib.rio_flush.argtypes = [P]
+        lib._rio_typed = True
+    return lib

@@ -166,7 +166,10 @@ class ShardedTrainer:
                 outs, aux_upd = prog._eval(arg_d, aux, rngs, True)
                 return tuple(outs), aux_upd
 
-            outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
+            from ..executor import _maybe_mirror
+
+            outs, vjp, aux_upd = jax.vjp(_maybe_mirror(loss_fn), params,
+                                         has_aux=True)
             seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp(seeds)[0]
 

@@ -1,0 +1,178 @@
+"""RecordIO + image pipeline tests (reference patterns:
+tests/python/unittest/test_recordio.py, test_image.py; VERDICT round-2
+task #2: write a .rec, train a small net from it, prefetch overlap)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu import image as img
+
+
+def _write_rec(tmp_path, n=40, size=24, classes=4, fmt=".png"):
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    h = size // 2
+    for i in range(n):
+        label = i % classes
+        # orthogonal classes: one bright quadrant per class, so a linear
+        # softmax separates them in a few epochs
+        im = np.full((size, size, 3), 40, np.uint8)
+        r, c = divmod(label, 2)
+        im[r * h:(r + 1) * h, c * h:(c + 1) * h] = 200
+        im += rng.randint(0, 8, im.shape).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(label), i, 0), im, img_fmt=fmt))
+    w.close()
+    return rec, idx
+
+
+def test_recordio_roundtrip(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    payloads = [b"a", b"bc" * 500, b""]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(rec, "r")
+    got = []
+    while True:
+        x = r.read()
+        if x is None:
+            break
+        got.append(x)
+    assert got == payloads
+    r.close()
+
+
+def test_recordio_format_bytes(tmp_path):
+    # dmlc framing: magic 0xced7230a, cflag<<29|len, 4-byte padding
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    w.write(b"abcde")
+    w.close()
+    raw = open(rec, "rb").read()
+    import struct
+
+    magic, lrec = struct.unpack("<II", raw[:8])
+    assert magic == 0xced7230a
+    assert lrec >> 29 == 0 and (lrec & ((1 << 29) - 1)) == 5
+    assert raw[8:13] == b"abcde" and len(raw) == 16  # padded to 4
+
+
+def test_indexed_recordio(tmp_path):
+    rec, idx = _write_rec(tmp_path, n=10)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert sorted(r.keys) == list(range(10))
+    h, im = recordio.unpack_img(r.read_idx(7))
+    assert h.label == 3.0 and im.shape == (24, 24, 3)
+    r.close()
+
+
+def test_irheader_array_label():
+    h = recordio.IRHeader(0, [1.5, 2.5], 3, 0)
+    s = recordio.pack(h, b"payload")
+    h2, content = recordio.unpack(s)
+    assert h2.flag == 2
+    np.testing.assert_array_equal(h2.label, [1.5, 2.5])
+    assert content == b"payload"
+
+
+def test_augmenters():
+    rng = np.random.RandomState(0)
+    im = rng.randint(0, 255, (40, 30, 3)).astype(np.uint8)
+    assert img.resize_short(im, 20).shape[1] == 20
+    out, (x0, y0, w, h) = img.random_crop(im, (16, 12))
+    assert out.shape == (12, 16, 3)
+    out, _ = img.center_crop(im, (16, 12))
+    assert out.shape == (12, 16, 3)
+    out = img.color_normalize(im, np.array([1.0, 2.0, 3.0]),
+                              np.array([2.0, 2.0, 2.0]))
+    np.testing.assert_allclose(out[..., 0], (im[..., 0] - 1.0) / 2.0)
+    augs = img.CreateAugmenter((3, 16, 16), rand_crop=True, rand_mirror=True,
+                               brightness=0.1, contrast=0.1, saturation=0.1,
+                               hue=0.1, pca_noise=0.05, rand_gray=0.5,
+                               mean=True, std=True)
+    out = im
+    for a in augs:
+        out = a(out)
+    assert out.shape == (16, 16, 3) and out.dtype == np.float32
+
+
+def test_image_iter_and_sharding(tmp_path):
+    rec, idx = _write_rec(tmp_path, n=40)
+    it = img.ImageIter(batch_size=8, data_shape=(3, 20, 20),
+                       path_imgrec=rec, path_imgidx=idx)
+    batch = next(iter([it.next()]))
+    assert batch.data[0].shape == (8, 3, 20, 20)
+    assert batch.label[0].shape == (8,)
+    # num_parts sharding partitions the keys
+    seen = []
+    for part in range(4):
+        p = img.ImageIter(batch_size=5, data_shape=(3, 20, 20),
+                          path_imgrec=rec, path_imgidx=idx,
+                          num_parts=4, part_index=part)
+        seen.extend(p.seq)
+    assert sorted(seen) == list(range(40))
+
+
+def test_train_from_rec(tmp_path):
+    # end-to-end: a small net learns the class-coded images from a .rec
+    rec, idx = _write_rec(tmp_path, n=64, size=12, classes=4)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 12, 12),
+        batch_size=16, shuffle=True, mean_r=127.0, mean_g=127.0,
+        mean_b=127.0, std_r=60.0, std_g=60.0, std_b=60.0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data=data)
+    net = mx.sym.FullyConnected(data=net, num_hidden=4)
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric=(metric := mx.metric.Accuracy()))
+    assert metric.get()[1] > 0.9, metric.get()
+
+
+def test_prefetch_overlap(tmp_path):
+    # the prefetch thread must overlap producer time with consumer time
+    class SlowIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+            self.provide_data = [mx.io.DataDesc("data", (2, 3))]
+            self.provide_label = [mx.io.DataDesc("softmax_label", (2,))]
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            if self.n >= 6:
+                raise StopIteration
+            self.n += 1
+            time.sleep(0.05)
+            return mx.io.DataBatch(data=[mx.nd.zeros((2, 3))],
+                                   label=[mx.nd.zeros((2,))], pad=0)
+
+    it = mx.io.PrefetchingIter(SlowIter(), prefetch_depth=3)
+    first = it.next()  # fill pipeline
+    time.sleep(0.2)    # let the producer run ahead
+    t0 = time.perf_counter()
+    count = 1
+    try:
+        while True:
+            it.next()
+            count += 1
+    except StopIteration:
+        pass
+    consumed = time.perf_counter() - t0
+    assert count == 6
+    # 5 remaining batches at 0.05s each would cost 0.25s serially; with
+    # prefetch ahead they must arrive much faster
+    assert consumed < 0.15, consumed

@@ -346,3 +346,36 @@ def test_conv_space_to_depth_parity():
         assert y1.shape == y0.shape
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_exec_flags_mirror_and_disable_jit():
+    # MXNET_BACKWARD_DO_MIRROR (remat) and MXNET_EXEC_DISABLE_JIT (eager
+    # debug mode) must produce identical numerics to the default path
+    from mxnet_tpu import config
+    import mxnet_tpu as mx
+
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    sym_data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=sym_data, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+    def run():
+        ex = net.simple_bind(mx.cpu(), data=(4, 6), grad_req="write")
+        ex.arg_dict["fc_weight"][:] = 0.1
+        ex.arg_dict["fc_bias"][:] = 0.0
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 0], np.float32)
+        ex.forward(is_train=True)
+        ex.backward()
+        return (ex.outputs[0].asnumpy().copy(),
+                ex.grad_dict["fc_weight"].asnumpy().copy())
+
+    base_out, base_grad = run()
+    for flag in ("MXNET_BACKWARD_DO_MIRROR", "MXNET_EXEC_DISABLE_JIT"):
+        config.set_flag(flag, 1)
+        try:
+            out, grad = run()
+        finally:
+            config.set_flag(flag, None)
+        np.testing.assert_allclose(out, base_out, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(grad, base_grad, rtol=1e-5, atol=1e-6)
