@@ -14,7 +14,10 @@ from . import broadcast_reduce
 from . import matrix
 from . import init_ops
 from . import indexing
+from . import linalg
 from . import nn
+from . import spatial
+from . import fork_ops
 from . import optimizer_ops
 from . import random_ops
 from . import rnn

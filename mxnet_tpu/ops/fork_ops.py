@@ -1,0 +1,373 @@
+"""Fork-specific research operators (SURVEY.md §2.6 — the MaureenZOU/mxnet
+deltas over upstream): LSoftmax, MultiLogistic, WeightedL1, nAvg, SPN, SCN,
+Correlation1D.
+
+References: src/operator/lsoftmax-inl.h (+.cu), multi_logistic-inl.h,
+weighted_l1-inl.h, nonzero-average-inl.h (+.cu), spatial-propagation-inl.h
+(+.cu), spatial-completion-inl.h (+.cu), correlation1D-inl.h (+.cu); the
+numeric ground truths are the python reimplementations in
+tests/python/train/test_spn.py, test_scn.py, test_nAvg.py.
+
+TPU-first shapes: SPN/SCN's column-recurrent propagation is a
+``lax.scan`` over the scan axis with the 3-neighbor mix as vectorized
+shifts (the reference launches one CUDA kernel per column); Correlation1D
+unrolls its (static, small) displacement set into strided slices that XLA
+fuses; gradients everywhere come from jax autodiff of the same forward,
+which reproduces the reference's hand-written backward kernels (they
+differentiate the identical expressions, holding the LSoftmax branch index
+k constant).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _register():
+    import jax
+
+    jnp = _jnp()
+
+    # --- LSoftmax ----------------------------------------------------------
+    def lsoftmax(attrs, x, w, label, is_train=False):
+        out = jnp.matmul(x, w.T)
+        x_norm = jnp.sqrt(jnp.sum(x * x, axis=1))
+        w_norm = jnp.sqrt(jnp.sum(w * w, axis=1))
+        if not is_train:
+            return out, x_norm, w_norm
+        margin = attrs.margin
+        beta = attrs.beta
+        # cos(i*pi/m) lookup and binomial C(m, 2p) (lsoftmax-inl.h:57-70)
+        k_table = np.array([math.cos(i * math.pi / margin)
+                            for i in range(margin + 1)], np.float32)
+        n = x.shape[0]
+        yi = label.astype(jnp.int32)
+        fo = out[jnp.arange(n), yi]
+        denom = x_norm * w_norm[yi]
+        cos_t = fo / denom
+        # k = the margin segment containing cos_t (LSFindK, eps=1e-5:
+        # exact boundary values resolve to the smaller segment)
+        k = jnp.sum((k_table[1:][None, :] - cos_t[:, None]) >= 1e-5, axis=1)
+        k = jnp.clip(k, 0, margin - 1) if margin > 1 else jnp.zeros_like(k)
+        # cos(m*t) by multi-angle expansion (LSCalcCosmt)
+        sin2_t = 1 - cos_t * cos_t
+        cos_mt = jnp.zeros_like(cos_t)
+        for p in range(margin // 2 + 1):
+            coef = (-1.0) ** p * math.comb(margin, 2 * p)
+            cos_mt = cos_mt + coef * cos_t ** (margin - 2 * p) * sin2_t ** p
+        f = (((-1.0) ** k.astype(jnp.float32)) * cos_mt
+             - 2.0 * k.astype(jnp.float32)) * denom
+        new = (f + beta * fo) / (1.0 + beta)
+        out = out.at[jnp.arange(n), yi].set(new.astype(out.dtype))
+        return out, x_norm, w_norm
+
+    def lsoftmax_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        m = attrs.num_hidden
+        w = (m, d[1])
+        return ([d, w, (d[0],)], [(d[0], m), (d[0],), (m,)], aux_shapes)
+
+    register_op(
+        "LSoftmax", lsoftmax,
+        params={"margin": Int(default=2), "beta": Float(default=1.0),
+                "beta_min": Float(default=0.0), "scale": Float(default=1.0),
+                "num_hidden": Int(), "verbose": Bool(default=False)},
+        num_inputs=3, input_names=["data", "weight", "label"],
+        num_outputs=3, needs_is_train=True, infer_shape=lsoftmax_infer,
+        doc="Large-Margin softmax FC head: f_yi = ((-1)^k cos(m t) - 2k)"
+            "|x||w|, blended by beta (reference: src/operator/lsoftmax-inl.h"
+            "; the beta/scale annealing schedule is driven by the caller "
+            "updating `beta`, as functional ops carry no mutable state)")
+
+    # --- MultiLogistic -----------------------------------------------------
+    def _multi_logistic_fn(grad_scale, weight):
+        @jax.custom_vjp
+        def f(data, label):
+            return jax.nn.sigmoid(data.astype(jnp.float32)).astype(data.dtype)
+
+        def fwd(data, label):
+            return f(data, label), (f(data, label), label)
+
+        def bwd(res, g):
+            out, label = res
+            o = out.astype(jnp.float32)
+            lab = label.astype(jnp.float32)
+            diff = o - lab
+            grad = grad_scale * (diff * lab * weight + diff * (1 - lab))
+            return grad.astype(out.dtype), jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def multi_logistic(attrs, data, label):
+        return _multi_logistic_fn(attrs.grad_scale, attrs.weight)(data, label)
+
+    def _headlike_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        return ([d, d], [d], aux_shapes)
+
+    register_op(
+        "MultiLogistic", multi_logistic,
+        params={"p": Float(default=2.0), "grad_scale": Float(default=1.0),
+                "weight": Float(default=1.0)},
+        num_inputs=2, input_names=["data", "label"],
+        infer_shape=_headlike_infer,
+        doc="multi-label sigmoid head with positive-class weighting: "
+            "grad = scale*((out-label)*label*weight + (out-label)*(1-label))"
+            " (reference: src/operator/multi_logistic-inl.h)")
+
+    # --- WeightedL1 --------------------------------------------------------
+    def _weighted_l1_fn(grad_scale):
+        @jax.custom_vjp
+        def f(data, label):
+            return data
+
+        def fwd(data, label):
+            return data, (data, label)
+
+        def bwd(res, g):
+            data, label = res
+            x = data.astype(jnp.float32)
+            lab = label.astype(jnp.float32)
+            grad = grad_scale * jnp.sign(x - lab) * (lab > 0)
+            return grad.astype(data.dtype), jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def weighted_l1(attrs, data, label):
+        return _weighted_l1_fn(attrs.grad_scale)(data, label)
+
+    register_op(
+        "WeightedL1", weighted_l1,
+        params={"grad_scale": Float(default=1.0)},
+        num_inputs=2, input_names=["data", "label"],
+        infer_shape=_headlike_infer,
+        doc="L1 regression head masked to positive labels: grad = "
+            "scale*sign(out-label)*(label>0) (reference: "
+            "src/operator/weighted_l1-inl.h)")
+
+    # --- nAvg --------------------------------------------------------------
+    def navg(attrs, x):
+        t = attrs.threshold
+        mask = (x > t).astype(jnp.float32)
+        cnt = jnp.sum(mask, axis=1, keepdims=True)
+        # count==0 positions yield 0 instead of the reference's 0/0 NaN
+        avg = jnp.where(cnt > 0,
+                        jnp.sum(x.astype(jnp.float32) * mask, axis=1,
+                                keepdims=True) / jnp.maximum(cnt, 1.0),
+                        0.0)
+        rest = jnp.zeros_like(x[:, 1:].astype(jnp.float32))
+        return jnp.concatenate([avg, rest], axis=1).astype(x.dtype)
+
+    register_op(
+        "nAvg", navg, params={"threshold": Float(default=1.0)},
+        num_inputs=1, input_names=["X"],
+        infer_shape=lambda attrs, s, a: ([s[0]], [s[0]], a)
+        if s[0] is not None else None,
+        doc="channel 0 := mean over channels of values > threshold, per "
+            "(n,h,w); other channels zero (reference: "
+            "src/operator/nonzero-average-inl.h; autodiff reproduces the "
+            "1/count masked backward)")
+
+    # --- SPN / SCN ---------------------------------------------------------
+    def _canon(arrs, horizontal, reverse):
+        """Bring the scan axis to the last dim, scanning left→right."""
+        if not horizontal:
+            arrs = [a.swapaxes(2, 3) for a in arrs]
+        if reverse:
+            arrs = [a[..., ::-1] for a in arrs]
+        return arrs
+
+    def _decanon(a, horizontal, reverse):
+        if reverse:
+            a = a[..., ::-1]
+        if horizontal:
+            return a
+        return a.swapaxes(2, 3)
+
+    def _propagate(x, g1, g2, g3, c_mask):
+        """Shared SPN/SCN left→right recurrence over the last axis.
+
+        h_t[i] = mix(x_t[i], g1z*h_{t-1}[i-1] + g2z*h_{t-1}[i]
+                      + g3z*h_{t-1}[i+1])
+        with gates zeroed where the source neighbor is out of bounds
+        (get_gate, spatial-propagation.cu:94). ``c_mask`` None selects the
+        SPN mix (1-Σg)x + Σ g h; else the SCN mix c*x + (1-c)Σ g h.
+        """
+        import jax
+
+        H = x.shape[2]
+        up_ok = (jnp.arange(H) > 0).astype(jnp.float32)[None, None, :]
+        dn_ok = (jnp.arange(H) < H - 1).astype(jnp.float32)[None, None, :]
+
+        # scan over width: move W to the leading axis → (W, n, c, H)
+        def to_scan(a):
+            return a.transpose(3, 0, 1, 2)
+
+        xs = [to_scan(x), to_scan(g1), to_scan(g2), to_scan(g3)]
+        W = x.shape[3]
+        first = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                                 jnp.ones((W - 1,), jnp.float32)])
+        xs.append(first)
+        if c_mask is not None:
+            xs.append(to_scan(c_mask))
+
+        def shift_up(a):   # value at i-1
+            return jnp.concatenate([jnp.zeros_like(a[..., :1]),
+                                    a[..., :-1]], axis=-1)
+
+        def shift_dn(a):   # value at i+1
+            return jnp.concatenate([a[..., 1:],
+                                    jnp.zeros_like(a[..., :1])], axis=-1)
+
+        def step(prev, inp):
+            if c_mask is None:
+                x_t, g1_t, g2_t, g3_t, ok = inp
+                cm = None
+            else:
+                x_t, g1_t, g2_t, g3_t, ok, cm = inp
+            g1z = g1_t.astype(jnp.float32) * up_ok * ok
+            g2z = g2_t.astype(jnp.float32) * ok
+            g3z = g3_t.astype(jnp.float32) * dn_ok * ok
+            mix = (g1z * shift_up(prev) + g2z * prev + g3z * shift_dn(prev))
+            if cm is None:
+                h = (1 - g1z - g2z - g3z) * x_t.astype(jnp.float32) + mix
+            else:
+                cf = cm.astype(jnp.float32)
+                h = cf * x_t.astype(jnp.float32) + (1 - cf) * mix
+            return h, h
+
+        init = jnp.zeros(x.shape[:3], jnp.float32)
+        _, hs = jax.lax.scan(step, init, tuple(xs))
+        return hs.transpose(1, 2, 3, 0).astype(x.dtype)
+
+    def spn(attrs, x, g1, g2, g3):
+        x, g1, g2, g3 = _canon([x, g1, g2, g3], attrs.horizontal,
+                               attrs.reverse)
+        h = _propagate(x, g1, g2, g3, None)
+        return _decanon(h, attrs.horizontal, attrs.reverse)
+
+    def _same4_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        return ([d] * len(in_shapes), [d], aux_shapes)
+
+    register_op(
+        "SPN", spn,
+        params={"horizontal": Bool(default=False),
+                "reverse": Bool(default=False)},
+        num_inputs=4, input_names=["X", "G1", "G2", "G3"],
+        infer_shape=_same4_infer,
+        doc="three-way spatial propagation h = (1-Σg)x + Σ g·h_prev as a "
+            "lax.scan over the scan axis (reference: "
+            "src/operator/spatial-propagation-inl.h; ground truth "
+            "tests/python/train/test_spn.py)")
+
+    def scn(attrs, x, g1, g2, g3, c):
+        x, g1, g2, g3, c = _canon([x, g1, g2, g3, c], attrs.horizontal,
+                                  attrs.reverse)
+        h = _propagate(x, g1, g2, g3, c)
+        return _decanon(h, attrs.horizontal, attrs.reverse)
+
+    register_op(
+        "SCN", scn,
+        params={"horizontal": Bool(default=False),
+                "reverse": Bool(default=False)},
+        num_inputs=5, input_names=["X", "G1", "G2", "G3", "C"],
+        infer_shape=_same4_infer,
+        doc="masked spatial completion h = c·x + (1-c)·Σ g·h_prev "
+            "(reference: src/operator/spatial-completion-inl.h; ground "
+            "truth tests/python/train/test_scn.py)")
+
+    # --- Correlation1D -----------------------------------------------------
+    def correlation1d(attrs, data1, data2):
+        ks = attrs.kernel_size
+        if ks % 2 == 0:
+            raise MXNetError("kernel_size must be odd")
+        kr = (ks - 1) // 2
+        s1, s2 = attrs.stride1, attrs.stride2
+        pad = attrs.pad_size
+        max_d = attrs.max_displacement
+        ngr = max_d // s2
+        ngw = ngr + 1 if attrs.single_side != 0 else 2 * ngr + 1
+        if attrs.single_side == -1:
+            x_shift = -ngw
+        elif attrs.single_side == 1:
+            x_shift = 0
+        else:
+            x_shift = -ngr
+        n, c, h, w = data1.shape
+        pw = w + 2 * pad
+        border = max_d + kr
+        top_w = int(np.ceil((pw - 2 * border) / float(s1)))
+        top_h = int(np.ceil((h - 2 * kr) / float(s1)))
+        a = jnp.pad(data1.astype(jnp.float32),
+                    ((0, 0), (0, 0), (0, 0), (pad, pad)))
+        # data2 gets extra zero margin so every displacement slice is in
+        # bounds — out-of-image displacements contribute zero (defined
+        # behavior where the reference kernel reads out of bounds for
+        # single_side=-1)
+        extra = abs(x_shift) * s2
+        b = jnp.pad(data2.astype(jnp.float32),
+                    ((0, 0), (0, 0), (0, 0), (pad + extra, pad + extra)))
+        norm = float(ks * ks * c)
+        chans = []
+        for tc in range(ngw):
+            s2o = (tc + x_shift) * s2
+            acc = 0.0
+            for j in range(ks):
+                for i in range(ks):
+                    av = a[:, :, j:j + top_h * s1:s1,
+                           max_d + i:max_d + i + top_w * s1:s1]
+                    x2 = extra + max_d + s2o + i
+                    bv = b[:, :, j:j + top_h * s1:s1,
+                           x2:x2 + top_w * s1:s1]
+                    acc = acc + jnp.sum(av * bv, axis=1)
+            chans.append(acc / norm)
+        out = jnp.stack(chans, axis=1)
+        return out.astype(data1.dtype)
+
+    def corr1d_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        ks = attrs.kernel_size
+        kr = (ks - 1) // 2
+        ngr = attrs.max_displacement // attrs.stride2
+        ngw = ngr + 1 if attrs.single_side != 0 else 2 * ngr + 1
+        pw = d[3] + 2 * attrs.pad_size
+        border = attrs.max_displacement + kr
+        top_w = int(np.ceil((pw - 2 * border) / float(attrs.stride1)))
+        top_h = int(np.ceil((d[2] - 2 * kr) / float(attrs.stride1)))
+        return ([d, d], [(d[0], ngw, top_h, top_w)], aux_shapes)
+
+    register_op(
+        "Correlation1D", correlation1d,
+        params={"kernel_size": Int(default=1), "max_displacement": Int(default=1),
+                "stride1": Int(default=1), "stride2": Int(default=1),
+                "pad_size": Int(default=0), "single_side": Int(default=0)},
+        num_inputs=2, input_names=["data1", "data2"],
+        infer_shape=corr1d_infer,
+        doc="FlowNet-style horizontal correlation: per displacement, "
+            "mean over (kernel window x channels) of data1·shift(data2) "
+            "(reference: src/operator/correlation1D-inl.h)")
+
+
+_register()

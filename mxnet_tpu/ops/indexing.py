@@ -102,3 +102,52 @@ def _register():
 
 
 _register()
+
+
+def _register_nd_scatter():
+    """gather_nd / scatter_nd (reference: src/operator/tensor/indexing_op.cc
+    GatherNDShape/ScatterNDShape): indices shape (M, Y0..Yk) addresses the
+    first M dims of data; XLA lowers the advanced-index gather/scatter
+    natively on TPU."""
+    jnp = _jnp()
+
+    def gather_nd(attrs, data, indices):
+        m = indices.shape[0]
+        idx = tuple(indices[i].astype(jnp.int32) for i in range(m))
+        return data[idx]
+
+    def gather_nd_infer(attrs, in_shapes, aux_shapes):
+        d, i = in_shapes
+        if d is None or i is None:
+            return None
+        m = i[0]
+        out = tuple(i[1:]) + tuple(d[m:])
+        return ([d, i], [out], aux_shapes)
+
+    register_op(
+        "gather_nd", gather_nd, params={},
+        num_inputs=2, input_names=["data", "indices"],
+        infer_shape=gather_nd_infer,
+        doc="indices (M,Y...) gathers data[idx0,...,idxM-1] -> (Y..., "
+            "data.shape[M:]) (reference: indexing_op.cc gather_nd)")
+
+    def scatter_nd(attrs, data, indices):
+        shape = tuple(attrs.shape)
+        m = indices.shape[0]
+        idx = tuple(indices[i].astype(jnp.int32) for i in range(m))
+        out = jnp.zeros(shape, dtype=data.dtype)
+        return out.at[idx].set(data)
+
+    def scatter_nd_infer(attrs, in_shapes, aux_shapes):
+        return (in_shapes, [tuple(attrs.shape)], aux_shapes)
+
+    register_op(
+        "scatter_nd", scatter_nd, params={"shape": Shape()},
+        num_inputs=2, input_names=["data", "indices"],
+        infer_shape=scatter_nd_infer,
+        doc="scatter data into zeros(shape) at indices; duplicate indices "
+            "keep one value, matching the reference's non-determinism note "
+            "(reference: indexing_op.cc scatter_nd)")
+
+
+_register_nd_scatter()

@@ -1,0 +1,280 @@
+"""Spatial warp ops: GridGenerator, BilinearSampler, SpatialTransformer,
+UpSampling, SVMOutput (reference: src/operator/grid_generator-inl.h,
+bilinear_sampler-inl.h, spatial_transformer-inl.h, upsampling-inl.h,
+svm_output-inl.h).
+
+TPU-first: sampling is expressed as gather + elementwise lerp (XLA gathers
+vectorize on TPU); no cuDNN SpatialTransformer path to mirror. All ops are
+NCHW like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _affine_grid(theta, h, w):
+    """theta (n, 6) → normalized sampling grid (n, 2, h, w) with rows
+    [x_src; y_src] in [-1, 1] (reference: grid_generator-inl.h:92-108)."""
+    jnp = _jnp()
+    ys, xs = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+    xn = -1.0 + xs.astype(jnp.float32) * (2.0 / max(w - 1, 1))
+    yn = -1.0 + ys.astype(jnp.float32) * (2.0 / max(h - 1, 1))
+    ones = jnp.ones_like(xn)
+    base = jnp.stack([xn.ravel(), yn.ravel(), ones.ravel()], axis=0)  # (3, hw)
+    out = jnp.matmul(theta.reshape(-1, 2, 3).astype(jnp.float32), base)
+    return out.reshape(-1, 2, h, w)
+
+
+def _bilinear_sample(data, grid):
+    """Sample NCHW ``data`` at normalized ``grid`` (n,2,h',w'); zero padding
+    outside [-1,1] (reference: bilinear_sampler-inl.h BilinearSamplerForward)."""
+    jnp = _jnp()
+    n, c, h, w = data.shape
+    gx = (grid[:, 0].astype(jnp.float32) + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1].astype(jnp.float32) + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def fetch(yi, xi):
+        valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        # batch-aligned gather: (n, h', w') indices into (n, c, h, w)
+        v = data[jnp.arange(n)[:, None, None], :, yc, xc]  # (n,h',w',c)
+        return jnp.where(valid[..., None], v, 0.0)
+
+    v00 = fetch(y0, x0)
+    v01 = fetch(y0, x0 + 1)
+    v10 = fetch(y0 + 1, x0)
+    v11 = fetch(y0 + 1, x0 + 1)
+    wx = wx[..., None]
+    wy = wy[..., None]
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return out.transpose(0, 3, 1, 2).astype(data.dtype)
+
+
+def _register():
+    import jax
+
+    jnp = _jnp()
+
+    # --- GridGenerator -----------------------------------------------------
+    def grid_generator(attrs, data):
+        if attrs.transform_type == "affine":
+            h, w = attrs.target_shape
+            return _affine_grid(data, h, w)
+        # warp: data is (n,2,h,w) optical flow in pixels; grid_src =
+        # normalize(pixel + flow) (reference: grid_generator-inl.h:114)
+        n, two, h, w = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+        fx = data[:, 0].astype(jnp.float32) + xs.astype(jnp.float32)
+        fy = data[:, 1].astype(jnp.float32) + ys.astype(jnp.float32)
+        xn = -1.0 + fx * (2.0 / max(w - 1, 1))
+        yn = -1.0 + fy * (2.0 / max(h - 1, 1))
+        return jnp.stack([xn, yn], axis=1).astype(data.dtype)
+
+    def grid_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        if attrs.transform_type == "affine":
+            h, w = attrs.target_shape
+            return ([d], [(d[0], 2, h, w)], aux_shapes)
+        return ([d], [d], aux_shapes)
+
+    register_op(
+        "GridGenerator", grid_generator,
+        params={"transform_type": Enum(("affine", "warp")),
+                "target_shape": Shape(default=(0, 0))},
+        num_inputs=1, infer_shape=grid_infer,
+        doc="generate a BilinearSampler grid from an affine transform or "
+            "optical flow (reference: src/operator/grid_generator.cc)")
+
+    # --- BilinearSampler ---------------------------------------------------
+    def bilinear_sampler(attrs, data, grid):
+        return _bilinear_sample(data, grid)
+
+    def bs_infer(attrs, in_shapes, aux_shapes):
+        d, g = in_shapes
+        if d is None or g is None:
+            return None
+        out = (d[0], d[1], g[2], g[3])
+        return ([d, g], [out], aux_shapes)
+
+    register_op(
+        "BilinearSampler", bilinear_sampler, params={},
+        num_inputs=2, input_names=["data", "grid"], infer_shape=bs_infer,
+        doc="bilinear sampling of NCHW data at a normalized grid, zero "
+            "outside [-1,1] (reference: src/operator/bilinear_sampler.cc)")
+
+    # --- SpatialTransformer ------------------------------------------------
+    def spatial_transformer(attrs, data, loc):
+        if attrs.transform_type != "affine":
+            raise MXNetError("SpatialTransformer supports affine only "
+                             "(matches reference)")
+        h, w = attrs.target_shape
+        grid = _affine_grid(loc, h, w)
+        return _bilinear_sample(data, grid)
+
+    def st_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        h, w = attrs.target_shape
+        loc = (d[0], 6)
+        return ([d, loc], [(d[0], d[1], h, w)], aux_shapes)
+
+    register_op(
+        "SpatialTransformer", spatial_transformer,
+        params={"target_shape": Shape(default=(0, 0)),
+                "transform_type": Enum(("affine",)),
+                "sampler_type": Enum(("bilinear",))},
+        num_inputs=2, input_names=["data", "loc"], infer_shape=st_infer,
+        doc="affine spatial transformer = GridGenerator + BilinearSampler "
+            "in one op (reference: src/operator/spatial_transformer.cc)")
+
+    # --- UpSampling --------------------------------------------------------
+    def upsampling(attrs, *inputs):
+        scale = attrs.scale
+        if attrs.sample_type == "nearest":
+            datas = inputs
+            h0, w0 = datas[0].shape[2], datas[0].shape[3]
+            outs = []
+            for d in datas:
+                r = (scale * h0) // d.shape[2]
+                up = jnp.repeat(jnp.repeat(d, r, axis=2), r, axis=3)
+                outs.append(up)
+            if attrs.multi_input_mode == "sum":
+                out = outs[0]
+                for o in outs[1:]:
+                    out = out + o
+                return out
+            return jnp.concatenate(outs, axis=1)
+        # bilinear: grouped transposed conv with the supplied weight
+        # (reference: upsampling.cc:40-55 builds a Deconvolution with
+        # kernel 2s - s%2, stride s, pad ceil((s-1)/2), num_group=C)
+        data, weight = inputs
+        import jax
+
+        n, c, h, w = data.shape
+        k = 2 * scale - scale % 2
+        pad = int(np.ceil((scale - 1) / 2.0))
+        # weight (C, 1, k, k): OIHW, one input channel per group; a true
+        # transposed convolution correlates with the spatially FLIPPED
+        # kernel (Deconvolution = vjp of Convolution)
+        out = jax.lax.conv_general_dilated(
+            data, weight[:, :, ::-1, ::-1],
+            window_strides=(1, 1),
+            padding=[(k - 1 - pad, k - 1 - pad)] * 2,
+            lhs_dilation=(scale, scale),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=c)
+        return out
+
+    def upsampling_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        s = attrs.scale
+        out_h, out_w = d[2] * s, d[3] * s
+        if attrs.sample_type == "nearest":
+            c = d[1]
+            if attrs.multi_input_mode != "sum":
+                c = 0
+                for sh in in_shapes:
+                    if sh is None:
+                        return None
+                    c += sh[1]
+            return (list(in_shapes), [(d[0], c, out_h, out_w)], aux_shapes)
+        k = 2 * s - s % 2
+        wshape = (d[1], 1, k, k)
+        return ([d, wshape], [(d[0], d[1], out_h, out_w)], aux_shapes)
+
+    register_op(
+        "UpSampling", upsampling,
+        params={"scale": Int(), "num_filter": Int(default=0),
+                "sample_type": Enum(("nearest", "bilinear")),
+                "multi_input_mode": Enum(("concat", "sum"),
+                                         default="concat"),
+                "num_args": Int(default=1),
+                "workspace": Int(default=512)},
+        num_inputs=lambda attrs: (attrs.num_args
+                                  if attrs.sample_type == "nearest" else 2),
+        input_names=lambda attrs: (
+            ["arg%d" % i for i in range(attrs.num_args)]
+            if attrs.sample_type == "nearest" else ["data", "weight"]),
+        infer_shape=upsampling_infer,
+        doc="nearest (repeat) or bilinear (grouped transposed conv with a "
+            "learnable weight) upsampling (reference: "
+            "src/operator/upsampling.cc)")
+
+    # --- SVMOutput ---------------------------------------------------------
+    def _svm_fn(margin, reg_coef, use_linear):
+        import jax
+
+        @jax.custom_vjp
+        def f(data, label):
+            return data
+
+        def fwd(data, label):
+            return data, (data, label)
+
+        def bwd(res, g):
+            data, label = res
+            x = data.astype(jnp.float32)
+            n, k = x.shape[0], x.shape[-1]
+            onehot = jax.nn.one_hot(label.astype(jnp.int32), k,
+                                    dtype=jnp.float32)
+            if use_linear:
+                # L1-SVM: d/df_y = -reg*[f_y < margin];
+                # d/df_x = reg*[f_x > -margin]  (svm_output-inl.h:31-47)
+                g_true = -(x < margin).astype(jnp.float32) * reg_coef
+                g_wrong = (x > -margin).astype(jnp.float32) * reg_coef
+            else:
+                # L2-SVM: d/df_y = -2 reg max(0, margin - f_y);
+                # d/df_x = 2 reg max(0, margin + f_x). NOTE the reference
+                # snapshot's L2 branch (svm_output.cc:59-62) has these
+                # signs inverted — a known upstream bug fixed in later
+                # MXNet; we implement the correct descent direction.
+                g_true = -2.0 * reg_coef * jnp.maximum(0.0, margin - x)
+                g_wrong = 2.0 * reg_coef * jnp.maximum(0.0, margin + x)
+            grad = jnp.where(onehot > 0, g_true, g_wrong)
+            return grad.astype(data.dtype), jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def svm_output(attrs, data, label):
+        return _svm_fn(attrs.margin, attrs.regularization_coefficient,
+                       attrs.use_linear)(data, label)
+
+    def svm_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        return ([d, tuple(d[:-1])], [d], aux_shapes)
+
+    register_op(
+        "SVMOutput", svm_output,
+        params={"margin": Float(default=1.0),
+                "regularization_coefficient": Float(default=1.0),
+                "use_linear": Bool(default=False)},
+        num_inputs=2, input_names=["data", "label"], infer_shape=svm_infer,
+        doc="hinge-loss output head: identity forward, L1/L2 SVM gradient "
+            "in backward (reference: src/operator/svm_output.cc)")
+
+
+_register()

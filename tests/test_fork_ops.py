@@ -1,0 +1,269 @@
+"""Fork research-op tests with ported numeric references (reference:
+tests/python/train/test_spn.py, test_scn.py, test_nAvg.py — python
+ground-truth reimplementations compared against the ops, plus
+finite-difference gradient checks)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+# --- python ground truths (vectorized ports of the reference tests) --------
+
+def _spn_ref(x, g1, g2, g3, horizontal, reverse):
+    """Sequential reference for SPN (test_spn.py forward_result)."""
+    n, c, H, W = x.shape
+    if not horizontal:
+        args = [a.swapaxes(2, 3) for a in (x, g1, g2, g3)]
+        out = _spn_ref(*args, True, reverse)
+        return out.swapaxes(2, 3)
+    if reverse:
+        args = [a[..., ::-1] for a in (x, g1, g2, g3)]
+        return _spn_ref(*args, True, False)[..., ::-1]
+    h = np.zeros_like(x, dtype=np.float64)
+    for t in range(W):
+        for i in range(H):
+            gg1 = g1[:, :, i, t] if (t > 0 and i > 0) else 0.0
+            gg2 = g2[:, :, i, t] if t > 0 else 0.0
+            gg3 = g3[:, :, i, t] if (t > 0 and i < H - 1) else 0.0
+            acc = (1 - gg1 - gg2 - gg3) * x[:, :, i, t]
+            if t > 0:
+                if i > 0:
+                    acc = acc + gg1 * h[:, :, i - 1, t - 1]
+                acc = acc + gg2 * h[:, :, i, t - 1]
+                if i < H - 1:
+                    acc = acc + gg3 * h[:, :, i + 1, t - 1]
+            h[:, :, i, t] = acc
+    return h
+
+
+def _scn_ref(x, g1, g2, g3, cm, horizontal, reverse):
+    """Sequential reference for SCN (test_scn.py forward_result)."""
+    n, c, H, W = x.shape
+    if not horizontal:
+        args = [a.swapaxes(2, 3) for a in (x, g1, g2, g3, cm)]
+        return _scn_ref(*args, True, reverse).swapaxes(2, 3)
+    if reverse:
+        args = [a[..., ::-1] for a in (x, g1, g2, g3, cm)]
+        return _scn_ref(*args, True, False)[..., ::-1]
+    h = np.zeros_like(x, dtype=np.float64)
+    for t in range(W):
+        for i in range(H):
+            gg1 = g1[:, :, i, t] if (t > 0 and i > 0) else 0.0
+            gg2 = g2[:, :, i, t] if t > 0 else 0.0
+            gg3 = g3[:, :, i, t] if (t > 0 and i < H - 1) else 0.0
+            mix = 0.0
+            if t > 0:
+                if i > 0:
+                    mix = mix + gg1 * h[:, :, i - 1, t - 1]
+                mix = mix + gg2 * h[:, :, i, t - 1]
+                if i < H - 1:
+                    mix = mix + gg3 * h[:, :, i + 1, t - 1]
+            cc = cm[:, :, i, t]
+            h[:, :, i, t] = cc * x[:, :, i, t] + (1 - cc) * mix
+    return h
+
+
+@pytest.mark.parametrize("horizontal,reverse", [(True, False), (True, True),
+                                                (False, False), (False, True)])
+def test_spn_forward_all_directions(horizontal, reverse):
+    r = _rs(1)
+    shape = (2, 3, 5, 6)
+    x = r.rand(*shape).astype(np.float32)
+    g1, g2, g3 = (r.rand(*shape).astype(np.float32) / 3 for _ in range(3))
+    out = mx.nd.SPN(mx.nd.array(x), mx.nd.array(g1), mx.nd.array(g2),
+                    mx.nd.array(g3), horizontal=horizontal, reverse=reverse)
+    ref = _spn_ref(x, g1, g2, g3, horizontal, reverse)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("horizontal,reverse", [(True, False), (False, True)])
+def test_scn_forward(horizontal, reverse):
+    r = _rs(2)
+    shape = (2, 2, 4, 5)
+    x = r.rand(*shape).astype(np.float32)
+    g1, g2, g3 = (r.rand(*shape).astype(np.float32) / 3 for _ in range(3))
+    cm = r.rand(*shape).astype(np.float32)
+    out = mx.nd.SCN(mx.nd.array(x), mx.nd.array(g1), mx.nd.array(g2),
+                    mx.nd.array(g3), mx.nd.array(cm),
+                    horizontal=horizontal, reverse=reverse)
+    ref = _scn_ref(x, g1, g2, g3, cm, horizontal, reverse)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def _fd_grad(fn, x, seed_grad, eps=1e-3):
+    """Finite-difference dL/dx for L = sum(fn(x) * seed_grad)."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = ((fn(xp) * seed_grad).sum()
+                  - (fn(xm) * seed_grad).sum()) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_spn_gradient_matches_fd():
+    # the reference test checks FD on single elements (test_spn.py); we
+    # check the whole (small) gate tensor at once
+    r = _rs(3)
+    shape = (1, 1, 3, 4)
+    x = r.rand(*shape).astype(np.float64)
+    g1, g2, g3 = (r.rand(*shape).astype(np.float64) / 3 for _ in range(3))
+    seed = r.rand(*shape).astype(np.float64)
+
+    xs = [mx.nd.array(a) for a in (x, g1, g2, g3)]
+    for a in xs:
+        a.attach_grad()
+    with autograd.record():
+        out = mx.nd.SPN(*xs, horizontal=True, reverse=False)
+    out.backward(mx.nd.array(seed))
+    fd = _fd_grad(lambda g2v: _spn_ref(x, g1, g2v, g3, True, False),
+                  g2, seed)
+    np.testing.assert_allclose(xs[2].grad.asnumpy(), fd, rtol=1e-2,
+                               atol=1e-4)
+    fd_x = _fd_grad(lambda xv: _spn_ref(xv, g1, g2, g3, True, False),
+                    x, seed)
+    np.testing.assert_allclose(xs[0].grad.asnumpy(), fd_x, rtol=1e-2,
+                               atol=1e-4)
+
+
+def test_navg_forward_backward():
+    # ground truth from test_nAvg.py: mean over channels of values above
+    # the threshold, gradient 1/count to contributing elements
+    r = _rs(4)
+    shape = (2, 4, 3, 3)
+    x = (10 * r.rand(*shape) - 1).astype(np.float64)
+    out = mx.nd.nAvg(mx.nd.array(x), threshold=0.5)
+    m = x > 0.5
+    cnt = m.sum(1)
+    assert (cnt > 0).all()  # seed chosen so no 0-count positions
+    np.testing.assert_allclose(out.asnumpy()[:, 0], (x * m).sum(1) / cnt,
+                               rtol=1e-5)
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        o = mx.nd.nAvg(xa, threshold=0.5)
+    seed = np.zeros(shape); seed[:, 0] = 1.0
+    o.backward(mx.nd.array(seed))
+    exp = m / cnt[:, None]
+    np.testing.assert_allclose(xa.grad.asnumpy(), exp, rtol=1e-5)
+
+
+def test_lsoftmax_margin_math():
+    r = _rs(5)
+    x = r.randn(6, 10).astype(np.float32)
+    w = r.randn(4, 10).astype(np.float32)
+    lab = np.array([0, 1, 2, 3, 0, 1], np.float32)
+    margin, beta = 2, 1.0
+    # eval mode: plain FC
+    out, xn, wn = mx.nd.LSoftmax(mx.nd.array(x), mx.nd.array(w),
+                                 mx.nd.array(lab), num_hidden=4,
+                                 margin=margin, beta=beta)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T, rtol=1e-4)
+    # train mode via autograd (is_train=True): numeric reference
+    xs, ws = mx.nd.array(x), mx.nd.array(w)
+    xs.attach_grad(); ws.attach_grad()
+    with autograd.record():
+        o, _, _ = mx.nd.LSoftmax(xs, ws, mx.nd.array(lab), num_hidden=4,
+                                 margin=margin, beta=beta)
+    ref = x @ w.T
+    xnorm = np.linalg.norm(x, axis=1)
+    wnorm = np.linalg.norm(w, axis=1)
+    for i, yi in enumerate(lab.astype(int)):
+        fo = ref[i, yi]
+        cos_t = fo / (xnorm[i] * wnorm[yi])
+        # margin=2: cos(2t) = 2cos^2 - 1; k = 0 if cos_t >= cos(pi/2)=0
+        k = 0 if cos_t >= 0 else 1
+        cos_mt = 2 * cos_t * cos_t - 1
+        f = ((-1) ** k * cos_mt - 2 * k) * xnorm[i] * wnorm[yi]
+        ref[i, yi] = (f + beta * fo) / (1 + beta)
+    np.testing.assert_allclose(o.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    # gradient exists and is finite
+    o.backward(mx.nd.array(np.ones_like(ref)))
+    assert np.isfinite(xs.grad.asnumpy()).all()
+    assert np.isfinite(ws.grad.asnumpy()).all()
+
+
+def test_multi_logistic_and_weighted_l1_grads():
+    r = _rs(6)
+    x = r.randn(3, 5).astype(np.float32)
+    lab = (r.rand(3, 5) > 0.5).astype(np.float32)
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        o = mx.nd.MultiLogistic(xa, mx.nd.array(lab), grad_scale=0.5,
+                                weight=2.0)
+    sig = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(o.asnumpy(), sig, rtol=1e-5)
+    o.backward()
+    diff = sig - lab
+    exp = 0.5 * (diff * lab * 2.0 + diff * (1 - lab))
+    np.testing.assert_allclose(xa.grad.asnumpy(), exp, rtol=1e-4)
+
+    lab2 = np.abs(r.randn(3, 5)).astype(np.float32)
+    lab2[0, :] = 0  # masked out
+    xb = mx.nd.array(x)
+    xb.attach_grad()
+    with autograd.record():
+        o = mx.nd.WeightedL1(xb, mx.nd.array(lab2), grad_scale=2.0)
+    np.testing.assert_allclose(o.asnumpy(), x, rtol=1e-6)
+    o.backward()
+    exp = 2.0 * np.sign(x - lab2) * (lab2 > 0)
+    np.testing.assert_allclose(xb.grad.asnumpy(), exp, rtol=1e-5)
+
+
+def test_correlation1d():
+    r = _rs(7)
+    n, c, h, w = 1, 3, 4, 12
+    d1 = r.randn(n, c, h, w).astype(np.float32)
+    d2 = r.randn(n, c, h, w).astype(np.float32)
+    max_d, pad = 2, 2
+    out = mx.nd.Correlation1D(mx.nd.array(d1), mx.nd.array(d2),
+                              kernel_size=1, max_displacement=max_d,
+                              stride1=1, stride2=1, pad_size=pad)
+    assert out.shape == (n, 2 * max_d + 1, h, w)
+    # reference: out[:, tc, y, x] = mean_c d1[y, x] * d2[y, x + tc - max_d]
+    d1p = np.pad(d1, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    d2p = np.pad(d2, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    got = out.asnumpy()
+    for tc in range(2 * max_d + 1):
+        s2o = tc - max_d
+        exp = (d1p[:, :, :, max_d:max_d + w]
+               * d2p[:, :, :, max_d + s2o:max_d + s2o + w]).mean(axis=1)
+        np.testing.assert_allclose(got[:, tc], exp, rtol=1e-4, atol=1e-5)
+    # single_side right
+    out_r = mx.nd.Correlation1D(mx.nd.array(d1), mx.nd.array(d2),
+                                kernel_size=1, max_displacement=max_d,
+                                pad_size=pad, single_side=1)
+    assert out_r.shape == (n, max_d + 1, h, w)
+    np.testing.assert_allclose(out_r.asnumpy()[:, 0], got[:, max_d],
+                               rtol=1e-5)
+
+
+def test_correlation1d_single_side_left():
+    r = _rs(8)
+    d1 = r.randn(1, 2, 3, 10).astype(np.float32)
+    d2 = r.randn(1, 2, 3, 10).astype(np.float32)
+    out = mx.nd.Correlation1D(mx.nd.array(d1), mx.nd.array(d2),
+                              kernel_size=1, max_displacement=2,
+                              pad_size=2, single_side=-1)
+    # displacements -(ngr+1)*s2 .. -s2 (reference x_shift = -ngw)
+    assert out.shape == (1, 3, 3, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_navg_zero_count_is_zero():
+    x = np.zeros((1, 3, 2, 2), np.float32)
+    out = mx.nd.nAvg(mx.nd.array(x), threshold=1.0)
+    assert np.isfinite(out.asnumpy()).all()
+    assert (out.asnumpy() == 0).all()

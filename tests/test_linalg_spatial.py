@@ -1,0 +1,227 @@
+"""linalg la_op family + gather_nd/scatter_nd + spatial/warp op tests
+(reference patterns: tests/python/unittest/test_operator.py test_laop*,
+test_stn, test_bilinear_sampler, test_svmoutput)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import check_numeric_gradient, check_symbolic_forward
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def test_linalg_gemm_family():
+    r = _rs()
+    A = r.randn(2, 3, 4).astype(np.float32)
+    B = r.randn(2, 4, 5).astype(np.float32)
+    C = r.randn(2, 3, 5).astype(np.float32)
+    out = mx.nd.linalg_gemm(mx.nd.array(A), mx.nd.array(B), mx.nd.array(C),
+                            alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * A @ B + 0.5 * C, rtol=1e-5)
+    out = mx.nd.linalg_gemm2(mx.nd.array(A), mx.nd.array(B))
+    np.testing.assert_allclose(out.asnumpy(), A @ B, rtol=1e-5)
+    # transposes
+    out = mx.nd.linalg_gemm2(mx.nd.array(A), mx.nd.array(A),
+                             transpose_b=True)
+    np.testing.assert_allclose(out.asnumpy(), A @ A.swapaxes(-1, -2),
+                               rtol=1e-5)
+
+
+def test_linalg_gemm_gradient():
+    a = mx.sym.Variable("A")
+    b = mx.sym.Variable("B")
+    sym = mx.sym.linalg_gemm2(a, b)
+    r = _rs(1)
+    check_numeric_gradient(sym, [r.randn(3, 4).astype(np.float64),
+                                 r.randn(4, 2).astype(np.float64)])
+
+
+def test_linalg_cholesky_family():
+    r = _rs(2)
+    for batch in [(), (3,)]:
+        M = r.randn(*batch, 4, 4).astype(np.float32)
+        spd = M @ M.swapaxes(-1, -2) + 4 * np.eye(4, dtype=np.float32)
+        L = mx.nd.linalg_potrf(mx.nd.array(spd))
+        np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().swapaxes(-1, -2),
+                                   spd, rtol=1e-3, atol=1e-4)
+        inv = mx.nd.linalg_potri(L)
+        np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(spd),
+                                   rtol=1e-2, atol=1e-3)
+        sld = mx.nd.linalg_sumlogdiag(L)
+        np.testing.assert_allclose(
+            sld.asnumpy().reshape(batch),
+            np.log(np.diagonal(L.asnumpy(), axis1=-2, axis2=-1)).sum(-1),
+            rtol=1e-5)
+
+
+def test_linalg_triangular():
+    r = _rs(3)
+    A = np.tril(r.randn(4, 4).astype(np.float32)) + 3 * np.eye(
+        4, dtype=np.float32)
+    B = r.randn(4, 3).astype(np.float32)
+    out = mx.nd.linalg_trmm(mx.nd.array(A), mx.nd.array(B), alpha=2.0)
+    np.testing.assert_allclose(out.asnumpy(), 2 * A @ B, rtol=1e-5)
+    out = mx.nd.linalg_trmm(mx.nd.array(A), mx.nd.array(B.T),
+                            rightside=True)
+    np.testing.assert_allclose(out.asnumpy(), B.T @ A, rtol=1e-5)
+    X = mx.nd.linalg_trsm(mx.nd.array(A), mx.nd.array(B))
+    np.testing.assert_allclose(A @ X.asnumpy(), B, rtol=1e-3, atol=1e-5)
+    X = mx.nd.linalg_trsm(mx.nd.array(A), mx.nd.array(B), transpose=True)
+    np.testing.assert_allclose(A.T @ X.asnumpy(), B, rtol=1e-3, atol=1e-5)
+
+
+def test_linalg_syrk_gelqf_syevd():
+    r = _rs(4)
+    A = r.randn(2, 3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        mx.nd.linalg_syrk(mx.nd.array(A), alpha=1.5).asnumpy(),
+        1.5 * A @ A.swapaxes(-1, -2), rtol=1e-4)
+    np.testing.assert_allclose(
+        mx.nd.linalg_syrk(mx.nd.array(A), transpose=True).asnumpy(),
+        A.swapaxes(-1, -2) @ A, rtol=1e-4)
+    Q, L = mx.nd.linalg_gelqf(mx.nd.array(A))
+    Qn, Ln = Q.asnumpy(), L.asnumpy()
+    np.testing.assert_allclose(Ln @ Qn, A, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(Qn @ Qn.swapaxes(-1, -2),
+                               np.broadcast_to(np.eye(3), (2, 3, 3)),
+                               atol=1e-5)
+    assert (np.diagonal(Ln, axis1=-2, axis2=-1) > 0).all()
+    M = r.randn(4, 4).astype(np.float32)
+    spd = M @ M.T + 4 * np.eye(4, dtype=np.float32)
+    U, W = mx.nd.linalg_syevd(mx.nd.array(spd))
+    Un, Wn = U.asnumpy(), W.asnumpy()
+    np.testing.assert_allclose(Un.T @ np.diag(Wn) @ Un, spd, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_gather_nd_scatter_nd():
+    data = mx.nd.array(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    idx = mx.nd.array(np.array([[0, 1, 1], [2, 0, 2]], np.float32))
+    out = mx.nd.gather_nd(data, idx)
+    np.testing.assert_array_equal(
+        out.asnumpy(), [[8, 9, 10, 11], [12, 13, 14, 15], [20, 21, 22, 23]])
+    sc = mx.nd.scatter_nd(mx.nd.array(np.array([9., 8, 7], np.float32)),
+                          mx.nd.array(np.array([[0, 2, 4]], np.float32)),
+                          shape=(6,))
+    np.testing.assert_array_equal(sc.asnumpy(), [9, 0, 8, 0, 7, 0])
+    # gather_nd gradient scatters (adds) into data
+    d = mx.nd.array(np.ones((3, 2), np.float32))
+    d.attach_grad()
+    with autograd.record():
+        y = mx.nd.gather_nd(d, mx.nd.array(np.array([[1, 1]], np.float32)))
+    y.backward()
+    np.testing.assert_array_equal(d.grad.asnumpy(),
+                                  [[0, 0], [2, 2], [0, 0]])
+
+
+def test_grid_generator_bilinear_sampler():
+    r = _rs(5)
+    data = r.randn(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                               target_shape=(5, 7))
+    assert grid.shape == (2, 2, 5, 7)
+    out = mx.nd.BilinearSampler(mx.nd.array(data), grid)
+    np.testing.assert_allclose(out.asnumpy(), data, rtol=1e-4, atol=1e-5)
+    # half-pixel x-shift via warp flow
+    flow = np.zeros((2, 2, 5, 7), np.float32)
+    flow[:, 0] = 1.0  # shift source x by +1 pixel
+    gw = mx.nd.GridGenerator(mx.nd.array(flow), transform_type="warp")
+    out2 = mx.nd.BilinearSampler(mx.nd.array(data), gw).asnumpy()
+    np.testing.assert_allclose(out2[:, :, :, :-1], data[:, :, :, 1:],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer():
+    r = _rs(6)
+    data = r.randn(2, 3, 6, 6).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(theta),
+                                   target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    np.testing.assert_allclose(out.asnumpy(), data, rtol=1e-4, atol=1e-5)
+    # gradient flows to loc
+    d = mx.nd.array(data)
+    t = mx.nd.array(theta)
+    t.attach_grad()
+    with autograd.record():
+        y = mx.nd.SpatialTransformer(d, t, target_shape=(6, 6),
+                                     transform_type="affine",
+                                     sampler_type="bilinear")
+    y.backward()
+    assert np.abs(t.grad.asnumpy()).sum() > 0
+
+
+def test_upsampling():
+    x = np.arange(8).reshape(1, 2, 2, 2).astype(np.float32)
+    up = mx.nd.UpSampling(mx.nd.array(x), scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 4, 4)
+    np.testing.assert_array_equal(up.asnumpy()[0, 1, :2, :2],
+                                  [[4, 4], [4, 4]])
+    # multi-input concat: second input upsampled to match the first
+    a = np.ones((1, 1, 4, 4), np.float32)
+    b = np.ones((1, 1, 2, 2), np.float32) * 2
+    out = mx.nd.UpSampling(mx.nd.array(a), mx.nd.array(b), scale=2,
+                           sample_type="nearest", num_args=2)
+    assert out.shape == (1, 2, 8, 8)
+    assert (out.asnumpy()[0, 0] == 1).all() and (out.asnumpy()[0, 1] == 2).all()
+    # bilinear: partition of unity in the interior for constant input
+    def bilinear_w(c, scale):
+        k = 2 * scale - scale % 2
+        f = np.ceil(k / 2.0)
+        cc = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = (1 - abs(og[0] / f - cc)) * (1 - abs(og[1] / f - cc))
+        w = np.zeros((c, 1, k, k), np.float32)
+        w[:, 0] = filt
+        return w
+
+    xb = np.ones((1, 3, 4, 4), np.float32)
+    ub = mx.nd.UpSampling(mx.nd.array(xb), mx.nd.array(bilinear_w(3, 2)),
+                          scale=2, sample_type="bilinear", num_filter=3,
+                          num_args=2)
+    assert ub.shape == (1, 3, 8, 8)
+    np.testing.assert_allclose(ub.asnumpy()[0, :, 2:6, 2:6], 1.0, rtol=1e-5)
+
+
+def test_svm_output():
+    xs = mx.nd.array(np.array([[2.0, -2.0, 0.5]], np.float32))
+    xs.attach_grad()
+    lab = mx.nd.array(np.array([0.0], np.float32))
+    with autograd.record():
+        y = mx.nd.SVMOutput(xs, lab, margin=1.0)
+    np.testing.assert_array_equal(y.asnumpy(), xs.asnumpy())
+    y.backward()
+    # L2-SVM: true f=2 beyond margin -> 0; wrong f=-2 beyond -> 0;
+    # wrong f=0.5 violating -> 2*(1+0.5)=3
+    np.testing.assert_allclose(xs.grad.asnumpy(), [[0.0, 0.0, 3.0]],
+                               rtol=1e-5)
+    xs2 = mx.nd.array(np.array([[0.5, -0.5]], np.float32))
+    xs2.attach_grad()
+    with autograd.record():
+        y = mx.nd.SVMOutput(xs2, mx.nd.array(np.array([0.0], np.float32)),
+                            margin=1.0, use_linear=True,
+                            regularization_coefficient=0.5)
+    y.backward()
+    # L1: true f=0.5 < margin -> -0.5; wrong f=-0.5 > -margin -> +0.5
+    np.testing.assert_allclose(xs2.grad.asnumpy(), [[-0.5, 0.5]], rtol=1e-5)
+
+
+def test_symbol_composition_linalg():
+    # the new ops compose in Symbol graphs with inferred shapes
+    A = mx.sym.Variable("A")
+    out = mx.sym.linalg_syrk(mx.sym.linalg_potrf(A))
+    arg_shapes, out_shapes, _ = out.infer_shape(A=(5, 5))
+    assert out_shapes == [(5, 5)]
+
+
+def test_sumlogdiag_2d_shape_convention():
+    # single matrix yields (1,), matching the reference's output shape
+    L = mx.nd.array(np.diag([1.0, 2.0, 4.0]).astype(np.float32))
+    out = mx.nd.linalg_sumlogdiag(L)
+    assert out.shape == (1,)
+    np.testing.assert_allclose(out.asnumpy()[0], np.log(8.0), rtol=1e-5)
