@@ -10,9 +10,10 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concatenate, moveaxis, waitall)
 from . import op
 from . import _internal
+from . import contrib
 from .register import populate_namespaces as _populate
 
-_populate(op, _internal)
+_populate(op, _internal, contrib)
 
 # expose generated ops at package level: nd.relu, nd.FullyConnected, ...
 globals().update(

@@ -1,0 +1,2 @@
+"""nd.contrib namespace: `_contrib_X` registry ops exposed as contrib.X
+(reference: python/mxnet/ndarray/contrib.py — same codegen-at-import)."""

@@ -18,6 +18,7 @@ from . import linalg
 from . import nn
 from . import spatial
 from . import fork_ops
+from . import detection
 from . import optimizer_ops
 from . import random_ops
 from . import rnn

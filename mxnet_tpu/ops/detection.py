@@ -1,0 +1,368 @@
+"""Detection ops: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection and
+ROIPooling (reference: src/operator/contrib/multibox_prior-inl.h/.cc,
+multibox_target-inl.h/.cc, multibox_detection-inl.h/.cc,
+src/operator/roi_pooling.cc).
+
+TPU-first design: everything is FIXED-shape. The reference's dynamic pieces
+— bipartite matching's data-dependent while loop, detection compaction to
+``valid_count``, sequential NMS — become bounded ``lax.fori_loop``s and
+masked/padded tensors (invalid rows are -1, exactly the reference's padding
+value), so the whole SSD train/infer graph stays inside one XLA program
+with no host synchronization.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .param import Bool, Float, Int, Shape, FloatList
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _iou(boxes_a, boxes_b):
+    """Pairwise IoU of corner boxes: (..., A, 4) x (..., B, 4) → (..., A, B)."""
+    jnp = _jnp()
+    ax1, ay1, ax2, ay2 = [boxes_a[..., :, None, i] for i in range(4)]
+    bx1, by1, bx2, by2 = [boxes_b[..., None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _register():
+    import jax
+
+    jnp = _jnp()
+
+    # --- MultiBoxPrior -----------------------------------------------------
+    def multibox_prior(attrs, data):
+        h, w = data.shape[2], data.shape[3]
+        sizes = list(attrs.sizes)
+        ratios = list(attrs.ratios)
+        steps = list(attrs.steps)
+        offs = list(attrs.offsets)
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offs[0]) * step_y
+        cx = (jnp.arange(w, dtype=jnp.float32) + offs[1]) * step_x
+        # anchor (w/2, h/2) list: all sizes at ratio 1, then ratios[1:] at
+        # sizes[0] (multibox_prior.cc MultiBoxPriorForward)
+        whs = [(s * h / w / 2.0, s / 2.0) for s in sizes]
+        whs += [(sizes[0] * h / w * np.sqrt(r) / 2.0,
+                 sizes[0] / np.sqrt(r) / 2.0) for r in ratios[1:]]
+        half = jnp.asarray(whs, jnp.float32)  # (A, 2) = (w/2, h/2)
+        ctr = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"),
+                        axis=-1).reshape(-1, 2)  # (hw, [cy, cx])
+        cxy = ctr[:, None, :]
+        out = jnp.concatenate(
+            [cxy[..., 1:2] - half[None, :, 0:1],   # xmin
+             cxy[..., 0:1] - half[None, :, 1:2],   # ymin
+             cxy[..., 1:2] + half[None, :, 0:1],   # xmax
+             cxy[..., 0:1] + half[None, :, 1:2]],  # ymax
+            axis=-1).reshape(1, -1, 4)
+        if attrs.clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    def prior_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        a = len(attrs.sizes) - 1 + len(attrs.ratios)
+        return ([d], [(1, d[2] * d[3] * a, 4)], aux_shapes)
+
+    register_op(
+        "_contrib_MultiBoxPrior", multibox_prior,
+        params={"sizes": FloatList(default=(1.0,)),
+                "ratios": FloatList(default=(1.0,)),
+                "clip": Bool(default=False),
+                "steps": FloatList(default=(-1.0, -1.0)),
+                "offsets": FloatList(default=(0.5, 0.5))},
+        num_inputs=1, infer_shape=prior_infer,
+        doc="SSD anchor generation over a feature map's grid (reference: "
+            "src/operator/contrib/multibox_prior.cc)")
+
+    # --- MultiBoxTarget ----------------------------------------------------
+    def multibox_target(attrs, anchor, label, cls_pred):
+        variances = list(attrs.variances)
+        num_anchors = anchor.shape[1]
+        A = anchor.reshape(-1, 4).astype(jnp.float32)
+        labels = label.astype(jnp.float32)
+        n_batch, num_labels = labels.shape[0], labels.shape[1]
+
+        def per_sample(lab, cls_p):
+            # lab (num_labels, width>=5), cls_p (num_classes, num_anchors)
+            valid = lab[:, 0] >= 0
+            # -1 rows terminate the list; everything after the first -1 is
+            # invalid (reference breaks at the first -1 row)
+            valid = jnp.cumprod(valid.astype(jnp.int32)) > 0
+            gt = lab[:, 1:5]
+            overlaps = _iou(A, gt) * valid[None, :].astype(jnp.float32)
+
+            # stage 1: greedy bipartite matching, one gt per iteration
+            def bip_body(_, state):
+                match_iou, match_gt, a_matched, g_matched = state
+                masked = jnp.where(a_matched[:, None] | g_matched[None, :],
+                                   -1.0, overlaps)
+                flat = jnp.argmax(masked).astype(jnp.int32)
+                bi, bg = flat // num_labels, flat % num_labels
+                biou = masked[bi, bg]
+                ok = biou > 1e-6
+                match_iou = jnp.where(ok, match_iou.at[bi].set(biou),
+                                      match_iou)
+                match_gt = jnp.where(ok, match_gt.at[bi].set(
+                    bg.astype(jnp.int32)), match_gt)
+                a_matched = jnp.where(ok, a_matched.at[bi].set(True),
+                                      a_matched)
+                g_matched = jnp.where(ok, g_matched.at[bg].set(True),
+                                      g_matched)
+                return match_iou, match_gt, a_matched, g_matched
+
+            state = (jnp.full((num_anchors,), -1.0),
+                     jnp.full((num_anchors,), -1, jnp.int32),
+                     jnp.zeros((num_anchors,), bool),
+                     jnp.zeros((num_labels,), bool))
+            match_iou, match_gt, a_matched, _ = jax.lax.fori_loop(
+                0, num_labels, bip_body, state)
+
+            # stage 2: per-anchor best gt; > overlap_threshold → positive
+            best_gt = jnp.argmax(overlaps, axis=1)
+            best_iou = jnp.take_along_axis(overlaps, best_gt[:, None],
+                                           axis=1)[:, 0]
+            if attrs.overlap_threshold > 0:
+                extra = (~a_matched) & (best_iou > attrs.overlap_threshold)
+            else:
+                extra = jnp.zeros_like(a_matched)
+            positive = a_matched | extra
+            match_gt = jnp.where(a_matched, match_gt, best_gt)
+            match_iou = jnp.where(a_matched, match_iou, best_iou)
+
+            num_positive = jnp.sum(positive)
+            if attrs.negative_mining_ratio > 0:
+                # hard-negative mining: highest background-class softmax
+                # prob among candidates below the mining threshold
+                num_neg = jnp.minimum(
+                    (num_positive * attrs.negative_mining_ratio
+                     ).astype(jnp.int32),
+                    num_anchors - num_positive.astype(jnp.int32))
+                probs = jax.nn.softmax(cls_p, axis=0)[0]  # background prob
+                cand = (~positive) & (match_iou < attrs.negative_mining_thresh)
+                # hard negatives: LOWEST background prob = model most
+                # confidently wrong (multibox_target.cc:230-237 sorts by
+                # -prob descending)
+                score = jnp.where(cand, -probs, -jnp.inf)
+                order = jnp.argsort(-score)
+                rank = jnp.zeros((num_anchors,), jnp.int32)
+                rank = rank.at[order].set(jnp.arange(num_anchors,
+                                                     dtype=jnp.int32))
+                negative = cand & (rank < num_neg)
+                ignored = (~positive) & (~negative)
+            else:
+                negative = ~positive
+                ignored = jnp.zeros_like(positive)
+
+            # encode loc targets for positives
+            g = gt[match_gt]
+            aw = A[:, 2] - A[:, 0]
+            ah = A[:, 3] - A[:, 1]
+            ax = (A[:, 0] + A[:, 2]) * 0.5
+            ay = (A[:, 1] + A[:, 3]) * 0.5
+            gw = g[:, 2] - g[:, 0]
+            gh = g[:, 3] - g[:, 1]
+            gx = (g[:, 0] + g[:, 2]) * 0.5
+            gy = (g[:, 1] + g[:, 3]) * 0.5
+            lt = jnp.stack([(gx - ax) / aw / variances[0],
+                            (gy - ay) / ah / variances[1],
+                            jnp.log(jnp.maximum(gw / aw, 1e-12)) / variances[2],
+                            jnp.log(jnp.maximum(gh / ah, 1e-12)) / variances[3]],
+                           axis=1)
+            pos_f = positive.astype(jnp.float32)[:, None]
+            loc_target = (lt * pos_f).reshape(-1)
+            loc_mask = jnp.tile(pos_f, (1, 4)).reshape(-1)
+            cls_id = lab[:, 0][match_gt] + 1.0  # 0 reserved for background
+            cls_target = jnp.where(
+                positive, cls_id,
+                jnp.where(negative, 0.0, attrs.ignore_label))
+            any_gt = jnp.any(valid)
+            cls_target = jnp.where(any_gt, cls_target, attrs.ignore_label)
+            loc_target = jnp.where(any_gt, loc_target, 0.0)
+            loc_mask = jnp.where(any_gt, loc_mask, 0.0)
+            return loc_target, loc_mask, cls_target
+
+        loc_t, loc_m, cls_t = jax.vmap(per_sample)(
+            labels, cls_pred.astype(jnp.float32))
+        return loc_t, loc_m, cls_t
+
+    def target_infer(attrs, in_shapes, aux_shapes):
+        a, l, c = in_shapes
+        if a is None or c is None:
+            return None
+        n = c[0]
+        na = a[1]
+        return ([a, l, c], [(n, na * 4), (n, na * 4), (n, na)], aux_shapes)
+
+    register_op(
+        "_contrib_MultiBoxTarget", multibox_target,
+        params={"overlap_threshold": Float(default=0.5),
+                "ignore_label": Float(default=-1.0),
+                "negative_mining_ratio": Float(default=-1.0),
+                "negative_mining_thresh": Float(default=0.5),
+                "minimum_negative_samples": Int(default=0),
+                "variances": FloatList(default=(0.1, 0.1, 0.2, 0.2))},
+        num_inputs=3, input_names=["anchor", "label", "cls_pred"],
+        num_outputs=3, infer_shape=target_infer,
+        doc="SSD training-target assignment: greedy bipartite matching + "
+            "per-anchor threshold matching + hard-negative mining, as "
+            "bounded fori_loops on fixed shapes (reference: "
+            "src/operator/contrib/multibox_target.cc)")
+
+    # --- MultiBoxDetection -------------------------------------------------
+    def multibox_detection(attrs, cls_prob, loc_pred, anchor):
+        variances = list(attrs.variances)
+        A = anchor.reshape(-1, 4).astype(jnp.float32)
+        num_anchors = A.shape[0]
+
+        def per_sample(cp, lp):
+            # cp (num_classes, num_anchors), lp (num_anchors*4,)
+            lp = lp.reshape(-1, 4).astype(jnp.float32)
+            score = jnp.max(cp[1:], axis=0)
+            cid = jnp.argmax(cp[1:], axis=0).astype(jnp.float32)
+            keep = score >= attrs.threshold
+            # decode
+            aw = A[:, 2] - A[:, 0]
+            ah = A[:, 3] - A[:, 1]
+            ax = (A[:, 0] + A[:, 2]) * 0.5
+            ay = (A[:, 1] + A[:, 3]) * 0.5
+            ox = lp[:, 0] * variances[0] * aw + ax
+            oy = lp[:, 1] * variances[1] * ah + ay
+            ow = jnp.exp(lp[:, 2] * variances[2]) * aw * 0.5
+            oh = jnp.exp(lp[:, 3] * variances[3]) * ah * 0.5
+            boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+            if attrs.clip:
+                boxes = jnp.clip(boxes, 0.0, 1.0)
+            # sort by score desc, invalid to the back
+            order = jnp.argsort(jnp.where(keep, -score, jnp.inf))
+            cid_s = jnp.where(keep[order], cid[order], -1.0)
+            score_s = score[order]
+            boxes_s = boxes[order]
+            if attrs.nms_topk > 0:
+                topk_mask = jnp.arange(num_anchors) < attrs.nms_topk
+                cid_s = jnp.where(topk_mask, cid_s, -1.0)
+            # sequential NMS over the sorted list (O(A) memory)
+            if 0 < attrs.nms_threshold <= 1:
+                # entries past nms_topk are already invalid; don't run
+                # guaranteed-no-op sequential steps
+                n_iter = (min(num_anchors, attrs.nms_topk)
+                          if attrs.nms_topk > 0 else num_anchors)
+
+                def nms_body(i, cids):
+                    cur = cids[i]
+                    iou_i = _iou(boxes_s[i][None, :], boxes_s)[0]
+                    same = (cids == cur) if not attrs.force_suppress \
+                        else jnp.ones_like(cids, bool)
+                    suppress = (jnp.arange(num_anchors) > i) & same \
+                        & (iou_i >= attrs.nms_threshold) & (cids >= 0)
+                    return jnp.where(cur >= 0,
+                                     jnp.where(suppress, -1.0, cids), cids)
+
+                cid_s = jax.lax.fori_loop(0, n_iter, nms_body, cid_s)
+            out = jnp.concatenate(
+                [cid_s[:, None], score_s[:, None], boxes_s], axis=1)
+            invalid = cid_s < 0
+            return jnp.where(invalid[:, None],
+                             jnp.concatenate(
+                                 [jnp.full((num_anchors, 1), -1.0),
+                                  jnp.zeros((num_anchors, 5))], axis=1),
+                             out)
+
+        return jax.vmap(per_sample)(cls_prob.astype(jnp.float32),
+                                    loc_pred.astype(jnp.float32))
+
+    def det_infer(attrs, in_shapes, aux_shapes):
+        c = in_shapes[0]
+        if c is None:
+            return None
+        return (list(in_shapes), [(c[0], c[2], 6)], aux_shapes)
+
+    register_op(
+        "_contrib_MultiBoxDetection", multibox_detection,
+        params={"clip": Bool(default=True), "threshold": Float(default=0.01),
+                "background_id": Int(default=0),
+                "nms_threshold": Float(default=0.5),
+                "force_suppress": Bool(default=False),
+                "variances": FloatList(default=(0.1, 0.1, 0.2, 0.2)),
+                "nms_topk": Int(default=-1)},
+        num_inputs=3, input_names=["cls_prob", "loc_pred", "anchor"],
+        infer_shape=det_infer,
+        doc="SSD decode + per-class NMS with fixed-shape padded output "
+            "rows [id, score, xmin, ymin, xmax, ymax], -1 id = invalid "
+            "(reference: src/operator/contrib/multibox_detection.cc)")
+
+    # --- ROIPooling --------------------------------------------------------
+    def roi_pooling(attrs, data, rois):
+        ph, pw = attrs.pooled_size
+        scale = attrs.spatial_scale
+        n, c, H, W = data.shape
+        x = data.astype(jnp.float32)
+
+        def per_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * scale)
+            y1 = jnp.round(roi[2] * scale)
+            x2 = jnp.round(roi[3] * scale)
+            y2 = jnp.round(roi[4] * scale)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            img = x[bidx]
+            hs = jnp.arange(H, dtype=jnp.float32)
+            ws = jnp.arange(W, dtype=jnp.float32)
+            # bin p covers [start_p, end_p) with floor/ceil per reference
+            py = jnp.arange(ph, dtype=jnp.float32)
+            px = jnp.arange(pw, dtype=jnp.float32)
+            y_lo = jnp.clip(jnp.floor(py * bin_h + y1), 0, H)
+            y_hi = jnp.clip(jnp.ceil((py + 1) * bin_h + y1), 0, H)
+            x_lo = jnp.clip(jnp.floor(px * bin_w + x1), 0, W)
+            x_hi = jnp.clip(jnp.ceil((px + 1) * bin_w + x1), 0, W)
+            my = (hs[None, :] >= y_lo[:, None]) & (hs[None, :] < y_hi[:, None])
+            mx = (ws[None, :] >= x_lo[:, None]) & (ws[None, :] < x_hi[:, None])
+            neg = jnp.float32(-1e30)
+            t1 = jnp.where(my[None, :, :, None], img[:, None, :, :], neg)
+            t1 = jnp.max(t1, axis=2)            # (C, ph, W)
+            t2 = jnp.where(mx[None, None, :, :], t1[:, :, None, :], neg)
+            out = jnp.max(t2, axis=3)           # (C, ph, pw)
+            # empty bins (hi<=lo) yield 0 like the reference's is_empty
+            empty = ((y_hi <= y_lo)[:, None] | (x_hi <= x_lo)[None, :])
+            return jnp.where(empty[None, :, :], 0.0, out)
+
+        out = jax.vmap(per_roi)(rois.astype(jnp.float32))
+        return out.astype(data.dtype)
+
+    def roi_infer(attrs, in_shapes, aux_shapes):
+        d, r = in_shapes
+        if d is None or r is None:
+            return None
+        ph, pw = attrs.pooled_size
+        return ([d, r], [(r[0], d[1], ph, pw)], aux_shapes)
+
+    register_op(
+        "ROIPooling", roi_pooling,
+        params={"pooled_size": Shape(), "spatial_scale": Float()},
+        num_inputs=2, input_names=["data", "rois"], infer_shape=roi_infer,
+        doc="max pooling over region-of-interest bins, rois = "
+            "[batch_idx, x1, y1, x2, y2] (reference: "
+            "src/operator/roi_pooling.cc; masked-max formulation keeps "
+            "shapes static for XLA, autodiff reproduces argmax routing)")
+
+
+_register()

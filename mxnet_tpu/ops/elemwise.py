@@ -212,6 +212,17 @@ def _register_scalar_ops():
         register_op(name, fn, params={"scalar": Float()}, num_inputs=1,
                     infer_shape=_elemwise_infer(1))
 
+    def smooth_l1(attrs, x):
+        # f(x) = 0.5 (sigma x)^2 if |x| < 1/sigma^2 else |x| - 0.5/sigma^2
+        # (reference: elemwise_binary_scalar_op_extended.cc:86,
+        # mshadow_op::smooth_l1_loss) — the SSD localization loss
+        s2 = attrs.scalar * attrs.scalar
+        ax = jnp.abs(x)
+        return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+    register_op("smooth_l1", smooth_l1, params={"scalar": Float(default=1.0)},
+                num_inputs=1, infer_shape=_elemwise_infer(1))
+
 
 _register_unary_ops()
 _register_binary_ops()

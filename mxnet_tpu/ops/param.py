@@ -173,3 +173,25 @@ def params_to_str_dict(fields, params):
             continue
         out[k] = f.to_str(v)
     return out
+
+
+class FloatList(Field):
+    """Tuple-of-float field, parses '(0.1, 0.2)', '0.5', '[1,2]'
+    (the dmlc nnvm::Tuple<float> analog used by detection ops)."""
+
+    def parse(self, v):
+        if v is None:
+            return None
+        if isinstance(v, str):
+            s = v.strip()
+            if s in ("None", ""):
+                return None
+            v = ast.literal_eval(s)
+        if isinstance(v, (int, float, np.integer, np.floating)):
+            return (float(v),)
+        return tuple(float(x) for x in v)
+
+    def to_str(self, v):
+        if v is None:
+            return "None"
+        return "(" + ", ".join(repr(float(x)) for x in v) + ")"

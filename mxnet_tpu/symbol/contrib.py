@@ -1,0 +1,2 @@
+"""sym.contrib namespace: `_contrib_X` registry ops exposed as contrib.X
+(reference: python/mxnet/symbol/contrib.py — same codegen-at-import)."""

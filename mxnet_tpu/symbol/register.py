@@ -79,10 +79,15 @@ def _make_sym_func(opdef):
     return sym_fn
 
 
-def populate_namespaces(op_module, internal_module):
+def populate_namespaces(op_module, internal_module, contrib_module=None):
     for name, opdef in OP_REGISTRY.items():
         fn = _make_sym_func(opdef)
-        if name.startswith("_"):
+        if name.startswith("_contrib_") and contrib_module is not None:
+            setattr(internal_module, name, fn)
+            pub = _make_sym_func(opdef)
+            pub.__name__ = pub.__qualname__ = name[len("_contrib_"):]
+            setattr(contrib_module, name[len("_contrib_"):], pub)
+        elif name.startswith("_"):
             setattr(internal_module, name, fn)
         else:
             setattr(op_module, name, fn)
