@@ -108,7 +108,11 @@ class _GraphProgram:
         return overrides
 
     # --- raw graph evaluation (traced under jit) --------------------------
-    def _eval(self, arg_d, aux_d, rngs, is_train):
+    def _eval(self, arg_d, aux_d, rngs, is_train, callback=None):
+        """Walk the graph once. With ``callback`` (only ever passed from
+        the eager monitor path), fire ``callback(entry_name, value)`` per
+        node output — the reference's per-node monitor hook
+        (GraphExecutor::ExecuteMonCallback, graph_executor.cc:199)."""
         env = {}
         aux_updates = {}
         rng_i = [0]
@@ -143,6 +147,10 @@ class _GraphProgram:
                                         rng=rng)
             for i, o in enumerate(outs):
                 env[(id(node), i)] = o
+                if callback is not None:
+                    # <node>_output entry naming (symbol.py list_outputs)
+                    callback(node.name + "_output" if len(outs) == 1
+                             else "%s_output%d" % (node.name, i), o)
             for e, nv in zip(node.inputs[n_main:], new_aux):
                 src, _ = e
                 if src.is_variable:
@@ -246,6 +254,24 @@ class Executor:
         arg_d = {n: self.arg_dict[n]._data for n in self._arg_names}
         aux_d = {n: self.aux_dict[n]._data for n in self._aux_names}
         rngs = self._rng_keys()
+
+        if self._monitor_callback is not None:
+            # per-node spy pass: fire the callback for every node output
+            # entry (reference: graph_executor.cc:199 ExecuteMonCallback;
+            # monitoring disables bulk exec there too — here it runs one
+            # eager un-jitted forward, and in train mode the compiled
+            # fwd+bwd still runs below for gradients, so a monitored
+            # train step pays roughly two forwards; a debug-only cost)
+            outs, aux_upd = self._prog._eval(
+                arg_d, aux_d, rngs, is_train,
+                callback=lambda name, v: self._monitor_callback(
+                    name, _from_data(v)))
+            if not is_train:
+                for n, nv in aux_upd.items():
+                    self.aux_dict[n]._set_data(nv)
+                self.outputs = [_from_data(o) for o in outs]
+                self._stashed_grads = None
+                return self.outputs
 
         if not is_train:
             outs = self._prog.infer_fn()(arg_d, aux_d, rngs)

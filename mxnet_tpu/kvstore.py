@@ -271,7 +271,13 @@ class KVStore:
             self._updater.set_states(fin.read())
 
     def _send_command_to_servers(self, head, body):
-        pass
+        # the reference ships pickled optimizer commands to PS servers
+        # (python/mxnet/kvstore.py:419-460); this build runs server logic
+        # in-process, so a silent no-op would hide real misuse
+        raise MXNetError(
+            "_send_command_to_servers is a parameter-server RPC; this "
+            "kvstore type (%r) runs updates in-process — use "
+            "set_optimizer() instead" % (self.type,))
 
 
 def _updater_key(key):

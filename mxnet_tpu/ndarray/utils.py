@@ -1,48 +1,193 @@
-"""NDArray save/load (reference: src/ndarray/ndarray.cc:835 NDArray::Save/Load,
+"""NDArray save/load in the reference's dmlc binary format
+(reference: src/ndarray/ndarray.cc:835-1060 — NDArray::Save/Load per-array
+records inside the kMXAPINDArrayListMagic list container; python surface
 python/mxnet/ndarray/utils.py).
 
-The reference's format is a dmlc::Stream binary (magic + stype + shape + ctx +
-dtype + raw bytes, dict-of-name→array container). Here the container is a
-``.npz``-compatible archive with the same dict/list semantics: ``save`` of a
-list stores keys ``arr_0..N``; of a dict stores the names. A reference-format
-binary loader can be added for checkpoint back-compat (tracked gap).
+``save`` writes the reference's exact on-disk layout (V2 records: magic +
+stype + shapes + ctx + dtype + aux + raw bytes), so checkpoints are
+interchangeable with the reference in both directions; ``load`` also reads
+V1 records and this package's earlier ``.npz`` archives.
 """
 from __future__ import annotations
 
+import struct
 import zipfile
 
 import numpy as np
 
+from ..base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["save", "load"]
 
 _LIST_PREFIX = "__mxlist__"
 
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+
+# mshadow type codes (mshadow/base.h TypeFlag)
+_DTYPE_TO_FLAG = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+                  np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+                  np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+                  np.dtype(np.int64): 6}
+_FLAG_TO_DTYPE = {v: k for k, v in _DTYPE_TO_FLAG.items()}
+
+# NDArrayStorageType (include/mxnet/ndarray.h:59-63)
+_STYPE_DEFAULT, _STYPE_RSP, _STYPE_CSR = 0, 1, 2
+
+
+def _write_shape(f, shape):
+    # nnvm::TShape dmlc save: uint32 ndim + uint32 dims (mxnet 1.x)
+    f.write(struct.pack("<I", len(shape)))
+    for d in shape:
+        f.write(struct.pack("<I", int(d)))
+
+
+def _read_shape(f):
+    (ndim,) = struct.unpack("<I", f.read(4))
+    return tuple(struct.unpack("<%dI" % ndim, f.read(4 * ndim)))
+
+
+def _write_array(f, arr):
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    f.write(struct.pack("<I", _V2_MAGIC))
+    if isinstance(arr, RowSparseNDArray):
+        stype, auxes = _STYPE_RSP, [np.asarray(arr._aux[0])]
+    elif isinstance(arr, CSRNDArray):
+        # csr aux order on disk: indptr, indices (ndarray.h CSRAuxType)
+        stype, auxes = _STYPE_CSR, [np.asarray(arr._aux[1]),
+                                    np.asarray(arr._aux[0])]
+    else:
+        stype, auxes = _STYPE_DEFAULT, []
+    f.write(struct.pack("<i", stype))
+    values = np.asarray(arr._data)
+    if values.ndim == 0:
+        # the reference format cannot represent 0-d arrays (an ndim-0
+        # shape on disk means a none/null handle, ndarray.cc:851)
+        raise MXNetError("cannot save a 0-d NDArray in the reference "
+                         ".params format; reshape to (1,) first")
+    if auxes:
+        _write_shape(f, values.shape)     # storage shape
+    _write_shape(f, arr.shape)            # logical shape
+    f.write(struct.pack("<ii", 1, 0))     # context: cpu(0)
+    dt = np.dtype(values.dtype)
+    if dt not in _DTYPE_TO_FLAG:
+        raise MXNetError("dtype %s has no reference save format" % dt)
+    f.write(struct.pack("<i", _DTYPE_TO_FLAG[dt]))
+    for aux in auxes:
+        f.write(struct.pack("<i", _DTYPE_TO_FLAG[np.dtype(aux.dtype)]))
+        _write_shape(f, aux.shape)
+    f.write(np.ascontiguousarray(values).tobytes())
+    for aux in auxes:
+        f.write(np.ascontiguousarray(aux).tobytes())
+
+
+def _read_array(f):
+    (magic,) = struct.unpack("<I", f.read(4))
+    shape = None
+    if magic == _V2_MAGIC:
+        (stype,) = struct.unpack("<i", f.read(4))
+    elif magic == _V1_MAGIC:
+        stype = _STYPE_DEFAULT
+    else:
+        # pre-V1 record: the "magic" IS the ndim (ndarray.cc:900
+        # LegacyTShapeLoad) — fixture tests/python/unittest/legacy_ndarray.v0
+        stype = _STYPE_DEFAULT
+        if magic > 32:
+            raise MXNetError("bad NDArray record magic 0x%x" % magic)
+        shape = tuple(struct.unpack("<%dI" % magic, f.read(4 * magic)))
+    nad = {_STYPE_DEFAULT: 0, _STYPE_RSP: 1, _STYPE_CSR: 2}[stype]
+    storage_shape = _read_shape(f) if nad else None
+    if shape is None:
+        shape = _read_shape(f)
+    if len(shape) == 0:
+        return array(np.zeros((), np.float32))
+    struct.unpack("<ii", f.read(8))  # context, ignored (host load)
+    (type_flag,) = struct.unpack("<i", f.read(4))
+    dt = _FLAG_TO_DTYPE[type_flag]
+    aux_meta = []
+    for _ in range(nad):
+        (aflag,) = struct.unpack("<i", f.read(4))
+        ashape = _read_shape(f)
+        aux_meta.append((_FLAG_TO_DTYPE[aflag], ashape))
+    data_shape = storage_shape if nad else shape
+    n = int(np.prod(data_shape)) if data_shape else 1
+    values = np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(
+        data_shape)
+    auxes = []
+    for adt, ashape in aux_meta:
+        an = int(np.prod(ashape)) if ashape else 1
+        auxes.append(np.frombuffer(f.read(an * adt.itemsize),
+                                   dtype=adt).reshape(ashape))
+    if stype == _STYPE_DEFAULT:
+        return array(values.copy())
+    import jax.numpy as jnp
+
+    from ..context import cpu
+    from .sparse import _sparse_new, CSRNDArray, RowSparseNDArray
+
+    if stype == _STYPE_RSP:
+        return _sparse_new(RowSparseNDArray, jnp.asarray(values.copy()),
+                           (jnp.asarray(auxes[0].copy()),), shape, cpu())
+    # csr on disk: (indptr, indices); our _aux is (indices, indptr)
+    return _sparse_new(CSRNDArray, jnp.asarray(values.copy()),
+                       (jnp.asarray(auxes[1].copy()),
+                        jnp.asarray(auxes[0].copy())), shape, cpu())
+
 
 def save(fname, data):
-    """Save a list or str-keyed dict of NDArrays (reference: mx.nd.save)."""
+    """Save a list or str-keyed dict of NDArrays in the reference's binary
+    format (reference: mx.nd.save → MXNDArraySave, ndarray.cc:1033)."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        npd = {"%s%d" % (_LIST_PREFIX, i): a.asnumpy() for i, a in enumerate(data)}
+        arrays, names = list(data), []
     elif isinstance(data, dict):
-        npd = {k: v.asnumpy() for k, v in data.items()}
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
     else:
-        raise ValueError("data needs to either be a NDArray, list of NDArray or "
-                         "a dict of str to NDArray")
-    # pass a file object so numpy does not append ".npz" — checkpoint file
-    # names must match what the caller asked for (model.py save_checkpoint)
+        raise ValueError("data needs to either be a NDArray, list of "
+                         "NDArray or a dict of str to NDArray")
     with open(fname, "wb") as f:
-        np.savez(f, **npd)
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_array(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nm in names:
+            b = nm.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
 
 
 def load(fname):
-    """Load NDArrays saved by :func:`save` (reference: mx.nd.load)."""
+    """Load NDArrays saved by :func:`save`, the reference, or this
+    package's earlier .npz archives (reference: mx.nd.load)."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        if len(head) == 8 and struct.unpack("<Q", head)[0] == _LIST_MAGIC:
+            f.read(8)  # reserved
+            (n,) = struct.unpack("<Q", f.read(8))
+            arrays = [_read_array(f) for _ in range(n)]
+            (nn,) = struct.unpack("<Q", f.read(8))
+            names = []
+            for _ in range(nn):
+                (ln,) = struct.unpack("<Q", f.read(8))
+                names.append(f.read(ln).decode("utf-8"))
+            if names:
+                return dict(zip(names, arrays))
+            return arrays
+    return _load_npz(fname)
+
+
+def _load_npz(fname):
     try:
         npz = np.load(fname, allow_pickle=False)
     except (zipfile.BadZipFile, ValueError) as e:
-        raise IOError("cannot parse %r as an NDArray archive: %s" % (fname, e))
+        raise IOError("cannot parse %r as an NDArray archive: %s"
+                      % (fname, e))
     keys = list(npz.keys())
     if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
         keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))

@@ -598,16 +598,23 @@ class ModifierCell(BaseRNNCell):
         self._own_params = False
         return self.base_cell.params
 
+    # state shape/weight handling is entirely the wrapped cell's; only the
+    # per-step transform (__call__) differs per modifier subclass
     @property
     def state_info(self):
         return self.base_cell.state_info
 
     def begin_state(self, init_sym=symbol.zeros, **kwargs):
-        assert not self._modified
+        if self._modified:
+            raise MXNetError("cannot request begin_state through an "
+                             "already-consumed modifier")
+        # temporarily lift the wrapped cell's modified latch so it can
+        # build its own initial states
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(init_sym, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            return self.base_cell.begin_state(init_sym, **kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def unpack_weights(self, args):
         return self.base_cell.unpack_weights(args)
@@ -623,12 +630,13 @@ class ZoneoutCell(ModifierCell):
     """(reference: rnn_cell.py:909)"""
 
     def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
-        assert not isinstance(base_cell, FusedRNNCell), \
-            "FusedRNNCell doesn't support zoneout. Please unfuse first."
-        assert not isinstance(base_cell, BidirectionalCell), \
-            "BidirectionalCell doesn't support zoneout since it doesn't " \
-            "support step. Please add ZoneoutCell to the cells underneath " \
-            "instead."
+        for bad, why in ((FusedRNNCell, "unfuse the cell first"),
+                         (BidirectionalCell,
+                          "wrap the inner directional cells instead "
+                          "(bidirectional cells cannot step)")):
+            if isinstance(base_cell, bad):
+                raise MXNetError("ZoneoutCell cannot wrap a %s: %s"
+                                 % (bad.__name__, why))
         super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states

@@ -697,16 +697,30 @@ def load_json(json_str):
     nodes = []
     for rn in raw_nodes:
         op = rn["op"]
-        attrs = rn.get("attrs", rn.get("param", {})) or {}
+        attrs = dict(rn.get("attrs", rn.get("param", {})) or {})
+        # pre-0.9 JSON keeps user attributes (ctx_group, lr_mult, ...)
+        # under a separate "attr" key (legacy_json_util.cc upgrade path)
+        user = dict(rn.get("attr", {}) or {})
         inputs = [(nodes[nid], idx) for nid, idx, *_ in rn["inputs"]]
         if op == "null":
-            node = _Node(None, rn["name"], user_attrs=attrs, inputs=inputs)
+            user.update(attrs)
+            node = _Node(None, rn["name"], user_attrs=user, inputs=inputs)
         else:
             opdef = get_op(op)
             known = {k: v for k, v in attrs.items() if k in opdef.params}
             extra = {k: v for k, v in attrs.items() if k not in opdef.params}
+            extra.update(user)
             node = _Node(op, rn["name"], attrs=known, user_attrs=extra,
                          inputs=inputs)
+            # pre-0.9 graphs list only the main inputs; append the op's aux
+            # state variables (the legacy_json_util.cc:228 upgrade)
+            parsed = opdef.parse_attrs(known)
+            aux_names = opdef.get_aux_names(parsed)
+            n_main = opdef.get_num_inputs(parsed)
+            if aux_names and len(inputs) == n_main:
+                for an in aux_names:
+                    av = _Node(None, "%s_%s" % (rn["name"], an))
+                    node.inputs.append((av, 0))
         nodes.append(node)
     heads = [(nodes[nid], idx) for nid, idx, *_ in graph["heads"]]
     return Symbol(heads)
