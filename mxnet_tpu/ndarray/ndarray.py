@@ -141,7 +141,10 @@ class NDArray:
                 # tensor (reference: CastStorageDispatch, common/utils.h)
                 src = self.astype(other.dtype) \
                     if self.dtype != other.dtype else self
-                cast_storage(src, other.stype).copyto(other)
+                casted = cast_storage(src, other.stype)
+                if other.context != self.context:
+                    casted = casted.copyto(other.context)
+                casted.copyto(other)
                 return other
             other._set_data(
                 jax.device_put(self._data, other.context.jax_device()).astype(

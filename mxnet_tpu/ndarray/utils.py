@@ -56,9 +56,10 @@ def _write_array(f, arr):
     if isinstance(arr, RowSparseNDArray):
         stype, auxes = _STYPE_RSP, [np.asarray(arr._aux[0])]
     elif isinstance(arr, CSRNDArray):
-        # csr aux order on disk: indptr, indices (ndarray.h CSRAuxType)
-        stype, auxes = _STYPE_CSR, [np.asarray(arr._aux[1]),
-                                    np.asarray(arr._aux[0])]
+        # csr aux order on disk: indptr, indices (ndarray.h CSRAuxType
+        # kIndPtr=0, kIdx=1) — same order as this class's _aux
+        stype, auxes = _STYPE_CSR, [np.asarray(arr._aux[0]),
+                                    np.asarray(arr._aux[1])]
     else:
         stype, auxes = _STYPE_DEFAULT, []
     f.write(struct.pack("<i", stype))
@@ -131,10 +132,10 @@ def _read_array(f):
     if stype == _STYPE_RSP:
         return _sparse_new(RowSparseNDArray, jnp.asarray(values.copy()),
                            (jnp.asarray(auxes[0].copy()),), shape, cpu())
-    # csr on disk: (indptr, indices); our _aux is (indices, indptr)
+    # csr _aux matches the disk order: (indptr, indices)
     return _sparse_new(CSRNDArray, jnp.asarray(values.copy()),
-                       (jnp.asarray(auxes[1].copy()),
-                        jnp.asarray(auxes[0].copy())), shape, cpu())
+                       (jnp.asarray(auxes[0].copy()),
+                        jnp.asarray(auxes[1].copy())), shape, cpu())
 
 
 def save(fname, data):

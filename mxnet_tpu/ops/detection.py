@@ -223,10 +223,19 @@ def _register():
         doc="SSD training-target assignment: greedy bipartite matching + "
             "per-anchor threshold matching + hard-negative mining, as "
             "bounded fori_loops on fixed shapes (reference: "
-            "src/operator/contrib/multibox_target.cc)")
+            "src/operator/contrib/multibox_target.cc; "
+            "minimum_negative_samples is accepted and ignored exactly "
+            "like the reference CPU kernel, which never reads it)")
 
     # --- MultiBoxDetection -------------------------------------------------
     def multibox_detection(attrs, cls_prob, loc_pred, anchor):
+        if attrs.background_id != 0:
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "MultiBoxDetection supports background_id=0 only (the "
+                "reference kernel hardcodes channel 0 as background too, "
+                "multibox_detection.cc)")
         variances = list(attrs.variances)
         A = anchor.reshape(-1, 4).astype(jnp.float32)
         num_anchors = A.shape[0]

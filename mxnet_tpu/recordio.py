@@ -162,15 +162,23 @@ class MXRecordIO(object):
         expect_more, first = True, True
         while expect_more:
             head = self.handle.read(8)
+            if len(head) == 0 and first:
+                return None  # clean EOF at a record boundary
             if len(head) < 8:
-                return None if first and not out else None
+                raise MXNetError("truncated RecordIO header in %s"
+                                 % self.uri)
             magic, lrec = struct.unpack("<II", head)
             if magic != _MAGIC:
-                return None
+                raise MXNetError("bad RecordIO magic 0x%x in %s"
+                                 % (magic, self.uri))
             cflag, length = lrec >> 29, lrec & _LEN_MASK
             expect_more = (cflag == 1) if first else (cflag == 2)
             first = False
-            out += self.handle.read(length)
+            payload = self.handle.read(length)
+            if len(payload) != length:
+                raise MXNetError("truncated RecordIO payload in %s"
+                                 % self.uri)
+            out += payload
             pad = (-length) % 4
             if pad:
                 self.handle.read(pad)

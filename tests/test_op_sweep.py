@@ -1,0 +1,411 @@
+"""Registry-wide operator numeric sweep (VERDICT round-2 task #4; reference
+pattern: tests/python/unittest/test_operator.py + the GPU suite's
+check_consistency re-run, tests/python/gpu/test_operator_gpu.py:25).
+
+Every registered op name must appear either in CONFIGS (swept here with
+finite-difference gradient checks and/or forward checks plus a
+jit-vs-eager consistency run) or in SKIP with a pointer to the dedicated
+test that covers it. ``test_every_op_is_covered`` enforces the invariant,
+so newly-registered ops fail CI until they get numeric coverage.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+from mxnet_tpu.ops.registry import OP_REGISTRY
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+_r = np.random.RandomState(7)
+
+
+def _pos(*shape):
+    return (_r.rand(*shape) + 0.5).astype(np.float64)
+
+
+def _sym(*shape):
+    return (_r.rand(*shape) * 1.6 - 0.8).astype(np.float64)
+
+
+def _wide(*shape):
+    return (_r.randn(*shape)).astype(np.float64)
+
+
+def _unit(*shape):
+    return (_r.rand(*shape) * 1.6 - 0.8).astype(np.float64)
+
+
+# Each entry: op name → list of cases. A case is a dict with
+#   inputs : list of np arrays (the op's positional inputs, in order)
+#   params : attr kwargs
+#   grad   : check finite-difference gradients (default True)
+#   ref    : optional numpy callable for a forward value check
+_U = {}  # unary smooth op table: name -> (input gen, numpy ref)
+_U.update({
+    "abs": (_sym, np.abs), "exp": (_sym, np.exp), "log": (_pos, np.log),
+    "log10": (_pos, np.log10), "log2": (_pos, np.log2),
+    "log1p": (_pos, np.log1p), "expm1": (_sym, np.expm1),
+    "sqrt": (_pos, np.sqrt), "rsqrt": (_pos, lambda x: 1 / np.sqrt(x)),
+    "cbrt": (_pos, np.cbrt), "rcbrt": (_pos, lambda x: 1 / np.cbrt(x)),
+    "square": (_sym, np.square),
+    "reciprocal": (_pos, lambda x: 1.0 / x),
+    "negative": (_sym, lambda x: -x),
+    "sin": (_sym, np.sin), "cos": (_sym, np.cos), "tan": (_unit, np.tan),
+    "arcsin": (_unit, np.arcsin), "arccos": (_unit, np.arccos),
+    "arctan": (_sym, np.arctan),
+    "sinh": (_sym, np.sinh), "cosh": (_sym, np.cosh),
+    "tanh": (_sym, np.tanh),
+    "arcsinh": (_sym, np.arcsinh),
+    "arccosh": (lambda *s: _pos(*s) + 1.0, np.arccosh),
+    "arctanh": (_unit, np.arctanh),
+    "degrees": (_sym, np.degrees), "radians": (_sym, np.radians),
+    "sigmoid": (_sym, lambda x: 1 / (1 + np.exp(-x))),
+    "relu": (_sym, lambda x: np.maximum(x, 0)),
+    "softsign": (_sym, lambda x: x / (1 + np.abs(x))),
+    "erf": (_sym, None),
+    "gamma": (_pos, None), "gammaln": (_pos, None),
+    "identity": (_sym, lambda x: x), "_copy": (_sym, lambda x: x),
+})
+
+# non-differentiable / discrete forward-only unary ops
+_U_FWD = {
+    "sign": np.sign, "floor": np.floor, "ceil": np.ceil,
+    "round": np.round, "rint": np.rint, "trunc": np.trunc,
+    "fix": np.trunc, "logical_not": lambda x: (x == 0).astype(np.float64),
+}
+
+_BIN = {  # binary elemwise with gradients
+    "_plus": np.add, "elemwise_add": np.add,
+    "_minus": np.subtract, "_sub": np.subtract,
+    "elemwise_sub": np.subtract,
+    "_mul": np.multiply, "elemwise_mul": np.multiply,
+    "_div": np.divide, "elemwise_div": np.divide,
+    "_power": None, "_hypot": np.hypot,
+    "_maximum": np.maximum, "_minimum": np.minimum,
+}
+_BIN_FWD = {  # forward-only binary
+    "_mod": np.mod,
+    "_equal": lambda a, b: (a == b).astype(np.float64),
+    "_not_equal": lambda a, b: (a != b).astype(np.float64),
+    "_greater": lambda a, b: (a > b).astype(np.float64),
+    "_greater_equal": lambda a, b: (a >= b).astype(np.float64),
+    "_lesser": lambda a, b: (a < b).astype(np.float64),
+    "_lesser_equal": lambda a, b: (a <= b).astype(np.float64),
+}
+
+_BCAST = {}  # broadcast binaries: (B, 1, 4) op (1, 3, 4)
+for _n in ["broadcast_add", "broadcast_plus", "broadcast_sub",
+           "broadcast_minus", "broadcast_mul", "broadcast_div",
+           "broadcast_maximum", "broadcast_minimum", "broadcast_hypot",
+           "broadcast_power"]:
+    _BCAST[_n] = True
+_BCAST_FWD = ["broadcast_mod", "broadcast_equal", "broadcast_not_equal",
+              "broadcast_greater", "broadcast_greater_equal",
+              "broadcast_lesser", "broadcast_lesser_equal"]
+
+_SCALAR = {  # scalar ops, gradient-checked
+    "_plus_scalar": {}, "_minus_scalar": {}, "_rminus_scalar": {},
+    "_mul_scalar": {}, "_div_scalar": {}, "_rdiv_scalar": {},
+    "_power_scalar": {}, "_rpower_scalar": {},
+    "_maximum_scalar": {}, "_minimum_scalar": {}, "_hypot_scalar": {},
+    "smooth_l1": {},
+}
+_SCALAR_FWD = ["_mod_scalar", "_rmod_scalar", "_equal_scalar",
+               "_not_equal_scalar", "_greater_scalar",
+               "_greater_equal_scalar", "_lesser_scalar",
+               "_lesser_equal_scalar"]
+
+_REDUCE = ["sum", "_sum", "sum_axis", "mean", "prod", "nansum", "nanprod",
+           "norm"]
+_REDUCE_FWD = ["max", "max_axis", "min", "min_axis", "argmax", "argmin",
+               "argmax_channel"]
+
+_SHAPE_GRAD = ["Reshape", "reshape", "Flatten", "flatten", "transpose",
+               "expand_dims", "slice", "slice_axis", "crop", "clip",
+               "repeat", "tile", "reverse", "flip", "SwapAxis", "swapaxes",
+               "broadcast_to", "broadcast_axes", "broadcast_axis", "Pad",
+               "pad", "stack", "Concat", "concat", "where",
+               "reshape_like", "Cast", "cast", "stop_gradient",
+               "BlockGrad", "ElementWiseSum", "add_n", "take", "pick",
+               "one_hot", "ones_like", "zeros_like", "SliceChannel",
+               "split", "dot", "batch_dot", "choose_element_0index",
+               "gather_nd", "scatter_nd", "sort", "argsort", "topk"]
+
+SKIP = {
+    # op families with dedicated numeric test files
+    "Convolution": "tests/test_operator.py conv tests + s2d parity",
+    "Deconvolution": "tests/test_gluon.py Conv2DTranspose",
+    "Pooling": "tests/test_operator.py + test_gluon.py pooling",
+    "FullyConnected": "tests/test_operator.py + exec flag parity test",
+    "Activation": "tests/test_operator.py",
+    "BatchNorm": "tests/test_operator.py BN eval dtype + vjp parity",
+    "Dropout": "tests/test_operator.py dropout",
+    "LRN": "tests/test_operator.py",
+    "InstanceNorm": "tests/test_gluon.py",
+    "L2Normalization": "tests/test_operator.py",
+    "LeakyReLU": "tests/test_operator.py",
+    "Embedding": "tests/test_sparse.py sparse-grad embedding",
+    "Softmax": "tests/test_operator.py softmax",
+    "softmax": "tests/test_operator.py softmax",
+    "log_softmax": "tests/test_operator.py",
+    "SoftmaxActivation": "tests/test_operator.py",
+    "SoftmaxOutput": "tests/test_module.py heads",
+    "LinearRegressionOutput": "tests/test_module.py",
+    "LogisticRegressionOutput": "tests/test_module.py",
+    "MAERegressionOutput": "tests/test_module.py",
+    "MakeLoss": "tests/test_detection.py SSD loc loss",
+    "make_loss": "alias of MakeLoss",
+    "softmax_cross_entropy": "tests/test_loss.py",
+    "SequenceLast": "tests/test_rnn.py",
+    "SequenceMask": "tests/test_rnn.py",
+    "SequenceReverse": "tests/test_rnn.py",
+    "RNN": "tests/test_rnn.py fused RNN suite",
+    "Custom": "tests/test_custom_op.py",
+    "ctc_loss": "tests/test_loss.py ctc",
+    "contrib_ctc_loss": "alias, tests/test_loss.py",
+    "_contrib_CTCLoss": "alias, tests/test_loss.py",
+    # detection / spatial / fork / linalg: dedicated files
+    "_contrib_MultiBoxPrior": "tests/test_detection.py",
+    "_contrib_MultiBoxTarget": "tests/test_detection.py",
+    "_contrib_MultiBoxDetection": "tests/test_detection.py",
+    "ROIPooling": "tests/test_detection.py",
+    "GridGenerator": "tests/test_linalg_spatial.py",
+    "BilinearSampler": "tests/test_linalg_spatial.py",
+    "SpatialTransformer": "tests/test_linalg_spatial.py",
+    "UpSampling": "tests/test_linalg_spatial.py",
+    "SVMOutput": "tests/test_linalg_spatial.py",
+    "LSoftmax": "tests/test_fork_ops.py",
+    "MultiLogistic": "tests/test_fork_ops.py",
+    "WeightedL1": "tests/test_fork_ops.py",
+    "nAvg": "tests/test_fork_ops.py",
+    "SPN": "tests/test_fork_ops.py",
+    "SCN": "tests/test_fork_ops.py",
+    "Correlation1D": "tests/test_fork_ops.py",
+    "linalg_gemm": "tests/test_linalg_spatial.py",
+    "linalg_gemm2": "tests/test_linalg_spatial.py",
+    "linalg_potrf": "tests/test_linalg_spatial.py",
+    "linalg_potri": "tests/test_linalg_spatial.py",
+    "linalg_trmm": "tests/test_linalg_spatial.py",
+    "linalg_trsm": "tests/test_linalg_spatial.py",
+    "linalg_sumlogdiag": "tests/test_linalg_spatial.py",
+    "linalg_syrk": "tests/test_linalg_spatial.py",
+    "linalg_gelqf": "tests/test_linalg_spatial.py",
+    "linalg_syevd": "tests/test_linalg_spatial.py",
+    # optimizer update ops: python-reference parity in test_optimizer.py
+    "sgd_update": "tests/test_optimizer.py",
+    "sgd_mom_update": "tests/test_optimizer.py",
+    "mp_sgd_update": "tests/test_optimizer.py",
+    "mp_sgd_mom_update": "tests/test_optimizer.py",
+    "adam_update": "tests/test_optimizer.py",
+    "rmsprop_update": "tests/test_optimizer.py",
+    "rmspropalex_update": "tests/test_optimizer.py",
+    "ftrl_update": "tests/test_optimizer.py",
+    # random samplers: moment tests in test_operator.py random section
+    # plus shape checks here would duplicate; list them explicitly
+    "_random_uniform": "moments: tests/test_operator.py",
+    "_random_normal": "moments: tests/test_operator.py",
+    "_random_gamma": "moments: tests/test_operator.py",
+    "_random_exponential": "moments: tests/test_operator.py",
+    "_random_poisson": "moments: tests/test_operator.py",
+    "_random_negative_binomial": "moments: tests/test_operator.py",
+    "_random_generalized_negative_binomial": "moments: test_operator.py",
+    "_random_uniform_like": "moments: tests/test_operator.py",
+    "_random_normal_like": "moments: tests/test_operator.py",
+    "random_uniform": "alias", "random_normal": "alias",
+    "random_gamma": "alias", "random_exponential": "alias",
+    "random_poisson": "alias", "random_negative_binomial": "alias",
+    "random_generalized_negative_binomial": "alias",
+    "uniform": "alias", "normal": "alias",
+    "_sample_multinomial": "tests/test_operator.py multinomial",
+    "sample_multinomial": "alias",
+    # creation ops: value checks in test_ndarray.py
+    "_zeros": "tests/test_ndarray.py", "_ones": "tests/test_ndarray.py",
+    "_full": "tests/test_ndarray.py", "_arange": "tests/test_ndarray.py",
+}
+
+
+def _build_cases():
+    cases = []  # (op_name, case_id, inputs, params, grad, ref)
+    for name, (gen, ref) in _U.items():
+        cases.append((name, "u", [gen(3, 4)], {}, True, ref))
+    for name, ref in _U_FWD.items():
+        cases.append((name, "u", [_sym(3, 4)], {}, False, ref))
+    for name, ref in _BIN.items():
+        a, b = (_pos(3, 4), _pos(3, 4)) if name == "_power" \
+            else (_sym(3, 4), _sym(3, 4) + 2.0)
+        cases.append((name, "b", [a, b], {}, True, ref))
+    for name, ref in _BIN_FWD.items():
+        cases.append((name, "b", [_sym(3, 4), _sym(3, 4)], {}, False, ref))
+    for name in _BCAST:
+        a, b = _pos(2, 1, 4), _pos(1, 3, 4)
+        cases.append((name, "bc", [a, b], {}, True, None))
+    for name in _BCAST_FWD:
+        cases.append((name, "bc", [_sym(2, 1, 4), _sym(1, 3, 4)], {},
+                      False, None))
+    for name, extra in _SCALAR.items():
+        cases.append((name, "s", [_pos(3, 4)],
+                      dict({"scalar": 1.7}, **extra), True, None))
+    for name in _SCALAR_FWD:
+        cases.append((name, "s", [_pos(3, 4)], {"scalar": 0.7}, False,
+                      None))
+    for name in _REDUCE:
+        p = {"axis": 1} if name in ("sum_axis",) else {}
+        cases.append((name, "r", [_pos(3, 4)], p, True, None))
+    for name in _REDUCE_FWD:
+        p = {"axis": 1} if name in ("max_axis", "min_axis", "argmax",
+                                    "argmin") else {}
+        cases.append((name, "r", [_sym(3, 4)], p, False, None))
+    shaped = {
+        "Reshape": ([_sym(2, 6)], {"shape": (3, 4)}),
+        "reshape": ([_sym(2, 6)], {"shape": (4, 3)}),
+        "Flatten": ([_sym(2, 3, 2)], {}),
+        "flatten": ([_sym(2, 3, 2)], {}),
+        "transpose": ([_sym(2, 3, 4)], {"axes": (2, 0, 1)}),
+        "expand_dims": ([_sym(3, 4)], {"axis": 1}),
+        "slice": ([_sym(4, 5)], {"begin": (1, 0), "end": (3, 4)}),
+        "slice_axis": ([_sym(4, 5)], {"axis": 1, "begin": 1, "end": 4}),
+        "crop": ([_sym(4, 5)], {"begin": (0, 1), "end": (3, 4)}),
+        "clip": ([_sym(3, 4)], {"a_min": -0.4, "a_max": 0.4}),
+        "repeat": ([_sym(2, 3)], {"repeats": 2, "axis": 1}),
+        "tile": ([_sym(2, 3)], {"reps": (2, 2)}),
+        "reverse": ([_sym(3, 4)], {"axis": 1}),
+        "flip": ([_sym(3, 4)], {"axis": 0}),
+        "SwapAxis": ([_sym(2, 3, 4)], {"dim1": 0, "dim2": 2}),
+        "swapaxes": ([_sym(2, 3, 4)], {"dim1": 1, "dim2": 2}),
+        "broadcast_to": ([_sym(1, 4)], {"shape": (3, 4)}),
+        "broadcast_axes": ([_sym(1, 4)], {"axis": 0, "size": 3}),
+        "broadcast_axis": ([_sym(3, 1)], {"axis": 1, "size": 5}),
+        "Pad": ([_sym(1, 2, 3, 3)],
+                {"mode": "constant",
+                 "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+        "pad": ([_sym(1, 2, 3, 3)],
+                {"mode": "edge", "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+        "stack": ([_sym(3, 4), _sym(3, 4)], {"axis": 1, "num_args": 2}),
+        "Concat": ([_sym(2, 3), _sym(2, 5)], {"dim": 1, "num_args": 2}),
+        "concat": ([_sym(2, 3), _sym(2, 4)], {"dim": 1, "num_args": 2}),
+        "where": ([(_r.rand(3, 4) > 0.5).astype(np.float64),
+                   _sym(3, 4), _sym(3, 4)], {}),
+        "reshape_like": ([_sym(2, 6), _sym(3, 4)], {}),
+        "Cast": ([_sym(3, 4)], {"dtype": "float64"}),
+        "cast": ([_sym(3, 4)], {"dtype": "float64"}),
+        "stop_gradient": ([_sym(3, 4)], {}),
+        "BlockGrad": ([_sym(3, 4)], {}),
+        "ElementWiseSum": ([_sym(3, 4), _sym(3, 4), _sym(3, 4)],
+                           {"num_args": 3}),
+        "add_n": ([_sym(3, 4), _sym(3, 4)], {"num_args": 2}),
+        "dot": ([_sym(3, 4), _sym(4, 2)], {}),
+        "batch_dot": ([_sym(2, 3, 4), _sym(2, 4, 2)], {}),
+        "take": ([_sym(5, 3),
+                  np.array([0.0, 2, 4, 1]).astype(np.float64)], {}),
+        "pick": ([_sym(4, 5),
+                  np.array([0.0, 2, 4, 1]).astype(np.float64)],
+                 {"axis": 1}),
+        "choose_element_0index": ([_sym(4, 5),
+                                   np.array([0.0, 2, 4, 1])], {}),
+        "one_hot": ([np.array([0.0, 2, 1])], {"depth": 4}),
+        "ones_like": ([_sym(3, 4)], {}),
+        "zeros_like": ([_sym(3, 4)], {}),
+        "SliceChannel": ([_sym(2, 6)],
+                         {"num_outputs": 3, "axis": 1}),
+        "split": ([_sym(2, 6)], {"num_outputs": 2, "axis": 1}),
+        "gather_nd": ([_sym(4, 3),
+                       np.array([[0.0, 2, 3]])], {}),
+        "scatter_nd": ([_sym(3), np.array([[0.0, 2, 4]])],
+                       {"shape": (6,)}),
+        "sort": ([_sym(3, 4)], {}),
+        "argsort": ([_sym(3, 4)], {}),
+        "topk": ([_sym(3, 6)], {"k": 2}),
+    }
+    no_grad = {"one_hot", "ones_like", "zeros_like", "argsort", "Cast",
+               "cast", "stop_gradient", "BlockGrad", "gather_nd",
+               "scatter_nd", "sort", "topk", "where",
+               "choose_element_0index", "pick", "take",
+               # multi-output symbols: forward-only here (gradient flow
+               # through Concat covers the split/concat adjoint pair)
+               "SliceChannel", "split"}
+    for name in _SHAPE_GRAD:
+        inputs, params = shaped[name]
+        cases.append((name, "shape", inputs, params,
+                      name not in no_grad, None))
+    return cases
+
+
+_CASES = _build_cases()
+
+
+@pytest.mark.parametrize(
+    "name,kind,inputs,params,grad,ref",
+    _CASES, ids=["%s-%s" % (c[0], c[1]) for c in _CASES])
+def test_op_numeric(name, kind, inputs, params, grad, ref):
+    sym_fn = getattr(mx.sym, name, None)
+    if sym_fn is None:
+        sym_fn = getattr(mx.sym._internal, name)
+    args = [mx.sym.Variable("in%d" % i) for i in range(len(inputs))]
+    sym = sym_fn(*args, **params)
+    loc = {"in%d" % i: a for i, a in enumerate(inputs)}
+    # forward value check when a numpy reference exists
+    if ref is not None:
+        from mxnet_tpu.test_utils import check_symbolic_forward
+
+        check_symbolic_forward(sym, loc, [ref(*inputs)], rtol=1e-4,
+                               atol=1e-5, dtype=np.float64)
+    else:
+        ex = sym.bind(mx.cpu(),
+                      args={k: mx.nd.array(v) for k, v in loc.items()})
+        ex.forward(is_train=False)
+        for o in ex.outputs:
+            assert np.isfinite(o.asnumpy().astype(np.float64)).all(), name
+    if grad:
+        check_numeric_gradient(sym, loc, numeric_eps=1e-4, rtol=1e-2,
+                               atol=1e-4, dtype=np.float64)
+
+
+def test_jit_eager_consistency():
+    """The check_consistency analog for this build: the same graph run
+    compiled (jit) and eager (MXNET_EXEC_DISABLE_JIT) must agree — the
+    reference's cpu-vs-gpu dual-execution comparison re-targeted at the
+    two execution paths that exist here (plus f32 vs f64 in
+    test_utils.check_consistency itself)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=5, name="fc")
+    net = mx.sym.Activation(data=net, act_type="tanh")
+    net = mx.sym.FullyConnected(data=net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    x = _r.rand(4, 6).astype(np.float32)
+    lab = np.array([0, 1, 2, 0], np.float32)
+
+    def run():
+        ex = net.simple_bind(mx.cpu(), data=(4, 6), grad_req="write")
+        for k, v in ex.arg_dict.items():
+            v[:] = (np.abs(_r_fixed[k]) if k in _r_fixed else v.asnumpy())
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["softmax_label"][:] = lab
+        ex.forward(is_train=True)
+        ex.backward()
+        return (ex.outputs[0].asnumpy(),
+                {k: g.asnumpy() for k, g in ex.grad_dict.items()})
+
+    rf = np.random.RandomState(3)
+    _r_fixed = {"fc_weight": rf.rand(5, 6), "fc_bias": rf.rand(5),
+                "fc2_weight": rf.rand(3, 5), "fc2_bias": rf.rand(3)}
+    out_jit, g_jit = run()
+    config.set_flag("MXNET_EXEC_DISABLE_JIT", 1)
+    try:
+        out_eager, g_eager = run()
+    finally:
+        config.set_flag("MXNET_EXEC_DISABLE_JIT", None)
+    np.testing.assert_allclose(out_jit, out_eager, rtol=1e-5, atol=1e-6)
+    for k in g_jit:
+        np.testing.assert_allclose(g_jit[k], g_eager[k], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_every_op_is_covered():
+    """Coverage invariant: every registry name is swept or explicitly
+    skipped with a pointer to its dedicated test."""
+    swept = {c[0] for c in _CASES}
+    all_ops = set(OP_REGISTRY.keys())
+    missing = all_ops - swept - set(SKIP)
+    assert not missing, "ops with no numeric coverage: %s" % sorted(missing)
+    stale = (set(SKIP) | swept) - all_ops
+    assert not stale, "sweep mentions unknown ops: %s" % sorted(stale)
