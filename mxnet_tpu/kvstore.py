@@ -115,19 +115,91 @@ class KVStore:
             return merged
         return nd.add_n(*vlist)
 
+    def _reduce_mesh(self):
+        """One-representative-device-per-process mesh for global reduces."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if getattr(self, "_mesh", None) is None:
+            devs = [None] * jax.process_count()
+            for d in jax.devices():
+                if devs[d.process_index] is None:
+                    devs[d.process_index] = d
+            self._mesh = Mesh(np.array(devs), ("p",))
+            self._psum_progs = {}
+        return self._mesh
+
     def _global_reduce(self, merged):
         """Sum the locally-merged value across all worker processes — the
-        dist_sync server-side accumulate (kvstore_dist_server.h:261-312)
-        expressed as an allreduce; every worker then applies the identical
-        update, so weights stay bit-identical across workers."""
+        dist_sync server-side accumulate (kvstore_dist_server.h:261-312) as
+        ONE compiled XLA program: each process contributes its shard of a
+        cross-process global array and the sum runs as an in-program
+        all-reduce over the process axis (ICI/DCN collective on TPU, gloo
+        on the CPU fake cluster) — no per-key host round-trip of the full
+        gradient (SURVEY.md §5.8 design). Every worker applies the
+        identical update, so weights stay bit-identical across workers."""
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+
+        if isinstance(merged, RowSparseNDArray):
+            return self._global_reduce_rsp(merged)
+        if isinstance(merged, BaseSparseNDArray):
+            merged = merged._dense_nd()  # csr: no sparse wire format
+        mesh = self._reduce_mesh()
+        x = merged._data
+        my_dev = mesh.devices.ravel()[jax.process_index()]
+        local = jax.device_put(x[None], my_dev)
+        gshape = (jax.process_count(),) + tuple(x.shape)
+        garr = jax.make_array_from_single_device_arrays(
+            gshape, NamedSharding(mesh, PartitionSpec("p")), [local])
+        key = (gshape, str(x.dtype))
+        if key not in self._psum_progs:
+            self._psum_progs[key] = jax.jit(
+                lambda a: a.sum(axis=0),
+                out_shardings=NamedSharding(mesh, PartitionSpec()))
+        out = self._psum_progs[key](garr)
+        # the replicated result is already on device; no host round-trip
+        from .ndarray.ndarray import _from_data
+
+        return _from_data(out.addressable_data(0), merged.context)
+
+    def _global_reduce_rsp(self, merged):
+        """Row-sparse global merge WITHOUT densifying: workers exchange
+        only (row-id, values) padded to the global max nnz — the
+        EncodeRowSparseKey idea (kvstore_dist.h:444) where wire traffic
+        scales with nnz, not the full table."""
+        import numpy as np
         from jax.experimental import multihost_utils
 
-        from .ndarray.sparse import BaseSparseNDArray
+        from .ndarray.sparse import row_sparse_array
 
-        if isinstance(merged, BaseSparseNDArray):
-            merged = merged._dense_nd()  # variable-nnz across workers
-        stacked = multihost_utils.process_allgather(merged._data)
-        return nd.array(stacked.sum(axis=0), dtype=merged._data.dtype)
+        idx = np.asarray(merged._aux[0])
+        vals = np.asarray(merged._data)
+        nnzs = multihost_utils.process_allgather(
+            np.array([idx.shape[0]], np.int64))
+        # bucket the pad size (next power of two) so the compiled
+        # collective count stays bounded as nnz varies per step
+        max_nnz = int(nnzs.max())
+        max_nnz = 1 << (max_nnz - 1).bit_length() if max_nnz > 1 else 1
+        pad = max_nnz - idx.shape[0]
+        idx_p = np.concatenate([idx, np.full((pad,), -1, idx.dtype)])
+        vals_p = np.concatenate(
+            [vals, np.zeros((pad,) + vals.shape[1:], vals.dtype)])
+        all_idx = multihost_utils.process_allgather(idx_p)
+        all_vals = multihost_utils.process_allgather(vals_p)
+        flat_idx = np.asarray(all_idx).reshape(-1)
+        flat_vals = np.asarray(all_vals).reshape(
+            (-1,) + vals.shape[1:])
+        keep = flat_idx >= 0
+        ui, inv = np.unique(flat_idx[keep], return_inverse=True)
+        out_vals = np.zeros((len(ui),) + vals.shape[1:], vals.dtype)
+        np.add.at(out_vals, inv, flat_vals[keep])
+        return row_sparse_array((out_vals, ui), shape=merged.shape,
+                                ctx=merged.context)
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
@@ -135,7 +207,31 @@ class KVStore:
             if k not in self._data:
                 raise MXNetError("key %r has not been initialized" % (k,))
             merged = self._reduce(vlist)
-            if self._dist and self.num_workers > 1:
+            from .ndarray.sparse import BaseSparseNDArray as _Sp
+
+            if self._gc_active() and not isinstance(merged, _Sp):
+                # quantize the locally-merged gradient; dist wire carries
+                # the packed 2-bit codes (kvstore_dist.h:346 Quantize)
+                import numpy as np
+
+                codes = self._quantize_2bit(k, merged)
+                if self._dist and self.num_workers > 1:
+                    from jax.experimental import multihost_utils
+
+                    packed = self._pack_2bit(codes)
+                    all_packed = np.asarray(
+                        multihost_utils.process_allgather(packed))
+                    deq = sum(self._unpack_2bit(p, codes.size)
+                              .astype(np.float32)
+                              for p in all_packed)
+                    merged = nd.array(
+                        (deq * self._gc_threshold).reshape(codes.shape)
+                        .astype(merged.dtype), ctx=merged.context)
+                else:
+                    merged = nd.array(
+                        (codes.astype(np.float32) * self._gc_threshold)
+                        .astype(merged.dtype), ctx=merged.context)
+            elif self._dist and self.num_workers > 1:
                 merged = self._global_reduce(merged)
             if self._updater is not None:
                 from .ndarray.sparse import BaseSparseNDArray
@@ -228,16 +324,66 @@ class KVStore:
     set_updater = _set_updater
 
     def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression with error feedback (reference:
+        src/kvstore/gradient_compression.h:37-52, quantize_2bit kernel in
+        gradient_compression-inl.h:44-80): each push quantizes
+        residual+grad to {-threshold, 0, +threshold}, keeping the
+        quantization error in a per-key residual. On dist stores the wire
+        carries the packed 2-bit codes (16x smaller than fp32)."""
         ctype = (compression_params or {}).get("type")
-        if ctype not in (None, "none"):
-            # explicit failure beats silently training uncompressed
-            # (reference: src/kvstore/gradient_compression.h 2-bit +
-            # error-feedback; not implemented on the TPU build)
-            raise MXNetError(
-                "gradient compression %r is not implemented; on TPU the "
-                "allreduce rides ICI where 2-bit quantization is not "
-                "profitable" % ctype)
+        if ctype not in (None, "none", "2bit"):
+            raise MXNetError("unsupported gradient compression %r "
+                             "(reference supports '2bit' only)" % ctype)
         self._compression_params = compression_params
+        self._gc_threshold = float(
+            (compression_params or {}).get("threshold", 0.5))
+        if ctype == "2bit" and self._gc_threshold <= 0:
+            raise MXNetError("2bit compression needs threshold > 0, got %g"
+                             % self._gc_threshold)
+        self._gc_residuals = {}
+
+    def _gc_active(self):
+        return (self._compression_params or {}).get("type") == "2bit"
+
+    def _quantize_2bit(self, key, merged):
+        """residual += grad; emit codes in {-1, 0, +1}; residual keeps the
+        quantization error (quantize_2bit Map, gradient_compression-inl.h)."""
+        import numpy as np
+
+        t = self._gc_threshold
+        g = merged.asnumpy().astype(np.float32)
+        buf = self._gc_residuals.setdefault(key, np.zeros(g.shape,
+                                                          np.float32))
+        buf += g
+        codes = np.zeros(g.shape, np.int8)
+        codes[buf >= t] = 1
+        codes[buf <= -t] = -1
+        buf -= codes * t
+        return codes
+
+    @staticmethod
+    def _pack_2bit(codes):
+        """Four 2-bit fields per byte (00 zero, 11 pos, 10 neg) — the
+        reference wire layout (posbits/negbits masks)."""
+        import numpy as np
+
+        flat = codes.reshape(-1)
+        pad = (-len(flat)) % 4
+        flat = np.concatenate([flat, np.zeros(pad, np.int8)])
+        field = np.where(flat == 1, 3, np.where(flat == -1, 2, 0)) \
+            .astype(np.uint8).reshape(-1, 4)
+        shifts = np.array([6, 4, 2, 0], np.uint8)
+        return (field << shifts).sum(axis=1).astype(np.uint8)
+
+    @staticmethod
+    def _unpack_2bit(packed, n):
+        import numpy as np
+
+        shifts = np.array([6, 4, 2, 0], np.uint8)
+        fields = (packed[:, None] >> shifts) & 0x3
+        flat = fields.reshape(-1)[:n]
+        return np.where(flat == 3, 1, np.where(flat == 2, -1, 0)) \
+            .astype(np.int8)
 
     # --- distributed attributes (reference: kvstore.py rank/num_workers) ---
     @property
