@@ -29,12 +29,53 @@ def default_batchify_fn(data):
     return nd.array(data, dtype=data.dtype)
 
 
+def _np_batchify(data):
+    """Worker-side batchify to plain numpy (safe to pickle across
+    processes; the parent wraps to NDArray once per batch — the role of
+    the reference's CPUShared zero-copy NDArrays, dataloader.py:240)."""
+    if isinstance(data[0], tuple):
+        return [_np_batchify(list(i)) for i in zip(*data)]
+    first = data[0]
+    if hasattr(first, "asnumpy"):
+        return np.stack([d.asnumpy() for d in data])
+    return np.asarray(data)
+
+
+_mp_dataset = None
+
+
+def _mp_init(dataset):
+    global _mp_dataset
+    _mp_dataset = dataset
+
+
+def _mp_load(indices):
+    return _np_batchify([_mp_dataset[i] for i in indices])
+
+
+def _mp_load_raw(indices):
+    return [_mp_dataset[i] for i in indices]
+
+
+def _wrap_np(batch):
+    if isinstance(batch, list):
+        return [_wrap_np(b) for b in batch]
+    return nd.array(batch, dtype=batch.dtype)
+
+
 class DataLoader:
-    """Mini-batch loader over a Dataset (reference: dataloader.py:DataLoader)."""
+    """Mini-batch loader over a Dataset (reference: dataloader.py:DataLoader).
+
+    ``thread_pool=True`` (default) runs workers as GIL-releasing threads —
+    the TPU-first choice since decode work is numpy/PIL C code and the
+    batch is device_put once. ``thread_pool=False`` uses spawned worker
+    PROCESSES like the reference's _MultiWorkerIter (dataloader.py:240):
+    workers ship numpy batches back and the parent wraps them, so
+    GIL-bound Python datasets still scale."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0):
+                 num_workers=0, thread_pool=True):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -59,12 +100,54 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._thread_pool = thread_pool
+
+    def _iter_multiprocess(self):
+        """Process-based workers (reference: dataloader.py _MultiWorkerIter
+        + worker_loop). Spawned (not forked: XLA threads make fork unsafe);
+        results come back as numpy and are wrapped once in the parent."""
+        import multiprocessing as mp
+
+        custom_fn = (self._batchify_fn
+                     if self._batchify_fn is not default_batchify_fn
+                     else None)
+        loader = _mp_load_raw if custom_fn else _mp_load
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(self._num_workers, initializer=_mp_init,
+                      initargs=(self._dataset,)) as pool:
+            from collections import deque
+
+            depth = 2 * self._num_workers
+            pending = deque()
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(depth):
+                    pending.append(
+                        pool.apply_async(loader, (list(next(it)),)))
+            except StopIteration:
+                it = None
+            while pending:
+                res = pending.popleft()
+                if it is not None:
+                    try:
+                        pending.append(
+                            pool.apply_async(loader, (list(next(it)),)))
+                    except StopIteration:
+                        it = None
+                got = res.get()
+                # a custom batchify_fn runs in the parent over the raw
+                # samples the workers fetched (the fn may close over
+                # unpicklable state)
+                yield custom_fn(got) if custom_fn else _wrap_np(got)
 
     def __iter__(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._batchify_fn(
                     [self._dataset[idx] for idx in batch])
+            return
+        if not self._thread_pool:
+            yield from self._iter_multiprocess()
             return
 
         def _load(b):

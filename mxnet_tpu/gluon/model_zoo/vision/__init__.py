@@ -8,6 +8,8 @@ from .vgg import *
 from .alexnet import *
 from .mobilenet import *
 from .squeezenet import *
+from .densenet import *
+from .inception import *
 from .resnet import get_resnet, resnet18_v1, resnet34_v1, resnet50_v1, \
     resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2, \
     resnet101_v2, resnet152_v2
@@ -17,6 +19,9 @@ from .alexnet import alexnet
 from .mobilenet import get_mobilenet, mobilenet1_0, mobilenet0_75, \
     mobilenet0_5, mobilenet0_25
 from .squeezenet import squeezenet1_0, squeezenet1_1
+from .densenet import densenet121, densenet161, densenet169, \
+    densenet201
+from .inception import inception_v3
 
 _models = {}
 
@@ -29,7 +34,9 @@ def _register_models():
                  "resnet101_v2", "resnet152_v2", "vgg11", "vgg13", "vgg16",
                  "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
                  "alexnet", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5",
-                 "mobilenet0_25", "squeezenet1_0", "squeezenet1_1"]:
+                 "mobilenet0_25", "squeezenet1_0", "squeezenet1_1",
+                 "densenet121", "densenet161", "densenet169",
+                 "densenet201", "inception_v3"]:
         _models[name] = getattr(mod, name)
 
 

@@ -24,7 +24,7 @@ from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -488,3 +488,114 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
         **kwargs)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
+
+
+class LibSVMIter(DataIter):
+    """Sparse libsvm-format text iterator producing CSR data batches
+    (reference: src/io/iter_libsvm.cc LibSVMIter + iter_sparse_batchloader.h;
+    registered MXNET_REGISTER_IO_ITER(LibSVMIter)).
+
+    Line format: ``<label> <index>:<value> ...`` (0-based indices by
+    default, like the reference's ``indexing_mode``); ``label_libsvm``
+    optionally reads labels (possibly multi-valued sparse rows) from a
+    second file. ``num_parts``/``part_index`` shard rows for distributed
+    training.
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, num_parts=1,
+                 part_index=0, **kwargs):
+        super().__init__(batch_size)
+        from .ndarray.sparse import csr_matrix
+
+        self._csr_matrix = csr_matrix
+        self.batch_size = batch_size
+        feat = int(np.prod(data_shape))
+        self._rows = self._parse(data_libsvm, feat)
+        if label_libsvm is not None:
+            lfeat = int(np.prod(label_shape)) if label_shape else 1
+            lab = self._parse(label_libsvm, lfeat)
+            if len(lab) != len(self._rows):
+                raise MXNetError(
+                    "label file has %d rows but data file has %d"
+                    % (len(lab), len(self._rows)))
+            if lfeat == 1:
+                self._labels = np.array(
+                    [r[1][0] if len(r[1]) else 0.0 for r in lab],
+                    np.float32)
+            else:
+                # multi-valued labels densify to (n, lfeat)
+                dense = np.zeros((len(lab), lfeat), np.float32)
+                for ri, (_, val, idx) in enumerate(lab):
+                    dense[ri, idx] = val
+                self._labels = dense
+                self.provide_label = None  # set below with the real shape
+        else:
+            self._labels = np.array([r[0] for r in self._rows], np.float32)
+        if num_parts > 1:
+            assert 0 <= part_index < num_parts
+            # every row belongs to exactly one part (dmlc InputSplit
+            # semantics: uneven parts, no dropped remainder)
+            bounds = np.linspace(0, len(self._rows), num_parts + 1
+                                 ).astype(int)
+            lo, hi = bounds[part_index], bounds[part_index + 1]
+            self._rows = self._rows[lo:hi]
+            self._labels = self._labels[lo:hi]
+        self._feat = feat
+        self.cur = 0
+        self.provide_data = [DataDesc("data", (batch_size, feat), "float32")]
+        lshape = ((batch_size,) if self._labels.ndim == 1
+                  else (batch_size,) + self._labels.shape[1:])
+        self.provide_label = [DataDesc("softmax_label", lshape, "float32")]
+
+    @staticmethod
+    def _parse(path, num_feat):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                label = float(parts[0].split(",")[0])
+                idx, val = [], []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    i = int(i)
+                    if i >= num_feat:
+                        raise MXNetError(
+                            "libsvm feature index %d out of range %d"
+                            % (i, num_feat))
+                    idx.append(i)
+                    val.append(float(v))
+                rows.append((label, val, idx))
+        return rows
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= len(self._rows):
+            raise StopIteration
+        batch_rows = self._rows[self.cur:self.cur + self.batch_size]
+        labels = self._labels[self.cur:self.cur + self.batch_size]
+        pad = self.batch_size - len(batch_rows)
+        self.cur += len(batch_rows)
+        indptr = [0]
+        indices, values = [], []
+        for _, val, idx in batch_rows:
+            indices.extend(idx)
+            values.extend(val)
+            indptr.append(len(indices))
+        for _ in range(pad):
+            indptr.append(len(indices))
+        data = self._csr_matrix(
+            (np.asarray(values, np.float32),
+             np.asarray(indices, np.int64),
+             np.asarray(indptr, np.int64)),
+            shape=(self.batch_size, self._feat))
+        if pad:
+            lab = np.concatenate(
+                [labels, np.zeros((pad,) + labels.shape[1:], np.float32)])
+        else:
+            lab = labels
+        return DataBatch(data=[data], label=[nd.array(lab)], pad=pad)

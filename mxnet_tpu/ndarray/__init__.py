@@ -24,3 +24,7 @@ from .utils import save, load  # noqa: E402
 from . import sparse  # noqa: E402
 from .sparse import (BaseSparseNDArray, RowSparseNDArray,  # noqa: E402
                      CSRNDArray)
+# stype-dispatching frontend functions on the nd namespace (reference:
+# mx.nd.cast_storage etc. are FComputeEx-dispatched registry ops; here the
+# storage boundary is an eager host-side dispatch, sparse.py module doc)
+from .sparse import (cast_storage, sparse_retain, square_sum)  # noqa: E402

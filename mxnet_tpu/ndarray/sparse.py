@@ -104,8 +104,9 @@ class BaseSparseNDArray(NDArray):
             other._sshape = self._sshape
             return other
         if isinstance(other, NDArray):
-            other._set_data(_jnp().asarray(self._to_dense_raw()).astype(
-                other._data.dtype))
+            other._set_data(jax.device_put(
+                _jnp().asarray(self._to_dense_raw()),
+                other.context.jax_device()).astype(other._data.dtype))
             return other
         raise TypeError("copyto does not support %s" % type(other))
 
@@ -128,6 +129,9 @@ class BaseSparseNDArray(NDArray):
     def __getitem__(self, key):
         raise MXNetError("%s does not support slicing; tostype('default') "
                          "first" % type(self).__name__)
+
+    def slice(self, begin, end):
+        raise MXNetError("%s does not support slicing" % type(self).__name__)
 
     def __repr__(self):
         return "\n<%s %s @%s>" % (type(self).__name__,
@@ -264,6 +268,29 @@ class CSRNDArray(BaseSparseNDArray):
         """Expand indptr to a per-nnz row-id vector (host, eager)."""
         indptr = np.asarray(self._aux[0])
         return np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+
+    def __getitem__(self, key):
+        # row-range slicing, the one indexing form the reference CSRNDArray
+        # supports (python/mxnet/ndarray/sparse.py CSRNDArray.__getitem__)
+        if isinstance(key, slice):
+            if key.step not in (None, 1):
+                raise MXNetError("CSRNDArray slicing supports step=1 only")
+            start, stop, _ = key.indices(self._sshape[0])
+            return self.slice(start, max(stop, start))
+        raise MXNetError("CSRNDArray supports row-slice indexing only")
+
+    def slice(self, begin, end):
+        import jax
+
+        indptr = np.asarray(self._aux[0])
+        lo, hi = int(indptr[begin]), int(indptr[end])
+        new_indptr = indptr[begin:end + 1] - lo
+        dev = self._ctx.jax_device()
+        return _sparse_new(
+            CSRNDArray, jax.device_put(self._data[lo:hi], dev),
+            (jax.device_put(_jnp().asarray(new_indptr), dev),
+             jax.device_put(self._aux[1][lo:hi], dev)),
+            (end - begin,) + self._sshape[1:], self._ctx)
 
     def _to_dense_raw(self):
         jnp = _jnp()

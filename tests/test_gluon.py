@@ -181,3 +181,22 @@ def test_clip_global_norm():
     total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
     assert abs(total - 1.0) < 1e-5
     assert norm > 1.0
+
+
+def test_model_zoo_densenet_inception():
+    # model_zoo tail (reference: model_zoo/vision/densenet.py, inception.py)
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.densenet121(classes=7)
+    net.initialize(mx.init.Xavier())
+    out = net(mx.nd.array(np.random.RandomState(0).rand(
+        1, 3, 224, 224).astype(np.float32)))
+    assert out.shape == (1, 7)
+    net2 = vision.inception_v3(classes=5)
+    net2.initialize(mx.init.Xavier())
+    out2 = net2(mx.nd.array(np.random.RandomState(1).rand(
+        1, 3, 299, 299).astype(np.float32)))
+    assert out2.shape == (1, 5)
+    assert np.isfinite(out2.asnumpy()).all()
+    # registry surface
+    assert "densenet121" in vision._models and "inception_v3" in vision._models

@@ -88,3 +88,66 @@ def test_csv_iter(tmp_path):
     assert len(batches) == 3
     np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
                                rtol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    # reference: src/io/iter_libsvm.cc — sparse text rows to CSR batches
+    path = str(tmp_path / "train.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("2 2:1.0 4:4.0\n")
+        f.write("1 0:0.5 4:1.0\n")
+        f.write("0 3:3.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2)
+    b1 = it.next()
+    assert b1.data[0].stype == "csr"
+    d = b1.data[0].asnumpy()
+    np.testing.assert_allclose(d, [[1.5, 0, 0, 2.0, 0], [0, 0.5, 0, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+    b2 = it.next()
+    b3 = it.next()
+    assert b3.pad == 1  # 5 rows, batch 2 -> last batch padded
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    again = it.next()
+    np.testing.assert_allclose(again.data[0].asnumpy(), d)
+    # sharding
+    p0 = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2,
+                          num_parts=2, part_index=0)
+    p1 = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2,
+                          num_parts=2, part_index=1)
+    assert len(p0._rows) + len(p1._rows) == 5  # no dropped rows
+    # label file variant
+    lpath = str(tmp_path / "lab.libsvm")
+    with open(lpath, "w") as f:
+        for v in [9, 8, 7, 6, 5]:
+            f.write("0 0:%d\n" % v)
+    it2 = mx.io.LibSVMIter(data_libsvm=path, label_libsvm=lpath,
+                           data_shape=(5,), batch_size=5)
+    np.testing.assert_allclose(it2.next().label[0].asnumpy(),
+                               [9, 8, 7, 6, 5])
+
+
+def test_libsvm_multivalue_labels(tmp_path):
+    dpath = str(tmp_path / "d.libsvm")
+    lpath = str(tmp_path / "l.libsvm")
+    with open(dpath, "w") as f:
+        for i in range(3):
+            f.write("0 %d:1.0\n" % i)
+    with open(lpath, "w") as f:
+        f.write("0 0:1.0 2:3.0\n")
+        f.write("0 1:2.0\n")
+        f.write("0\n")
+    it = mx.io.LibSVMIter(data_libsvm=dpath, label_libsvm=lpath,
+                          data_shape=(3,), label_shape=(3,), batch_size=2)
+    b = it.next()
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [[1.0, 0, 3.0], [0, 2.0, 0]])
+    # mismatched label row count raises
+    with open(lpath, "w") as f:
+        f.write("0 0:1.0\n")
+    with pytest.raises(mx.MXNetError):
+        mx.io.LibSVMIter(data_libsvm=dpath, label_libsvm=lpath,
+                         data_shape=(3,), label_shape=(3,), batch_size=2)

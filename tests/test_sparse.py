@@ -311,3 +311,21 @@ def test_row_sparse_pull_out_of_range_raises():
     out = sparse.row_sparse_array(np.zeros((5, 2), np.float32))
     with pytest.raises(_Err):
         kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([1, 99]))
+
+
+def test_csr_row_slice():
+    dense = _rand_dense((6, 4), seed=9)
+    csr = sparse.csr_matrix(dense)
+    sl = csr[1:4]
+    assert sl.stype == "csr" and sl.shape == (3, 4)
+    np.testing.assert_allclose(sl.asnumpy(), dense[1:4])
+    np.testing.assert_allclose(csr[:].asnumpy(), dense)
+    with pytest.raises(mx.MXNetError):
+        csr[::2]
+
+
+def test_csr_empty_slice():
+    csr = sparse.csr_matrix(_rand_dense((5, 3), seed=3))
+    empty = csr[4:2]
+    assert empty.shape == (0, 3)
+    assert empty.asnumpy().shape == (0, 3)
