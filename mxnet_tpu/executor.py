@@ -107,12 +107,37 @@ class _GraphProgram:
         self._init_shape_cache[key] = overrides
         return overrides
 
+    def assign_contexts(self, group2ctx, default_ctx):
+        """Map each node to a device from its ``ctx_group`` user attr —
+        the AssignContext + PlaceDevice pass (graph_executor.cc:317-421);
+        returns {id(node): jax device} for nodes bound off-default."""
+        ctx_map = {}
+        for node in self.topo:
+            if node.is_variable:
+                continue
+            grp = node.user_attrs.get("ctx_group")
+            if grp is None:
+                continue
+            if grp not in group2ctx:
+                raise MXNetError(
+                    "ctx_group %r has no mapping in group2ctx (groups: %s)"
+                    % (grp, sorted(group2ctx)))
+            ctx = group2ctx[grp]
+            if ctx != default_ctx:
+                ctx_map[id(node)] = ctx.jax_device()
+        return ctx_map
+
     # --- raw graph evaluation (traced under jit) --------------------------
-    def _eval(self, arg_d, aux_d, rngs, is_train, callback=None):
+    def _eval(self, arg_d, aux_d, rngs, is_train, callback=None,
+              ctx_map=None):
         """Walk the graph once. With ``callback`` (only ever passed from
         the eager monitor path), fire ``callback(entry_name, value)`` per
         node output — the reference's per-node monitor hook
-        (GraphExecutor::ExecuteMonCallback, graph_executor.cc:199)."""
+        (GraphExecutor::ExecuteMonCallback, graph_executor.cc:199).
+        With ``ctx_map`` (eager model-parallel path), inputs of a mapped
+        node are device_put onto its assigned device first — the
+        _CrossDeviceCopy insertion of the PlaceDevice pass; eager jax
+        dispatch then runs the op on that device."""
         env = {}
         aux_updates = {}
         rng_i = [0]
@@ -139,6 +164,12 @@ class _GraphProgram:
             n_main = node.num_main_inputs()
             ins = [get_entry(e) for e in node.inputs[:n_main]]
             auxs = [get_entry(e) for e in node.inputs[n_main:]]
+            if ctx_map and id(node) in ctx_map:
+                import jax
+
+                dev = ctx_map[id(node)]
+                ins = [jax.device_put(x, dev) for x in ins]
+                auxs = [jax.device_put(x, dev) for x in auxs]
             rng = None
             if opdef.needs_rng:
                 rng = rngs[rng_i[0]]
@@ -196,11 +227,16 @@ class Executor:
     """Bound executor (reference: include/mxnet/executor.h:53, executor.py)."""
 
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 shared_exec=None):
+                 shared_exec=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self._prog = (shared_exec._prog if shared_exec is not None
                       and shared_exec._symbol is symbol else _GraphProgram(symbol))
+        # model parallelism: ctx_group attrs -> devices (reference:
+        # group2ctx through AssignContext, graph_executor.cc:317-421)
+        self._group2ctx = group2ctx
+        self._ctx_map = (self._prog.assign_contexts(group2ctx, self._ctx)
+                         if group2ctx else None)
         self.arg_dict = dict(args)
         self.grad_dict = dict(args_grad or {})
         self.grad_req = dict(grad_req)
@@ -263,7 +299,7 @@ class Executor:
             # fwd+bwd still runs below for gradients, so a monitored
             # train step pays roughly two forwards; a debug-only cost)
             outs, aux_upd = self._prog._eval(
-                arg_d, aux_d, rngs, is_train,
+                arg_d, aux_d, rngs, is_train, ctx_map=self._ctx_map,
                 callback=lambda name, v: self._monitor_callback(
                     name, _from_data(v)))
             if not is_train:
@@ -272,6 +308,13 @@ class Executor:
                 self.outputs = [_from_data(o) for o in outs]
                 self._stashed_grads = None
                 return self.outputs
+
+        if self._ctx_map:
+            # model-parallel graphs run eagerly so each op dispatches on
+            # its assigned device (per-op execution is also what the
+            # reference does — engine pushes per node)
+            return self._forward_model_parallel(is_train, arg_d, aux_d,
+                                                rngs)
 
         if not is_train:
             outs = self._prog.infer_fn()(arg_d, aux_d, rngs)
@@ -291,6 +334,51 @@ class Executor:
             for n, nv in aux_upd.items():
                 self.aux_dict[n]._set_data(nv)
             self._stashed_grads = grads
+        self.outputs = [_from_data(o) for o in outs]
+        return self.outputs
+
+    def _forward_model_parallel(self, is_train, arg_d, aux_d, rngs,
+                                seeds=None, grads_only=False):
+        """group2ctx forward(+backward prep): eager multi-device walk with
+        jax.vjp for gradients; cross-device copies are the device_puts the
+        ctx_map inserts (reference: _CrossDeviceCopy nodes). With
+        ``grads_only`` (the explicit backward(out_grads) recompute) the
+        gradients are returned and NO state is touched — aux states,
+        self.outputs, and stashed grads stay as the user's forward left
+        them (the non-parallel path has the same discard semantics)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import _from_data
+
+        prog = self._prog
+        if not is_train:
+            outs, _ = prog._eval(arg_d, aux_d, rngs, False,
+                                 ctx_map=self._ctx_map)
+            self._stashed_grads = None
+            self.outputs = [_from_data(o) for o in outs]
+            return self.outputs
+        grad_names = tuple(n for n in self._arg_names
+                           if self.grad_req.get(n, "null") != "null")
+        nograd_d = {n: v for n, v in arg_d.items() if n not in grad_names}
+        grad_d = {n: arg_d[n] for n in grad_names}
+
+        def f(gd):
+            merged = dict(nograd_d)
+            merged.update(gd)
+            outs, aux_upd = prog._eval(merged, aux_d, rngs, True,
+                                       ctx_map=self._ctx_map)
+            return tuple(outs), aux_upd
+
+        outs, vjp, aux_upd = jax.vjp(f, grad_d, has_aux=True)
+        if seeds is None:
+            seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+        grads = vjp(tuple(seeds))[0]
+        if grads_only:
+            return grads
+        for n, nv in aux_upd.items():
+            self.aux_dict[n]._set_data(nv)
+        self._stashed_grads = grads
         self.outputs = [_from_data(o) for o in outs]
         return self.outputs
 
@@ -317,13 +405,19 @@ class Executor:
                 out_grads = [out_grads]
             arg_d = {n: self.arg_dict[n]._data for n in self._arg_names}
             aux_d = {n: self.aux_dict[n]._data for n in self._aux_names}
-            grad_names = tuple(n for n in self._arg_names
-                               if self.grad_req.get(n, "null") != "null")
-            nograd_d = {n: v for n, v in arg_d.items() if n not in grad_names}
-            grad_d = {n: arg_d[n] for n in grad_names}
             seeds = tuple(g._data for g in out_grads)
-            _, _, grads = self._prog.train_fn(grad_names)(
-                nograd_d, grad_d, aux_d, self._rng_keys(), seeds)
+            if self._ctx_map:
+                grads = self._forward_model_parallel(
+                    True, arg_d, aux_d, self._rng_keys(), seeds=seeds,
+                    grads_only=True)
+            else:
+                grad_names = tuple(n for n in self._arg_names
+                                   if self.grad_req.get(n, "null") != "null")
+                nograd_d = {n: v for n, v in arg_d.items()
+                            if n not in grad_names}
+                grad_d = {n: arg_d[n] for n in grad_names}
+                _, _, grads = self._prog.train_fn(grad_names)(
+                    nograd_d, grad_d, aux_d, self._rng_keys(), seeds)
         else:
             if self._stashed_grads is None:
                 raise MXNetError("backward() called without a prior "
@@ -383,7 +477,8 @@ class Executor:
             new_aux[name] = old if tuple(old.shape) == tuple(shape) else \
                 nd.zeros(shape, ctx=self._ctx, dtype=old.dtype)
         ex = Executor(self._symbol, self._ctx, new_args, new_grads,
-                      self.grad_req, new_aux, shared_exec=self)
+                      self.grad_req, new_aux, shared_exec=self,
+                      group2ctx=self._group2ctx)
         return ex
 
     def set_monitor_callback(self, callback):

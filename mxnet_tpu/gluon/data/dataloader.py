@@ -102,43 +102,56 @@ class DataLoader:
         self._num_workers = num_workers
         self._thread_pool = thread_pool
 
+    def _get_pool(self):
+        """Workers stay alive across epochs like the reference's
+        _MultiWorkerIter pool; spawned once per loader (fork is unsafe
+        under XLA threads), dataset shipped to workers once."""
+        if getattr(self, "_pool", None) is None:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            self._pool = ctx.Pool(self._num_workers, initializer=_mp_init,
+                                  initargs=(self._dataset,))
+        return self._pool
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.terminate()
+
     def _iter_multiprocess(self):
         """Process-based workers (reference: dataloader.py _MultiWorkerIter
-        + worker_loop). Spawned (not forked: XLA threads make fork unsafe);
-        results come back as numpy and are wrapped once in the parent."""
-        import multiprocessing as mp
-
+        + worker_loop); results come back as numpy and are wrapped once in
+        the parent."""
         custom_fn = (self._batchify_fn
                      if self._batchify_fn is not default_batchify_fn
                      else None)
         loader = _mp_load_raw if custom_fn else _mp_load
-        ctx = mp.get_context("spawn")
-        with ctx.Pool(self._num_workers, initializer=_mp_init,
-                      initargs=(self._dataset,)) as pool:
-            from collections import deque
+        from collections import deque
 
-            depth = 2 * self._num_workers
-            pending = deque()
-            it = iter(self._batch_sampler)
-            try:
-                for _ in range(depth):
+        pool = self._get_pool()
+        depth = 2 * self._num_workers
+        pending = deque()
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(depth):
+                pending.append(
+                    pool.apply_async(loader, (list(next(it)),)))
+        except StopIteration:
+            it = None
+        while pending:
+            res = pending.popleft()
+            if it is not None:
+                try:
                     pending.append(
                         pool.apply_async(loader, (list(next(it)),)))
-            except StopIteration:
-                it = None
-            while pending:
-                res = pending.popleft()
-                if it is not None:
-                    try:
-                        pending.append(
-                            pool.apply_async(loader, (list(next(it)),)))
-                    except StopIteration:
-                        it = None
-                got = res.get()
-                # a custom batchify_fn runs in the parent over the raw
-                # samples the workers fetched (the fn may close over
-                # unpicklable state)
-                yield custom_fn(got) if custom_fn else _wrap_np(got)
+                except StopIteration:
+                    it = None
+            got = res.get()
+            # a custom batchify_fn runs in the parent over the raw
+            # samples the workers fetched (the fn may close over
+            # unpicklable state)
+            yield custom_fn(got) if custom_fn else _wrap_np(got)
 
     def __iter__(self):
         if self._num_workers == 0:

@@ -529,7 +529,6 @@ class LibSVMIter(DataIter):
                 for ri, (_, val, idx) in enumerate(lab):
                     dense[ri, idx] = val
                 self._labels = dense
-                self.provide_label = None  # set below with the real shape
         else:
             self._labels = np.array([r[0] for r in self._rows], np.float32)
         if num_parts > 1:

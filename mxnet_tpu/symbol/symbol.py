@@ -534,7 +534,7 @@ class Symbol:
             for name, shape, t in zip(aux_names, aux_shapes, aux_types)
         }
         return Executor(self, ctx, args, args_grad, reqs, aux_states,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, group2ctx=group2ctx)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):

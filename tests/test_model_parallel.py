@@ -1,0 +1,122 @@
+"""group2ctx model parallelism (reference pattern:
+tests/python/unittest/test_multi_device_exec.py + test_model_parallel.py —
+ctx groups mapped onto cpu(0)/cpu(1) without real multi-accelerator
+hardware; graph_executor.cc:317-421 AssignContext/PlaceDevice).
+
+Numerical note: virtual CPU devices may take different oneDNN threading
+paths, so float results can differ across devices by reassociation. The
+parity checks use integer-valued tensors (exact in fp32 under any
+summation order), making the comparison bitwise-meaningful.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _int_net():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data=data, num_hidden=6, name="fc1")
+        act1 = mx.sym.Activation(data=fc1, act_type="relu", name="act1")
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = mx.sym.FullyConnected(data=act1, num_hidden=4, name="fc2")
+        net = mx.sym.LinearRegressionOutput(data=fc2, name="lro")
+    return net
+
+
+def _int_fill(ex, seed=0):
+    r = np.random.RandomState(seed)
+    for k, v in ex.arg_dict.items():
+        v[:] = r.randint(-3, 4, v.shape).astype(np.float32)
+
+
+def test_group2ctx_two_device_parity():
+    net = _int_net()
+    g2c = {"stage1": mx.Context("cpu", 0), "stage2": mx.Context("cpu", 1)}
+    ex = net.simple_bind(mx.cpu(0), data=(4, 5), grad_req="write",
+                         group2ctx=g2c)
+    assert ex._ctx_map and len(ex._ctx_map) == 2  # fc2 + lro off-default
+    _int_fill(ex)
+    ex.forward(is_train=True)
+    ex.backward()
+    out_mp = ex.outputs[0].asnumpy()
+    g_mp = {k: g.asnumpy().copy() for k, g in ex.grad_dict.items()}
+
+    ref = net.simple_bind(mx.cpu(0), data=(4, 5), grad_req="write")
+    _int_fill(ref)
+    ref.forward(is_train=True)
+    ref.backward()
+    np.testing.assert_array_equal(out_mp, ref.outputs[0].asnumpy())
+    for k in g_mp:
+        np.testing.assert_array_equal(g_mp[k], ref.grad_dict[k].asnumpy())
+
+
+def test_group2ctx_inference_and_out_grads():
+    net = _int_net()
+    g2c = {"stage1": mx.Context("cpu", 0), "stage2": mx.Context("cpu", 1)}
+    ex = net.simple_bind(mx.cpu(0), data=(2, 5), grad_req="write",
+                         group2ctx=g2c)
+    _int_fill(ex, seed=1)
+    ex.forward(is_train=False)
+    ref = net.simple_bind(mx.cpu(0), data=(2, 5), grad_req="write")
+    _int_fill(ref, seed=1)
+    ref.forward(is_train=False)
+    np.testing.assert_array_equal(ex.outputs[0].asnumpy(),
+                                  ref.outputs[0].asnumpy())
+    # explicit head gradients route through the multi-device backward
+    seed = np.ones((2, 4), np.float32) * 2
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.array(seed))
+    ref.forward(is_train=True)
+    ref.backward(mx.nd.array(seed))
+    np.testing.assert_array_equal(ex.grad_dict["fc1_weight"].asnumpy(),
+                                  ref.grad_dict["fc1_weight"].asnumpy())
+
+
+def test_group2ctx_unknown_group_raises():
+    net = _int_net()
+    with pytest.raises(mx.MXNetError):
+        net.simple_bind(mx.cpu(0), data=(2, 5),
+                        group2ctx={"stage1": mx.Context("cpu", 1)})
+
+
+def test_group2ctx_output_lands_on_assigned_device():
+    import jax
+
+    net = _int_net()
+    g2c = {"stage1": mx.Context("cpu", 0), "stage2": mx.Context("cpu", 1)}
+    ex = net.simple_bind(mx.cpu(0), data=(2, 5), group2ctx=g2c)
+    _int_fill(ex, seed=2)
+    ex.forward(is_train=False)
+    devs = {d for d in ex.outputs[0]._data.devices()}
+    assert devs == {jax.devices("cpu")[1]}, devs
+
+
+def test_group2ctx_reshape_and_backward_isolation():
+    import jax
+
+    net = _int_net()
+    g2c = {"stage1": mx.Context("cpu", 0), "stage2": mx.Context("cpu", 1)}
+    ex = net.simple_bind(mx.cpu(0), data=(2, 5), grad_req="write",
+                         group2ctx=g2c)
+    # reshape keeps the device mapping
+    ex2 = ex.reshape(data=(6, 5))
+    assert ex2._ctx_map and len(ex2._ctx_map) == len(ex._ctx_map)
+    _int_fill(ex)
+    ex.forward(is_train=True)
+    outs_before = [o.asnumpy().copy() for o in ex.outputs]
+    # explicit-seed backward must not disturb outputs
+    ex.backward(mx.nd.ones((2, 4)))
+    for a, b in zip(outs_before, ex.outputs):
+        np.testing.assert_array_equal(a, b.asnumpy())
+
+
+def test_csr_slice_bounds():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    csr = sp.csr_matrix(np.eye(4, dtype=np.float32))
+    with pytest.raises(mx.MXNetError):
+        csr.slice(0, 10)
+    with pytest.raises(mx.MXNetError):
+        csr.slice(-1, 2)

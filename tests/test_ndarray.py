@@ -134,3 +134,54 @@ def test_ndarray_scalar_ops():
     assert a.asscalar() == 4.0
     assert float(a) == 4.0
     assert int(a) == 4
+
+
+def test_storage_facade():
+    # reference: Storage::Get()->Alloc/Free + pooled-manager stats
+    from mxnet_tpu import storage
+
+    st = storage.Storage.get()
+    assert st is storage.Storage.get()
+    h = st.alloc(1024, mx.cpu())
+    assert h.size == 1024 and h.array.shape == (1024,)
+    st.free(h)
+    assert h.array is None
+    info = storage.memory_info(mx.cpu())
+    assert isinstance(info, dict)  # CPU: {} like the naive manager
+
+
+def test_tools_im2rec_rec2idx(tmp_path):
+    # tools parity: im2rec packs a folder, rec2idx rebuilds the index
+    # (reference: tools/im2rec.py, tools/rec2idx.py)
+    import os
+    import sys
+
+    from PIL import Image
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import im2rec
+    import rec2idx
+
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray(
+                (np.random.RandomState(i).rand(16, 16, 3) * 255
+                 ).astype(np.uint8)).save(root / cls / ("%d.png" % i))
+    prefix = str(tmp_path / "data")
+    im2rec.pack(prefix, str(root), num_thread=2)
+    from mxnet_tpu import recordio
+
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(r.keys) == 6
+    h, im = recordio.unpack_img(r.read_idx(0))
+    assert im.shape == (16, 16, 3) and h.label in (0.0, 1.0)
+    r.close()
+    # rebuild the idx from scratch and compare
+    idx_before = open(prefix + ".idx").read()
+    os.remove(prefix + ".idx")
+    n = rec2idx.rec2idx(prefix + ".rec", prefix + ".idx")
+    assert n == 6
+    assert open(prefix + ".idx").read() == idx_before

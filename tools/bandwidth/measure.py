@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""KVStore communication micro-benchmark (reference: tools/bandwidth/
+measure.py — push/pull cost of ResNet-sized gradient sets per kvstore type).
+
+Measures sustained push+pull GB/s for a list of array sizes on the chosen
+kvstore; on dist stores the numbers include the in-program allreduce.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def measure(kv_type="local", sizes=(1 << 20, 4 << 20, 16 << 20),
+            n_iters=10, num_devices=1):
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(kv_type)
+    results = []
+    for size in sizes:
+        shape = (size // 4,)  # fp32 elements
+        kv.init(str(size), mx.nd.zeros(shape))
+        grads = [mx.nd.array(np.random.rand(*shape).astype(np.float32))
+                 for _ in range(num_devices)]
+        out = mx.nd.zeros(shape)
+        # warm
+        kv.push(str(size), grads if num_devices > 1 else grads[0])
+        kv.pull(str(size), out=out)
+        out.asnumpy()
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            kv.push(str(size), grads if num_devices > 1 else grads[0])
+            kv.pull(str(size), out=out)
+        out.asnumpy()
+        dt = (time.perf_counter() - t0) / n_iters
+        gbs = 2 * size / dt / 1e9  # push + pull bytes
+        results.append((size, dt * 1e3, gbs))
+        print("size %8.1f MB  push+pull %7.2f ms  %6.2f GB/s"
+              % (size / 1e6, dt * 1e3, gbs))
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kv-store", default="local")
+    p.add_argument("--num-devices", type=int, default=1)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--sizes", default="1,4,16",
+                   help="comma-separated sizes in MB")
+    args = p.parse_args()
+    sizes = [int(float(s) * (1 << 20)) for s in args.sizes.split(",")]
+    measure(args.kv_store, sizes, args.iters, args.num_devices)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Pack an image folder (or .lst file) into RecordIO (reference:
+tools/im2rec.py — list generation + multithreaded packing into .rec/.idx).
+
+List mode:    python tools/im2rec.py --list prefix image_root
+Pack mode:    python tools/im2rec.py prefix image_root [--resize N]
+The .lst format matches the reference: ``index\\tlabel\\trelpath``.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root):
+    """Walk ``root``; each immediate subdirectory is a class (reference:
+    im2rec.py list_image with recursive folder labels)."""
+    entries = []
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(_EXTS):
+                        rel = os.path.relpath(os.path.join(dirpath, fn),
+                                              root)
+                        entries.append((float(label), rel))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(_EXTS):
+                entries.append((0.0, fn))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, rel) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (i, label, rel))
+    print("wrote %s.lst (%d images, %d classes)"
+          % (prefix, len(entries), max(1, len(classes))))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, num_thread=4, color=1):
+    from concurrent.futures import ThreadPoolExecutor
+
+    from mxnet_tpu import image as img
+    from mxnet_tpu import recordio
+
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        make_list(prefix, root)
+    items = list(read_list(lst))
+
+    def encode(item):
+        idx, label, rel = item
+        im = img.imread(os.path.join(root, rel),
+                        flag=1 if color else 0)
+        if resize:
+            im = img.resize_short(im, resize)
+        header = recordio.IRHeader(0, label[0] if len(label) == 1
+                                   else label, idx, 0)
+        return idx, recordio.pack_img(header, im, quality=quality)
+
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    with ThreadPoolExecutor(max_workers=num_thread) as pool:
+        for idx, payload in pool.map(encode, items):
+            writer.write_idx(idx, payload)
+    writer.close()
+    print("wrote %s.rec + .idx (%d records)" % (prefix, len(items)))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst only")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize the short edge to this size")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--num-thread", type=int, default=4)
+    p.add_argument("--color", type=int, default=1, choices=[0, 1])
+    args = p.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, num_thread=args.num_thread,
+             color=args.color)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Rebuild the .idx companion for a .rec file (reference: tools/rec2idx.py).
+
+Uses the native recordio scanner (mxnet_tpu/native/recordio.cc rio_scan) to
+find record boundaries without touching payload bytes — multi-GB files scan
+at IO speed with the GIL released.
+"""
+import argparse
+import ctypes
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def rec2idx(rec_path, idx_path=None):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.native import recordio_lib
+
+    idx_path = idx_path or os.path.splitext(rec_path)[0] + ".idx"
+    lib = recordio_lib()
+    if lib is not None:
+        h = lib.rio_open(rec_path.encode(), b"rb")
+        if not h:
+            raise IOError("cannot open %s" % rec_path)
+        try:
+            count = lib.rio_scan(h, None, 0)
+            if count < 0:
+                raise IOError("corrupt RecordIO framing in %s" % rec_path)
+            offsets = (ctypes.c_longlong * count)()
+            lib.rio_seek(h, 0)
+            lib.rio_scan(h, offsets, count)
+        finally:
+            lib.rio_close(h)
+        offs = list(offsets)
+    else:  # pure-python fallback
+        reader = recordio.MXRecordIO(rec_path, "r")
+        offs = []
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            offs.append(pos)
+        reader.close()
+    with open(idx_path, "w") as f:
+        for i, pos in enumerate(offs):
+            f.write("%d\t%d\n" % (i, pos))
+    return len(offs)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("record")
+    p.add_argument("index", nargs="?")
+    args = p.parse_args()
+    n = rec2idx(args.record, args.index)
+    print("indexed %d records" % n)
+
+
+if __name__ == "__main__":
+    main()
