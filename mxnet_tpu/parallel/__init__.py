@@ -11,3 +11,5 @@ the same program under jax.distributed initialization.
 """
 from .mesh import make_mesh, data_parallel_sharding, replicated_sharding
 from .trainer import ShardedTrainer
+from .ring_attention import ring_attention, attention_reference
+from .transformer import TransformerParallel

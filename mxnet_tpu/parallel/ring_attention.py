@@ -1,0 +1,139 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+Not present in the reference (SURVEY.md §2.3 lists sequence parallelism as
+absent); this is new TPU-first capability required for long-context work:
+the sequence axis is sharded over a mesh axis ('sp'), each device holds a
+(T/n)-length Q/K/V shard, and K/V blocks rotate around the ring with
+``lax.ppermute`` while a streaming (online-softmax) accumulator combines
+per-block attention — compute overlaps the ICI transfer and no device ever
+materializes the full T×T score matrix (Liu et al., "Ring Attention with
+Blockwise Transformers", 2023 — the public recipe; implementation here is
+original).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["ring_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Plain full-materialization attention (the parity oracle).
+
+    q/k/v: (batch, heads, T, head_dim).
+    """
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision="highest") * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    import jax
+
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v, precision="highest")
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale,
+                          vary_axes=None):
+    """shard_map body: q/k/v are the LOCAL sequence shards
+    (batch, heads, T_local, d); returns the local output shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    Tl = q.shape[2]
+    q32 = q.astype(jnp.float32) * scale
+    # global positions of the local queries
+    q_pos = my_idx * Tl + jnp.arange(Tl)
+
+    def combine(acc, m, l, k_cur, v_cur, i):
+        """Fold one K/V block into the online-softmax accumulator."""
+        src = (my_idx - i) % n  # which shard this block came from
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k_cur.astype(jnp.float32),
+                            precision="highest")
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        block_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, block_max)
+        new_m_safe = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.exp(scores - new_m_safe[..., None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        correction = jnp.where(jnp.isneginf(m), 0.0,
+                               jnp.exp(m - new_m_safe))
+        new_l = l * correction + jnp.sum(p, axis=-1)
+        new_acc = (acc * correction[..., None]
+                   + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                v_cur.astype(jnp.float32),
+                                precision="highest"))
+        return new_acc, new_m, new_l
+
+    def step(carry, i):
+        k_cur, v_cur, acc, m, l = carry
+        acc, m, l = combine(acc, m, l, k_cur, v_cur, i)
+        # rotate K/V to the next ring position (ICI neighbor exchange)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m, l), None
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    # the carries become device-varying after one ring step; mark the
+    # initial values varying over every sharded axis so scan carry types
+    # match (with tensor parallelism the values vary over tp too)
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None:
+        va = tuple(vary_axes or (axis_name,))
+        acc0, m0, l0 = (pvary(x, va) for x in (acc0, m0, l0))
+    if n > 1:
+        # n-1 rotations; the final block is folded without the (wasted)
+        # last neighbor exchange
+        (k_l, v_l, acc, m, l), _ = lax.scan(
+            step, (k, v, acc0, m0, l0), jnp.arange(n - 1))
+        acc, m, l = combine(acc, m, l, k_l, v_l, n - 1)
+    else:
+        acc, m, l = combine(acc0, m0, l0, k, v, 0)
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
+                   head_axis=None, batch_axis=None):
+    """Sequence-parallel attention over ``mesh`` axis ``axis``.
+
+    q/k/v are GLOBAL (batch, heads, T, head_dim) arrays (or already
+    sharded on the sequence dim); T must divide by the axis size. Returns
+    the global attention output with the same sharding. Differentiable —
+    the vjp rides the same ring in reverse (autodiff of scan+ppermute).
+    """
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    d = q.shape[-1]
+    # python float stays weakly typed (a np.float64 scalar would promote
+    # the whole ring to f64 under x64)
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+    # heads and batch may additionally be sharded (tensor/data
+    # parallelism compose with the sequence ring: each (dp, tp) shard
+    # runs its own ring over its batch rows and heads)
+    spec = P(batch_axis, head_axis, axis, None)
+    vary = tuple(a for a in (batch_axis, head_axis, axis) if a is not None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          causal=causal, scale=scale, vary_axes=vary),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

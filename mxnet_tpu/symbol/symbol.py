@@ -556,7 +556,7 @@ class Symbol:
                 reqs = dict(reqs)
                 reqs[name] = "null"
         return Executor(self, ctx, args, args_grad, reqs, aux_states,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, group2ctx=group2ctx)
 
     # --- eval ---------------------------------------------------------------
     def eval(self, ctx=None, **kwargs):
