@@ -13,3 +13,4 @@ from .mesh import make_mesh, data_parallel_sharding, replicated_sharding
 from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, attention_reference
 from .transformer import TransformerParallel
+from .pipeline import pipeline_apply

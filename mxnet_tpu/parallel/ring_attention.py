@@ -94,10 +94,10 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale,
     # the carries become device-varying after one ring step; mark the
     # initial values varying over every sharded axis so scan carry types
     # match (with tensor parallelism the values vary over tp too)
-    pvary = getattr(lax, "pvary", None)
-    if pvary is not None:
-        va = tuple(vary_axes or (axis_name,))
-        acc0, m0, l0 = (pvary(x, va) for x in (acc0, m0, l0))
+    from .pipeline import _mark_varying
+
+    va = tuple(vary_axes or (axis_name,))
+    acc0, m0, l0 = (_mark_varying(x, va) for x in (acc0, m0, l0))
     if n > 1:
         # n-1 rotations; the final block is folded without the (wasted)
         # last neighbor exchange
@@ -120,7 +120,10 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
     the vjp rides the same ring in reverse (autodiff of scan+ppermute).
     """
     import jax
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     d = q.shape[-1]

@@ -164,3 +164,59 @@ def test_transformer_step_fn_lr_not_stale():
                              n_layers=1, d_ff=8, n_experts=1)
     assert tr.step_fn(lr=0.1) is tr.step_fn(lr=0.1)
     assert tr.step_fn(lr=0.1) is not tr.step_fn(lr=0.01)
+
+
+def test_pipeline_parallel_gpipe():
+    # pp axis: GPipe microbatch schedule == sequential stage application
+    # (fwd and grads); tolerances cover CPU fastmath-vs-compiled drift
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+
+    S = 4
+    mesh = Mesh(np.array(jax.devices("cpu")[:S]), ("pp",))
+    r = np.random.RandomState(0)
+    W = jnp.asarray(r.randn(S, 6, 6).astype(np.float32) * 0.3)
+    b = jnp.asarray(r.randn(S, 6).astype(np.float32) * 0.1)
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    x = jnp.asarray(r.randn(8, 6).astype(np.float32))
+    with jax.default_matmul_precision("highest"):
+        out = pipeline_apply(stage, {"w": W, "b": b}, x, mesh,
+                             n_microbatches=4)
+        ref = x
+        for i in range(S):
+            ref = jnp.tanh(ref @ W[i] + b[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+        g_pipe = jax.grad(lambda W: jnp.sum(pipeline_apply(
+            stage, {"w": W, "b": b}, x, mesh, n_microbatches=4) ** 2))(W)
+
+        def seq(W):
+            h = x
+            for i in range(S):
+                h = jnp.tanh(h @ W[i] + b[i])
+            return jnp.sum(h ** 2)
+
+        g_seq = jax.grad(seq)(W)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq),
+                               rtol=1e-3, atol=2e-4)
+
+
+def test_pipeline_stage_count_mismatch_raises():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("pp",))
+    W = jnp.zeros((4, 3, 3), jnp.float32)  # 4 stages on a pp=2 mesh
+    with pytest.raises(ValueError):
+        pipeline_apply(lambda p, x: x @ p, W,
+                       jnp.zeros((4, 3), jnp.float32), mesh)
