@@ -375,3 +375,188 @@ def _register():
 
 
 _register()
+
+
+def _register_proposal():
+    """Faster-RCNN RPN Proposal (reference:
+    src/operator/contrib/proposal.cc + proposal-inl.h): anchor enumeration
+    -> bbox delta decode + image clip -> min-size filter -> top-pre_nms ->
+    NMS -> top-post_nms rois. Fixed shapes throughout; gradients are zero
+    (the reference backward writes zeros too)."""
+    import jax
+
+    jnp = _jnp()
+    from .param import Bool, Float, FloatList, Int
+    from .registry import register_op
+
+    def _base_anchors(stride, ratios, scales):
+        base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+        w = base[2] - base[0] + 1
+        h = base[3] - base[1] + 1
+        cx = base[0] + 0.5 * (w - 1)
+        cy = base[1] + 0.5 * (h - 1)
+        out = []
+        for r in ratios:
+            size_r = np.floor(w * h / r)
+            nw = np.floor(np.sqrt(size_r) + 0.5)
+            nh = np.floor(nw * r + 0.5)
+            for s in scales:
+                ws, hs = nw * s, nh * s
+                out.append([cx - 0.5 * (ws - 1), cy - 0.5 * (hs - 1),
+                            cx + 0.5 * (ws - 1), cy + 0.5 * (hs - 1)])
+        return np.asarray(out, np.float32)
+
+    def proposal(attrs, cls_prob, bbox_pred, im_info):
+        ratios = list(attrs.ratios)
+        scales = list(attrs.scales)
+        stride = attrs.feature_stride
+        n, twoA, H, W = cls_prob.shape
+        A = twoA // 2
+        if A != len(ratios) * len(scales):
+            from ..base import MXNetError
+
+            raise MXNetError(
+                "cls_prob has %d anchors/position but scales x ratios "
+                "gives %d" % (A, len(ratios) * len(scales)))
+        base = _base_anchors(stride, ratios, scales)  # (A, 4)
+        sx = (np.arange(W) * stride)[None, :, None]
+        sy = (np.arange(H) * stride)[:, None, None]
+        shifts = np.stack([np.broadcast_to(sx, (H, W, A)),
+                           np.broadcast_to(sy, (H, W, A)),
+                           np.broadcast_to(sx, (H, W, A)),
+                           np.broadcast_to(sy, (H, W, A))], -1)
+        anchors = jnp.asarray((shifts + base[None, None]).reshape(-1, 4))
+        N = anchors.shape[0]
+        # pre_nms <= 0 disables the cap (proposal.cc:322); post is NOT
+        # clamped — short supply cycles kept proposals (proposal.cc:426)
+        pre = N if attrs.rpn_pre_nms_top_n <= 0 \
+            else min(attrs.rpn_pre_nms_top_n, N)
+        post = attrs.rpn_post_nms_top_n
+        # feature positions beyond the real image are invalid
+        pos_h = np.repeat(np.arange(H), W * A)
+        pos_w = np.tile(np.repeat(np.arange(W), A), H)
+
+        def per_sample(cp, bp, info):
+            fg = cp[A:].transpose(1, 2, 0).reshape(-1).astype(jnp.float32)
+            deltas = bp.reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+                .reshape(-1, 4).astype(jnp.float32)
+            im_h, im_w, im_scale = info[0], info[1], info[2]
+            if attrs.iou_loss:
+                # IoU-loss mode: deltas are direct corner offsets
+                # (proposal.cc IoUTransformInv)
+                px1 = anchors[:, 0] + deltas[:, 0]
+                py1 = anchors[:, 1] + deltas[:, 1]
+                px2 = anchors[:, 2] + deltas[:, 2]
+                py2 = anchors[:, 3] + deltas[:, 3]
+            else:
+                aw = anchors[:, 2] - anchors[:, 0] + 1.0
+                ah = anchors[:, 3] - anchors[:, 1] + 1.0
+                ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+                ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+                px = deltas[:, 0] * aw + ax
+                py = deltas[:, 1] * ah + ay
+                pw = jnp.exp(deltas[:, 2]) * aw
+                ph = jnp.exp(deltas[:, 3]) * ah
+                px1 = px - 0.5 * (pw - 1)
+                py1 = py - 0.5 * (ph - 1)
+                px2 = px + 0.5 * (pw - 1)
+                py2 = py + 0.5 * (ph - 1)
+            x1 = jnp.clip(px1, 0, im_w - 1)
+            y1 = jnp.clip(py1, 0, im_h - 1)
+            x2 = jnp.clip(px2, 0, im_w - 1)
+            y2 = jnp.clip(py2, 0, im_h - 1)
+            min_size = attrs.rpn_min_size * im_scale
+            small = ((x2 - x1 + 1 < min_size) | (y2 - y1 + 1 < min_size))
+            # FilterBox expands too-small boxes and demotes them to score
+            # -1 (last-resort fill), it does not drop them
+            # (proposal.cc:149-165)
+            x1 = jnp.where(small, x1 - min_size / 2, x1)
+            y1 = jnp.where(small, y1 - min_size / 2, y1)
+            x2 = jnp.where(small, x2 + min_size / 2, x2)
+            y2 = jnp.where(small, y2 + min_size / 2, y2)
+            boxes = jnp.stack([x1, y1, x2, y2], 1)
+            # anchors over the padded feature region are demoted too
+            # (BBoxTransformInv's -1 marking, proposal.cc:373-377)
+            padded = ((jnp.asarray(pos_h) >= im_h / stride)
+                      | (jnp.asarray(pos_w) >= im_w / stride))
+            score = jnp.where(small | padded, -1.0, fg)
+            order = jnp.argsort(-score)[:pre]
+            b = boxes[order]
+            s = score[order]
+            keep = jnp.ones((pre,), bool)
+
+            def pair_iou(box, all_boxes):
+                # proposal NMS convention: +1 pixel areas, strict >
+                # (proposal.cc:236-268)
+                iw = jnp.maximum(
+                    jnp.minimum(box[2], all_boxes[:, 2])
+                    - jnp.maximum(box[0], all_boxes[:, 0]) + 1.0, 0.0)
+                ih = jnp.maximum(
+                    jnp.minimum(box[3], all_boxes[:, 3])
+                    - jnp.maximum(box[1], all_boxes[:, 1]) + 1.0, 0.0)
+                inter = iw * ih
+                area = (box[2] - box[0] + 1.0) * (box[3] - box[1] + 1.0)
+                areas = ((all_boxes[:, 2] - all_boxes[:, 0] + 1.0)
+                         * (all_boxes[:, 3] - all_boxes[:, 1] + 1.0))
+                return inter / (area + areas - inter)
+
+            def nms_body(i, keep):
+                iou_i = pair_iou(b[i], b)
+                sup = (jnp.arange(pre) > i) & keep \
+                    & (iou_i > attrs.threshold)
+                return jnp.where(keep[i], keep & ~sup, keep)
+
+            keep = jax.lax.fori_loop(0, pre, nms_body, keep)
+            # survivors in score order first, then cycle them to fill the
+            # fixed post slots (proposal.cc:426-445 cur_keep[i % size])
+            rank_score = jnp.where(keep, s, -jnp.inf)
+            survivors = jnp.argsort(-rank_score)
+            n_keep = jnp.maximum(jnp.sum(keep), 1)
+            sel = survivors[jnp.arange(post) % n_keep]
+            return b[sel], s[sel]
+
+        boxes, scores = jax.vmap(per_sample)(
+            cls_prob.astype(jnp.float32), bbox_pred.astype(jnp.float32),
+            im_info.astype(jnp.float32))
+        batch_idx = jnp.repeat(jnp.arange(n, dtype=jnp.float32), post)
+        rois = jnp.concatenate([batch_idx[:, None],
+                                boxes.reshape(-1, 4)], axis=1)
+        rois = jax.lax.stop_gradient(rois).astype(cls_prob.dtype)
+        if attrs.output_score:
+            return rois, jax.lax.stop_gradient(
+                scores.reshape(-1, 1)).astype(cls_prob.dtype)
+        return rois
+
+    def proposal_infer(attrs, in_shapes, aux_shapes):
+        c = in_shapes[0]
+        if c is None:
+            return None
+        n = c[0]
+        post = attrs.rpn_post_nms_top_n
+        a = c[1] // 2
+        bbox = (n, 4 * a, c[2], c[3])
+        outs = [(n * post, 5)]
+        if attrs.output_score:
+            outs.append((n * post, 1))
+        return ([c, bbox, (n, 3)], outs, aux_shapes)
+
+    register_op(
+        "_contrib_Proposal", proposal,
+        params={"rpn_pre_nms_top_n": Int(default=6000),
+                "rpn_post_nms_top_n": Int(default=300),
+                "threshold": Float(default=0.7),
+                "rpn_min_size": Int(default=16),
+                "scales": FloatList(default=(4.0, 8.0, 16.0, 32.0)),
+                "ratios": FloatList(default=(0.5, 1.0, 2.0)),
+                "feature_stride": Int(default=16),
+                "output_score": Bool(default=False),
+                "iou_loss": Bool(default=False)},
+        num_inputs=3, input_names=["cls_prob", "bbox_pred", "im_info"],
+        num_outputs=lambda attrs: 2 if attrs.output_score else 1,
+        infer_shape=proposal_infer,
+        doc="RPN proposal generation: anchors + delta decode + min-size "
+            "filter + NMS, fixed-shape padded rois (reference: "
+            "src/operator/contrib/proposal.cc)")
+
+
+_register_proposal()

@@ -229,3 +229,65 @@ def test_ssd_toy_convergence():
         if union > 0 and inter / union > 0.4:
             found += 1
     assert found >= bs // 2, f"only {found}/{bs} localized"
+
+
+def test_proposal_op():
+    # Faster-RCNN RPN proposals (reference: contrib/proposal.cc)
+    n, H, W = 2, 4, 4
+    A = 6
+    r = np.random.RandomState(0)
+    cls_prob = r.rand(n, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (r.randn(n, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8,
+        rpn_min_size=4, scales=(2.0, 4.0), ratios=(0.5, 1.0, 2.0),
+        feature_stride=16, output_score=True)
+    ro = rois.asnumpy()
+    assert ro.shape == (16, 5)
+    np.testing.assert_array_equal(ro[:8, 0], 0)
+    np.testing.assert_array_equal(ro[8:, 0], 1)
+    assert (ro[:, 1] <= ro[:, 3]).all() and (ro[:, 2] <= ro[:, 4]).all()
+    assert ro[:, 1:].min() >= 0 and ro[:, 1:].max() <= 63
+    # NMS suppresses overlaps: surviving proposals pairwise IoU < thresh
+    from mxnet_tpu.ops.detection import _iou
+    import jax.numpy as jnp
+
+    b0 = ro[:8, 1:]
+    valid = (b0.sum(1) > 0)
+    ious = np.asarray(_iou(jnp.asarray(b0), jnp.asarray(b0)))
+    off = ious - np.eye(len(b0))
+    assert (off[valid][:, valid] < 0.7 + 1e-5).all()
+    # proposals feed ROIPooling (the Faster-RCNN head wiring)
+    feat = mx.nd.array(r.rand(n, 4, 8, 8).astype(np.float32))
+    pooled = mx.nd.ROIPooling(feat, rois, pooled_size=(3, 3),
+                              spatial_scale=0.125)
+    assert pooled.shape == (16, 4, 3, 3)
+    # symbol-level shape inference
+    sym = mx.sym.contrib.Proposal(
+        mx.sym.Variable("cls"), mx.sym.Variable("bbox"),
+        mx.sym.Variable("im_info"), rpn_post_nms_top_n=8,
+        scales=(2.0, 4.0), ratios=(0.5, 1.0, 2.0))
+    _, out_shapes, _ = sym.infer_shape(cls=(n, 2 * A, H, W))
+    assert out_shapes == [(n * 8, 5)]
+
+
+def test_proposal_edge_cases():
+    # small feature map + default post_nms: kept proposals CYCLE to fill
+    # the fixed output (proposal.cc:426); pre_nms<=0 disables the cap
+    n, H, W, A = 1, 2, 2, 3
+    r = np.random.RandomState(1)
+    cls_prob = r.rand(n, 2 * A, H, W).astype(np.float32)
+    bbox = (r.randn(n, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=-1, rpn_post_nms_top_n=50, rpn_min_size=2,
+        scales=(2.0,), ratios=(0.5, 1.0, 2.0), feature_stride=16)
+    ro = rois.asnumpy()
+    assert ro.shape == (50, 5)
+    # with <= 12 candidates, rows repeat rather than zero-pad
+    uniq = np.unique(ro[:, 1:], axis=0)
+    assert 1 <= len(uniq) <= 12
+    assert not (ro[:, 1:] == 0).all(axis=1).any() or len(uniq) == 1
