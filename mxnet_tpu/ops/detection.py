@@ -477,8 +477,8 @@ def _register_proposal():
             boxes = jnp.stack([x1, y1, x2, y2], 1)
             # anchors over the padded feature region are demoted too
             # (BBoxTransformInv's -1 marking, proposal.cc:373-377)
-            padded = ((jnp.asarray(pos_h) >= im_h / stride)
-                      | (jnp.asarray(pos_w) >= im_w / stride))
+            padded = ((jnp.asarray(pos_h) >= jnp.floor(im_h / stride))
+                      | (jnp.asarray(pos_w) >= jnp.floor(im_w / stride)))
             score = jnp.where(small | padded, -1.0, fg)
             order = jnp.argsort(-score)[:pre]
             b = boxes[order]

@@ -14,3 +14,4 @@ from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, attention_reference
 from .transformer import TransformerParallel
 from .pipeline import pipeline_apply
+from .flash_attention import flash_attention

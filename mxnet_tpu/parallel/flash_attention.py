@@ -1,0 +1,165 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the long-context path: computes softmax(QK^T)V in VMEM-sized
+blocks with an online-softmax accumulator, so the T x T score matrix never
+touches HBM (HBM traffic drops from O(T^2) to O(T * d) — exactly the class
+of fix PERF_NOTES.md shows this chip needs). Composes with
+:mod:`ring_attention`: the ring shards the sequence ACROSS chips while this
+kernel blocks it WITHIN a chip.
+
+Standard flash-attention recurrence (Dao et al. 2022, public algorithm);
+the kernel implementation is original. Falls back to the XLA reference
+implementation when Pallas is unavailable on the backend.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["flash_attention"]
+
+
+def _pick_block(T, bound):
+    for b in range(min(bound, T), 0, -1):
+        if T % b == 0:
+            return b
+    return 1
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale, causal, block_k, seq_len):
+    """One (batch*head, q_block, k_block) grid step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: blocks entirely above the diagonal contribute nothing —
+    # skip their MXU work (half the grid for long sequences)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    # a block is live unless it lies entirely above the causal diagonal:
+    # last query position >= first key position
+    live = ((q_idx + 1) * bq - 1 >= kv_idx * bk) if causal         else (kv_idx >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = kv_idx * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_prev = m_ref[...]                       # (bq, 1)
+        block_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m_prev), 0.0,
+                         jnp.exp(m_prev - m_safe))
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = (acc_ref[...] * corr
+                        + jax.lax.dot_general(
+                            p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = m_new
+
+    @pl.when(kv_idx == (seq_len // block_k) - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512, interpret=False):
+    """Blocked attention; q/k/v: (batch, heads, T, d).
+
+    block_q/block_k are upper bounds; the largest divisors of T at or
+    below them are used. The vjp falls back to XLA autodiff of the
+    reference formula (a backward Pallas kernel is a further
+    optimization).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(D))
+    # block sizes are upper bounds: the largest divisor of T at or below
+    # the bound is used, so any T works (a non-divisor block would read
+    # out of range)
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(T, block_k)
+    @jax.custom_vjp
+    def _flash(q, k, v):
+        return _flash_fwd_impl(q, k, v)
+
+    def _fwd(q, k, v):
+        return _flash_fwd_impl(q, k, v), (q, k, v)
+
+    def _bwd(res, g):
+        # backward via XLA autodiff of the dense formula (the forward's
+        # memory win stands; a backward Pallas kernel is future work)
+        from .ring_attention import attention_reference
+
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: attention_reference(q, k, v, causal=causal,
+                                                scale=scale), q, k, v)
+        return vjp(g)
+
+    _flash.defvjp(_fwd, _bwd)
+
+    def _flash_fwd_impl(q, k, v):
+        qf = q.reshape(B * H, T, D)
+        kf = k.reshape(B * H, T, D)
+        vf = v.reshape(B * H, T, D)
+        grid = (B * H, T // block_q, T // block_k)
+        kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                                   block_k=block_k, seq_len=T)
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                # j * 0 (not a literal 0): under jax_enable_x64 a literal
+                # becomes an i64 constant and Mosaic rejects the
+                # mixed-width index tuple
+                pl.BlockSpec((1, block_q, D),
+                             lambda b, i, j: (b, i, j * 0)),
+                pl.BlockSpec((1, block_k, D),
+                             lambda b, i, j: (b, j, i * 0)),
+                pl.BlockSpec((1, block_k, D),
+                             lambda b, i, j: (b, j, i * 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i, j: (b, i, j * 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+        )(qf, kf, vf)
+        return out.reshape(B, H, T, D)
+
+    return _flash(q, k, v)

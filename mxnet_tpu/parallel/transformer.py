@@ -116,9 +116,8 @@ class TransformerParallel:
                     head_axis="tp" if "tp" in self.axes else None,
                     batch_axis="dp" if "dp" in self.axes else None)
             else:
-                from .ring_attention import attention_reference
-
-                att = attention_reference(q, k, v, causal=True)
+                att = _local_attention(q, k, v,
+                                       self.mesh.devices.size)
             att = att.transpose(0, 2, 1, 3).reshape(B, T, d)
             x = x + att @ params[p + "wo"]
             # --- MoE FFN: soft top-2-ish gate over ep-sharded experts ---
@@ -168,6 +167,24 @@ class TransformerParallel:
 
         sh = self._ns("dp", "sp")
         return jax.device_put(tokens, sh), jax.device_put(targets, sh)
+
+
+def _local_attention(q, k, v, mesh_size=1):
+    """Single-device attention: the Pallas flash kernel on TPU (no T x T
+    HBM materialization), XLA reference elsewhere. pallas_call has no
+    GSPMD partitioning rule, so the kernel only engages on a trivial
+    (single-device) mesh; sharded meshes keep the XLA formula, which
+    GSPMD partitions correctly."""
+    import jax
+
+    if jax.default_backend() == "tpu" and mesh_size == 1 \
+            and q.shape[2] >= 128:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    from .ring_attention import attention_reference
+
+    return attention_reference(q, k, v, causal=True)
 
 
 def _rms_norm(x):

@@ -220,3 +220,41 @@ def test_pipeline_stage_count_mismatch_raises():
     with pytest.raises(ValueError):
         pipeline_apply(lambda p, x: x @ p, W,
                        jnp.zeros((4, 3), jnp.float32), mesh)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_interpret(causal):
+    # the Pallas kernel in interpreter mode vs the dense oracle, compared
+    # under full matmul precision (CPU fastmath otherwise dominates)
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import attention_reference, flash_attention
+
+    r = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(r.randn(2, 2, 64, 16).astype(np.float32))
+               for _ in range(3))
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal=causal, block_q=16,
+                              block_k=16, interpret=True)
+        ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_ragged_seq_picks_divisor_blocks():
+    # block sizes are bounds: T=48 with bound 32 runs with block 24/16
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import attention_reference, flash_attention
+
+    r = np.random.RandomState(5)
+    q, k, v = (jnp.asarray(r.randn(1, 2, 48, 16).astype(np.float32))
+               for _ in range(3))
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal=True, block_q=32,
+                              block_k=32, interpret=True)
+        ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
