@@ -115,3 +115,109 @@ def _register_ctc():
 
 
 _register_ctc()
+
+
+def _register_contrib_extras():
+    """fft/ifft, quantize/dequantize, count_sketch, MultiProposal
+    (reference: src/operator/contrib/fft-inl.h, ifft-inl.h,
+    quantize-inl.h, dequantize-inl.h, count_sketch-inl.h,
+    multi_proposal.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .param import Int, Str
+    from .registry import alias_op, register_op
+
+    def fft(attrs, data):
+        # (n, d) real -> (n, 2d) interleaved re/im (fft-inl.h layout)
+        c = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+        out = jnp.stack([jnp.real(c), jnp.imag(c)], axis=-1)
+        return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+            .astype(jnp.float32)
+
+    register_op(
+        "_contrib_fft", fft,
+        params={"compute_size": Int(default=128)},
+        num_inputs=1,
+        infer_shape=lambda attrs, s, a: (
+            [s[0]], [tuple(s[0][:-1]) + (2 * s[0][-1],)], a)
+        if s[0] is not None else None,
+        doc="real FFT along the last dim, interleaved re/im output "
+            "(reference: src/operator/contrib/fft-inl.h; cuFFT there)")
+
+    def ifft(attrs, data):
+        d = data.shape[-1] // 2
+        x = data.reshape(data.shape[:-1] + (d, 2)).astype(jnp.float32)
+        c = jax.lax.complex(x[..., 0], x[..., 1])
+        # the reference's cuFFT inverse is unnormalized (fft-inl.h note);
+        # jnp.fft.ifft normalizes by d, so scale back
+        out = jnp.real(jnp.fft.ifft(c, axis=-1)) * d
+        return out.astype(jnp.float32)
+
+    register_op(
+        "_contrib_ifft", ifft,
+        params={"compute_size": Int(default=128)},
+        num_inputs=1,
+        infer_shape=lambda attrs, s, a: (
+            [s[0]], [tuple(s[0][:-1]) + (s[0][-1] // 2,)], a)
+        if s[0] is not None else None,
+        doc="unnormalized inverse FFT of interleaved re/im input "
+            "(reference: src/operator/contrib/ifft-inl.h)")
+
+    def quantize(attrs, data, min_range, max_range):
+        # float -> uint8 over [min_range, max_range] (quantize-inl.h)
+        lo = min_range.reshape(())
+        hi = max_range.reshape(())
+        scale = 255.0 / (hi - lo)
+        q = jnp.clip(jnp.round((data.astype(jnp.float32) - lo) * scale),
+                     0, 255).astype(jnp.uint8)
+        return q, min_range, max_range
+
+    register_op(
+        "_contrib_quantize", quantize,
+        params={"out_type": Str(default="uint8")},
+        num_inputs=3, input_names=["data", "min_range", "max_range"],
+        num_outputs=3,
+        infer_shape=lambda attrs, s, a: (s, [s[0], (1,), (1,)], a)
+        if s[0] is not None else None,
+        doc="uint8 quantization over a calibration range (reference: "
+            "src/operator/contrib/quantize-inl.h)")
+
+    def dequantize(attrs, data, min_range, max_range):
+        lo = min_range.reshape(())
+        hi = max_range.reshape(())
+        return (data.astype(jnp.float32) * (hi - lo) / 255.0 + lo) \
+            .astype(jnp.float32)
+
+    register_op(
+        "_contrib_dequantize", dequantize,
+        params={"out_type": Str(default="float32")},
+        num_inputs=3, input_names=["data", "min_range", "max_range"],
+        infer_shape=lambda attrs, s, a: (s, [s[0]], a)
+        if s[0] is not None else None,
+        doc="inverse of _contrib_quantize (reference: "
+            "src/operator/contrib/dequantize-inl.h)")
+
+    def count_sketch(attrs, data, h, s):
+        # out[b, h[i]] += s[i] * data[b, i] (count_sketch-inl.h)
+        idx = h.reshape(-1).astype(jnp.int32)
+        sign = s.reshape(-1).astype(jnp.float32)
+        contrib = data.astype(jnp.float32) * sign[None, :]
+        out = jnp.zeros((data.shape[0], attrs.out_dim), jnp.float32)
+        return out.at[:, idx].add(contrib).astype(data.dtype)
+
+    register_op(
+        "_contrib_count_sketch", count_sketch,
+        params={"out_dim": Int(), "processing_batch_size": Int(default=32)},
+        num_inputs=3, input_names=["data", "h", "s"],
+        infer_shape=lambda attrs, s, a: (
+            s, [(s[0][0], attrs.out_dim)], a) if s[0] is not None else None,
+        doc="count-sketch projection: signed scatter-add through hash "
+            "indices (reference: src/operator/contrib/count_sketch-inl.h)")
+
+    # MultiProposal is batched Proposal; our Proposal vmaps over the batch
+    # already (reference: src/operator/contrib/multi_proposal.cc)
+    alias_op("_contrib_Proposal", "_contrib_MultiProposal")
+
+
+_register_contrib_extras()

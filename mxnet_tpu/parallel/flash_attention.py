@@ -102,10 +102,18 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     B, H, T, D = q.shape
     scale = float(scale) if scale is not None else float(1.0 / np.sqrt(D))
     # block sizes are upper bounds: the largest divisor of T at or below
-    # the bound is used, so any T works (a non-divisor block would read
-    # out of range)
+    # the bound is used. When T has no reasonable divisor (prime-ish), a
+    # "block" would balloon toward T and defeat the kernel — fall back to
+    # the XLA formula instead.
+    bq_req, bk_req = min(block_q, T), min(block_k, T)
     block_q = _pick_block(T, block_q)
     block_k = _pick_block(T, block_k)
+    if block_q * 8 < bq_req or block_k * 8 < bk_req:
+        # prime-ish T: only tiny divisors exist; tiny blocks waste the
+        # MXU and the grid explodes — the XLA formula is faster
+        from .ring_attention import attention_reference
+
+        return attention_reference(q, k, v, causal=causal, scale=scale)
     @jax.custom_vjp
     def _flash(q, k, v):
         return _flash_fwd_impl(q, k, v)

@@ -169,6 +169,12 @@ SKIP = {
     "_contrib_MultiBoxTarget": "tests/test_detection.py",
     "_contrib_MultiBoxDetection": "tests/test_detection.py",
     "_contrib_Proposal": "tests/test_detection.py",
+    "_contrib_MultiProposal": "alias of Proposal, tests/test_detection.py",
+    "_contrib_fft": "tests/test_operator.py contrib",
+    "_contrib_ifft": "tests/test_operator.py contrib",
+    "_contrib_quantize": "tests/test_operator.py contrib",
+    "_contrib_dequantize": "tests/test_operator.py contrib",
+    "_contrib_count_sketch": "tests/test_operator.py contrib",
     "ROIPooling": "tests/test_detection.py",
     "GridGenerator": "tests/test_linalg_spatial.py",
     "BilinearSampler": "tests/test_linalg_spatial.py",

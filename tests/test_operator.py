@@ -379,3 +379,37 @@ def test_exec_flags_mirror_and_disable_jit():
             config.set_flag(flag, None)
         np.testing.assert_allclose(out, base_out, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(grad, base_grad, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_fft_quantize_count_sketch():
+    # reference: src/operator/contrib/{fft,ifft,quantize,dequantize,
+    # count_sketch}-inl.h
+    r = np.random.RandomState(0)
+    x = r.randn(2, 8).astype(np.float32)
+    f = mx.nd.contrib.fft(mx.nd.array(x))
+    c = np.fft.fft(x, axis=-1)
+    exp = np.stack([c.real, c.imag], -1).reshape(2, 16).astype(np.float32)
+    np.testing.assert_allclose(f.asnumpy(), exp, rtol=1e-4, atol=1e-4)
+    # the reference's inverse is unnormalized: ifft(fft(x)) == d * x
+    back = mx.nd.contrib.ifft(f)
+    np.testing.assert_allclose(back.asnumpy(), x * 8, rtol=1e-4, atol=1e-4)
+
+    q, lo, hi = mx.nd.contrib.quantize(mx.nd.array(x), mx.nd.array([-3.0]),
+                                       mx.nd.array([3.0]))
+    assert q.dtype == np.uint8
+    deq = mx.nd.contrib.dequantize(q, lo, hi)
+    assert np.abs(deq.asnumpy() - np.clip(x, -3, 3)).max() <= 6.0 / 255 + 1e-3
+
+    h = np.array([[0, 2, 1, 0, 3, 2, 1, 0]], np.float32)
+    s = np.array([[1, -1, 1, 1, -1, 1, -1, 1]], np.float32)
+    cs = mx.nd.contrib.count_sketch(mx.nd.array(x), mx.nd.array(h),
+                                    mx.nd.array(s), out_dim=4)
+    exp = np.zeros((2, 4), np.float32)
+    for i in range(8):
+        exp[:, int(h[0, i])] += s[0, i] * x[:, i]
+    np.testing.assert_allclose(cs.asnumpy(), exp, rtol=1e-4, atol=1e-5)
+
+    # MultiProposal aliases the batched Proposal
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+    assert OP_REGISTRY["_contrib_MultiProposal"] is \
+        OP_REGISTRY["_contrib_Proposal"]

@@ -258,3 +258,19 @@ def test_flash_attention_ragged_seq_picks_divisor_blocks():
         ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_prime_seq_falls_back():
+    # prime T has no usable divisor blocks; the XLA formula takes over
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import attention_reference, flash_attention
+
+    r = np.random.RandomState(6)
+    q, k, v = (jnp.asarray(r.randn(1, 1, 127, 8).astype(np.float32))
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
