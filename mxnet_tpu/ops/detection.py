@@ -560,3 +560,153 @@ def _register_proposal():
 
 
 _register_proposal()
+
+
+def _register_roi_align_psroi():
+    """ROIAlign_v2 + PSROIPooling (reference:
+    src/operator/contrib/roi_align_v2-inl.h ROIAlignForwardKernel_v2,
+    src/operator/contrib/psroi_pooling.cu PSROIPoolingForwardKernel)."""
+    import jax
+
+    jnp = _jnp()
+    from .param import Float, Int, Shape
+    from .registry import register_op
+
+    def roi_align(attrs, data, rois):
+        """Max over 4 bilinear samples per bin (2x2 interior grid), the
+        v2 kernel's sampling pattern; autodiff routes gradients through
+        the winning sample's bilinear weights like the argmax backward."""
+        ph_n, pw_n = attrs.pooled_size
+        scale = attrs.spatial_scale
+        n, C, H, W = data.shape
+        x = data.astype(jnp.float32)
+
+        def per_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = (roi[i].astype(jnp.float32) * scale
+                              for i in range(1, 5))
+            bin_h = (y2 - y1) / ph_n
+            bin_w = (x2 - x1) / pw_n
+            ph = jnp.arange(ph_n, dtype=jnp.float32)
+            pw = jnp.arange(pw_n, dtype=jnp.float32)
+            hs = jnp.clip(ph * bin_h + y1, 0, H - 1)
+            he = jnp.clip((ph + 1) * bin_h + y1, 0, H - 1)
+            ws = jnp.clip(pw * bin_w + x1, 0, W - 1)
+            we = jnp.clip((pw + 1) * bin_w + x1, 0, W - 1)
+            # interior 2-sample grid per dim (kernel strides by
+            # (end-start)/3 from start+stride to end-stride)
+            h_str = (he - hs) / 3.0
+            w_str = (we - ws) / 3.0
+            hpts = jnp.stack([hs + h_str, he - h_str], -1)   # (PH, 2)
+            wpts = jnp.stack([ws + w_str, we - w_str], -1)   # (PW, 2)
+            empty = ((he <= hs)[:, None] | (we <= ws)[None, :])
+            img = x[bidx]                                    # (C, H, W)
+
+            def bilinear(hh, ww):
+                # hh (PH,2), ww (PW,2) -> (C, PH, PW, 2, 2)
+                hl = jnp.clip(jnp.floor(hh), 0, H - 1).astype(jnp.int32)
+                hh_i = jnp.clip(jnp.ceil(hh), 0, H - 1).astype(jnp.int32)
+                wl = jnp.clip(jnp.floor(ww), 0, W - 1).astype(jnp.int32)
+                wr = jnp.clip(jnp.ceil(ww), 0, W - 1).astype(jnp.int32)
+                a = jnp.where(hl == hh_i, 0.5, hh - hl)      # (PH,2)
+                b = jnp.where(wl == wr, 0.5, ww - wl)        # (PW,2)
+                def g(yi, xi):
+                    # (PH,2) x (PW,2) advanced index -> (C, PH, PW, 2, 2)
+                    return img[:, yi[:, None, :, None],
+                               xi[None, :, None, :]]
+                tl = g(hl, wl)          # (C, PH, PW, 2, 2)
+                tr = g(hl, wr)
+                bl = g(hh_i, wl)
+                br = g(hh_i, wr)
+                A = a[None, :, None, :, None]
+                Bt = b[None, None, :, None, :]
+                return ((1 - A) * (1 - Bt) * tl + (1 - A) * Bt * tr
+                        + A * (1 - Bt) * bl + A * Bt * br)
+
+            vals = bilinear(hpts, wpts)            # (C, PH, PW, 2, 2)
+            out = jnp.max(vals.reshape(C, ph_n, pw_n, 4), axis=-1)
+            # padded roi rows (batch index < 0) output zeros and stop
+            # gradients (roi_align_v2-inl.h:76-82)
+            invalid = roi[0] < 0
+            return jnp.where(invalid | empty[None], 0.0, out)
+
+        out = jax.vmap(per_roi)(rois.astype(jnp.float32))
+        return out.astype(data.dtype)
+
+    def ra_infer(attrs, in_shapes, aux_shapes):
+        d, r = in_shapes
+        if d is None or r is None:
+            return None
+        ph, pw = attrs.pooled_size
+        return ([d, r], [(r[0], d[1], ph, pw)], aux_shapes)
+
+    register_op(
+        "_contrib_ROIAlign_v2", roi_align,
+        params={"pooled_size": Shape(), "spatial_scale": Float()},
+        num_inputs=2, input_names=["data", "rois"], infer_shape=ra_infer,
+        doc="ROI align (max over bilinear samples per bin) — reference: "
+            "src/operator/contrib/roi_align_v2-inl.h")
+
+    def psroi_pool(attrs, data, rois):
+        p = attrs.pooled_size
+        group = attrs.group_size or p
+        od = attrs.output_dim
+        scale = attrs.spatial_scale
+        n, C, H, W = data.shape
+        x = data.astype(jnp.float32)
+        hs_idx = jnp.arange(H, dtype=jnp.float32)
+        ws_idx = jnp.arange(W, dtype=jnp.float32)
+        # position-sensitive channel map: bin (ph,pw) of output channel
+        # ctop reads input channel (ctop*group+gh)*group+gw
+        ph = np.arange(p)
+        gh = np.clip((ph * group) // p, 0, group - 1)
+        cmap = ((np.arange(od)[:, None, None] * group
+                 + gh[None, :, None]) * group + gh[None, None, :])
+
+        def per_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1]) * scale
+            y1 = jnp.round(roi[2]) * scale
+            x2 = (jnp.round(roi[3]) + 1.0) * scale
+            y2 = (jnp.round(roi[4]) + 1.0) * scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bh, bw = rh / p, rw / p
+            phf = jnp.arange(p, dtype=jnp.float32)
+            h_lo = jnp.clip(jnp.floor(phf * bh + y1), 0, H)
+            h_hi = jnp.clip(jnp.ceil((phf + 1) * bh + y1), 0, H)
+            w_lo = jnp.clip(jnp.floor(phf * bw + x1), 0, W)
+            w_hi = jnp.clip(jnp.ceil((phf + 1) * bw + x1), 0, W)
+            my = ((hs_idx[None, :] >= h_lo[:, None])
+                  & (hs_idx[None, :] < h_hi[:, None]))   # (P, H)
+            mx = ((ws_idx[None, :] >= w_lo[:, None])
+                  & (ws_idx[None, :] < w_hi[:, None]))   # (P, W)
+            img = x[bidx][jnp.asarray(cmap)]             # (od, P, P, H, W)
+            msk = (my[None, :, None, :, None]
+                   * mx[None, None, :, None, :])
+            s = jnp.sum(img * msk, axis=(-2, -1))
+            area = ((h_hi - h_lo)[:, None] * (w_hi - w_lo)[None, :])
+            empty = ((h_hi <= h_lo)[:, None] | (w_hi <= w_lo)[None, :])
+            return jnp.where(empty[None], 0.0,
+                             s / jnp.maximum(area, 1.0)[None])
+
+        out = jax.vmap(per_roi)(rois.astype(jnp.float32))
+        return out.astype(data.dtype)
+
+    def ps_infer(attrs, in_shapes, aux_shapes):
+        d, r = in_shapes
+        if d is None or r is None:
+            return None
+        p = attrs.pooled_size
+        return ([d, r], [(r[0], attrs.output_dim, p, p)], aux_shapes)
+
+    register_op(
+        "_contrib_PSROIPooling", psroi_pool,
+        params={"spatial_scale": Float(), "output_dim": Int(),
+                "pooled_size": Int(), "group_size": Int(default=0)},
+        num_inputs=2, input_names=["data", "rois"], infer_shape=ps_infer,
+        doc="position-sensitive ROI average pooling (R-FCN; reference: "
+            "src/operator/contrib/psroi_pooling.cu)")
+
+
+_register_roi_align_psroi()

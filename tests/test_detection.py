@@ -291,3 +291,66 @@ def test_proposal_edge_cases():
     uniq = np.unique(ro[:, 1:], axis=0)
     assert 1 <= len(uniq) <= 12
     assert not (ro[:, 1:] == 0).all(axis=1).any() or len(uniq) == 1
+
+
+def test_roi_align_v2():
+    # reference: contrib/roi_align_v2-inl.h — max over bilinear samples
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    ra = mx.nd.contrib.ROIAlign_v2(mx.nd.array(x), mx.nd.array(rois),
+                                   pooled_size=(2, 2), spatial_scale=1.0)
+    assert ra.shape == (1, 2, 2, 2)
+    o = ra.asnumpy()[0, 0]
+    # bin (0,0) covers [0,1.5]^2; samples at 0.5/1.0 -> max is the
+    # bilinear value at (1.0, 1.0) = x[1,1] = 5
+    np.testing.assert_allclose(o[0, 0], 5.0, rtol=1e-5)
+    # monotone layout: bottom-right bin pools larger values
+    assert o[1, 1] > o[0, 0]
+    # gradient routes through the winning sample's bilinear corners
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    from mxnet_tpu import autograd as ag
+
+    with ag.record():
+        y = mx.nd.contrib.ROIAlign_v2(xa, mx.nd.array(rois),
+                                      pooled_size=(2, 2),
+                                      spatial_scale=1.0)
+    y.backward()
+    g = xa.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_psroi_pooling():
+    # reference: contrib/psroi_pooling.cu — position-sensitive averages
+    r = np.random.RandomState(0)
+    ps_x = r.rand(1, 2 * 2 * 2, 6, 6).astype(np.float32)  # od=2, group=2
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    ps = mx.nd.contrib.PSROIPooling(mx.nd.array(ps_x), mx.nd.array(rois),
+                                    spatial_scale=1.0, output_dim=2,
+                                    pooled_size=2)
+    assert ps.shape == (1, 2, 2, 2)
+    # bin (0,0) of ctop 0 averages channel 0 over the top-left bin
+    np.testing.assert_allclose(ps.asnumpy()[0, 0, 0, 0],
+                               ps_x[0, 0, 0:3, 0:3].mean(), rtol=1e-5)
+    # bin (1,1) of ctop 1 reads channel (1*2+1)*2+1 = 7
+    np.testing.assert_allclose(ps.asnumpy()[0, 1, 1, 1],
+                               ps_x[0, 7, 3:6, 3:6].mean(), rtol=1e-5)
+
+
+def test_roi_align_padded_roi_outputs_zero():
+    # reference guard: roi batch index < 0 -> zeros, no gradient
+    x = np.arange(64, dtype=np.float32).reshape(2, 2, 4, 4)
+    rois = np.array([[-1, 0, 0, 3, 3]], np.float32)
+    out = mx.nd.contrib.ROIAlign_v2(mx.nd.array(x), mx.nd.array(rois),
+                                    pooled_size=(2, 2), spatial_scale=1.0)
+    assert (out.asnumpy() == 0).all()
+    from mxnet_tpu import autograd as ag
+
+    xa = mx.nd.array(x)
+    xa.attach_grad()
+    with ag.record():
+        y = mx.nd.contrib.ROIAlign_v2(xa, mx.nd.array(rois),
+                                      pooled_size=(2, 2),
+                                      spatial_scale=1.0)
+    y.backward()
+    assert (xa.grad.asnumpy() == 0).all()
