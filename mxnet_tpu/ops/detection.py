@@ -679,12 +679,20 @@ def _register_roi_align_psroi():
             w_hi = jnp.clip(jnp.ceil((phf + 1) * bw + x1), 0, W)
             my = ((hs_idx[None, :] >= h_lo[:, None])
                   & (hs_idx[None, :] < h_hi[:, None]))   # (P, H)
-            mx = ((ws_idx[None, :] >= w_lo[:, None])
-                  & (ws_idx[None, :] < w_hi[:, None]))   # (P, W)
-            img = x[bidx][jnp.asarray(cmap)]             # (od, P, P, H, W)
-            msk = (my[None, :, None, :, None]
-                   * mx[None, None, :, None, :])
-            s = jnp.sum(img * msk, axis=(-2, -1))
+            mxm = ((ws_idx[None, :] >= w_lo[:, None])
+                   & (ws_idx[None, :] < w_hi[:, None]))  # (P, W)
+            img = x[bidx]                                # (C, H, W)
+            # separable two-pass reduction (the roi_pooling pattern):
+            # (C, P, W) row sums, then (C, P, P) bin sums, THEN the
+            # position-sensitive channel gather — never materializes
+            # an (od, P, P, H, W) intermediate
+            rows = jnp.einsum("chw,ph->cpw", img,
+                              my.astype(jnp.float32))
+            bins = jnp.einsum("cpw,qw->cpq", rows,
+                              mxm.astype(jnp.float32))   # (C, P, P)
+            s = bins[jnp.asarray(cmap),
+                     jnp.arange(p)[None, :, None],
+                     jnp.arange(p)[None, None, :]]       # (od, P, P)
             area = ((h_hi - h_lo)[:, None] * (w_hi - w_lo)[None, :])
             empty = ((h_hi <= h_lo)[:, None] | (w_hi <= w_lo)[None, :])
             return jnp.where(empty[None], 0.0,
@@ -710,3 +718,129 @@ def _register_roi_align_psroi():
 
 
 _register_roi_align_psroi()
+
+
+def _register_deformable():
+    """DeformableConvolution (reference:
+    src/operator/contrib/deformable_convolution-inl.h +
+    nn/deformable_im2col.cuh; Dai et al., "Deformable Convolutional
+    Networks"). The CUDA bilinear-im2col becomes a vectorized gather:
+    every kernel tap's sampling position is shifted by the learned
+    offset and read with zero-padded bilinear interpolation.
+    (DeformablePSROIPooling remains unimplemented — it raises as an
+    unknown op rather than existing as a wrong one.)"""
+    import jax
+
+    jnp = _jnp()
+    from ..base import MXNetError
+    from .param import Bool, Float, Int, Shape, Str
+    from .registry import register_op
+
+    def _bilinear_hw(img, ys, xs):
+        """img (C, H, W); ys/xs (...,) float sample positions; returns
+        (C, ...) with zeros outside (deformable_im2col_bilinear)."""
+        C, H, W = img.shape
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+
+        def corner(yi, xi, wgt):
+            ok = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1))
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            v = img[:, yc, xc]
+            return v * (wgt * ok.astype(jnp.float32))[None]
+
+        wy = ys - y0
+        wx = xs - x0
+        return (corner(y0, x0, (1 - wy) * (1 - wx))
+                + corner(y0, x0 + 1, (1 - wy) * wx)
+                + corner(y0 + 1, x0, wy * (1 - wx))
+                + corner(y0 + 1, x0 + 1, wy * wx))
+
+    def _dc_geometry(attrs):
+        if attrs.layout not in (None, "NCHW"):
+            raise MXNetError("DeformableConvolution supports NCHW only "
+                             "(the reference kernel is NCHW too); got "
+                             "layout=%r" % (attrs.layout,))
+        if len(attrs.kernel) != 2:
+            raise MXNetError("DeformableConvolution is 2-d only")
+        kh, kw = attrs.kernel
+        sh, sw = attrs.stride or (1, 1)
+        dh, dw = attrs.dilate or (1, 1)
+        ph_, pw_ = attrs.pad or (0, 0)
+        return kh, kw, sh, sw, dh, dw, ph_, pw_
+
+    def deformable_convolution(attrs, data, offset, weight, *rest):
+        kh, kw, sh, sw, dh, dw, ph_, pw_ = _dc_geometry(attrs)
+        dg = attrs.num_deformable_group
+        ng = attrs.num_group
+        n, C, H, W = data.shape
+        F = attrs.num_filter
+        Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+        K = kh * kw
+        # base tap positions per output pixel
+        hb = (jnp.arange(Ho) * sh - ph_)[:, None] \
+            + (jnp.arange(kh) * dh)[None, :]        # (Ho, kh)
+        wb = (jnp.arange(Wo) * sw - pw_)[:, None] \
+            + (jnp.arange(kw) * dw)[None, :]        # (Wo, kw)
+        base_y = jnp.broadcast_to(hb[:, None, :, None], (Ho, Wo, kh, kw))
+        base_x = jnp.broadcast_to(wb[None, :, None, :], (Ho, Wo, kh, kw))
+        base_y = base_y.transpose(2, 3, 0, 1).reshape(K, Ho, Wo)
+        base_x = base_x.transpose(2, 3, 0, 1).reshape(K, Ho, Wo)
+
+        def per_sample(img, off):
+            # off (2*K*dg, Ho, Wo): [g, 2*(i*kw+j)] = dy, +1 = dx
+            off = off.reshape(dg, K, 2, Ho, Wo).astype(jnp.float32)
+            cols = []
+            Cg = C // dg
+            for g in range(dg):
+                ys = base_y.astype(jnp.float32) + off[g, :, 0]
+                xs = base_x.astype(jnp.float32) + off[g, :, 1]
+                cols.append(_bilinear_hw(
+                    img[g * Cg:(g + 1) * Cg].astype(jnp.float32),
+                    ys, xs))                        # (Cg, K, Ho, Wo)
+            return jnp.concatenate(cols, axis=0)    # (C, K, Ho, Wo)
+
+        cols = jax.vmap(per_sample)(data, offset)   # (n, C, K, Ho, Wo)
+        w = weight.reshape(ng, F // ng, C // ng, K).astype(jnp.float32)
+        cols = cols.reshape(n, ng, C // ng, K, Ho, Wo)
+        out = jnp.einsum("gfck,ngckhw->ngfhw", w, cols)
+        out = out.reshape(n, F, Ho, Wo)
+        if not attrs.no_bias:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out.astype(data.dtype)
+
+    def dc_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        kh, kw, sh, sw, dh, dw, ph_, pw_ = _dc_geometry(attrs)
+        Ho = (d[2] + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (d[3] + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+        off = (d[0], 2 * kh * kw * attrs.num_deformable_group, Ho, Wo)
+        wshape = (attrs.num_filter, d[1] // attrs.num_group, kh, kw)
+        ins = [d, off, wshape]
+        if not attrs.no_bias:
+            ins.append((attrs.num_filter,))
+        return (ins, [(d[0], attrs.num_filter, Ho, Wo)], aux_shapes)
+
+    register_op(
+        "_contrib_DeformableConvolution", deformable_convolution,
+        params={"kernel": Shape(), "stride": Shape(default=()),
+                "dilate": Shape(default=()), "pad": Shape(default=()),
+                "num_filter": Int(), "num_group": Int(default=1),
+                "num_deformable_group": Int(default=1),
+                "workspace": Int(default=1024),
+                "no_bias": Bool(default=False),
+                "layout": Str(default=None)},
+        num_inputs=lambda attrs: 3 if attrs.no_bias else 4,
+        input_names=lambda attrs: ["data", "offset", "weight"]
+        + ([] if attrs.no_bias else ["bias"]),
+        infer_shape=dc_infer,
+        doc="convolution whose kernel taps sample at learned offset "
+            "positions via zero-padded bilinear gather (reference: "
+            "src/operator/contrib/deformable_convolution-inl.h)")
+
+
+_register_deformable()

@@ -354,3 +354,66 @@ def test_roi_align_padded_roi_outputs_zero():
                                       spatial_scale=1.0)
     y.backward()
     assert (xa.grad.asnumpy() == 0).all()
+
+
+def test_deformable_convolution():
+    # reference: contrib/deformable_convolution-inl.h — zero offsets
+    # reduce to plain convolution; integer offsets shift the taps
+    r = np.random.RandomState(0)
+    x = r.randn(2, 4, 6, 6).astype(np.float32)
+    w = r.randn(3, 4, 3, 3).astype(np.float32)
+    b = r.randn(3).astype(np.float32)
+    off = np.zeros((2, 18, 4, 4), np.float32)
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w), mx.nd.array(b),
+        kernel=(3, 3), num_filter=3)
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                            mx.nd.array(b), kernel=(3, 3), num_filter=3)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    # +1/+1 integer offsets == conv of the shifted image
+    off1 = np.zeros((2, 18, 4, 4), np.float32)
+    off1[:, 0::2] = 1.0
+    off1[:, 1::2] = 1.0
+    out1 = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off1), mx.nd.array(w),
+        mx.nd.array(b), kernel=(3, 3), num_filter=3)
+    xs = np.zeros_like(x)
+    xs[:, :, :-1, :-1] = x[:, :, 1:, 1:]
+    ref1 = mx.nd.Convolution(mx.nd.array(xs), mx.nd.array(w),
+                             mx.nd.array(b), kernel=(3, 3), num_filter=3)
+    np.testing.assert_allclose(out1.asnumpy(), ref1.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    # gradients flow into data, offsets, and weights
+    from mxnet_tpu import autograd as ag
+
+    arrs = [mx.nd.array(a) for a in (x, off, w, b)]
+    for a in arrs:
+        a.attach_grad()
+    with ag.record():
+        y = mx.nd.contrib.DeformableConvolution(
+            *arrs, kernel=(3, 3), num_filter=3)
+    y.backward()
+    assert all(np.isfinite(a.grad.asnumpy()).all() for a in arrs)
+    assert np.abs(arrs[1].grad.asnumpy()).sum() > 0  # offsets learn
+    # fractional offsets differentiate smoothly (bilinear)
+    sym = mx.sym.contrib.DeformableConvolution(
+        mx.sym.Variable("data"), mx.sym.Variable("off"),
+        mx.sym.Variable("w"), mx.sym.Variable("b"), kernel=(3, 3),
+        num_filter=3)
+    _, out_shapes, _ = sym.infer_shape(data=(2, 4, 6, 6))
+    assert out_shapes == [(2, 3, 4, 4)]
+
+
+def test_deformable_conv_rejects_bad_layout_and_kernel():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.contrib.DeformableConvolution(
+        x, mx.sym.Variable("o"), mx.sym.Variable("w"), kernel=(3, 3),
+        num_filter=2, no_bias=True, layout="NHWC")
+    with pytest.raises(mx.MXNetError):
+        sym.infer_shape(data=(1, 4, 8, 8))
+    sym1d = mx.sym.contrib.DeformableConvolution(
+        x, mx.sym.Variable("o"), mx.sym.Variable("w"), kernel=(3,),
+        num_filter=2, no_bias=True)
+    with pytest.raises(mx.MXNetError):
+        sym1d.infer_shape(data=(1, 4, 8, 8))

@@ -172,6 +172,7 @@ SKIP = {
     "_contrib_MultiProposal": "alias of Proposal, tests/test_detection.py",
     "_contrib_ROIAlign_v2": "tests/test_detection.py",
     "_contrib_PSROIPooling": "tests/test_detection.py",
+    "_contrib_DeformableConvolution": "tests/test_detection.py",
     "_contrib_fft": "tests/test_operator.py contrib",
     "_contrib_ifft": "tests/test_operator.py contrib",
     "_contrib_quantize": "tests/test_operator.py contrib",
