@@ -27,7 +27,12 @@ __all__ = ["ShardedTrainer"]
 _FUSED_OPT = {
     "sgd": ("sgd_update", ()),
     "sgd_mom": ("sgd_mom_update", ("mom",)),
+    "mp_sgd": ("mp_sgd_update", ("weight32",)),
+    "mp_sgd_mom": ("mp_sgd_mom_update", ("mom", "weight32")),
     "adam": ("adam_update", ("mean", "var")),
+    "rmsprop": ("rmsprop_update", ("n",)),
+    "rmspropalex": ("rmspropalex_update", ("n", "g", "delta")),
+    "ftrl": ("ftrl_update", ("z", "n")),
 }
 
 
@@ -41,7 +46,10 @@ class ShardedTrainer:
     mesh : jax.sharding.Mesh
         Mesh with a data-parallel axis (default name 'dp').
     optimizer : str
-        'sgd' (momentum>0 selects sgd_mom) or 'adam'.
+        'sgd' / 'mp_sgd' (momentum>0 selects the _mom variant), 'adam',
+        'rmsprop', 'rmspropalex', or 'ftrl' — every fused update op in
+        ops/optimizer_ops.py. 'mp_sgd' keeps an fp32 master copy of bf16
+        weights (reference mp_sgd_update, src/operator/optimizer_op.cc).
     optimizer_params : dict
         lr/wd/momentum/... forwarded to the fused update op.
     data_names / label_names : input variable names (sharded on dp).
@@ -68,10 +76,11 @@ class ShardedTrainer:
         self._lr = opt_params.pop("learning_rate", opt_params.pop("lr", 0.01))
         self._user_rescale = "rescale_grad" in opt_params
         momentum = opt_params.get("momentum", 0.0)
-        if optimizer == "sgd" and momentum > 0:
-            optimizer = "sgd_mom"
-        elif optimizer == "sgd":
-            opt_params.pop("momentum", None)
+        if optimizer in ("sgd", "mp_sgd"):
+            if momentum > 0:
+                optimizer += "_mom"
+            else:
+                opt_params.pop("momentum", None)
         if optimizer not in _FUSED_OPT:
             raise MXNetError("ShardedTrainer supports %s; got %r"
                              % (sorted(_FUSED_OPT), optimizer))
@@ -127,11 +136,19 @@ class ShardedTrainer:
             aux[name] = jax.device_put(
                 jnp.full(aux_shape_d[name], fill, dtype=np.float32),
                 self._rep_sharding)
+        def _init_state(state_name, param_name):
+            # the mp_sgd master copy starts as the fp32 value of the
+            # (possibly bf16) initialized weight, not zeros
+            if state_name == "weight32":
+                return jax.device_put(
+                    jnp.asarray(params[param_name], dtype=np.float32),
+                    self._rep_sharding)
+            return jax.device_put(
+                jnp.zeros(shapes[param_name], dtype=np.float32),
+                self._rep_sharding)
+
         opt_state = {
-            name: tuple(jax.device_put(jnp.zeros(shapes[name],
-                                                 dtype=np.float32),
-                                       self._rep_sharding)
-                        for _ in self._opt_state_names)
+            name: tuple(_init_state(s, name) for s in self._opt_state_names)
             for name in self.param_names}
         return {"params": params, "aux": aux, "opt": opt_state, "step": 0}
 
