@@ -23,4 +23,5 @@ from . import optimizer_ops
 from . import random_ops
 from . import rnn
 from . import contrib
+from . import legacy_ops
 from .. import operator as _operator  # noqa: F401  (registers Custom)

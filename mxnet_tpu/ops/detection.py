@@ -726,9 +726,9 @@ def _register_deformable():
     nn/deformable_im2col.cuh; Dai et al., "Deformable Convolutional
     Networks"). The CUDA bilinear-im2col becomes a vectorized gather:
     every kernel tap's sampling position is shifted by the learned
-    offset and read with zero-padded bilinear interpolation.
-    (DeformablePSROIPooling remains unimplemented — it raises as an
-    unknown op rather than existing as a wrong one.)"""
+    offset and read with zero-padded bilinear interpolation. Also
+    DeformablePSROIPooling (deformable_psroi_pooling.cu), whose per-part
+    offsets come from a learned `trans` input."""
     import jax
 
     jnp = _jnp()
@@ -841,6 +841,118 @@ def _register_deformable():
         doc="convolution whose kernel taps sample at learned offset "
             "positions via zero-padded bilinear gather (reference: "
             "src/operator/contrib/deformable_convolution-inl.h)")
+
+    def deformable_psroi_pooling(attrs, data, rois, *rest):
+        p = attrs.pooled_size
+        part = attrs.part_size or p
+        group = attrs.group_size
+        od = attrs.output_dim
+        spp = attrs.sample_per_part
+        scale = attrs.spatial_scale
+        no_trans = attrs.no_trans or not rest
+        n, C, H, W = data.shape
+        x = data.astype(jnp.float32)
+        if no_trans:
+            ncls = 1
+        else:
+            ncls = rest[0].shape[1] // 2
+        ch_each = od if no_trans else od // ncls
+        # static per-output-position maps (the kernel's integer math)
+        ph_i = np.arange(p)
+        part_h = np.minimum((ph_i * part) // p, part - 1)
+        gh = np.clip((ph_i * group) // p, 0, group - 1)
+        ctop = np.arange(od)
+        cls_map = ctop // ch_each                      # (od,)
+        cmap = ((ctop[:, None, None] * group + gh[None, :, None]) * group
+                + gh[None, None, :])                   # (od, p, p) input ch
+
+        def per_roi(roi, tr):
+            bidx = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1]) * scale - 0.5
+            y1 = jnp.round(roi[2]) * scale - 0.5
+            x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+            y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bh, bw = rh / p, rw / p
+            sub_h, sub_w = bh / spp, bw / spp
+            if no_trans:
+                tx = jnp.zeros((1, p, p), dtype=jnp.float32)
+                ty = jnp.zeros((1, p, p), dtype=jnp.float32)
+            else:
+                trp = tr.reshape(ncls, 2, part, part).astype(jnp.float32)
+                sel = trp[:, :, jnp.asarray(part_h)[:, None],
+                          jnp.asarray(part_h)[None, :]]  # (ncls, 2, p, p)
+                tx = sel[:, 0] * attrs.trans_std
+                ty = sel[:, 1] * attrs.trans_std
+            phf = jnp.arange(p, dtype=jnp.float32)
+            hstart = (phf * bh + y1)[None, :, None] + ty * rh  # (ncls,p,p)
+            wstart = (phf * bw + x1)[None, None, :] + tx * rw
+            # expand to per-output-channel start positions
+            hs = hstart[jnp.asarray(cls_map)]          # (od, p, p)
+            ws = wstart[jnp.asarray(cls_map)]
+            img = x[bidx]                              # (C, H, W)
+            chan = jnp.asarray(cmap)
+            total = jnp.zeros((od, p, p), dtype=jnp.float32)
+            cnt = jnp.zeros((od, p, p), dtype=jnp.float32)
+            for ih in range(spp):
+                for iw in range(spp):
+                    hh = hs + ih * sub_h
+                    ww = ws + iw * sub_w
+                    valid = ((ww >= -0.5) & (ww <= W - 0.5)
+                             & (hh >= -0.5) & (hh <= H - 0.5))
+                    hc = jnp.clip(hh, 0.0, H - 1.0)
+                    wc = jnp.clip(ww, 0.0, W - 1.0)
+                    y0 = jnp.floor(hc)
+                    x0 = jnp.floor(wc)
+                    dy = hc - y0
+                    dx = wc - x0
+                    y0i = y0.astype(jnp.int32)
+                    x0i = x0.astype(jnp.int32)
+                    y1i = jnp.minimum(y0i + 1, H - 1)
+                    x1i = jnp.minimum(x0i + 1, W - 1)
+                    val = ((1 - dy) * (1 - dx) * img[chan, y0i, x0i]
+                           + (1 - dy) * dx * img[chan, y0i, x1i]
+                           + dy * (1 - dx) * img[chan, y1i, x0i]
+                           + dy * dx * img[chan, y1i, x1i])
+                    vf = valid.astype(jnp.float32)
+                    total = total + val * vf
+                    cnt = cnt + vf
+            return jnp.where(cnt > 0, total / jnp.maximum(cnt, 1.0), 0.0)
+
+        rois_f = rois.astype(jnp.float32)
+        if no_trans:
+            trans = jnp.zeros((rois.shape[0], 2, part, part),
+                              dtype=jnp.float32)
+        else:
+            trans = rest[0]
+        out = jax.vmap(per_roi)(rois_f, trans)
+        return out.astype(data.dtype)
+
+    def dps_infer(attrs, in_shapes, aux_shapes):
+        d, r = in_shapes[0], in_shapes[1]
+        if r is None:
+            return None
+        p = attrs.pooled_size
+        return (in_shapes, [(r[0], attrs.output_dim, p, p)], aux_shapes)
+
+    register_op(
+        "_contrib_DeformablePSROIPooling", deformable_psroi_pooling,
+        params={"spatial_scale": Float(), "output_dim": Int(),
+                "group_size": Int(), "pooled_size": Int(),
+                "part_size": Int(default=0),
+                "sample_per_part": Int(default=1),
+                "trans_std": Float(default=0.0),
+                "no_trans": Bool(default=False)},
+        num_inputs=lambda attrs: 2 if attrs.no_trans else 3,
+        input_names=lambda attrs: ["data", "rois"]
+        + ([] if attrs.no_trans else ["trans"]),
+        infer_shape=dps_infer,
+        doc="position-sensitive ROI pooling with learned per-part "
+            "(dx, dy) offsets scaled by trans_std and the roi size; "
+            "sample_per_part^2 bilinear samples per bin, averaging only "
+            "in-image samples (reference: src/operator/contrib/"
+            "deformable_psroi_pooling.cu DeformablePSROIPoolForwardKernel)")
 
 
 _register_deformable()

@@ -369,5 +369,76 @@ def _register():
             "mean over (kernel window x channels) of data1·shift(data2) "
             "(reference: src/operator/correlation1D-inl.h)")
 
+    # --- Correlation (2-D, upstream FlowNet op) ----------------------------
+    def _corr_dims(attrs, h, w):
+        kr = (attrs.kernel_size - 1) // 2
+        border = attrs.max_displacement + kr
+        ph_, pw_ = h + 2 * attrs.pad_size, w + 2 * attrs.pad_size
+        top_h = int(np.ceil((ph_ - 2 * border) / float(attrs.stride1)))
+        top_w = int(np.ceil((pw_ - 2 * border) / float(attrs.stride1)))
+        ngr = attrs.max_displacement // attrs.stride2
+        return kr, top_h, top_w, ngr, 2 * ngr + 1
+
+    def correlation(attrs, data1, data2):
+        ks = attrs.kernel_size
+        if ks % 2 == 0:
+            raise MXNetError("kernel_size must be odd")
+        s1, s2, pad, max_d = (attrs.stride1, attrs.stride2, attrs.pad_size,
+                              attrs.max_displacement)
+        _, top_h, top_w, ngr, ngw = _corr_dims(attrs, *data1.shape[2:])
+        if top_h < 1 or top_w < 1:
+            raise MXNetError("Correlation: neighborhood and kernel do not "
+                             "fit in the input")
+        n, c, h, w = data1.shape
+        spatial_pad = ((0, 0), (0, 0), (pad, pad), (pad, pad))
+        a = jnp.pad(data1.astype(jnp.float32), spatial_pad)
+        b = jnp.pad(data2.astype(jnp.float32), spatial_pad)
+        norm = float(ks * ks * c)
+        chans = []
+        for tc in range(ngw * ngw):
+            s2o = (tc % ngw - ngr) * s2   # x displacement
+            s2p = (tc // ngw - ngr) * s2  # y displacement
+            acc = 0.0
+            for j in range(ks):
+                for i in range(ks):
+                    av = a[:, :, max_d + j:max_d + j + top_h * s1:s1,
+                           max_d + i:max_d + i + top_w * s1:s1]
+                    bv = b[:, :,
+                           max_d + s2p + j:max_d + s2p + j + top_h * s1:s1,
+                           max_d + s2o + i:max_d + s2o + i + top_w * s1:s1]
+                    if attrs.is_multiply:
+                        acc = acc + jnp.sum(av * bv, axis=1)
+                    else:
+                        acc = acc + jnp.sum(jnp.abs(av - bv), axis=1)
+            chans.append(acc / norm)
+        return jnp.stack(chans, axis=1).astype(data1.dtype)
+
+    def corr_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        if attrs.kernel_size % 2 == 0:
+            raise MXNetError("kernel_size must be odd")
+        _, top_h, top_w, _, ngw = _corr_dims(attrs, d[2], d[3])
+        if top_h < 1 or top_w < 1:
+            raise MXNetError("Correlation: neighborhood and kernel do not "
+                             "fit in the input")
+        return ([d, d], [(d[0], ngw * ngw, top_h, top_w)], aux_shapes)
+
+    register_op(
+        "Correlation", correlation,
+        params={"kernel_size": Int(default=1),
+                "max_displacement": Int(default=1),
+                "stride1": Int(default=1), "stride2": Int(default=1),
+                "pad_size": Int(default=0), "is_multiply": Bool(default=True)},
+        num_inputs=2, input_names=["data1", "data2"],
+        infer_shape=corr_infer,
+        doc="FlowNet 2-D correlation over a (2r+1)^2 displacement grid; "
+            "channel tc holds displacement (dy, dx) = ((tc//W)-r, "
+            "(tc%W)-r)*stride2; mean over kernel window x channels of "
+            "data1*shift(data2) (is_multiply) or |data1-shift(data2)| "
+            "(reference: src/operator/correlation-inl.h, correlation.cc "
+            "CorrelationForward)")
+
 
 _register()

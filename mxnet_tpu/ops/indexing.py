@@ -149,5 +149,36 @@ def _register_nd_scatter():
             "keep one value, matching the reference's non-determinism note "
             "(reference: indexing_op.cc scatter_nd)")
 
+    def scatter_set_nd(attrs, lhs, rhs, indices):
+        m = indices.shape[0]
+        idx = tuple(indices[i].astype(jnp.int32) for i in range(m))
+        return lhs.at[idx].set(rhs)
+
+    register_op(
+        "_scatter_set_nd", scatter_set_nd, params={"shape": Shape()},
+        num_inputs=3, input_names=["lhs", "rhs", "indices"],
+        infer_shape=lambda attrs, ins, auxs: (ins, [tuple(attrs.shape)],
+                                              auxs),
+        doc="lhs with rhs written at nd indices — backs advanced indexed "
+            "assignment x[idx] = v (reference: indexing_op.cc "
+            "_scatter_set_nd)")
+
+    def batch_take(attrs, a, indices):
+        idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+        return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+    def batch_take_infer(attrs, in_shapes, aux_shapes):
+        a, i = in_shapes
+        if a is None:
+            return None
+        return ([a, (a[0],) if i is None else i], [(a[0],)], aux_shapes)
+
+    register_op(
+        "batch_take", batch_take, params={},
+        num_inputs=2, input_names=["a", "indices"],
+        infer_shape=batch_take_infer,
+        doc="out[i] = a[i, indices[i]] for 2-D a (reference: "
+            "indexing_op.cc batch_take)")
+
 
 _register_nd_scatter()

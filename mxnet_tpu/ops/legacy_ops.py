@@ -1,0 +1,298 @@
+"""Legacy and internal graph ops kept for reference op-name parity.
+
+Covers the tail of the reference registry that real MXNet-1.0 graphs can
+contain but that earlier rounds skipped:
+
+- ``Crop`` — the legacy spatial crop layer (src/operator/crop.cc), distinct
+  from the lowercase ``crop`` alias of ``slice``.
+- ``IdentityAttachKLSparseReg`` — identity forward with a KL sparseness
+  penalty attached to the gradient
+  (src/operator/identity_attach_KL_sparse_reg-inl.h).
+- ``_slice_assign`` / ``_slice_assign_scalar`` (+ their historical
+  ``_crop_assign`` aliases) — functional slice assignment backing
+  ``x[a:b] = y`` (src/operator/tensor/matrix_op.cc _slice_assign).
+- ``_grad_add``, ``_identity_with_attr_like_rhs``, ``_scatter_*`` — internal
+  nodes emitted by the reference's gradient passes and sparse frontends
+  (src/operator/tensor/elemwise_binary_op_basic.cc,
+  elemwise_scatter_op.cc). On a dense XLA program the scatter variants
+  compute the same math as their base ops; row-sparse storage optimization
+  lives at the NDArray layer (ndarray/sparse.py), not in op dispatch.
+- ``*_v1`` legacy layer names and ``_linalg_*`` internal names as aliases.
+- ``_CrossDeviceCopy`` — the PlaceDevice-inserted copy node
+  (src/operator/cross_device_copy.cc). Device movement is the executor's
+  job here (group2ctx lowering / jax.device_put); inside one XLA program
+  it is the identity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .param import Bool, Enum, Float, Int, Shape
+from .registry import alias_op, register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _register():
+    jnp = _jnp()
+
+    # --- Crop (legacy layer, crop.cc) ----------------------------------
+    def crop_layer(attrs, *inputs):
+        data = inputs[0]
+        h, w = data.shape[2], data.shape[3]
+        if attrs.num_args == 2:
+            ch, cw = inputs[1].shape[2], inputs[1].shape[3]
+        else:
+            ch, cw = attrs.h_w
+        if attrs.center_crop:
+            oy, ox = (h - ch) // 2, (w - cw) // 2
+        else:
+            oy, ox = attrs.offset
+        if oy + ch > h or ox + cw > w:
+            raise MXNetError("crop offset+size exceeds input (%d+%d > %d or "
+                             "%d+%d > %d)" % (oy, ch, h, ox, cw, w))
+        return data[:, :, oy:oy + ch, ox:ox + cw]
+
+    def crop_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        if attrs.num_args == 2:
+            like = in_shapes[1]
+            if like is None:
+                return None
+            ch, cw = like[2], like[3]
+        else:
+            ch, cw = attrs.h_w
+        return (in_shapes, [(d[0], d[1], ch, cw)], aux_shapes)
+
+    register_op(
+        "Crop", crop_layer,
+        params={"num_args": Int(default=1), "offset": Shape(default=(0, 0)),
+                "h_w": Shape(default=(0, 0)),
+                "center_crop": Bool(default=False)},
+        num_inputs=lambda attrs: attrs.num_args,
+        input_names=lambda attrs: (["data", "crop_like"]
+                                   if attrs.num_args == 2 else ["data"]),
+        infer_shape=crop_infer,
+        doc="crop 4-D data to h_w (num_args=1) or to crop_like's spatial "
+            "size (num_args=2), at offset (y, x) or centered; gradient to "
+            "crop_like is zero, matching the reference "
+            "(src/operator/crop-inl.h)")
+
+    # --- IdentityAttachKLSparseReg -------------------------------------
+    def kl_sparse_reg(attrs, data, aux=(), is_train=False):
+        import jax
+
+        (moving_avg,) = aux
+        rho = attrs.sparseness_target
+        penalty = attrs.penalty
+        mom = attrs.momentum
+        flat = data.reshape(data.shape[0], -1)
+        if is_train:
+            new_avg = mom * moving_avg + (1 - mom) * jnp.mean(flat, axis=0)
+        else:
+            new_avg = moving_avg
+
+        @jax.custom_vjp
+        def _ident(x, avg):
+            return x
+
+        def _fwd(x, avg):
+            return x, (x.shape, avg)
+
+        def _bwd(res, g):
+            shape, avg = res
+            pen = penalty * (-rho / avg + (1 - rho) / (1 - avg))
+            gflat = g.reshape(g.shape[0], -1) + pen[None, :]
+            return gflat.reshape(shape), jnp.zeros_like(avg)
+
+        _ident.defvjp(_fwd, _bwd)
+        return (_ident(flat, new_avg).reshape(data.shape),), (new_avg,)
+
+    def kl_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        rest = int(np.prod(d[1:])) if len(d) > 1 else 1
+        return ([d], [d], [(rest,)])
+
+    register_op(
+        "IdentityAttachKLSparseReg", kl_sparse_reg,
+        params={"sparseness_target": Float(default=0.1),
+                "penalty": Float(default=0.001),
+                "momentum": Float(default=0.9)},
+        num_inputs=1, input_names=["data"], aux_names=["moving_avg"],
+        needs_is_train=True, infer_shape=kl_infer,
+        doc="identity forward; backward adds the KL(rho || rho_hat) "
+            "sparseness penalty using a moving average of mean activation "
+            "(pair with sigmoid activations; reference: "
+            "src/operator/identity_attach_KL_sparse_reg-inl.h)")
+
+    # --- slice assignment ----------------------------------------------
+    def _assign_index(shape, attrs):
+        idx = []
+        begin, end = attrs.begin, attrs.end
+        step = attrs.step if attrs.step else ()
+        for i, d in enumerate(shape):
+            b = begin[i] if i < len(begin) and begin[i] is not None else 0
+            e = end[i] if i < len(end) and end[i] is not None else d
+            s = step[i] if i < len(step) and step[i] is not None else 1
+            idx.append(slice(b, e, s))
+        return tuple(idx)
+
+    def slice_assign(attrs, lhs, rhs):
+        return lhs.at[_assign_index(lhs.shape, attrs)].set(rhs)
+
+    def slice_assign_scalar(attrs, lhs):
+        return lhs.at[_assign_index(lhs.shape, attrs)].set(attrs.scalar)
+
+    _slice_params = {"begin": Shape(), "end": Shape(),
+                     "step": Shape(default=None)}
+    register_op(
+        "_slice_assign", slice_assign, params=dict(_slice_params),
+        num_inputs=2, input_names=["lhs", "rhs"],
+        infer_shape=lambda attrs, ins, auxs:
+            None if ins[0] is None else (ins, [ins[0]], auxs),
+        doc="lhs with lhs[begin:end:step] replaced by rhs — functional "
+            "slice assignment (reference: matrix_op.cc _slice_assign)")
+    alias_op("_slice_assign", "_crop_assign")
+    register_op(
+        "_slice_assign_scalar", slice_assign_scalar,
+        params=dict(_slice_params, scalar=Float(default=0.0)),
+        num_inputs=1, input_names=["data"],
+        infer_shape=lambda attrs, ins, auxs:
+            None if ins[0] is None else (ins, [ins[0]], auxs),
+        doc="lhs with lhs[begin:end:step] = scalar (reference: "
+            "matrix_op.cc _slice_assign_scalar)")
+    alias_op("_slice_assign_scalar", "_crop_assign_scalar")
+
+    # --- internal gradient-pass / sparse-frontend nodes -----------------
+    def grad_add(attrs, lhs, rhs):
+        return lhs + rhs
+
+    register_op(
+        "_grad_add", grad_add, num_inputs=2, input_names=["lhs", "rhs"],
+        doc="gradient aggregation add emitted by the reference's Gradient "
+            "pass (elemwise_binary_op_basic.cc _grad_add)")
+
+    def identity_with_attr_like_rhs(attrs, lhs, rhs):
+        return lhs
+
+    register_op(
+        "_identity_with_attr_like_rhs", identity_with_attr_like_rhs,
+        num_inputs=2, input_names=["lhs", "rhs"],
+        infer_shape=lambda attrs, ins, auxs:
+            None if ins[0] is None else (ins, [ins[0]], auxs),
+        doc="identity of lhs carrying rhs's storage attributes in the "
+            "reference's stype inference; dense here "
+            "(elemwise_unary_op_basic.cc)")
+
+    def scatter_plus_scalar(attrs, data):
+        return data + attrs.scalar
+
+    def scatter_minus_scalar(attrs, data):
+        return data - attrs.scalar
+
+    def scatter_elemwise_div(attrs, lhs, rhs):
+        return lhs / rhs
+
+    for name, fn, n_in, names in (
+            ("_scatter_plus_scalar", scatter_plus_scalar, 1, ["data"]),
+            ("_scatter_minus_scalar", scatter_minus_scalar, 1, ["data"])):
+        register_op(
+            name, fn, params={"scalar": Float(default=0.0)},
+            num_inputs=n_in, input_names=names,
+            doc="scalar op variant that preserves sparse output storage in "
+                "the reference (elemwise_scatter_op.cc); dense XLA compute "
+                "here — row-sparse storage lives at the NDArray layer")
+    register_op(
+        "_scatter_elemwise_div", scatter_elemwise_div,
+        num_inputs=2, input_names=["lhs", "rhs"],
+        doc="elemwise div preserving lhs's sparse storage in the reference "
+            "(elemwise_scatter_op.cc); dense XLA compute here")
+
+    # --- sparse ops: dense value semantics for compiled graphs -----------
+    # The reference dispatches these by storage type (FInferStorageType,
+    # include/mxnet/op_attr_types.h:185-264). Here storage type is an
+    # NDArray-layer property (ndarray/sparse.py holds the rsp/csr
+    # machinery and mx.nd.cast_storage/sparse_retain/square_sum are the
+    # storage-aware frontends); the registered ops give the same VALUE
+    # semantics inside a compiled dense graph, so symbols containing them
+    # lower to XLA.
+    def cast_storage_op(attrs, data):
+        if attrs.stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError("unknown stype %r" % (attrs.stype,))
+        return data
+
+    register_op(
+        "cast_storage", cast_storage_op,
+        params={"stype": Enum(["default", "row_sparse", "csr"])},
+        doc="storage cast (src/operator/tensor/cast_storage-inl.h). "
+            "Value-identity in a compiled graph; the storage-aware "
+            "NDArray path is mx.nd.cast_storage (ndarray/sparse.py)")
+
+    def sparse_retain(attrs, data, indices):
+        idx = indices.astype(jnp.int32)
+        out = jnp.zeros_like(data)
+        return out.at[idx].set(data[idx])
+
+    register_op(
+        "_sparse_retain", sparse_retain,
+        num_inputs=2, input_names=["data", "indices"],
+        infer_shape=lambda attrs, ins, auxs:
+            None if ins[0] is None else (ins, [ins[0]], auxs),
+        doc="keep only the listed rows, zeroing the rest — the dense "
+            "value semantics of rsp retain (src/operator/tensor/"
+            "sparse_retain.cc); storage-aware path: mx.nd.sparse_retain")
+
+    def square_sum(attrs, data):
+        ax = attrs.axis
+        return jnp.sum(jnp.square(data), axis=ax,
+                       keepdims=bool(attrs.keepdims))
+
+    register_op(
+        "_square_sum", square_sum,
+        params={"axis": Shape(default=None), "keepdims": Bool(default=False)},
+        doc="fused sum of squares over axis (src/operator/tensor/"
+            "square_sum-inl.h; the rsp-fused norm used by "
+            "clip_global_norm); storage-aware path: mx.nd.square_sum")
+
+    # contrib SparseEmbedding: identical forward to Embedding; the
+    # row-sparse gradient optimization is the NDArray/optimizer layer's
+    # job (sparse-grad embedding, ndarray/sparse.py sparse_embedding)
+    alias_op("Embedding", "_contrib_SparseEmbedding")
+
+    # --- cross-device copy ----------------------------------------------
+    def cross_device_copy(attrs, data):
+        return data
+
+    register_op(
+        "_CrossDeviceCopy", cross_device_copy,
+        doc="device-boundary copy node inserted by the reference's "
+            "PlaceDevice pass (src/operator/cross_device_copy.cc). The "
+            "group2ctx lowering here moves data via jax.device_put at the "
+            "executor level; within one XLA program this is the identity")
+
+    # --- legacy *_v1 and internal _linalg_* names ------------------------
+    # The v1 layers are the pre-NNVM registrations kept by the reference
+    # for checkpoint back-compat (src/operator/{convolution,pooling,
+    # batch_norm}_v1.cc). Their parameter surface is a subset of the
+    # modern ops'; the semantic deltas (2-D-only kernels, no `axis`) are
+    # enforced by the modern implementations' own validation.
+    alias_op("Convolution", "Convolution_v1")
+    alias_op("Pooling", "Pooling_v1")
+    alias_op("BatchNorm", "BatchNorm_v1")
+    # the reference registers la_ops as _linalg_* and surfaces them in
+    # python as mx.nd.linalg_* / mx.sym.linalg.*; accept both names
+    for _la in ("gemm", "gemm2", "potrf", "potri", "trmm", "trsm",
+                "sumlogdiag", "syrk", "gelqf", "syevd"):
+        alias_op("linalg_" + _la, "_linalg_" + _la, visible=False)
+
+
+_register()

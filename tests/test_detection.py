@@ -417,3 +417,87 @@ def test_deformable_conv_rejects_bad_layout_and_kernel():
         num_filter=2, no_bias=True)
     with pytest.raises(mx.MXNetError):
         sym1d.infer_shape(data=(1, 4, 8, 8))
+
+
+def _dpsroi_ref(data, rois, trans, scale, od, group, p, part, spp,
+                trans_std, no_trans):
+    """Transcription of deformable_psroi_pooling.cu
+    DeformablePSROIPoolForwardKernel."""
+    n_rois = rois.shape[0]
+    _, C, H, W = data.shape
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    ch_each = od if no_trans else od // ncls
+    out = np.zeros((n_rois, od, p, p), np.float64)
+
+    def bilinear(img, w, h):
+        x1, y1 = int(np.floor(w)), int(np.floor(h))
+        x2, y2 = min(x1 + 1, W - 1), min(y1 + 1, H - 1)
+        dx, dy = w - x1, h - y1
+        return ((1 - dy) * (1 - dx) * img[y1, x1]
+                + (1 - dy) * dx * img[y1, x2]
+                + dy * (1 - dx) * img[y2, x1]
+                + dy * dx * img[y2, x2])
+
+    for n in range(n_rois):
+        b = int(rois[n, 0])
+        x1 = round(rois[n, 1]) * scale - 0.5
+        y1 = round(rois[n, 2]) * scale - 0.5
+        x2 = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        y2 = (round(rois[n, 4]) + 1.0) * scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        sub_h, sub_w = bh / spp, bw / spp
+        for ctop in range(od):
+            cls = ctop // ch_each
+            for ph in range(p):
+                for pw in range(p):
+                    part_h = int(np.floor(ph / p * part))
+                    part_w = int(np.floor(pw / p * part))
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[n, cls * 2, part_h, part_w] * trans_std
+                        ty = trans[n, cls * 2 + 1, part_h, part_w] * trans_std
+                    wstart = pw * bw + x1 + tx * rw
+                    hstart = ph * bh + y1 + ty * rh
+                    gw = min(max(int(np.floor(pw * group / p)), 0), group - 1)
+                    gh = min(max(int(np.floor(ph * group / p)), 0), group - 1)
+                    c = (ctop * group + gh) * group + gw
+                    s, cnt = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w = wstart + iw * sub_w
+                            h = hstart + ih * sub_h
+                            if w < -0.5 or w > W - 0.5 or h < -0.5 \
+                                    or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            s += bilinear(data[b, c], w, h)
+                            cnt += 1
+                    out[n, ctop, ph, pw] = 0.0 if cnt == 0 else s / cnt
+    return out
+
+
+def test_deformable_psroi_pooling_matches_reference():
+    r = np.random.RandomState(5)
+    od, group, p = 2, 2, 3
+    C = od * group * group
+    data = r.randn(2, C, 10, 12).astype(np.float32)
+    rois = np.array([[0, 1, 1, 8, 9], [1, 2, 0, 11, 7]], np.float32)
+    ncls = 1
+    trans = (r.rand(2, 2 * ncls, p, p).astype(np.float32) - 0.5)
+    for no_trans, spp, tstd in [(True, 2, 0.0), (False, 2, 0.3),
+                                (False, 3, 0.1)]:
+        args = [mx.nd.array(data), mx.nd.array(rois)]
+        if not no_trans:
+            args.append(mx.nd.array(trans))
+        out = mx.nd.contrib.DeformablePSROIPooling(
+            *args, spatial_scale=0.5, output_dim=od, group_size=group,
+            pooled_size=p, sample_per_part=spp, trans_std=tstd,
+            no_trans=no_trans)
+        exp = _dpsroi_ref(data.astype(np.float64), rois, trans, 0.5, od,
+                          group, p, p, spp, tstd, no_trans)
+        assert out.shape == exp.shape
+        np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-4,
+                                   atol=1e-5)

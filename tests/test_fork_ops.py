@@ -267,3 +267,65 @@ def test_navg_zero_count_is_zero():
     out = mx.nd.nAvg(mx.nd.array(x), threshold=1.0)
     assert np.isfinite(out.asnumpy()).all()
     assert (out.asnumpy() == 0).all()
+
+
+def _corr2d_ref(d1, d2, ks, max_d, s1, s2, pad, is_multiply):
+    """Direct transcription of the reference CPU loops
+    (src/operator/correlation.cc CorrelationForward)."""
+    n, c, h, w = d1.shape
+    kr = (ks - 1) // 2
+    border = max_d + kr
+    ph_, pw_ = h + 2 * pad, w + 2 * pad
+    top_h = int(np.ceil((ph_ - 2 * border) / float(s1)))
+    top_w = int(np.ceil((pw_ - 2 * border) / float(s1)))
+    ngr = max_d // s2
+    ngw = 2 * ngr + 1
+    t1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    t2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, ngw * ngw, top_h, top_w), np.float64)
+    sumelems = ks * ks * c
+    for i in range(top_h):
+        for j in range(top_w):
+            x1, y1 = j * s1 + max_d, i * s1 + max_d
+            for tc in range(ngw * ngw):
+                s2o = (tc % ngw - ngr) * s2
+                s2p = (tc // ngw - ngr) * s2
+                x2, y2 = x1 + s2o, y1 + s2p
+                a = t1[:, :, y1:y1 + ks, x1:x1 + ks]
+                b = t2[:, :, y2:y2 + ks, x2:x2 + ks]
+                if is_multiply:
+                    v = (a * b).sum(axis=(1, 2, 3))
+                else:
+                    v = np.abs(a - b).sum(axis=(1, 2, 3))
+                out[:, tc, i, j] = v / sumelems
+    return out
+
+
+def test_correlation_2d_matches_reference_loops():
+    r = _rs(11)
+    n, c, h, w = 2, 3, 8, 9
+    d1 = r.randn(n, c, h, w).astype(np.float32)
+    d2 = r.randn(n, c, h, w).astype(np.float32)
+    for ks, max_d, s1, s2, pad, mult in [(1, 2, 1, 1, 2, True),
+                                         (3, 1, 1, 1, 2, True),
+                                         (1, 2, 2, 2, 2, False)]:
+        out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                                kernel_size=ks, max_displacement=max_d,
+                                stride1=s1, stride2=s2, pad_size=pad,
+                                is_multiply=mult)
+        exp = _corr2d_ref(d1.astype(np.float64), d2.astype(np.float64),
+                          ks, max_d, s1, s2, pad, mult)
+        assert out.shape == exp.shape, (out.shape, exp.shape)
+        np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_correlation_2d_gradients():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    r = _rs(12)
+    d1 = (r.rand(1, 2, 6, 6) * 2 - 1).astype(np.float64)
+    d2 = (r.rand(1, 2, 6, 6) * 2 - 1).astype(np.float64)
+    sym = mx.sym.Correlation(mx.sym.Variable("a"), mx.sym.Variable("b"),
+                             kernel_size=1, max_displacement=1,
+                             pad_size=1)
+    check_numeric_gradient(sym, {"a": d1, "b": d2}, rtol=1e-2, atol=1e-3)

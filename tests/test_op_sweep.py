@@ -129,7 +129,14 @@ _SHAPE_GRAD = ["Reshape", "reshape", "Flatten", "flatten", "transpose",
                "BlockGrad", "ElementWiseSum", "add_n", "take", "pick",
                "one_hot", "ones_like", "zeros_like", "SliceChannel",
                "split", "dot", "batch_dot", "choose_element_0index",
-               "gather_nd", "scatter_nd", "sort", "argsort", "topk"]
+               "gather_nd", "scatter_nd", "sort", "argsort", "topk",
+               "batch_take", "_scatter_set_nd", "_slice_assign",
+               "_slice_assign_scalar", "_crop_assign",
+               "_crop_assign_scalar", "_grad_add",
+               "_identity_with_attr_like_rhs", "_scatter_plus_scalar",
+               "_scatter_minus_scalar", "_scatter_elemwise_div",
+               "Crop", "_CrossDeviceCopy", "cast_storage",
+               "_sparse_retain", "_square_sum"]
 
 SKIP = {
     # op families with dedicated numeric test files
@@ -191,6 +198,21 @@ SKIP = {
     "SPN": "tests/test_fork_ops.py",
     "SCN": "tests/test_fork_ops.py",
     "Correlation1D": "tests/test_fork_ops.py",
+    "Correlation": "tests/test_fork_ops.py (vs reference-loop numpy)",
+    "IdentityAttachKLSparseReg": "tests/test_operator.py KL sparse reg",
+    "_contrib_DeformablePSROIPooling": "tests/test_detection.py",
+    # legacy-name aliases of modern ops (src/operator/*_v1.cc kept for
+    # checkpoint back-compat); numerics covered by the modern op's tests
+    "Convolution_v1": "alias of Convolution",
+    "Pooling_v1": "alias of Pooling",
+    "BatchNorm_v1": "alias of BatchNorm",
+    "_linalg_gemm": "alias", "_linalg_gemm2": "alias",
+    "_linalg_potrf": "alias", "_linalg_potri": "alias",
+    "_linalg_trmm": "alias", "_linalg_trsm": "alias",
+    "_linalg_sumlogdiag": "alias", "_linalg_syrk": "alias",
+    "_linalg_gelqf": "alias", "_linalg_syevd": "alias",
+    "_contrib_SparseEmbedding": "alias of Embedding (sparse grad: "
+                                "tests/test_sparse.py)",
     "linalg_gemm": "tests/test_linalg_spatial.py",
     "linalg_gemm2": "tests/test_linalg_spatial.py",
     "linalg_potrf": "tests/test_linalg_spatial.py",
@@ -325,11 +347,38 @@ def _build_cases():
         "sort": ([_sym(3, 4)], {}),
         "argsort": ([_sym(3, 4)], {}),
         "topk": ([_sym(3, 6)], {"k": 2}),
+        "batch_take": ([_sym(4, 5), np.array([0.0, 2, 4, 1])], {}),
+        "_scatter_set_nd": ([_sym(4, 3), _sym(2, 3),
+                             np.array([[0.0, 2]])], {"shape": (4, 3)}),
+        "_slice_assign": ([_sym(4, 5), _sym(2, 3)],
+                          {"begin": (1, 0), "end": (3, 3)}),
+        "_slice_assign_scalar": ([_sym(4, 5)],
+                                 {"begin": (0, 1), "end": (2, 4),
+                                  "scalar": 0.25}),
+        "_crop_assign": ([_sym(4, 5), _sym(2, 3)],
+                         {"begin": (1, 1), "end": (3, 4)}),
+        "_crop_assign_scalar": ([_sym(4, 5)],
+                                {"begin": (1, 0), "end": (3, 2),
+                                 "scalar": -0.5}),
+        "_grad_add": ([_sym(3, 4), _sym(3, 4)], {}),
+        "_identity_with_attr_like_rhs": ([_sym(3, 4), _sym(3, 4)], {}),
+        "_scatter_plus_scalar": ([_sym(3, 4)], {"scalar": 1.3}),
+        "_scatter_minus_scalar": ([_sym(3, 4)], {"scalar": 0.6}),
+        "_scatter_elemwise_div": ([_sym(3, 4), _pos(3, 4)], {}),
+        "Crop": ([_sym(2, 3, 6, 7)],
+                 {"num_args": 1, "h_w": (4, 5), "offset": (1, 2)}),
+        "_CrossDeviceCopy": ([_sym(3, 4)], {}),
+        "cast_storage": ([_sym(3, 4)], {"stype": "row_sparse"}),
+        "_sparse_retain": ([_sym(5, 3), np.array([0.0, 2, 4])], {}),
+        "_square_sum": ([_sym(3, 4)], {"axis": (1,)}),
     }
     no_grad = {"one_hot", "ones_like", "zeros_like", "argsort", "Cast",
                "cast", "stop_gradient", "BlockGrad", "gather_nd",
                "scatter_nd", "sort", "topk", "where",
                "choose_element_0index", "pick", "take",
+               # integer index inputs: finite differences over the index
+               # array are meaningless
+               "batch_take", "_scatter_set_nd", "_sparse_retain",
                # multi-output symbols: forward-only here (gradient flow
                # through Concat covers the split/concat adjoint pair)
                "SliceChannel", "split"}

@@ -413,3 +413,38 @@ def test_contrib_fft_quantize_count_sketch():
     from mxnet_tpu.ops.registry import OP_REGISTRY
     assert OP_REGISTRY["_contrib_MultiProposal"] is \
         OP_REGISTRY["_contrib_Proposal"]
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Forward is identity; backward adds the KL sparseness penalty using
+    the updated moving average (reference:
+    src/operator/identity_attach_KL_sparse_reg-inl.h Backward)."""
+    np.random.seed(4)
+    x = np.random.rand(4, 3).astype(np.float32) * 0.6 + 0.2  # sigmoid-like
+    rho, penalty, mom = 0.2, 0.01, 0.9
+    data = mx.sym.Variable("data")
+    s = mx.sym.IdentityAttachKLSparseReg(data, sparseness_target=rho,
+                                         penalty=penalty, momentum=mom,
+                                         name="klreg")
+    init_avg = np.full((3,), 0.5, np.float32)
+    exe = s.bind(mx.cpu(), args={"data": mx.nd.array(x)},
+                 args_grad={"data": mx.nd.zeros(x.shape)},
+                 aux_states={"klreg_moving_avg": mx.nd.array(init_avg)},
+                 grad_req="write")
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, x, rtol=1e-6, atol=1e-7)
+    new_avg = mom * init_avg + (1 - mom) * x.mean(axis=0)
+    assert_almost_equal(exe.aux_dict["klreg_moving_avg"], new_avg,
+                        rtol=1e-5, atol=1e-6)
+    # no-arg backward consumes the gradients stashed by the train-mode
+    # forward (computed with the same pre-update moving average); the
+    # explicit out_grads path would re-run with the updated aux
+    exe.backward()
+    pen = penalty * (-rho / new_avg + (1 - rho) / (1 - new_avg))
+    expected = np.ones_like(x) + pen[None, :]
+    assert_almost_equal(exe.grad_dict["data"], expected,
+                        rtol=1e-5, atol=1e-6)
+    # eval mode must not move the average
+    exe.forward(is_train=False)
+    assert_almost_equal(exe.aux_dict["klreg_moving_avg"], new_avg,
+                        rtol=1e-6, atol=1e-7)
