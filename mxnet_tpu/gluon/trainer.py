@@ -137,7 +137,10 @@ class Trainer:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
-            self._optimizer = self._kvstore._updater.optimizer
+            if self._kvstore._updater is not None:
+                self._optimizer = self._kvstore._updater.optimizer
+            # else (dist_async): the optimizer lives on the servers; the
+            # local handle in self._optimizer is already the one shipped
         else:
             with open(fname, "rb") as f:
                 states = f.read()

@@ -83,12 +83,6 @@ class KVStore:
         self._barrier_count = 0
         self._dist = kv_type.startswith("dist")
         if self._dist:
-            if "async" in kv_type:
-                raise MXNetError(
-                    "dist_async is not supported: the TPU build is "
-                    "allreduce-based (synchronous); the reference's "
-                    "per-push server updates (kvstore_dist_server.h:422) "
-                    "have no straggler-tolerant analog here")
             _ensure_distributed()
 
     # --- basic ops (reference: kvstore.py init/push/pull) -----------------
@@ -419,11 +413,202 @@ class KVStore:
     def _send_command_to_servers(self, head, body):
         # the reference ships pickled optimizer commands to PS servers
         # (python/mxnet/kvstore.py:419-460); this build runs server logic
-        # in-process, so a silent no-op would hide real misuse
+        # in-process, so a silent no-op would hide real misuse.
+        # KVStoreDistAsync overrides this with the real server RPC.
         raise MXNetError(
             "_send_command_to_servers is a parameter-server RPC; this "
             "kvstore type (%r) runs updates in-process — use "
             "set_optimizer() instead" % (self.type,))
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Liveness query (reference: include/mxnet/kvstore.h:338
+        get_num_dead_node over ps-lite heartbeats). Non-PS stores run
+        every role in this process, so nothing can be dead."""
+        return 0
+
+
+class KVStoreDistAsync(KVStore):
+    """``dist_async`` — the reference's asynchronous parameter server
+    (src/kvstore/kvstore_dist_server.h:422-435: each worker's push updates
+    server weights immediately; no cross-worker synchronization, straggler
+    tolerant by design).
+
+    There is no XLA-collective analog of asynchrony — a compiled psum IS a
+    synchronization point — so this runs the reference's actual host-side
+    architecture: TCP parameter servers (mxnet_tpu/kvstore_server.py)
+    holding the weights, with the optimizer shipped from rank 0 as a
+    pickle (_send_command_to_servers head 0). Device compute (forward/
+    backward) stays on-chip; push/pull move gradients/weights host-side
+    per key, exactly the reference's wire pattern.
+    """
+
+    def __init__(self):
+        # intentionally NOT calling super().__init__ with dist machinery:
+        # the PS path needs no jax.distributed (workers only talk to
+        # servers; no worker-to-worker collectives)
+        self.type = "dist_async"
+        self._data = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._barrier_count = 0
+        self._dist = True
+        addrs = os.environ.get("MXTPU_PS_ADDR")
+        self._rank = int(os.environ.get("MXTPU_WORKER_ID", "0"))
+        self._num_workers = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+        self._own_server = None
+        if not addrs:
+            # single-process convenience: spin up an in-process server so
+            # dist_async works without a launcher (and its update/pull
+            # semantics can be unit-tested)
+            from .kvstore_server import start_server_thread
+
+            self._own_server = start_server_thread()
+            addrs = self._own_server.address
+        from .kvstore_server import PSClient
+
+        self._client = PSClient(addrs.split(","), self._rank)
+        self._key_shapes = {}
+
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            v = vlist[0]
+            from .ndarray.sparse import BaseSparseNDArray
+
+            if isinstance(v, BaseSparseNDArray):
+                v = v._dense_nd()
+            self._client.key_call(k, ("init", k, v.asnumpy()))
+            self._key_shapes[k] = v.shape
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            merged = self._reduce(vlist)   # local multi-device reduce
+            from .ndarray.sparse import BaseSparseNDArray
+
+            if isinstance(merged, BaseSparseNDArray):
+                merged = merged._dense_nd()
+            if self._gc_active():
+                # quantize with error feedback and send PACKED 2-bit codes
+                # (4/byte — the 16x wire saving is the feature's point,
+                # kvstore_dist.h:346); the server dequantizes and applies
+                # the {0, ±threshold} gradient
+                codes = self._quantize_2bit(k, merged)
+                packed = self._pack_2bit(codes)
+                self._client.key_call(
+                    k, ("push_2bit", k, packed.tobytes(), codes.size,
+                        codes.shape, self._gc_threshold))
+            else:
+                self._client.key_call(k, ("push", k, merged.asnumpy()))
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            arr = self._client.key_call(k, ("pull", k))
+            src = nd.array(arr)
+            for o in olist:
+                src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        from .ndarray.sparse import (BaseSparseNDArray, RowSparseNDArray,
+                                     row_sparse_array)
+
+        assert out is not None
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        keys, outs = _ctype_key_value(key, out)
+        if not isinstance(row_ids, (tuple, list)):
+            row_ids = [row_ids] * len(keys)
+        import numpy as _np
+
+        for k, olist, rids in zip(keys, outs, row_ids):
+            rid_list = rids if isinstance(rids, (tuple, list)) else [rids]
+            if len(rid_list) == 1 and len(olist) > 1:
+                rid_list = rid_list * len(olist)
+            for o, rid in zip(olist, rid_list):
+                want = _np.unique(_np.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid,
+                    dtype=_np.int64).reshape(-1))
+                shape = self._key_shapes.get(k)
+                if shape and len(want) and (want[0] < 0
+                                            or want[-1] >= shape[0]):
+                    raise MXNetError("row_ids out of range for key %r"
+                                     % (k,))
+                rows, got = self._client.key_call(
+                    k, ("row_sparse_pull", k, want)), want
+                res = row_sparse_array((rows, got),
+                                       shape=shape or o.shape,
+                                       ctx=o.context)
+                if isinstance(o, BaseSparseNDArray):
+                    res.copyto(o)
+                else:
+                    o._set_data(
+                        res._dense_nd()._data.astype(o._data.dtype))
+
+    # --- server-side optimizer (the PS contract) -------------------------
+    def set_optimizer(self, optimizer):
+        """Rank 0 ships the pickled optimizer to every server; other
+        ranks just barrier alongside (reference: kvstore.py:419-460)."""
+        self._optimizer = optimizer
+        if self.rank == 0:
+            self._send_command_to_servers(0, pickle.dumps(optimizer))
+        self._barrier()
+
+    def _send_command_to_servers(self, head, body):
+        self._client.all_call(("command", head, body))
+
+    def set_updater(self, updater):
+        raise MXNetError("dist_async runs the optimizer on the servers; "
+                         "use set_optimizer (reference: update_on_kvstore "
+                         "is mandatory for dist_async, "
+                         "python/mxnet/model.py _create_kvstore)")
+
+    _set_updater = set_updater
+
+    # --- distributed attributes ------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def _barrier(self):
+        self._barrier_count += 1
+        if self._num_workers > 1 or self._own_server is None:
+            self._client.call0(("barrier", self._num_workers))
+
+    barrier = _barrier
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        return int(self._client.call0(("num_dead", timeout)))
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        # each server shard holds state only for its own keys — gather
+        # every shard's blob (a single-shard save would silently lose
+        # momentum for keys hashed to the other shards)
+        blobs = self._client.gather_call(("save_states",))
+        with open(fname, "wb") as fout:
+            pickle.dump({"num_shards": len(blobs), "blobs": blobs}, fout)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as fin:
+            data = pickle.load(fin)
+        if data["num_shards"] != self._client.num_shards:
+            raise MXNetError(
+                "optimizer states were saved with %d PS shards; this job "
+                "has %d (key->shard placement would not line up)"
+                % (data["num_shards"], self._client.num_shards))
+        for i, blob in enumerate(data["blobs"]):
+            self._client.shard_call(i, ("load_states", blob))
+
+    def close(self):
+        if self._own_server is not None:
+            self._own_server.stop()
+        self._client.close()
 
 
 def _updater_key(key):
@@ -438,9 +623,11 @@ def create(name="local"):
     python/mxnet/kvstore.py:create).
 
     local / local_allreduce_cpu / local_allreduce_device / device / nccl all
-    map to the in-process XLA reduce; dist_sync / dist_device_sync /
-    dist_async additionally require jax.distributed to be initialized (the
-    multi-host analog of the ps-lite role system)."""
+    map to the in-process XLA reduce; dist_sync / dist_device_sync require
+    jax.distributed (allreduce across worker processes); dist_async talks
+    to host-side parameter servers (mxnet_tpu/kvstore_server.py) with the
+    optimizer running server-side per push — the reference's asynchronous
+    PS architecture."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     known = ("local", "local_allreduce_cpu", "local_allreduce_device",
@@ -448,4 +635,6 @@ def create(name="local"):
              "dist")
     if name not in known:
         raise MXNetError("unknown KVStore type %r" % name)
+    if name == "dist_async":
+        return KVStoreDistAsync()
     return KVStore(name)

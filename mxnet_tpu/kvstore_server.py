@@ -1,0 +1,416 @@
+"""Parameter-server backend for ``dist_async`` (and the server half of the
+reference's PS role system).
+
+Reference: src/kvstore/kvstore_dist_server.h (KVStoreDistServer — per-push
+async updates, pickled-optimizer command), python/mxnet/kvstore_server.py
+(the server-role main loop), ps-lite's ZMQ Van (scheduler/server/worker
+roles).
+
+TPU-native stance (SURVEY.md §5.8): the *sync* path is an in-program XLA
+collective and never touches this file. ``dist_async`` is inherently a
+host-side protocol — servers apply updates the moment each worker's push
+arrives, tolerating stragglers — so it is implemented as a host service:
+a threaded TCP server speaking length-prefixed pickles (the ZMQ KV RPC
+analog), holding numpy weights and running the worker-pickled optimizer
+per push (kvstore_dist_server.h:422-435 DataHandleDefault async branch).
+Device compute stays on the worker side; the server is pure control/state.
+
+Multiple servers shard keys by stable hash (the EncodeDefaultKey
+small-array path, src/kvstore/kvstore_dist.h:229; big-array slicing across
+servers is not implemented). Worker liveness rides on per-connection
+heartbeats: ``get_num_dead_node`` reports workers whose last contact is
+older than the timeout (ps-lite heartbeat analog,
+include/mxnet/kvstore.h:338).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer", "PSClient", "run_server", "start_server_thread"]
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class KVStoreServer:
+    """One PS shard: stores weights, applies async updates per push.
+
+    The update path mirrors KVStoreDistServer::DataHandleDefault in async
+    mode (kvstore_dist_server.h:422-435): no cross-worker accumulation —
+    each arriving gradient updates the stored weight immediately via the
+    optimizer the rank-0 worker shipped (command head 0,
+    python/mxnet/kvstore.py:419-460 → kvstore_server.py:28-55).
+    """
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = "%s:%d" % self._sock.getsockname()
+        self._store = {}          # key -> np.ndarray
+        self._updater = None
+        self._lock = threading.Lock()
+        self._last_seen = {}      # worker rank -> timestamp
+        self._barrier_waiters = []
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+
+    # --- command handlers -------------------------------------------------
+    def _handle(self, msg, conn_state):
+        op = msg[0]
+        now = time.time()
+        if op == "hello":
+            rank = int(msg[1])
+            conn_state["rank"] = rank
+            with self._lock:
+                self._last_seen[rank] = now
+            return ("ok",)
+        if "rank" in conn_state:
+            with self._lock:
+                self._last_seen[conn_state["rank"]] = now
+        if op == "heartbeat":
+            return ("ok",)
+        if op == "bye":
+            # explicit deregistration on clean shutdown; a crashed worker
+            # never sends this, so its stale _last_seen entry ages past
+            # the timeout and get_num_dead_node reports it
+            with self._lock:
+                self._last_seen.pop(conn_state.get("rank"), None)
+            conn_state.pop("rank", None)
+            return ("ok",)
+        if op == "init":
+            _, key, arr = msg
+            with self._lock:
+                # reference servers take the first init and ignore repeats
+                # (workers race to init the same key)
+                self._store.setdefault(key, np.array(arr))
+            return ("ok",)
+        if op == "push":
+            _, key, grad = msg
+            return self._apply_push(key, grad)
+        if op == "push_2bit":
+            # packed 2-bit codes on the wire (4 codes/byte, the reference
+            # gradient-compression wire layout); dequantize server-side
+            _, key, packed, n, shape, threshold = msg
+            from .kvstore import KVStore
+
+            codes = KVStore._unpack_2bit(
+                np.frombuffer(packed, np.uint8), n)
+            grad = (codes.astype(np.float32) * threshold).reshape(shape)
+            return self._apply_push(key, grad)
+        if op == "pull":
+            _, key = msg
+            with self._lock:
+                if key not in self._store:
+                    return ("err", "key %r not initialized" % (key,))
+                return ("ok", np.array(self._store[key]))
+        if op == "row_sparse_pull":
+            _, key, row_ids = msg
+            with self._lock:
+                if key not in self._store:
+                    return ("err", "key %r not initialized" % (key,))
+                rows = np.asarray(row_ids, dtype=np.int64)
+                return ("ok", np.array(self._store[key][rows]), rows)
+        if op == "command":
+            # head 0 == kSetOptimizer (kvstore_dist_server.h:43 CommandType)
+            _, head, body = msg
+            if head == 0:
+                from . import optimizer as opt
+
+                optimizer = pickle.loads(body)
+                with self._lock:
+                    self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+                return ("ok",)
+            return ("err", "unknown command head %r" % (head,))
+        if op == "barrier":
+            return self._barrier(msg[1])
+        if op == "num_dead":
+            _, timeout = msg
+            with self._lock:
+                dead = sum(1 for t in self._last_seen.values()
+                           if now - t > timeout)
+            return ("ok", dead)
+        if op == "save_states":
+            with self._lock:
+                if self._updater is None:
+                    return ("err", "no optimizer set on server")
+                return ("ok", self._updater.get_states())
+        if op == "load_states":
+            with self._lock:
+                if self._updater is None:
+                    return ("err", "no optimizer set on server")
+                self._updater.set_states(msg[1])
+            return ("ok",)
+        if op == "stop":
+            self._stop.set()
+            # wake the accept loop
+            try:
+                socket.create_connection(
+                    self._sock.getsockname(), timeout=1).close()
+            except OSError:
+                pass
+            return ("ok",)
+        return ("err", "unknown op %r" % (op,))
+
+    def _apply_push(self, key, grad):
+        with self._lock:
+            if key not in self._store:
+                return ("err", "key %r not initialized" % (key,))
+            if self._updater is not None:
+                self._updater(key, grad, self._store[key])
+            else:
+                self._store[key] = np.array(grad)
+        return ("ok",)
+
+    def _barrier(self, num_workers):
+        """Block until num_workers workers reach the barrier (ps-lite
+        Barrier analog). Returns once released. The wait bound exists
+        only to fail jobs whose peers died — tune MXTPU_PS_BARRIER_TIMEOUT
+        for workloads with long gaps between sync points (slow workers
+        are the norm for dist_async, not an error)."""
+        timeout = float(os.environ.get("MXTPU_PS_BARRIER_TIMEOUT", "1800"))
+        with self._lock:
+            gen = self._barrier_gen
+            self._barrier_waiters.append(threading.Event())
+            ev = self._barrier_waiters[-1]
+            if len(self._barrier_waiters) >= int(num_workers):
+                self._barrier_gen += 1
+                waiters, self._barrier_waiters = self._barrier_waiters, []
+                for w in waiters:
+                    w.set()
+        ev.wait(timeout=timeout)
+        if not ev.is_set():
+            # withdraw so this stale event cannot count toward (and
+            # prematurely release) a later barrier round; re-check under
+            # the lock — the release may have raced our timeout
+            with self._lock:
+                if ev.is_set():
+                    return ("ok",)
+                if ev in self._barrier_waiters:
+                    self._barrier_waiters.remove(ev)
+            return ("err", "barrier timeout (gen %d)" % gen)
+        return ("ok",)
+
+    # --- server loop ------------------------------------------------------
+    def _serve_conn(self, conn):
+        # NOTE: a dropped connection does NOT deregister the worker —
+        # a SIGKILLed process closes its sockets exactly like a clean
+        # exit, so deregistration is only via the explicit "bye" message
+        # (PSClient.close); crashed workers age out and count as dead
+        conn_state = {}
+        try:
+            self._serve_conn_loop(conn, conn_state)
+        finally:
+            conn.close()
+
+    def _serve_conn_loop(self, conn, conn_state):
+        while not self._stop.is_set():
+            try:
+                msg = _recv_msg(conn)
+            except (ConnectionError, OSError):
+                break
+            try:
+                resp = self._handle(msg, conn_state)
+            except Exception as e:  # surface handler errors to caller
+                resp = ("err", "%s: %s" % (type(e).__name__, e))
+            try:
+                _send_msg(conn, resp)
+            except (ConnectionError, OSError):
+                break
+
+    def serve_forever(self):
+        """Accept loop; one thread per worker connection (the reference's
+        server customer threads)."""
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+        self._sock.close()
+
+    def stop(self):
+        self._handle(("stop",), {})
+
+
+class _NumpyUpdater:
+    """Adapt the NDArray-based Updater to the server's numpy store."""
+
+    def __init__(self, updater):
+        self._updater = updater
+
+    def __call__(self, key, grad, weight):
+        from . import ndarray as nd
+
+        w = nd.array(weight)
+        self._updater(_int_key(key), nd.array(np.asarray(grad)), w)
+        weight[...] = w.asnumpy()
+
+    def get_states(self):
+        return self._updater.get_states()
+
+    def set_states(self, states):
+        self._updater.set_states(states)
+
+
+def _int_key(key):
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key
+
+
+class PSClient:
+    """Worker-side connection pool over the server shards.
+
+    Key→server placement is a stable hash (EncodeDefaultKey's
+    hash-to-one-server path for small arrays, kvstore_dist.h:229);
+    barrier/liveness queries go to shard 0.
+    """
+
+    def __init__(self, addresses, rank):
+        self.rank = rank
+        self._socks = []
+        self._locks = []
+        deadline = time.time() + 30
+        for addr in addresses:
+            host, port = addr.rsplit(":", 1)
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=30)
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise MXNetError(
+                            "cannot reach PS server at %s" % addr)
+                    time.sleep(0.05)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+        for i in range(len(self._socks)):
+            self._call(i, ("hello", rank))
+        # background heartbeat so liveness does not depend on push cadence
+        # (ps-lite's Van heartbeats; get_num_dead_node contract)
+        self._closed = threading.Event()
+        interval = float(os.environ.get("MXTPU_PS_HEARTBEAT", "5"))
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(interval,), daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval):
+        while not self._closed.wait(interval):
+            for i in range(len(self._socks)):
+                try:
+                    self._call(i, ("heartbeat",))
+                except (MXNetError, OSError):
+                    return
+
+    def _shard(self, key):
+        # stable across processes (python str hash is per-process salted)
+        import zlib
+
+        return zlib.crc32(str(key).encode()) % len(self._socks)
+
+    def _call(self, shard, msg):
+        with self._locks[shard]:
+            _send_msg(self._socks[shard], msg)
+            resp = _recv_msg(self._socks[shard])
+        if resp[0] == "err":
+            raise MXNetError("PS server: %s" % resp[1])
+        return resp[1] if len(resp) > 1 else None
+
+    def key_call(self, key, msg):
+        return self._call(self._shard(key), msg)
+
+    def all_call(self, msg):
+        out = None
+        for i in range(len(self._socks)):
+            out = self._call(i, msg)
+        return out
+
+    def gather_call(self, msg):
+        """Run msg on every shard, returning the per-shard results."""
+        return [self._call(i, msg) for i in range(len(self._socks))]
+
+    def shard_call(self, shard, msg):
+        return self._call(shard, msg)
+
+    @property
+    def num_shards(self):
+        return len(self._socks)
+
+    def call0(self, msg):
+        return self._call(0, msg)
+
+    def close(self):
+        if hasattr(self, "_closed"):
+            self._closed.set()
+        for i, s in enumerate(self._socks):
+            try:
+                # clean shutdown deregisters from liveness tracking; a
+                # crash skips this and ages into get_num_dead_node
+                self._call(i, ("bye",))
+            except (MXNetError, OSError):
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def start_server_thread(host="127.0.0.1", port=0):
+    """In-process server (single-process tests / single-worker async)."""
+    server = KVStoreServer(host, port)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server
+
+
+def run_server():
+    """Server-role main: blocks serving until a worker sends 'stop'
+    (reference: python/mxnet/kvstore_server.py:41 _controller loop +
+    run_server; role selected by DMLC_ROLE there, MXTPU_ROLE here via
+    tools/launch.py)."""
+    host, _, port = os.environ.get("MXTPU_PS_BIND",
+                                   "127.0.0.1:0").partition(":")
+    server = KVStoreServer(host, int(port or 0))
+    # hand the bound address to the launcher via stdout (it forwards it to
+    # workers as MXTPU_PS_ADDR)
+    print("MXTPU_PS_ADDR=%s" % server.address, flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    run_server()

@@ -851,11 +851,20 @@ def _register_deformable():
         scale = attrs.spatial_scale
         no_trans = attrs.no_trans or not rest
         n, C, H, W = data.shape
+        if C != od * group * group:
+            raise MXNetError(
+                "DeformablePSROIPooling: data has %d channels, needs "
+                "output_dim*group_size^2 = %d" % (C, od * group * group))
         x = data.astype(jnp.float32)
         if no_trans:
             ncls = 1
         else:
             ncls = rest[0].shape[1] // 2
+            if ncls == 0 or od % ncls != 0:
+                raise MXNetError(
+                    "DeformablePSROIPooling: output_dim (%d) must divide "
+                    "evenly into trans's %d offset classes"
+                    % (od, ncls))
         ch_each = od if no_trans else od // ncls
         # static per-output-position maps (the kernel's integer math)
         ph_i = np.arange(p)

@@ -81,6 +81,16 @@ class Symbol:
         # list of (node, out_index)
         self._outputs = list(outputs)
 
+    # pickle via the nnvm-JSON round-trip: node DAGs recurse past the
+    # interpreter limit under pickle's default traversal, and JSON is the
+    # reference's own wire format for symbols (kvstore ships optimizers
+    # holding `sym` to PS servers, python/mxnet/kvstore.py:419-460)
+    def __getstate__(self):
+        return {"__json__": self.tojson()}
+
+    def __setstate__(self, state):
+        self._outputs = load_json(state["__json__"])._outputs
+
     # --- basic introspection ---------------------------------------------
     @property
     def name(self):

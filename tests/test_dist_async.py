@@ -1,0 +1,315 @@
+"""dist_async parameter-server tests (reference pattern:
+tests/nightly/dist_sync_kvstore.py's async sibling + the server-side
+optimizer contract of python/mxnet/kvstore_server.py)."""
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from launch import launch_local  # noqa: E402
+
+
+def _fresh_async_kv():
+    # each test gets its own in-process server
+    os.environ.pop("MXTPU_PS_ADDR", None)
+    return mx.kv.create("dist_async")
+
+
+def test_async_push_pull_no_optimizer():
+    kv = _fresh_async_kv()
+    try:
+        kv.init("a", mx.nd.ones((2, 3)))
+        out = mx.nd.zeros((2, 3))
+        kv.pull("a", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        # no optimizer: push replaces (kvstore_local PushImpl semantics)
+        kv.push("a", mx.nd.full((2, 3), 7.0))
+        kv.pull("a", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 7.0)
+    finally:
+        kv.close()
+
+
+def test_async_server_side_optimizer():
+    kv = _fresh_async_kv()
+    try:
+        kv.init(3, mx.nd.ones((4,)))
+        opt = mx.optimizer.create("sgd", learning_rate=0.1,
+                                  rescale_grad=1.0)
+        kv.set_optimizer(opt)
+        # each push applies sgd immediately on the server: w -= lr * g
+        kv.push(3, mx.nd.ones((4,)))
+        kv.push(3, mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull(3, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * 2,
+                                   atol=1e-6)
+        # updater is server-side only
+        with pytest.raises(mx.MXNetError):
+            kv.set_updater(lambda k, g, w: None)
+    finally:
+        kv.close()
+
+
+def test_async_optimizer_state_roundtrip(tmp_path):
+    """Server-side momentum state survives a save/load round-trip: after
+    restoring the state AND the weight, replaying the same push must give
+    bit-identical weights (the reference's save_optimizer_states contract,
+    module.py:758 with update_on_kvstore=True)."""
+    def run(restore_from=None, save_to=None):
+        kv = _fresh_async_kv()
+        try:
+            kv.init("w", mx.nd.ones((3,)))
+            kv.set_optimizer(mx.optimizer.create(
+                "sgd", learning_rate=0.1, momentum=0.9, rescale_grad=1.0))
+            kv.push("w", mx.nd.ones((3,)))      # builds momentum
+            if save_to:
+                kv.save_optimizer_states(save_to)
+            if restore_from:
+                kv.load_optimizer_states(restore_from)
+            kv.push("w", mx.nd.ones((3,)))      # uses momentum state
+            out = mx.nd.zeros((3,))
+            kv.pull("w", out=out)
+            assert kv.get_num_dead_node(timeout=60) == 0
+            return out.asnumpy()
+        finally:
+            kv.close()
+
+    fname = str(tmp_path / "states")
+    w_a = run(save_to=fname)
+    # fresh server, but momentum restored from the first run's step-1
+    # state: step 2 must match exactly
+    w_b = run(restore_from=fname)
+    np.testing.assert_array_equal(w_a, w_b)
+
+
+def test_async_row_sparse_pull():
+    kv = _fresh_async_kv()
+    try:
+        w = np.arange(12, dtype=np.float32).reshape(6, 2)
+        kv.init("emb", mx.nd.array(w))
+        from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+        out = row_sparse_array(np.zeros((6, 2), np.float32))
+        kv.row_sparse_pull("emb", out=out,
+                           row_ids=mx.nd.array([1.0, 4.0]))
+        got = out.asnumpy()
+        assert np.allclose(got[1], w[1]) and np.allclose(got[4], w[4])
+        assert np.allclose(got[0], 0) and np.allclose(got[3], 0)
+    finally:
+        kv.close()
+
+
+_ASYNC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, %(repo)r)
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == %(n)d, (rank, nw)
+
+    # every worker inits (first wins), rank 0 ships the optimizer
+    kv.init("w", mx.nd.ones((3, 2)))
+    kv.init("v", mx.nd.zeros((4,)))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, rescale_grad=1.0)
+    kv.set_optimizer(opt)          # rank0 sends; everyone barriers inside
+
+    # constant gradients: async sgd updates commute, so after the barrier
+    # the weight is exactly w0 - lr * g * (steps * nw) on every worker
+    steps = 5
+    for _ in range(steps):
+        kv.push("w", mx.nd.ones((3, 2)))
+    kv.barrier()
+    out = mx.nd.zeros((3, 2))
+    kv.pull("w", out=out)
+    expect = 1.0 - 0.1 * steps * nw
+    assert np.allclose(out.asnumpy(), expect, atol=1e-5), (
+        rank, out.asnumpy(), expect)
+
+    # async training on a shared quadratic: loss must decrease even with
+    # interleaved stale pushes (the straggler-tolerance property)
+    target = np.array([0.5, -1.0, 2.0, 0.0], np.float32)
+    buf = mx.nd.zeros((4,))
+    first = last = None
+    for i in range(40):
+        kv.pull("v", out=buf)
+        v = buf.asnumpy()
+        loss = float(((v - target) ** 2).sum())
+        if first is None: first = loss
+        last = loss
+        kv.push("v", mx.nd.array(2.0 * (v - target)))
+    kv.barrier()
+    kv.pull("v", out=buf)
+    final = float(((buf.asnumpy() - target) ** 2).sum())
+    assert final < first * 0.01, (rank, first, final)
+
+    assert kv.get_num_dead_node(timeout=120) == 0
+    kv.barrier()
+    print("ASYNC_WORKER_OK", rank)
+""")
+
+
+@pytest.mark.parametrize("n,num_servers", [(2, 1), (3, 2)])
+def test_dist_async_fake_cluster(n, num_servers):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    script = _ASYNC_WORKER % {"repo": repo, "n": n}
+    procs = launch_local(n, [sys.executable, "-c", script],
+                         num_servers=num_servers)
+    try:
+        outputs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outputs.append(out.decode())
+        for i, (p, out) in enumerate(zip(procs, outputs)):
+            assert p.returncode == 0, "worker %d failed:\n%s" % (i, out)
+            assert "ASYNC_WORKER_OK" in out
+    finally:
+        for p in procs.ps_procs:
+            p.kill()
+
+
+def test_async_gradient_compression_2bit():
+    """dist_async quantizes on the wire like the dist push path: the
+    server sees {0, ±threshold} with error feedback on the worker."""
+    kv = _fresh_async_kv()
+    try:
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("w", mx.nd.zeros((2, 2)))
+        g = np.array([[0.3, 0.6], [-0.7, 0.1]], np.float32)
+        kv.push("w", mx.nd.array(g))
+        out = mx.nd.zeros((2, 2))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   [[0.0, 0.5], [-0.5, 0.0]], atol=1e-6)
+        kv.push("w", mx.nd.array(g))   # residual feedback kicks in
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   [[0.5, 0.5], [-0.5, 0.0]], atol=1e-6)
+    finally:
+        kv.close()
+
+
+def test_async_state_roundtrip_multi_shard(tmp_path):
+    """Every shard's optimizer state is saved and restored (a shard-0-only
+    save would silently reset momentum for half the keys)."""
+    from mxnet_tpu.kvstore_server import start_server_thread
+
+    def run(restore_from=None, save_to=None):
+        servers = [start_server_thread(), start_server_thread()]
+        os.environ["MXTPU_PS_ADDR"] = ",".join(s.address for s in servers)
+        try:
+            kv = mx.kv.create("dist_async")
+            # two keys guaranteed to land on different shards
+            import zlib
+            keys = ["k0"]
+            shard0 = zlib.crc32(b"k0") % 2
+            i = 1
+            while True:
+                k = "k%d" % i
+                if zlib.crc32(k.encode()) % 2 != shard0:
+                    keys.append(k)
+                    break
+                i += 1
+            for k in keys:
+                kv.init(k, mx.nd.ones((3,)))
+            kv.set_optimizer(mx.optimizer.create(
+                "sgd", learning_rate=0.1, momentum=0.9, rescale_grad=1.0))
+            for k in keys:
+                kv.push(k, mx.nd.ones((3,)))
+            if save_to:
+                kv.save_optimizer_states(save_to)
+            if restore_from:
+                kv.load_optimizer_states(restore_from)
+            for k in keys:
+                kv.push(k, mx.nd.ones((3,)))
+            outs = {}
+            for k in keys:
+                o = mx.nd.zeros((3,))
+                kv.pull(k, out=o)
+                outs[k] = o.asnumpy()
+            kv.close()
+            return keys, outs
+        finally:
+            os.environ.pop("MXTPU_PS_ADDR", None)
+            for s in servers:
+                s.stop()
+
+    fname = str(tmp_path / "states")
+    keys_a, a = run(save_to=fname)
+    keys_b, b = run(restore_from=fname)
+    assert keys_a == keys_b
+    for k in keys_a:
+        np.testing.assert_array_equal(a[k], b[k])
+    # shard-count mismatch must be detected, not silently misplaced
+    servers = [start_server_thread()]
+    os.environ["MXTPU_PS_ADDR"] = servers[0].address
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.ones((2,)))
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+        with pytest.raises(mx.MXNetError):
+            kv.load_optimizer_states(fname)
+        kv.close()
+    finally:
+        os.environ.pop("MXTPU_PS_ADDR", None)
+        servers[0].stop()
+
+
+def test_async_crashed_worker_counts_dead():
+    """A SIGKILLed worker never sends 'bye', so its last_seen entry ages
+    out and get_num_dead_node reports it; a clean close() deregisters."""
+    from mxnet_tpu.kvstore_server import PSClient, start_server_thread
+
+    server = start_server_thread()
+    try:
+        a = PSClient([server.address], rank=0)
+        b = PSClient([server.address], rank=1)
+        assert int(a.call0(("num_dead", 10))) == 0
+        # simulate a crash: close b's sockets without the bye handshake
+        b._closed.set()
+        for s in b._socks:
+            s.close()
+        import time
+        time.sleep(1.2)
+        assert int(a.call0(("num_dead", 1))) == 1    # rank 1 aged out
+        a.close()                                     # clean: deregisters
+        c = PSClient([server.address], rank=2)
+        time.sleep(0.1)
+        # rank 0 said bye -> gone; rank 1 still dead; rank 2 alive
+        assert int(c.call0(("num_dead", 1))) == 1
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_async_gluon_trainer_states(tmp_path):
+    """gluon.Trainer over dist_async: step + save/load states exercise the
+    server-side-optimizer path (trainer.py:load_states previously assumed
+    a local updater)."""
+    os.environ.pop("MXTPU_PS_ADDR", None)
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="dist_async")
+    x = mx.nd.array(np.ones((4, 3), np.float32))
+    from mxnet_tpu import autograd
+
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    trainer.step(4)
+    f = str(tmp_path / "trainer_states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+    trainer.step(4)  # still works after the round-trip

@@ -132,9 +132,13 @@ def test_dist_sync_fake_cluster(n):
         assert "WORKER_OK" in out
 
 
-def test_dist_async_raises():
-    with pytest.raises(mx.MXNetError):
-        mx.kv.create("dist_async")
+def test_dist_async_exists():
+    # dist_async is the PS path now — covered in tests/test_dist_async.py
+    kv = mx.kv.create("dist_async")
+    try:
+        assert kv.type == "dist_async"
+    finally:
+        kv.close()
 
 
 def test_gradient_compression_2bit_local():

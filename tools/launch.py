@@ -38,26 +38,77 @@ def clean_env(base=None):
     return env
 
 
-def launch_local(n, command, env_extra=None, platform="cpu"):
-    """Spawn n local worker processes; returns the Popen list."""
+def launch_servers(num_servers, platform="cpu"):
+    """Spawn parameter-server processes for dist_async (reference: the
+    tracker's server role, DMLC_ROLE=server). Returns (procs, addr_csv) —
+    pass the address string to workers as MXTPU_PS_ADDR."""
+    procs, addrs = [], []
+    try:
+        for _ in range(num_servers):
+            env = clean_env()
+            env["JAX_PLATFORMS"] = platform
+            env["MXTPU_PS_BIND"] = "127.0.0.1:0"
+            p = subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            procs.append(p)
+            # the server prints its bound address first (port 0 = ephemeral)
+            line = p.stdout.readline().decode().strip()
+            if not line.startswith("MXTPU_PS_ADDR="):
+                raise RuntimeError("server failed to start: %r" % line)
+            addrs.append(line.split("=", 1)[1])
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+    return procs, ",".join(addrs)
+
+
+class WorkerProcs(list):
+    """Worker Popen list; ``.ps_procs`` holds any parameter-server
+    processes launched alongside (empty for allreduce jobs)."""
+
+    def __init__(self, procs, ps_procs=()):
+        super().__init__(procs)
+        self.ps_procs = list(ps_procs)
+
+
+def launch_local(n, command, env_extra=None, platform="cpu",
+                 num_servers=0):
+    """Spawn n local worker processes (plus optional PS servers for
+    dist_async); returns a WorkerProcs list."""
     port = _free_port()
+    extra = dict(env_extra or {})
+    ps_procs = []
+    if num_servers:
+        ps_procs, addr_csv = launch_servers(num_servers, platform)
+        extra["MXTPU_PS_ADDR"] = addr_csv
     procs = []
-    for i in range(n):
-        env = clean_env()
-        env.update(env_extra or {})
-        env["JAX_PLATFORMS"] = platform
-        env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % port
-        env["MXTPU_NUM_WORKERS"] = str(n)
-        env["MXTPU_WORKER_ID"] = str(i)
-        procs.append(subprocess.Popen(
-            command, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    return procs
+    try:
+        for i in range(n):
+            env = clean_env()
+            env.update(extra)
+            env["JAX_PLATFORMS"] = platform
+            env["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % port
+            env["MXTPU_NUM_WORKERS"] = str(n)
+            env["MXTPU_WORKER_ID"] = str(i)
+            procs.append(subprocess.Popen(
+                command, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    except Exception:
+        for p in procs + ps_procs:
+            p.kill()
+        raise
+    return WorkerProcs(procs, ps_procs)
 
 
 def main():
     parser = argparse.ArgumentParser(description="Launch a distributed job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="parameter servers for dist_async (the "
+                             "reference tracker's server role); 0 for "
+                             "allreduce-based dist_sync")
     parser.add_argument("--launcher", choices=["local"], default="local",
                         help="only 'local' (fake cluster); multi-host "
                              "launches use the cluster scheduler's own "
@@ -66,13 +117,16 @@ def main():
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     procs = launch_local(args.num_workers, args.command,
-                         platform=args.platform)
+                         platform=args.platform,
+                         num_servers=args.num_servers)
     rc = 0
     for i, p in enumerate(procs):
         out, _ = p.communicate()
         sys.stdout.write("---- worker %d (rc=%d) ----\n%s\n"
                          % (i, p.returncode, out.decode()))
         rc = rc or p.returncode
+    for p in procs.ps_procs:
+        p.kill()
     sys.exit(rc)
 
 
