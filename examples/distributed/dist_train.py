@@ -1,0 +1,71 @@
+"""Multi-process data-parallel training — sync allreduce or async PS.
+
+Reference: tests/nightly/dist_lenet.py + example/image-classification
+distributed section (README.md:300-323). Launch with the fake-cluster
+launcher:
+
+    python tools/launch.py -n 2 -- python examples/distributed/dist_train.py
+    python tools/launch.py -n 2 -s 1 -- \\
+        python examples/distributed/dist_train.py --kvstore dist_async
+
+`dist_sync` reduces gradients with one compiled cross-process collective
+per key (ICI/DCN on TPU pods, gloo on the CPU fake cluster); `dist_async`
+pushes to parameter servers that update per push (straggler-tolerant).
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+
+import numpy as np
+
+import logging
+import mxnet_tpu as mx
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--kvstore", default="dist_sync",
+                   choices=["dist_sync", "dist_async"])
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=50)
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    kv = mx.kv.create(args.kvstore)
+    rank, nw = kv.rank, kv.num_workers
+
+    mnist = mx.test_utils.get_mnist()
+    n = 2000
+    # each worker reads its own shard (num_parts/part_index semantics,
+    # src/io/iter_image_recordio_2.cc:78)
+    shard = slice(rank * n // nw, (rank + 1) * n // nw)
+    train = mx.io.NDArrayIter(mnist["train_data"][:n][shard],
+                              mnist["train_label"][:n][shard],
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(mnist["test_data"][:500],
+                            mnist["test_label"][:500], args.batch_size)
+
+    mod = mx.mod.Module(mx.models.get_mlp(10), context=mx.cpu())
+    # async: each worker's pushes apply immediately, so the effective
+    # step rate is num_workers x — scale lr down and keep momentum off
+    # (stale-gradient + momentum amplification diverges; the reference's
+    # async recipes do the same)
+    is_sync = args.kvstore == "dist_sync"
+    lr = 0.1 if is_sync else 0.05 / nw
+    momentum = 0.9 if is_sync else 0.0
+    mod.fit(train, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": momentum},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print("worker %d/%d final val acc %.4f" % (rank, nw, acc))
+    assert acc > 0.8, acc
+    kv.barrier()
+    print("DIST_TRAIN_OK", rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Imperative MNIST training with Gluon (Block/Trainer/autograd).
+
+Reference: example/gluon/mnist.py — the eager API surface: nn.Sequential,
+gluon.Trainer, autograd.record, loss classes, DataLoader.
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--hybridize", action="store_true",
+                   help="compile the block to one XLA program per shape")
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.epochs = 2
+
+    mnist = mx.test_utils.get_mnist()
+    n = 2000 if args.smoke else 10000
+    x = mnist["train_data"][:n].reshape(n, -1)
+    y = mnist["train_label"][:n]
+    dataset = gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    loader = gluon.data.DataLoader(dataset, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    net = nn.Sequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        print("epoch %d train %s" % (epoch, metric.get()))
+    name, acc = metric.get()
+    assert acc > (0.8 if args.smoke else 0.95), acc
+    print("final train accuracy: %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

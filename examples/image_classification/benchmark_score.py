@@ -1,0 +1,62 @@
+"""Inference throughput benchmark on synthetic data.
+
+Reference: example/image-classification/benchmark_score.py (and the
+`--benchmark 1` synthetic mode of train_imagenet.py) — score model-zoo
+networks on random data, reporting images/sec. The reference's published
+numbers for this protocol are in docs/faq/perf.md:107-142 (BASELINE.md).
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def score(sym, data_shape, n_batches):
+    prog_ctx = mx.gpu() if mx.context.num_gpus() else mx.cpu()
+    ex = sym.simple_bind(prog_ctx, data=data_shape, grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = (rng.randn(*v.shape) * 0.01).astype(np.float32)
+    batch = mx.nd.array(rng.rand(*data_shape).astype(np.float32))
+    # warmup (first call compiles the whole graph to one XLA program)
+    ex.forward(data=batch)
+    np.asarray(ex.outputs[0].asnumpy())
+    start = time.time()
+    for _ in range(n_batches):
+        ex.forward(data=batch)
+    np.asarray(ex.outputs[0].asnumpy())  # force the queue to drain
+    dt = time.time() - start
+    return data_shape[0] * n_batches / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-batches", type=int, default=20)
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--networks", default="resnet-18,resnet-50")
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.networks, args.image_shape = "resnet-18", "3,32,32"
+        args.batch_size, args.num_batches = 4, 2
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    for name in args.networks.split(","):
+        depth = int(name.split("-")[1])
+        sym = mx.models.get_resnet(num_classes=1000, num_layers=depth,
+                                   image_shape=shape)
+        ips = score(sym, (args.batch_size,) + shape, args.num_batches)
+        print("network %s batch %d: %.1f img/s" % (name, args.batch_size,
+                                                   ips))
+
+
+if __name__ == "__main__":
+    main()

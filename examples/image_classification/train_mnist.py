@@ -1,0 +1,60 @@
+"""Train an MLP or LeNet on MNIST with the Module API.
+
+Reference: example/image-classification/train_mnist.py (+ common/fit.py).
+BASELINE config #1's surface: Symbol -> Module.fit with optimizer,
+metric, and kvstore selection (works with 'local', 'device', 'dist_sync'
+under tools/launch.py, or 'dist_async' against parameter servers).
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+import logging
+
+import mxnet_tpu as mx
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", choices=["mlp", "lenet"], default="mlp")
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--kvstore", default="local")
+    p.add_argument("--num-examples", type=int, default=10000)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny run for CI (1 epoch, 2k examples)")
+    args = p.parse_args()
+    if args.smoke:
+        args.num_epochs, args.num_examples = 1, 2000
+    logging.basicConfig(level=logging.INFO)
+
+    mnist = mx.test_utils.get_mnist()
+    n = args.num_examples
+    train = mx.io.NDArrayIter(mnist["train_data"][:n],
+                              mnist["train_label"][:n],
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(mnist["test_data"][:n // 4],
+                            mnist["test_label"][:n // 4],
+                            args.batch_size)
+
+    sym = (mx.models.get_mlp(10) if args.network == "mlp"
+           else mx.models.get_lenet(10))
+    mod = mx.mod.Module(sym, context=mx.gpu() if mx.context.num_gpus()
+                        else mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc", kvstore=args.kvstore,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       frequent=20))
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print("final validation accuracy: %.4f" % acc)
+    assert acc > (0.85 if args.smoke else 0.95), acc
+
+
+if __name__ == "__main__":
+    main()

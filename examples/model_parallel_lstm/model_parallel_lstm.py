@@ -1,0 +1,103 @@
+"""Model-parallel LSTM: each layer placed on its own device via group2ctx.
+
+Reference: example/model-parallel-lstm/lstm.py:65-129 +
+docs/faq/model_parallel_lstm.md — the reference's mechanism for models
+too big for one device: tag symbol subgraphs with AttrScope(ctx_group=)
+and map groups to Contexts at bind time; the executor inserts the
+cross-device copies (graph_executor.cc:317-421 PlaceDevice).
+
+Runs on virtual CPU devices by default (set
+XLA_FLAGS=--xla_force_host_platform_device_count=2 or more); on real
+hardware map the groups to distinct accelerators.
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.rnn import LSTMCell
+
+
+def build(seq_len, num_hidden, num_layers, vocab):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    with mx.AttrScope(ctx_group="layer0"):
+        inputs = mx.sym.Embedding(data, input_dim=vocab,
+                                  output_dim=num_hidden, name="embed")
+    # one ctx group per LSTM layer — the reference's per-GPU placement
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group="layer%d" % i):
+            cell = LSTMCell(num_hidden=num_hidden, prefix="lstm%d_" % i)
+            inputs, _ = cell.unroll(seq_len, inputs=inputs,
+                                    merge_outputs=True)
+    with mx.AttrScope(ctx_group="head"):
+        pred = mx.sym.Reshape(inputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        labf = mx.sym.Reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, labf, name="softmax")
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-hidden", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=8)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.steps = 30
+    vocab, bs = 16, 8
+    rng = np.random.RandomState(0)
+
+    net = build(args.seq_len, args.num_hidden, args.num_layers, vocab)
+    import jax
+
+    n_dev = max(2, len(jax.devices()))
+    devices = [mx.Context("cpu", i) for i in range(n_dev)] \
+        if not mx.context.num_gpus() \
+        else [mx.gpu(i) for i in range(mx.context.num_gpus())]
+    group2ctx = {"head": devices[-1]}
+    for i in range(args.num_layers):
+        group2ctx["layer%d" % i] = devices[i % len(devices)]
+    print("placement:", {k: str(v) for k, v in group2ctx.items()})
+    ex = net.simple_bind(devices[0], data=(bs, args.seq_len),
+                         softmax_label=(bs, args.seq_len),
+                         grad_req="write", group2ctx=group2ctx)
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = (rng.randn(*v.shape) * 0.1).astype(np.float32)
+
+    first = last = None
+    for step in range(args.steps):
+        starts = rng.randint(0, vocab, bs)
+        d = (starts[:, None] + np.arange(args.seq_len)[None, :]) % vocab
+        lab = (d + 1) % vocab
+        ex.arg_dict["data"][:] = mx.nd.array(d.astype(np.float32))
+        ex.arg_dict["softmax_label"][:] = mx.nd.array(
+            lab.astype(np.float32))
+        ex.forward(is_train=True)
+        ex.backward()
+        probs = ex.outputs[0].asnumpy()
+        nll = -np.log(np.maximum(
+            probs[np.arange(probs.shape[0]), lab.reshape(-1)], 1e-9)
+        ).mean()
+        if first is None:
+            first = nll
+        last = nll
+        for k, g in ex.grad_dict.items():
+            if k in ("data", "softmax_label") or g is None:
+                continue
+            ex.arg_dict[k][:] = ex.arg_dict[k] - 0.2 * g
+    print("loss %.3f -> %.3f over %d steps" % (first, last, args.steps))
+    assert last < first * 0.7
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""LSTM language model with bucketing over variable-length sequences.
+
+Reference: example/rnn/lstm_bucketing.py (BASELINE config #4's surface:
+BucketSentenceIter + BucketingModule + rnn cells, docs/faq/bucketing.md).
+The corpus is a synthetic deterministic grammar (offline environment), so
+a learnable structure exists: each sentence is an arithmetic ramp whose
+next token is (t + step) mod V.
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.rnn import BucketSentenceIter, LSTMCell, SequentialRNNCell
+
+
+def synthetic_corpus(n_sent, vocab, rng):
+    sents = []
+    for _ in range(n_sent):
+        length = rng.randint(5, 30)
+        start = rng.randint(1, vocab)
+        step = rng.randint(1, 4)
+        sents.append([(start + i * step) % (vocab - 1) + 1
+                      for i in range(length)])
+    return sents
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--num-embed", type=int, default=32)
+    p.add_argument("--num-layers", type=int, default=1)
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=32)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.num_epochs = 2
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    buckets = [10, 20, 30]
+    train = BucketSentenceIter(synthetic_corpus(400, args.vocab, rng),
+                               args.batch_size, buckets=buckets)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=args.vocab,
+                                 output_dim=args.num_embed, name="embed")
+        stack = SequentialRNNCell()
+        for i in range(args.num_layers):
+            stack.add(LSTMCell(num_hidden=args.num_hidden,
+                               prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=args.vocab,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax",
+                            use_ignore=True, ignore_label=-1,
+                            normalization="valid")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key,
+                                 context=mx.cpu())
+    metric = mx.metric.Perplexity(ignore_label=-1)
+    mod.fit(train, num_epoch=args.num_epochs, eval_metric=metric,
+            optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier())
+    train.reset()
+    res = dict(mod.score(train, metric))
+    print("final train perplexity: %.2f" % res["perplexity"])
+    assert res["perplexity"] < (args.vocab if args.smoke else 10.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Train a tiny SSD to localize synthetic bright squares.
+
+Reference: example/ssd/train.py + symbol/common.py multibox_layer
+(BASELINE config #5's op surface: MultiBoxPrior → MultiBoxTarget →
+SoftmaxOutput cls head + smooth-L1 loc head → MultiBoxDetection at
+inference). Offline stand-in for VOC: images contain one bright square,
+the detector learns to find it.
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.ssd import get_ssd
+
+
+def tiny_features(data):
+    """Two tiny conv stages -> two detection scales."""
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), stride=(2, 2),
+                            pad=(1, 1), num_filter=16, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    c2 = mx.sym.Convolution(a1, kernel=(3, 3), stride=(2, 2),
+                            pad=(1, 1), num_filter=32, name="c2")
+    a2 = mx.sym.Activation(c2, act_type="relu")
+    c3 = mx.sym.Convolution(a2, kernel=(3, 3), stride=(2, 2),
+                            pad=(1, 1), num_filter=32, name="c3")
+    a3 = mx.sym.Activation(c3, act_type="relu")
+    return [a2, a3]
+
+
+def make_batch(rng, bs, size=32):
+    data = rng.rand(bs, 3, size, size).astype(np.float32) * 0.2
+    lab = np.zeros((bs, 1, 5), np.float32)
+    for i in range(bs):
+        cx, cy = rng.uniform(0.3, 0.7, 2)
+        half = 0.15
+        x1, y1, x2, y2 = cx - half, cy - half, cx + half, cy + half
+        lab[i, 0] = [0, x1, y1, x2, y2]
+        data[i, :, int(y1 * size):int(y2 * size),
+             int(x1 * size):int(x2 * size)] = 1.0
+    return data, lab
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        args.steps = 80
+    rng = np.random.RandomState(0)
+
+    net = get_ssd(num_classes=1, mode="train", features=tiny_features,
+                  sizes=[[0.3, 0.4], [0.6, 0.8]], ratios=[[1], [1]])
+    bs = args.batch_size
+    ex = net.simple_bind(mx.cpu(), data=(bs, 3, 32, 32),
+                         label=(bs, 1, 5), grad_req="write")
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "label"):
+            v[:] = (rng.randn(*v.shape) * 0.05).astype(np.float32)
+
+    data, lab = make_batch(rng, bs)
+    ex.arg_dict["data"][:] = data
+    ex.arg_dict["label"][:] = lab
+    first_loss = None
+    for step in range(args.steps):
+        ex.forward(is_train=True)
+        ex.backward()
+        cls_prob = ex.outputs[0].asnumpy()
+        cls_target = ex.outputs[2].asnumpy()
+        valid = cls_target >= 0
+        nll = -np.log(np.maximum(
+            np.take_along_axis(
+                cls_prob, cls_target.clip(0)[:, None].astype(int),
+                axis=1)[:, 0][valid], 1e-9)).mean()
+        if first_loss is None:
+            first_loss = nll
+        for k, g in ex.grad_dict.items():
+            if k in ("data", "label") or g is None:
+                continue
+            # clip: multibox cls gradients spike early under hard-negative
+            # mining
+            ex.arg_dict[k][:] = (ex.arg_dict[k].asnumpy()
+                                 - args.lr * np.clip(g.asnumpy(), -1, 1))
+        if step % 50 == 0:
+            print("step %d cls-loss %.4f" % (step, nll))
+    print("cls loss: %.4f -> %.4f" % (first_loss, nll))
+    factor = 0.97 if args.smoke else 0.85
+    assert nll < first_loss * factor, (first_loss, nll)
+
+    # inference path: MultiBoxDetection with NMS finds the square
+    det_net = get_ssd(num_classes=1, mode="inference",
+                      features=tiny_features,
+                      sizes=[[0.3, 0.4], [0.6, 0.8]], ratios=[[1], [1]])
+    dex = det_net.simple_bind(mx.cpu(), data=(bs, 3, 32, 32),
+                              grad_req="null")
+    for k, v in ex.arg_dict.items():
+        if k in dex.arg_dict and k not in ("data", "label"):
+            dex.arg_dict[k][:] = v
+    dex.arg_dict["data"][:] = data
+    dets = dex.forward()[0].asnumpy()
+    kept = dets[0][dets[0][:, 0] >= 0]
+    print("detections for image 0 (cls, score, x1, y1, x2, y2):")
+    print(kept[:3])
+
+
+if __name__ == "__main__":
+    main()

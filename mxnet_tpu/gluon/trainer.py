@@ -98,13 +98,29 @@ class Trainer:
     def set_learning_rate(self, lr):
         """(reference: trainer.py:set_learning_rate)"""
         self._optimizer.lr = lr
+        if (self._kv_initialized and self._update_on_kvstore
+                and self._kvstore is not None
+                and self._kvstore._updater is None):
+            # the applying optimizer lives on the PS servers — re-ship it
+            # (server preserves momentum state across the swap)
+            self._kvstore.set_optimizer(self._optimizer)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step (reference: trainer.py:step:156)."""
         if not self._kv_initialized:
             self._init_kvstore()
 
-        self._optimizer.rescale_grad = self._scale / batch_size
+        rescale = self._scale / batch_size
+        if (self._update_on_kvstore and self._kvstore is not None
+                and self._kvstore._updater is None
+                and self._optimizer.rescale_grad != rescale):
+            # server-side optimizer (dist_async): the pickled copy on the
+            # servers is the one applying updates, so hyperparameter
+            # changes (rescale_grad here; set_learning_rate likewise)
+            # must be re-shipped or the servers keep stale values
+            self._optimizer.rescale_grad = rescale
+            self._kvstore.set_optimizer(self._optimizer)
+        self._optimizer.rescale_grad = rescale
 
         for i, param in enumerate(self._params):
             if param.grad_req == "null":

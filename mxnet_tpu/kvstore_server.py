@@ -79,6 +79,7 @@ class KVStoreServer:
         self._store = {}          # key -> np.ndarray
         self._updater = None
         self._lock = threading.Lock()
+        self._key_locks = {}      # key -> Lock (creation under _lock)
         self._last_seen = {}      # worker rank -> timestamp
         self._barrier_waiters = []
         self._barrier_gen = 0
@@ -132,14 +133,18 @@ class KVStoreServer:
             with self._lock:
                 if key not in self._store:
                     return ("err", "key %r not initialized" % (key,))
-                return ("ok", np.array(self._store[key]))
+                weight = self._store[key]
+            with self._key_lock(key):   # no torn read of in-place updates
+                return ("ok", np.array(weight))
         if op == "row_sparse_pull":
             _, key, row_ids = msg
             with self._lock:
                 if key not in self._store:
                     return ("err", "key %r not initialized" % (key,))
+                weight = self._store[key]
+            with self._key_lock(key):
                 rows = np.asarray(row_ids, dtype=np.int64)
-                return ("ok", np.array(self._store[key][rows]), rows)
+                return ("ok", np.array(weight[rows]), rows)
         if op == "command":
             # head 0 == kSetOptimizer (kvstore_dist_server.h:43 CommandType)
             _, head, body = msg
@@ -148,7 +153,13 @@ class KVStoreServer:
 
                 optimizer = pickle.loads(body)
                 with self._lock:
+                    # hyperparameter re-ships (Trainer rescale_grad /
+                    # set_learning_rate) must not reset momentum state
+                    old_states = (self._updater.get_states()
+                                  if self._updater is not None else None)
                     self._updater = _NumpyUpdater(opt.get_updater(optimizer))
+                    if old_states is not None:
+                        self._updater.set_states(old_states)
                 return ("ok",)
             return ("err", "unknown command head %r" % (head,))
         if op == "barrier":
@@ -181,14 +192,27 @@ class KVStoreServer:
             return ("ok",)
         return ("err", "unknown op %r" % (op,))
 
+    def _key_lock(self, key):
+        with self._lock:
+            return self._key_locks.setdefault(key, threading.Lock())
+
     def _apply_push(self, key, grad):
+        # per-key locking: the optimizer update (which dispatches device
+        # compute in _NumpyUpdater) must not serialize pushes/pulls of
+        # UNRELATED keys behind one shard-wide lock. Updater-internal
+        # state is a per-key dict, so cross-key concurrency is safe
+        # (shared scalar counters like num_update degrade gracefully).
         with self._lock:
             if key not in self._store:
                 return ("err", "key %r not initialized" % (key,))
-            if self._updater is not None:
-                self._updater(key, grad, self._store[key])
+            updater = self._updater
+            weight = self._store[key]
+        with self._key_lock(key):
+            if updater is not None:
+                updater(key, grad, weight)   # in-place on the stored array
             else:
-                self._store[key] = np.array(grad)
+                with self._lock:
+                    self._store[key] = np.array(grad)
         return ("ok",)
 
     def _barrier(self, num_workers):
@@ -301,41 +325,59 @@ class PSClient:
 
     def __init__(self, addresses, rank):
         self.rank = rank
+        self._addresses = list(addresses)
         self._socks = []
         self._locks = []
-        deadline = time.time() + 30
         for addr in addresses:
-            host, port = addr.rsplit(":", 1)
-            while True:
-                try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=30)
-                    break
-                except OSError:
-                    if time.time() > deadline:
-                        raise MXNetError(
-                            "cannot reach PS server at %s" % addr)
-                    time.sleep(0.05)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s = self._connect(addr)
             self._socks.append(s)
             self._locks.append(threading.Lock())
         for i in range(len(self._socks)):
             self._call(i, ("hello", rank))
-        # background heartbeat so liveness does not depend on push cadence
-        # (ps-lite's Van heartbeats; get_num_dead_node contract)
+        # Heartbeats ride DEDICATED connections (ps-lite's Van heartbeats;
+        # get_num_dead_node contract): a data call blocked in a long
+        # server barrier holds its socket lock for the whole wait, and
+        # liveness must not depend on that (a worker waiting at a barrier
+        # is alive, not dead).
+        self._hb_socks = []
+        for addr in addresses:
+            hs = self._connect(addr)
+            _send_msg(hs, ("hello", rank))
+            _recv_msg(hs)
+            self._hb_socks.append(hs)
         self._closed = threading.Event()
         interval = float(os.environ.get("MXTPU_PS_HEARTBEAT", "5"))
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(interval,), daemon=True)
         self._hb_thread.start()
 
+    @staticmethod
+    def _connect(addr):
+        host, port = addr.rsplit(":", 1)
+        deadline = time.time() + 30
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)), timeout=30)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise MXNetError("cannot reach PS server at %s" % addr)
+                time.sleep(0.05)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
     def _heartbeat_loop(self, interval):
         while not self._closed.wait(interval):
-            for i in range(len(self._socks)):
+            for i, hs in enumerate(self._hb_socks):
+                if hs is None:
+                    continue
                 try:
-                    self._call(i, ("heartbeat",))
-                except (MXNetError, OSError):
-                    return
+                    _send_msg(hs, ("heartbeat",))
+                    _recv_msg(hs)
+                except (ConnectionError, OSError):
+                    # that shard is unreachable; keep heartbeating the
+                    # healthy ones so they do not falsely age us out
+                    self._hb_socks[i] = None
 
     def _shard(self, key):
         # stable across processes (python str hash is per-process salted)
@@ -377,6 +419,9 @@ class PSClient:
     def close(self):
         if hasattr(self, "_closed"):
             self._closed.set()
+            # stop heartbeats BEFORE deregistering, or a racing beat
+            # re-registers the rank after the bye
+            self._hb_thread.join(timeout=2)
         for i, s in enumerate(self._socks):
             try:
                 # clean shutdown deregisters from liveness tracking; a
@@ -388,6 +433,12 @@ class PSClient:
                 s.close()
             except OSError:
                 pass
+        for hs in getattr(self, "_hb_socks", []):
+            if hs is not None:
+                try:
+                    hs.close()
+                except OSError:
+                    pass
 
 
 def start_server_thread(host="127.0.0.1", port=0):

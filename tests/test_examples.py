@@ -1,0 +1,62 @@
+"""Every example stays runnable (the reference keeps example/ working via
+tests/python/train; here each script's --smoke mode runs in CI)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_EXAMPLES = [
+    "examples/image_classification/train_mnist.py",
+    "examples/image_classification/benchmark_score.py",
+    "examples/rnn/lstm_bucketing.py",
+    "examples/ssd/train_ssd_toy.py",
+    "examples/model_parallel_lstm/model_parallel_lstm.py",
+    "examples/sparse/linear_classification.py",
+    "examples/gluon/mnist_gluon.py",
+]
+
+
+@pytest.mark.parametrize("script", _EXAMPLES,
+                         ids=[os.path.basename(s) for s in _EXAMPLES])
+def test_example_smoke(script):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    from launch import clean_env
+
+    # clean_env strips the axon tunnel vars that would override
+    # JAX_PLATFORMS and land half the arrays on the real TPU
+    env = clean_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MXTPU_PS_ADDR", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=2"
+                            ).strip()
+    res = subprocess.run(
+        [sys.executable, os.path.join(_REPO, script), "--smoke"],
+        env=env, cwd=_REPO, capture_output=True, timeout=600)
+    assert res.returncode == 0, "%s failed:\n%s\n%s" % (
+        script, res.stdout.decode()[-3000:], res.stderr.decode()[-3000:])
+
+
+def test_example_dist_train():
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    from launch import launch_local
+
+    script = os.path.join(_REPO, "examples/distributed/dist_train.py")
+    for kvstore, num_servers in [("dist_sync", 0), ("dist_async", 1)]:
+        procs = launch_local(
+            2, [sys.executable, script, "--kvstore", kvstore,
+                "--num-epochs", "1"], num_servers=num_servers)
+        try:
+            for i, p in enumerate(procs):
+                out, _ = p.communicate(timeout=300)
+                assert p.returncode == 0, "%s worker %d:\n%s" % (
+                    kvstore, i, out.decode()[-3000:])
+                assert b"DIST_TRAIN_OK" in out
+        finally:
+            for p in procs.ps_procs:
+                p.kill()
