@@ -8,3 +8,4 @@ from . import loss
 from . import data
 from . import utils
 from . import model_zoo
+from . import contrib

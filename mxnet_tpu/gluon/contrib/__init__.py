@@ -1,0 +1,3 @@
+"""Gluon contrib — experimental layers kept for reference parity
+(reference: python/mxnet/gluon/contrib/)."""
+from . import rnn

@@ -1,0 +1,3 @@
+"""Contrib recurrent cells (reference: python/mxnet/gluon/contrib/rnn/)."""
+from .conv_rnn_cell import *  # noqa: F401,F403
+from .rnn_cell import *  # noqa: F401,F403

@@ -1,0 +1,215 @@
+"""Gluon contrib cells (reference tests:
+tests/python/unittest/test_gluon_contrib.py — conv cell shapes/forward +
+variational dropout mask reuse)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.contrib.rnn import (
+    Conv1DGRUCell, Conv1DLSTMCell, Conv1DRNNCell, Conv2DLSTMCell,
+    Conv2DRNNCell, Conv3DRNNCell, VariationalDropoutCell)
+
+
+def _params(cell):
+    out = {}
+    for k, v in cell.collect_params().items():
+        for suffix in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+            if k.endswith(suffix):
+                out[suffix] = v.data().asnumpy().astype(np.float64)
+    return out
+
+
+def _conv1d(x, w, b, pad):
+    """Plain numpy NCW conv, stride 1."""
+    n, c, width = x.shape
+    f, _, k = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad)))
+    ow = width + 2 * pad - k + 1
+    out = np.zeros((n, f, ow), np.float64)
+    for i in range(ow):
+        out[:, :, i] = np.einsum("ncw,fcw->nf", xp[:, :, i:i + k], w)
+    return out + b.reshape(1, -1, 1)
+
+
+def test_conv1d_rnn_cell_matches_numpy():
+    rng = np.random.RandomState(0)
+    cell = Conv1DRNNCell(input_shape=(2, 8), hidden_channels=3,
+                         i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(rng.randn(4, 2, 8).astype(np.float32))
+    states = cell.begin_state(batch_size=4)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 3, 8)
+    assert len(new_states) == 1
+    p = _params(cell)
+    i2h = _conv1d(x.asnumpy().astype(np.float64), p["i2h_weight"],
+                  p["i2h_bias"], pad=1)
+    h2h = _conv1d(np.zeros((4, 3, 8)), p["h2h_weight"], p["h2h_bias"],
+                  pad=1)
+    np.testing.assert_allclose(out.asnumpy(), np.tanh(i2h + h2h),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_lstm_cell_matches_numpy():
+    rng = np.random.RandomState(1)
+    cell = Conv1DLSTMCell(input_shape=(2, 6), hidden_channels=2,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(rng.randn(2, 2, 6).astype(np.float32))
+    h0 = mx.nd.array(rng.randn(2, 2, 6).astype(np.float32))
+    c0 = mx.nd.array(rng.randn(2, 2, 6).astype(np.float32))
+    out, (h1, c1) = cell(x, [h0, c0])
+    p = _params(cell)
+    gates = (_conv1d(x.asnumpy().astype(np.float64), p["i2h_weight"],
+                     p["i2h_bias"], 1)
+             + _conv1d(h0.asnumpy().astype(np.float64), p["h2h_weight"],
+                       p["h2h_bias"], 1))
+    gi, gf, gc, go = np.split(gates, 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_exp = sig(gf) * c0.asnumpy() + sig(gi) * np.tanh(gc)
+    h_exp = sig(go) * np.tanh(c_exp)
+    np.testing.assert_allclose(c1.asnumpy(), c_exp, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h1.asnumpy(), h_exp, rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_gru_cell_matches_numpy():
+    rng = np.random.RandomState(2)
+    cell = Conv1DGRUCell(input_shape=(2, 5), hidden_channels=2,
+                         i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(rng.randn(3, 2, 5).astype(np.float32))
+    h0 = mx.nd.array(rng.randn(3, 2, 5).astype(np.float32))
+    out, (h1,) = cell(x, [h0])
+    p = _params(cell)
+    i2h = _conv1d(x.asnumpy().astype(np.float64), p["i2h_weight"],
+                  p["i2h_bias"], 1)
+    h2h = _conv1d(h0.asnumpy().astype(np.float64), p["h2h_weight"],
+                  p["h2h_bias"], 1)
+    ir, iz, io = np.split(i2h, 3, axis=1)
+    hr, hz, ho = np.split(h2h, 3, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    r, z = sig(ir + hr), sig(iz + hz)
+    cand = np.tanh(io + r * ho)
+    h_exp = (1 - z) * cand + z * h0.asnumpy()
+    np.testing.assert_allclose(h1.asnumpy(), h_exp, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_cells_shapes_and_unroll():
+    # 2D LSTM: state spatial size follows the i2h conv geometry
+    cell = Conv2DLSTMCell(input_shape=(1, 8, 8), hidden_channels=4,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = mx.nd.array(np.random.rand(5, 2, 1, 8, 8).astype(np.float32))
+    outputs, states = cell.unroll(5, [seq[i] for i in range(5)],
+                                  layout="TNC", merge_outputs=False)
+    assert len(outputs) == 5 and outputs[0].shape == (2, 4, 8, 8)
+    assert states[0].shape == (2, 4, 8, 8)
+    # unpadded i2h shrinks the state
+    info = Conv2DRNNCell(input_shape=(3, 10, 10), hidden_channels=2,
+                         i2h_kernel=3, h2h_kernel=3).state_info(4)
+    assert info[0]["shape"] == (4, 2, 8, 8)
+    # 3D variant constructs and steps
+    c3 = Conv3DRNNCell(input_shape=(1, 4, 4, 4), hidden_channels=2,
+                       i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c3.initialize()
+    out, _ = c3(mx.nd.array(np.random.rand(1, 1, 4, 4, 4)
+                            .astype(np.float32)),
+                c3.begin_state(batch_size=1))
+    assert out.shape == (1, 2, 4, 4, 4)
+
+
+def test_conv_cell_validation():
+    with pytest.raises(ValueError):
+        Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                      i2h_kernel=3, h2h_kernel=2)  # even h2h kernel
+    with pytest.raises(ValueError):
+        Conv2DRNNCell(input_shape=(4, 4, 1), hidden_channels=2,
+                      i2h_kernel=3, h2h_kernel=3, conv_layout="NHWC")
+
+
+def test_conv_lstm_gradients_flow():
+    cell = Conv1DLSTMCell(input_shape=(2, 6), hidden_channels=2,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 2, 6).astype(np.float32))
+    # nonzero initial states: with h0 = 0 the h2h gradient is legitimately
+    # zero after a single step
+    h0 = mx.nd.array(np.random.rand(2, 2, 6).astype(np.float32))
+    c0 = mx.nd.array(np.random.rand(2, 2, 6).astype(np.float32))
+    with autograd.record():
+        out, _ = cell(x, [h0, c0])
+        loss = (out * out).sum()
+    loss.backward()
+    for k, v in cell.collect_params().items():
+        g = v.grad().asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0, k
+
+
+def test_variational_dropout_mask_reuse():
+    base = Conv1DRNNCell(input_shape=(1, 4), hidden_channels=1,
+                         i2h_kernel=1, h2h_kernel=1)
+    cell = VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize(mx.init.One())
+    x = mx.nd.array(np.ones((1, 1, 4), np.float32))
+    states = cell.begin_state(batch_size=1)
+    with autograd.record():
+        # masks sample once; two steps with identical input must see the
+        # identical input mask (the defining variational property)
+        out1, states = cell(x, states)
+        out2, _ = cell(x, states)
+    m = cell._masks["inputs"].asnumpy()
+    assert set(np.round(m.ravel(), 4)) <= {0.0, 2.0}
+    m2 = cell._masks["inputs"].asnumpy()
+    np.testing.assert_array_equal(m, m2)
+    # reset resamples eventually (probability a 20-elem mask repeats is
+    # tiny; use a bigger mask to avoid flakes)
+    big = VariationalDropoutCell(
+        Conv1DRNNCell(input_shape=(1, 64), hidden_channels=1,
+                      i2h_kernel=1, h2h_kernel=1), drop_inputs=0.5)
+    big.initialize()
+    xb = mx.nd.array(np.ones((1, 1, 64), np.float32))
+    with autograd.record():
+        big(xb, big.begin_state(batch_size=1))
+        ma = big._masks["inputs"].asnumpy()
+        big.reset()
+        big(xb, big.begin_state(batch_size=1))
+        mb = big._masks["inputs"].asnumpy()
+    assert not np.array_equal(ma, mb)
+    # eval mode: after reset (masks are held until then, like the
+    # reference), dropout of ones is identity outside train mode
+    cell.reset()
+    out_eval, _ = cell(x, cell.begin_state(batch_size=1))
+    base._modified = False
+    ref_out, _ = base(x, base.begin_state(batch_size=1))
+    base._modified = True
+    np.testing.assert_allclose(out_eval.asnumpy(), ref_out.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_conv_cell_rejects_missing_channel_dim():
+    with pytest.raises(ValueError):
+        Conv2DRNNCell(input_shape=(10, 10), hidden_channels=2,
+                      i2h_kernel=3, h2h_kernel=3)
+
+
+def test_variational_dropout_hybridize_stays_eager():
+    import warnings
+
+    cell = VariationalDropoutCell(
+        Conv1DRNNCell(input_shape=(1, 32), hidden_channels=1,
+                      i2h_kernel=1, h2h_kernel=1), drop_inputs=0.5)
+    cell.initialize()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cell.hybridize()
+    assert any("eagerly" in str(x.message) for x in w)
+    x = mx.nd.array(np.ones((1, 1, 32), np.float32))
+    states = cell.begin_state(batch_size=1)
+    with autograd.record():
+        cell(x, states)
+        m1 = cell._masks["inputs"].asnumpy()
+        cell(x, states)
+        m2 = cell._masks["inputs"].asnumpy()
+    # the variational property survives hybridize: same mask both steps
+    np.testing.assert_array_equal(m1, m2)
