@@ -504,7 +504,14 @@ class ImageIter(_io.DataIter):
                     path_imgidx, path_imgrec, "r")
                 self.seq = list(self.imgrec.keys)
             else:
-                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                # sequential scan: use the native read-ahead thread so
+                # disk IO overlaps decode (PrefetcherIter analog); fall
+                # back to the plain reader without a toolchain
+                try:
+                    self.imgrec = recordio.MXRecordIOPrefetcher(
+                        path_imgrec)
+                except MXNetError:
+                    self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
                 self.seq = None
         if path_imglist:
             imglist_d = {}

@@ -500,6 +500,11 @@ class LibSVMIter(DataIter):
     optionally reads labels (possibly multi-valued sparse rows) from a
     second file. ``num_parts``/``part_index`` shard rows for distributed
     training.
+
+    Parsing runs in the native C++ tokenizer when the toolchain is
+    available (mxnet_tpu/native/libsvmparse.cc — the reference parses in
+    C++ too) with a pure-Python fallback; either way the dataset is held
+    as one CSR triple, so a batch is an indptr slice, not a row loop.
     """
 
     def __init__(self, data_libsvm, data_shape, batch_size,
@@ -511,35 +516,41 @@ class LibSVMIter(DataIter):
         self._csr_matrix = csr_matrix
         self.batch_size = batch_size
         feat = int(np.prod(data_shape))
-        self._rows = self._parse(data_libsvm, feat)
+        labels0, self._indptr, self._indices, self._values = \
+            self._parse(data_libsvm, feat)
+        n_rows = len(labels0)
         if label_libsvm is not None:
             lfeat = int(np.prod(label_shape)) if label_shape else 1
-            lab = self._parse(label_libsvm, lfeat)
-            if len(lab) != len(self._rows):
+            _, lptr, lidx, lval = self._parse(label_libsvm, lfeat)
+            if len(lptr) - 1 != n_rows:
                 raise MXNetError(
                     "label file has %d rows but data file has %d"
-                    % (len(lab), len(self._rows)))
+                    % (len(lptr) - 1, n_rows))
             if lfeat == 1:
-                self._labels = np.array(
-                    [r[1][0] if len(r[1]) else 0.0 for r in lab],
-                    np.float32)
+                self._labels = np.zeros(n_rows, np.float32)
+                has = lptr[1:] > lptr[:-1]
+                self._labels[has] = lval[lptr[:-1][has]]
             else:
                 # multi-valued labels densify to (n, lfeat)
-                dense = np.zeros((len(lab), lfeat), np.float32)
-                for ri, (_, val, idx) in enumerate(lab):
-                    dense[ri, idx] = val
+                dense = np.zeros((n_rows, lfeat), np.float32)
+                row_of = np.repeat(np.arange(n_rows), np.diff(lptr))
+                dense[row_of, lidx] = lval
                 self._labels = dense
         else:
-            self._labels = np.array([r[0] for r in self._rows], np.float32)
+            self._labels = labels0
         if num_parts > 1:
             assert 0 <= part_index < num_parts
             # every row belongs to exactly one part (dmlc InputSplit
             # semantics: uneven parts, no dropped remainder)
-            bounds = np.linspace(0, len(self._rows), num_parts + 1
-                                 ).astype(int)
+            bounds = np.linspace(0, n_rows, num_parts + 1).astype(int)
             lo, hi = bounds[part_index], bounds[part_index + 1]
-            self._rows = self._rows[lo:hi]
+            base = self._indptr[lo]
+            self._indices = self._indices[self._indptr[lo]:
+                                          self._indptr[hi]]
+            self._values = self._values[base:self._indptr[hi]]
+            self._indptr = self._indptr[lo:hi + 1] - base
             self._labels = self._labels[lo:hi]
+        self._n_rows = len(self._indptr) - 1
         self._feat = feat
         self.cur = 0
         self.provide_data = [DataDesc("data", (batch_size, feat), "float32")]
@@ -549,49 +560,90 @@ class LibSVMIter(DataIter):
 
     @staticmethod
     def _parse(path, num_feat):
-        rows = []
-        with open(path) as f:
-            for line in f:
-                parts = line.split()
-                if not parts:
-                    continue
-                label = float(parts[0].split(",")[0])
-                idx, val = [], []
-                for tok in parts[1:]:
-                    i, v = tok.split(":")
-                    i = int(i)
-                    if i >= num_feat:
-                        raise MXNetError(
-                            "libsvm feature index %d out of range %d"
-                            % (i, num_feat))
-                    idx.append(i)
-                    val.append(float(v))
-                rows.append((label, val, idx))
-        return rows
+        """Parse a libsvm file to (labels, indptr, indices, values)."""
+        from . import native
+
+        lib = native.libsvm_lib()
+        if lib is not None:
+            import ctypes
+
+            h = lib.lsvm_parse(path.encode())
+            if not h:
+                raise MXNetError("cannot open %s" % path)
+            try:
+                bad = lib.lsvm_error_line(h)
+                if bad:
+                    raise MXNetError("libsvm parse error at %s:%d"
+                                     % (path, bad))
+                n, nnz = lib.lsvm_rows(h), lib.lsvm_nnz(h)
+                labels = np.empty(n, np.float32)
+                indptr = np.empty(n + 1, np.int64)
+                indices = np.empty(nnz, np.int64)
+                values = np.empty(nnz, np.float32)
+                lib.lsvm_fill(
+                    h,
+                    labels.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)),
+                    indptr.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_longlong)),
+                    indices.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_longlong)),
+                    values.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)))
+            finally:
+                lib.lsvm_free(h)
+        else:
+            labels_l, indptr_l, indices_l, values_l = [], [0], [], []
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    try:
+                        labels_l.append(float(parts[0].split(",")[0]))
+                        for tok in parts[1:]:
+                            i, v = tok.split(":")
+                            indices_l.append(int(i))
+                            values_l.append(float(v))
+                    except ValueError:
+                        # same error contract as the native parser
+                        raise MXNetError("libsvm parse error at %s:%d"
+                                         % (path, lineno))
+                    indptr_l.append(len(indices_l))
+            labels = np.asarray(labels_l, np.float32)
+            indptr = np.asarray(indptr_l, np.int64)
+            indices = np.asarray(indices_l, np.int64)
+            values = np.asarray(values_l, np.float32)
+        if len(indices) and (indices.max() >= num_feat or
+                             indices.min() < 0):
+            bad = (int(indices.min()) if indices.min() < 0
+                   else int(indices.max()))
+            raise MXNetError(
+                "libsvm feature index %d out of range %d"
+                % (bad, num_feat))
+        return labels, indptr, indices, values
 
     def reset(self):
         self.cur = 0
 
     def next(self):
-        if self.cur >= len(self._rows):
+        if self.cur >= self._n_rows:
             raise StopIteration
-        batch_rows = self._rows[self.cur:self.cur + self.batch_size]
-        labels = self._labels[self.cur:self.cur + self.batch_size]
-        pad = self.batch_size - len(batch_rows)
-        self.cur += len(batch_rows)
-        indptr = [0]
-        indices, values = [], []
-        for _, val, idx in batch_rows:
-            indices.extend(idx)
-            values.extend(val)
-            indptr.append(len(indices))
-        for _ in range(pad):
-            indptr.append(len(indices))
+        lo = self.cur
+        hi = min(lo + self.batch_size, self._n_rows)
+        pad = self.batch_size - (hi - lo)
+        self.cur = hi
+        base = self._indptr[lo]
+        indptr = self._indptr[lo:hi + 1] - base
+        if pad:
+            indptr = np.concatenate(
+                [indptr, np.full(pad, indptr[-1], np.int64)])
         data = self._csr_matrix(
-            (np.asarray(values, np.float32),
-             np.asarray(indices, np.int64),
-             np.asarray(indptr, np.int64)),
+            (self._values[base:self._indptr[hi]],
+             self._indices[base:self._indptr[hi]],
+             indptr),
             shape=(self.batch_size, self._feat))
+        labels = self._labels[lo:hi]
         if pad:
             lab = np.concatenate(
                 [labels, np.zeros((pad,) + labels.shape[1:], np.float32)])

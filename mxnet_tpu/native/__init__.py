@@ -16,12 +16,17 @@ _LOCK = threading.Lock()
 _LIBS = {}
 
 
+# per-library extra compile flags
+_FLAGS = {"prefetch": ["-pthread"]}
+
+
 def _build(name):
     src = os.path.join(_HERE, name + ".cc")
     so = os.path.join(_HERE, "lib%s.so" % name)
     if (not os.path.exists(so)
             or os.path.getmtime(so) < os.path.getmtime(src)):
-        cmd = ["g++", "-O2", "-std=c++14", "-fPIC", "-shared", src, "-o", so]
+        cmd = (["g++", "-O2", "-std=c++14", "-fPIC", "-shared", src]
+               + _FLAGS.get(name, []) + ["-o", so])
         subprocess.run(cmd, check=True, capture_output=True)
     return so
 
@@ -63,4 +68,42 @@ def recordio_lib():
         lib.rio_flush.restype = ctypes.c_int
         lib.rio_flush.argtypes = [P]
         lib._rio_typed = True
+    return lib
+
+
+def libsvm_lib():
+    lib = load("libsvmparse")
+    if lib is not None and not getattr(lib, "_lsvm_typed", False):
+        LL = ctypes.c_longlong
+        P = ctypes.c_void_p
+        FP = ctypes.POINTER(ctypes.c_float)
+        LP = ctypes.POINTER(LL)
+        lib.lsvm_parse.restype = P
+        lib.lsvm_parse.argtypes = [ctypes.c_char_p]
+        lib.lsvm_rows.restype = LL
+        lib.lsvm_rows.argtypes = [P]
+        lib.lsvm_nnz.restype = LL
+        lib.lsvm_nnz.argtypes = [P]
+        lib.lsvm_error_line.restype = LL
+        lib.lsvm_error_line.argtypes = [P]
+        lib.lsvm_fill.argtypes = [P, FP, LP, LP, FP]
+        lib.lsvm_free.argtypes = [P]
+        lib._lsvm_typed = True
+    return lib
+
+
+def prefetch_lib():
+    lib = load("prefetch")
+    if lib is not None and not getattr(lib, "_rpf_typed", False):
+        LL = ctypes.c_longlong
+        P = ctypes.c_void_p
+        lib.rpf_open.restype = P
+        lib.rpf_open.argtypes = [ctypes.c_char_p, LL]
+        lib.rpf_next.restype = LL
+        lib.rpf_next.argtypes = [P, ctypes.c_char_p, LL]
+        lib.rpf_peek_size.restype = LL
+        lib.rpf_peek_size.argtypes = [P]
+        lib.rpf_reset.argtypes = [P]
+        lib.rpf_close.argtypes = [P]
+        lib._rpf_typed = True
     return lib

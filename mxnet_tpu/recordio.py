@@ -17,8 +17,8 @@ import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "MXRecordIOPrefetcher",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
 
 _MAGIC = 0xced7230a
 _LEN_MASK = (1 << 29) - 1
@@ -183,6 +183,70 @@ class MXRecordIO(object):
             if pad:
                 self.handle.read(pad)
         return out
+
+
+class MXRecordIOPrefetcher(object):
+    """Read-only sequential .rec reader with a native read-ahead thread.
+
+    The dmlc::ThreadedIter / PrefetcherIter analog (reference:
+    src/io/iter_prefetcher.h:47): a C++ producer thread
+    (mxnet_tpu/native/prefetch.cc) keeps a bounded ring of reassembled
+    records filled while Python decodes the previous ones, so disk reads
+    run off the GIL and overlap with augmentation. Same ``read()`` /
+    ``reset()`` surface as ``MXRecordIO`` opened for reading; raises
+    MXNetError at construction when the native toolchain is missing
+    (callers fall back to MXRecordIO).
+    """
+
+    def __init__(self, uri, capacity=8):
+        from . import native
+
+        self.uri = uri
+        self.capacity = capacity
+        self._lib = native.prefetch_lib()
+        if self._lib is None:
+            raise MXNetError("native prefetcher unavailable "
+                             "(no C++ toolchain)")
+        self.handle = self._lib.rpf_open(uri.encode(), capacity)
+        if not self.handle:
+            raise MXNetError("cannot open %s" % uri)
+
+    # picklable like MXRecordIO (workers receive iterators by pickle);
+    # the clone restarts from the beginning of the file
+    def __getstate__(self):
+        return {"uri": self.uri, "capacity": self.capacity}
+
+    def __setstate__(self, d):
+        self.__init__(d["uri"], d["capacity"])
+
+    def read(self):
+        """Next record's payload bytes; None at EOF."""
+        import ctypes
+
+        size = self._lib.rpf_peek_size(self.handle)
+        if size == -1:
+            return None
+        if size == -3:
+            raise MXNetError("corrupt RecordIO framing in %s" % self.uri)
+        buf = ctypes.create_string_buffer(max(int(size), 1))
+        got = self._lib.rpf_next(self.handle, buf, size)
+        if got != size:
+            raise MXNetError("prefetch read error in %s" % self.uri)
+        return buf.raw[:int(size)]
+
+    def reset(self):
+        self._lib.rpf_reset(self.handle)
+
+    def close(self):
+        if getattr(self, "handle", None):
+            self._lib.rpf_close(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -176,3 +176,150 @@ def test_prefetch_overlap(tmp_path):
     # 5 remaining batches at 0.05s each would cost 0.25s serially; with
     # prefetch ahead they must arrive much faster
     assert consumed < 0.15, consumed
+
+
+def test_native_prefetcher_matches_plain_reader(tmp_path):
+    """MXRecordIOPrefetcher (C++ read-ahead thread) returns byte-identical
+    records in order, resets, and reports EOF like MXRecordIO."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu import native
+
+    if native.prefetch_lib() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "pf.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    recs = [rng.bytes(rng.randint(1, 5000)) for _ in range(57)]
+    for r in recs:
+        w.write(r)
+    w.close()
+
+    pf = recordio.MXRecordIOPrefetcher(path, capacity=4)
+    got = []
+    while True:
+        r = pf.read()
+        if r is None:
+            break
+        got.append(r)
+    assert got == recs
+    # reset replays from the start
+    pf.reset()
+    assert pf.read() == recs[0]
+    pf.close()
+
+
+def test_image_iter_sequential_uses_prefetcher(tmp_path):
+    from mxnet_tpu import recordio, native
+    from mxnet_tpu.image import ImageIter
+
+    rec_path = str(tmp_path / "imgs.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(1)
+    for i in range(12):
+        img = (rng.rand(10, 10, 3) * 255).astype(np.uint8)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img,
+            img_fmt=".png"))
+    w.close()
+
+    it = ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                   path_imgrec=rec_path, rand_crop=True)
+    if native.prefetch_lib() is not None:
+        assert isinstance(it.imgrec, recordio.MXRecordIOPrefetcher)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 8, 8)
+        n += 1
+    assert n == 3
+    it.reset()
+    assert next(iter(it)).data[0].shape == (4, 3, 8, 8)
+
+
+def test_native_libsvm_parser_matches_python(tmp_path):
+    from mxnet_tpu import native
+    from mxnet_tpu.io import LibSVMIter
+
+    if native.libsvm_lib() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1.5 0:1.0 3:-2.5 7:0.125\n")
+        f.write("\n")                     # blank line skipped
+        f.write("-1,9 2:4\n")             # extra label values ignored
+        f.write("0\n")                    # empty row
+        f.write("2 1:0.5 5:1e-3 9:-7\n")
+    native_parsed = LibSVMIter._parse(path, 10)
+    # force the pure-python fallback for comparison
+    real = native.libsvm_lib
+    native.libsvm_lib = lambda: None
+    try:
+        py_parsed = LibSVMIter._parse(path, 10)
+    finally:
+        native.libsvm_lib = real
+    for a, b in zip(native_parsed, py_parsed):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    labels, indptr, indices, values = native_parsed
+    assert labels.tolist() == [1.5, -1.0, 0.0, 2.0]
+    assert indptr.tolist() == [0, 3, 4, 4, 7]
+    assert indices.tolist() == [0, 3, 7, 2, 1, 5, 9]
+
+
+def test_native_libsvm_parse_error_reported(tmp_path):
+    from mxnet_tpu import native
+
+    if native.libsvm_lib() is None:
+        pytest.skip("no native toolchain")
+    from mxnet_tpu.io import LibSVMIter
+
+    path = str(tmp_path / "bad.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.0\n")
+        f.write("2 3abc\n")
+    with pytest.raises(mx.MXNetError):
+        LibSVMIter._parse(path, 10)
+
+
+def test_prefetcher_pickles(tmp_path):
+    import pickle
+
+    from mxnet_tpu import native, recordio
+
+    if native.prefetch_lib() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "p.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"alpha")
+    w.write(b"beta")
+    w.close()
+    pf = recordio.MXRecordIOPrefetcher(path)
+    assert pf.read() == b"alpha"
+    clone = pickle.loads(pickle.dumps(pf))
+    # the clone restarts from the beginning (documented semantics)
+    assert clone.read() == b"alpha"
+    assert pf.read() == b"beta"
+    pf.close()
+    clone.close()
+
+
+def test_libsvm_fallback_error_contract(tmp_path):
+    """Parse errors raise MXNetError with the line number in BOTH the
+    native and the pure-python paths."""
+    from mxnet_tpu import native
+    from mxnet_tpu.io import LibSVMIter
+
+    path = str(tmp_path / "bad2.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.0\n2 3abc\n")
+    real = native.libsvm_lib
+    native.libsvm_lib = lambda: None
+    try:
+        with pytest.raises(mx.MXNetError, match=":2"):
+            LibSVMIter._parse(path, 10)
+    finally:
+        native.libsvm_lib = real
+    # negative index reports the negative value, not the max
+    path2 = str(tmp_path / "neg.libsvm")
+    with open(path2, "w") as f:
+        f.write("1 -2:3 5:1\n")
+    with pytest.raises(mx.MXNetError, match="-2"):
+        LibSVMIter._parse(path2, 10)

@@ -118,7 +118,7 @@ def test_libsvm_iter(tmp_path):
                           num_parts=2, part_index=0)
     p1 = mx.io.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2,
                           num_parts=2, part_index=1)
-    assert len(p0._rows) + len(p1._rows) == 5  # no dropped rows
+    assert p0._n_rows + p1._n_rows == 5  # no dropped rows
     # label file variant
     lpath = str(tmp_path / "lab.libsvm")
     with open(lpath, "w") as f:
