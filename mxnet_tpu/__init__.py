@@ -48,6 +48,7 @@ from . import module as mod
 from . import monitor
 from .monitor import Monitor
 from . import profiler
+from . import rtc
 from . import storage
 from . import visualization
 from . import visualization as viz

@@ -1,0 +1,161 @@
+"""mx.rtc — runtime Pallas kernel modules (reference:
+tests/python/gpu/test_rtc.py pattern over python/mxnet/rtc.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rtc_axpy():
+    # the reference's canonical rtc example, in Pallas
+    source = """
+def axpy(x_ref, y_ref, out_ref, *, alpha):
+    out_ref[...] = y_ref[...] + alpha * x_ref[...]
+"""
+    module = mx.rtc.PallasModule(source)
+    func = module.get_kernel(
+        "axpy", "const float32 *x, const float32 *y, float32 *out, "
+                "float32 alpha")
+    x = mx.nd.ones((10,))
+    y = mx.nd.full((10,), 2.0)
+    out = mx.nd.zeros((10,))
+    ret = func.launch([x, y, out, 3.0], mx.cpu(), (1, 1, 1))
+    np.testing.assert_allclose(out.asnumpy(), 5.0)
+    assert ret[0] is out
+
+
+def test_rtc_grid_program_id():
+    # per-program indexing over a pallas grid
+    source = """
+def fill_rows(out_ref):
+    i = pl.program_id(0)
+    out_ref[i, :] = jnp.full((4,), i, dtype=out_ref.dtype)
+"""
+    module = mx.rtc.PallasModule(source)
+    func = module.get_kernel("fill_rows", "float32 *out")
+    out = mx.nd.zeros((3, 4))
+    func.launch([out], mx.cpu(), (3, 1, 1))
+    np.testing.assert_allclose(
+        out.asnumpy(), np.arange(3, dtype=np.float32)[:, None]
+        * np.ones((1, 4), np.float32))
+
+
+def test_rtc_multiple_outputs_and_dtypes():
+    source = """
+def split_stats(x_ref, mean_ref, sq_ref):
+    mean_ref[...] = jnp.mean(x_ref[...], axis=1)
+    sq_ref[...] = x_ref[...] * x_ref[...]
+"""
+    module = mx.rtc.PallasModule(source)
+    func = module.get_kernel(
+        "split_stats",
+        "const float32 *x, float32 *mean, float32 *sq")
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 6).astype(np.float32)
+    x = mx.nd.array(xv)
+    mean = mx.nd.zeros((4,))
+    sq = mx.nd.zeros((4, 6))
+    func.launch([x, mean, sq], mx.cpu(), (1, 1, 1))
+    np.testing.assert_allclose(mean.asnumpy(), xv.mean(axis=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(sq.asnumpy(), xv * xv, rtol=1e-6)
+
+
+def test_rtc_exports_and_errors():
+    source = """
+def a(out_ref):
+    out_ref[...] = out_ref[...]
+
+def b(out_ref):
+    out_ref[...] = out_ref[...]
+"""
+    module = mx.rtc.PallasModule(source, exports=["a"])
+    with pytest.raises(mx.MXNetError):
+        module.get_kernel("b", "float32 *o")
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.PallasModule(source, exports=["missing"])
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.PallasModule("x = ][")           # syntax error
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.PallasModule("x = 1")            # no kernels
+    with pytest.raises(mx.MXNetError):
+        module.get_kernel("a", "qfloat *o")     # bad type word
+    func = module.get_kernel("a", "float32 *o")
+    with pytest.raises(mx.MXNetError):
+        func.launch([mx.nd.zeros((2,))], mx.cpu(), (1, 1, 1),
+                    shared_mem=16)              # CUDA-ism rejected
+    with pytest.raises(mx.MXNetError):
+        func.launch([], mx.cpu(), (1, 1, 1))    # arity mismatch
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_rtc_scalar_cast_and_inplace_semantics():
+    source = """
+def scale(x_ref, out_ref, *, k):
+    out_ref[...] = x_ref[...] * k
+"""
+    func = mx.rtc.PallasModule(source).get_kernel(
+        "scale", "const float32 *x, float32 *out, int32 k")
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = mx.nd.zeros((2, 3))
+    func.launch([x, out, 4.9], mx.cpu(), (1,))   # int param truncates
+    np.testing.assert_allclose(
+        out.asnumpy(), np.arange(6, dtype=np.float32).reshape(2, 3) * 4)
+
+
+def test_rtc_blockspec_module_spec():
+    """A `<kernel>_spec` dict in the source supplies pl.BlockSpec
+    blocking — the TPU-native replacement for CUDA block_dims."""
+    source = """
+def scale(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * 3.0
+
+scale_spec = dict(
+    in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+    out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)))
+"""
+    module = mx.rtc.PallasModule(source)
+    assert "scale_spec" not in module._fns
+    func = module.get_kernel("scale", "const float32 *x, float32 *out")
+    x = mx.nd.array(np.arange(32 * 128, dtype=np.float32)
+                    .reshape(32, 128))
+    out = mx.nd.zeros((32, 128))
+    func.launch([x, out], mx.cpu(), (4, 1, 1))
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 3.0)
+
+
+def test_rtc_inplace_accumulate():
+    """Output refs see the passed NDArray's CURRENT contents — the
+    reference's in-place launch semantics (y += alpha*x patterns)."""
+    source = """
+def accum(x_ref, y_ref, *, alpha):
+    y_ref[...] = y_ref[...] + alpha * x_ref[...]
+"""
+    func = mx.rtc.PallasModule(source).get_kernel(
+        "accum", "const float32 *x, float32 *y, float32 alpha")
+    x = mx.nd.ones((8,))
+    y = mx.nd.full((8,), 10.0)
+    func.launch([x, y, 3.0], mx.cpu(), (1, 1, 1))
+    np.testing.assert_allclose(y.asnumpy(), 13.0)
+    func.launch([x, y, 3.0], mx.cpu(), (1, 1, 1))  # cached call re-used
+    np.testing.assert_allclose(y.asnumpy(), 16.0)
+
+
+def test_rtc_launch_is_cached():
+    source = """
+def scale2(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * 2.0
+"""
+    func = mx.rtc.PallasModule(source).get_kernel(
+        "scale2", "const float32 *x, float32 *out")
+    x = mx.nd.ones((16,))
+    out = mx.nd.zeros((16,))
+    func.launch([x, out], mx.cpu(), (1,))
+    assert len(func._calls) == 1
+    func.launch([x, out], mx.cpu(), (1,))
+    assert len(func._calls) == 1      # same signature: cached
+    x2 = mx.nd.ones((32,))
+    out2 = mx.nd.zeros((32,))
+    func.launch([x2, out2], mx.cpu(), (1,))
+    assert len(func._calls) == 2      # new shape: new entry
