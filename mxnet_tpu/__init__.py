@@ -50,6 +50,14 @@ from .monitor import Monitor
 from . import profiler
 from . import rtc
 from . import storage
+from . import attribute
+from . import name
+from . import log
+from . import libinfo
+from . import engine
+from . import executor_manager
+from . import registry
+from . import contrib
 from . import visualization
 from . import visualization as viz
 from . import parallel
@@ -62,3 +70,18 @@ from .operator import _install_frontends as _iff
 
 _iff()
 del _iff
+
+
+def __getattr__(attr):
+    # kvstore_server is importable as mx.kvstore_server (reference module
+    # layout) but loads lazily: an eager import would trip runpy's
+    # double-import warning when the server role runs as
+    # `python -m mxnet_tpu.kvstore_server` (tools/launch.py -s)
+    if attr == "kvstore_server":
+        import importlib
+
+        mod = importlib.import_module(__name__ + ".kvstore_server")
+        globals()[attr] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, attr))

@@ -1,0 +1,7 @@
+"""Attribute scoping (reference: python/mxnet/attribute.py — AttrScope
+carries ctx_group/lr_mult/etc. onto symbols created inside the scope).
+The implementation lives in base.py; this module preserves the
+reference's import location ``mx.attribute.AttrScope``."""
+from .base import AttrScope
+
+__all__ = ["AttrScope"]

@@ -1,0 +1,98 @@
+"""The pre-1.0 experimental autograd API (reference:
+python/mxnet/contrib/autograd.py — kept so old user code keeps running;
+the modern surface is ``mx.autograd``). Everything delegates to the
+current tape."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set training mode globally; returns the previous mode
+    (reference: contrib/autograd.py:32 — the old API coupled recording
+    and training into one flag)."""
+    prev_t = _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev_t
+
+
+class TrainingStateScope(object):
+    """(reference: contrib/autograd.py:54)"""
+
+    def __init__(self, enter_state):
+        self._enter_state = enter_state
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_is_training(self._enter_state)
+
+    def __exit__(self, ptype, value, trace):
+        set_is_training(self._prev)
+
+
+def train_section():
+    """Scope with training (and recording) on (reference:
+    contrib/autograd.py:74)."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """Scope with training off (reference: contrib/autograd.py:88)."""
+    return TrainingStateScope(False)
+
+
+mark_variables = _ag.mark_variables
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """(reference: contrib/autograd.py:123)"""
+    return _ag.backward(outputs, head_grads=out_grads,
+                        retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """(reference: contrib/autograd.py:158)"""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Wrap ``func`` to return (gradients, outputs)
+    (reference: contrib/autograd.py:163)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        from ..ndarray import NDArray, zeros_like
+
+        argnums = ([argnum] if isinstance(argnum, int)
+                   else list(argnum) if argnum is not None
+                   else list(range(len(args))))
+        variables = [args[i] for i in argnums]
+        for x in variables:
+            assert isinstance(x, NDArray), \
+                "type of autograd input should be NDArray"
+        grads = [zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+            backward([outputs] if isinstance(outputs, NDArray)
+                     else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Wrap ``func`` to return only gradients
+    (reference: contrib/autograd.py:195)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

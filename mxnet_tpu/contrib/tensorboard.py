@@ -1,0 +1,41 @@
+"""TensorBoard metric logging (reference:
+python/mxnet/contrib/tensorboard.py — LogMetricsCallback writing eval
+metrics as scalars per batch)."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback pushing eval metrics to TensorBoard
+    (reference: contrib/tensorboard.py:25). Pass either a logging
+    directory (requires a tensorboard ``SummaryWriter`` implementation
+    to be importable) or a ready writer object exposing
+    ``add_scalar(name, value, global_step)``."""
+
+    def __init__(self, logging_dir=None, prefix=None, summary_writer=None):
+        self.prefix = prefix
+        self.step = 0
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter
+            except ImportError:
+                raise ImportError(
+                    "LogMetricsCallback needs a SummaryWriter: install "
+                    "tensorboard/tensorboardX, or pass summary_writer=")
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """(reference: contrib/tensorboard.py __call__)"""
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

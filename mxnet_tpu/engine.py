@@ -1,0 +1,40 @@
+"""Engine control facade (reference: python/mxnet/engine.py over
+MXEngineSetBulkSize — bundling many small ops into one engine push,
+src/engine/threaded_engine.h BulkStatus).
+
+On TPU the dependency engine is XLA: a jitted graph IS one fused
+"bulk", and eager ops already compile per (op, attrs) with async
+dispatch, so there is nothing to bundle by hand. The API is kept so
+reference code runs; the size is recorded and visible but does not
+change execution."""
+from __future__ import annotations
+
+__all__ = ["set_bulk_size", "bulk"]
+
+_bulk_size = 15  # the reference's MXNET_ENGINE_BULK_SIZE default
+
+
+def set_bulk_size(size):
+    """Set (and return the previous) bulk size. Advisory on TPU — XLA
+    fusion plays the bulking role (reference: engine.py:26)."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+class _BulkScope(object):
+    def __init__(self, size):
+        self._size = size
+        self._old = None
+
+    def __enter__(self):
+        self._old = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        set_bulk_size(self._old)
+
+
+def bulk(size):
+    """Scope form of :func:`set_bulk_size` (reference: engine.py:63)."""
+    return _BulkScope(size)

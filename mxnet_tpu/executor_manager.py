@@ -1,0 +1,8 @@
+"""Executor-manager helpers (reference: python/mxnet/executor_manager.py
+— the legacy FeedForward-era device management; Module's
+DataParallelExecutorGroup superseded it, but `_split_input_slice` is the
+canonical workload-weighted batch splitter both use, reference
+executor_manager.py:31)."""
+from .module.executor_group import _split_input_slice
+
+__all__ = ["_split_input_slice"]

@@ -1,0 +1,15 @@
+"""Library metadata (reference: python/mxnet/libinfo.py:64 — locates
+libmxnet.so and declares __version__). Here the "library" is the set of
+on-demand-compiled native components under mxnet_tpu/native/."""
+import os
+
+__version__ = "1.0.0"
+
+
+def find_lib_path():
+    """Paths of the built native components (the libmxnet.so analog);
+    empty when the toolchain has not built anything yet."""
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")
+    return sorted(os.path.join(here, f) for f in os.listdir(here)
+                  if f.endswith(".so"))

@@ -1,0 +1,7 @@
+"""Automatic symbol naming (reference: python/mxnet/name.py —
+NameManager assigns `op0`, `op1`, ... and Prefix prepends a scope
+prefix). The implementation lives in base.py; this module preserves the
+reference's import location ``mx.name.NameManager``."""
+from .base import NameManager, Prefix
+
+__all__ = ["NameManager", "Prefix"]

@@ -1,0 +1,106 @@
+"""Generic class registry factories (reference: python/mxnet/registry.py
+— the machinery behind Optimizer.register/create-from-config, also
+usable for user class hierarchies). Supports creating instances from a
+name, a config dict, or a JSON string, matching the reference grammar:
+for a factory with nickname ``thing``, ``'{"thing": "gadget", ...}'``
+or ``'["gadget", {...}]'``."""
+from __future__ import annotations
+
+import json
+import logging
+
+from .base import MXNetError
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRY = {}
+
+
+def get_register_func(base_class, nickname):
+    """A ``register(klass, name=None)`` decorator factory for
+    ``base_class`` (reference: registry.py:32)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "Can only register subclass of %s" % base_class.__name__
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in registry:
+            logging.warning(
+                "Registering %s %s overrides the existing %s",
+                nickname, name, registry[name].__name__)
+        registry[name] = klass
+        return klass
+
+    register.__doc__ = ("Register %s to the %s factory"
+                        % (nickname, base_class.__name__))
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """An ``alias(*names)`` decorator factory (reference:
+    registry.py:70)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A ``create(name_or_config, **kwargs)`` factory (reference:
+    registry.py:97): accepts an instance (returned as-is), a registered
+    name, a config dict, or a JSON string."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise MXNetError(
+                    "%s is already an instance; additional arguments are "
+                    "invalid" % nickname)
+            return name
+        if isinstance(name, dict):
+            if args or kwargs:
+                raise MXNetError(
+                    "a dict config carries all arguments; extra "
+                    "args/kwargs are invalid")
+            return create(**name)
+        if not isinstance(name, str):
+            raise MXNetError("%s must be a string, dict, or %s instance"
+                             % (nickname, base_class.__name__))
+        if name.startswith("["):
+            if args or kwargs:
+                raise MXNetError("JSON config takes no extra arguments")
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            if args or kwargs:
+                raise MXNetError("JSON config takes no extra arguments")
+            return create(**json.loads(name))
+        name = name.lower()
+        if name not in registry:
+            raise MXNetError(
+                "%s is not registered; register with %s.register first"
+                % (name, nickname))
+        return registry[name](*args, **kwargs)
+
+    create.__doc__ = ("Create a %s instance from a name, config dict, or "
+                      "JSON string" % nickname)
+    return create

@@ -52,10 +52,24 @@ def launch_servers(num_servers, platform="cpu"):
                 [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
             procs.append(p)
-            # the server prints its bound address first (port 0 = ephemeral)
-            line = p.stdout.readline().decode().strip()
-            if not line.startswith("MXTPU_PS_ADDR="):
-                raise RuntimeError("server failed to start: %r" % line)
+            # the server prints its bound address (port 0 = ephemeral);
+            # tolerate a few interpreter warning lines before it
+            consumed = []
+            for _ in range(20):
+                raw = p.stdout.readline()
+                if not raw:  # EOF: the server process died
+                    raise RuntimeError(
+                        "server exited before printing its address; "
+                        "output:\n%s" % "".join(consumed))
+                line = raw.decode()
+                if line.strip().startswith("MXTPU_PS_ADDR="):
+                    line = line.strip()
+                    break
+                consumed.append(line)
+            else:
+                raise RuntimeError(
+                    "server failed to start: no address line printed; "
+                    "output:\n%s" % "".join(consumed))
             addrs.append(line.split("=", 1)[1])
     except Exception:
         for p in procs:
