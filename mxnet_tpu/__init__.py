@@ -71,6 +71,12 @@ from .operator import _install_frontends as _iff
 _iff()
 del _iff
 
+from .fluent import install as _install_fluent  # noqa: E402
+from .fluent import NotImplementedForSymbol  # noqa: E402,F401
+
+_install_fluent()
+del _install_fluent
+
 
 def __getattr__(attr):
     # kvstore_server is importable as mx.kvstore_server (reference module

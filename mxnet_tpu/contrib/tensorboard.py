@@ -32,9 +32,9 @@ class LogMetricsCallback(object):
 
     def __call__(self, param):
         """(reference: contrib/tensorboard.py __call__)"""
-        self.step += 1
         if param.eval_metric is None:
             return
+        self.step += 1
         for name, value in param.eval_metric.get_name_value():
             if self.prefix is not None:
                 name = "%s-%s" % (self.prefix, name)

@@ -286,6 +286,44 @@ class Symbol:
                 out[node.name] = d
         return out
 
+    def list_attr(self, recursive=False):
+        """This symbol's own attributes (reference: symbol.py list_attr;
+        recursive=True was deprecated there in favor of attr_dict)."""
+        if recursive:
+            raise DeprecationWarning(
+                "Symbol.list_attr with recursive=True has been "
+                "deprecated. Please use attr_dict instead.")
+        if len(self._outputs) != 1:
+            return {}
+        node = self._outputs[0][0]
+        d = dict(node.attrs)
+        d.update(node.user_attrs)
+        return {k: str(v) for k, v in d.items()}
+
+    def debug_str(self):
+        """Printable graph description (reference: symbol.py debug_str /
+        MXSymbolPrint): outputs, then every node in topological order
+        with its op and inputs."""
+        lines = ["Symbol Outputs:"]
+        for i, (node, idx) in enumerate(self._outputs):
+            lines.append("\toutput[%d]=%s(%d)" % (i, node.name, idx))
+        for node in self.topo_nodes():
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+                continue
+            lines.append("--------------------")
+            lines.append("Op:%s, Name=%s" % (node.op, node.name))
+            lines.append("Inputs:")
+            for j, (inp, iidx) in enumerate(node.inputs):
+                lines.append("\targ[%d]=%s(%d)" % (j, inp.name, iidx))
+            merged = dict(node.attrs)
+            merged.update(node.user_attrs)  # ctx_group/lr_mult visible too
+            if merged:
+                lines.append("Attrs:")
+                for k in sorted(merged):
+                    lines.append("\t%s=%s" % (k, merged[k]))
+        return "\n".join(lines) + "\n"
+
     def _set_attr(self, **kwargs):
         for node, _ in self._outputs:
             node.user_attrs.update({k: str(v) for k, v in kwargs.items()})

@@ -119,3 +119,50 @@ def test_libinfo_find_lib_path():
 def test_executor_manager_split():
     slices = mx.executor_manager._split_input_slice(10, [1, 1])
     assert [s.stop - s.start for s in slices] == [5, 5]
+
+
+def test_fluent_methods_ndarray():
+    """Fluent convenience methods delegate to the registry functions
+    (reference: ndarray.py per-op fluent defs)."""
+    x = mx.nd.array(np.array([[1.0, 4.0], [9.0, 16.0]], np.float32))
+    np.testing.assert_allclose(x.sqrt().asnumpy(),
+                               np.sqrt(x.asnumpy()))
+    np.testing.assert_allclose(x.sum(axis=1).asnumpy(),
+                               x.asnumpy().sum(axis=1))
+    np.testing.assert_allclose(x.transpose().asnumpy(), x.asnumpy().T)
+    np.testing.assert_allclose(
+        x.clip(a_min=2.0, a_max=10.0).asnumpy(),
+        np.clip(x.asnumpy(), 2, 10))
+    assert x.topk(k=1).shape == (2, 1)
+    assert x.expand_dims(axis=0).shape == (1, 2, 2)
+    # tostype routes through the storage-aware cast
+    rsp = x.tostype("row_sparse")
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    assert isinstance(rsp, RowSparseNDArray)
+    np.testing.assert_allclose(rsp.asnumpy(), x.asnumpy())
+
+
+def test_fluent_methods_symbol_and_stubs():
+    a = mx.sym.Variable("a")
+    y = a.exp().sum(axis=0)
+    ex = y.simple_bind(mx.cpu(), a=(3,))
+    ex.arg_dict["a"][:] = mx.nd.array(np.array([0.0, 1.0, 2.0],
+                                               np.float32))
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, np.exp([0, 1, 2]).sum(), rtol=1e-6)
+    with pytest.raises(mx.NotImplementedForSymbol):
+        a.asnumpy()
+    with pytest.raises(mx.NotImplementedForSymbol):
+        a.wait_to_read()
+
+
+def test_symbol_list_attr_and_debug_str():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    attrs = net.list_attr()
+    assert attrs["num_hidden"] == "3"
+    with pytest.raises(DeprecationWarning):
+        net.list_attr(recursive=True)
+    s = net.debug_str()
+    assert "Op:FullyConnected, Name=fc" in s
+    assert "Variable:data" in s and "arg[1]=fc_weight(0)" in s

@@ -38,6 +38,11 @@ def clean_env(base=None):
     return env
 
 
+def _drain(stream):
+    for _ in iter(stream.readline, b""):
+        pass
+
+
 def launch_servers(num_servers, platform="cpu"):
     """Spawn parameter-server processes for dist_async (reference: the
     tracker's server role, DMLC_ROLE=server). Returns (procs, addr_csv) —
@@ -71,6 +76,12 @@ def launch_servers(num_servers, platform="cpu"):
                     "server failed to start: no address line printed; "
                     "output:\n%s" % "".join(consumed))
             addrs.append(line.split("=", 1)[1])
+            # keep draining the pipe: a chatty server would otherwise
+            # block on a full pipe buffer and stop serving
+            import threading
+
+            threading.Thread(target=_drain, args=(p.stdout,),
+                             daemon=True).start()
     except Exception:
         for p in procs:
             p.kill()
