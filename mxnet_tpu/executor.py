@@ -316,6 +316,11 @@ class Executor:
             return self._forward_model_parallel(is_train, arg_d, aux_d,
                                                 rngs)
 
+        from . import profiler as _profiler
+
+        profiled = _profiler.symbolic_active()
+        t0 = _profiler._now_us() if profiled else 0
+
         if not is_train:
             outs = self._prog.infer_fn()(arg_d, aux_d, rngs)
             self._stashed_grads = None
@@ -334,6 +339,15 @@ class Executor:
             for n, nv in aux_upd.items():
                 self.aux_dict[n]._set_data(nv)
             self._stashed_grads = grads
+        if profiled:
+            # one event per compiled-program run — the engine-op analog
+            # (a whole graph is ONE engine push here, SURVEY.md §7.1)
+            import jax
+
+            jax.block_until_ready(outs)
+            _profiler.record(
+                "forward_backward" if is_train else "forward",
+                "executor", t0, _profiler._now_us() - t0)
         self.outputs = [_from_data(o) for o in outs]
         return self.outputs
 

@@ -50,7 +50,7 @@ _FLUENT = [
 
 # NDArray-only surface stubbed on Symbol (reference symbol.py:2335)
 _ND_ONLY = ["wait_to_read", "asnumpy", "asscalar", "copy",
-            "as_in_context", "detach", "backward", "astype", "gradient"]
+            "as_in_context", "detach", "backward", "gradient"]
 
 
 def _make_fluent(ns, name):
@@ -83,6 +83,13 @@ def install():
             setattr(NDArray, name, _make_fluent(nd_ns, name))
         if not hasattr(Symbol, name) and hasattr(sym_ns, name):
             setattr(Symbol, name, _make_fluent(sym_ns, name))
+    if not hasattr(Symbol, "astype"):
+        def astype(self, dtype):
+            """Insert a Cast (the reference Symbol.astype delegates to
+            the Cast op)."""
+            return sym_ns.Cast(self, dtype=dtype)
+
+        Symbol.astype = astype
     if not hasattr(NDArray, "tostype"):
         def tostype(self, stype):
             """Storage-type cast (reference: ndarray.py tostype —

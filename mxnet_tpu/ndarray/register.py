@@ -84,6 +84,26 @@ def record_apply(f, inputs, name="fn"):
 
 def invoke(opdef, args, kwargs):
     """Invoke one registered op imperatively (Imperative::Invoke analog)."""
+    from .. import profiler as _profiler
+
+    if _profiler.imperative_active():
+        # profiled path: run synchronously and record a chrome-trace
+        # event per op (the reference measures inside the engine worker,
+        # src/engine/profiler.cc SetOprStart/SetOprEnd)
+        import jax
+
+        t0 = _profiler._now_us()
+        res = _invoke_impl(opdef, args, kwargs)
+        jax.block_until_ready(
+            [r._data for r in
+             (res if isinstance(res, (list, tuple)) else [res])])
+        _profiler.record(opdef.name, "operator", t0,
+                         _profiler._now_us() - t0)
+        return res
+    return _invoke_impl(opdef, args, kwargs)
+
+
+def _invoke_impl(opdef, args, kwargs):
     from .. import autograd
     from .. import random as _random
 

@@ -1,44 +1,119 @@
-"""Profiler facade (reference: python/mxnet/profiler.py:27-55,
-src/engine/profiler.cc).
+"""Profiler (reference: python/mxnet/profiler.py:27-55,
+src/engine/profiler.cc — per-op chrome://tracing JSON).
 
-The reference's engine profiler emits chrome://tracing JSON per engine op;
-the TPU analog is the JAX/XLA profiler (XPlane → TensorBoard / perfetto
-trace). The mx.profiler API is kept: set_config(filename) + set_state
-('run'/'stop') wraps jax.profiler.start_trace/stop_trace; dump_profile stops
-and flushes the trace directory."""
+Two complementary layers here:
+
+1. **Framework events** — when profiling runs, the eager op dispatcher
+   and the graph executor record per-op / per-program events with host
+   timestamps and write the reference's chrome://tracing JSON format on
+   ``dump_profile()`` (load it in chrome://tracing or Perfetto). Mode
+   'symbolic' records only whole-program executor runs (the engine-op
+   analog); 'imperative' only eager ops; 'all' records both. While
+   profiling, eager ops run synchronously (block_until_ready) so
+   durations mean compute, not dispatch — the reference's profiler
+   measures inside the engine worker the same way.
+2. **XLA device trace** — set_state('run') also starts the JAX/XLA
+   profiler (XPlane → TensorBoard/Perfetto) in ``<filename>_trace/``
+   for kernel-level device timing.
+"""
 from __future__ import annotations
 
+import json
 import os
+import threading
+import time
 
-__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile"]
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "pause", "resume"]
 
-_state = {"mode": "symbolic", "filename": "profile.json", "running": False}
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "paused": False}
+_events = []
+_lock = threading.Lock()
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def imperative_active():
+    return (_state["running"] and not _state["paused"]
+            and _state["mode"] in ("imperative", "all"))
+
+
+def symbolic_active():
+    return (_state["running"] and not _state["paused"]
+            and _state["mode"] in ("symbolic", "all"))
+
+
+def record(name, cat, ts_us, dur_us):
+    """Append one complete ('ph':'X') event."""
+    with _lock:
+        _events.append({"name": name, "cat": cat, "ph": "X",
+                        "ts": ts_us, "dur": dur_us,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % (1 << 20)})
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
-    """(reference: profiler.py:profiler_set_config)"""
+    """(reference: profiler.py:profiler_set_config); mode is 'symbolic',
+    'imperative', or 'all'."""
+    if mode not in ("symbolic", "imperative", "all"):
+        raise ValueError("mode must be symbolic/imperative/all, got %r"
+                         % (mode,))
     _state["mode"] = mode
     _state["filename"] = filename
 
 
 def profiler_set_state(state="stop"):
-    """(reference: profiler.py:profiler_set_state); 'run' starts a JAX trace,
-    'stop' ends it."""
+    """(reference: profiler.py:profiler_set_state); 'run' starts
+    recording (+ a JAX device trace), 'stop' ends it."""
     import jax
 
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop', got %r"
+                         % (state,))
     if state == "run" and not _state["running"]:
         trace_dir = os.path.splitext(_state["filename"])[0] + "_trace"
-        jax.profiler.start_trace(trace_dir)
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _state["trace_dir"] = trace_dir
+        except Exception:  # device trace is best-effort (tunnel backends)
+            _state["trace_dir"] = None
         _state["running"] = True
-        _state["trace_dir"] = trace_dir
+        _state["paused"] = False
     elif state == "stop" and _state["running"]:
-        jax.profiler.stop_trace()
+        if _state.get("trace_dir"):
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         _state["running"] = False
 
 
+def pause():
+    """Suspend event recording without ending the session
+    (reference: profiler.py pause)."""
+    _state["paused"] = True
+
+
+def resume():
+    """(reference: profiler.py resume)"""
+    _state["paused"] = False
+
+
 def dump_profile():
-    """(reference: profiler.py:dump_profile)"""
+    """Stop profiling and write the chrome://tracing JSON
+    (reference: profiler.py:dump_profile → DumpProfile,
+    src/engine/profiler.h:107)."""
     profiler_set_state("stop")
+    with _lock:
+        events, _events[:] = list(_events), []
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(_state["filename"], "w") as f:
+        json.dump(payload, f)
+    return _state["filename"]
 
 
 # aliased modern names

@@ -293,9 +293,10 @@ class Symbol:
             raise DeprecationWarning(
                 "Symbol.list_attr with recursive=True has been "
                 "deprecated. Please use attr_dict instead.")
-        if len(self._outputs) != 1:
+        nodes = {id(n): n for n, _ in self._outputs}
+        if len(nodes) != 1:   # grouped symbols have no single attr set
             return {}
-        node = self._outputs[0][0]
+        node = next(iter(nodes.values()))
         d = dict(node.attrs)
         d.update(node.user_attrs)
         return {k: str(v) for k, v in d.items()}

@@ -166,3 +166,49 @@ def test_symbol_list_attr_and_debug_str():
     s = net.debug_str()
     assert "Op:FullyConnected, Name=fc" in s
     assert "Variable:data" in s and "arg[1]=fc_weight(0)" in s
+
+
+def test_profiler_chrome_trace(tmp_path):
+    """mx.profiler writes the reference's chrome://tracing JSON with
+    per-op (imperative) and per-program (symbolic) events
+    (reference: src/engine/profiler.h:107 DumpProfile)."""
+    import json
+
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.set_config(mode="all", filename=fname)
+    mx.profiler.set_state("run")
+    x = mx.nd.ones((4, 4))
+    y = (x * 2).exp()
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    import numpy as _np
+    for k, v in ex.arg_dict.items():
+        v[:] = mx.nd.array(_np.ones(v.shape, _np.float32))
+    ex.forward(is_train=True)
+    mx.profiler.pause()
+    _ = x + 1          # not recorded while paused
+    mx.profiler.resume()
+    out = mx.profiler.dump_profile()
+    assert out == fname
+    data = json.load(open(fname))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "exp" in names                       # imperative op event
+    assert "forward_backward" in names          # executor program event
+    for e in data["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+    # stopped: no further recording
+    z = x * 3  # noqa: F841
+    assert not mx.profiler.imperative_active()
+
+
+def test_symbol_astype_and_multi_output_list_attr():
+    a = mx.sym.Variable("a")
+    c = a.astype("float16")
+    ex = c.simple_bind(mx.cpu(), a=(2,))
+    ex.arg_dict["a"][:] = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    assert str(ex.forward()[0].dtype) == "float16"
+    s = mx.sym.split(mx.sym.Variable("d"), num_outputs=2)
+    assert s.list_attr()["num_outputs"] == "2"
+    with pytest.raises(ValueError):
+        mx.profiler.set_state("start")
