@@ -276,6 +276,94 @@ class ShardedTrainer:
         return ({"params": params, "aux": aux, "opt": opt,
                  "step": state["step"] + 1}, outs)
 
+    # --- checkpoint / resume ------------------------------------------------
+    def save_checkpoint(self, state, prefix, epoch=0):
+        """Write ``prefix-symbol.json`` + ``prefix-%04d.params`` (the
+        Module checkpoint pair, reference model.py:366) plus
+        ``prefix-%04d.opt.npz`` holding optimizer state and step count, so
+        sharded training resumes exactly. Multi-host: process 0 writes
+        (replicated state is identical everywhere) to a SHARED
+        filesystem, then all processes fence before anyone loads."""
+        import jax
+
+        if jax.process_index() == 0:
+            self._write_checkpoint(state, prefix, epoch)
+        if jax.process_count() > 1:
+            # writers and readers need a fence: non-zero processes must
+            # not load a half-written checkpoint (requires a SHARED
+            # filesystem across hosts, e.g. GCS/NFS — per-host local
+            # disk cannot work with a single writer)
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                "sharded_ckpt_%s_%d" % (prefix, epoch))
+
+    def _write_checkpoint(self, state, prefix, epoch):
+        from .. import ndarray as nd
+
+        self.symbol.save("%s-symbol.json" % prefix)
+        save_dict = {}
+        for k, v in state["params"].items():
+            # bf16 round-trips exactly through fp32
+            save_dict["arg:%s" % k] = nd.array(
+                np.asarray(v, dtype=np.float32))
+        for k, v in state["aux"].items():
+            save_dict["aux:%s" % k] = nd.array(np.asarray(v))
+        nd.save("%s-%04d.params" % (prefix, epoch), save_dict)
+        opt_np = {"step": np.int64(state["step"]),
+                  "rescale_grad": np.float64(
+                      self._opt_defaults.get("rescale_grad", 1.0))}
+        for name, states in state["opt"].items():
+            for i, s in enumerate(states):
+                opt_np["%s/%d" % (name, i)] = np.asarray(s)
+        np.savez("%s-%04d.opt.npz" % (prefix, epoch), **opt_np)
+
+    def load_checkpoint(self, prefix, epoch=0):
+        """Rebuild the training state dict from a checkpoint; every
+        process loads and re-places onto its mesh (replicated), so the
+        resumed run is bit-identical to an uninterrupted one."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import ndarray as nd
+
+        loaded = nd.load("%s-%04d.params" % (prefix, epoch))
+        params, aux = {}, {}
+        for k, v in loaded.items():
+            tag, name = k.split(":", 1)
+            if tag == "arg":
+                params[name] = jax.device_put(
+                    jnp.asarray(v.asnumpy(), dtype=self.dtype),
+                    self._rep_sharding)
+            else:
+                aux[name] = jax.device_put(jnp.asarray(v.asnumpy()),
+                                           self._rep_sharding)
+        missing = set(self.param_names) - set(params)
+        if missing:
+            raise MXNetError("checkpoint %r is missing parameters: %s"
+                             % (prefix, sorted(missing)))
+        with np.load("%s-%04d.opt.npz" % (prefix, epoch)) as z:
+            step = int(z["step"])
+            if not self._user_rescale and "rescale_grad" in z:
+                # init() derives this from the batch size; a resumed
+                # trainer must apply the same scale without init(). The
+                # compiled step baked the old value in at trace time, so
+                # drop any compiled functions when it changes
+                new_scale = float(z["rescale_grad"])
+                if self._opt_defaults.get("rescale_grad") != new_scale:
+                    self._opt_defaults["rescale_grad"] = new_scale
+                    self._step_fn = None
+                    if hasattr(self, "_multi_fns"):
+                        self._multi_fns.clear()
+            opt_state = {}
+            for name in self.param_names:
+                opt_state[name] = tuple(
+                    jax.device_put(jnp.asarray(z["%s/%d" % (name, i)]),
+                                   self._rep_sharding)
+                    for i in range(len(self._opt_state_names)))
+        return {"params": params, "aux": aux, "opt": opt_state,
+                "step": step}
+
     # --- inference ----------------------------------------------------------
     def forward_fn(self):
         """Compiled inference forward over the mesh (batch-sharded)."""

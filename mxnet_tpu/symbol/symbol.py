@@ -272,8 +272,9 @@ class Symbol:
 
     # --- attrs -------------------------------------------------------------
     def attr(self, key):
-        if len(self._outputs) == 1:
-            node = self._outputs[0][0]
+        nodes = {id(n): n for n, _ in self._outputs}
+        if len(nodes) == 1:  # incl. multi-output single-node (split...)
+            node = next(iter(nodes.values()))
             return node.user_attrs.get(key, node.attrs.get(key))
         return None
 

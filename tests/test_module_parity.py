@@ -212,3 +212,8 @@ def test_symbol_astype_and_multi_output_list_attr():
     assert s.list_attr()["num_outputs"] == "2"
     with pytest.raises(ValueError):
         mx.profiler.set_state("start")
+
+
+def test_symbol_attr_multi_output_single_node():
+    s = mx.sym.split(mx.sym.Variable("d"), num_outputs=2)
+    assert s.attr("num_outputs") == "2"
