@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""CPU-vs-accelerator consistency sweep over the op registry.
+
+Reference pattern: tests/python/gpu/test_operator_gpu.py:25 re-runs the
+whole CPU unit suite on device, and check_consistency
+(python/mxnet/test_utils.py:1203) executes one graph per context and
+compares. Here the op-sweep case table (tests/test_op_sweep.py) runs on
+the host CPU backend and on the attached accelerator; outputs must agree
+within per-dtype tolerances.
+
+Run on a TPU machine:  python tools/check_device_consistency.py
+Prints one line per mismatch and a summary; exit code 1 on any failure.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "tests"))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.ops.registry import get_op
+
+    from test_op_sweep import _CASES  # noqa: E402 (the case table)
+
+    cpu_dev = jax.devices("cpu")[0]
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    if not accel:
+        print("no accelerator attached; nothing to compare")
+        return 0
+    dev = accel[0]
+    print("comparing cpu(%s) vs %s over %d op cases"
+          % (cpu_dev.device_kind, dev, len(_CASES)))
+
+    # matmul ops run on the MXU whose default precision passes bf16
+    # operands (jax default_matmul_precision); the reference's
+    # check_consistency applies the same per-dtype loosening (fp16 tol
+    # 1e-1, test_utils.py:1203). Only dot/batch_dot appear in the sweep
+    # table — FullyConnected/linalg_* live in dedicated test files.
+    MATMUL_TOL = {"dot", "batch_dot"}
+
+    failures = []
+    checked = skipped = 0
+    for name, kind, inputs, params, grad, ref in _CASES:
+        try:
+            opdef = get_op(name)
+            attrs = opdef.parse_attrs(
+                {k: str(v) for k, v in params.items()})
+            if opdef.needs_rng:
+                skipped += 1  # sampling ops: distribution tests cover
+                continue
+            ins32 = [np.asarray(a, np.float32) for a in inputs]
+            outs = {}
+            for tag, device in (("cpu", cpu_dev), ("accel", dev)):
+                placed = tuple(jax.device_put(a, device) for a in ins32)
+                o, _ = opdef.apply(attrs, placed, (), is_train=False)
+                outs[tag] = [np.asarray(x, np.float64) for x in o]
+            for i, (a, b) in enumerate(zip(outs["cpu"], outs["accel"])):
+                rtol, atol = ((1e-2, 5e-3) if name in MATMUL_TOL
+                              else (1e-3, 1e-4))
+                if not np.allclose(a, b, rtol=rtol, atol=atol,
+                                   equal_nan=True):
+                    bad = np.abs(a - b).max()
+                    failures.append((name, i, float(bad)))
+                    print("MISMATCH %-28s out[%d] max|diff|=%.3e"
+                          % (name, i, bad))
+        except Exception as e:  # surface per-op execution failures
+            failures.append((name, -1, str(e)))
+            print("ERROR    %-28s %s: %s" % (name, type(e).__name__,
+                                             str(e)[:100]))
+        finally:
+            checked += 1
+    checked -= skipped
+    print("checked %d cases (%d rng-skipped), %d failures"
+          % (checked, skipped, len(failures)))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
