@@ -16,6 +16,7 @@ _EXAMPLES = [
     "examples/model_parallel_lstm/model_parallel_lstm.py",
     "examples/sparse/linear_classification.py",
     "examples/gluon/mnist_gluon.py",
+    "examples/transformer/train_lm.py",
 ]
 
 
@@ -33,7 +34,7 @@ def test_example_smoke(script):
     flags = env.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (flags +
-                            " --xla_force_host_platform_device_count=2"
+                            " --xla_force_host_platform_device_count=8"
                             ).strip()
     res = subprocess.run(
         [sys.executable, os.path.join(_REPO, script), "--smoke"],
