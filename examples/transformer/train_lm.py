@@ -34,7 +34,8 @@ def main():
     p.add_argument("--steps", type=int, default=120)
     p.add_argument("--vocab", type=int, default=32)
     p.add_argument("--mesh", default="dp2,tp2,sp2",
-                   help="comma list of axis=size, e.g. dp2,tp2,sp2,ep1")
+                   help="comma list of axis sizes, 'dp2,tp2,sp2' or "
+                        "'dp=2,tp=2,sp=2'")
     p.add_argument("--smoke", action="store_true")
     args = p.parse_args()
     if args.smoke:
@@ -47,8 +48,15 @@ def main():
 
     axes = {}
     for part in args.mesh.split(","):
-        name = part.rstrip("0123456789")
-        axes[name] = int(part[len(name):])
+        if "=" in part:
+            name, _, size = part.partition("=")
+        else:
+            name = part.rstrip("0123456789")
+            size = part[len(name):]
+        if not name or not size.isdigit():
+            raise SystemExit("bad --mesh entry %r (want e.g. dp2 or dp=2)"
+                             % part)
+        axes[name] = int(size)
     n_dev = int(np.prod(list(axes.values())))
     devices = jax.devices()
     if len(devices) < n_dev:

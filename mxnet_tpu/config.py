@@ -21,6 +21,12 @@ Flags currently honored:
     so activations are rematerialized in backward, trading FLOPs for
     HBM footprint.
 
+``MXNET_POOLING_MASK_BWD`` (default 0)
+    Max-pool backward as fused strided tie-splitting masks instead of
+    XLA's SelectAndScatter (ops/nn.py _maxpool_mask_bwd). Measured ~14%
+    slower for ResNet-50 on v5e (PERF_NOTES.md) — kept as an experiment
+    knob for other backends/window shapes.
+
 ``MXNET_EXEC_DISABLE_JIT`` (default 0)
     Debug switch: run graph programs eagerly (op-by-op) instead of one
     compiled XLA program — the analog of MXNET_ENGINE_TYPE=NaiveEngine
@@ -36,6 +42,10 @@ _DEFAULTS = {
     "MXNET_CONV_SPACE_TO_DEPTH": 1,
     "MXNET_BACKWARD_DO_MIRROR": 0,
     "MXNET_EXEC_DISABLE_JIT": 0,
+    # max-pool backward as fused strided masks instead of XLA's
+    # SelectAndScatter (tie gradients go to every max; see ops/nn.py
+    # _maxpool_mask_bwd)
+    "MXNET_POOLING_MASK_BWD": 0,
 }
 
 

@@ -272,6 +272,61 @@ def _register_pool():
                 pads.append((p, p))
         return pads
 
+    def _maxpool_mask_bwd(x, window, strides, pads):
+        """Max pooling whose backward avoids SelectAndScatter.
+
+        XLA autodiff of reduce_window(max) lowers the gradient to
+        SelectAndScatter — a serialized, bandwidth-hungry TPU op
+        (PERF_NOTES.md). Here the VJP computes
+        ``dx_i = sum over windows w covering i of
+        [x_i == out_w] * g_w / ties_w``
+        as strided elementwise passes, which XLA fuses. Tie semantics:
+        the window's gradient SPLITS EVENLY across tied maxima (ties are
+        common post-ReLU — exact 0.0s), preserving total gradient mass;
+        SelectAndScatter sends it all to the first tie. Both are valid
+        subgradient selections, so tie-free gradients match exactly.
+        """
+        import itertools
+
+        import jax
+
+        @jax.custom_vjp
+        def mp(x):
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                         window, strides, pads)
+
+        def fwd(x):
+            out = mp(x)
+            return out, (x, out)
+
+        def bwd(res, g):
+            x, out = res
+            neg = jnp.asarray(-jnp.inf, x.dtype)
+            xp = jnp.pad(x, pads, constant_values=neg)
+            taps = list(itertools.product(*[range(k) for k in window]))
+
+            def tap_idx(tap):
+                return tuple(slice(t, t + o * s, s)
+                             for t, o, s in zip(tap, out.shape, strides))
+
+            # pass 1: per-window tie count (>= 1 by construction)
+            ties = jnp.zeros(out.shape, g.dtype)
+            for tap in taps:
+                ties = ties + (xp[tap_idx(tap)] == out).astype(g.dtype)
+            gsplit = g / ties
+            # pass 2: scatter the split gradient to the tied maxima
+            acc = jnp.zeros(xp.shape, g.dtype)
+            for tap in taps:
+                idx = tap_idx(tap)
+                acc = acc.at[idx].add(
+                    jnp.where(xp[idx] == out, gsplit, 0).astype(g.dtype))
+            crop = tuple(slice(lo, dim - hi) for (lo, hi), dim
+                         in zip(pads, acc.shape))
+            return (acc[crop].astype(x.dtype),)
+
+        mp.defvjp(fwd, bwd)
+        return mp(x)
+
     def pooling(attrs, data):
         nd = len(attrs.kernel) if attrs.kernel else data.ndim - 2
         channels_last = _is_channels_last(attrs)
@@ -290,8 +345,19 @@ def _register_pool():
             strides = (1, 1) + tuple(stride)
             pads = [(0, 0), (0, 0)] + sp_pads
         if attrs.pool_type == "max":
-            init = -jnp.inf
-            out = jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+            from ..config import get_flag
+
+            if (get_flag("MXNET_POOLING_MASK_BWD")
+                    and int(np.prod(window)) <= 64):
+                # the tap unroll scales with the window size; global
+                # pooling would emit thousands of passes — keep the
+                # one-op SelectAndScatter there
+                out = _maxpool_mask_bwd(data, window, strides,
+                                        tuple(pads))
+            else:
+                init = -jnp.inf
+                out = jax.lax.reduce_window(data, init, jax.lax.max,
+                                            window, strides, pads)
         elif attrs.pool_type in ("avg", "sum"):
             out = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
             if attrs.pool_type == "avg":

@@ -448,3 +448,61 @@ def test_identity_attach_kl_sparse_reg():
     exe.forward(is_train=False)
     assert_almost_equal(exe.aux_dict["klreg_moving_avg"], new_avg,
                         rtol=1e-6, atol=1e-7)
+
+
+def test_maxpool_mask_backward_parity():
+    """MXNET_POOLING_MASK_BWD computes gradients identical to the
+    SelectAndScatter autodiff path on tie-free inputs (PERF_NOTES.md
+    records the v5e measurement: the mask path is ~14% slower for
+    ResNet-50, so the flag defaults off)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import config
+    from mxnet_tpu.ops.registry import get_op
+
+    opdef = get_op("Pooling")
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    attrs = opdef.parse_attrs({"kernel": "(3, 3)", "stride": "(2, 2)",
+                               "pad": "(1, 1)", "pool_type": "max"})
+
+    def run(flag):
+        config.set_flag("MXNET_POOLING_MASK_BWD", flag)
+        try:
+            f = lambda a: opdef.apply(attrs, (a,), ())[0][0].sum()
+            out = opdef.apply(attrs, (jnp.asarray(x),), ())[0][0]
+            return np.asarray(out), np.asarray(jax.grad(f)(jnp.asarray(x)))
+        finally:
+            config.set_flag("MXNET_POOLING_MASK_BWD", None)
+
+    f0, g0 = run(0)
+    f1, g1 = run(1)
+    np.testing.assert_array_equal(f0, f1)
+    np.testing.assert_allclose(g0, g1, rtol=1e-6, atol=1e-7)
+
+
+def test_maxpool_mask_backward_tie_splitting():
+    """With exact ties (post-ReLU zeros pattern) the mask backward
+    splits each window's gradient across tied maxima — total gradient
+    mass equals the output cotangent mass (a valid subgradient; naive
+    send-to-all would multiply it by the tie count)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import config
+    from mxnet_tpu.ops.registry import get_op
+
+    opdef = get_op("Pooling")
+    x = np.zeros((1, 1, 4, 4), np.float32)   # every window fully tied
+    attrs = opdef.parse_attrs({"kernel": "(2, 2)", "stride": "(2, 2)",
+                               "pool_type": "max"})
+    config.set_flag("MXNET_POOLING_MASK_BWD", 1)
+    try:
+        f = lambda a: opdef.apply(attrs, (a,), ())[0][0].sum()
+        g = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    finally:
+        config.set_flag("MXNET_POOLING_MASK_BWD", None)
+    # 4 windows, each with cotangent 1 split over 4 ties
+    np.testing.assert_allclose(g, np.full_like(x, 0.25))
+    assert abs(g.sum() - 4.0) < 1e-6
