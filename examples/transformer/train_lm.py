@@ -53,7 +53,7 @@ def main():
         else:
             name = part.rstrip("0123456789")
             size = part[len(name):]
-        if not name or not size.isdigit():
+        if not name or not size.isdigit() or int(size) < 1:
             raise SystemExit("bad --mesh entry %r (want e.g. dp2 or dp=2)"
                              % part)
         axes[name] = int(size)

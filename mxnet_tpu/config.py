@@ -43,8 +43,8 @@ _DEFAULTS = {
     "MXNET_BACKWARD_DO_MIRROR": 0,
     "MXNET_EXEC_DISABLE_JIT": 0,
     # max-pool backward as fused strided masks instead of XLA's
-    # SelectAndScatter (tie gradients go to every max; see ops/nn.py
-    # _maxpool_mask_bwd)
+    # SelectAndScatter (each window's gradient splits evenly across
+    # tied maxima; see ops/nn.py _maxpool_mask_bwd)
     "MXNET_POOLING_MASK_BWD": 0,
 }
 

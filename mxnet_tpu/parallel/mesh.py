@@ -43,3 +43,18 @@ def replicated_sharding(mesh):
     from jax.sharding import NamedSharding, PartitionSpec
 
     return NamedSharding(mesh, PartitionSpec())
+
+
+def write_and_fence(write_fn, fence_key):
+    """Multi-host checkpoint discipline: process 0 runs ``write_fn``
+    (to a SHARED filesystem — per-host local disk cannot work with a
+    single writer), then every process fences so no reader can observe
+    a half-written checkpoint. Single-process: just writes."""
+    import jax
+
+    if jax.process_index() == 0:
+        write_fn()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(fence_key)

@@ -284,19 +284,11 @@ class ShardedTrainer:
         sharded training resumes exactly. Multi-host: process 0 writes
         (replicated state is identical everywhere) to a SHARED
         filesystem, then all processes fence before anyone loads."""
-        import jax
+        from .mesh import write_and_fence
 
-        if jax.process_index() == 0:
-            self._write_checkpoint(state, prefix, epoch)
-        if jax.process_count() > 1:
-            # writers and readers need a fence: non-zero processes must
-            # not load a half-written checkpoint (requires a SHARED
-            # filesystem across hosts, e.g. GCS/NFS — per-host local
-            # disk cannot work with a single writer)
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(
-                "sharded_ckpt_%s_%d" % (prefix, epoch))
+        write_and_fence(lambda: self._write_checkpoint(state, prefix,
+                                                       epoch),
+                        "sharded_ckpt_%s_%d" % (prefix, epoch))
 
     def _write_checkpoint(self, state, prefix, epoch):
         from .. import ndarray as nd

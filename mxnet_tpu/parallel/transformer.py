@@ -168,6 +168,47 @@ class TransformerParallel:
         sh = self._ns("dp", "sp")
         return jax.device_put(tokens, sh), jax.device_put(targets, sh)
 
+    # --- checkpoint / resume ----------------------------------------------
+    def save_checkpoint(self, params, path):
+        """Write the sharded parameter tree to ``path`` (.npz). Arrays
+        are gathered to host via `multihost_utils.process_allgather`
+        when any shard lives on another process, so tp/ep-sharded
+        tensors checkpoint whole; process 0 writes, all fence."""
+        import jax
+
+        host = {}
+        for k, v in params.items():
+            if getattr(v, "is_fully_addressable", True):
+                host[k] = np.asarray(v)
+            else:
+                from jax.experimental import multihost_utils
+
+                host[k] = np.asarray(
+                    multihost_utils.process_allgather(v, tiled=True))
+        from .mesh import write_and_fence
+
+        write_and_fence(
+            lambda: np.savez(path if path.endswith(".npz")
+                             else path + ".npz", **host),
+            "tp_ckpt_%s" % path)
+
+    def load_checkpoint(self, path):
+        """Rebuild the parameter tree with this instance's shardings
+        (each device receives only its shard)."""
+        import jax
+
+        shardings = self.param_shardings()
+        if not path.endswith(".npz"):
+            path += ".npz"
+        with np.load(path) as z:
+            missing = set(shardings) - set(z.files)
+            if missing:
+                raise ValueError("checkpoint %r missing parameters: %s"
+                                 % (path, sorted(missing)))
+            return {k: jax.device_put(
+                        np.asarray(z[k], dtype=self.dtype), shardings[k])
+                    for k in shardings}
+
 
 def _local_attention(q, k, v, mesh_size=1):
     """Single-device attention: the Pallas flash kernel on TPU (no T x T
