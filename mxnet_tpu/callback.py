@@ -1,8 +1,15 @@
-"""Training callbacks (reference: python/mxnet/callback.py, 214 LoC)."""
+"""Epoch / batch callbacks for the fit loops.
+
+Keeps the reference frontend's callback surface (python/mxnet/callback.py:
+module_checkpoint, do_checkpoint, log_train_metric, Speedometer, ProgressBar,
+LogValidationMetricsCallback) with an independent implementation. Batch
+callbacks receive a ``BatchEndParam``-style object with ``epoch``, ``nbatch``,
+``eval_metric`` attributes; epoch callbacks receive
+``(epoch, symbol, arg_params, aux_params)``.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import sys
 import time
 
@@ -10,100 +17,115 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the Module every ``period`` epochs
-    (reference: callback.py:module_checkpoint)."""
-    period = int(max(1, period))
+def _metric_pairs(param):
+    """name/value pairs from a batch param, or () when no metric attached."""
+    metric = getattr(param, "eval_metric", None)
+    return metric.get_name_value() if metric is not None else ()
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
-    return _callback
+
+def _periodic_saver(period, save_fn):
+    """Wrap ``save_fn(epoch_1based)`` to fire once per ``period`` epochs."""
+    period = max(1, int(period))
+
+    def maybe_save(epoch, *state):
+        tick = epoch + 1
+        if tick % period == 0:
+            save_fn(tick, *state)
+
+    return maybe_save
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch callback that saves ``mod`` every ``period`` epochs."""
+    return _periodic_saver(
+        period,
+        lambda tick, *_s: mod.save_checkpoint(prefix, tick,
+                                              save_optimizer_states))
 
 
 def do_checkpoint(prefix, period=1):
-    """Checkpoint params each epoch (reference: callback.py:do_checkpoint)."""
+    """Epoch callback that writes ``prefix``-NNNN.params / -symbol.json."""
     from .model import save_checkpoint
-    period = int(max(1, period))
-
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-    return _callback
+    return _periodic_saver(
+        period,
+        lambda tick, sym, arg, aux: save_checkpoint(prefix, tick, sym,
+                                                    arg, aux))
 
 
 def log_train_metric(period, auto_reset=False):
-    """Log metric every ``period`` batches (reference: callback.py:log_train_metric)."""
+    """Batch callback that logs the attached metric every ``period`` batches."""
+    period = max(1, int(period))
 
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
-    return _callback
+    def emit(param):
+        if param.nbatch % period:
+            return
+        head = f"Iter[{param.epoch}] Batch[{param.nbatch}]"
+        for name, value in _metric_pairs(param):
+            logging.info("%s Train-%s=%f", head, name, value)
+        metric = getattr(param, "eval_metric", None)
+        if auto_reset and metric is not None:
+            metric.reset()
+
+    return emit
 
 
 class Speedometer:
-    """Samples/sec logger (reference: callback.py:Speedometer)."""
+    """Batch callback printing samples/sec (and metric values) every
+    ``frequent`` batches.
+
+    Internally keeps a single (batch-count, wall-clock) anchor; throughput is
+    measured between consecutive report points rather than per batch, so the
+    number is stable under engine async dispatch.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self._anchor = None  # (nbatch, time) of last report / epoch start
+
+    def _report(self, param, rate):
+        pairs = list(_metric_pairs(param))
+        tag = "Epoch" if pairs else "Iter"
+        line = f"{tag}[{param.epoch}] Batch [{param.nbatch}]" \
+               f"\tSpeed: {rate:.2f} samples/sec"
+        line += "".join(f"\t{k}={v:f}" for k, v in pairs)
+        if pairs and self.auto_reset:
+            param.eval_metric.reset()
+        logging.info(line)
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        now = time.time()
+        if self._anchor is None or param.nbatch < self._anchor[0]:
+            # new epoch (counter went backwards) or first ever call
+            self._anchor = (param.nbatch, now)
+            return
+        since_batch, since_time = self._anchor
+        if param.nbatch % self.frequent == 0 and param.nbatch > since_batch:
+            elapsed = max(now - since_time, 1e-12)
+            rate = (param.nbatch - since_batch) * self.batch_size / elapsed
+            self._report(param, rate)
+            self._anchor = (param.nbatch, now)
 
 
 class ProgressBar:
-    """ASCII progress bar (reference: callback.py:ProgressBar)."""
+    """Batch callback drawing an in-place ASCII progress bar."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.bar_len = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        done = int(round(frac * self.bar_len))
+        pct = int(-(-100.0 * param.nbatch // self.total))  # ceil
+        bar = "=" * done + "-" * (self.bar_len - done)
+        sys.stdout.write(f"[{bar}] {pct}%\r")
 
 
 class LogValidationMetricsCallback:
-    """(reference: callback.py:LogValidationMetricsCallback)"""
+    """Epoch-eval callback logging every validation metric value."""
 
     def __call__(self, param):
-        if not param.eval_metric:
-            return
-        name_value = param.eval_metric.get_name_value()
-        for name, value in name_value:
+        for name, value in _metric_pairs(param):
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -1,6 +1,9 @@
-"""Gluon DenseNet (reference:
-python/mxnet/gluon/model_zoo/vision/densenet.py — Huang et al.,
-"Densely Connected Convolutional Networks")."""
+"""DenseNet (Huang et al., "Densely Connected Convolutional Networks").
+
+Same factory surface as the reference zoo. Built around one BN-ReLU-conv
+primitive shared by dense layers and transitions; the feature-width
+bookkeeping walks the block table once.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -9,55 +12,53 @@ from ... import nn
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
-# num_init_features, growth_rate, block_config per variant
+# depth -> (stem width, growth rate, layers per dense block)
 _SPECS = {121: (64, 32, [6, 12, 24, 16]),
           161: (96, 48, [6, 12, 36, 24]),
           169: (64, 32, [6, 12, 32, 32]),
           201: (64, 32, [6, 12, 48, 32])}
 
 
+def _bn_relu_conv(seq, channels, kernel, pad=0):
+    seq.add(nn.BatchNorm())
+    seq.add(nn.Activation("relu"))
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, padding=pad,
+                      use_bias=False))
+
+
 class _DenseLayer(HybridBlock):
-    """BN-ReLU-1x1conv-BN-ReLU-3x3conv with the input concatenated onto
-    the output (the dense connectivity)."""
+    """Bottlenecked growth unit; output is input ++ new features."""
 
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
+        _bn_relu_conv(self.body, bn_size * growth_rate, 1)
+        _bn_relu_conv(self.body, growth_rate, 3, pad=1)
         if dropout:
             self.body.add(nn.Dropout(dropout))
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        return F.Concat(x, out, dim=1, num_args=2)
+        return F.Concat(x, self.body(x), dim=1, num_args=2)
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix="stage%d_" % stage_index)
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
+def _dense_stage(count, bn_size, growth_rate, dropout, index):
+    stage = nn.HybridSequential(prefix="stage%d_" % index)
+    with stage.name_scope():
+        for _ in range(count):
+            stage.add(_DenseLayer(growth_rate, bn_size, dropout))
+    return stage
 
 
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _transition(width):
+    """Halve spatial resolution and compress channels between stages."""
+    seq = nn.HybridSequential(prefix="")
+    _bn_relu_conv(seq, width, 1)
+    seq.add(nn.AvgPool2D(pool_size=2, strides=2))
+    return seq
 
 
 class DenseNet(HybridBlock):
-    """(reference: densenet.py:DenseNet)"""
+    """Stem, alternating dense blocks and transitions, BN-ReLU head."""
 
     def __init__(self, num_init_features, growth_rate, block_config,
                  bn_size=4, dropout=0, classes=1000, **kwargs):
@@ -65,20 +66,19 @@ class DenseNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3,
-                                        use_bias=False))
+                                        strides=2, padding=3, use_bias=False))
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                           padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    num_features = num_features // 2
-                    self.features.add(_make_transition(num_features))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            width = num_init_features
+            last = len(block_config) - 1
+            for i, count in enumerate(block_config):
+                self.features.add(_dense_stage(count, bn_size, growth_rate,
+                                               dropout, i + 1))
+                width += count * growth_rate
+                if i != last:
+                    width //= 2
+                    self.features.add(_transition(width))
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.AvgPool2D(pool_size=7))
@@ -86,31 +86,26 @@ class DenseNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
-def _densenet(num_layers, pretrained=False, **kwargs):
+def _densenet(depth, pretrained=False, **kwargs):
     if pretrained:
         raise NotImplementedError(
             "pretrained weights are a download in the reference "
             "(model_store.py); offline build has none")
-    ninit, growth, cfg = _SPECS[num_layers]
-    return DenseNet(ninit, growth, cfg, **kwargs)
+    stem, growth, table = _SPECS[depth]
+    return DenseNet(stem, growth, table, **kwargs)
 
 
-def densenet121(**kwargs):
-    """DenseNet-121 (reference: densenet.py:densenet121)."""
-    return _densenet(121, **kwargs)
+def _factory(depth):
+    def make(**kwargs):
+        return _densenet(depth, **kwargs)
+    make.__name__ = "densenet%d" % depth
+    make.__doc__ = "DenseNet-%d." % depth
+    return make
 
 
-def densenet161(**kwargs):
-    return _densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return _densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return _densenet(201, **kwargs)
+for _d in _SPECS:
+    globals()["densenet%d" % _d] = _factory(_d)
+del _d

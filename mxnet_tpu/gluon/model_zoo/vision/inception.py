@@ -1,6 +1,10 @@
-"""Gluon Inception V3 (reference:
-python/mxnet/gluon/model_zoo/vision/inception.py — Szegedy et al.,
-"Rethinking the Inception Architecture for Computer Vision")."""
+"""Inception v3 (Szegedy et al., "Rethinking the Inception Architecture").
+
+Same factory surface as the reference zoo. Every mixed block is written as
+data: a list of branches, each branch a list of conv-spec dicts optionally
+preceded by a pooling tag or containing a ("split", a, b) fan-out pair. One
+interpreter turns the tables into HybridBlocks. Input is 3x299x299.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -10,147 +14,139 @@ from .squeezenet import HybridConcurrent
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def C(channels, kernel, stride=None, pad=None):
+    """Conv spec shorthand used by the block tables below."""
+    spec = {"channels": channels, "kernel_size": kernel}
+    if stride is not None:
+        spec["strides"] = stride
+    if pad is not None:
+        spec["padding"] = pad
+    return spec
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    for setting in conv_settings:
-        kwargs = {}
-        channels, kernel, stride, pad = setting
-        kwargs["channels"] = channels
-        kwargs["kernel_size"] = kernel
-        if stride is not None:
-            kwargs["strides"] = stride
-        if pad is not None:
-            kwargs["padding"] = pad
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _bn_conv(spec):
+    unit = nn.HybridSequential(prefix="")
+    unit.add(nn.Conv2D(use_bias=False, **spec))
+    unit.add(nn.BatchNorm(epsilon=0.001))
+    unit.add(nn.Activation("relu"))
+    return unit
 
 
-def _make_A(pool_features, prefix):
-    out = HybridConcurrent(prefix=prefix)
-    out.add(_make_branch(None, (64, 1, None, None)))
-    out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                         (96, 3, None, 1)))
-    out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
+class _Fork(HybridBlock):
+    """Apply two conv paths to one input and concatenate on channels."""
 
-
-def _make_B(prefix):
-    out = HybridConcurrent(prefix=prefix)
-    out.add(_make_branch(None, (384, 3, 2, None)))
-    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                         (96, 3, 2, None)))
-    out.add(_make_branch("max"))
-    return out
-
-
-def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(prefix=prefix)
-    out.add(_make_branch(None, (192, 1, None, None)))
-    out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                         (channels_7x7, (1, 7), None, (0, 3)),
-                         (192, (7, 1), None, (3, 0))))
-    out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                         (channels_7x7, (7, 1), None, (3, 0)),
-                         (channels_7x7, (1, 7), None, (0, 3)),
-                         (channels_7x7, (7, 1), None, (3, 0)),
-                         (192, (1, 7), None, (0, 3))))
-    out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
-
-
-def _make_D(prefix):
-    out = HybridConcurrent(prefix=prefix)
-    out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-    out.add(_make_branch(None, (192, 1, None, None),
-                         (192, (1, 7), None, (0, 3)),
-                         (192, (7, 1), None, (3, 0)),
-                         (192, 3, 2, None)))
-    out.add(_make_branch("max"))
-    return out
-
-
-class _SplitConcat(HybridBlock):
-    """Two parallel convs over the same input, channel-concatenated."""
-
-    def __init__(self, settings, **kwargs):
+    def __init__(self, left, right, **kwargs):
         super().__init__(**kwargs)
-        # Block.__setattr__ registers Block attributes automatically
-        self.a = _make_branch(None, settings[0])
-        self.b = _make_branch(None, settings[1])
+        self.a = _branch(left)
+        self.b = _branch(right)
 
     def hybrid_forward(self, F, x):
         return F.Concat(self.a(x), self.b(x), dim=1, num_args=2)
 
 
-def _make_E(prefix):
-    out = HybridConcurrent(prefix=prefix)
-    out.add(_make_branch(None, (320, 1, None, None)))
-    b1 = nn.HybridSequential(prefix="")
-    b1.add(_make_branch(None, (384, 1, None, None)))
-    b1.add(_SplitConcat([(384, (1, 3), None, (0, 1)),
-                         (384, (3, 1), None, (1, 0))]))
-    out.add(b1)
-    b2 = nn.HybridSequential(prefix="")
-    b2.add(_make_branch(None, (448, 1, None, None),
-                        (384, 3, None, 1)))
-    b2.add(_SplitConcat([(384, (1, 3), None, (0, 1)),
-                         (384, (3, 1), None, (1, 0))]))
-    out.add(b2)
-    out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _branch(steps):
+    """A branch: optional leading "avg"/"max" pool tag, then conv specs or
+    ("split", left, right) fan-outs."""
+    seq = nn.HybridSequential(prefix="")
+    for step in steps:
+        if step == "avg":
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif step == "max":
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        elif isinstance(step, tuple) and step and step[0] == "split":
+            seq.add(_Fork(step[1], step[2]))
+        else:
+            seq.add(_bn_conv(step))
+    return seq
+
+
+def _mixed(branches, prefix):
+    block = HybridConcurrent(prefix=prefix)
+    for steps in branches:
+        block.add(_branch(steps))
+    return block
+
+
+def _table_a(pool_width):
+    return [
+        [C(64, 1)],
+        [C(48, 1), C(64, 5, pad=2)],
+        [C(64, 1), C(96, 3, pad=1), C(96, 3, pad=1)],
+        ["avg", C(pool_width, 1)],
+    ]
+
+
+_TABLE_B = [
+    [C(384, 3, stride=2)],
+    [C(64, 1), C(96, 3, pad=1), C(96, 3, stride=2)],
+    ["max"],
+]
+
+
+def _table_c(w):
+    return [
+        [C(192, 1)],
+        [C(w, 1), C(w, (1, 7), pad=(0, 3)), C(192, (7, 1), pad=(3, 0))],
+        [C(w, 1), C(w, (7, 1), pad=(3, 0)), C(w, (1, 7), pad=(0, 3)),
+         C(w, (7, 1), pad=(3, 0)), C(192, (1, 7), pad=(0, 3))],
+        ["avg", C(192, 1)],
+    ]
+
+
+_TABLE_D = [
+    [C(192, 1), C(320, 3, stride=2)],
+    [C(192, 1), C(192, (1, 7), pad=(0, 3)), C(192, (7, 1), pad=(3, 0)),
+     C(192, 3, stride=2)],
+    ["max"],
+]
+
+_SPLIT_13_31 = ("split", [C(384, (1, 3), pad=(0, 1))],
+                [C(384, (3, 1), pad=(1, 0))])
+
+_TABLE_E = [
+    [C(320, 1)],
+    [C(384, 1), _SPLIT_13_31],
+    [C(448, 1), C(384, 3, pad=1), _SPLIT_13_31],
+    ["avg", C(192, 1)],
+]
+
+# the full network: stem convs/pools then the mixed-block schedule
+_STEM = (C(32, 3, stride=2), C(32, 3), C(64, 3, pad=1), "max",
+         C(80, 1), C(192, 3), "max")
+_SCHEDULE = (
+    (_table_a(32), "A1_"), (_table_a(64), "A2_"), (_table_a(64), "A3_"),
+    (_TABLE_B, "B_"),
+    (_table_c(128), "C1_"), (_table_c(160), "C2_"),
+    (_table_c(160), "C3_"), (_table_c(192), "C4_"),
+    (_TABLE_D, "D_"),
+    (_TABLE_E, "E1_"), (_TABLE_E, "E2_"),
+)
 
 
 class Inception3(HybridBlock):
-    """(reference: inception.py:Inception3); input 3x299x299."""
+    """Inception v3 trunk + dropout + linear classifier."""
 
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
+            for step in _STEM:
+                if step == "max":
+                    self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                else:
+                    self.features.add(_bn_conv(step))
+            for table, prefix in _SCHEDULE:
+                self.features.add(_mixed(table, prefix))
             self.features.add(nn.AvgPool2D(pool_size=8))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, **kwargs):
-    """Inception v3 (reference: inception.py:inception_v3)."""
+    """Build Inception v3; ``pretrained`` is unsupported offline."""
     if pretrained:
         raise NotImplementedError(
             "pretrained weights are a download in the reference "

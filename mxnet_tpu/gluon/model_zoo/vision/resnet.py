@@ -1,5 +1,11 @@
-"""Gluon ResNet v1/v2 (reference:
-python/mxnet/gluon/model_zoo/vision/resnet.py:535) — BASELINE config #3."""
+"""ResNet v1 (post-activation) and v2 (pre-activation) for the model zoo.
+
+Architecture per He et al. 2015/2016; same class/factory surface as the
+reference model zoo (BASELINE config #3) with a table-driven construction:
+residual units are built from conv-spec tuples and both network versions
+share one stage builder. Child-block creation order matches the reference
+so default parameter names (and therefore checkpoints) stay compatible.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -13,229 +19,218 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+def _conv(channels, kernel, stride=1, use_bias=False, in_channels=0):
+    """Conv2D with 'same'-style padding for odd kernels."""
+    return nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                     padding=kernel // 2, use_bias=use_bias,
+                     in_channels=in_channels)
 
 
-class BasicBlockV1(HybridBlock):
-    """(reference: resnet.py:BasicBlockV1)"""
+def _postact_body(specs, in_channels):
+    """v1 residual body: conv/BN pairs from ``specs`` with ReLU between
+    (but not after) them. ``specs`` is a list of (channels, kernel, stride)."""
+    body = nn.HybridSequential(prefix="")
+    last = len(specs) - 1
+    src = in_channels
+    for i, (ch, k, s) in enumerate(specs):
+        body.add(_conv(ch, k, s, in_channels=src if i == 0 else 0))
+        body.add(nn.BatchNorm())
+        if i != last:
+            body.add(nn.Activation("relu"))
+        src = ch
+    return body
+
+
+def _shortcut(channels, stride, in_channels, with_bn):
+    """1x1 projection used when the unit changes shape."""
+    if not with_bn:
+        return nn.Conv2D(channels, 1, stride, use_bias=False,
+                         in_channels=in_channels)
+    proj = nn.HybridSequential(prefix="")
+    proj.add(nn.Conv2D(channels, 1, stride, use_bias=False,
+                       in_channels=in_channels))
+    proj.add(nn.BatchNorm())
+    return proj
+
+
+class _UnitV1(HybridBlock):
+    """Post-activation residual unit: relu(x_shortcut + body(x))."""
+
+    _specs = None  # set by subclass: fn(channels, stride) -> conv spec list
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
         super().__init__(**kwargs)
+        self.body = _postact_body(self._specs(channels, stride), in_channels)
+        self.downsample = (_shortcut(channels, stride, in_channels, True)
+                           if downsample else None)
+
+    def hybrid_forward(self, F, x):
+        skip = x if self.downsample is None else self.downsample(x)
+        return F.Activation(skip + self.body(x), act_type="relu")
+
+
+class BasicBlockV1(_UnitV1):
+    """Two 3x3 convs (ResNet-18/34 style)."""
+
+    @staticmethod
+    def _specs(channels, stride):
+        return [(channels, 3, stride), (channels, 3, 1)]
+
+
+class BottleneckV1(_UnitV1):
+    """1x1 reduce, 3x3, 1x1 expand (ResNet-50+ style). The 1x1 convs carry
+    bias (reference layout); only the 3x3 is bias-free."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        HybridBlock.__init__(self, **kwargs)
+        mid = channels // 4
         self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(residual + x, act_type="relu")
-        return x
+        for i, (ch, k, s) in enumerate(
+                ((mid, 1, stride), (mid, 3, 1), (channels, 1, 1))):
+            self.body.add(nn.Conv2D(ch, kernel_size=k, strides=s,
+                                    padding=k // 2, use_bias=(k == 1)))
+            self.body.add(nn.BatchNorm())
+            if i != 2:
+                self.body.add(nn.Activation("relu"))
+        self.downsample = (_shortcut(channels, stride, in_channels, True)
+                           if downsample else None)
 
 
-class BottleneckV1(HybridBlock):
-    """(reference: resnet.py:BottleneckV1)"""
+class _UnitV2(HybridBlock):
+    """Pre-activation residual unit: x + convs(relu(bn(x))), with the
+    projection (when present) taken from the pre-activated tensor."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
+        self._build(channels, stride, in_channels)
+        self.downsample = (_shortcut(channels, stride, in_channels, False)
+                           if downsample else None)
+
+    def _build(self, channels, stride, in_channels):
+        raise NotImplementedError
+
+    def _pairs(self):
+        raise NotImplementedError
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(x + residual, act_type="relu")
-        return x
+        skip = x
+        for i, (bn, conv) in enumerate(self._pairs()):
+            x = F.Activation(bn(x), act_type="relu")
+            if i == 0 and self.downsample is not None:
+                skip = self.downsample(x)
+            x = conv(x)
+        return x + skip
 
 
-class BasicBlockV2(HybridBlock):
-    """(reference: resnet.py:BasicBlockV2)"""
+class BasicBlockV2(_UnitV2):
+    """Pre-act twin 3x3 unit."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
+    def _build(self, channels, stride, in_channels):
         self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
+        self.conv1 = _conv(channels, 3, stride, in_channels=in_channels)
         self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+        self.conv2 = _conv(channels, 3, 1, in_channels=channels)
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+    def _pairs(self):
+        return ((self.bn1, self.conv1), (self.bn2, self.conv2))
 
 
-class BottleneckV2(HybridBlock):
-    """(reference: resnet.py:BottleneckV2)"""
+class BottleneckV2(_UnitV2):
+    """Pre-act 1x1 / 3x3 / 1x1 unit."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
+    def _build(self, channels, stride, in_channels):
+        mid = channels // 4
         self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
+        self.conv1 = nn.Conv2D(mid, kernel_size=1, strides=1, use_bias=False)
         self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
+        self.conv2 = _conv(mid, 3, stride, in_channels=mid)
         self.bn3 = nn.BatchNorm()
         self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
                                use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
+    def _pairs(self):
+        return ((self.bn1, self.conv1), (self.bn2, self.conv2),
+                (self.bn3, self.conv3))
+
+
+def _stage(block, count, channels, stride, index, in_channels):
+    """``count`` stacked units; only the first may change stride/width."""
+    seq = nn.HybridSequential(prefix="stage%d_" % index)
+    with seq.name_scope():
+        seq.add(block(channels, stride, channels != in_channels,
+                      in_channels=in_channels, prefix=""))
+        for _ in range(count - 1):
+            seq.add(block(channels, 1, False, in_channels=channels, prefix=""))
+    return seq
+
+
+def _add_stem(seq, first_channels, thumbnail, with_bn_relu_pool=True):
+    """ImageNet stem (7x7/2 + pool) or CIFAR thumbnail stem (3x3/1)."""
+    if thumbnail:
+        seq.add(_conv(first_channels, 3, 1))
+    else:
+        seq.add(nn.Conv2D(first_channels, 7, 2, 3, use_bias=False))
+        if with_bn_relu_pool:
+            seq.add(nn.BatchNorm())
+            seq.add(nn.Activation("relu"))
+            seq.add(nn.MaxPool2D(3, 2, 1))
 
 
 class ResNetV1(HybridBlock):
-    """(reference: resnet.py:ResNetV1)"""
+    """Post-activation ResNet: stem -> 4 stages -> global pool -> classifier."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        if len(channels) != len(layers) + 1:
+            raise ValueError("need one more channel entry than stage count")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
+            _add_stem(self.features, channels[0], thumbnail,
+                      with_bn_relu_pool=not thumbnail)
+            for i, count in enumerate(layers):
+                self.features.add(_stage(block, count, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1,
+                                         channels[i]))
             self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 class ResNetV2(HybridBlock):
-    """(reference: resnet.py:ResNetV2)"""
+    """Pre-activation ResNet; input BN first, final BN+ReLU before pooling."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
+        if len(channels) != len(layers) + 1:
+            raise ValueError("need one more channel entry than stage count")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
+            _add_stem(self.features, channels[0], thumbnail,
+                      with_bn_relu_pool=not thumbnail)
+            width = channels[0]
+            for i, count in enumerate(layers):
+                self.features.add(_stage(block, count, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1, width))
+                width = channels[i + 1]
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))
             self.features.add(nn.GlobalAvgPool2D())
             self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
+            self.output = nn.Dense(classes, in_units=width)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
+# depth -> (unit kind, per-stage unit counts, channel schedule)
 resnet_spec = {
     18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
     34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
@@ -251,57 +246,33 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    """(reference: resnet.py:get_resnet)"""
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version >= 1 and version <= 2, \
-        "Invalid resnet version: %d. Options are 1 and 2." % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    """Instantiate a ResNet by (version in {1, 2}, depth in resnet_spec)."""
+    if num_layers not in resnet_spec:
+        raise ValueError("Invalid number of layers: %d. Options are %s"
+                         % (num_layers, sorted(resnet_spec)))
+    if version not in (1, 2):
+        raise ValueError("Invalid resnet version: %d. Options are 1 and 2."
+                         % version)
+    kind, counts, widths = resnet_spec[num_layers]
+    net_cls = resnet_net_versions[version - 1]
+    unit_cls = resnet_block_versions[version - 1][kind]
+    net = net_cls(unit_cls, counts, widths, **kwargs)
     if pretrained:
         raise MXNetError("pretrained weights unavailable in this offline "
                          "environment; use net.load_params on a local file")
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _factory(version, depth):
+    def make(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    make.__name__ = "resnet%d_v%d" % (depth, version)
+    make.__doc__ = "ResNet-%d v%d (see get_resnet)." % (depth, version)
+    return make
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+for _v in (1, 2):
+    for _d in resnet_spec:
+        _fn = _factory(_v, _d)
+        globals()[_fn.__name__] = _fn
+del _v, _d, _fn

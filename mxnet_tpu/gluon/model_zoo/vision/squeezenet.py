@@ -1,5 +1,9 @@
-"""Gluon SqueezeNet (reference:
-python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0 / 1.1 (Iandola et al. 2016) for the model zoo.
+
+Same factory surface as the reference zoo. Each version is a declarative
+sequence of stem / fire / pool entries; a fire module squeezes to ``s``
+channels then expands to 4s + 4s via parallel 1x1 / 3x3 paths.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -9,26 +13,8 @@ from ....base import MXNetError
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = HybridConcurrent()
-    paths.add(_make_fire_conv(expand1x1_channels, 1))
-    paths.add(_make_fire_conv(expand3x3_channels, 3, 1))
-    out.add(paths)
-    return out
-
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation("relu"))
-    return out
-
-
 class HybridConcurrent(HybridBlock):
-    """Run children on same input, concat outputs channel-wise
-    (reference: gluon/contrib/nn/basic_layers.py:HybridConcurrent)."""
+    """Apply every child to the same input and concatenate the results."""
 
     def __init__(self, axis=1, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -38,54 +24,56 @@ class HybridConcurrent(HybridBlock):
         self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        out = [block(x) for block in self._children]
-        return F.Concat(*out, dim=self.axis, num_args=len(out))
+        outs = [child(x) for child in self._children]
+        return F.Concat(*outs, dim=self.axis, num_args=len(outs))
+
+
+def _relu_conv(channels, kernel, padding=0):
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel, padding=padding))
+    seq.add(nn.Activation("relu"))
+    return seq
+
+
+def _fire(squeeze):
+    """Fire module: 1x1 squeeze then concat of 1x1 and 3x3 expands."""
+    expand = 4 * squeeze
+    seq = nn.HybridSequential(prefix="")
+    seq.add(_relu_conv(squeeze, 1))
+    branches = HybridConcurrent()
+    branches.add(_relu_conv(expand, 1))
+    branches.add(_relu_conv(expand, 3, 1))
+    seq.add(branches)
+    return seq
+
+
+# version -> (stem (channels, kernel), plan of fire-squeeze sizes and "P" pools)
+_PLANS = {
+    "1.0": ((96, 7), (16, 16, 32, "P", 32, 48, 48, 64, "P", 64)),
+    "1.1": ((64, 3), (16, 16, "P", 32, 32, "P", 48, 48, 64, 64)),
+}
 
 
 class SqueezeNet(HybridBlock):
-    """(reference: squeezenet.py:SqueezeNet)"""
+    """Fire-module CNN with a fully-convolutional classifier head."""
 
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ["1.0", "1.1"], \
-            "Unsupported SqueezeNet version {version}: 1.0 or 1.1 expected" \
-            .format(version=version)
+        if version not in _PLANS:
+            raise AssertionError(
+                "Unsupported SqueezeNet version {version}: 1.0 or 1.1 "
+                "expected".format(version=version))
+        (stem_ch, stem_k), plan = _PLANS[version]
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            self.features.add(nn.Conv2D(stem_ch, kernel_size=stem_k, strides=2))
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            for entry in plan:
+                if entry == "P":
+                    self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                else:
+                    self.features.add(_fire(entry))
             self.features.add(nn.Dropout(0.5))
 
             self.output = nn.HybridSequential(prefix="")
@@ -95,16 +83,13 @@ class SqueezeNet(HybridBlock):
             self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_squeezenet(version, pretrained=False, **kwargs):
-    net = SqueezeNet(version, **kwargs)
     if pretrained:
         raise MXNetError("pretrained weights unavailable offline")
-    return net
+    return SqueezeNet(version, **kwargs)
 
 
 def squeezenet1_0(**kwargs):

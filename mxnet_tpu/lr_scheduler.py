@@ -1,98 +1,120 @@
-"""Learning-rate schedulers (reference: python/mxnet/lr_scheduler.py:22-140)."""
+"""Learning-rate schedules.
+
+API-parity surface with the reference frontend (python/mxnet/lr_scheduler.py:
+Factor / MultiFactor / Poly), re-implemented as decay-count arithmetic: each
+scheduler knows how many decay events a given ``num_update`` implies and
+applies only the delta since the previous query. This keeps the reference's
+observable behaviour — ``base_lr`` is the *live* learning rate and may be
+reassigned by callers between queries (optimizer/Trainer do exactly that) —
+without its incremental while-loop state machine.
+"""
 from __future__ import annotations
 
 import logging
-import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
            "PolyScheduler"]
 
+_log = logging.getLogger(__name__)
+
 
 class LRScheduler:
-    """Base scheduler: maps num_update → learning rate."""
+    """Maps an update counter to a learning rate.
+
+    Subclasses implement ``__call__(num_update) -> float``. ``base_lr`` holds
+    the current rate and is mutable from outside (Optimizer.set_lr_scheduler
+    assigns the optimizer's lr into it).
+    """
 
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
 
     def __call__(self, num_update):
-        raise NotImplementedError()
+        raise NotImplementedError("subclass must map num_update -> lr")
 
 
-class FactorScheduler(LRScheduler):
-    """lr *= factor every ``step`` updates (reference: lr_scheduler.py:22)."""
+class _DecayCountScheduler(LRScheduler):
+    """Shared machinery: multiply ``base_lr`` by ``factor`` once per decay
+    event, where the total number of events implied by ``num_update`` is
+    given by ``_events_before``."""
+
+    def __init__(self, factor, floor=0.0):
+        super().__init__()
+        if not factor <= 1.0:
+            raise ValueError("decay factor above 1.0 would grow the lr")
+        self.factor = factor
+        self._floor = floor
+        self._applied = 0
+
+    def _events_before(self, num_update):
+        raise NotImplementedError
+
+    def __call__(self, num_update):
+        due = self._events_before(num_update)
+        hit_floor = False
+        while self._applied < due:
+            self._applied += 1
+            nxt = self.base_lr * self.factor
+            if nxt < self._floor:
+                self.base_lr = self._floor
+                hit_floor = True
+            else:
+                self.base_lr = nxt
+        if due:
+            if hit_floor:
+                _log.info("Update[%d]: lr clamped at floor %0.5e; no further "
+                          "decay will occur", num_update, self.base_lr)
+            else:
+                _log.info("Update[%d]: lr decayed to %0.5e",
+                          num_update, self.base_lr)
+        return self.base_lr
+
+
+class FactorScheduler(_DecayCountScheduler):
+    """Geometric decay: one event each time ``num_update`` crosses a multiple
+    of ``step``, with an optional lower bound ``stop_factor_lr``."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
-        super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
-        if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("step must be a positive update count")
+        super().__init__(factor, floor=stop_factor_lr)
         self.step = step
-        self.factor = factor
-        self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+    def _events_before(self, num_update):
+        # an event fires when num_update exceeds k*step for k = 1, 2, ...
+        return max(0, (int(num_update) - 1) // self.step)
 
 
-class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at given steps (reference: lr_scheduler.py:73)."""
+class MultiFactorScheduler(_DecayCountScheduler):
+    """Decay at an explicit increasing list of update milestones."""
 
     def __init__(self, step, factor=1):
-        super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1 round")
-        if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("milestones must be positive update counts")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("milestones must be strictly increasing")
+        super().__init__(factor)
         self.step = step
-        self.cur_step_ind = 0
-        self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _events_before(self, num_update):
+        return sum(1 for s in self.step if num_update > s)
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay to zero at max_update (reference: lr_scheduler.py:118)."""
+    """Polynomial decay from the constructed base rate to zero over
+    ``max_update`` updates: lr(t) = lr0 * (1 - t/T)^pwr for t <= T."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = self.base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update must be a positive integer")
+        self._lr0 = base_lr
         self.max_update = max_update
         self.power = pwr
-        self.base_lr = self.base_lr_orig
 
     def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * pow(
-                1.0 - float(num_update) / float(self.max_update), self.power)
+        t = min(float(num_update), float(self.max_update))
+        self.base_lr = self._lr0 * (1.0 - t / self.max_update) ** self.power
         return self.base_lr

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Token-level similarity sweep: repo files vs same-named reference files.
+
+Replicates the round-3 judge's measurement so de-cloning progress is
+verifiable: strip comments + docstrings, tokenize to an identifier/op
+stream, and compute difflib.SequenceMatcher ratio between the repo file
+and its same-named counterpart under /root/reference/python/mxnet/.
+
+Usage:
+    python tools/similarity_sweep.py                 # sweep all mapped files
+    python tools/similarity_sweep.py --threshold 0.5 # exit 1 on any file above
+    python tools/similarity_sweep.py mxnet_tpu/metric.py   # one file
+"""
+import argparse
+import difflib
+import io
+import os
+import sys
+import tokenize
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+REF = "/root/reference/python/mxnet"
+
+
+def token_stream(path):
+    """Return the token stream of a python file with comments, docstrings,
+    NL/NEWLINE/INDENT markers stripped — identifiers, ops, and literals only."""
+    with open(path, "rb") as f:
+        src = f.read()
+    toks = []
+    prev_significant = None
+    try:
+        gen = tokenize.tokenize(io.BytesIO(src).readline)
+        for tok in gen:
+            t, s = tok.type, tok.string
+            if t in (tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+                     tokenize.INDENT, tokenize.DEDENT, tokenize.ENCODING,
+                     tokenize.ENDMARKER):
+                if t == tokenize.NEWLINE:
+                    prev_significant = "NEWLINE"
+                continue
+            # a STRING that begins a logical line is a docstring-ish bare string
+            if t == tokenize.STRING and prev_significant in (None, "NEWLINE", ":"):
+                prev_significant = "str"
+                continue
+            toks.append(s)
+            prev_significant = s
+    except tokenize.TokenError:
+        pass
+    return toks
+
+
+def similarity(repo_file, ref_file):
+    a, b = token_stream(repo_file), token_stream(ref_file)
+    if not a or not b:
+        return 0.0
+    return difflib.SequenceMatcher(None, a, b, autojunk=False).ratio()
+
+
+# repo path (relative to repo root) -> reference path (relative to REF).
+# Covers every file the round-3 sweep flagged plus the natural same-name map.
+MAPPING = {
+    "mxnet_tpu/callback.py": "callback.py",
+    "mxnet_tpu/lr_scheduler.py": "lr_scheduler.py",
+    "mxnet_tpu/metric.py": "metric.py",
+    "mxnet_tpu/monitor.py": "monitor.py",
+    "mxnet_tpu/initializer.py": "initializer.py",
+    "mxnet_tpu/optimizer.py": "optimizer.py",
+    "mxnet_tpu/registry.py": "registry.py",
+    "mxnet_tpu/visualization.py": "visualization.py",
+    "mxnet_tpu/model.py": "model.py",
+    "mxnet_tpu/io.py": "io.py",
+    "mxnet_tpu/recordio.py": "recordio.py",
+    "mxnet_tpu/operator.py": "operator.py",
+    "mxnet_tpu/autograd.py": "autograd.py",
+    "mxnet_tpu/executor.py": "executor.py",
+    "mxnet_tpu/kvstore.py": "kvstore.py",
+    "mxnet_tpu/kvstore_server.py": "kvstore_server.py",
+    "mxnet_tpu/image/image.py": "image/image.py",
+    "mxnet_tpu/image/detection.py": "image/detection.py",
+    "mxnet_tpu/module/module.py": "module/module.py",
+    "mxnet_tpu/module/base_module.py": "module/base_module.py",
+    "mxnet_tpu/module/bucketing_module.py": "module/bucketing_module.py",
+    "mxnet_tpu/module/sequential_module.py": "module/sequential_module.py",
+    "mxnet_tpu/module/python_module.py": "module/python_module.py",
+    "mxnet_tpu/module/executor_group.py": "module/executor_group.py",
+    "mxnet_tpu/rnn/rnn_cell.py": "rnn/rnn_cell.py",
+    "mxnet_tpu/rnn/io.py": "rnn/io.py",
+    "mxnet_tpu/rnn/rnn.py": "rnn/rnn.py",
+    "mxnet_tpu/gluon/block.py": "gluon/block.py",
+    "mxnet_tpu/gluon/parameter.py": "gluon/parameter.py",
+    "mxnet_tpu/gluon/trainer.py": "gluon/trainer.py",
+    "mxnet_tpu/gluon/utils.py": "gluon/utils.py",
+    "mxnet_tpu/gluon/loss.py": "gluon/loss.py",
+    "mxnet_tpu/gluon/nn/basic_layers.py": "gluon/nn/basic_layers.py",
+    "mxnet_tpu/gluon/nn/conv_layers.py": "gluon/nn/conv_layers.py",
+    "mxnet_tpu/gluon/rnn/rnn_cell.py": "gluon/rnn/rnn_cell.py",
+    "mxnet_tpu/gluon/rnn/rnn_layer.py": "gluon/rnn/rnn_layer.py",
+    "mxnet_tpu/gluon/data/sampler.py": "gluon/data/sampler.py",
+    "mxnet_tpu/gluon/data/dataset.py": "gluon/data/dataset.py",
+    "mxnet_tpu/gluon/data/dataloader.py": "gluon/data/dataloader.py",
+    "mxnet_tpu/gluon/data/vision.py": "gluon/data/vision.py",
+    "mxnet_tpu/gluon/model_zoo/vision/alexnet.py": "gluon/model_zoo/vision/alexnet.py",
+    "mxnet_tpu/gluon/model_zoo/vision/densenet.py": "gluon/model_zoo/vision/densenet.py",
+    "mxnet_tpu/gluon/model_zoo/vision/inception.py": "gluon/model_zoo/vision/inception.py",
+    "mxnet_tpu/gluon/model_zoo/vision/mobilenet.py": "gluon/model_zoo/vision/mobilenet.py",
+    "mxnet_tpu/gluon/model_zoo/vision/resnet.py": "gluon/model_zoo/vision/resnet.py",
+    "mxnet_tpu/gluon/model_zoo/vision/squeezenet.py": "gluon/model_zoo/vision/squeezenet.py",
+    "mxnet_tpu/gluon/model_zoo/vision/vgg.py": "gluon/model_zoo/vision/vgg.py",
+    "mxnet_tpu/gluon/contrib/rnn/conv_rnn_cell.py": "gluon/contrib/rnn/conv_rnn_cell.py",
+    "mxnet_tpu/gluon/contrib/rnn/rnn_cell.py": "gluon/contrib/rnn/rnn_cell.py",
+    "mxnet_tpu/test_utils.py": "test_utils.py",
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("files", nargs="*", help="specific repo-relative files")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="exit nonzero if any file >= threshold")
+    ap.add_argument("--all", action="store_true",
+                    help="also sweep every repo .py against same-relative-path ref file")
+    args = ap.parse_args()
+
+    pairs = []
+    if args.files:
+        for f in args.files:
+            f = f if f.startswith("mxnet_tpu") else os.path.relpath(f, REPO)
+            ref = MAPPING.get(f)
+            if ref is None:
+                ref = f.replace("mxnet_tpu/", "", 1)
+            pairs.append((f, ref))
+    else:
+        pairs = sorted(MAPPING.items())
+        if args.all:
+            for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
+                for fn in files:
+                    if not fn.endswith(".py"):
+                        continue
+                    rel = os.path.relpath(os.path.join(root, fn), REPO)
+                    refrel = rel.replace("mxnet_tpu/", "", 1)
+                    if rel not in MAPPING and os.path.exists(os.path.join(REF, refrel)):
+                        pairs.append((rel, refrel))
+
+    failures = []
+    for repo_rel, ref_rel in pairs:
+        rp = os.path.join(REPO, repo_rel)
+        fp = os.path.join(REF, ref_rel)
+        if not os.path.exists(rp):
+            print(f"  (missing repo)  {repo_rel}")
+            continue
+        if not os.path.exists(fp):
+            print(f"  (missing ref)   {repo_rel}")
+            continue
+        r = similarity(rp, fp)
+        marker = ""
+        if args.threshold is not None and r >= args.threshold:
+            failures.append((repo_rel, r))
+            marker = "  <-- ABOVE THRESHOLD"
+        print(f"  {r:0.3f}  {repo_rel}{marker}")
+
+    if failures:
+        print(f"\n{len(failures)} file(s) at or above {args.threshold}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
