@@ -1,6 +1,12 @@
-"""Module API (reference: python/mxnet/module/)."""
+"""Module API: symbolic training drivers (Module, Bucketing, Sequential).
+
+Import-location parity with the reference python/mxnet/module package.
+"""
 from .base_module import BaseModule
-from .module import Module
-from .executor_group import DataParallelExecutorGroup
 from .bucketing_module import BucketingModule
+from .executor_group import DataParallelExecutorGroup
+from .module import Module
 from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "BucketingModule", "DataParallelExecutorGroup",
+           "Module", "SequentialModule"]

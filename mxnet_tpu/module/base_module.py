@@ -1,47 +1,81 @@
-"""BaseModule — the canonical train/score/predict loops (reference:
-python/mxnet/module/base_module.py:94, fit at :376)."""
+"""BaseModule: the train / score / predict driver shared by all modules.
+
+Behavioral parity surface: reference python/mxnet/module/base_module.py
+(fit/score/predict/iter_predict and the abstract bind/init/forward family).
+Independent implementation built around two small generators: a lookahead
+batch iterator (so ``prepare`` can see the next batch while the current one
+is in flight — the TPU analog of the reference's double-buffering) and a
+shared inference-batch generator feeding score / predict / iter_predict.
+"""
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
 from .. import metric as metric_mod
-from ..base import MXNetError
 from ..model import BatchEndParam
 from .. import ndarray as nd
 from ..context import cpu
 from ..initializer import Uniform
 
+_PARAM_KINDS = ("arg", "aux")
+_WEIGHT_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+
 
 def _as_list(obj):
-    if isinstance(obj, list):
-        return obj
-    return [obj]
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _fire(callbacks, *args):
+    """Invoke a callback or list of callbacks (ignoring None)."""
+    if callbacks is None:
+        return
+    for cb in _as_list(callbacks):
+        cb(*args)
+
+
+def _resolve_metric(m):
+    return m if isinstance(m, metric_mod.EvalMetric) else metric_mod.create(m)
 
 
 def _check_input_names(symbol, names, typename, throw):
-    """(reference: base_module.py:_check_input_names)"""
+    """Warn (or raise) when a declared input name is absent from the graph,
+    suggesting likely data/label argument names."""
+    args = symbol.list_arguments()
     for name in names:
-        if name in symbol.list_arguments():
+        if name in args:
             continue
-        candidates = [arg for arg in symbol.list_arguments()
-                      if not arg.endswith("_weight")
-                      and not arg.endswith("_bias")
-                      and not arg.endswith("_gamma")
-                      and not arg.endswith("_beta")]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
+        data_like = [a for a in args
+                     if not any(a.endswith(sfx) for sfx in _WEIGHT_SUFFIXES)]
+        msg = ("\033[91mYou created Module with Module(..., %s_names=%s) but "
+               "input with name '%s' is not found in symbol.list_arguments(). "
+               "Did you mean one of:\n\t%s\033[0m"
+               % (typename, str(names), name, "\n\t".join(data_like)))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
+def _lookahead(data_iter):
+    """Yield (batch, is_last) pairs, reading one batch ahead."""
+    it = iter(data_iter)
+    try:
+        pending = next(it)
+    except StopIteration:
+        return
+    while True:
+        try:
+            upcoming = next(it)
+        except StopIteration:
+            yield pending, True, None
+            return
+        yield pending, False, upcoming
+        pending = upcoming
+
+
 class BaseModule:
-    """The base class of a module (reference: base_module.py:94)."""
+    """Abstract module: a symbol + bound executors + parameters, with
+    high-level driver loops implemented on the abstract interface."""
 
     def __init__(self, logger=logging):
         self.logger = logger
@@ -53,84 +87,11 @@ class BaseModule:
         self._symbol = None
         self._total_exec_bytes = 0
 
-    # --- high-level interface ---------------------------------------------
+    # ------------------------------------------------------------------ fit
     def forward_backward(self, data_batch):
-        """(reference: base_module.py:189)"""
+        """One fused optimization step's compute half."""
         self.forward(data_batch, is_train=True)
         self.backward()
-
-    def score(self, eval_data, eval_metric, num_batch=None,
-              batch_end_callback=None, score_end_callback=None, reset=True,
-              epoch=0):
-        """Evaluate on eval_data (reference: base_module.py:score)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-        eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-        return eval_metric.get_name_value()
-
-    def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """(reference: base_module.py:iter_predict)"""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
-
-    def predict(self, eval_data, num_batch=None, merge_batches=True,
-                reset=True, always_output_list=False):
-        """(reference: base_module.py:predict)"""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -140,8 +101,9 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """The canonical training loop (reference: base_module.py:376)."""
-        assert num_epoch is not None, "please specify number of epochs"
+        """Train over ``train_data`` for ``num_epoch`` epochs."""
+        if num_epoch is None:
+            raise ValueError("please specify number of epochs")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -154,66 +116,172 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        train_metric = _resolve_metric(eval_metric)
+        validation_metric = (train_metric if validation_metric is None
+                             else validation_metric)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+            started = time.time()
+            train_metric.reset()
+            nbatch = self._fit_epoch(train_data, train_metric, monitor,
+                                     batch_end_callback, epoch)
 
-            for name, val in eval_metric.get_name_value():
+            for name, val in train_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - started)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # sync params from devices so callbacks / eval see fresh values
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
+            _fire(epoch_end_callback, epoch, self.symbol, arg_now, aux_now)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name,
-                                     val)
+                scores = self.score(eval_data, validation_metric,
+                                    score_end_callback=eval_end_callback,
+                                    batch_end_callback=eval_batch_end_callback,
+                                    epoch=epoch)
+                for name, val in scores:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
 
-    # --- symbol/params interface (implemented by subclasses) ---------------
+    def _fit_epoch(self, train_data, train_metric, monitor,
+                   batch_end_callback, epoch):
+        """One pass over train_data; returns the number of batches run."""
+        nbatch = 0
+        eval_metric = train_metric  # keep legacy name visible in locals()
+        for data_batch, _is_last, upcoming in _lookahead(train_data):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(data_batch)
+            self.update()
+            if upcoming is not None:
+                self.prepare(upcoming)
+            self.update_metric(train_metric, data_batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=train_metric, locals=locals()))
+            nbatch += 1
+        return nbatch
+
+    # ---------------------------------------------------------- inference
+    def _inference_batches(self, eval_data, num_batch, reset):
+        """Forward (is_train=False) over eval_data, yielding
+        (index, batch, depadded outputs)."""
+        if not (self.binded and self.params_initialized):
+            raise AssertionError("call bind and init_params first")
+        if reset:
+            eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                return
+            self.forward(batch, is_train=False)
+            keep = lambda o: o[0:o.shape[0] - batch.pad]  # noqa: E731
+            yield i, batch, [keep(o) for o in self.get_outputs()]
+
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0):
+        """Run a full evaluation pass and return metric name/value pairs."""
+        eval_metric = _resolve_metric(eval_metric)
+        eval_metric.reset()
+        seen = 0
+        for nbatch, batch, _outs in self._inference_batches(
+                eval_data, num_batch, reset):
+            self.update_metric(eval_metric, batch.label)
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals()))
+            seen += 1
+        _fire(score_end_callback,
+              BatchEndParam(epoch=epoch, nbatch=seen,
+                            eval_metric=eval_metric, locals=locals()))
+        return eval_metric.get_name_value()
+
+    def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Generator over (outputs, batch index, batch)."""
+        for i, batch, outs in self._inference_batches(eval_data, num_batch,
+                                                      reset):
+            yield outs, i, batch
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        """Collect predictions; optionally concatenate across batches."""
+        collected = [
+            [o.copy() for o in outs]
+            for _i, _batch, outs in self._inference_batches(eval_data,
+                                                            num_batch, reset)]
+        if not collected:
+            return collected
+        if not merge_batches:
+            return collected
+        width = len(collected[0])
+        if any(len(outs) != width for outs in collected):
+            raise AssertionError(
+                "Cannot merge batches, as num of outputs is not the same "
+                "in mini-batches. Maybe bucketing is used?")
+        merged = [nd.concatenate([outs[i] for outs in collected])
+                  for i in range(width)]
+        if width == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    # ------------------------------------------------------------- params
     @property
     def symbol(self):
         return self._symbol
 
+    def get_params(self):
+        raise NotImplementedError()
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        raise NotImplementedError()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    def save_params(self, fname):
+        """Write arg/aux params as a flat dict with arg:/aux: key prefixes."""
+        blobs = {}
+        for kind, params in zip(_PARAM_KINDS, self.get_params()):
+            for name, value in params.items():
+                blobs[f"{kind}:{name}"] = value.as_in_context(cpu())
+        nd.save(fname, blobs)
+
+    def load_params(self, fname):
+        """Inverse of save_params."""
+        split = {kind: {} for kind in _PARAM_KINDS}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind not in split or not name:
+                raise ValueError("Invalid param file " + fname)
+            split[kind][name] = value
+        self.set_params(split["arg"], split["aux"])
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        assert not merge_multi_context
+        return []
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        assert not states and not value
+
+    def install_monitor(self, mon):
+        raise NotImplementedError()
+
+    def prepare(self, data_batch):
+        """Hook called with the *next* batch before it is consumed."""
+
+    # ---------------------------------------------------- abstract surface
     @property
     def data_names(self):
         raise NotImplementedError()
@@ -234,60 +302,6 @@ class BaseModule:
     def output_shapes(self):
         raise NotImplementedError()
 
-    def get_params(self):
-        raise NotImplementedError()
-
-    def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
-        raise NotImplementedError()
-
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        self.init_params(initializer=None, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init, allow_extra=allow_extra)
-
-    def save_params(self, fname):
-        """(reference: base_module.py:save_params)"""
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v.as_in_context(cpu())
-                     for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v.as_in_context(cpu())
-                          for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
-
-    def load_params(self, fname):
-        """(reference: base_module.py:load_params)"""
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
-
-    def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        assert not merge_multi_context
-        return []
-
-    def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
-        assert not states and not value
-
-    def install_monitor(self, mon):
-        raise NotImplementedError()
-
-    def prepare(self, data_batch):
-        """Prepare for the next batch (no-op by default)."""
-
-    # --- computation interface (implemented by subclasses) -----------------
     def forward(self, data_batch, is_train=None):
         raise NotImplementedError()
 

@@ -1,316 +1,310 @@
-"""DataParallelExecutorGroup (reference:
-python/mxnet/module/executor_group.py:99, executor_manager.py:31).
+"""Data-parallel replica group: one bound Executor per context.
 
-One bound Executor per context; the batch is split along the batch axis
-(workload-weighted `_split_input_slice`), each replica runs its own compiled
-XLA program asynchronously (jax async dispatch gives the overlap the
-reference gets from the dependency engine), and gradient aggregation happens
-in KVStore/psum afterwards. On a TPU mesh the preferred layout is instead ONE
-sharded executor under pjit (mxnet_tpu.parallel); this group exists for
+Parity surface: reference python/mxnet/module/executor_group.py — the batch
+is split along its batch axis proportionally to a workload list, each
+replica runs its own compiled XLA program (jax async dispatch provides the
+overlap the reference gets from its dependency engine), outputs/grads are
+gathered on demand. On a TPU mesh the preferred layout is ONE sharded
+executor under pjit (mxnet_tpu.parallel); this group exists for
 context-list parity.
 """
 from __future__ import annotations
 
 import logging
-from collections import namedtuple
 
 import numpy as np
 
-from ..base import MXNetError
 from .. import ndarray as nd
 from ..io import DataDesc
 
-_SliceRange = namedtuple("_SliceRange", ["start", "stop"])
-
 
 def _split_input_slice(batch_size, work_load_list):
-    """Workload-weighted batch split (reference: executor_manager.py:31)."""
-    total_work_load = sum(work_load_list)
-    batch_num_list = [round(work_load * batch_size / total_work_load)
-                      for work_load in work_load_list]
-    batch_num_sum = sum(batch_num_list)
-    if batch_num_sum < batch_size:
-        batch_num_list[-1] += batch_size - batch_num_sum
-    slices = []
-    end = 0
-    for batch_num in batch_num_list:
-        begin = int(min(end, batch_size))
-        end = int(min(begin + batch_num, batch_size))
-        if begin >= end:
+    """Proportional batch split: each device's share is its workload
+    fraction (rounded); the final device absorbs rounding error. Raises if
+    any share rounds to zero."""
+    total = sum(work_load_list)
+    shares = [round(batch_size * w / total) for w in work_load_list]
+    shares[-1] += batch_size - sum(shares)
+    cuts = []
+    cursor = 0
+    for share in shares:
+        lo = min(cursor, batch_size)
+        hi = min(lo + share, batch_size)
+        if hi <= lo:
             raise ValueError("Too many slices. Some splits are empty.")
-        slices.append(slice(begin, end))
-    return slices
+        cuts.append(slice(int(lo), int(hi)))
+        cursor = hi
+    return cuts
 
 
-def _load_general(data, targets, major_axis):
-    """Scatter batch slices to per-device arrays (reference:
-    executor_group.py:65)."""
-    for d_src, d_targets in zip(data, targets):
-        if isinstance(d_targets, nd.NDArray):
-            d_src.copyto(d_targets)
+def _scatter(sources, destinations, major_axis=0):
+    """Copy each source array into its per-replica destination slots.
+
+    ``destinations[j]`` is either a single NDArray (broadcast copy) or a
+    list of (slice, array) pairs describing the replica split.
+    """
+    for src, dests in zip(sources, destinations):
+        if isinstance(dests, nd.NDArray):
+            src.copyto(dests)
+            continue
+        for cut, dst in dests:
+            if major_axis in (0, None):
+                src[cut].copyto(dst)
+            else:
+                host = src.asnumpy()
+                sel = [slice(None)] * host.ndim
+                sel[major_axis] = cut
+                dst._set_data(nd.array(host[tuple(sel)])._data)
+
+
+def _gather(per_output_tensors, axes):
+    """Concatenate replica outputs along their batch axes (or pass through
+    when a single replica / no batch axis)."""
+    merged = []
+    for tensors, axis in zip(per_output_tensors, axes):
+        if len(tensors) > 1 and axis >= 0:
+            merged.append(nd.concatenate(tensors, axis=axis))
         else:
-            for slice_idx, d_dst in d_targets:
-                if major_axis == 0 or major_axis is None:
-                    d_src[slice_idx].copyto(d_dst)
-                else:
-                    src_np = d_src.asnumpy()
-                    idx = [slice(None)] * src_np.ndim
-                    idx[major_axis] = slice_idx
-                    d_dst._set_data(nd.array(src_np[tuple(idx)])._data)
+            merged.append(tensors[0])
+    return merged
 
 
-def _merge_multi_context(outputs, major_axis):
-    """Gather per-device outputs (reference: executor_group.py:merge)."""
-    rets = []
-    for tensors, axis in zip(outputs, major_axis):
-        if axis >= 0 and len(tensors) > 1:
-            rets.append(nd.concatenate(tensors, axis=axis))
-        else:
-            rets.append(tensors[0])
-    return rets
+def _normalize_grad_req(grad_req, arg_names, param_names, data_names,
+                        fixed_param_names, inputs_need_grad):
+    """Expand user grad_req into a per-argument dict."""
+
+    def default_for(name, req):
+        if name in param_names:
+            return "null" if name in fixed_param_names else req
+        if name in data_names:
+            return req if inputs_need_grad else "null"
+        return "null"
+
+    if isinstance(grad_req, str):
+        return {a: default_for(a, grad_req) for a in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        if len(grad_req) != len(arg_names):
+            raise ValueError("grad_req list must cover every argument")
+        return dict(zip(arg_names, grad_req))
+    if isinstance(grad_req, dict):
+        table = {a: default_for(a, "write") for a in arg_names}
+        table.update(grad_req)
+        return table
+    raise ValueError("grad_req must be one of str, list, tuple, or dict.")
 
 
 class DataParallelExecutorGroup:
-    """Replica manager for multi-context data parallelism."""
+    """Manages the per-context executors behind Module."""
 
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=logging, fixed_param_names=None, grad_req="write",
                  state_names=None):
-        self.param_names = param_names
-        self.arg_names = symbol.list_arguments()
-        self.aux_names = symbol.list_auxiliary_states()
         self.symbol = symbol
         self.contexts = contexts
         self.workload = workload
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.logger = logger
+
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
         self.fixed_param_names = fixed_param_names or []
         self.state_names = state_names or []
-        if not for_training:
-            grad_req = "null"
 
-        data_names = [x[0] for x in data_shapes]
-        if isinstance(grad_req, str):
-            self.grad_req = {}
-            for k in self.arg_names:
-                if k in self.param_names:
-                    self.grad_req[k] = ("null" if k in self.fixed_param_names
-                                        else grad_req)
-                elif k in data_names:
-                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
-                else:
-                    self.grad_req[k] = "null"
-        elif isinstance(grad_req, (list, tuple)):
-            assert len(grad_req) == len(self.arg_names)
-            self.grad_req = dict(zip(self.arg_names, grad_req))
-        elif isinstance(grad_req, dict):
-            self.grad_req = {}
-            for k in self.arg_names:
-                if k in self.param_names:
-                    self.grad_req[k] = ("null" if k in self.fixed_param_names
-                                        else "write")
-                elif k in data_names:
-                    self.grad_req[k] = "write" if inputs_need_grad else "null"
-                else:
-                    self.grad_req[k] = "null"
-            self.grad_req.update(grad_req)
-        else:
-            raise ValueError("grad_req must be one of str, list, tuple, or "
-                             "dict.")
+        self.grad_req = _normalize_grad_req(
+            grad_req if for_training else "null",
+            self.arg_names, self.param_names,
+            [d[0] for d in data_shapes],
+            self.fixed_param_names, inputs_need_grad)
 
         self._shared_group = shared_group
         self.execs = []
-        self.data_shapes = None
-        self.label_shapes = None
-        self.data_layouts = None
-        self.label_layouts = None
+        self.data_shapes = self.label_shapes = None
+        self.data_layouts = self.label_layouts = None
         self.output_layouts = [
-            DataDesc.get_batch_axis(self.symbol[i].attr("__layout__"))
-            for i in range(len(self.symbol.list_outputs()))]
+            DataDesc.get_batch_axis(symbol[i].attr("__layout__"))
+            for i in range(len(symbol.list_outputs()))]
         self.bind_exec(data_shapes, label_shapes, shared_group)
 
+    # ---------------------------------------------------------------- bind
     def decide_slices(self, data_shapes):
-        """(reference: executor_group.py:decide_slices)"""
-        assert len(data_shapes) > 0
-        major_axis = [DataDesc.get_batch_axis(getattr(x, "layout", "NCHW"))
-                      for x in data_shapes]
-        for (name, shape), axis in zip(data_shapes, major_axis):
+        """Record the common batch size and replica slices; returns the
+        batch axis of every input (from its layout string)."""
+        if not data_shapes:
+            raise ValueError("need at least one input to split")
+        axes = [DataDesc.get_batch_axis(getattr(d, "layout", "NCHW"))
+                for d in data_shapes]
+        for (name, shape), axis in zip(data_shapes, axes):
             if axis == -1:
                 continue
-            batch_size = shape[axis]
-            if self.batch_size is not None:
-                assert batch_size == self.batch_size, \
-                    ("all data must have the same batch size: batch_size = %d"
-                     ", but %s has shape %s" % (self.batch_size, name, shape))
-            else:
-                self.batch_size = batch_size
+            if self.batch_size is None:
+                self.batch_size = shape[axis]
                 self.slices = _split_input_slice(self.batch_size,
                                                  self.workload)
-        return major_axis
+            elif shape[axis] != self.batch_size:
+                raise AssertionError(
+                    "all data must have the same batch size: batch_size = %d"
+                    ", but %s has shape %s" % (self.batch_size, name, shape))
+        return axes
 
     def bind_exec(self, data_shapes, label_shapes, shared_group=None,
                   reshape=False):
-        """Bind one executor per context (reference: executor_group.py:302)."""
+        """(Re)create one executor per context for the given shapes."""
         self.batch_size = None
         self.data_layouts = self.decide_slices(data_shapes)
-        if label_shapes is not None:
-            self.label_layouts = self.decide_slices(label_shapes)
-        self.execs = []
-        for i in range(len(self.contexts)):
-            self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes,
-                                                  shared_group))
+        self.label_layouts = (self.decide_slices(label_shapes)
+                              if label_shapes is not None else None)
+        self.execs = [self._bind_replica(i, data_shapes, label_shapes,
+                                         shared_group)
+                      for i in range(len(self.contexts))]
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
-        self.data_names = [i.name for i in self.data_shapes]
+        self.data_names = [d.name for d in data_shapes]
         if label_shapes is not None:
-            self.label_names = [i.name for i in self.label_shapes]
-        self._collect_arrays()
+            self.label_names = [d.name for d in label_shapes]
+        self._index_arrays()
 
     def reshape(self, data_shapes, label_shapes):
-        if data_shapes == self.data_shapes and label_shapes == self.label_shapes:
+        if (data_shapes, label_shapes) == (self.data_shapes,
+                                           self.label_shapes):
             return
         self.bind_exec(data_shapes, label_shapes, reshape=True)
 
-    def _sliced_shape(self, shapes, i, major_axis):
-        """(reference: executor_group.py:_sliced_shape)"""
-        sliced_shapes = []
-        for desc, axis in zip(shapes, major_axis):
-            shape = list(desc.shape)
+    def _replica_descs(self, shapes, i, axes):
+        """Input descs for replica ``i``: batch axis cut to its slice."""
+        descs = []
+        for desc, axis in zip(shapes, axes):
+            dims = list(desc.shape)
             if axis >= 0:
-                shape[axis] = self.slices[i].stop - self.slices[i].start
-            sliced_shapes.append(DataDesc(desc.name, tuple(shape),
-                                          getattr(desc, "dtype", np.float32),
-                                          getattr(desc, "layout", "NCHW")))
-        return sliced_shapes
+                cut = self.slices[i]
+                dims[axis] = cut.stop - cut.start
+            descs.append(DataDesc(desc.name, tuple(dims),
+                                  getattr(desc, "dtype", np.float32),
+                                  getattr(desc, "layout", "NCHW")))
+        return descs
 
-    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
-        """simple_bind the i-th replica (reference: executor_group.py:562)."""
-        shared_exec = None if shared_group is None else shared_group.execs[i]
-        context = self.contexts[i]
-        shared_data_arrays = {}
-        input_shapes = dict(
-            [(x.name, x.shape)
-             for x in self._sliced_shape(data_shapes, i, self.data_layouts)])
+    def _bind_replica(self, i, data_shapes, label_shapes, shared_group):
+        """simple_bind replica ``i`` on its context."""
+        shapes = {d.name: d.shape
+                  for d in self._replica_descs(data_shapes, i,
+                                               self.data_layouts)}
         if label_shapes is not None:
-            input_shapes.update(
-                [(x.name, x.shape)
-                 for x in self._sliced_shape(label_shapes, i,
-                                             self.label_layouts)])
-        executor = self.symbol.simple_bind(
-            ctx=context, grad_req=self.grad_req, shared_exec=shared_exec,
-            **input_shapes)
-        return executor
+            shapes.update(
+                {d.name: d.shape
+                 for d in self._replica_descs(label_shapes, i,
+                                              self.label_layouts)})
+        return self.symbol.simple_bind(
+            ctx=self.contexts[i], grad_req=self.grad_req,
+            shared_exec=None if shared_group is None else shared_group.execs[i],
+            **shapes)
 
-    def _collect_arrays(self):
-        """(reference: executor_group.py:_collect_arrays)"""
-        self.data_arrays = [
-            [(self.slices[i], e.arg_dict[name]) for i, e in
-             enumerate(self.execs)]
-            for name, _ in self.data_shapes]
-        if self.label_shapes is not None:
-            self.label_arrays = [
-                [(self.slices[i], e.arg_dict[name]) for i, e in
-                 enumerate(self.execs)]
-                for name, _ in self.label_shapes]
-        else:
-            self.label_arrays = None
-        self.param_arrays = [
-            [exec_.arg_dict[name] for exec_ in self.execs]
-            for name in self.param_names if name in self.arg_names]
-        if self.for_training:
-            self.grad_arrays = [
-                [exec_.grad_dict.get(name) for exec_ in self.execs]
-                for name in self.param_names if name in self.arg_names]
-        else:
-            self.grad_arrays = None
-        data_names = [x[0] for x in self.data_shapes]
-        if self.inputs_need_grad:
-            self.input_grad_arrays = [
-                [exec_.grad_dict.get(name) for exec_ in self.execs]
-                for name in data_names]
-        else:
-            self.input_grad_arrays = None
-        self.aux_arrays = [
-            [exec_.aux_dict[name] for exec_ in self.execs]
-            for name in self.aux_names]
+    def _index_arrays(self):
+        """Build the name-major views over per-replica executor arrays."""
 
+        def sliced(names):
+            return [[(self.slices[i], e.arg_dict[name])
+                     for i, e in enumerate(self.execs)] for name in names]
+
+        def replicated(dict_name, names):
+            return [[getattr(e, dict_name).get(name) for e in self.execs]
+                    for name in names]
+
+        self.data_arrays = sliced(self.data_names)
+        self.label_arrays = (sliced(self.label_names)
+                             if self.label_shapes is not None else None)
+        bound_params = [n for n in self.param_names if n in self.arg_names]
+        self.param_arrays = replicated("arg_dict", bound_params)
+        self.grad_arrays = (replicated("grad_dict", bound_params)
+                            if self.for_training else None)
+        self.input_grad_arrays = (replicated("grad_dict", self.data_names)
+                                  if self.inputs_need_grad else None)
+        self.aux_arrays = replicated("aux_dict", self.aux_names)
+
+    # -------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params, allow_extra=False):
-        """(reference: executor_group.py:set_params)"""
-        for exec_ in self.execs:
-            exec_.copy_params_from(arg_params, aux_params,
-                                   allow_extra_params=allow_extra)
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params,
+                               allow_extra_params=allow_extra)
 
     def get_params(self, arg_params, aux_params):
-        """Merge per-device params back (reference: executor_group.py:get_params)."""
-        for name, block in zip(self.param_names, self.param_arrays):
-            weight = sum(b.as_in_context(block[0].context)
-                         for b in block) / len(block)
-            weight.astype(arg_params[name].dtype).copyto(arg_params[name])
-        for name, block in zip(self.aux_names, self.aux_arrays):
-            weight = sum(b.as_in_context(block[0].context)
-                         for b in block) / len(block)
-            weight.astype(aux_params[name].dtype).copyto(aux_params[name])
+        """Average each parameter across replicas into the host dicts."""
+        for name, replicas in list(zip(self.param_names, self.param_arrays)) \
+                + list(zip(self.aux_names, self.aux_arrays)):
+            home = replicas[0].context
+            mean = sum(r.as_in_context(home) for r in replicas) / len(replicas)
+            mean.astype(arg_params.get(name, aux_params.get(name)).dtype) \
+                .copyto(arg_params[name] if name in arg_params
+                        else aux_params[name])
 
+    # ------------------------------------------------------------- compute
     def forward(self, data_batch, is_train=None):
-        """Scatter + per-replica forward (reference: executor_group.py:394)."""
-        _load_general(data_batch.data, self.data_arrays, 0)
-        if is_train is None:
-            is_train = self.for_training
+        _scatter(data_batch.data, self.data_arrays)
         if self.label_arrays is not None and data_batch.label:
-            _load_general(data_batch.label, self.label_arrays, 0)
-        for exec_ in self.execs:
-            exec_.forward(is_train=is_train)
+            _scatter(data_batch.label, self.label_arrays)
+        train_flag = self.for_training if is_train is None else is_train
+        for e in self.execs:
+            e.forward(is_train=train_flag)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise AssertionError(
+                "re-bind with for_training=True to run backward")
+        for i, e in enumerate(self.execs):
+            piece = None
+            if out_grads is not None:
+                piece = [g[self.slices[i]].as_in_context(self.contexts[i])
+                         for g in out_grads]
+            e.backward(out_grads=piece)
+
+    def _replica_output_shapes(self):
+        """Output shapes of replica 0 — from its materialised outputs, or
+        (before the first forward) via symbol shape inference."""
+        outs = self.execs[0].outputs
+        if outs:
+            return [o.shape for o in outs]
+        feed = {d.name: d.shape
+                for d in self._replica_descs(self.data_shapes, 0,
+                                             self.data_layouts)}
+        if self.label_shapes is not None:
+            feed.update({d.name: d.shape
+                         for d in self._replica_descs(self.label_shapes, 0,
+                                                      self.label_layouts)})
+        _args, out_shapes, _auxs = self.symbol.infer_shape(**feed)
+        return out_shapes
 
     def get_output_shapes(self):
-        outputs = self.execs[0].outputs
-        shapes = [out.shape for out in outputs]
-        concat_shapes = []
-        for key, the_shape, axis in zip(self.symbol.list_outputs(), shapes,
-                                        self.output_layouts):
-            the_shape = list(the_shape)
+        """Merged (name, shape) pairs with the batch axis restored."""
+        merged = []
+        for name, shape, axis in zip(self.symbol.list_outputs(),
+                                     self._replica_output_shapes(),
+                                     self.output_layouts):
+            dims = list(shape)
             if axis >= 0:
-                the_shape[axis] = self.batch_size
-            concat_shapes.append((key, tuple(the_shape)))
-        return concat_shapes
+                dims[axis] = self.batch_size
+            merged.append((name, tuple(dims)))
+        return merged
 
     def get_outputs(self, merge_multi_context=True):
-        """(reference: executor_group.py:get_outputs)"""
-        outputs = [[exec_.outputs[i] for exec_ in self.execs]
+        columns = [[e.outputs[i] for e in self.execs]
                    for i in range(len(self.execs[0].outputs))]
-        if merge_multi_context:
-            out_axes = [axis if axis is not None and axis >= 0 else 0
-                        for axis in self.output_layouts]
-            outputs = _merge_multi_context(outputs, out_axes)
-        return outputs
+        if not merge_multi_context:
+            return columns
+        axes = [axis if axis is not None and axis >= 0 else 0
+                for axis in self.output_layouts]
+        return _gather(columns, axes)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.inputs_need_grad
-        if merge_multi_context:
-            return _merge_multi_context(self.input_grad_arrays,
-                                        [0] * len(self.input_grad_arrays))
-        return self.input_grad_arrays
-
-    def backward(self, out_grads=None):
-        """(reference: executor_group.py:526)"""
-        assert self.for_training, "re-bind with for_training=True to run backward"
-        for i, exec_ in enumerate(self.execs):
-            out_grads_slice = None
-            if out_grads is not None:
-                out_grads_slice = []
-                for grad in out_grads:
-                    og = grad[self.slices[i]]
-                    out_grads_slice.append(og.as_in_context(self.contexts[i]))
-            exec_.backward(out_grads=out_grads_slice)
+        if not merge_multi_context:
+            return self.input_grad_arrays
+        return _gather(self.input_grad_arrays,
+                       [0] * len(self.input_grad_arrays))
 
     def update_metric(self, eval_metric, labels):
-        """(reference: executor_group.py:555)"""
-        for texec, islice in zip(self.execs, self.slices):
-            labels_slice = []
-            for label in labels:
-                if label.shape[0] == self.batch_size:
-                    labels_slice.append(label[islice])
-                else:
-                    labels_slice.append(label)
-            eval_metric.update(labels_slice, texec.outputs)
+        """Feed each replica's outputs + its label slice to the metric."""
+        for e, cut in zip(self.execs, self.slices):
+            shard = [lbl[cut] if lbl.shape[0] == self.batch_size else lbl
+                     for lbl in labels]
+            eval_metric.update(shard, e.outputs)

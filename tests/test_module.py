@@ -193,3 +193,30 @@ def test_executor_monitor_callback_fires_per_node():
     ex2.backward()
     assert "fc_output" in seen
     assert np.abs(ex2.grad_dict["fc_weight"].asnumpy()).sum() > 0
+
+
+def test_sequential_module_fit():
+    """Two-stage SequentialModule with auto_wiring + take_labels trains and
+    exposes merged params (reference: sequential_module.py semantics)."""
+    import numpy as np
+    stage1 = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                              name="fc1"), act_type="relu")
+    stage2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc2"), name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(stage1, label_names=None))
+    seq.add(mx.mod.Module(stage2), take_labels=True, auto_wiring=True)
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(16, 10).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("float32")
+    train = mx.io.NDArrayIter(x, y, batch_size=4, label_name="softmax_label")
+    seq.fit(train, num_epoch=2, optimizer_params=(("learning_rate", 0.1),))
+
+    args, _ = seq.get_params()
+    assert sorted(args) == ["fc1_bias", "fc1_weight", "fc2_bias", "fc2_weight"]
+    out = seq.predict(train)
+    assert out.shape == (16, 4)
