@@ -1,9 +1,14 @@
-"""Evaluation metrics (reference: python/mxnet/metric.py, 1199 LoC).
+"""Evaluation metrics.
 
-Same registry + API (``mx.metric.create``, ``update(labels, preds)``,
-``get()``/``get_name_value()``). Metric math runs in numpy on host — metrics
-sit outside the compiled train step, exactly like the reference computes them
-on CPU outside the engine.
+Parity surface: reference python/mxnet/metric.py — same registry
+(``mx.metric.create``), class names, ``update(labels, preds)`` /
+``get_name_value()`` protocol, and accumulator attributes
+(``sum_metric``/``num_inst``). Independent implementation: metrics that
+consume aligned (label, prediction) pairs share one ``_PairwiseMetric``
+driver that handles device→numpy conversion, and the error-statistic family
+(MAE/MSE/RMSE) is generated from a reduction table. Metric math runs in
+numpy on host — metrics sit outside the compiled train step, exactly like
+the reference computes them on CPU outside its engine.
 """
 from __future__ import annotations
 
@@ -21,60 +26,64 @@ __all__ = [
     "CustomMetric", "np", "create",
 ]
 
-_METRIC_REGISTRY = {}
+_REGISTRY = {}
 
 
 def register(klass, *names):
-    for n in names or (klass.__name__.lower(),):
-        _METRIC_REGISTRY[n.lower()] = klass
+    """Register a metric class under one or more lowercase names."""
+    for alias in names or (klass.__name__.lower(),):
+        _REGISTRY[alias.lower()] = klass
     return klass
 
 
 def create(metric, *args, **kwargs):
-    """Create a metric from name / callable / list (reference: metric.py:create)."""
+    """Resolve a metric from a name, callable, instance, or list thereof."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite = CompositeEvalMetric()
-        for child in metric:
-            composite.add(create(child, *args, **kwargs))
-        return composite
+        bundle = CompositeEvalMetric()
+        for item in metric:
+            bundle.add(create(item, *args, **kwargs))
+        return bundle
     if isinstance(metric, str):
-        key = metric.lower()
-        if key not in _METRIC_REGISTRY:
+        try:
+            return _REGISTRY[metric.lower()](*args, **kwargs)
+        except KeyError:
             raise MXNetError("Metric must be either callable or in registry; "
                              "got %r" % metric)
-        return _METRIC_REGISTRY[key](*args, **kwargs)
     raise TypeError("metric should be string, callable, EvalMetric or list")
 
 
-def _as_numpy(x):
-    if isinstance(x, NDArray):
-        return x.asnumpy()
-    return numpy.asarray(x)
+def _fwd(local_vars, *extra):
+    """Collect the standard ctor passthrough kwargs from a locals() dict."""
+    keys = ("output_names", "label_names") + extra
+    return {k: local_vars[k] for k in keys}
+
+
+def _host(x):
+    """Bring a device array (or anything array-like) to numpy."""
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
 def check_label_shapes(labels, preds, shape=0):
-    """(reference: metric.py:check_label_shapes)"""
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    """Lengths (shape=0) or full shapes (shape=1) must agree."""
+    want = len(labels) if shape == 0 else labels.shape
+    got = len(preds) if shape == 0 else preds.shape
+    if want != got:
         raise ValueError(
-            "Shape of labels {} does not match shape of predictions {}".format(
-                label_shape, pred_shape))
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(want, got))
 
 
 class EvalMetric:
-    """Base metric (reference: metric.py:EvalMetric)."""
+    """Accumulating metric: update() folds batches into
+    (sum_metric, num_inst); get() reports their ratio."""
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
-        self.output_names = output_names
-        self.label_names = label_names
+        self.output_names, self.label_names = output_names, label_names
         self._kwargs = kwargs
         self.reset()
 
@@ -82,456 +91,378 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
-        return config
+        cfg = dict(self._kwargs,
+                   metric=type(self).__name__,
+                   name=self.name,
+                   output_names=self.output_names,
+                   label_names=self.label_names)
+        return cfg
+
+    def _select(self, mapping, wanted):
+        return ([mapping[k] for k in wanted] if wanted is not None
+                else list(mapping.values()))
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        self.update(self._select(label, self.label_names),
+                    self._select(pred, self.output_names))
 
     def update(self, labels, preds):
         raise NotImplementedError()
 
     def reset(self):
-        self.num_inst = 0
         self.sum_metric = 0.0
+        self.num_inst = 0
 
     def get(self):
-        if self.num_inst == 0:
+        if not self.num_inst:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
+
+
+class _PairwiseMetric(EvalMetric):
+    """Driver for metrics consuming aligned (label, pred) numpy pairs.
+
+    Subclasses implement ``_accumulate(label, pred) -> (value, weight)``.
+    """
+
+    check_shapes = True
+
+    def update(self, labels, preds):
+        if self.check_shapes:
+            check_label_shapes(labels, preds)
+        for raw_label, raw_pred in zip(labels, preds):
+            value, weight = self._accumulate(_host(raw_label), _host(raw_pred))
+            self.sum_metric += value
+            self.num_inst += weight
+
+    def _accumulate(self, label, pred):
+        raise NotImplementedError()
 
 
 @register
 class CompositeEvalMetric(EvalMetric):
-    """Manage multiple metrics (reference: metric.py:CompositeEvalMetric)."""
+    """A bundle of metrics updated together and reported jointly."""
 
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        super().__init__(name, **_fwd(locals()))
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
 
     def get_metric(self, index):
-        try:
+        if 0 <= index < len(self.metrics):
             return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+        return ValueError("Metric index {} is out of range 0 and {}"
+                          .format(index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
         if self.label_names is not None:
-            labels = {name: label for name, label in labels.items()
-                      if name in self.label_names}
+            labels = {k: v for k, v in labels.items()
+                      if k in self.label_names}
         if self.output_names is not None:
-            preds = {name: pred for name, pred in preds.items()
-                     if name in self.output_names}
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+            preds = {k: v for k, v in preds.items()
+                     if k in self.output_names}
+        for child in self.metrics:
+            child.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for child in self.metrics:
+            child.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for child in getattr(self, "metrics", ()):
+            child.reset()
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, numpy.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+        names, values = [], []
+        for child in self.metrics:
+            name, value = child.get()
+            names.extend([name] if isinstance(name, str) else name)
+            values.extend([value] if isinstance(value,
+                                                (float, int, numpy.generic))
+                          else value)
         return (names, values)
 
     def get_config(self):
-        config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
-        return config
+        cfg = super().get_config()
+        cfg["metrics"] = [child.get_config() for child in self.metrics]
+        return cfg
 
 
-class Accuracy(EvalMetric):
-    """Classification accuracy (reference: metric.py:Accuracy)."""
+class Accuracy(_PairwiseMetric):
+    """Fraction of samples whose arg-max prediction equals the label."""
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
-        super().__init__(name, axis=axis, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals(), "axis"))
         self.axis = axis
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred_label = _as_numpy(pred_label)
-            label = _as_numpy(label)
-            if pred_label.shape != label.shape:
-                pred_label = numpy.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.astype("int32").ravel()
-            label = label.astype("int32").ravel()
-            check_label_shapes(label, pred_label, shape=1)
-            self.sum_metric += (pred_label == label).sum()
-            self.num_inst += len(pred_label)
+    def _accumulate(self, label, pred):
+        if pred.shape != label.shape:
+            pred = numpy.argmax(pred, axis=self.axis)
+        pred = pred.astype("int32").ravel()
+        label = label.astype("int32").ravel()
+        check_label_shapes(label, pred, shape=1)
+        return (pred == label).sum(), pred.size
 
 
 register(Accuracy, "accuracy", "acc")
 
 
-@register
-class TopKAccuracy(EvalMetric):
-    """Top-k accuracy (reference: metric.py:TopKAccuracy)."""
+class TopKAccuracy(_PairwiseMetric):
+    """Fraction of samples whose label is among the k highest scores."""
 
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
-        super().__init__(name, top_k=top_k, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals(), "top_k"))
+        if top_k <= 1:
+            raise AssertionError(
+                "Please use Accuracy if top_k is no more than 1")
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += "_%d" % self.top_k
+        self.name = "%s_%d" % (self.name, top_k)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(_as_numpy(pred_label).astype("float32"),
-                                    axis=1)
-            label = _as_numpy(label).astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.ravel() == label.ravel()).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].ravel()
-                        == label.ravel()).sum()
-            self.num_inst += num_samples
+    def _accumulate(self, label, pred):
+        if pred.ndim > 2:
+            raise AssertionError("Predictions should be no more than 2 dims")
+        ranked = numpy.argsort(pred.astype("float32"), axis=1)
+        label = label.astype("int32")
+        check_label_shapes(label, ranked)
+        if ranked.ndim == 1:
+            return (ranked.ravel() == label.ravel()).sum(), ranked.shape[0]
+        classes = ranked.shape[1]
+        depth = min(classes, self.top_k)
+        # the last `depth` columns of the ascending argsort are the top-k
+        hits = (ranked[:, classes - depth:] == label.reshape(-1, 1)).sum()
+        return hits, ranked.shape[0]
 
 
 register(TopKAccuracy, "top_k_accuracy", "top_k_acc")
 
 
 @register
-class F1(EvalMetric):
-    """Binary F1 (reference: metric.py:F1)."""
+class F1(_PairwiseMetric):
+    """Binary F1 from vectorized confusion counts, averaged per batch."""
 
     def __init__(self, name="f1", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals()))
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = _as_numpy(pred)
-            label = _as_numpy(label).astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(numpy.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0.0, 0.0, 0.0
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.0
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.0
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.0
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.0
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.0
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.0
-            self.sum_metric += f1_score
-            self.num_inst += 1
+    def _accumulate(self, label, pred):
+        label = label.astype("int32")
+        decided = numpy.argmax(pred, axis=1)
+        check_label_shapes(label, pred)
+        if numpy.unique(label).size > 2:
+            raise ValueError(
+                "F1 currently only supports binary classification.")
+        tp = float(((decided == 1) & (label == 1)).sum())
+        fp = float(((decided == 1) & (label == 0)).sum())
+        fn = float(((decided == 0) & (label == 1)).sum())
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        score = (2 * precision * recall / (precision + recall)
+                 if precision + recall else 0.0)
+        return score, 1
 
 
 @register
 class Perplexity(EvalMetric):
-    """Perplexity (reference: metric.py:Perplexity)."""
+    """exp(mean negative log prob of the target tokens), with an optional
+    ignored padding label."""
 
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
-        super().__init__(name, ignore_label=ignore_label, axis=axis,
-                         output_names=output_names, label_names=label_names)
+        super().__init__(name, **_fwd(locals(), "ignore_label", "axis"))
         self.ignore_label = ignore_label
         self.axis = axis
 
     def update(self, labels, preds):
         assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.reshape((label.size,)).astype("int32")
-            probs = pred.reshape(-1, pred.shape[-1])[
-                numpy.arange(label.size), label]
+        total_nll, total_count = 0.0, 0
+        for raw_label, raw_pred in zip(labels, preds):
+            label = _host(raw_label)
+            pred = _host(raw_pred)
+            if label.size != pred.size // pred.shape[-1]:
+                raise AssertionError("shape mismatch: %s vs. %s"
+                                     % (label.shape, pred.shape))
+            flat = label.reshape(-1).astype("int32")
+            target_prob = pred.reshape(-1, pred.shape[-1])[
+                numpy.arange(flat.size), flat]
             if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= numpy.sum(ignore)
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.size
-        self.sum_metric += loss
-        self.num_inst += num
+                masked = (flat == self.ignore_label)
+                total_count -= int(masked.sum())
+                target_prob = numpy.where(masked, 1.0, target_prob)
+            total_nll -= numpy.log(numpy.maximum(1e-10, target_prob)).sum()
+            total_count += flat.size
+        self.sum_metric += total_nll
+        self.num_inst += total_count
 
     def get(self):
-        if self.num_inst == 0:
+        if not self.num_inst:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
-@register
-class MAE(EvalMetric):
-    """Mean absolute error (reference: metric.py:MAE)."""
-
-    def __init__(self, name="mae", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+def _column(arr):
+    """Regression inputs as 2-D column matrices."""
+    return arr.reshape(arr.shape[0], 1) if arr.ndim == 1 else arr
 
 
-@register
-class MSE(EvalMetric):
-    """Mean squared error (reference: metric.py:MSE)."""
+class _ErrorStat(_PairwiseMetric):
+    """Shared body for the per-batch mean-error family; subclasses set
+    ``_reduce`` to map a difference matrix to a scalar."""
 
-    def __init__(self, name="mse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+    _reduce = None
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def __init__(self, name=None, output_names=None, label_names=None):
+        super().__init__(name or type(self).__name__.lower(),
+                         **_fwd(locals()))
+
+    def _accumulate(self, label, pred):
+        diff = _column(label) - _column(pred)
+        return type(self)._reduce(diff), 1
 
 
 @register
-class RMSE(EvalMetric):
-    """Root mean squared error (reference: metric.py:RMSE)."""
-
-    def __init__(self, name="rmse", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+class MAE(_ErrorStat):
+    """Mean absolute error."""
+    _reduce = staticmethod(lambda diff: numpy.abs(diff).mean())
 
 
 @register
-class CrossEntropy(EvalMetric):
-    """Cross entropy of class probabilities (reference: metric.py:CrossEntropy)."""
+class MSE(_ErrorStat):
+    """Mean squared error."""
+    _reduce = staticmethod(lambda diff: (diff ** 2.0).mean())
 
-    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+
+@register
+class RMSE(_ErrorStat):
+    """Root mean squared error."""
+    _reduce = staticmethod(lambda diff: numpy.sqrt((diff ** 2.0).mean()))
+
+
+class _TargetNLL(_PairwiseMetric):
+    """Summed -log(prob of true class) over samples (base for CE / NLL)."""
+
+    def __init__(self, eps=1e-12, name=None, output_names=None,
                  label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals(), "eps"))
         self.eps = eps
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def _accumulate(self, label, pred):
+        flat = label.ravel()
+        count = pred.shape[0]
+        if flat.shape[0] != count:
+            raise AssertionError((flat.shape[0], count))
+        chosen = pred[numpy.arange(count, dtype=numpy.int64),
+                      numpy.int64(flat)]
+        return -numpy.log(chosen + self.eps).sum(), count
+
+
+class CrossEntropy(_TargetNLL):
+    """Cross entropy against one-hot labels given class probabilities."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
 
 
 register(CrossEntropy, "cross-entropy", "ce")
 
 
-@register
-class NegativeLogLikelihood(EvalMetric):
-    """NLL (reference: metric.py:NegativeLogLikelihood)."""
+class NegativeLogLikelihood(_TargetNLL):
+    """Negative log likelihood of the labels under predicted probabilities."""
 
-    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
-                 label_names=None):
-        super().__init__(name, eps=eps, output_names=output_names,
-                         label_names=label_names)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, \
-                (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
 
 
 register(NegativeLogLikelihood, "nll-loss", "nll_loss")
 
 
 @register
-class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (reference: metric.py:PearsonCorrelation)."""
+class PearsonCorrelation(_PairwiseMetric):
+    """Mean per-batch Pearson correlation coefficient."""
 
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals()))
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, 1)
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            self.sum_metric += numpy.corrcoef(pred.ravel(), label.ravel())[0, 1]
-            self.num_inst += 1
+    def _accumulate(self, label, pred):
+        check_label_shapes(label, pred, 1)
+        return numpy.corrcoef(pred.ravel(), label.ravel())[0, 1], 1
 
 
 @register
 class Loss(EvalMetric):
-    """Mean of a loss output (reference: metric.py:Loss)."""
+    """Running mean of a loss output (labels are ignored)."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals()))
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += _as_numpy(pred).sum()
+            self.sum_metric += _host(pred).sum()
             self.num_inst += pred.size
 
 
 @register
 class Torch(Loss):
-    """(reference: metric.py:Torch)"""
+    """Alias of Loss kept for reference parity (torch plugin outputs)."""
 
     def __init__(self, name="torch", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals()))
 
 
 @register
 class Caffe(Loss):
-    """(reference: metric.py:Caffe)"""
+    """Alias of Loss kept for reference parity (caffe plugin outputs)."""
 
     def __init__(self, name="caffe", output_names=None, label_names=None):
-        super().__init__(name, output_names=output_names,
-                         label_names=label_names)
+        super().__init__(name, **_fwd(locals()))
 
 
 @register
 class CustomMetric(EvalMetric):
-    """Metric from a python function (reference: metric.py:CustomMetric)."""
+    """Wrap feval(label, pred) -> value or (sum, count) as a metric."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
-        super().__init__(name, feval=feval,
-                         allow_extra_outputs=allow_extra_outputs,
-                         output_names=output_names, label_names=label_names)
+        super().__init__(name, **_fwd(locals(), "feval",
+                                      "allow_extra_outputs"))
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+        for raw_pred, raw_label in zip(preds, labels):
+            outcome = self._feval(_host(raw_label), _host(raw_pred))
+            if isinstance(outcome, tuple):
+                part, weight = outcome
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                part, weight = outcome, 1
+            self.sum_metric += part
+            self.num_inst += weight
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy eval function as a metric (reference: metric.py:np)."""
+    """Lift a plain numpy_feval(label, pred) function into a metric."""
+    import functools
 
+    @functools.wraps(numpy_feval)
     def feval(label, pred):
         return numpy_feval(label, pred)
 
-    feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
