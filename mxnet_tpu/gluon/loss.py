@@ -1,7 +1,13 @@
-"""Gluon losses (reference: python/mxnet/gluon/loss.py:698)."""
-from __future__ import annotations
+"""Gluon loss blocks.
 
-import numpy as np
+Parity surface: reference gluon/loss.py (class names, ctor signatures,
+weighting semantics). Independent implementation: every loss computes a raw
+elementwise term and hands it to one shared ``_finish`` step (sample
+weighting, scalar weight, mean over the non-batch axes); the numerically
+stable binary cross entropy core is shared between the sigmoid BCE and
+logistic losses.
+"""
+from __future__ import annotations
 
 from .block import HybridBlock
 
@@ -12,11 +18,12 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """(reference: loss.py:_apply_weighting)"""
+    """Optional per-sample weights then optional scalar weight."""
     if sample_weight is not None:
         loss = F.broadcast_mul(loss, sample_weight)
     if weight is not None:
-        assert isinstance(weight, (float, int)), "weight must be a number"
+        if not isinstance(weight, (float, int)):
+            raise AssertionError("weight must be a number")
         loss = loss * weight
     return loss
 
@@ -25,8 +32,14 @@ def _reshape_like(F, x, y):
     return x.reshape(y.shape)
 
 
+def _stable_bce(F, pred, label):
+    """-log sigmoid pieces computed as relu(x) - x*y + softplus(-|x|)."""
+    return (F.relu(pred) - pred * label
+            + F.Activation(-F.abs(pred), act_type="softrelu"))
+
+
 class Loss(HybridBlock):
-    """Base loss (reference: loss.py:Loss)."""
+    """Base class: holds the scalar weight and the batch axis."""
 
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
@@ -34,41 +47,44 @@ class Loss(HybridBlock):
         self._batch_axis = batch_axis
 
     def __repr__(self):
-        s = "{name}(batch_axis={_batch_axis}, w={_weight})"
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return "{name}(batch_axis={_batch_axis}, w={_weight})".format(
+            name=type(self).__name__, **self.__dict__)
+
+    def _finish(self, F, loss, sample_weight, weight=None):
+        """Weighting + mean over everything except the batch axis."""
+        loss = _apply_weighting(F, loss,
+                                self._weight if weight is None else weight,
+                                sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
 class L2Loss(Loss):
-    """0.5*(pred-label)^2 (reference: loss.py:L2Loss)."""
+    """Half squared error."""
 
     def __init__(self, weight=1.0, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(pred - label)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        term = F.square(pred - _reshape_like(F, label, pred))
+        return self._finish(F, term, sample_weight, weight=self._weight / 2)
 
 
 class L1Loss(Loss):
-    """|pred-label| (reference: loss.py:L1Loss)."""
+    """Absolute error."""
 
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        term = F.abs(pred - _reshape_like(F, label, pred))
+        return self._finish(F, term, sample_weight)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
-    """(reference: loss.py:SigmoidBinaryCrossEntropyLoss)"""
+    """BCE over logits (default) or over probabilities (from_sigmoid)."""
 
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
@@ -77,22 +93,19 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
         label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # log(1+exp(-|x|)) + max(x,0) - x*y  — numerically stable
-            loss = F.relu(pred) - pred * label + \
-                F.Activation(-F.abs(pred), act_type="softrelu")
+        if self._from_sigmoid:
+            term = -(F.log(pred + 1e-12) * label
+                     + F.log(1. - pred + 1e-12) * (1. - label))
         else:
-            loss = -(F.log(pred + 1e-12) * label +
-                     F.log(1. - pred + 1e-12) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            term = _stable_bce(F, pred, label)
+        return self._finish(F, term, sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """(reference: loss.py:SoftmaxCrossEntropyLoss)"""
+    """CE with integer (sparse) or dense labels; logits by default."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
@@ -102,22 +115,21 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits else F.log_softmax(pred,
+                                                            axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            term = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            term = -F.sum(logp * _reshape_like(F, label, logp),
+                          axis=self._axis, keepdims=True)
+        return self._finish(F, term, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
-    """(reference: loss.py:KLDivLoss)"""
+    """KL(label || softmax(pred)); pred already log-probs when from_logits."""
 
     def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
                  **kwargs):
@@ -126,110 +138,102 @@ class KLDivLoss(Loss):
         self._axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits else F.log_softmax(pred,
+                                                            axis=self._axis)
+        term = label * (F.log(label + 1e-12) - logp)
+        return self._finish(F, term, sample_weight)
 
 
 class HuberLoss(Loss):
-    """(reference: loss.py:HuberLoss)"""
+    """Quadratic near zero, linear past ``rho``."""
 
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        err = F.abs(pred - _reshape_like(F, label, pred))
+        term = F.where(err > self._rho,
+                       err - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(err))
+        return self._finish(F, term, sample_weight)
 
 
-class HingeLoss(Loss):
-    """(reference: loss.py:HingeLoss)"""
+class _MarginLoss(Loss):
+    """Common ctor for the margin-based hinge family."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
 
-
-class SquaredHingeLoss(Loss):
-    """(reference: loss.py:SquaredHingeLoss)"""
-
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
+class HingeLoss(_MarginLoss):
+    """max(0, margin - pred*label) with signed labels."""
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        term = F.relu(self._margin - pred * _reshape_like(F, label, pred))
+        return self._finish(F, term, sample_weight)
+
+
+class SquaredHingeLoss(_MarginLoss):
+    """Squared hinge."""
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        term = F.square(
+            F.relu(self._margin - pred * _reshape_like(F, label, pred)))
+        return self._finish(F, term, sample_weight)
 
 
 class LogisticLoss(Loss):
-    """(reference: loss.py:LogisticLoss)"""
+    """BCE over logits with signed (default) or binary labels."""
 
     def __init__(self, weight=None, batch_axis=0, label_format="signed",
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._label_format = label_format
-        if self._label_format not in ["signed", "binary"]:
+        if label_format not in ("signed", "binary"):
             raise ValueError(
                 "label_format can only be signed or binary, recieved %s."
                 % label_format)
+        self._label_format = label_format
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
         label = _reshape_like(F, label, pred)
         if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            label = (label + 1.0) / 2.0  # map {-1,1} -> {0,1}
+        return self._finish(F, _stable_bce(F, pred, label), sample_weight)
 
 
-class TripletLoss(Loss):
-    """(reference: loss.py:TripletLoss)"""
-
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
+class TripletLoss(_MarginLoss):
+    """max(0, margin + d(pred,pos) - d(pred,neg)) with squared distances."""
 
     def hybrid_forward(self, F, pred, positive, negative):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(pred - positive) - F.square(pred - negative),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, None)
+        gap = (F.square(pred - _reshape_like(F, positive, pred))
+               - F.square(pred - _reshape_like(F, negative, pred)))
+        per_sample = F.sum(gap, axis=self._batch_axis, exclude=True)
+        return _apply_weighting(F, F.relu(per_sample + self._margin),
+                                self._weight, None)
 
 
 class CTCLoss(Loss):
-    """Connectionist Temporal Classification loss (reference:
-    loss.py:CTCLoss / src/operator/contrib/ctc_loss.cc — vendored warp-ctc).
-    Implemented with the standard alpha-recursion in log space via lax.scan."""
+    """Connectionist Temporal Classification.
+
+    The alpha recursion runs in log space inside the registered ctc_loss op
+    (ops/contrib.py, a lax.scan kernel — the reference vendored warp-ctc,
+    src/operator/contrib/ctc_loss.cc). This block only normalises layouts.
+    """
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
-        assert layout in ["NTC", "TNC"], \
-            "Only 'NTC' and 'TNC' layouts for pred are supported. Got: %s" % layout
-        assert label_layout in ["NT", "TN"], \
-            "Only 'NT' and 'TN' layouts for label are supported. Got: %s" % label_layout
+        if layout not in ("NTC", "TNC"):
+            raise AssertionError(
+                "Only 'NTC' and 'TNC' layouts for pred are supported. "
+                "Got: %s" % layout)
+        if label_layout not in ("NT", "TN"):
+            raise AssertionError(
+                "Only 'NT' and 'TN' layouts for label are supported. "
+                "Got: %s" % label_layout)
         self._layout = layout
         self._label_layout = label_layout
-        batch_axis = label_layout.find("N")
-        super().__init__(weight, batch_axis, **kwargs)
+        super().__init__(weight, label_layout.find("N"), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
@@ -237,9 +241,8 @@ class CTCLoss(Loss):
             pred = F.swapaxes(pred, dim1=0, dim2=1)
         if self._batch_axis == 1:
             label = F.swapaxes(label, dim1=0, dim2=1)
-        extra = [x for x in (pred_lengths, label_lengths) if x is not None]
-        loss = F.ctc_loss(pred, label, *extra,
-                          use_data_lengths=pred_lengths is not None,
-                          use_label_lengths=label_lengths is not None)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return loss
+        lengths = [x for x in (pred_lengths, label_lengths) if x is not None]
+        raw = F.ctc_loss(pred, label, *lengths,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None)
+        return _apply_weighting(F, raw, self._weight, sample_weight)

@@ -1,124 +1,87 @@
-"""Basic Gluon layers (reference: python/mxnet/gluon/nn/basic_layers.py:564)."""
+"""Core Gluon layers: containers, Dense, BatchNorm, Dropout, Embedding.
+
+Parity surface: reference gluon/nn/basic_layers.py (class names, ctor
+signatures, child/param naming). Independent implementation: both
+sequential containers share one mixin, the single-op activation-style
+layers derive from a tiny ``_OpLayer`` template, and parameter creation
+goes through one helper.
+"""
 from __future__ import annotations
 
 import numpy as np
 
 from ..block import Block, HybridBlock
+from ..utils import _to_initializer as _init
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Activation", "Dropout",
            "BatchNorm", "LeakyReLU", "Embedding", "Flatten", "Lambda",
            "HybridLambda"]
 
 
-class Sequential(Block):
-    """Stack Blocks sequentially (reference: basic_layers.py:Sequential)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
+class _ChainMixin:
+    """add()/indexing/repr shared by the two sequential containers."""
 
     def add(self, *blocks):
         for block in blocks:
             self.register_child(block)
+
+    def __getitem__(self, i):
+        return self._children[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __repr__(self):
+        body = "\n".join(
+            "  (%d): %s" % (i, repr(child).replace("\n", "\n  "))
+            for i, child in enumerate(self._children))
+        return "%s(\n%s\n)" % (type(self).__name__, body)
+
+
+class Sequential(_ChainMixin, Block):
+    """Imperative container running children in insertion order."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
 
     def forward(self, x):
-        for block in self._children:
-            x = block(x)
+        for child in self._children:
+            x = child(x)
         return x
 
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(["  ({key}): {block}".format(
-            key=key, block=repr(block).replace("\n", "\n  "))
-            for key, block in enumerate(self._children)])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
 
-    def __getitem__(self, i):
-        return self._children[i]
-
-    def __len__(self):
-        return len(self._children)
-
-
-class HybridSequential(HybridBlock):
-    """Hybridizable Sequential (reference: basic_layers.py:HybridSequential)."""
+class HybridSequential(_ChainMixin, HybridBlock):
+    """Hybridizable container running children in insertion order."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
 
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
     def hybrid_forward(self, F, x):
-        for block in self._children:
-            x = block(x)
+        for child in self._children:
+            x = child(x)
         return x
 
-    def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(["  ({key}): {block}".format(
-            key=key, block=repr(block).replace("\n", "\n  "))
-            for key, block in enumerate(self._children)])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
 
-    def __getitem__(self, i):
-        return self._children[i]
+class _OpLayer(HybridBlock):
+    """A parameterless layer applying one registered operator.
 
-    def __len__(self):
-        return len(self._children)
+    Subclasses set ``_repr_tmpl`` and implement ``_apply(F, x)``.
+    """
 
+    _repr_tmpl = "{cls}"
 
-class Dense(HybridBlock):
-    """Fully-connected layer (reference: basic_layers.py:Dense)."""
-
-    def __init__(self, units, activation=None, use_bias=True, flatten=True,
-                 weight_initializer=None, bias_initializer="zeros",
-                 in_units=0, **kwargs):
-        super().__init__(**kwargs)
-        self._flatten = flatten
-        with self.name_scope():
-            self._units = units
-            self._in_units = in_units
-            self.weight = self.params.get(
-                "weight", shape=(units, in_units),
-                init=weight_initializer, allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    "bias", shape=(units,), init=_init(bias_initializer),
-                    allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + "_")
-            else:
-                self.act = None
-
-    def hybrid_forward(self, F, x, weight, bias=None):
-        if bias is None:
-            act = F.FullyConnected(x, weight, no_bias=True,
-                                   num_hidden=self._units,
-                                   flatten=self._flatten, name="fwd")
-        else:
-            act = F.FullyConnected(x, weight, bias, num_hidden=self._units,
-                                   flatten=self._flatten, name="fwd")
-        if self.act is not None:
-            act = self.act(act)
-        return act
+    def hybrid_forward(self, F, x):
+        return self._apply(F, x)
 
     def __repr__(self):
-        s = "{name}({layout}, {act})"
-        shape = self.weight.shape
-        return s.format(name=self.__class__.__name__,
-                        act=self.act if self.act else "linear",
-                        layout="{0} -> {1}".format(
-                            shape[1] if shape[1] else None, shape[0]))
+        return self._repr_tmpl.format(cls=type(self).__name__,
+                                      **vars(self))
 
 
-from ..utils import _to_initializer as _init
+class Activation(_OpLayer):
+    """Elementwise activation by name (relu/sigmoid/tanh/softrelu)."""
 
-
-class Activation(HybridBlock):
-    """(reference: basic_layers.py:Activation)"""
+    _repr_tmpl = "{cls}({_act_type})"
 
     def __init__(self, activation, **kwargs):
         self._act_type = activation
@@ -127,31 +90,85 @@ class Activation(HybridBlock):
     def _alias(self):
         return self._act_type
 
-    def hybrid_forward(self, F, x):
+    def _apply(self, F, x):
         return F.Activation(x, act_type=self._act_type, name="fwd")
 
-    def __repr__(self):
-        return "{name}({_act_type})".format(
-            name=self.__class__.__name__, _act_type=self._act_type)
 
+class Dropout(_OpLayer):
+    """Zero inputs with probability ``rate`` at train time."""
 
-class Dropout(HybridBlock):
-    """(reference: basic_layers.py:Dropout)"""
+    _repr_tmpl = "{cls}(p = {_rate})"
 
     def __init__(self, rate, **kwargs):
         super().__init__(**kwargs)
         self._rate = rate
 
-    def hybrid_forward(self, F, x):
+    def _apply(self, F, x):
         return F.Dropout(x, p=self._rate, name="fwd")
 
+
+class LeakyReLU(_OpLayer):
+    """max(x, alpha*x)."""
+
+    _repr_tmpl = "{cls}({_alpha})"
+
+    def __init__(self, alpha, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def _apply(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha, name="fwd")
+
+
+class Flatten(_OpLayer):
+    """Collapse all but the batch axis."""
+
+    def _apply(self, F, x):
+        return F.Flatten(x)
+
+
+class Dense(HybridBlock):
+    """y = act(x W^T + b), optionally flattening non-batch axes first."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._flatten = flatten
+        self._units = units
+        self._in_units = in_units
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units),
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(units,), init=_init(bias_initializer),
+                allow_deferred_init=True) if use_bias else None
+            self.act = (Activation(activation, prefix=activation + "_")
+                        if activation is not None else None)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        fc_kw = dict(num_hidden=self._units, flatten=self._flatten,
+                     name="fwd")
+        if bias is None:
+            out = F.FullyConnected(x, weight, no_bias=True, **fc_kw)
+        else:
+            out = F.FullyConnected(x, weight, bias, **fc_kw)
+        return out if self.act is None else self.act(out)
+
     def __repr__(self):
-        return "{name}(p = {_rate})".format(
-            name=self.__class__.__name__, _rate=self._rate)
+        shape = self.weight.shape
+        return "%s(%s -> %s, %s)" % (type(self).__name__,
+                                     shape[1] if shape[1] else None,
+                                     shape[0],
+                                     self.act if self.act else "linear")
 
 
 class BatchNorm(HybridBlock):
-    """(reference: basic_layers.py:BatchNorm)"""
+    """Batch normalization with running-stat aux state.
+
+    ``scale=False`` freezes gamma at 1; ``center=False`` freezes beta at 0.
+    """
 
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
@@ -163,24 +180,22 @@ class BatchNorm(HybridBlock):
                         "use_global_stats": use_global_stats}
         if in_channels != 0:
             self.in_channels = in_channels
-        self.gamma = self.params.get(
-            "gamma", grad_req="write" if scale else "null",
-            shape=(in_channels,), init=_init(gamma_initializer),
-            allow_deferred_init=True, differentiable=scale)
-        self.beta = self.params.get(
-            "beta", grad_req="write" if center else "null",
-            shape=(in_channels,), init=_init(beta_initializer),
-            allow_deferred_init=True, differentiable=center)
-        self.running_mean = self.params.get(
-            "running_mean", grad_req="null", shape=(in_channels,),
-            init=_init(running_mean_initializer), allow_deferred_init=True,
-            differentiable=False)
-        self.running_var = self.params.get(
-            "running_var", grad_req="null", shape=(in_channels,),
-            init=_init(running_variance_initializer),
-            allow_deferred_init=True, differentiable=False)
+
+        def channel_param(name, init, trainable):
+            return self.params.get(
+                name, grad_req="write" if trainable else "null",
+                shape=(in_channels,), init=_init(init),
+                allow_deferred_init=True, differentiable=trainable)
+
+        self.gamma = channel_param("gamma", gamma_initializer, scale)
+        self.beta = channel_param("beta", beta_initializer, center)
+        self.running_mean = channel_param("running_mean",
+                                          running_mean_initializer, False)
+        self.running_var = channel_param("running_var",
+                                         running_variance_initializer, False)
 
     def cast(self, dtype):
+        # BN statistics stay in fp32 even under half-precision casts
         if np.dtype(dtype).name == "float16":
             dtype = "float32"
         super().cast(dtype)
@@ -190,33 +205,14 @@ class BatchNorm(HybridBlock):
                            name="fwd", **self._kwargs)
 
     def __repr__(self):
-        s = "{name}({content}"
-        in_channels = self.gamma.shape[0]
-        s += ", in_channels={0}".format(in_channels if in_channels else None)
-        s += ")"
-        return s.format(name=self.__class__.__name__,
-                        content=", ".join(
-                            ["=".join([k, v.__repr__()])
-                             for k, v in self._kwargs.items()]))
-
-
-class LeakyReLU(HybridBlock):
-    """(reference: basic_layers.py:LeakyReLU)"""
-
-    def __init__(self, alpha, **kwargs):
-        super().__init__(**kwargs)
-        self._alpha = alpha
-
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha, name="fwd")
-
-    def __repr__(self):
-        return "{name}({alpha})".format(
-            name=self.__class__.__name__, alpha=self._alpha)
+        width = self.gamma.shape[0]
+        inner = ", ".join("%s=%r" % kv for kv in self._kwargs.items())
+        return "%s(%s, in_channels=%s)" % (type(self).__name__, inner,
+                                           width if width else None)
 
 
 class Embedding(HybridBlock):
-    """(reference: basic_layers.py:Embedding)"""
+    """Integer ids -> learned dense vectors."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, **kwargs):
@@ -231,50 +227,50 @@ class Embedding(HybridBlock):
         return F.Embedding(x, weight, name="fwd", **self._kwargs)
 
     def __repr__(self):
-        s = "{block_name}({input_dim} -> {output_dim}, {dtype})"
-        return s.format(block_name=self.__class__.__name__, **self._kwargs)
+        return "{cls}({input_dim} -> {output_dim}, {dtype})".format(
+            cls=type(self).__name__, **self._kwargs)
 
 
-class Flatten(HybridBlock):
-    """(reference: basic_layers.py:Flatten)"""
-
-    def hybrid_forward(self, F, x):
-        return F.Flatten(x)
-
-    def __repr__(self):
-        return self.__class__.__name__
+def _resolve_named_func(function, *namespaces):
+    """Look up a function by name in the given op namespaces (all must
+    provide it); returns the per-namespace mapping."""
+    table = {}
+    for ns in namespaces:
+        if not hasattr(ns, function):
+            raise AssertionError(
+                "Function name %s is not found in %s."
+                % (function, "/".join(n.__name__.split(".")[-1]
+                                      for n in namespaces)))
+        table[ns] = getattr(ns, function)
+    return table
 
 
 class Lambda(Block):
-    """Wrap a function as a Block (reference: basic_layers.py:Lambda)."""
+    """Wrap a free function (or an ndarray op name) as a Block."""
 
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
         from ... import ndarray as nd_mod
 
         if isinstance(function, str):
-            assert hasattr(nd_mod, function), \
-                "Function name %s is not found in ndarray." % function
-            self._func_impl = getattr(nd_mod, function)
+            self._func_impl = _resolve_named_func(function, nd_mod)[nd_mod]
+            self._func_name = function
         elif callable(function):
             self._func_impl = function
+            self._func_name = getattr(function, "__name__", str(function))
         else:
-            raise ValueError(
-                "Unrecognized function in lambda: {} of type {}".format(
-                    function, type(function)))
-        self._func_name = getattr(self._func_impl, "__name__", str(function))
+            raise ValueError("Lambda accepts an op name or a callable; got "
+                             "%r (%s)" % (function, type(function)))
 
     def forward(self, *args):
         return self._func_impl(*args)
 
     def __repr__(self):
-        return "{name}({function})".format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "%s(%s)" % (type(self).__name__, self._func_name)
 
 
 class HybridLambda(HybridBlock):
-    """Wrap a function as a HybridBlock (reference:
-    basic_layers.py:HybridLambda)."""
+    """Wrap an F-generic function (or op name) as a HybridBlock."""
 
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
@@ -282,23 +278,18 @@ class HybridLambda(HybridBlock):
         from ... import symbol as sym_mod
 
         if isinstance(function, str):
-            assert hasattr(nd_mod, function) and hasattr(sym_mod, function), \
-                "Function name %s is not found in symbol/ndarray." % function
-            func_dict = {sym_mod: getattr(sym_mod, function),
-                         nd_mod: getattr(nd_mod, function)}
-            self._func = lambda F, *args: func_dict[F](*args)
+            table = _resolve_named_func(function, nd_mod, sym_mod)
+            self._func = lambda F, *args: table[F](*args)
             self._func_name = function
         elif callable(function):
             self._func = lambda F, *args: function(F, *args)
             self._func_name = getattr(function, "__name__", str(function))
         else:
-            raise ValueError(
-                "Unrecognized function in lambda: {} of type {}".format(
-                    function, type(function)))
+            raise ValueError("HybridLambda accepts an op name or a callable; "
+                             "got %r (%s)" % (function, type(function)))
 
     def hybrid_forward(self, F, x, *args):
         return self._func(F, x, *args)
 
     def __repr__(self):
-        return "{name}({function})".format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "%s(%s)" % (type(self).__name__, self._func_name)

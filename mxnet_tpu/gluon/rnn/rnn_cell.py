@@ -1,86 +1,112 @@
-"""Gluon RNN cells (reference: python/mxnet/gluon/rnn/rnn_cell.py:913)."""
+"""Gluon recurrent cells.
+
+Parity surface: reference gluon/rnn/rnn_cell.py (cell classes, unroll
+protocol, state_info/begin_state, parameter names i2h_*/h2h_*).
+Independent implementation: the three gated cells derive from one
+``_GatedCell`` that owns the fused input/hidden projections (gate count is
+a class attribute), sequence formatting is split into typed helpers, and
+gate math uses the sigmoid/tanh ops directly.
+"""
 from __future__ import annotations
 
 from ... import ndarray as nd
 from ... import symbol as sym_mod
-from ...base import MXNetError
 from ..block import Block, HybridBlock
+from ..utils import _to_initializer as _b
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "GRUCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
            "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
 
 
-def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+def _is_tensor(x):
+    return isinstance(x, (nd.NDArray, sym_mod.Symbol))
 
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+def _namespace_of(x):
+    probe = x if _is_tensor(x) else x[0]
+    return sym_mod if isinstance(probe, sym_mod.Symbol) else nd
 
 
-def _get_begin_state(cell, F, begin_state, inputs, batch_size):
-    if begin_state is None:
-        if F is nd:
-            ctx = inputs.context if isinstance(inputs, nd.NDArray) \
-                else inputs[0].context
-            with ctx:
-                begin_state = cell.begin_state(func=F.zeros,
-                                               batch_size=batch_size)
-        else:
-            begin_state = cell.begin_state(func=F.zeros,
-                                           batch_size=batch_size)
-    return begin_state
-
-
-def _format_sequence(length, inputs, layout, merge, in_layout=None):
-    """(reference: rnn_cell.py:_format_sequence)"""
-    assert inputs is not None, \
-        "unroll(inputs=None) has been deprecated. " \
-        "Please create input variables outside unroll."
-
-    axis = layout.find("T")
-    batch_axis = layout.find("N")
-    batch_size = 0
-    in_axis = in_layout.find("T") if in_layout is not None else axis
-    if isinstance(inputs, sym_mod.Symbol):
-        F = sym_mod
-        if merge is False:
-            assert len(inputs.list_outputs()) == 1, \
-                "unroll doesn't allow grouped symbol as input. Please " \
-                "convert to list first or let unroll handle splitting."
-            inputs = list(sym_mod.SliceChannel(inputs, axis=in_axis,
-                                               num_outputs=length,
-                                               squeeze_axis=1))
-    elif isinstance(inputs, nd.NDArray):
-        F = nd
-        batch_size = inputs.shape[batch_axis]
-        if merge is False:
-            assert length is None or length == inputs.shape[in_axis]
-            inputs = [x.squeeze(axis=in_axis) for x in
-                      nd.SliceChannel(inputs, axis=in_axis,
-                                      num_outputs=inputs.shape[in_axis])]
-    else:
-        assert length is None or len(inputs) == length
-        if isinstance(inputs[0], sym_mod.Symbol):
-            F = sym_mod
-        else:
-            F = nd
-            batch_size = inputs[0].shape[batch_axis]
-        if merge is True:
-            inputs = _stack_seq(F, inputs, axis)
-    if isinstance(inputs, (nd.NDArray, sym_mod.Symbol)) and axis != in_axis:
-        inputs = F.swapaxes(inputs, dim1=axis, dim2=in_axis)
-    return inputs, axis, F, batch_size
+def _split_seq(F, tensor, time_axis, length):
+    """Merged tensor -> list of per-step tensors (time axis squeezed)."""
+    if F is sym_mod:
+        if len(tensor.list_outputs()) != 1:
+            raise AssertionError(
+                "unroll doesn't allow grouped symbol as input. Please "
+                "convert to list first or let unroll handle splitting.")
+        return list(sym_mod.SliceChannel(tensor, axis=time_axis,
+                                         num_outputs=length, squeeze_axis=1))
+    steps = tensor.shape[time_axis]
+    if length is not None and length != steps:
+        raise AssertionError("sequence length mismatch")
+    return [t.squeeze(axis=time_axis)
+            for t in nd.SliceChannel(tensor, axis=time_axis,
+                                     num_outputs=steps)]
 
 
 def _stack_seq(F, seq, axis):
-    expanded = [F.expand_dims(i, axis=axis) for i in seq]
-    return F.Concat(*expanded, dim=axis, num_args=len(expanded))
+    """List of per-step tensors -> one merged tensor with a new time axis."""
+    grown = [F.expand_dims(s, axis=axis) for s in seq]
+    return F.Concat(*grown, dim=axis, num_args=len(grown))
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    """Normalize ``inputs`` to the requested form.
+
+    Returns (inputs, time_axis, F, batch_size). merge=False yields a list of
+    step tensors; merge=True yields one stacked tensor; merge=None keeps the
+    incoming form.
+    """
+    if inputs is None:
+        raise AssertionError(
+            "unroll(inputs=None) has been deprecated. Please create input "
+            "variables outside unroll.")
+    time_axis = layout.find("T")
+    batch_axis = layout.find("N")
+    src_axis = in_layout.find("T") if in_layout is not None else time_axis
+    batch_size = 0
+
+    if _is_tensor(inputs):
+        F = _namespace_of(inputs)
+        if F is nd:
+            batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            inputs = _split_seq(F, inputs, src_axis, length)
+    else:
+        if length is not None and len(inputs) != length:
+            raise AssertionError("sequence length mismatch")
+        F = _namespace_of(inputs)
+        if F is nd:
+            batch_size = inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = _stack_seq(F, inputs, time_axis)
+
+    if _is_tensor(inputs) and time_axis != src_axis:
+        inputs = F.swapaxes(inputs, dim1=time_axis, dim2=src_axis)
+    return inputs, time_axis, F, batch_size
+
+
+def _stacked_state_info(cells, batch_size):
+    return sum((c.state_info(batch_size) for c in cells), [])
+
+
+def _stacked_begin_state(cells, **kwargs):
+    return sum((c.begin_state(**kwargs) for c in cells), [])
+
+
+def _default_begin_state(cell, F, begin_state, inputs, batch_size):
+    if begin_state is not None:
+        return begin_state
+    if F is nd:
+        ctx = inputs.context if _is_tensor(inputs) else inputs[0].context
+        with ctx:
+            return cell.begin_state(func=F.zeros, batch_size=batch_size)
+    return cell.begin_state(func=F.zeros, batch_size=batch_size)
 
 
 class RecurrentCell(Block):
-    """Abstract RNN cell (reference: rnn_cell.py:RecurrentCell)."""
+    """Abstract step cell: ``cell(step_input, states) -> (out, states)``."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -88,50 +114,47 @@ class RecurrentCell(Block):
         self.reset()
 
     def reset(self):
-        """Reset before re-unroll."""
-        self._init_counter = -1
+        """Forget unroll counters so the cell can be unrolled again."""
         self._counter = -1
+        self._init_counter = -1
 
     def state_info(self, batch_size=0):
         raise NotImplementedError()
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
-        """Initial states (reference: rnn_cell.py:begin_state)."""
-        assert not self._modified, \
-            "After applying modifier cells (e.g. ZoneoutCell) the base cell " \
-            "cannot be called directly. Call the modifier cell instead."
-        if func is None:
-            func = nd.zeros
+        """Build initial state arrays/symbols via ``func`` (default zeros)."""
+        if self._modified:
+            raise AssertionError(
+                "After applying modifier cells (e.g. ZoneoutCell) the base "
+                "cell cannot be called directly. Call the modifier cell "
+                "instead.")
+        func = func or nd.zeros
         states = []
         for info in self.state_info(batch_size):
             self._init_counter += 1
-            info = dict(info or {})
-            info.pop("__layout__", None)
-            info.update(kwargs)
-            name = "%sbegin_state_%d" % (self._prefix, self._init_counter)
+            spec = dict(info or {})
+            spec.pop("__layout__", None)
+            spec.update(kwargs)
+            tag = "%sbegin_state_%d" % (self._prefix, self._init_counter)
             try:
-                state = func(name=name, **info)
+                states.append(func(name=tag, **spec))
             except TypeError:
-                state = func(**info)
-            states.append(state)
+                states.append(func(**spec))
         return states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """Unroll for ``length`` steps (reference: rnn_cell.py:unroll)."""
+        """Apply the cell ``length`` times over the time axis."""
         self.reset()
-        inputs, _, F, batch_size = _format_sequence(length, inputs, layout,
-                                                    False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
-        outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-        outputs, _, _, _ = _format_sequence(length, outputs, layout,
-                                            merge_outputs)
-        return outputs, states
+        steps, _, F, batch_size = _format_sequence(length, inputs, layout,
+                                                   False)
+        states = _default_begin_state(self, F, begin_state, steps, batch_size)
+        outs = []
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outs.append(out)
+        outs, _, _, _ = _format_sequence(length, outs, layout, merge_outputs)
+        return outs, states
 
     def _get_activation(self, F, inputs, activation, **kwargs):
         if isinstance(activation, str):
@@ -144,7 +167,7 @@ class RecurrentCell(Block):
 
 
 class HybridRecurrentCell(RecurrentCell, HybridBlock):
-    """(reference: rnn_cell.py:HybridRecurrentCell)"""
+    """Recurrent cell usable under hybridize."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -157,154 +180,111 @@ class HybridRecurrentCell(RecurrentCell, HybridBlock):
         raise NotImplementedError
 
 
-class RNNCell(HybridRecurrentCell):
-    """Elman RNN cell (reference: rnn_cell.py:RNNCell)."""
+class _GatedCell(HybridRecurrentCell):
+    """Shared machinery for RNN/LSTM/GRU: fused i2h / h2h projections with
+    ``_GATES`` gates stacked along the hidden axis."""
 
-    def __init__(self, hidden_size, activation="tanh",
-                 i2h_weight_initializer=None, h2h_weight_initializer=None,
-                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 input_size=0, prefix=None, params=None):
+    _GATES = 1
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         self._hidden_size = hidden_size
-        self._activation = activation
         self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(hidden_size,),
-            init=_b(i2h_bias_initializer), allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(hidden_size,),
-            init=_b(h2h_bias_initializer), allow_deferred_init=True)
+        width = self._GATES * hidden_size
+        for tag, shape, init in (
+                ("i2h_weight", (width, input_size), i2h_weight_initializer),
+                ("h2h_weight", (width, hidden_size), h2h_weight_initializer),
+                ("i2h_bias", (width,), _b(i2h_bias_initializer)),
+                ("h2h_bias", (width,), _b(h2h_bias_initializer))):
+            setattr(self, tag, self.params.get(
+                tag, shape=shape, init=init, allow_deferred_init=True))
+
+    def _hc_info(self, batch_size):
+        return {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}
 
     def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        return [self._hc_info(batch_size)]
+
+    def _project(self, F, inputs, hidden, i2h_weight, h2h_weight, i2h_bias,
+                 h2h_bias):
+        width = self._GATES * self._hidden_size
+        return (F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=width, name="i2h"),
+                F.FullyConnected(hidden, h2h_weight, h2h_bias,
+                                 num_hidden=width, name="h2h"))
+
+
+class RNNCell(_GatedCell):
+    """Elman cell: h' = act(W_i x + W_h h + b)."""
+
+    _GATES = 1
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        # activation sits between hidden_size and the initializer kwargs in
+        # the reference signature; accept it positionally here too
+        super().__init__(hidden_size, **kwargs)
+        self._activation = activation
 
     def _alias(self):
         return "rnn"
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size, name="i2h")
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size, name="h2h")
-        output = self._get_activation(F, i2h + h2h, self._activation,
-                                      name="out")
-        return output, [output]
+        i2h, h2h = self._project(F, inputs, states[0], i2h_weight, h2h_weight,
+                                 i2h_bias, h2h_bias)
+        out = self._get_activation(F, i2h + h2h, self._activation, name="out")
+        return out, [out]
 
 
-from ..utils import _to_initializer as _b  # noqa: E402
+class LSTMCell(_GatedCell):
+    """LSTM with gates stacked in i, f, c, o order."""
 
-
-class LSTMCell(HybridRecurrentCell):
-    """LSTM cell (reference: rnn_cell.py:LSTMCell). Gate order i,f,c,o."""
-
-    def __init__(self, hidden_size, i2h_weight_initializer=None,
-                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
-                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
-                 params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(4 * hidden_size,),
-            init=_b(i2h_bias_initializer), allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(4 * hidden_size,),
-            init=_b(h2h_bias_initializer), allow_deferred_init=True)
+    _GATES = 4
 
     def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"},
-                {"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+        return [self._hc_info(batch_size), self._hc_info(batch_size)]
 
     def _alias(self):
         return "lstm"
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size * 4, name="i2h")
-        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size * 4, name="h2h")
-        gates = i2h + h2h
-        slice_gates = F.SliceChannel(gates, num_outputs=4, name="slice")
-        in_gate = F.Activation(slice_gates[0], act_type="sigmoid", name="i")
-        forget_gate = F.Activation(slice_gates[1], act_type="sigmoid",
-                                   name="f")
-        in_transform = F.Activation(slice_gates[2], act_type="tanh", name="c")
-        out_gate = F.Activation(slice_gates[3], act_type="sigmoid", name="o")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * F.Activation(next_c, act_type="tanh")
-        return next_h, [next_h, next_c]
+        i2h, h2h = self._project(F, inputs, states[0], i2h_weight, h2h_weight,
+                                 i2h_bias, h2h_bias)
+        gi, gf, gc, go = F.SliceChannel(i2h + h2h, num_outputs=4,
+                                        name="slice")
+        memory = F.sigmoid(gf) * states[1] + F.sigmoid(gi) * F.tanh(gc)
+        hidden = F.sigmoid(go) * F.tanh(memory)
+        return hidden, [hidden, memory]
 
 
-class GRUCell(HybridRecurrentCell):
-    """GRU cell (reference: rnn_cell.py:GRUCell). Gate order r,z,o."""
+class GRUCell(_GatedCell):
+    """GRU with gates stacked in r, z, o order."""
 
-    def __init__(self, hidden_size, i2h_weight_initializer=None,
-                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
-                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
-                 params=None):
-        super().__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            "i2h_weight", shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            "h2h_weight", shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            "i2h_bias", shape=(3 * hidden_size,),
-            init=_b(i2h_bias_initializer), allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            "h2h_bias", shape=(3 * hidden_size,),
-            init=_b(h2h_bias_initializer), allow_deferred_init=True)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (batch_size, self._hidden_size),
-                 "__layout__": "NC"}]
+    _GATES = 3
 
     def _alias(self):
         return "gru"
 
     def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
                        i2h_bias, h2h_bias):
-        prev_state_h = states[0]
-        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
-                               num_hidden=self._hidden_size * 3, name="i2h")
-        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
-                               num_hidden=self._hidden_size * 3, name="h2h")
-        i2h_r, i2h_z, i2h = F.SliceChannel(i2h, num_outputs=3,
-                                           name="i2h_slice")
-        h2h_r, h2h_z, h2h = F.SliceChannel(h2h, num_outputs=3,
-                                           name="h2h_slice")
-        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                  name="r_act")
-        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                   name="z_act")
-        next_h_tmp = F.Activation(i2h + reset_gate * h2h, act_type="tanh",
-                                  name="h_act")
-        next_h = (1. - update_gate) * next_h_tmp + update_gate * prev_state_h
-        return next_h, [next_h]
+        prev = states[0]
+        i2h, h2h = self._project(F, inputs, prev, i2h_weight, h2h_weight,
+                                 i2h_bias, h2h_bias)
+        ir, iz, ic = F.SliceChannel(i2h, num_outputs=3, name="i2h_slice")
+        hr, hz, hc = F.SliceChannel(h2h, num_outputs=3, name="h2h_slice")
+        reset = F.sigmoid(ir + hr, name="r_act")
+        update = F.sigmoid(iz + hz, name="z_act")
+        candidate = F.tanh(ic + reset * hc, name="h_act")
+        out = update * prev + (1. - update) * candidate
+        return out, [out]
 
 
 class SequentialRNNCell(RecurrentCell):
-    """Stack cells (reference: rnn_cell.py:SequentialRNNCell)."""
+    """Vertically stacked cells sharing one flattened state list."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -313,44 +293,47 @@ class SequentialRNNCell(RecurrentCell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children, batch_size)
+        return _stacked_state_info(self._children, batch_size)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children, **kwargs)
+        return _stacked_begin_state(self._children, **kwargs)
+
+    def _state_slices(self, states):
+        """Carve the flat state list into per-cell chunks."""
+        at = 0
+        for cell in self._children:
+            width = len(cell.state_info())
+            yield cell, states[at:at + width]
+            at += width
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._children:
-            assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info())
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        collected = []
+        for cell, chunk in self._state_slices(states):
+            if isinstance(cell, BidirectionalCell):
+                raise AssertionError(
+                    "BidirectionalCell cannot be stepped inside a stack")
+            inputs, chunk = cell(inputs, chunk)
+            collected.extend(chunk)
+        return inputs, collected
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
         inputs, _, F, batch_size = _format_sequence(length, inputs, layout,
                                                     None)
-        num_cells = len(self._children)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
+        begin_state = _default_begin_state(self, F, begin_state, inputs,
                                        batch_size)
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._children):
-            n = len(cell.state_info())
-            states = begin_state[p:p + n]
-            p += n
-            inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return inputs, next_states
+        final_states = []
+        last = len(self._children) - 1
+        for i, (cell, chunk) in enumerate(
+                self._state_slices(begin_state)):
+            inputs, chunk = cell.unroll(
+                length, inputs=inputs, begin_state=chunk, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            final_states.extend(chunk)
+        return inputs, final_states
 
     def __getitem__(self, i):
         return self._children[i]
@@ -363,11 +346,12 @@ class SequentialRNNCell(RecurrentCell):
 
 
 class DropoutCell(HybridRecurrentCell):
-    """(reference: rnn_cell.py:DropoutCell)"""
+    """Stateless dropout applied to the step input."""
 
     def __init__(self, rate, prefix=None, params=None):
         super().__init__(prefix, params)
-        assert isinstance(rate, float)
+        if not isinstance(rate, float):
+            raise AssertionError("rate must be a float")
         self.rate = rate
 
     def state_info(self, batch_size=0):
@@ -378,8 +362,8 @@ class DropoutCell(HybridRecurrentCell):
 
     def hybrid_forward(self, F, inputs, states):
         if self.rate > 0:
-            inputs = F.Dropout(inputs, p=self.rate, name="t%d_fwd"
-                               % self._counter)
+            inputs = F.Dropout(inputs, p=self.rate,
+                               name="t%d_fwd" % self._counter)
         return inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
@@ -387,23 +371,24 @@ class DropoutCell(HybridRecurrentCell):
         self.reset()
         inputs, _, F, _ = _format_sequence(length, inputs, layout,
                                            merge_outputs)
-        if isinstance(inputs, (nd.NDArray, sym_mod.Symbol)):
+        if _is_tensor(inputs):
+            # dropout is time-independent: apply once to the merged tensor
             return self.hybrid_forward(F, inputs, begin_state or [])
         return super().unroll(length, inputs, begin_state=begin_state,
                               layout=layout, merge_outputs=merge_outputs)
 
 
 class ModifierCell(HybridRecurrentCell):
-    """Base for cells that modify another cell
-    (reference: rnn_cell.py:ModifierCell)."""
+    """Wrap a base cell, reusing its parameters but changing its step."""
 
     def __init__(self, base_cell):
-        assert not base_cell._modified, \
-            "Cell %s is already modified. One cell cannot be modified twice" \
-            % base_cell.name
+        if base_cell._modified:
+            raise AssertionError(
+                "Cell %s is already modified. One cell cannot be modified "
+                "twice" % base_cell.name)
         base_cell._modified = True
-        super().__init__(prefix=base_cell.prefix + self._alias(),
-                         params=None)
+        tag = base_cell.prefix + self._alias()
+        super().__init__(prefix=tag, params=None)
         self.base_cell = base_cell
 
     @property
@@ -416,22 +401,24 @@ class ModifierCell(HybridRecurrentCell):
     def begin_state(self, func=None, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func or nd.zeros, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            return self.base_cell.begin_state(func=func or nd.zeros, **kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def hybrid_forward(self, F, inputs, states):
         raise NotImplementedError
 
 
 class ZoneoutCell(ModifierCell):
-    """(reference: rnn_cell.py:ZoneoutCell)"""
+    """Randomly preserve previous outputs/states (Krueger et al. 2016)."""
 
     def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
-        assert not isinstance(base_cell, BidirectionalCell), \
-            "BidirectionalCell doesn't support zoneout since it doesn't " \
-            "support step. Please add ZoneoutCell to the cells underneath " \
-            "instead."
+        if isinstance(base_cell, BidirectionalCell):
+            raise AssertionError(
+                "BidirectionalCell doesn't support zoneout since it doesn't "
+                "support step. Please add ZoneoutCell to the cells "
+                "underneath instead.")
         super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
@@ -445,59 +432,58 @@ class ZoneoutCell(ModifierCell):
         self.prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
+        new_out, new_states = self.base_cell(inputs, states)
 
-        def mask(p, like):
-            ones = like * 0 + 1
-            return F.Dropout(ones, p=p)
+        def keep_mask(p, like):
+            return F.Dropout(like * 0 + 1, p=p)
 
-        prev_output = self.prev_output if self.prev_output is not None \
-            else next_output * 0
-        output = (F.where(mask(p_outputs, next_output), next_output,
-                          prev_output)
-                  if p_outputs != 0. else next_output)
-        states = ([F.where(mask(p_states, new_s), new_s, old_s)
-                   for new_s, old_s in zip(next_states, states)]
-                  if p_states != 0. else next_states)
-        self.prev_output = output
-        return output, states
+        old_out = (self.prev_output if self.prev_output is not None
+                   else new_out * 0)
+        out = new_out
+        if self.zoneout_outputs != 0.:
+            out = F.where(keep_mask(self.zoneout_outputs, new_out),
+                          new_out, old_out)
+        if self.zoneout_states != 0.:
+            new_states = [F.where(keep_mask(self.zoneout_states, ns), ns, os)
+                          for ns, os in zip(new_states, states)]
+        self.prev_output = out
+        return out, new_states
 
 
 class ResidualCell(ModifierCell):
-    """(reference: rnn_cell.py:ResidualCell)"""
-
-    def hybrid_forward(self, F, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
+    """Add the step input to the base cell's output."""
 
     def _alias(self):
         return "residual"
+
+    def hybrid_forward(self, F, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
         self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=merge_outputs)
-        self.base_cell._modified = True
+        try:
+            outs, states = self.base_cell.unroll(
+                length, inputs=inputs, begin_state=begin_state, layout=layout,
+                merge_outputs=merge_outputs)
+        finally:
+            self.base_cell._modified = True
 
-        merge_outputs = isinstance(outputs, (nd.NDArray, sym_mod.Symbol)) \
-            if merge_outputs is None else merge_outputs
+        if merge_outputs is None:
+            merge_outputs = _is_tensor(outs)
         inputs, _, F, _ = _format_sequence(length, inputs, layout,
                                            merge_outputs)
         if merge_outputs:
-            outputs = outputs + inputs
+            outs = outs + inputs
         else:
-            outputs = [i + j for i, j in zip(outputs, inputs)]
-        return outputs, states
+            outs = [o + x for o, x in zip(outs, inputs)]
+        return outs, states
 
 
 class BidirectionalCell(HybridRecurrentCell):
-    """(reference: rnn_cell.py:BidirectionalCell)"""
+    """Run one cell forward and one backward; concat their outputs."""
 
     def __init__(self, l_cell, r_cell, output_prefix="bi_"):
         super().__init__(prefix="", params=None)
@@ -510,42 +496,37 @@ class BidirectionalCell(HybridRecurrentCell):
             "Bidirectional cannot be stepped. Please use unroll")
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children, batch_size)
+        return _stacked_state_info(self._children, batch_size)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children, **kwargs)
+        return _stacked_begin_state(self._children, **kwargs)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs,
+        steps, _axis, F, batch_size = _format_sequence(length, inputs,
                                                        layout, False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
-        l_cell, r_cell = self._children
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info())],
+        states = _default_begin_state(self, F, begin_state, steps, batch_size)
+        fwd_cell, bwd_cell = self._children
+        split_at = len(fwd_cell.state_info())
+
+        fwd_out, fwd_states = fwd_cell.unroll(
+            length, inputs=steps, begin_state=states[:split_at],
             layout=layout, merge_outputs=merge_outputs)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info()):],
-            layout=layout, merge_outputs=False)
+        bwd_out, bwd_states = bwd_cell.unroll(
+            length, inputs=list(reversed(steps)),
+            begin_state=states[split_at:], layout=layout, merge_outputs=False)
+
         if merge_outputs is None:
-            merge_outputs = isinstance(l_outputs,
-                                       (nd.NDArray, sym_mod.Symbol))
-            l_outputs, _, _, _ = _format_sequence(None, l_outputs, layout,
-                                                  merge_outputs)
+            merge_outputs = _is_tensor(fwd_out)
+            fwd_out, _, _, _ = _format_sequence(None, fwd_out, layout,
+                                                merge_outputs)
         if merge_outputs:
-            r_outputs = list(reversed(r_outputs))
-            r_outputs, _, _, _ = _format_sequence(None, r_outputs, layout,
-                                                  merge_outputs)
-            outputs = F.Concat(l_outputs, r_outputs, dim=2, num_args=2)
+            bwd_out, _, _, _ = _format_sequence(
+                None, list(reversed(bwd_out)), layout, True)
+            outs = F.Concat(fwd_out, bwd_out, dim=2, num_args=2)
         else:
-            outputs = [F.Concat(l_o, r_o, dim=1, num_args=2)
-                       for l_o, r_o in zip(l_outputs,
-                                           reversed(r_outputs))]
-        states = l_states + r_states
-        return outputs, states
+            outs = [F.Concat(f, b, dim=1, num_args=2)
+                    for f, b in zip(fwd_out, reversed(bwd_out))]
+        return outs, fwd_states + bwd_states

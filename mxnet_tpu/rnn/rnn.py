@@ -1,5 +1,12 @@
-"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py)."""
+"""Checkpoint helpers for the symbolic RNN toolkit.
+
+Parity surface: reference rnn/rnn.py — fused cell weights are unpacked to
+per-gate form on save (so checkpoints are portable across fused/unfused
+cells) and re-packed on load.
+"""
 from __future__ import annotations
+
+from functools import reduce
 
 from .. import model
 from .rnn_cell import BaseRNNCell
@@ -7,31 +14,32 @@ from .rnn_cell import BaseRNNCell
 __all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
 
 
+def _through(cells, args, op):
+    """Fold args through op ('pack_weights'/'unpack_weights') of each cell."""
+    chain = [cells] if isinstance(cells, BaseRNNCell) else list(cells)
+    return reduce(lambda acc, cell: getattr(cell, op)(acc), chain, args)
+
+
 def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
-    """Save checkpoint with cell weights unpacked to per-gate form
-    (reference: rnn.py:save_rnn_checkpoint)."""
-    if isinstance(cells, BaseRNNCell):
-        cells = [cells]
-    for cell in cells:
-        arg_params = cell.unpack_weights(arg_params)
-    model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+    """Save, converting each cell's fused weights to per-gate entries."""
+    model.save_checkpoint(prefix, epoch, symbol,
+                          _through(cells, arg_params, "unpack_weights"),
+                          aux_params)
 
 
 def load_rnn_checkpoint(cells, prefix, epoch):
-    """(reference: rnn.py:load_rnn_checkpoint)"""
+    """Load, re-fusing per-gate entries into each cell's packed layout."""
     sym, arg, aux = model.load_checkpoint(prefix, epoch)
-    if isinstance(cells, BaseRNNCell):
-        cells = [cells]
-    for cell in cells:
-        arg = cell.pack_weights(arg)
-    return sym, arg, aux
+    return sym, _through(cells, arg, "pack_weights"), aux
 
 
 def do_rnn_checkpoint(cells, prefix, period=1):
-    """Epoch-end callback (reference: rnn.py:do_rnn_checkpoint)."""
-    period = int(max(1, period))
+    """Epoch callback running save_rnn_checkpoint every ``period`` epochs."""
+    stride = max(1, int(period))
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
-    return _callback
+    def maybe_save(epoch, sym=None, arg=None, aux=None):
+        tick = epoch + 1
+        if tick % stride == 0:
+            save_rnn_checkpoint(cells, prefix, tick, sym, arg, aux)
+
+    return maybe_save

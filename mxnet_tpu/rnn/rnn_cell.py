@@ -1,17 +1,18 @@
-"""Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py:1436).
+"""Symbolic RNN cells (pre-Gluon toolkit; feeds BucketingModule).
 
-These compose ``mx.sym`` graphs (used with BucketingModule); FusedRNNCell
-emits the fused ``RNN`` op (ops/rnn.py lax.scan kernel) and can
-pack/unpack between per-gate weights and the flat fused parameter vector —
-the same convention the reference uses for cuDNN weight blobs.
+Parity surface: reference rnn/rnn_cell.py — cell classes, weight naming
+(``<prefix>i2h_weight`` etc.), pack/unpack between per-gate and fused
+layouts, unroll protocol. FusedRNNCell emits the registered ``RNN`` op
+(ops/rnn.py lax.scan kernel; the reference binds cuDNN blobs instead).
+Independent implementation: the three step cells share one projection
+helper, fused-blob slicing walks a generated (name, size, shape) spec, and
+gate math uses sigmoid/tanh ops directly.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from .. import symbol
 from ..base import MXNetError
-from ..ops.rnn import rnn_param_size, _layer_offsets, _GATES
+from ..ops.rnn import rnn_param_size
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "FusedRNNCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
@@ -19,36 +20,64 @@ __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
 
 
 class RNNParams(object):
-    """Container for cell weight symbols (reference: rnn_cell.py:RNNParams)."""
+    """Lazily-created, prefix-scoped weight Variables shared by cells."""
 
     def __init__(self, prefix=""):
         self._prefix = prefix
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = symbol.Variable(full, **kwargs)
+        return self._params[full]
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """Coerce ``inputs`` to a step list (merge=False) or a stacked symbol
+    (merge=True); merge=None keeps the incoming form. Returns
+    (inputs, time_axis)."""
+    if inputs is None:
+        raise AssertionError("unroll requires explicit input symbols")
+    time_axis = layout.find("T")
+    src_axis = in_layout.find("T") if in_layout is not None else time_axis
+
+    if isinstance(inputs, symbol.Symbol):
+        if merge is False:
+            if len(inputs.list_outputs()) != 1:
+                raise AssertionError(
+                    "unroll doesn't allow grouped symbol as input. Please "
+                    "convert to list with list(inputs) first or let unroll "
+                    "handle splitting.")
+            inputs = list(symbol.SliceChannel(inputs, axis=src_axis,
+                                              num_outputs=length,
+                                              squeeze_axis=1))
+    else:
+        if length is not None and len(inputs) != length:
+            raise AssertionError("sequence length mismatch")
+        if merge is True:
+            grown = [symbol.expand_dims(s, axis=time_axis) for s in inputs]
+            inputs = symbol.Concat(*grown, dim=time_axis, num_args=len(grown))
+            src_axis = time_axis
+
+    if isinstance(inputs, symbol.Symbol) and time_axis != src_axis:
+        inputs = symbol.SwapAxis(inputs, dim1=time_axis, dim2=src_axis)
+    return inputs, time_axis
 
 
 class BaseRNNCell(object):
-    """Abstract symbolic cell (reference: rnn_cell.py:BaseRNNCell)."""
+    """Abstract symbolic step cell."""
 
     def __init__(self, prefix="", params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
+        self._own_params = params is None
+        self._params = RNNParams(prefix) if params is None else params
         self._prefix = prefix
-        self._params = params
         self._modified = False
         self.reset()
 
     def reset(self):
-        self._init_counter = -1
         self._counter = -1
+        self._init_counter = -1
 
     def __call__(self, inputs, states):
         raise NotImplementedError()
@@ -64,129 +93,108 @@ class BaseRNNCell(object):
 
     @property
     def state_shape(self):
-        return [ele["shape"] for ele in self.state_info]
+        return [info["shape"] for info in self.state_info]
 
     @property
     def _gate_names(self):
         return ()
 
     def begin_state(self, func=symbol.zeros, **kwargs):
-        """(reference: rnn_cell.py:begin_state)"""
-        assert not self._modified, \
-            "After applying modifier cells (e.g. DropoutCell) the base " \
-            "cell cannot be called directly. Call the modifier cell instead."
-        states = []
+        """Fresh initial-state symbols built by ``func``."""
+        if self._modified:
+            raise AssertionError(
+                "After applying modifier cells (e.g. DropoutCell) the base "
+                "cell cannot be called directly. Call the modifier cell "
+                "instead.")
+        out = []
         for info in self.state_info:
             self._init_counter += 1
-            if info is None:
-                state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                        self._init_counter),
-                             **kwargs)
-            else:
+            if info is not None:
                 kwargs.update(info)
-                state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                        self._init_counter),
-                             **kwargs)
-            states.append(state)
-        return states
+            out.append(func(name="%sbegin_state_%d"
+                            % (self._prefix, self._init_counter), **kwargs))
+        return out
+
+    def _fused_entries(self):
+        """(fused name, [per-gate names]) pairs for i2h/h2h weights+biases."""
+        h = self._num_hidden
+        for group in ("i2h", "h2h"):
+            for kind in ("weight", "bias"):
+                fused = f"{self._prefix}{group}_{kind}"
+                split = [f"{self._prefix}{group}{gate}_{kind}"
+                         for gate in self._gate_names]
+                yield fused, split, h
 
     def unpack_weights(self, args):
-        """Split fused blobs into per-gate weights (reference:
-        rnn_cell.py:unpack_weights; identity for unfused cells)."""
+        """Fused blobs -> per-gate entries (identity for gateless cells)."""
         args = args.copy()
         if not self._gate_names:
             return args
-        h = self._num_hidden
-        for group_name in ["i2h", "h2h"]:
-            weight = args.pop("%s%s_weight" % (self._prefix, group_name))
-            bias = args.pop("%s%s_bias" % (self._prefix, group_name))
-            for j, gate in enumerate(self._gate_names):
-                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
-                args[wname] = weight[j * h:(j + 1) * h].copy()
-                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
-                args[bname] = bias[j * h:(j + 1) * h].copy()
+        for fused, split, h in self._fused_entries():
+            blob = args.pop(fused)
+            for j, name in enumerate(split):
+                args[name] = blob[j * h:(j + 1) * h].copy()
         return args
 
     def pack_weights(self, args):
-        """(reference: rnn_cell.py:pack_weights)"""
+        """Per-gate entries -> fused blobs."""
         args = args.copy()
         if not self._gate_names:
             return args
         from .. import ndarray as nd
-
-        for group_name in ["i2h", "h2h"]:
-            weight = []
-            bias = []
-            for gate in self._gate_names:
-                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
-                weight.append(args.pop(wname))
-                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
-                bias.append(args.pop(bname))
-            args["%s%s_weight" % (self._prefix, group_name)] = \
-                nd.concatenate(weight)
-            args["%s%s_bias" % (self._prefix, group_name)] = \
-                nd.concatenate(bias)
+        for fused, split, _h in self._fused_entries():
+            args[fused] = nd.concatenate([args.pop(name) for name in split])
         return args
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """(reference: rnn_cell.py:295)"""
+        """Step the cell ``length`` times building an explicit graph."""
         self.reset()
-        inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
-        outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-        outputs, _ = _normalize_sequence(length, outputs, layout,
-                                         merge_outputs)
-        return outputs, states
+        steps, _ = _normalize_sequence(length, inputs, layout, False)
+        states = begin_state if begin_state is not None else self.begin_state()
+        outs = []
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outs.append(out)
+        outs, _ = _normalize_sequence(length, outs, layout, merge_outputs)
+        return outs, states
 
     def _get_activation(self, inputs, activation, **kwargs):
         if isinstance(activation, str):
             return symbol.Activation(inputs, act_type=activation, **kwargs)
         return activation(inputs, **kwargs)
 
+    def _step_tag(self):
+        """Per-step node-name prefix."""
+        return "%st%d_" % (self._prefix, self._counter)
 
-def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
-    """(reference: rnn_cell.py:_normalize_sequence)"""
-    assert inputs is not None
-    axis = layout.find("T")
-    in_axis = in_layout.find("T") if in_layout is not None else axis
-    if isinstance(inputs, symbol.Symbol):
-        if merge is False:
-            assert len(inputs.list_outputs()) == 1, \
-                "unroll doesn't allow grouped symbol as input. Please " \
-                "convert to list with list(inputs) first or let unroll " \
-                "handle splitting."
-            inputs = list(symbol.SliceChannel(inputs, axis=in_axis,
-                                              num_outputs=length,
-                                              squeeze_axis=1))
-    else:
-        assert length is None or len(inputs) == length
-        if merge is True:
-            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
-            inputs = symbol.Concat(*inputs, dim=axis, num_args=len(inputs))
-            in_axis = axis
-    if isinstance(inputs, symbol.Symbol) and axis != in_axis:
-        inputs = symbol.SwapAxis(inputs, dim1=axis, dim2=in_axis)
-    return inputs, axis
+    def _bind_gate_params(self, bias_init=None):
+        """Create/fetch the four standard projection weights."""
+        self._iW = self.params.get("i2h_weight")
+        self._iB = (self.params.get("i2h_bias", init=bias_init)
+                    if bias_init is not None
+                    else self.params.get("i2h_bias"))
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    def _project(self, inputs, hidden, gates, tag):
+        """Fused input and hidden projections of width gates*num_hidden."""
+        width = gates * self._num_hidden
+        return (symbol.FullyConnected(inputs, self._iW, self._iB,
+                                      num_hidden=width, name=tag + "i2h"),
+                symbol.FullyConnected(hidden, self._hW, self._hB,
+                                      num_hidden=width, name=tag + "h2h"))
 
 
 class RNNCell(BaseRNNCell):
-    """Simple recurrent cell (reference: rnn_cell.py:362)."""
+    """Elman step cell: h' = act(W_i x + W_h h + b)."""
 
     def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
                  params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         self._activation = activation
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._bind_gate_params()
 
     @property
     def state_info(self):
@@ -198,37 +206,27 @@ class RNNCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name="%sh2h" % name)
-        output = self._get_activation(i2h + h2h, self._activation,
-                                      name="%sout" % name)
-        return output, [output]
+        tag = self._step_tag()
+        i2h, h2h = self._project(inputs, states[0], 1, tag)
+        out = self._get_activation(i2h + h2h, self._activation,
+                                   name=tag + "out")
+        return out, [out]
 
 
 class LSTMCell(BaseRNNCell):
-    """LSTM cell (reference: rnn_cell.py:408). Gate order i,f,c,o."""
+    """LSTM step cell; gates stacked i, f, c, o; forget bias via init."""
 
     def __init__(self, num_hidden, prefix="lstm_", params=None,
                  forget_bias=1.0):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._hW = self.params.get("h2h_weight")
         from ..initializer import LSTMBias
-
-        self._iB = self.params.get(
-            "i2h_bias", init=LSTMBias(forget_bias=forget_bias))
-        self._hB = self.params.get("h2h_bias")
+        self._bind_gate_params(bias_init=LSTMBias(forget_bias=forget_bias))
 
     @property
     def state_info(self):
-        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
-                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+        hc = {"shape": (0, self._num_hidden), "__layout__": "NC"}
+        return [dict(hc), dict(hc)]
 
     @property
     def _gate_names(self):
@@ -236,39 +234,24 @@ class LSTMCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%sh2h" % name)
-        gates = i2h + h2h
-        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
-                                          name="%sslice" % name)
-        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
-                                    name="%si" % name)
-        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
-                                        name="%sf" % name)
-        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
-                                         name="%sc" % name)
-        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
-                                     name="%so" % name)
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
-        return next_h, [next_h, next_c]
+        tag = self._step_tag()
+        i2h, h2h = self._project(inputs, states[0], 4, tag)
+        gi, gf, gc, go = symbol.SliceChannel(i2h + h2h, num_outputs=4,
+                                             name=tag + "slice")
+        memory = (symbol.sigmoid(gf, name=tag + "f") * states[1]
+                  + symbol.sigmoid(gi, name=tag + "i")
+                  * symbol.tanh(gc, name=tag + "c"))
+        hidden = symbol.sigmoid(go, name=tag + "o") * symbol.tanh(memory)
+        return hidden, [hidden, memory]
 
 
 class GRUCell(BaseRNNCell):
-    """GRU cell (reference: rnn_cell.py:469). Gate order r,z,o."""
+    """GRU step cell; gates stacked r, z, o."""
 
     def __init__(self, num_hidden, prefix="gru_", params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._bind_gate_params()
 
     @property
     def state_info(self):
@@ -280,40 +263,28 @@ class GRUCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        seq_idx = self._counter
-        name = "%st%d_" % (self._prefix, seq_idx)
-        prev_state_h = states[0]
-        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(prev_state_h, self._hW, self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%sh2h" % name)
-        i2h_r, i2h_z, i2h = symbol.SliceChannel(
-            i2h, num_outputs=3, name="%si2h_slice" % name)
-        h2h_r, h2h_z, h2h = symbol.SliceChannel(
-            h2h, num_outputs=3, name="%sh2h_slice" % name)
-        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                       name="%sr_act" % name)
-        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                        name="%sz_act" % name)
-        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
-                                       act_type="tanh",
-                                       name="%sh_act" % name)
-        next_h = (1. - update_gate) * next_h_tmp + update_gate * prev_state_h
-        return next_h, [next_h]
+        tag = self._step_tag()
+        prev = states[0]
+        i2h, h2h = self._project(inputs, prev, 3, tag)
+        ir, iz, ic = symbol.SliceChannel(i2h, num_outputs=3,
+                                         name=tag + "i2h_slice")
+        hr, hz, hc = symbol.SliceChannel(h2h, num_outputs=3,
+                                         name=tag + "h2h_slice")
+        reset = symbol.sigmoid(ir + hr, name=tag + "r_act")
+        update = symbol.sigmoid(iz + hz, name=tag + "z_act")
+        cand = symbol.tanh(ic + reset * hc, name=tag + "h_act")
+        out = update * prev + (1. - update) * cand
+        return out, [out]
 
 
 class FusedRNNCell(BaseRNNCell):
-    """Fused multi-layer cell emitting the RNN op (reference:
-    rnn_cell.py:536 — cuDNN there, lax.scan kernel here)."""
+    """Whole-sequence multi-layer cell emitting one fused RNN node."""
 
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0., get_next_state=False,
                  forget_bias=1.0, prefix=None, params=None):
-        if prefix is None:
-            prefix = "%s_" % mode
-        super().__init__(prefix=prefix, params=params)
+        super().__init__(prefix=mode + "_" if prefix is None else prefix,
+                         params=params)
         self._num_hidden = num_hidden
         self._num_layers = num_layers
         self._mode = mode
@@ -322,17 +293,17 @@ class FusedRNNCell(BaseRNNCell):
         self._get_next_state = get_next_state
         self._directions = ["l", "r"] if bidirectional else ["l"]
         from ..initializer import FusedRNN
-
-        initializer = FusedRNN(None, num_hidden, num_layers, mode,
-                               bidirectional, forget_bias)
-        self._parameter = self.params.get("parameters", init=initializer)
+        self._parameter = self.params.get(
+            "parameters", init=FusedRNN(None, num_hidden, num_layers, mode,
+                                        bidirectional, forget_bias))
 
     @property
     def state_info(self):
-        b = self._bidirectional + 1
-        n = (self._mode == "lstm") + 1
-        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
-                 "__layout__": "LNC"} for _ in range(n)]
+        dirs = len(self._directions)
+        shape = (dirs * self._num_layers, 0, self._num_hidden)
+        count = 2 if self._mode == "lstm" else 1
+        return [{"shape": shape, "__layout__": "LNC"}
+                for _ in range(count)]
 
     @property
     def _gate_names(self):
@@ -344,86 +315,58 @@ class FusedRNNCell(BaseRNNCell):
     def _num_gates(self):
         return len(self._gate_names)
 
-    def _slice_weights(self, arr, li, lh):
-        """Slice the flat vector into per-layer/gate views (reference:
-        rnn_cell.py:_slice_weights)."""
-        args = {}
-        gate_names = self._gate_names
-        directions = self._directions
-        b = len(directions)
-        p = 0
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for gate in gate_names:
-                    name = "%s%s%d_i2h%s_weight" % (self._prefix, direction,
-                                                    layer, gate)
-                    size = (li if layer == 0 else lh * b) * lh
-                    args[name] = arr[p:p + size].reshape(
-                        (lh, li if layer == 0 else lh * b))
-                    p += size
-                for gate in gate_names:
-                    name = "%s%s%d_h2h%s_weight" % (self._prefix, direction,
-                                                    layer, gate)
-                    size = lh ** 2
-                    args[name] = arr[p:p + size].reshape((lh, lh))
-                    p += size
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for gate in gate_names:
-                    name = "%s%s%d_i2h%s_bias" % (self._prefix, direction,
-                                                  layer, gate)
-                    args[name] = arr[p:p + lh]
-                    p += lh
-                for gate in gate_names:
-                    name = "%s%s%d_h2h%s_bias" % (self._prefix, direction,
-                                                  layer, gate)
-                    args[name] = arr[p:p + lh]
-                    p += lh
-        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
-        return args
+    def _blob_spec(self, num_input):
+        """Yield (name, size, shape|None) for every slice of the flat blob,
+        in the canonical order: all weights, then all biases."""
+        lh = self._num_hidden
+        dirs = self._directions
+        fan_in_scale = len(dirs)
+        for kind in ("weight", "bias"):
+            for layer in range(self._num_layers):
+                for direction in dirs:
+                    for group in ("i2h", "h2h"):
+                        for gate in self._gate_names:
+                            name = (f"{self._prefix}{direction}{layer}_"
+                                    f"{group}{gate}_{kind}")
+                            if kind == "bias":
+                                yield name, lh, None
+                            elif group == "h2h":
+                                yield name, lh * lh, (lh, lh)
+                            else:
+                                fan_in = (num_input if layer == 0
+                                          else lh * fan_in_scale)
+                                yield name, lh * fan_in, (lh, fan_in)
 
     def unpack_weights(self, args):
         args = args.copy()
-        arr = args.pop(self._parameter.name)
-        num_input = int(arr.size // self._num_layers // self._num_gates //
-                        self._num_hidden) if self._num_layers == 1 and \
-            len(self._directions) == 1 else None
-        b = len(self._directions)
-        m = self._num_gates
-        h = self._num_hidden
-        # solve for input size from total size
-        num_input = (int(arr.size) // b // h // m -
-                     (self._num_layers - 1) * (h + b * h + 2) - h - 2)
-        args.update(self._slice_weights(arr, num_input, self._num_hidden))
+        blob = args.pop(self._parameter.name)
+        dirs = len(self._directions)
+        m, h = self._num_gates, self._num_hidden
+        # invert rnn_param_size to recover the input width
+        num_input = (int(blob.size) // dirs // h // m
+                     - (self._num_layers - 1) * (h + dirs * h + 2) - h - 2)
+        at = 0
+        for name, size, shape in self._blob_spec(num_input):
+            piece = blob[at:at + size]
+            args[name] = piece.reshape(shape) if shape else piece
+            at += size
+        if at != blob.size:
+            raise AssertionError("Invalid parameters size for FusedRNNCell")
         return args
 
     def pack_weights(self, args):
         args = args.copy()
         from .. import ndarray as nd
-
-        w0 = args["%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])]
-        num_input = w0.shape[1]
-        total = rnn_param_size(self._num_layers, self._num_hidden, num_input,
-                               self._mode, self._bidirectional)
-        flat = []
-        gate_names = self._gate_names
-        for layer in range(self._num_layers):
-            for direction in self._directions:
-                for g in ["i2h", "h2h"]:
-                    for gate in gate_names:
-                        name = "%s%s%d_%s%s_weight" % (
-                            self._prefix, direction, layer, g, gate)
-                        flat.append(args.pop(name).reshape((-1,)))
-        for layer in range(self._num_layers):
-            for direction in self._directions:
-                for g in ["i2h", "h2h"]:
-                    for gate in gate_names:
-                        name = "%s%s%d_%s%s_bias" % (
-                            self._prefix, direction, layer, g, gate)
-                        flat.append(args.pop(name).reshape((-1,)))
+        probe = f"{self._prefix}l0_i2h{self._gate_names[0]}_weight"
+        num_input = args[probe].shape[1]
+        flat = [args.pop(name).reshape((-1,))
+                for name, _size, _shape in self._blob_spec(num_input)]
         packed = nd.concatenate(flat)
-        assert packed.size == total, \
-            "Invalid parameters size: %d vs %d" % (packed.size, total)
+        want = rnn_param_size(self._num_layers, self._num_hidden, num_input,
+                              self._mode, self._bidirectional)
+        if packed.size != want:
+            raise AssertionError("Invalid parameters size: %d vs %d"
+                                 % (packed.size, want))
         args[self._parameter.name] = packed
         return args
 
@@ -433,66 +376,78 @@ class FusedRNNCell(BaseRNNCell):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """Emit one fused RNN node (reference: rnn_cell.py:670)."""
         self.reset()
         inputs, axis = _normalize_sequence(length, inputs, layout, True)
-        if axis == 1:  # NTC → TNC for the op
+        if axis == 1:  # the fused op wants TNC
             inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
+        states = begin_state if begin_state is not None else self.begin_state()
 
-        rnn_args = [inputs, self._parameter] + list(states)
-        rnn = symbol.RNN(*rnn_args, state_size=self._num_hidden,
-                         num_layers=self._num_layers,
-                         bidirectional=self._bidirectional, p=self._dropout,
-                         state_outputs=self._get_next_state, mode=self._mode,
-                         name=self._prefix + "rnn")
+        node = symbol.RNN(inputs, self._parameter, *states,
+                          state_size=self._num_hidden,
+                          num_layers=self._num_layers,
+                          bidirectional=self._bidirectional, p=self._dropout,
+                          state_outputs=self._get_next_state, mode=self._mode,
+                          name=self._prefix + "rnn")
 
-        attr_states = []
         if not self._get_next_state:
-            outputs = rnn
+            outputs, out_states = node, []
         elif self._mode == "lstm":
-            outputs, attr_states = rnn[0], [rnn[1], rnn[2]]
+            outputs, out_states = node[0], [node[1], node[2]]
         else:
-            outputs, attr_states = rnn[0], [rnn[1]]
+            outputs, out_states = node[0], [node[1]]
         if axis == 1:
             outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
         if merge_outputs is False:
-            outputs = list(symbol.SliceChannel(
-                outputs, axis=axis, num_outputs=length, squeeze_axis=1))
-        return outputs, attr_states
+            outputs = list(symbol.SliceChannel(outputs, axis=axis,
+                                               num_outputs=length,
+                                               squeeze_axis=1))
+        return outputs, out_states
 
     def unfuse(self):
-        """Equivalent unfused stack (reference: rnn_cell.py:unfuse)."""
-        stack = SequentialRNNCell()
-        get_cell = {
-            "rnn_relu": lambda cell_prefix: RNNCell(
-                self._num_hidden, activation="relu", prefix=cell_prefix),
-            "rnn_tanh": lambda cell_prefix: RNNCell(
-                self._num_hidden, activation="tanh", prefix=cell_prefix),
-            "lstm": lambda cell_prefix: LSTMCell(
-                self._num_hidden, prefix=cell_prefix),
-            "gru": lambda cell_prefix: GRUCell(
-                self._num_hidden, prefix=cell_prefix),
+        """Build the equivalent stack of explicit step cells."""
+        step_cls, step_kw = {
+            "rnn_relu": (RNNCell, {"activation": "relu"}),
+            "rnn_tanh": (RNNCell, {"activation": "tanh"}),
+            "lstm": (LSTMCell, {}),
+            "gru": (GRUCell, {}),
         }[self._mode]
-        for i in range(self._num_layers):
+
+        stack = SequentialRNNCell()
+        for layer in range(self._num_layers):
+            def cell_for(side):
+                return step_cls(self._num_hidden,
+                                prefix="%s%s%d_" % (self._prefix, side,
+                                                    layer),
+                                **step_kw)
             if self._bidirectional:
                 stack.add(BidirectionalCell(
-                    get_cell("%sl%d_" % (self._prefix, i)),
-                    get_cell("%sr%d_" % (self._prefix, i)),
-                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+                    cell_for("l"), cell_for("r"),
+                    output_prefix="%sbi_l%d_" % (self._prefix, layer)))
             else:
-                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
-            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(cell_for("l"))
+            if self._dropout > 0 and layer != self._num_layers - 1:
                 stack.add(DropoutCell(self._dropout,
-                                      prefix="%s_dropout%d_" % (self._prefix,
-                                                                i)))
+                                      prefix="%s_dropout%d_"
+                                      % (self._prefix, layer)))
         return stack
 
 
+def _merged_state_info(cells):
+    return sum((c.state_info for c in cells), [])
+
+
+def _merged_begin_state(cells, **kwargs):
+    return sum((c.begin_state(**kwargs) for c in cells), [])
+
+
+def _repack_through(cells, args, direction):
+    for cell in cells:
+        args = getattr(cell, direction)(args)
+    return args
+
+
 class SequentialRNNCell(BaseRNNCell):
-    """(reference: rnn_cell.py:748)"""
+    """Vertical stack of cells with a flattened state list."""
 
     def __init__(self, params=None):
         super().__init__(prefix="", params=params)
@@ -502,68 +457,66 @@ class SequentialRNNCell(BaseRNNCell):
     def add(self, cell):
         self._cells.append(cell)
         if self._override_cell_params:
-            assert cell._own_params, \
-                "Either specify params for SequentialRNNCell or child cells, " \
-                "not both."
+            if not cell._own_params:
+                raise AssertionError(
+                    "Either specify params for SequentialRNNCell or child "
+                    "cells, not both.")
             cell.params._params.update(self.params._params)
         self.params._params.update(cell.params._params)
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return _merged_state_info(self._cells)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        return _merged_begin_state(self._cells, **kwargs)
 
     def unpack_weights(self, args):
-        for cell in self._cells:
-            args = cell.unpack_weights(args)
-        return args
+        return _repack_through(self._cells, args, "unpack_weights")
 
     def pack_weights(self, args):
+        return _repack_through(self._cells, args, "pack_weights")
+
+    def _state_slices(self, states):
+        at = 0
         for cell in self._cells:
-            args = cell.pack_weights(args)
-        return args
+            width = len(cell.state_info)
+            yield cell, states[at:at + width]
+            at += width
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._cells:
-            assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        carried = []
+        for cell, chunk in self._state_slices(states):
+            if isinstance(cell, BidirectionalCell):
+                raise AssertionError("bidirectional cells cannot be stepped")
+            inputs, chunk = cell(inputs, chunk)
+            carried.extend(chunk)
+        return inputs, carried
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        num_cells = len(self._cells)
         if begin_state is None:
             begin_state = self.begin_state()
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            states = begin_state[p:p + n]
-            p += n
-            inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return inputs, next_states
+        final = []
+        last = len(self._cells) - 1
+        for i, (cell, chunk) in enumerate(self._state_slices(begin_state)):
+            inputs, chunk = cell.unroll(
+                length, inputs=inputs, begin_state=chunk, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            final.extend(chunk)
+        return inputs, final
 
 
 class DropoutCell(BaseRNNCell):
-    """(reference: rnn_cell.py:827)"""
+    """Stateless dropout over step inputs (or the whole merged tensor)."""
 
     def __init__(self, dropout, prefix="dropout_", params=None):
         super().__init__(prefix, params)
-        assert isinstance(dropout, (int, float))
+        if not isinstance(dropout, (int, float)):
+            raise AssertionError("dropout rate must be numeric")
         self.dropout = dropout
 
     @property
@@ -586,7 +539,7 @@ class DropoutCell(BaseRNNCell):
 
 
 class ModifierCell(BaseRNNCell):
-    """(reference: rnn_cell.py:867)"""
+    """Wrap a base cell: weights/states belong to it, the step differs."""
 
     def __init__(self, base_cell):
         base_cell._modified = True
@@ -598,8 +551,6 @@ class ModifierCell(BaseRNNCell):
         self._own_params = False
         return self.base_cell.params
 
-    # state shape/weight handling is entirely the wrapped cell's; only the
-    # per-step transform (__call__) differs per modifier subclass
     @property
     def state_info(self):
         return self.base_cell.state_info
@@ -627,7 +578,7 @@ class ModifierCell(BaseRNNCell):
 
 
 class ZoneoutCell(ModifierCell):
-    """(reference: rnn_cell.py:909)"""
+    """Per-step stochastic identity on outputs/states (Krueger et al.)."""
 
     def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
         for bad, why in ((FusedRNNCell, "unfuse the cell first"),
@@ -647,65 +598,65 @@ class ZoneoutCell(ModifierCell):
         self.prev_output = None
 
     def __call__(self, inputs, states):
-        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
-                                     self.zoneout_states)
-        next_output, next_states = cell(inputs, states)
+        new_out, new_states = self.base_cell(inputs, states)
 
-        def mask(p, like):
+        def keep(p, like):
             return symbol.Dropout(symbol.ones_like(like), p=p)
 
-        prev_output = self.prev_output if self.prev_output is not None \
-            else symbol.zeros_like(next_output)
-        output = (symbol.where(mask(p_outputs, next_output), next_output,
-                               prev_output)
-                  if p_outputs != 0. else next_output)
-        states = ([symbol.where(mask(p_states, new_s), new_s, old_s)
-                   for new_s, old_s in zip(next_states, states)]
-                  if p_states != 0. else next_states)
-        self.prev_output = output
-        return output, states
+        old_out = (self.prev_output if self.prev_output is not None
+                   else symbol.zeros_like(new_out))
+        out = new_out
+        if self.zoneout_outputs != 0.:
+            out = symbol.where(keep(self.zoneout_outputs, new_out),
+                               new_out, old_out)
+        if self.zoneout_states != 0.:
+            new_states = [
+                symbol.where(keep(self.zoneout_states, ns), ns, os)
+                for ns, os in zip(new_states, states)]
+        self.prev_output = out
+        return out, new_states
 
 
 class ResidualCell(ModifierCell):
-    """(reference: rnn_cell.py:957)"""
+    """Add the step input to the wrapped cell's output."""
 
     def __init__(self, base_cell):
         super().__init__(base_cell)
 
     def __call__(self, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
         self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state, layout=layout,
-            merge_outputs=merge_outputs)
-        self.base_cell._modified = True
-        merge_outputs = isinstance(outputs, symbol.Symbol) \
-            if merge_outputs is None else merge_outputs
+        try:
+            outs, states = self.base_cell.unroll(
+                length, inputs=inputs, begin_state=begin_state, layout=layout,
+                merge_outputs=merge_outputs)
+        finally:
+            self.base_cell._modified = True
+        if merge_outputs is None:
+            merge_outputs = isinstance(outs, symbol.Symbol)
         inputs, _ = _normalize_sequence(length, inputs, layout, merge_outputs)
         if merge_outputs:
-            outputs = outputs + inputs
-        else:
-            outputs = [i + j for i, j in zip(outputs, inputs)]
-        return outputs, states
+            return outs + inputs, states
+        return [o + x for o, x in zip(outs, inputs)], states
 
 
 class BidirectionalCell(BaseRNNCell):
-    """(reference: rnn_cell.py:998)"""
+    """Forward + backward cells over the sequence, outputs concatenated."""
 
     def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
         super().__init__("", params=params)
         self._output_prefix = output_prefix
         self._override_cell_params = params is not None
         if self._override_cell_params:
-            assert l_cell._own_params and r_cell._own_params, \
-                "Either specify params for BidirectionalCell or child " \
-                "cells, not both."
+            if not (l_cell._own_params and r_cell._own_params):
+                raise AssertionError(
+                    "Either specify params for BidirectionalCell or child "
+                    "cells, not both.")
             l_cell.params._params.update(self.params._params)
             r_cell.params._params.update(self.params._params)
         self.params._params.update(l_cell.params._params)
@@ -713,10 +664,10 @@ class BidirectionalCell(BaseRNNCell):
         self._cells = [l_cell, r_cell]
 
     def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
+        return _repack_through(self._cells, args, "unpack_weights")
 
     def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
+        return _repack_through(self._cells, args, "pack_weights")
 
     def __call__(self, inputs, states):
         raise NotImplementedError("Bidirectional cannot be stepped. "
@@ -724,61 +675,45 @@ class BidirectionalCell(BaseRNNCell):
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return _merged_state_info(self._cells)
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        return _merged_begin_state(self._cells, **kwargs)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        steps, axis = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
             begin_state = self.begin_state()
-        states = begin_state
-        l_cell, r_cell = self._cells
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info)], layout=layout,
-            merge_outputs=merge_outputs)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info):], layout=layout,
+        fwd, bwd = self._cells
+        split_at = len(fwd.state_info)
+        fwd_out, fwd_states = fwd.unroll(
+            length, inputs=steps, begin_state=begin_state[:split_at],
+            layout=layout, merge_outputs=merge_outputs)
+        bwd_out, bwd_states = bwd.unroll(
+            length, inputs=list(reversed(steps)),
+            begin_state=begin_state[split_at:], layout=layout,
             merge_outputs=False)
+
         if merge_outputs is None:
-            merge_outputs = isinstance(l_outputs, symbol.Symbol)
-            if not merge_outputs and isinstance(l_outputs, symbol.Symbol):
-                l_outputs = list(l_outputs)
+            merge_outputs = isinstance(fwd_out, symbol.Symbol)
         if merge_outputs:
-            if not isinstance(l_outputs, symbol.Symbol):
-                l_outputs, _ = _normalize_sequence(length, l_outputs, layout,
-                                                   True)
-            r_outputs = list(reversed(r_outputs))
-            r_outputs, _ = _normalize_sequence(length, r_outputs, layout,
-                                               True)
-            outputs = symbol.Concat(l_outputs, r_outputs, dim=2, num_args=2,
-                                    name="%sout" % self._output_prefix)
+            if not isinstance(fwd_out, symbol.Symbol):
+                fwd_out, _ = _normalize_sequence(length, fwd_out, layout,
+                                                 True)
+            bwd_out, _ = _normalize_sequence(length,
+                                             list(reversed(bwd_out)),
+                                             layout, True)
+            outs = symbol.Concat(fwd_out, bwd_out, dim=2, num_args=2,
+                                 name="%sout" % self._output_prefix)
         else:
-            if isinstance(l_outputs, symbol.Symbol):
-                l_outputs = list(symbol.SliceChannel(
-                    l_outputs, axis=axis, num_outputs=length,
-                    squeeze_axis=1))
-            outputs = [symbol.Concat(l_o, r_o, dim=1, num_args=2,
-                                     name="%st%d" % (self._output_prefix, i))
-                       for i, (l_o, r_o) in enumerate(
-                           zip(l_outputs, reversed(r_outputs)))]
-        states = l_states + r_states
-        return outputs, states
-
-
-def _cells_unpack_weights(cells, args):
-    for cell in cells:
-        args = cell.unpack_weights(args)
-    return args
-
-
-def _cells_pack_weights(cells, args):
-    for cell in cells:
-        args = cell.pack_weights(args)
-    return args
+            if isinstance(fwd_out, symbol.Symbol):
+                fwd_out = list(symbol.SliceChannel(
+                    fwd_out, axis=axis, num_outputs=length, squeeze_axis=1))
+            outs = [symbol.Concat(f, b, dim=1, num_args=2,
+                                  name="%st%d" % (self._output_prefix, t))
+                    for t, (f, b) in enumerate(zip(fwd_out,
+                                                   reversed(bwd_out)))]
+        return outs, fwd_states + bwd_states
