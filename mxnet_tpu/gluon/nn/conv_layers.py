@@ -1,5 +1,13 @@
-"""Convolution / pooling Gluon layers (reference:
-python/mxnet/gluon/nn/conv_layers.py:1008)."""
+"""Convolution and pooling Gluon layers.
+
+Parity surface: reference gluon/nn/conv_layers.py — the 17 public classes
+with their ctor signatures and parameter naming. Independent
+implementation: there are exactly two real blocks (``_Conv``, ``_Pooling``);
+every public class is produced by a small factory that pins dimensionality,
+layout, operator, and pooling kind. Weight shapes come from partial shape
+inference through the symbolic op, so transposed convs need no special
+casing.
+"""
 from __future__ import annotations
 
 from ..block import HybridBlock
@@ -12,188 +20,132 @@ __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
            "GlobalAvgPool3D"]
 
 
+def _tuple_of(value, ndim):
+    return (value,) * ndim if isinstance(value, int) else tuple(value)
+
+
 class _Conv(HybridBlock):
-    """Base conv block (reference: conv_layers.py:_Conv)."""
+    """Shared conv/deconv block driving a named symbolic operator."""
 
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, layout, in_channels=0, activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer="zeros", op_name="Convolution",
-                 adj=None, prefix=None, params=None):
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
             self._channels = channels
             self._in_channels = in_channels
-            if isinstance(strides, int):
-                strides = (strides,) * len(kernel_size)
-            if isinstance(padding, int):
-                padding = (padding,) * len(kernel_size)
-            if isinstance(dilation, int):
-                dilation = (dilation,) * len(kernel_size)
+            ndim = len(kernel_size)
             self._op_name = op_name
             self._kwargs = {
-                "kernel": kernel_size, "stride": strides, "dilate": dilation,
-                "pad": padding, "num_filter": channels, "num_group": groups,
+                "kernel": kernel_size,
+                "stride": _tuple_of(strides, ndim),
+                "dilate": _tuple_of(dilation, ndim),
+                "pad": _tuple_of(padding, ndim),
+                "num_filter": channels, "num_group": groups,
                 "no_bias": not use_bias, "layout": layout}
             if adj is not None:
                 self._kwargs["adj"] = adj
 
-            dshape = [0] * (len(kernel_size) + 2)
-            dshape[layout.find("N")] = 1
-            dshape[layout.find("C")] = in_channels
-            wshapes = self._infer_weight_shape(op_name, tuple(dshape))
+            probe = [0] * (ndim + 2)
+            probe[layout.find("N")] = 1
+            probe[layout.find("C")] = in_channels
             self.weight = self.params.get(
-                "weight", shape=wshapes[1], init=weight_initializer,
-                allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    "bias", shape=(channels,), init=_init(bias_initializer),
-                    allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + "_")
-            else:
-                self.act = None
+                "weight", shape=self._weight_shape(tuple(probe)),
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=_init(bias_initializer),
+                allow_deferred_init=True) if use_bias else None
+            self.act = (Activation(activation, prefix=activation + "_")
+                        if activation is not None else None)
 
-    def _infer_weight_shape(self, op_name, data_shape):
+    def _op_kwargs(self):
+        return {k: v for k, v in self._kwargs.items() if k != "layout"}
+
+    def _weight_shape(self, data_shape):
+        """Infer the weight shape by tracing the op on a probe input."""
         from ... import symbol as sym_mod
-
-        data = sym_mod.Variable("data", shape=data_shape)
-        op = getattr(sym_mod, op_name)
-        kwargs = {k: v for k, v in self._kwargs.items() if k != "layout"}
-        s = op(data, **kwargs)
-        return s.infer_shape_partial(data=data_shape)[0]
+        probe = sym_mod.Variable("data", shape=data_shape)
+        traced = getattr(sym_mod, self._op_name)(probe, **self._op_kwargs())
+        return traced.infer_shape_partial(data=data_shape)[0][1]
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
-        kwargs = {k: v for k, v in self._kwargs.items() if k != "layout"}
-        if bias is None:
-            act = op(x, weight, name="fwd", **kwargs)
-        else:
-            act = op(x, weight, bias, name="fwd", **kwargs)
-        if self.act is not None:
-            act = self.act(act)
-        return act
+        tensors = (x, weight) if bias is None else (x, weight, bias)
+        out = op(*tensors, name="fwd", **self._op_kwargs())
+        return out if self.act is None else self.act(out)
 
     def _alias(self):
         return "conv"
 
     def __repr__(self):
-        s = "{name}({mapping}, kernel_size={kernel}, stride={stride}"
-        len_kernel_size = len(self._kwargs["kernel"])
-        if self._kwargs["pad"] != (0,) * len_kernel_size:
-            s += ", padding={pad}"
-        if self._kwargs["dilate"] != (1,) * len_kernel_size:
-            s += ", dilation={dilate}"
+        ndim = len(self._kwargs["kernel"])
+        parts = ["kernel_size={kernel}", "stride={stride}"]
+        if self._kwargs["pad"] != (0,) * ndim:
+            parts.append("padding={pad}")
+        if self._kwargs["dilate"] != (1,) * ndim:
+            parts.append("dilation={dilate}")
         if self._kwargs["num_group"] != 1:
-            s += ", groups={num_group}"
+            parts.append("groups={num_group}")
         if self.bias is None:
-            s += ", bias=False"
-        s += ")"
+            parts.append("bias=False")
         shape = self.weight.shape
-        return s.format(name=self.__class__.__name__,
-                        mapping="{0} -> {1}".format(
-                            shape[1] if shape[1] else None, shape[0]),
-                        **self._kwargs)
+        head = "%s -> %s" % (shape[1] if shape[1] else None, shape[0])
+        return ("%s(%s, %s)" % (type(self).__name__, head,
+                                ", ".join(parts))).format(**self._kwargs)
 
 
-class Conv1D(_Conv):
-    """(reference: conv_layers.py:Conv1D)"""
+def _conv_factory(name, ndim, default_layout, transpose=False):
+    """Build a ConvND / ConvNDTranspose class pinned to ``ndim``."""
 
-    def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 dilation=1, groups=1, layout="NCW", activation=None,
-                 use_bias=True, weight_initializer=None,
-                 bias_initializer="zeros", in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,)
-        assert len(kernel_size) == 1, "kernel_size must be a number or a list of 1 ints"
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
+    if transpose:
+        def __init__(self, channels, kernel_size, strides=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     layout=default_layout, activation=None, use_bias=True,
+                     weight_initializer=None, bias_initializer="zeros",
+                     in_channels=0, **kwargs):
+            kernel_size = _tuple_of(kernel_size, ndim)
+            if len(kernel_size) != ndim:
+                raise AssertionError(
+                    "kernel_size must be a number or a list of %d ints"
+                    % ndim)
+            _Conv.__init__(self, channels, kernel_size, strides, padding,
+                           dilation, groups, layout, in_channels, activation,
+                           use_bias, weight_initializer, bias_initializer,
+                           op_name="Deconvolution",
+                           adj=_tuple_of(output_padding, ndim), **kwargs)
+    else:
+        def __init__(self, channels, kernel_size, strides=1, padding=0,
+                     dilation=1, groups=1, layout=default_layout,
+                     activation=None, use_bias=True, weight_initializer=None,
+                     bias_initializer="zeros", in_channels=0, **kwargs):
+            kernel_size = _tuple_of(kernel_size, ndim)
+            if len(kernel_size) != ndim:
+                raise AssertionError(
+                    "kernel_size must be a number or a list of %d ints"
+                    % ndim)
+            _Conv.__init__(self, channels, kernel_size, strides, padding,
+                           dilation, groups, layout, in_channels, activation,
+                           use_bias, weight_initializer, bias_initializer,
+                           **kwargs)
 
-
-class Conv2D(_Conv):
-    """(reference: conv_layers.py:Conv2D)"""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
-                 use_bias=True, weight_initializer=None,
-                 bias_initializer="zeros", in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 2
-        assert len(kernel_size) == 2, "kernel_size must be a number or a list of 2 ints"
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
-
-
-class Conv3D(_Conv):
-    """(reference: conv_layers.py:Conv3D)"""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
-                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer="zeros",
-                 in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 3
-        assert len(kernel_size) == 3, "kernel_size must be a number or a list of 3 ints"
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
-
-
-class Conv1DTranspose(_Conv):
-    """(reference: conv_layers.py:Conv1DTranspose)"""
-
-    def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout="NCW",
-                 activation=None, use_bias=True, weight_initializer=None,
-                 bias_initializer="zeros", in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,)
-        if isinstance(output_padding, int):
-            output_padding = (output_padding,)
-        assert len(kernel_size) == 1, "kernel_size must be a number or a list of 1 ints"
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name="Deconvolution", adj=output_padding, **kwargs)
-
-
-class Conv2DTranspose(_Conv):
-    """(reference: conv_layers.py:Conv2DTranspose)"""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout="NCHW", activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer="zeros",
-                 in_channels=0, **kwargs):
-        if isinstance(kernel_size, int):
-            kernel_size = (kernel_size,) * 2
-        if isinstance(output_padding, int):
-            output_padding = (output_padding,) * 2
-        assert len(kernel_size) == 2, "kernel_size must be a number or a list of 2 ints"
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name="Deconvolution", adj=output_padding, **kwargs)
+    doc = "%dD %sconvolution layer (layout %s)." % (
+        ndim, "transposed " if transpose else "", default_layout)
+    return type(name, (_Conv,), {"__init__": __init__, "__doc__": doc})
 
 
 class _Pooling(HybridBlock):
-    """Base pooling block (reference: conv_layers.py:_Pooling)."""
+    """Shared pooling block over the symbolic Pooling operator."""
 
     def __init__(self, pool_size, strides, padding, ceil_mode=False,
                  global_pool=False, pool_type="max", **kwargs):
         super().__init__(**kwargs)
-        if strides is None:
-            strides = pool_size
-        if isinstance(strides, int):
-            strides = (strides,) * len(pool_size)
-        if isinstance(padding, int):
-            padding = (padding,) * len(pool_size)
+        ndim = len(pool_size)
+        strides = pool_size if strides is None else strides
         self._kwargs = {
-            "kernel": pool_size, "stride": strides, "pad": padding,
+            "kernel": pool_size,
+            "stride": _tuple_of(strides, ndim),
+            "pad": _tuple_of(padding, ndim),
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid"}
 
@@ -204,98 +156,50 @@ class _Pooling(HybridBlock):
         return F.Pooling(x, name="fwd", **self._kwargs)
 
     def __repr__(self):
-        return "{name}(size={kernel}, stride={stride}, padding={pad}, " \
-            "ceil_mode={ceil_mode})".format(
-                name=self.__class__.__name__,
-                ceil_mode=self._kwargs["pooling_convention"] == "full",
-                **self._kwargs)
+        return ("{name}(size={kernel}, stride={stride}, padding={pad}, "
+                "ceil_mode={ceil}").format(
+                    name=type(self).__name__,
+                    ceil=self._kwargs["pooling_convention"] == "full",
+                    **self._kwargs) + ")"
 
 
-class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
-                 ceil_mode=False, **kwargs):
-        assert layout == "NCW", "Only supports NCW layout for now"
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,)
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         "max", **kwargs)
+def _pool_factory(name, ndim, kind, canonical_layout):
+    """Build a Max/AvgPoolND class."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0,
+                 layout=canonical_layout, ceil_mode=False, **kwargs):
+        if layout != canonical_layout:
+            raise AssertionError("Only supports %s layout for now"
+                                 % canonical_layout)
+        _Pooling.__init__(self, _tuple_of(pool_size, ndim), strides, padding,
+                          ceil_mode, False, kind, **kwargs)
+
+    doc = "%dD %s pooling (layout %s)." % (ndim, kind, canonical_layout)
+    return type(name, (_Pooling,), {"__init__": __init__, "__doc__": doc})
 
 
-class MaxPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
-        assert layout == "NCHW", "Only supports NCHW layout for now"
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 2
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         "max", **kwargs)
+def _global_pool_factory(name, ndim, kind, layout):
+    """Build a Global{Max,Avg}PoolND class."""
+
+    def __init__(self, layout=layout, **kwargs):
+        _Pooling.__init__(self, (1,) * ndim, None, 0, True, True, kind,
+                          **kwargs)
+
+    doc = "Global %dD %s pooling." % (ndim, kind)
+    return type(name, (_Pooling,), {"__init__": __init__, "__doc__": doc})
 
 
-class MaxPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
-        assert layout == "NCDHW", "Only supports NCDHW layout for now"
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 3
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         "max", **kwargs)
+_LAYOUTS = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
 
-
-class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
-                 ceil_mode=False, **kwargs):
-        assert layout == "NCW", "Only supports NCW layout for now"
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,)
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         "avg", **kwargs)
-
-
-class AvgPool2D(_Pooling):
-    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
-        assert layout == "NCHW", "Only supports NCHW layout for now"
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 2
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         "avg", **kwargs)
-
-
-class AvgPool3D(_Pooling):
-    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
-        assert layout == "NCDHW", "Only supports NCDHW layout for now"
-        if isinstance(pool_size, int):
-            pool_size = (pool_size,) * 3
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         "avg", **kwargs)
-
-
-class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, 0, True, True, "max", **kwargs)
-
-
-class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, 0, True, True, "max", **kwargs)
-
-
-class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, 0, True, True, "max", **kwargs)
-
-
-class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, 0, True, True, "avg", **kwargs)
-
-
-class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, 0, True, True, "avg", **kwargs)
-
-
-class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, 0, True, True, "avg", **kwargs)
+for _n, _layout in _LAYOUTS.items():
+    globals()["Conv%dD" % _n] = _conv_factory("Conv%dD" % _n, _n, _layout)
+    for _kind in ("max", "avg"):
+        _title = _kind.capitalize()
+        globals()["%sPool%dD" % (_title, _n)] = _pool_factory(
+            "%sPool%dD" % (_title, _n), _n, _kind, _layout)
+        globals()["Global%sPool%dD" % (_title, _n)] = _global_pool_factory(
+            "Global%sPool%dD" % (_title, _n), _n, _kind, _layout)
+for _n in (1, 2):
+    globals()["Conv%dDTranspose" % _n] = _conv_factory(
+        "Conv%dDTranspose" % _n, _n, _LAYOUTS[_n], transpose=True)
+del _n, _layout, _kind, _title

@@ -1,32 +1,48 @@
-"""Monitor — executor-level tensor spy (reference: python/mxnet/monitor.py:33,
-src/executor/graph_executor.cc:199 ExecuteMonCallback).
+"""Monitor: periodic tensor statistics over bound executors.
 
-The reference installs a C callback fired per output entry; here the
-executor exposes its outputs (and optionally interior node values) after each
-forward, and the monitor applies a stat function to tensors whose names match
-the pattern. ``jax.debug.callback`` is the in-jit analog when interior values
-are needed; the default mode spies bound executor outputs + arguments."""
+Parity surface: reference monitor.py + the executor monitor-callback hook
+(src/executor/graph_executor.cc ExecuteMonCallback). The reference fires a
+C callback per output entry; here the executor exposes outputs, args, and
+aux arrays after each forward and the monitor scans whichever names match
+its pattern every ``interval`` batches. ``jax.debug.callback`` is the
+in-jit analog when interior node values are needed (Executor
+set_monitor_callback wires that path).
+"""
 from __future__ import annotations
 
 import logging
 import re
-from math import sqrt
 
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
 
 
+def _default_stat(x):
+    """Mean absolute value, dispatched async on device."""
+    return x.abs().sum() / x.size
+
+
+def _render(values):
+    """Format a stat result (NDArray or list of them) for logging."""
+    if isinstance(values, NDArray):
+        values = [values]
+    if not isinstance(values, list):
+        raise AssertionError("stat_func must return NDArray(s)")
+    pieces = []
+    for v in values:
+        if not isinstance(v, NDArray):
+            raise AssertionError("stat_func must return NDArray(s)")
+        scalarish = v.shape in ((1,), ())
+        pieces.append(str(v.asscalar() if scalarish else v.asnumpy()) + "\t")
+    return "".join(pieces)
+
+
 class Monitor:
-    """Collect stats on matching tensors each step (reference: monitor.py:33)."""
+    """Every ``interval`` batches, record stat_func over matching tensors."""
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                """returns |x|/size(x), async execution."""
-                return x.abs().sum() / x.size
-            stat_func = asum_stat
-        self.stat_func = stat_func
+        self.stat_func = stat_func or _default_stat
         self.interval = interval
         self.activated = False
         self.queue = []
@@ -36,51 +52,40 @@ class Monitor:
         self.sort = sort
 
     def install(self, exe):
-        """Attach to an executor (reference: monitor.py:install)."""
+        """Start watching an executor's tensors."""
         self.exes.append(exe)
 
     def tic(self):
-        """Start collecting for this batch (reference: monitor.py:tic)."""
+        """Call at batch start; arms collection on interval boundaries."""
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
         self.step += 1
 
+    def _scan(self, exe):
+        """All (name, array) pairs this executor exposes."""
+        yield from zip(exe._symbol.list_outputs(), exe.outputs)
+        yield from exe.arg_dict.items()
+        yield from exe.aux_dict.items()
+
     def toc(self):
-        """Collect stats from installed executors (reference: monitor.py:toc)."""
+        """Call at batch end; returns [(step, name, rendered stat)]."""
         if not self.activated:
             return []
         for exe in self.exes:
-            for name, array in zip(exe._symbol.list_outputs(), exe.outputs):
+            for name, array in self._scan(exe):
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-            for name, array in exe.arg_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-            for name, array in exe.aux_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+                    self.queue.append(
+                        (self.step, name, self.stat_func(array)))
         self.activated = False
-        res = []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
+            self.queue.sort(key=lambda entry: entry[1])
+        rendered = [(step, name, _render(stat))
+                    for step, name, stat in self.queue]
         self.queue = []
-        return res
+        return rendered
 
     def toc_print(self):
-        """Collect and log (reference: monitor.py:toc_print)."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """toc() + log each entry."""
+        for step, name, text in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, text)

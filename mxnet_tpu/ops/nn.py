@@ -203,17 +203,36 @@ def _register_conv():
             "use mxnet_tpu.model.convert_conv_weight_layout to exchange "
             "checkpoints with reference NHWC graphs")
 
+    def _deconv_geometry(attrs):
+        """stride/pad/adj/dilate tuples with MXNet defaults applied."""
+        nd = len(attrs.kernel)
+        return (attrs.stride or (1,) * nd, attrs.pad or (0,) * nd,
+                attrs.adj or (0,) * nd, attrs.dilate or (1,) * nd)
+
+    def _deconv_out_size(n, k, s, p, a, d):
+        """MXNet transposed-conv size: s*(n-1) + d*(k-1) + 1 - 2p + a
+        (reference: deconvolution-inl.h InferShape)."""
+        return s * (n - 1) + d * (k - 1) + 1 - 2 * p + a
+
     def deconvolution(attrs, data, weight, *rest):
         nd = len(attrs.kernel)
-        stride = attrs.stride or (1,) * nd
-        pad = attrs.pad or (0,) * nd
-        adj = attrs.adj or (0,) * nd
-        # transposed conv = lhs-dilated conv with flipped kernel semantics;
-        # conv_transpose handles it directly
+        stride, pad, adj, dilate = _deconv_geometry(attrs)
+        if attrs.target_shape:
+            # target_shape overrides adj: pick adj so sizes land exactly
+            adj = tuple(
+                t - _deconv_out_size(data.shape[2 + i], attrs.kernel[i],
+                                     stride[i], pad[i], 0, dilate[i])
+                for i, t in enumerate(attrs.target_shape))
+        # lax.conv_transpose with transpose_kernel=True takes the FORWARD
+        # conv's padding; the transposed operator pads the lhs-dilated input
+        # by d*(k-1)-p on the low side and d*(k-1)-p+adj on the high side.
+        pad_cfg = [(d * (k - 1) - p, d * (k - 1) - p + a)
+                   for k, p, a, d in zip(attrs.kernel, pad, adj, dilate)]
         out = jax.lax.conv_transpose(
             data, weight,
             strides=stride,
-            padding=[(p, p - a) for p, a in zip(pad, adj)],
+            padding=pad_cfg,
+            rhs_dilation=dilate,
             dimension_numbers=_conv_dims(nd),
             transpose_kernel=True,
         )
@@ -225,15 +244,16 @@ def _register_conv():
         d = in_shapes[0]
         if d is None:
             return None
-        nd = len(attrs.kernel)
-        stride = attrs.stride or (1,) * nd
-        pad = attrs.pad or (0,) * nd
-        adj = attrs.adj or (0,) * nd
+        stride, pad, adj, dilate = _deconv_geometry(attrs)
         c = d[1]
         w = (c, attrs.num_filter // attrs.num_group) + tuple(attrs.kernel)
-        spatial = tuple(
-            stride[i] * (d[2 + i] - 1) + attrs.kernel[i] - 2 * pad[i] + adj[i]
-            for i in range(nd))
+        if attrs.target_shape:
+            spatial = tuple(attrs.target_shape)
+        else:
+            spatial = tuple(
+                _deconv_out_size(d[2 + i], attrs.kernel[i], stride[i],
+                                 pad[i], adj[i], dilate[i])
+                for i in range(len(attrs.kernel)))
         out = (d[0], attrs.num_filter) + spatial
         shapes = [d, w] + ([] if attrs.no_bias else [(attrs.num_filter,)])
         return (shapes, [out], aux_shapes)

@@ -506,3 +506,32 @@ def test_maxpool_mask_backward_tie_splitting():
     # 4 windows, each with cotangent 1 split over 4 ties
     np.testing.assert_allclose(g, np.full_like(x, 0.25))
     assert abs(g.sum() - 4.0) < 1e-6
+
+
+def test_deconvolution_geometry_and_values():
+    """Deconvolution must follow the reference size formula
+    s*(n-1) + d*(k-1) + 1 - 2p + a (deconvolution-inl.h InferShape) and
+    match torch's conv_transpose2d numerically. Regression: the old padding
+    transform was only correct at p == k-1."""
+    import numpy as np
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as Fn
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 8, 8).astype("float32")
+    w = rng.randn(4, 6, 3, 3).astype("float32")
+    for s, p, a in [(1, 0, 0), (2, 0, 0), (2, 1, 0), (2, 1, 1), (3, 0, 2)]:
+        got = mx.nd.Deconvolution(
+            mx.nd.array(x), mx.nd.array(w), num_filter=6, kernel=(3, 3),
+            stride=(s, s), pad=(p, p), adj=(a, a), no_bias=True).asnumpy()
+        want = Fn.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                   stride=s, padding=p,
+                                   output_padding=a).numpy()
+        assert got.shape == want.shape, (s, p, a, got.shape, want.shape)
+        assert np.abs(got - want).max() < 1e-4
+
+    # target_shape overrides adj
+    y = mx.nd.Deconvolution(
+        mx.nd.array(x), mx.nd.array(w), num_filter=6, kernel=(3, 3),
+        stride=(2, 2), target_shape=(16, 16), no_bias=True)
+    assert y.shape == (1, 6, 16, 16)
