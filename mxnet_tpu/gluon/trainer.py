@@ -1,4 +1,13 @@
-"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py:235)."""
+"""Gluon Trainer: one optimizer step over a set of Parameters.
+
+Parity surface: reference gluon/trainer.py (ctor, step, save/load_states,
+kvstore wiring). Independent implementation; one deliberate deviation:
+hyperparameter re-ships to a dist_async parameter server (learning rate or
+rescale_grad changes after init) go through the kvstore's barrier-free
+``refresh_optimizer`` path — the reference never re-ships at all, and a
+barriered re-ship could hang the job when triggered asymmetrically (e.g. a
+rank-0-only LR schedule).
+"""
 from __future__ import annotations
 
 from .. import optimizer as opt
@@ -8,158 +17,165 @@ from .parameter import ParameterDict, Parameter
 __all__ = ["Trainer"]
 
 
+def _as_param_list(params):
+    """Normalize dict/ParameterDict/list input to a list of Parameters."""
+    if isinstance(params, (dict, ParameterDict)):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError(
+            "First argument must be a list or dict of Parameters, "
+            "got %s." % (type(params)))
+    for p in params:
+        if not isinstance(p, Parameter):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got list of %s." % (type(p)))
+    return list(params)
+
+
 class Trainer:
-    """Applies an Optimizer to a set of Parameters (reference:
-    trainer.py:Trainer)."""
+    """Pushes gradients and pulls (or locally updates) weights each step."""
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None):
-        if isinstance(params, (dict, ParameterDict)):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, "
-                "got %s." % (type(params)))
-        self._params = []
-        for param in params:
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % (type(param)))
-            self._params.append(param)
+        self._params = _as_param_list(params)
         self._compression_params = compression_params
-        optimizer_params = optimizer_params if optimizer_params else {}
+        optimizer_params = dict(optimizer_params or {})
         self._scale = optimizer_params.get("rescale_grad", 1.0)
-        self._contexts = self._check_contexts()
-        self._init_optimizer(optimizer, optimizer_params)
+        self._contexts = self._common_contexts()
+        self._optimizer = self._build_optimizer(optimizer, optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
         self._kv_initialized = False
         self._kvstore = kvstore
 
-    def _check_contexts(self):
-        contexts = None
-        for param in self._params:
-            ctx = param.list_ctx()
-            assert contexts is None or contexts == ctx, \
-                "All Parameters must be initialized on the same set of " \
-                "contexts, but Parameter %s is initialized on %s while " \
-                "previous Parameters are initialized on %s." % (
-                    param.name, str(ctx), str(contexts))
-            contexts = ctx
-        return contexts
+    def _common_contexts(self):
+        """All parameters must live on one identical context list."""
+        seen = None
+        for p in self._params:
+            ctx = p.list_ctx()
+            if seen is not None and seen != ctx:
+                raise AssertionError(
+                    "All Parameters must be initialized on the same set of "
+                    "contexts, but Parameter %s is initialized on %s while "
+                    "previous Parameters are initialized on %s."
+                    % (p.name, str(ctx), str(seen)))
+            seen = ctx
+        return seen
 
-    def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
+    def _build_optimizer(self, optimizer, optimizer_params):
+        idx2name = {i: p.name for i, p in enumerate(self._params)}
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an Optimizer " \
-                "instance"
-            self._optimizer = optimizer
-            self._optimizer.idx2name = {
-                i: param.name for i, param in enumerate(self._params)}
+            if optimizer_params:
+                raise AssertionError(
+                    "optimizer_params must be None if optimizer is an "
+                    "Optimizer instance")
+            optimizer.idx2name = idx2name
         else:
-            self._optimizer = opt.create(
-                optimizer, param_idx2name={
-                    i: param.name for i, param in enumerate(self._params)},
-                **optimizer_params)
-        # per-param lr/wd multipliers from Parameter attributes
-        self._optimizer.set_lr_mult(
-            {param.name: param.lr_mult for param in self._params})
-        self._optimizer.set_wd_mult(
-            {param.name: param.wd_mult for param in self._params})
-        self._updaters = [opt.get_updater(self._optimizer)
-                          for _ in self._contexts]
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        optimizer.set_lr_mult({p.name: p.lr_mult for p in self._params})
+        optimizer.set_wd_mult({p.name: p.wd_mult for p in self._params})
+        return optimizer
 
     def _init_kvstore(self):
-        """(reference: trainer.py:_init_kvstore)"""
-        arg_arrays = {param.name: param.data(self._contexts[0])
-                      for param in self._params}
+        """Create the kvstore lazily on first step and seed it with weights."""
+        sample = {p.name: p.data(self._contexts[0]) for p in self._params}
         kvstore, update_on_kvstore = _create_kvstore(
-            self._kvstore, len(self._contexts), arg_arrays)
-        if kvstore:
+            self._kvstore, len(self._contexts), sample)
+        if not kvstore:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
-            for i, param in enumerate(self._params):
-                kvstore.init(param.name, param.data(self._contexts[0]))
+            for i, p in enumerate(self._params):
+                kvstore.init(p.name, p.data(self._contexts[0]))
                 if update_on_kvstore:
-                    kvstore.pull(param.name, param.list_data(), priority=-i)
+                    kvstore.pull(p.name, p.list_data(), priority=-i)
             self._kvstore = kvstore
             self._update_on_kvstore = update_on_kvstore
-        else:
-            self._kvstore = None
-            self._update_on_kvstore = False
         self._kv_initialized = True
+
+    def _server_side_optimizer(self):
+        """True when a PS applies updates with its own pickled optimizer
+        copy (dist_async): hyperparameter changes must be re-shipped."""
+        return (self._kv_initialized and self._update_on_kvstore
+                and self._kvstore is not None
+                and self._kvstore._updater is None)
+
+    def _reship_optimizer(self):
+        """Send updated hyperparameters to the PS without a barrier (the
+        server swap preserves optimizer state and is idempotent)."""
+        kv = self._kvstore
+        if hasattr(kv, "refresh_optimizer"):
+            kv.refresh_optimizer(self._optimizer)
+        else:
+            kv.set_optimizer(self._optimizer)
 
     @property
     def learning_rate(self):
         return self._optimizer.lr
 
     def set_learning_rate(self, lr):
-        """(reference: trainer.py:set_learning_rate)"""
+        """Change the lr; re-ships to PS servers when they hold the
+        applying optimizer."""
         self._optimizer.lr = lr
-        if (self._kv_initialized and self._update_on_kvstore
-                and self._kvstore is not None
-                and self._kvstore._updater is None):
-            # the applying optimizer lives on the PS servers — re-ship it
-            # (server preserves momentum state across the swap)
-            self._kvstore.set_optimizer(self._optimizer)
+        if self._server_side_optimizer():
+            self._reship_optimizer()
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Apply one optimization step (reference: trainer.py:step:156)."""
+        """Push grads, then pull updated weights (kvstore) or run the
+        local updaters. ``batch_size`` normalizes the gradient scale."""
         if not self._kv_initialized:
             self._init_kvstore()
 
         rescale = self._scale / batch_size
-        if (self._update_on_kvstore and self._kvstore is not None
-                and self._kvstore._updater is None
-                and self._optimizer.rescale_grad != rescale):
-            # server-side optimizer (dist_async): the pickled copy on the
-            # servers is the one applying updates, so hyperparameter
-            # changes (rescale_grad here; set_learning_rate likewise)
-            # must be re-shipped or the servers keep stale values
+        if self._optimizer.rescale_grad != rescale:
             self._optimizer.rescale_grad = rescale
-            self._kvstore.set_optimizer(self._optimizer)
-        self._optimizer.rescale_grad = rescale
+            if self._server_side_optimizer():
+                self._reship_optimizer()
 
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
                 continue
             if self._kvstore:
-                self._kvstore.push(param.name, param.list_grad(), priority=-i)
+                self._kvstore.push(p.name, p.list_grad(), priority=-i)
                 if self._update_on_kvstore:
-                    self._kvstore.pull(param.name, param.list_data(),
-                                       priority=-i)
+                    self._kvstore.pull(p.name, p.list_data(), priority=-i)
                     continue
-                self._kvstore.pull(param.name, param.list_grad(), priority=-i)
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
+                self._kvstore.pull(p.name, p.list_grad(), priority=-i)
+            for updater, weight, grad in zip(self._updaters, p.list_data(),
+                                             p.list_grad()):
+                updater(i, grad, weight)
 
     def save_states(self, fname):
-        """(reference: trainer.py:save_states)"""
+        """Persist optimizer state (server-side when update_on_kvstore)."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states())
+            return
+        blob = self._updaters[0].get_states()
+        with open(fname, "wb") as sink:
+            sink.write(blob)
 
     def load_states(self, fname):
-        """(reference: trainer.py:load_states)"""
+        """Inverse of save_states."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
             if self._kvstore._updater is not None:
                 self._optimizer = self._kvstore._updater.optimizer
-            # else (dist_async): the optimizer lives on the servers; the
-            # local handle in self._optimizer is already the one shipped
-        else:
-            with open(fname, "rb") as f:
-                states = f.read()
-            for updater in self._updaters:
-                updater.set_states(states)
-                updater.optimizer = self._optimizer
+            # else (dist_async): the applying optimizer lives on the
+            # servers; the local handle is already the shipped one
+            return
+        with open(fname, "rb") as src:
+            blob = src.read()
+        for updater in self._updaters:
+            updater.set_states(blob)
+            updater.optimizer = self._optimizer

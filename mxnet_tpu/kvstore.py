@@ -487,9 +487,13 @@ class KVStoreDistAsync(KVStore):
             merged = self._reduce(vlist)   # local multi-device reduce
             from .ndarray.sparse import BaseSparseNDArray
 
-            if isinstance(merged, BaseSparseNDArray):
+            was_sparse = isinstance(merged, BaseSparseNDArray)
+            if was_sparse:
                 merged = merged._dense_nd()
-            if self._gc_active():
+            # mirror the dist_sync store: 2-bit compression never applies
+            # to sparse gradients (densify-then-compress would silently
+            # change semantics for the same inputs)
+            if self._gc_active() and not was_sparse:
                 # quantize with error feedback and send PACKED 2-bit codes
                 # (4/byte — the 16x wire saving is the feature's point,
                 # kvstore_dist.h:346); the server dequantizes and applies
@@ -555,6 +559,18 @@ class KVStoreDistAsync(KVStore):
         if self.rank == 0:
             self._send_command_to_servers(0, pickle.dumps(optimizer))
         self._barrier()
+
+    def refresh_optimizer(self, optimizer):
+        """Barrier-free hyperparameter re-ship.
+
+        Unlike set_optimizer this may be called from ANY rank and does not
+        synchronize workers: dist_async workers are deliberately
+        unsynchronized, so a barriered re-ship triggered asymmetrically
+        (rank-0-only LR schedule, per-rank rescale_grad) would hang the
+        other ranks. The server-side swap preserves optimizer state and is
+        idempotent, so duplicate re-ships from several ranks are safe."""
+        self._optimizer = optimizer
+        self._send_command_to_servers(0, pickle.dumps(optimizer))
 
     def _send_command_to_servers(self, head, body):
         self._client.all_call(("command", head, body))

@@ -152,14 +152,32 @@ class KVStoreServer:
                 from . import optimizer as opt
 
                 optimizer = pickle.loads(body)
+                # quiesce in-flight pushes before snapshotting state: a
+                # concurrent _apply_push holds only its per-key lock and
+                # would keep writing momentum into the OLD updater after
+                # the snapshot, losing that update across the swap.
+                # Acquire every existing key lock (sorted for a stable
+                # order against concurrent swaps) around the exchange;
+                # keys created mid-swap have no momentum yet, so missing
+                # their locks is harmless.
                 with self._lock:
-                    # hyperparameter re-ships (Trainer rescale_grad /
-                    # set_learning_rate) must not reset momentum state
-                    old_states = (self._updater.get_states()
-                                  if self._updater is not None else None)
-                    self._updater = _NumpyUpdater(opt.get_updater(optimizer))
-                    if old_states is not None:
-                        self._updater.set_states(old_states)
+                    quiesce = [lock for _key, lock in
+                               sorted(self._key_locks.items())]
+                for lock in quiesce:
+                    lock.acquire()
+                try:
+                    with self._lock:
+                        # hyperparameter re-ships (Trainer rescale_grad /
+                        # set_learning_rate) must not reset momentum state
+                        old_states = (self._updater.get_states()
+                                      if self._updater is not None else None)
+                        self._updater = _NumpyUpdater(
+                            opt.get_updater(optimizer))
+                        if old_states is not None:
+                            self._updater.set_states(old_states)
+                finally:
+                    for lock in reversed(quiesce):
+                        lock.release()
                 return ("ok",)
             return ("err", "unknown command head %r" % (head,))
         if op == "barrier":

@@ -164,21 +164,29 @@ def _register_nd_scatter():
             "_scatter_set_nd)")
 
     def batch_take(attrs, a, indices):
-        idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
-        return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+        # N-D data: flatten all but the last axis (BatchTakeOpShape,
+        # indexing_op.h:766-810), clip-take one element per row, restore
+        # the leading shape
+        last = a.shape[-1]
+        rows = a.reshape(-1, last)
+        idx = jnp.clip(indices.astype(jnp.int32).reshape(-1), 0, last - 1)
+        picked = jnp.take_along_axis(rows, idx[:, None], axis=1)[:, 0]
+        return picked.reshape(a.shape[:-1])
 
     def batch_take_infer(attrs, in_shapes, aux_shapes):
         a, i = in_shapes
         if a is None:
             return None
-        return ([a, (a[0],) if i is None else i], [(a[0],)], aux_shapes)
+        out = a[:-1]
+        return ([a, out if i is None else i], [out], aux_shapes)
 
     register_op(
         "batch_take", batch_take, params={},
         num_inputs=2, input_names=["a", "indices"],
         infer_shape=batch_take_infer,
-        doc="out[i] = a[i, indices[i]] for 2-D a (reference: "
-            "indexing_op.cc batch_take)")
+        doc="out[i...] = data[i..., indices[i...]] — N-D data is flattened "
+            "to (prod(shape[:-1]), shape[-1]) like BatchTakeOpShape "
+            "(reference: indexing_op.h:766-810)")
 
 
 _register_nd_scatter()
