@@ -1,33 +1,38 @@
-"""Gluon RNN layers (reference: python/mxnet/gluon/rnn/rnn_layer.py:519).
+"""Gluon fused recurrent layers (RNN / LSTM / GRU).
 
-The reference dispatches to the fused cuDNN RNN op on GPU and unfuses to
-cell-by-cell on CPU (rnn_layer.py:101). Here the fused ``RNN`` op
-(ops/rnn.py, lax.scan) is the only path — it compiles equally for TPU and
-CPU, so no unfuse fallback is needed.
+Parity surface: reference gluon/rnn/rnn_layer.py — ctor signatures,
+parameter naming (``l0_i2h_weight`` …), begin_state/forward protocol,
+_unfuse. The reference runs cuDNN on GPU and falls back to cell-by-cell on
+CPU (rnn_layer.py:101); here the registered ``RNN`` op (ops/rnn.py,
+lax.scan) is the only path — it compiles for TPU and CPU alike, so no
+unfuse fallback is needed. Independent implementation: parameters come
+from one spec generator shared with the flat-blob packing order, and the
+state layout is a class attribute instead of per-class state_info bodies.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from ... import ndarray as nd
-from ...base import MXNetError
 from ..block import Block
-from ..parameter import Parameter
-from ...ops.rnn import rnn_param_size
+from ..utils import _to_initializer as _b
 
 __all__ = ["RNN", "LSTM", "GRU"]
 
+_GATE_COUNTS = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
 
 class _RNNLayer(Block):
-    """Base layer (reference: rnn_layer.py:_RNNLayer)."""
+    """Multi-layer (optionally bidirectional) fused recurrent layer."""
+
+    _STATE_TENSORS = 1  # LSTM carries (h, c)
 
     def __init__(self, hidden_size, num_layers, layout, dropout,
                  bidirectional, input_size, i2h_weight_initializer,
                  h2h_weight_initializer, i2h_bias_initializer,
                  h2h_bias_initializer, mode, **kwargs):
         super().__init__(**kwargs)
-        assert layout in ("TNC", "NTC"), \
-            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        if layout not in ("TNC", "NTC"):
+            raise AssertionError(
+                "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout)
         self._hidden_size = hidden_size
         self._num_layers = num_layers
         self._mode = mode
@@ -39,207 +44,198 @@ class _RNNLayer(Block):
         self._h2h_weight_initializer = h2h_weight_initializer
         self._i2h_bias_initializer = i2h_bias_initializer
         self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = _GATE_COUNTS[mode]
 
-        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        inits = {"i2h_weight": i2h_weight_initializer,
+                 "h2h_weight": h2h_weight_initializer,
+                 "i2h_bias": _b(i2h_bias_initializer),
+                 "h2h_bias": _b(h2h_bias_initializer)}
+        for name, shape in self._param_specs(input_size):
+            kind = name.split("_", 1)[1]
+            p = self.params.get(name, shape=shape, init=inits[kind],
+                                allow_deferred_init=True)
+            setattr(self, name, p)
 
-        ng, ni, nh = self._gates, input_size, hidden_size
-        for i in range(num_layers):
-            for j in (["l", "r"] if self._dir == 2 else ["l"]):
-                self._register_param("%s%d_i2h_weight" % (j, i),
-                                     shape=(ng * nh, ni),
-                                     init=i2h_weight_initializer)
-                self._register_param("%s%d_h2h_weight" % (j, i),
-                                     shape=(ng * nh, nh),
-                                     init=h2h_weight_initializer)
-                self._register_param("%s%d_i2h_bias" % (j, i),
-                                     shape=(ng * nh,),
-                                     init=_b(i2h_bias_initializer))
-                self._register_param("%s%d_h2h_bias" % (j, i),
-                                     shape=(ng * nh,),
-                                     init=_b(h2h_bias_initializer))
-            ni = nh * self._dir
+    def _directions(self):
+        return ("l", "r")[:self._dir]
 
-    def _register_param(self, name, shape, init):
-        p = self.params.get(name, shape=shape, init=init,
-                            allow_deferred_init=True)
-        setattr(self, name, p)
-        return p
+    def _param_specs(self, input_size):
+        """(name, shape) for every parameter, in registration order."""
+        width = self._gates * self._hidden_size
+        fan_in = input_size
+        for layer in range(self._num_layers):
+            for side in self._directions():
+                tag = "%s%d_" % (side, layer)
+                yield tag + "i2h_weight", (width, fan_in)
+                yield tag + "h2h_weight", (width, self._hidden_size)
+                yield tag + "i2h_bias", (width,)
+                yield tag + "h2h_bias", (width,)
+            fan_in = self._hidden_size * self._dir
 
     def __repr__(self):
-        s = "{name}({mapping}, {_layout}"
-        if self._num_layers != 1:
-            s += ", num_layers={_num_layers}"
-        if self._dropout != 0:
-            s += ", dropout={_dropout}"
-        if self._dir == 2:
-            s += ", bidirectional"
-        s += ")"
         shape = self.l0_i2h_weight.shape
-        mapping = "{0} -> {1}".format(
-            shape[1] if shape[1] else None, shape[0] // self._gates)
-        return s.format(name=self.__class__.__name__, mapping=mapping,
-                        **self.__dict__)
+        head = "%s -> %s" % (shape[1] if shape[1] else None,
+                             shape[0] // self._gates)
+        extras = [head, self._layout]
+        if self._num_layers != 1:
+            extras.append("num_layers=%s" % self._num_layers)
+        if self._dropout != 0:
+            extras.append("dropout=%s" % self._dropout)
+        if self._dir == 2:
+            extras.append("bidirectional")
+        return "%s(%s)" % (type(self).__name__, ", ".join(extras))
 
     def state_info(self, batch_size=0):
-        raise NotImplementedError
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"}
+                for _ in range(self._STATE_TENSORS)]
 
     def _unfuse(self):
-        """Build the equivalent stacked cells (reference: rnn_layer.py:_unfuse)."""
+        """Equivalent explicit cell stack sharing this layer's params."""
         from . import rnn_cell as cell_mod
 
-        get_cell = {
-            "rnn_relu": lambda **kw: cell_mod.RNNCell(
-                self._hidden_size, activation="relu", **kw),
-            "rnn_tanh": lambda **kw: cell_mod.RNNCell(
-                self._hidden_size, activation="tanh", **kw),
-            "lstm": lambda **kw: cell_mod.LSTMCell(self._hidden_size, **kw),
-            "gru": lambda **kw: cell_mod.GRUCell(self._hidden_size, **kw),
+        step_cls, step_kw = {
+            "rnn_relu": (cell_mod.RNNCell, {"activation": "relu"}),
+            "rnn_tanh": (cell_mod.RNNCell, {"activation": "tanh"}),
+            "lstm": (cell_mod.LSTMCell, {}),
+            "gru": (cell_mod.GRUCell, {}),
         }[self._mode]
 
         stack = cell_mod.SequentialRNNCell(prefix=self.prefix,
                                            params=self.collect_params())
         with stack.name_scope():
-            ni = self._input_size
-            for i in range(self._num_layers):
-                kwargs = {
-                    "input_size": ni,
-                    "i2h_weight_initializer": self._i2h_weight_initializer,
-                    "h2h_weight_initializer": self._h2h_weight_initializer,
-                    "i2h_bias_initializer": self._i2h_bias_initializer,
-                    "h2h_bias_initializer": self._h2h_bias_initializer}
+            fan_in = self._input_size
+            for layer in range(self._num_layers):
+                common = dict(
+                    step_kw, input_size=fan_in,
+                    i2h_weight_initializer=self._i2h_weight_initializer,
+                    h2h_weight_initializer=self._h2h_weight_initializer,
+                    i2h_bias_initializer=self._i2h_bias_initializer,
+                    h2h_bias_initializer=self._h2h_bias_initializer)
+
+                def make(side):
+                    return step_cls(self._hidden_size,
+                                    prefix="%s%d_" % (side, layer), **common)
+
                 if self._dir == 2:
-                    stack.add(cell_mod.BidirectionalCell(
-                        get_cell(prefix="l%d_" % i, **kwargs),
-                        get_cell(prefix="r%d_" % i, **kwargs)))
+                    stack.add(cell_mod.BidirectionalCell(make("l"), make("r")))
                 else:
-                    stack.add(get_cell(prefix="l%d_" % i, **kwargs))
-                if self._dropout > 0 and i != self._num_layers - 1:
+                    stack.add(make("l"))
+                if self._dropout > 0 and layer != self._num_layers - 1:
                     stack.add(cell_mod.DropoutCell(self._dropout))
-                ni = self._hidden_size * self._dir
+                fan_in = self._hidden_size * self._dir
         return stack
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
-        """(reference: rnn_layer.py:begin_state)"""
-        if func is None:
-            func = nd.zeros
+        """Initial state tensors (default zeros)."""
+        func = func or nd.zeros
         states = []
         for i, info in enumerate(self.state_info(batch_size)):
-            info = dict(info)
-            info.pop("__layout__", None)
-            info.update(kwargs)
+            spec = dict(info)
+            spec.pop("__layout__", None)
+            spec.update(kwargs)
             try:
-                states.append(func(name="%sh0_%d" % (self.prefix, i), **info))
+                states.append(func(name="%sh0_%d" % (self.prefix, i), **spec))
             except TypeError:
-                states.append(func(**info))
+                states.append(func(**spec))
         return states
 
+    def _finish_deferred(self, inputs):
+        """Resolve deferred weight shapes from the first real input."""
+        feature_size = inputs.shape[2]
+        for side in self._directions():
+            first = getattr(self, "%s0_i2h_weight" % side)
+            first.shape = (self._gates * self._hidden_size, feature_size)
+        for p in self.collect_params().values():
+            p._finish_deferred_init()
+        self._input_size = feature_size
+
     def forward(self, inputs, states=None):
-        """(reference: rnn_layer.py:forward — always the fused path here)"""
         batch_size = inputs.shape[self._layout.find("N")]
-        skip_states = states is None
-        if skip_states:
+        implicit = states is None
+        if implicit:
             states = self.begin_state(batch_size, ctx=inputs.context)
         if isinstance(states, nd.NDArray):
             states = [states]
         for state, info in zip(states, self.state_info(batch_size)):
             if state.shape != info["shape"]:
                 raise ValueError(
-                    "Invalid recurrent state shape. Expecting %s, got %s." % (
-                        str(info["shape"]), str(state.shape)))
+                    "Invalid recurrent state shape. Expecting %s, got %s."
+                    % (str(info["shape"]), str(state.shape)))
         if self._input_size == 0:
-            # finish deferred init now that the input feature size is known
-            for name in ("l", "r")[:self._dir]:
-                p = getattr(self, "%s0_i2h_weight" % name)
-                p.shape = (self._gates * self._hidden_size, inputs.shape[2])
-            for p in self.collect_params().values():
-                p._finish_deferred_init()
-            self._input_size = inputs.shape[2]
+            self._finish_deferred(inputs)
         out = self._forward_kernel(inputs, states)
-        return out[0] if skip_states else out
+        return out[0] if implicit else out
+
+    def _flat_params(self, ctx):
+        """All weights then all biases, layer-major, as one flat vector
+        (the fused op's canonical blob layout)."""
+        chunks = []
+        for kind in ("weight", "bias"):
+            for layer in range(self._num_layers):
+                for side in self._directions():
+                    for group in ("i2h", "h2h"):
+                        p = getattr(self, "%s%d_%s_%s"
+                                    % (side, layer, group, kind))
+                        chunks.append(p.data(ctx).reshape((-1,)))
+        return nd.concatenate(chunks, axis=0)
 
     def _forward_kernel(self, inputs, states):
-        """Pack params flat + call fused RNN op (reference:
-        rnn_layer.py:_forward_kernel)."""
         if self._layout == "NTC":
             inputs = nd.swapaxes(inputs, dim1=0, dim2=1)
-        ctx = inputs.context
-        params = []
-        for t in ("weight", "bias"):
-            for i in range(self._num_layers):
-                for j in (["l", "r"] if self._dir == 2 else ["l"]):
-                    for k in ("i2h", "h2h"):
-                        p = getattr(self, "%s%d_%s_%s" % (j, i, k, t))
-                        params.append(p.data(ctx).reshape((-1,)))
-        params = nd.concatenate(params, axis=0)
-
-        rnn_args = [inputs, params] + list(states)
-        outputs = nd.RNN(*rnn_args, state_size=self._hidden_size,
-                         num_layers=self._num_layers,
-                         bidirectional=self._dir == 2, p=self._dropout,
-                         state_outputs=True, mode=self._mode)
-        if self._mode == "lstm":
-            outputs, states = outputs[0], [outputs[1], outputs[2]]
-        else:
-            outputs, states = outputs[0], [outputs[1]]
+        blob = self._flat_params(inputs.context)
+        node = nd.RNN(inputs, blob, *states, state_size=self._hidden_size,
+                      num_layers=self._num_layers,
+                      bidirectional=self._dir == 2, p=self._dropout,
+                      state_outputs=True, mode=self._mode)
+        outputs = node[0]
+        states = [node[1], node[2]] if self._mode == "lstm" else [node[1]]
         if self._layout == "NTC":
             outputs = nd.swapaxes(outputs, dim1=0, dim2=1)
         return outputs, states
 
 
-from ..utils import _to_initializer as _b  # noqa: E402
+def _ctor_args(local_vars):
+    """Rearrange a subclass ctor's locals() into base-ctor kwargs."""
+    picked = dict(local_vars)
+    picked.pop("self")
+    picked.pop("__class__", None)
+    extra = picked.pop("kwargs")
+    picked.update(extra)
+    return picked
 
 
 class RNN(_RNNLayer):
-    """Elman RNN layer (reference: rnn_layer.py:RNN)."""
+    """Stacked Elman RNN with relu/tanh activation."""
 
     def __init__(self, hidden_size, num_layers=1, activation="relu",
                  layout="TNC", dropout=0, bidirectional=False,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  input_size=0, **kwargs):
-        super().__init__(hidden_size, num_layers, layout, dropout,
-                         bidirectional, input_size, i2h_weight_initializer,
-                         h2h_weight_initializer, i2h_bias_initializer,
-                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"}]
+        picked = _ctor_args(locals())
+        super().__init__(mode="rnn_" + picked.pop("activation"), **picked)
 
 
 class LSTM(_RNNLayer):
-    """LSTM layer (reference: rnn_layer.py:LSTM) — BASELINE config #4."""
+    """Stacked LSTM (BASELINE config #4's layer)."""
+
+    _STATE_TENSORS = 2
 
     def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
                  bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  **kwargs):
-        super().__init__(hidden_size, num_layers, layout, dropout,
-                         bidirectional, input_size, i2h_weight_initializer,
-                         h2h_weight_initializer, i2h_bias_initializer,
-                         h2h_bias_initializer, "lstm", **kwargs)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"},
-                {"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"}]
+        super().__init__(mode="lstm", **_ctor_args(locals()))
 
 
 class GRU(_RNNLayer):
-    """GRU layer (reference: rnn_layer.py:GRU)."""
+    """Stacked GRU."""
 
     def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
                  bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  **kwargs):
-        super().__init__(hidden_size, num_layers, layout, dropout,
-                         bidirectional, input_size, i2h_weight_initializer,
-                         h2h_weight_initializer, i2h_bias_initializer,
-                         h2h_bias_initializer, "gru", **kwargs)
-
-    def state_info(self, batch_size=0):
-        return [{"shape": (self._num_layers * self._dir, batch_size,
-                           self._hidden_size), "__layout__": "LNC"}]
+        super().__init__(mode="gru", **_ctor_args(locals()))
