@@ -9,32 +9,27 @@ reference code runs; the size is recorded and visible but does not
 change execution."""
 from __future__ import annotations
 
+import contextlib
+
 __all__ = ["set_bulk_size", "bulk"]
 
 _bulk_size = 15  # the reference's MXNET_ENGINE_BULK_SIZE default
 
 
 def set_bulk_size(size):
-    """Set (and return the previous) bulk size. Advisory on TPU — XLA
-    fusion plays the bulking role (reference: engine.py:26)."""
+    """Set the advisory bulk size, returning the previous value. On TPU the
+    XLA fusion pass plays the bulking role, so this only records intent."""
     global _bulk_size
-    prev, _bulk_size = _bulk_size, int(size)
-    return prev
+    previous = _bulk_size
+    _bulk_size = int(size)
+    return previous
 
 
-class _BulkScope(object):
-    def __init__(self, size):
-        self._size = size
-        self._old = None
-
-    def __enter__(self):
-        self._old = set_bulk_size(self._size)
-        return self
-
-    def __exit__(self, ptype, value, trace):
-        set_bulk_size(self._old)
-
-
+@contextlib.contextmanager
 def bulk(size):
-    """Scope form of :func:`set_bulk_size` (reference: engine.py:63)."""
-    return _BulkScope(size)
+    """``with engine.bulk(n):`` scope form of :func:`set_bulk_size`."""
+    outer = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(outer)

@@ -1,11 +1,10 @@
-"""Gluon — the imperative/hybrid frontend (reference: python/mxnet/gluon/)."""
-from .parameter import Parameter, ParameterDict, DeferredInitializationError
-from .block import Block, HybridBlock, SymbolBlock
-from .trainer import Trainer
-from . import nn
-from . import rnn
-from . import loss
-from . import data
-from . import utils
-from . import model_zoo
-from . import contrib
+"""Gluon: the imperative / hybridizable frontend.
+
+Same import surface as the reference gluon package (Block family, Parameter
+machinery, Trainer, and the nn/rnn/loss/data/model_zoo/contrib subpackages).
+"""
+from . import contrib, data, loss, model_zoo, nn, rnn, utils  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import (DeferredInitializationError, Parameter,  # noqa: F401
+                        ParameterDict)
+from .trainer import Trainer  # noqa: F401

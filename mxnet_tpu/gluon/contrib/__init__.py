@@ -1,3 +1,4 @@
-"""Gluon contrib — experimental layers kept for reference parity
-(reference: python/mxnet/gluon/contrib/)."""
-from . import rnn
+"""Experimental Gluon pieces kept at reference import locations."""
+from . import rnn  # noqa: F401
+
+__all__ = ["rnn"]

@@ -1,3 +1,7 @@
-"""Contrib recurrent cells (reference: python/mxnet/gluon/contrib/rnn/)."""
+"""Contrib recurrent cells (Conv*Cells, VariationalDropoutCell)."""
 from .conv_rnn_cell import *  # noqa: F401,F403
 from .rnn_cell import *  # noqa: F401,F403
+
+from . import conv_rnn_cell as _conv, rnn_cell as _plain
+
+__all__ = list(_conv.__all__) + list(_plain.__all__)

@@ -1,5 +1,6 @@
-"""Gluon data API (reference: python/mxnet/gluon/data/)."""
-from .dataset import Dataset, ArrayDataset, SimpleDataset
-from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
-from .dataloader import DataLoader
-from . import vision
+"""Gluon dataset / sampler / loader API (reference import surface)."""
+from . import vision  # noqa: F401
+from .dataloader import DataLoader  # noqa: F401
+from .dataset import ArrayDataset, Dataset, SimpleDataset  # noqa: F401
+from .sampler import (BatchSampler, RandomSampler,  # noqa: F401
+                      SequentialSampler, Sampler)

@@ -1,10 +1,14 @@
-"""Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py:676).
+"""Parameter and ParameterDict: trainable state with deferred shapes.
 
-Keeps the reference's deferred-initialization contract (shape may contain 0s
-until the first forward infers it) and the per-context data/grad replica API
-(`list_data`/`list_grad`). On TPU the interesting multi-device layout is a
-sharded jax.Array over a Mesh rather than replica lists — `list_data` serves
-the context-list compatibility surface.
+Parity surface: reference gluon/parameter.py — the deferred-initialization
+contract (shapes may contain 0s until the first forward infers them) and
+the per-context replica API (data/list_data/grad/list_grad). On TPU the
+interesting multi-device layout is a sharded jax.Array over a Mesh
+(mxnet_tpu.parallel); the context-replica lists here serve API compat.
+
+Independent implementation: replica storage is one ``_Replicas`` record
+(per-context data + grads created together), and shape reconciliation in
+ParameterDict.get is a standalone merge function.
 """
 from __future__ import annotations
 
@@ -23,11 +27,32 @@ __all__ = ["Parameter", "ParameterDict", "DeferredInitializationError"]
 
 
 class DeferredInitializationError(MXNetError):
-    """Error for unfinished deferred initialization."""
+    """Raised when touching a parameter whose init is still deferred."""
+
+
+def _ctx_list(ctx, fallback=None):
+    """Normalize a ctx argument to a list (or the fallback when None)."""
+    if ctx is None:
+        return fallback
+    if isinstance(ctx, Context):
+        return [ctx]
+    return list(ctx)
+
+
+def _merge_shapes(declared, incoming):
+    """Reconcile two shapes where 0 means unknown; None if incompatible."""
+    if incoming is None or len(incoming) != len(declared):
+        return None
+    merged = []
+    for a, b in zip(incoming, declared):
+        if a != b and a * b != 0:
+            return None
+        merged.append(b if a == 0 else a)
+    return tuple(merged)
 
 
 class Parameter:
-    """A trainable parameter (reference: parameter.py:Parameter)."""
+    """One named tensor with optional gradient, replicated per context."""
 
     def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
@@ -52,17 +77,20 @@ class Parameter:
             self._stype = stype
 
     def __repr__(self):
-        s = "Parameter {name} (shape={shape}, dtype={dtype})"
-        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape,
+                                                      self.dtype)
 
+    # ------------------------------------------------------------- grad req
     @property
     def grad_req(self):
         return self._grad_req
 
     @grad_req.setter
     def grad_req(self, req):
-        assert req in ("write", "add", "null"), \
-            "grad_req must be one of 'write', 'add', or 'null', but got '%s'" % req
+        if req not in ("write", "add", "null"):
+            raise AssertionError(
+                "grad_req must be one of 'write', 'add', or 'null', but got "
+                "'%s'" % req)
         if not self._differentiable:
             req = "null"
         if self._grad_req == req:
@@ -73,89 +101,118 @@ class Parameter:
         elif self._data is not None:
             self._init_grad()
 
-    def _check_and_get(self, arr_dict, ctx):
-        if arr_dict is not None:
-            if ctx is list:
-                return list(arr_dict.values())
-            if ctx is None:
-                if len(arr_dict) == 1:
-                    return list(arr_dict.values())[0]
-                ctx = current_context()
-            if ctx in arr_dict:
-                return arr_dict[ctx]
-            # context-relaxed lookup (same type, any id)
-            for c, v in arr_dict.items():
-                if c.device_type == ctx.device_type:
-                    return v
-            raise RuntimeError(
-                "Parameter %s was not initialized on context %s. It was only "
-                "initialized on %s." % (self.name, str(ctx),
-                                        str(list(arr_dict.keys()))))
+    # ------------------------------------------------------------ accessors
+    def _uninitialized_error(self):
         if self._deferred_init:
-            raise DeferredInitializationError(
+            return DeferredInitializationError(
                 "Parameter %s has not been initialized yet because "
                 "initialization was deferred. Actual initialization happens "
                 "during the first forward pass. Please pass one batch of "
-                "data through the network before accessing Parameters." %
-                self.name)
-        raise RuntimeError(
+                "data through the network before accessing Parameters."
+                % self.name)
+        return RuntimeError(
             "Parameter %s has not been initialized. Note that you should "
-            "initialize parameters and create Trainer with Block.collect_params() "
-            "instead of Block.params because the later does not include "
-            "Parameters of nested child Blocks" % self.name)
+            "initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the "
+            "later does not include Parameters of nested child Blocks"
+            % self.name)
 
-    def _load_init(self, data, ctx):
-        """(reference: parameter.py:_load_init)"""
-        if self.shape:
-            for self_dim, data_dim in zip(self.shape, data.shape):
-                assert self_dim == 0 or self_dim == data_dim, \
-                    "Failed loading Parameter %s from saved params: shape " \
-                    "incompatible expacted %s vs saved %s" % (
-                        self.name, str(self.shape), str(data.shape))
-        if isinstance(ctx, Context):
-            ctx = [ctx]
+    def _fetch(self, table, ctx):
+        """One replica (or all of them when ctx is the ``list`` sentinel)."""
+        if table is None:
+            raise self._uninitialized_error()
+        if ctx is list:
+            return list(table.values())
+        if ctx is None:
+            if len(table) == 1:
+                return next(iter(table.values()))
+            ctx = current_context()
+        if ctx in table:
+            return table[ctx]
+        for c, arr in table.items():  # relaxed: same device type, any id
+            if c.device_type == ctx.device_type:
+                return arr
+        raise RuntimeError(
+            "Parameter %s was not initialized on context %s. It was only "
+            "initialized on %s." % (self.name, str(ctx),
+                                    str(list(table.keys()))))
+
+    def data(self, ctx=None):
+        return self._fetch(self._data, ctx)
+
+    def list_data(self):
+        return self._fetch(self._data, list)
+
+    def _grad_table(self):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because "
+                "grad_req='null'" % self.name)
+        return self._grad
+
+    def grad(self, ctx=None):
+        return self._fetch(self._grad_table(), ctx)
+
+    def list_grad(self):
+        return self._fetch(self._grad_table(), list)
+
+    def list_ctx(self):
         if self._data is None:
             if self._deferred_init:
-                assert ctx is None or set(ctx) == set(self._deferred_init[1]), \
-                    "Failed to load Parameter %s on %s because it was " \
-                    "previous initialized on %s." % (
-                        self.name, str(ctx), str(self.list_ctx()))
-                ctx = self._deferred_init[1]
-            elif ctx is None:
-                ctx = [cpu()]
-            self._init_impl(data, ctx)
-        else:
-            assert ctx is None or set(ctx) == set(self.list_ctx()), \
-                "Failed to load Parameter %s on %s because it was " \
-                "previous initialized on %s." % (
-                    self.name, str(ctx), str(self.list_ctx()))
-            self.set_data(data)
-        self._deferred_init = ()
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter %s has not been initialized"
+                               % self.name)
+        return self._ctx_list
+
+    # -------------------------------------------------------- initialization
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Materialise (or defer) the parameter on the given contexts."""
+        from ..initializer import Uniform
+
+        default_init = default_init or Uniform()
+        if self._data is not None and not force_reinit:
+            warnings.warn("Parameter %s is already initialized, ignoring. "
+                          "Set force_reinit=True to re-initialize."
+                          % self.name, stacklevel=2)
+            return
+        self._data = self._grad = None
+        ctx = _ctx_list(ctx, [current_context()])
+        chosen = init if init is not None else (self.init or default_init)
+        self._deferred_init = (chosen, ctx, default_init, None)
+        if self.shape is None or np.prod(self.shape) <= 0:
+            if not self._allow_deferred_init:
+                raise ValueError(
+                    "Cannot initialize Parameter %s because it has invalid "
+                    "shape: %s." % (self.name, str(self.shape)))
+            return
+        self._finish_deferred_init()
 
     def _finish_deferred_init(self):
-        """(reference: parameter.py:_finish_deferred_init)"""
+        """Run the stored init once the shape is fully known."""
         if not self._deferred_init:
             return
-        init_, ctx, default_init, data = self._deferred_init
+        chosen, ctx, default_init, data = self._deferred_init
         self._deferred_init = ()
-        if isinstance(init_, str):
-            init_ = init.create(init_)
+        if isinstance(chosen, str):
+            chosen = init.create(chosen)
         if isinstance(default_init, str):
             default_init = init.create(default_init)
-        assert self.shape is not None and np.prod(self.shape) > 0, \
-            "Cannot initialize Parameter %s because it has invalid shape: %s. " \
-            "Please specify in_units, in_channels, etc for `Block`s." % (
-                self.name, str(self.shape))
+        if self.shape is None or np.prod(self.shape) <= 0:
+            raise AssertionError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape: %s. Please specify in_units, in_channels, etc for "
+                "`Block`s." % (self.name, str(self.shape)))
         with autograd.pause():
             if data is None:
-                buf = np.zeros(self.shape, dtype=self.dtype)
-                (init_ if init_ is not None else default_init)(
-                    InitDesc(self.name, {"__init__": ""}), buf)
-                data = nd.array(buf, dtype=self.dtype)
+                host = np.zeros(self.shape, dtype=self.dtype)
+                (chosen if chosen is not None else default_init)(
+                    InitDesc(self.name, {"__init__": ""}), host)
+                data = nd.array(host, dtype=self.dtype)
             self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
-        """Set data on every context (reference: parameter.py:_init_impl)."""
+        """Place ``data`` on every context and build grads."""
         if not isinstance(data, nd.NDArray):
             data = nd.array(np.asarray(data), dtype=self.dtype)
         self.shape = data.shape
@@ -164,7 +221,6 @@ class Parameter:
         self._init_grad()
 
     def _init_grad(self):
-        """(reference: parameter.py:_init_grad)"""
         if self.grad_req == "null":
             self._grad = None
             return
@@ -174,117 +230,77 @@ class Parameter:
             autograd.mark_variables([self._data[c]], [self._grad[c]],
                                     self.grad_req)
 
+    def _load_init(self, data, ctx):
+        """Initialize from a loaded array, validating shape and contexts."""
+        if self.shape:
+            for mine, theirs in zip(self.shape, data.shape):
+                if mine not in (0, theirs):
+                    raise AssertionError(
+                        "Failed loading Parameter %s from saved params: "
+                        "shape incompatible expacted %s vs saved %s"
+                        % (self.name, str(self.shape), str(data.shape)))
+        ctx = _ctx_list(ctx)
+        if self._data is not None:
+            if ctx is not None and set(ctx) != set(self.list_ctx()):
+                raise AssertionError(
+                    "Failed to load Parameter %s on %s because it was "
+                    "previous initialized on %s."
+                    % (self.name, str(ctx), str(self.list_ctx())))
+            self.set_data(data)
+        else:
+            if self._deferred_init:
+                deferred_ctx = self._deferred_init[1]
+                if ctx is not None and set(ctx) != set(deferred_ctx):
+                    raise AssertionError(
+                        "Failed to load Parameter %s on %s because it was "
+                        "previous initialized on %s."
+                        % (self.name, str(ctx), str(self.list_ctx())))
+                ctx = deferred_ctx
+            elif ctx is None:
+                ctx = [cpu()]
+            self._init_impl(data, ctx)
+        self._deferred_init = ()
+
+    # -------------------------------------------------------------- mutation
     def _reduce(self):
-        """Average over contexts (reference: parameter.py:_reduce)."""
-        block = self.list_data()
-        if len(block) == 1:
-            return block[0].copy()
-        data = sum(w.as_in_context(cpu()) for w in block) / len(block)
-        return data
-
-    def initialize(self, init=None, ctx=None, default_init=None,
-                   force_reinit=False):
-        """(reference: parameter.py:initialize)"""
-        from ..initializer import Uniform
-
-        default_init = default_init or Uniform()
-        if self._data is not None and not force_reinit:
-            warnings.warn("Parameter %s is already initialized, ignoring. "
-                          "Set force_reinit=True to re-initialize." % self.name,
-                          stacklevel=2)
-            return
-        self._data = self._grad = None
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if init is None:
-            init = default_init if self.init is None else self.init
-        if self.shape is None or np.prod(self.shape) <= 0:
-            if self._allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init, None)
-                return
-            raise ValueError("Cannot initialize Parameter %s because it has "
-                             "invalid shape: %s." % (self.name, str(self.shape)))
-        self._deferred_init = (init, ctx, default_init, None)
-        self._finish_deferred_init()
+        """One averaged host-side copy across replicas."""
+        replicas = self.list_data()
+        if len(replicas) == 1:
+            return replicas[0].copy()
+        return sum(r.as_in_context(cpu()) for r in replicas) / len(replicas)
 
     def reset_ctx(self, ctx):
-        """(reference: parameter.py:reset_ctx)"""
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
+        """Move the parameter to a new context list."""
+        ctx = _ctx_list(ctx, [current_context()])
         if self._data:
-            data = self._reduce()
+            merged = self._reduce()
             with autograd.pause():
-                self._init_impl(data, ctx)
+                self._init_impl(merged, ctx)
         elif self._deferred_init:
-            init_, _, default_init, data = self._deferred_init
-            self._deferred_init = (init_, ctx, default_init, data)
+            chosen, _old, default_init, data = self._deferred_init
+            self._deferred_init = (chosen, ctx, default_init, data)
         else:
-            raise ValueError("Cannot reset context for Parameter %s because it "
-                             "has not been initialized." % self.name)
+            raise ValueError("Cannot reset context for Parameter %s because "
+                             "it has not been initialized." % self.name)
 
     def set_data(self, data):
-        """(reference: parameter.py:set_data)"""
-        assert self._data is not None, \
-            "Parameter %s has not been initialized" % self.name
+        """Overwrite every replica with ``data``."""
+        if self._data is None:
+            raise AssertionError("Parameter %s has not been initialized"
+                                 % self.name)
         if not isinstance(data, nd.NDArray):
             data = nd.array(np.asarray(data), dtype=self.dtype)
         for c, arr in self._data.items():
             arr._set_data(data.as_in_context(c)._data)
 
-    def data(self, ctx=None):
-        """(reference: parameter.py:data)"""
-        return self._check_and_get(self._data, ctx)
-
-    def list_data(self):
-        return self._check_and_get(self._data, list)
-
-    def grad(self, ctx=None):
-        """(reference: parameter.py:grad)"""
-        if self._data is not None and self._grad is None:
-            raise RuntimeError(
-                "Cannot get gradient array for Parameter %s because grad_req="
-                "'null'" % self.name)
-        return self._check_and_get(self._grad, ctx)
-
-    def list_grad(self):
-        if self._data is not None and self._grad is None:
-            raise RuntimeError(
-                "Cannot get gradient array for Parameter %s because grad_req="
-                "'null'" % self.name)
-        return self._check_and_get(self._grad, list)
-
-    def list_ctx(self):
-        """(reference: parameter.py:list_ctx)"""
-        if self._data is None:
-            if self._deferred_init:
-                return self._deferred_init[1]
-            raise RuntimeError("Parameter %s has not been initialized"
-                               % self.name)
-        return self._ctx_list
-
     def zero_grad(self):
-        """(reference: parameter.py:zero_grad)"""
         if self._grad is None:
             return
         for g in self._grad.values():
             g._set_data(nd.zeros(g.shape, ctx=g.context, dtype=g.dtype)._data)
 
-    def var(self):
-        """Symbol view for hybrid trace (reference: parameter.py:var)."""
-        from .. import symbol as sym
-
-        if self._var is None:
-            self._var = sym.Variable(self.name, shape=self.shape,
-                                     lr_mult=self.lr_mult,
-                                     wd_mult=self.wd_mult)
-        return self._var
-
     def cast(self, dtype):
-        """(reference: parameter.py:cast)"""
+        """Change dtype in place (replicas and grads re-created)."""
         self.dtype = dtype
         if self._data is None:
             return
@@ -297,20 +313,31 @@ class Parameter:
                     autograd.mark_variables([self._data[c]], [self._grad[c]],
                                             self.grad_req)
 
+    def var(self):
+        """The Symbol standing for this parameter in hybrid traces."""
+        from .. import symbol as sym
+
+        if self._var is None:
+            self._var = sym.Variable(self.name, shape=self.shape,
+                                     lr_mult=self.lr_mult,
+                                     wd_mult=self.wd_mult)
+        return self._var
+
 
 class ParameterDict:
-    """Name-scoped dict of Parameters (reference: parameter.py:ParameterDict)."""
+    """Insertion-ordered, prefix-scoped mapping of Parameters with
+    optional fallthrough to a shared dict."""
 
     def __init__(self, prefix="", shared=None):
         self._prefix = prefix
-        self._params = {}  # insertion-ordered
+        self._params = {}
         self._shared = shared
 
     def __repr__(self):
-        s = "{name}(\n{content}\n)"
-        name = self._prefix + " " if self._prefix else ""
-        return s.format(name=name, content="\n".join(
-            [repr(v).replace("\n", "\n  ") for v in self.values()]))
+        head = self._prefix + " " if self._prefix else ""
+        body = "\n".join(repr(p).replace("\n", "\n  ")
+                         for p in self.values())
+        return "%s(\n%s\n)" % (head, body)
 
     def __getitem__(self, key):
         return self._params[key]
@@ -331,126 +358,122 @@ class ParameterDict:
     def prefix(self):
         return self._prefix
 
-    def _get_impl(self, name):
+    def _find(self, name):
+        """Local lookup, then the shared dict (cached locally on hit)."""
         if name in self._params:
             return self._params[name]
         if self._shared is not None and name in self._shared._params:
-            self._params[name] = self._shared._params[name]
-            return self._shared._params[name]
+            borrowed = self._shared._params[name]
+            self._params[name] = borrowed
+            return borrowed
         return None
 
     def get(self, name, **kwargs):
-        """Get-or-create (reference: parameter.py:ParameterDict.get)."""
+        """Fetch-or-create ``prefix+name``, reconciling attributes."""
         name = self.prefix + name
-        param = self._get_impl(name)
+        param = self._find(name)
         if param is None:
             param = Parameter(name, **kwargs)
             self._params[name] = param
-        else:
-            for k, v in kwargs.items():
-                if hasattr(param, k) and getattr(param, k) is not None:
-                    existing = getattr(param, k)
-                    if k == "shape" and v is not None and \
-                            len(v) == len(existing):
-                        inferred_shape = []
-                        matched = True
-                        for dim1, dim2 in zip(v, existing):
-                            if dim1 != dim2 and dim1 * dim2 != 0:
-                                matched = False
-                                break
-                            elif dim1 == dim2:
-                                inferred_shape.append(dim1)
-                            elif dim1 == 0:
-                                inferred_shape.append(dim2)
-                            else:
-                                inferred_shape.append(dim1)
-                        if matched:
-                            param.shape = tuple(inferred_shape)
-                            continue
-                    assert v is None or v == existing, \
-                        "Cannot retrieve Parameter %s because desired " \
-                        "attribute does not match with stored for attribute " \
-                        "%s: desired %s vs stored %s." % (
-                            name, k, str(v), str(getattr(param, k)))
-                else:
-                    setattr(param, k, v)
+            return param
+        for attr, wanted in kwargs.items():
+            stored = getattr(param, attr, None)
+            if stored is None:
+                setattr(param, attr, wanted)
+                continue
+            if attr == "shape" and wanted is not None:
+                merged = _merge_shapes(stored, wanted)
+                if merged is not None:
+                    param.shape = merged
+                    continue
+            if wanted is not None and wanted != stored:
+                raise AssertionError(
+                    "Cannot retrieve Parameter %s because desired attribute "
+                    "does not match with stored for attribute %s: desired "
+                    "%s vs stored %s." % (name, attr, str(wanted),
+                                          str(stored)))
         return param
 
     def update(self, other):
-        """(reference: parameter.py:ParameterDict.update)"""
-        for k, v in other.items():
-            if k in self._params:
-                assert self._params[k] is v, \
-                    "Cannot update self with other because they have different " \
-                    "Parameters with the same name %s" % k
-            else:
-                self._params[k] = v
+        """Merge another dict; same-name entries must be the same object."""
+        for name, param in other.items():
+            mine = self._params.get(name)
+            if mine is None:
+                self._params[name] = param
+            elif mine is not param:
+                raise AssertionError(
+                    "Cannot update self with other because they have "
+                    "different Parameters with the same name %s" % name)
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
-        """(reference: parameter.py:ParameterDict.initialize)"""
+        """Initialize every parameter (optionally with a global override)."""
         from ..initializer import Uniform
 
-        default = Uniform()
-        if init is not None and not isinstance(init, str) and \
-                not callable(init):
+        if init is not None and not (isinstance(init, str) or callable(init)):
             raise TypeError("init must be an Initializer, callable or None")
         if isinstance(init, str):
             from .. import initializer as init_mod
             init = init_mod.create(init)
         if verbose and init is not None:
             init.set_verbosity(verbose=verbose)
-        for v in self.values():
-            v.initialize(None, ctx, init if init is not None else default,
-                         force_reinit=force_reinit)
+        fallback = init if init is not None else Uniform()
+        for p in self.values():
+            p.initialize(None, ctx, fallback, force_reinit=force_reinit)
 
     def zero_grad(self):
-        for v in self.values():
-            v.zero_grad()
+        for p in self.values():
+            p.zero_grad()
 
     def reset_ctx(self, ctx):
-        for v in self.values():
-            v.reset_ctx(ctx)
+        for p in self.values():
+            p.reset_ctx(ctx)
 
     def setattr(self, name, value):
-        for v in self.values():
-            setattr(v, name, value)
+        for p in self.values():
+            setattr(p, name, value)
 
     def save(self, filename, strip_prefix=""):
-        """(reference: parameter.py:ParameterDict.save)"""
-        arg_dict = {}
-        for param in self.values():
-            weight = param._reduce()
-            if not param.name.startswith(strip_prefix):
+        """Write averaged replicas; names get ``strip_prefix`` removed."""
+        blobs = {}
+        for p in self.values():
+            if not p.name.startswith(strip_prefix):
                 raise ValueError(
-                    "Prefix %s is to be striped before saving, but Parameter "
-                    "%s does not start with %s." % (
-                        strip_prefix, param.name, strip_prefix))
-            arg_dict[param.name[len(strip_prefix):]] = weight
-        nd.save(filename, arg_dict)
+                    "Prefix %s is to be striped before saving, but "
+                    "Parameter %s does not start with %s."
+                    % (strip_prefix, p.name, strip_prefix))
+            blobs[p.name[len(strip_prefix):]] = p._reduce()
+        nd.save(filename, blobs)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
-        """(reference: parameter.py:ParameterDict.load)"""
+        """Inverse of save; accepts arg:/aux:-prefixed Module files too."""
         if restore_prefix:
             for name in self.keys():
-                assert name.startswith(restore_prefix), \
-                    "restore_prefix is %s but Parameters name %s does not " \
-                    "start with %s" % (restore_prefix, name, restore_prefix)
-        lprefix = len(restore_prefix)
-        loaded = nd.load(filename)
-        arg_dict = {restore_prefix + k.split(":", 1)[-1]
-                    if k.startswith(("arg:", "aux:")) else restore_prefix + k: v
-                    for k, v in loaded.items()}
+                if not name.startswith(restore_prefix):
+                    raise AssertionError(
+                        "restore_prefix is %s but Parameters name %s does "
+                        "not start with %s" % (restore_prefix, name,
+                                               restore_prefix))
+        cut = len(restore_prefix)
+
+        def renamed(key):
+            stripped = (key.split(":", 1)[-1]
+                        if key.startswith(("arg:", "aux:")) else key)
+            return restore_prefix + stripped
+
+        table = {renamed(k): v for k, v in nd.load(filename).items()}
         if not allow_missing:
             for name in self.keys():
-                assert name in arg_dict, \
-                    "Parameter %s is missing in file %s" % (
-                        name[lprefix:], filename)
-        for name in arg_dict:
+                if name not in table:
+                    raise AssertionError(
+                        "Parameter %s is missing in file %s"
+                        % (name[cut:], filename))
+        for name, value in table.items():
             if name not in self._params:
-                assert ignore_extra, \
-                    "Parameter %s loaded from file %s is not present in " \
-                    "ParameterDict" % (name[lprefix:], filename)
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter %s loaded from file %s is not present in "
+                        "ParameterDict" % (name[cut:], filename))
                 continue
-            self[name]._load_init(arg_dict[name], ctx)
+            self[name]._load_init(value, ctx)

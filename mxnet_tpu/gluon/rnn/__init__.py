@@ -1,3 +1,10 @@
-"""Gluon RNN (reference: python/mxnet/gluon/rnn/)."""
-from .rnn_cell import *
-from .rnn_layer import *
+"""Gluon recurrent API: cells (step-wise) and fused layers.
+
+Import-location parity with the reference gluon/rnn package.
+"""
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn_layer import *  # noqa: F401,F403
+
+from . import rnn_cell as _cells, rnn_layer as _layers
+
+__all__ = list(_cells.__all__) + list(_layers.__all__)

@@ -1,5 +1,6 @@
-"""Pre-Gluon symbolic RNN toolkit (reference: python/mxnet/rnn/, 1.76k LoC)
-— the surface BASELINE config #4 (lstm_bucketing) uses with BucketingModule."""
-from .rnn_cell import *
-from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
-from .io import BucketSentenceIter, encode_sentences
+"""Symbolic (pre-Gluon) RNN toolkit — BucketingModule's companion
+(BASELINE config #4 surface: lstm_bucketing)."""
+from .io import BucketSentenceIter, encode_sentences  # noqa: F401
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,  # noqa: F401
+                  save_rnn_checkpoint)
+from .rnn_cell import *  # noqa: F401,F403
