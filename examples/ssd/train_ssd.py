@@ -1,0 +1,171 @@
+"""Train SSD end-to-end from a VOC-style .rec with real det augmentation.
+
+Reference workflow: example/ssd/train.py + tools/prepare_dataset.py —
+images packed as RecordIO with header-prefixed detection labels, loaded by
+ImageDetIter (python/mxnet/image/detection.py), augmented with
+IoU-constrained random crops / flips / padding, trained with the
+MultiBoxPrior→MultiBoxTarget pipeline, evaluated with MultiBoxDetection.
+
+Offline stand-in for VOC: a generated dataset of colored rectangles on
+noise (class = color). The pipeline — .rec packing, ImageDetIter with
+augmentation, Module-style training with checkpoints — is the real one.
+
+Usage:
+    python examples/ssd/train_ssd.py --steps 400
+    python examples/ssd/train_ssd.py --smoke
+"""
+import argparse
+import os as _os
+import sys as _sys
+import tempfile
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import ImageDetIter
+from mxnet_tpu.models.ssd import get_ssd
+
+CLASS_COLORS = [(220, 40, 40), (40, 220, 40), (40, 40, 220)]  # r, g, b
+
+
+def make_voc_rec(path, n_images=128, size=64, seed=0):
+    """Pack a synthetic detection dataset as .rec/.idx (im2rec layout)."""
+    rng = np.random.RandomState(seed)
+    rec, idx = path + ".rec", path + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n_images):
+        img = rng.randint(0, 60, (size, size, 3)).astype(np.uint8)
+        objs = []
+        for _ in range(rng.randint(1, 3)):
+            cls = rng.randint(0, len(CLASS_COLORS))
+            w, h = rng.uniform(0.25, 0.5, 2)
+            x1 = rng.uniform(0, 1 - w)
+            y1 = rng.uniform(0, 1 - h)
+            x2, y2 = x1 + w, y1 + h
+            ix1, iy1 = int(x1 * size), int(y1 * size)
+            ix2, iy2 = int(x2 * size), int(y2 * size)
+            img[iy1:iy2, ix1:ix2] = CLASS_COLORS[cls]
+            objs.append([cls, x1, y1, x2, y2])
+        # header: (header_width=2, obj_width=5, objects...)
+        label = np.array([2.0, 5.0] + [v for o in objs for v in o],
+                         np.float32)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+    writer.close()
+    return rec, idx
+
+
+def tiny_features(data):
+    """Three strided conv stages -> two detection scales."""
+    x = data
+    for i, nf in enumerate((16, 32, 32)):
+        x = mx.sym.Convolution(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                               num_filter=nf, name="c%d" % i)
+        x = mx.sym.Activation(x, act_type="relu")
+        if i == 1:
+            scale_a = x
+    return [scale_a, x]
+
+
+def build(num_classes, bs, size, mode):
+    net = get_ssd(num_classes=num_classes, mode=mode, features=tiny_features,
+                  sizes=[[0.35, 0.45], [0.6, 0.8]], ratios=[[1, 1.5], [1, 1.5]])
+    shapes = {"data": (bs, 3, size, size)}
+    if mode == "train":
+        shapes["label"] = (bs, 2, 5)
+    return net.simple_bind(mx.cpu(), grad_req="write" if mode == "train"
+                           else "null", **shapes)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.steps = 60
+
+    workdir = args.data_dir or tempfile.mkdtemp(prefix="ssd_voc_")
+    rec, idx = make_voc_rec(_os.path.join(workdir, "train"),
+                            n_images=24 if args.smoke else 128,
+                            size=args.size)
+    print("packed dataset:", rec)
+
+    train_iter = ImageDetIter(
+        batch_size=args.batch_size, data_shape=(3, args.size, args.size),
+        path_imgrec=rec, path_imgidx=idx, shuffle=True,
+        rand_crop=0.5, rand_mirror=True, rand_pad=0.3,
+        min_object_covered=0.5, area_range=(0.3, 2.0),
+        mean=True, std=True)
+    print("label shape:", train_iter.label_shape)
+
+    rng = np.random.RandomState(0)
+    ex = build(len(CLASS_COLORS), args.batch_size, args.size, "train")
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            arr[:] = (rng.randn(*arr.shape) * 0.05).astype(np.float32)
+
+    first = last = None
+    step = 0
+    max_objs = train_iter.label_shape[0]
+    while step < args.steps:
+        for batch in train_iter:
+            if step >= args.steps:
+                break
+            labels = batch.label[0].asnumpy()[:, :2, :5]
+            if max_objs < 2:  # pad to the bound executor's label shape
+                labels = np.concatenate(
+                    [labels, -np.ones((labels.shape[0], 2 - max_objs, 5),
+                                      np.float32)], axis=1)
+            ex.arg_dict["data"][:] = batch.data[0]
+            ex.arg_dict["label"][:] = labels
+            ex.forward(is_train=True)
+            ex.backward()
+
+            cls_prob = ex.outputs[0].asnumpy()
+            cls_target = ex.outputs[2].asnumpy()
+            valid = cls_target >= 0
+            nll = -np.log(np.maximum(np.take_along_axis(
+                cls_prob, cls_target.clip(0)[:, None].astype(int),
+                axis=1)[:, 0][valid], 1e-9)).mean()
+            if first is None:
+                first = nll
+            last = nll
+            for name, grad in ex.grad_dict.items():
+                if name in ("data", "label") or grad is None:
+                    continue
+                ex.arg_dict[name][:] = (
+                    ex.arg_dict[name].asnumpy()
+                    - args.lr * np.clip(grad.asnumpy(), -1, 1))
+            if step % 50 == 0:
+                print("step %4d cls-loss %.4f" % (step, nll))
+            step += 1
+        train_iter.reset()
+
+    print("cls loss: %.4f -> %.4f" % (first, last))
+    assert last < first * (0.98 if args.smoke else 0.9), (first, last)
+
+    # detection pass with NMS over one augmented batch
+    det_ex = build(len(CLASS_COLORS), args.batch_size, args.size, "inference")
+    for name, arr in ex.arg_dict.items():
+        if name in det_ex.arg_dict and name not in ("data", "label"):
+            det_ex.arg_dict[name][:] = arr
+    train_iter.reset()
+    probe = next(iter(train_iter))
+    det_ex.arg_dict["data"][:] = probe.data[0]
+    dets = det_ex.forward()[0].asnumpy()
+    kept = dets[0][dets[0][:, 0] >= 0]
+    print("top detections (cls, score, x1, y1, x2, y2):")
+    print(kept[:3])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,8 +1,15 @@
-"""Gluon utilities (reference: python/mxnet/gluon/utils.py)."""
+"""Gluon helper utilities.
+
+Parity surface: reference gluon/utils.py (split_data / split_and_load /
+clip_global_norm / check_sha1 / download). ``download`` is an offline stub
+— this environment has no egress, so it only resolves already-present
+files.
+"""
 from __future__ import annotations
 
 import hashlib
 import os
+import warnings
 
 import numpy as np
 
@@ -17,87 +24,85 @@ def _to_initializer(initializer):
     (single home for the coercion used by nn/rnn layer constructors)."""
     from .. import initializer as init_mod
 
-    if initializer is None or not isinstance(initializer, str):
-        return initializer
-    return init_mod.create(initializer)
+    if isinstance(initializer, str):
+        return init_mod.create(initializer)
+    return initializer
+
+
+def _axis_slice(data, axis, start, stop):
+    if axis == 0:
+        return data[start:stop]
+    return nd.slice_axis(data, axis, start, stop)
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
-    """Split along batch axis into num_slice (reference: utils.py:split_data)."""
+    """Cut ``data`` into ``num_slice`` chunks along the batch axis; the
+    final chunk absorbs the remainder when even_split is off."""
     size = data.shape[batch_axis]
     if size < num_slice:
         raise ValueError(
             "Too many slices for data with shape %s. Arguments are "
             "num_slice=%d and batch_axis=%d." % (str(data.shape), num_slice,
                                                  batch_axis))
-    if even_split and size % num_slice != 0:
+    if even_split and size % num_slice:
         raise ValueError(
             "data with shape %s cannot be evenly split into %d slices along "
             "axis %d. Use a batch size that's multiple of %d or set "
-            "even_split=False to allow uneven partitioning of data." % (
-                str(data.shape), num_slice, batch_axis, num_slice))
+            "even_split=False to allow uneven partitioning of data."
+            % (str(data.shape), num_slice, batch_axis, num_slice))
 
     step = size // num_slice
-    if batch_axis == 0:
-        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1
-                  else data[i * step:size]
-                  for i in range(num_slice)]
-    else:
-        slices = [nd.slice_axis(data, batch_axis, i * step, (i + 1) * step)
-                  if i < num_slice - 1
-                  else nd.slice_axis(data, batch_axis, i * step, size)
-                  for i in range(num_slice)]
-    return slices
+    bounds = [i * step for i in range(num_slice)] + [size]
+    return [_axis_slice(data, batch_axis, lo, hi)
+            for lo, hi in zip(bounds, bounds[1:])]
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Split + move to contexts (reference: utils.py:split_and_load)."""
+    """split_data + one as_in_context per chunk."""
     if not isinstance(data, nd.NDArray):
         data = nd.array(np.asarray(data), ctx=ctx_list[0])
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+    chunks = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [chunk.as_in_context(ctx)
+            for chunk, ctx in zip(chunks, ctx_list)]
 
 
 def clip_global_norm(arrays, max_norm):
-    """Rescale so that the joint 2-norm ≤ max_norm
-    (reference: utils.py:clip_global_norm)."""
-    assert len(arrays) > 0
-    total_norm = 0
-    for arr in arrays:
-        total_norm += float((arr.reshape((-1,)) ** 2).sum().asscalar())
-    total_norm = np.sqrt(total_norm)
-    if np.isnan(total_norm) or np.isinf(total_norm):
-        import warnings
+    """Jointly rescale ``arrays`` so their global 2-norm is <= max_norm;
+    returns the pre-clip norm."""
+    if not arrays:
+        raise AssertionError("need at least one array")
+    sq_sum = sum(float((a.reshape((-1,)) ** 2).sum().asscalar())
+                 for a in arrays)
+    norm = np.sqrt(sq_sum)
+    if not np.isfinite(norm):
         warnings.warn("nan or inf is detected. Clipping results will be "
                       "undefined.", stacklevel=2)
-    scale = max_norm / (total_norm + 1e-8)
-    if scale < 1.0:
-        for arr in arrays:
-            arr *= scale
-    return total_norm
+    ratio = max_norm / (norm + 1e-8)
+    if ratio < 1.0:
+        for a in arrays:
+            a *= ratio
+    return norm
 
 
 def check_sha1(filename, sha1_hash):
-    """(reference: utils.py:check_sha1)"""
-    sha1 = hashlib.sha1()
-    with open(filename, "rb") as f:
-        while True:
-            data = f.read(1048576)
-            if not data:
-                break
-            sha1.update(data)
-    return sha1.hexdigest() == sha1_hash
+    """True when the file's SHA1 digest equals ``sha1_hash``."""
+    digest = hashlib.sha1()
+    with open(filename, "rb") as stream:
+        for block in iter(lambda: stream.read(1 << 20), b""):
+            digest.update(block)
+    return digest.hexdigest() == sha1_hash
 
 
 def download(url, path=None, overwrite=False, sha1_hash=None):
-    """Offline stub (reference: utils.py:download): returns an existing local
-    file, raises otherwise — this environment has no egress."""
-    fname = url.split("/")[-1] if path is None or os.path.isdir(path or "") \
-        else path
-    if path is not None and os.path.isdir(path):
-        fname = os.path.join(path, fname)
+    """Offline stub: return an existing local file, raise otherwise."""
+    if path is None or os.path.isdir(path or ""):
+        fname = url.split("/")[-1]
+        if path is not None:
+            fname = os.path.join(path, fname)
+    else:
+        fname = path
     if os.path.exists(fname) and not overwrite:
         return fname
     raise IOError("download is unavailable in this offline environment: %s"

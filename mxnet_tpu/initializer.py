@@ -82,39 +82,34 @@ class Initializer:
             logging.info("Initialized %s as %s: %s", desc, init,
                          self._print_func(arr))
 
+    # suffix -> (handler method name, verbose tag or None); checked in order
+    _SUFFIX_DISPATCH = (
+        (("weight",), "_init_weight", "weight"),
+        (("bias",), "_init_bias", "bias"),
+        (("gamma",), "_init_gamma", "gamma"),
+        (("beta",), "_init_beta", "beta"),
+        (("moving_mean", "running_mean"), "_init_zero", None),
+        (("moving_var", "running_var", "moving_inv_var"), "_init_one", None),
+        (("moving_avg", "min", "max"), "_init_zero", None),
+    )
+
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
             desc = InitDesc(str(desc))
-        init = desc.attrs.get("__init__", "")
-        if init:
-            klass, kwargs = json.loads(init)
+        override = desc.attrs.get("__init__", "")
+        if override:
+            klass, kwargs = json.loads(override)
             create(klass, **kwargs)._init_weight(desc, arr)
-            self._verbose_print(desc, init, arr)
+            self._verbose_print(desc, override, arr)
             return
         name = desc.lower()
-        if name.endswith("weight"):
-            self._init_weight(desc, arr)
-            self._verbose_print(desc, "weight", arr)
-        elif name.endswith("bias"):
-            self._init_bias(desc, arr)
-            self._verbose_print(desc, "bias", arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(desc, arr)
-            self._verbose_print(desc, "gamma", arr)
-        elif name.endswith("beta"):
-            self._init_beta(desc, arr)
-            self._verbose_print(desc, "beta", arr)
-        elif name.endswith("moving_mean") or name.endswith("running_mean"):
-            self._init_zero(desc, arr)
-        elif (name.endswith("moving_var") or name.endswith("running_var")
-              or name.endswith("moving_inv_var")):
-            self._init_one(desc, arr)
-        elif name.endswith("moving_avg"):
-            self._init_zero(desc, arr)
-        elif name.endswith("min") or name.endswith("max"):
-            self._init_zero(desc, arr)
-        else:
-            self._init_default(desc, arr)
+        for suffixes, handler, tag in self._SUFFIX_DISPATCH:
+            if name.endswith(suffixes):
+                getattr(self, handler)(desc, arr)
+                if tag:
+                    self._verbose_print(desc, tag, arr)
+                return
+        self._init_default(desc, arr)
 
     # numpy-buffer fillers; subclasses override _init_weight ---------------
     def _fill(self, arr, value):
@@ -235,28 +230,22 @@ class Xavier(Initializer):
 
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.0
         if len(shape) < 2:
             raise ValueError(
                 "Xavier initializer cannot be applied to vector %s. It "
                 "requires at least 2D." % name)
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.0
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
+        spatial = np.prod(shape[2:]) if len(shape) > 2 else 1.0
+        fan_in, fan_out = shape[1] * spatial, shape[0] * spatial
+        try:
+            factor = {"avg": (fan_in + fan_out) / 2.0,
+                      "in": fan_in, "out": fan_out}[self.factor_type]
+        except KeyError:
             raise ValueError("Incorrect factor type")
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, arr.shape)
+            arr[:] = np.random.uniform(-scale, scale, shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = np.random.normal(0.0, scale, arr.shape)
+            arr[:] = np.random.normal(0.0, scale, shape)
         else:
             raise ValueError("Unknown random type")
 
@@ -276,15 +265,13 @@ class Bilinear(Initializer):
     """Bilinear upsampling kernel (reference: initializer.py:Bilinear)."""
 
     def _init_weight(self, _, arr):
-        weight = np.zeros(int(np.prod(arr.shape)), dtype="float32")
-        shape = arr.shape
-        f = np.ceil(shape[3] / 2.0)
-        c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(int(np.prod(shape))):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        arr[:] = weight.reshape(shape)
+        # separable triangular kernel, computed vectorized per axis
+        h, w = arr.shape[2], arr.shape[3]
+        f = np.ceil(w / 2.0)
+        center = (2 * f - 1 - f % 2) / (2.0 * f)
+        wx = 1 - np.abs(np.arange(w) / f - center)
+        wy = 1 - np.abs(np.arange(h) / f - center)
+        arr[:] = np.broadcast_to(np.outer(wy, wx), arr.shape)
 
 
 @register
@@ -297,8 +284,8 @@ class LSTMBias(Initializer):
 
     def _init_weight(self, _, arr):
         arr[:] = 0.0
-        num_hidden = int(arr.shape[0] / 4)
-        arr[num_hidden:2 * num_hidden] = self.forget_bias
+        h = arr.shape[0] // 4
+        arr[h:2 * h] = self.forget_bias  # gates are stacked i, f, c, o
 
 
 @register

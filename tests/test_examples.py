@@ -13,6 +13,7 @@ _EXAMPLES = [
     "examples/image_classification/benchmark_score.py",
     "examples/rnn/lstm_bucketing.py",
     "examples/ssd/train_ssd_toy.py",
+    "examples/ssd/train_ssd.py",
     "examples/model_parallel_lstm/model_parallel_lstm.py",
     "examples/sparse/linear_classification.py",
     "examples/gluon/mnist_gluon.py",
