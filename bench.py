@@ -112,6 +112,33 @@ def main():
             max(img_s_32, img_s_big) * FLOPS_PER_IMG / 128.6e12, 4)
         result["roofline_img_per_sec"] = 2950
         result["vs_roofline"] = round(max(img_s_32, img_s_big) / 2950.0, 3)
+
+    # sidecar: all-config artifact (BENCH_ALL.json) covering every
+    # BASELINE.json config — best-effort, never blocks the headline line
+    if os.environ.get("BENCH_HEADLINE_ONLY", "") != "1":
+        try:
+            import bench_all
+
+            extra = bench_all.main(skip=("resnet50_train_bs32",),
+                                   quiet=True)
+            extra["configs"]["resnet50_train_bs32"] = {
+                "value": result["value"], "unit": "images/sec",
+                "protocol": result["protocol"],
+                "vs_baseline_p100": result["vs_baseline"]}
+            import json as _json
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "BENCH_ALL.json"),
+                    "w") as sink:
+                _json.dump(extra, sink, indent=1)
+            ssd = extra["configs"].get("ssd300_train", {})
+            lstm = extra["configs"].get("lstm_ptb_train", {})
+            infer = extra["configs"].get("resnet50_infer_bs32", {})
+            result["resnet50_infer_img_per_sec"] = infer.get("value")
+            result["lstm_ptb_samples_per_sec"] = lstm.get("value")
+            result["ssd300_train_img_per_sec"] = ssd.get("value")
+        except Exception as err:  # noqa: BLE001
+            print("bench_all sidecar failed: %r" % err, file=sys.stderr)
+
     print(json.dumps(result))
 
 

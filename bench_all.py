@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""All-config benchmark sidecar: one JSON artifact covering every
+BASELINE.json config plus the flash-attention claim.
+
+Configs (BASELINE.json "configs" + VERDICT r3 item 3):
+  1. MNIST LeNet training (Module API)          — samples/sec
+  2. ResNet-50 train bs32 (headline, bench.py protocol) — img/sec
+  3. Gluon HybridBlock ResNet-18 train step     — img/sec
+  4. LSTM PTB training step (2x200, bs32, T=35) — samples/sec
+  5. SSD-300 training step (VGG-reduced)        — img/sec
+  +  ResNet-50 inference bs32 (benchmark_score protocol, P100 713.17)
+  +  flash vs dense attention at T=4096         — speedup ratio
+
+Writes BENCH_ALL.json (repo root by default) and prints it. Each entry is
+measured independently and failures are recorded, not fatal, so one slow
+compile cannot sink the artifact. Set BENCH_QUICK=1 for a fast smoke pass.
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+QUICK = os.environ.get("BENCH_QUICK", "") == "1"
+
+# published reference numbers (BASELINE.md)
+P100_RESNET50_TRAIN = 181.53   # docs/faq/perf.md:180-187
+P100_RESNET50_INFER = 713.17   # docs/faq/perf.md:138
+
+
+def _timed(fn, *args, **kwargs):
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    return out, time.perf_counter() - t0
+
+
+def bench_resnet50_train():
+    import bench
+
+    iters = 20 if QUICK else 200
+    return {"value": round(bench._bench_one(
+        32, "NHWC", np.dtype("bfloat16"), iters), 2),
+        "unit": "images/sec", "protocol": "bs32 bf16 NHWC fused train step",
+        "vs_baseline_p100": None}
+
+
+def bench_resnet50_infer():
+    """benchmark_score protocol: repeated executor forward, async queue
+    drained once at the end (reference: benchmark_score.py)."""
+    import mxnet_tpu as mx
+
+    size = 64 if QUICK else 224
+    batches = 5 if QUICK else 50
+    sym = mx.models.get_resnet(num_classes=1000, num_layers=50,
+                               image_shape=(3, size, size), layout="NHWC")
+    shape = (32, size, size, 3) if size != 64 else (32, size, size, 3)
+    ctx = mx.gpu() if mx.context.num_gpus() else mx.cpu()
+    ex = sym.simple_bind(ctx, data=shape, grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = (rng.randn(*v.shape) * 0.01).astype(np.float32)
+    ex.arg_dict["data"][:] = rng.rand(*shape).astype(np.float32)
+    ex.forward()
+    ex.outputs[0].asnumpy()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        ex.forward()
+    ex.outputs[0].asnumpy()
+    dt = time.perf_counter() - t0
+    ips = 32 * batches / dt
+    return {"value": round(ips, 2), "unit": "images/sec",
+            "protocol": "bs32 fp32 executor forward x%d" % batches,
+            "vs_baseline_p100": round(ips / P100_RESNET50_INFER, 3)}
+
+
+def bench_lenet_mnist():
+    """Module.fit protocol on synthetic MNIST-shaped data."""
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(5, 5), num_filter=20), act_type="tanh")
+    p1 = mx.sym.Pooling(c1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Activation(mx.sym.Convolution(
+        p1, kernel=(5, 5), num_filter=50), act_type="tanh")
+    p2 = mx.sym.Pooling(c2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f1 = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.Flatten(p2), num_hidden=500), act_type="tanh")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(f1, num_hidden=10),
+                               name="softmax")
+
+    bs = 128
+    steps = 10 if QUICK else 100
+    mod = mx.mod.Module(net, context=mx.gpu() if mx.context.num_gpus()
+                        else mx.cpu())
+    mod.bind(data_shapes=[("data", (bs, 1, 28, 28))],
+             label_shapes=[("softmax_label", (bs,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.rand(bs, 1, 28, 28).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 10, bs).astype(np.float32))])
+    for _ in range(3):  # compile + warm
+        mod.forward_backward(batch)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()
+    dt = time.perf_counter() - t0
+    return {"value": round(bs * steps / dt, 1), "unit": "samples/sec",
+            "protocol": "Module fwd+bwd+update, bs128"}
+
+
+def bench_gluon_resnet():
+    """Gluon HybridBlock path: hybridized resnet18 forward+backward."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    size = 32 if QUICK else 224
+    bs = 4 if QUICK else 32
+    steps = 3 if QUICK else 30
+    net = resnet18_v1()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.rand(bs, 3, size, size).astype(np.float32))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    y = mx.nd.array(np.random.randint(0, 1000, bs).astype(np.float32))
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05}, kvstore="local")
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(bs)
+        return loss
+
+    loss = step()
+    loss.asnumpy()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+    return {"value": round(bs * steps / dt, 1), "unit": "images/sec",
+            "protocol": "hybridized resnet18_v1 bs%d %dx%d autograd step"
+                        % (bs, size, size)}
+
+
+def bench_lstm_ptb():
+    """PTB-style LSTM LM step: 2 layers x 200 hidden, bs32, T=35
+    (example/rnn/lstm_bucketing.py protocol, BASELINE config #4)."""
+    import mxnet_tpu as mx
+
+    bs, seq_len, hidden, layers, vocab = 32, 35, 200, 2, 10000
+    if QUICK:
+        bs, seq_len, vocab = 8, 10, 500
+    steps = 5 if QUICK else 60
+
+    stack = mx.rnn.FusedRNNCell(hidden, num_layers=layers, mode="lstm")
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=hidden,
+                             name="embed")
+    outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.gpu() if mx.context.num_gpus()
+                        else mx.cpu())
+    mod.bind(data_shapes=[("data", (bs, seq_len))],
+             label_shapes=[("softmax_label", (bs, seq_len))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randint(0, vocab, (bs, seq_len))
+                          .astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, vocab, (bs, seq_len))
+                           .astype(np.float32))])
+    for _ in range(2):
+        mod.forward_backward(batch)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()
+    dt = time.perf_counter() - t0
+    return {"value": round(bs * steps / dt, 1), "unit": "samples/sec",
+            "protocol": "LSTM 2x200 T=%d bs%d fused-RNN train step"
+                        % (seq_len, bs)}
+
+
+def bench_ssd300():
+    """SSD-300 training step over the MultiBox pipeline (config #5)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.ssd import get_ssd
+
+    size, bs = (64, 4) if QUICK else (300, 8)
+    steps = 3 if QUICK else 20
+
+    if QUICK:
+        def features(data):
+            x = data
+            outs = []
+            for i, nf in enumerate((16, 32)):
+                x = mx.sym.Convolution(x, kernel=(3, 3), stride=(2, 2),
+                                       pad=(1, 1), num_filter=nf,
+                                       name="f%d" % i)
+                x = mx.sym.Activation(x, act_type="relu")
+                outs.append(x)
+            return outs
+        net = get_ssd(num_classes=20, mode="train", features=features,
+                      sizes=[[0.3], [0.6]], ratios=[[1], [1]])
+    else:
+        net = get_ssd(num_classes=20, mode="train")
+
+    ex = net.simple_bind(mx.gpu() if mx.context.num_gpus() else mx.cpu(),
+                         data=(bs, 3, size, size), label=(bs, 3, 5),
+                         grad_req="write")
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "label"):
+            v[:] = (rng.randn(*v.shape) * 0.01).astype(np.float32)
+    ex.arg_dict["data"][:] = rng.rand(bs, 3, size, size).astype(np.float32)
+    lab = -np.ones((bs, 3, 5), np.float32)
+    lab[:, 0] = [0, 0.3, 0.3, 0.7, 0.7]
+    ex.arg_dict["label"][:] = lab
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.outputs[0].asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ex.forward(is_train=True)
+        ex.backward()
+    ex.outputs[0].asnumpy()
+    dt = time.perf_counter() - t0
+    return {"value": round(bs * steps / dt, 2), "unit": "images/sec",
+            "protocol": "SSD-%d VGG-reduced fwd+bwd bs%d" % (size, bs)}
+
+
+def bench_flash_attention():
+    """Flash (Pallas) vs dense XLA attention at T=4096 — the README claim."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.flash_attention import flash_attention
+
+    b, h, t, d = 1, 8, (512 if QUICK else 4096), 64
+    q = jnp.asarray(np.random.randn(b, h, t, d), jnp.bfloat16)
+    k = jnp.asarray(np.random.randn(b, h, t, d), jnp.bfloat16)
+    v = jnp.asarray(np.random.randn(b, h, t, d), jnp.bfloat16)
+
+    def dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(jnp.bfloat16), v)
+
+    jd = jax.jit(dense)
+    jf = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+
+    def timeit(fn, n=20):
+        fn(q, k, v).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(q, k, v)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / n
+
+    td = timeit(jd)
+    tf = timeit(jf)
+    return {"value": round(td / tf, 2), "unit": "x speedup vs dense XLA",
+            "protocol": "causal attention b1 h8 T=%d d64 bf16" % t,
+            "dense_ms": round(td * 1e3, 2), "flash_ms": round(tf * 1e3, 2)}
+
+
+BENCHES = [
+    ("resnet50_train_bs32", bench_resnet50_train),
+    ("resnet50_infer_bs32", bench_resnet50_infer),
+    ("lenet_mnist_train", bench_lenet_mnist),
+    ("gluon_resnet18_train", bench_gluon_resnet),
+    ("lstm_ptb_train", bench_lstm_ptb),
+    ("ssd300_train", bench_ssd300),
+    ("flash_attention_T4096", bench_flash_attention),
+]
+
+
+def main(out_path=None, skip=(), quiet=False):
+    import jax
+
+    results = {"device": jax.devices()[0].device_kind,
+               "quick": QUICK, "configs": {}}
+    for name, fn in BENCHES:
+        if name in skip:
+            continue
+        try:
+            entry, wall = _timed(fn)
+            entry["bench_wall_s"] = round(wall, 1)
+            results["configs"][name] = entry
+            print("[bench_all] %s: %s %s" % (name, entry["value"],
+                                             entry["unit"]), file=sys.stderr)
+        except Exception as err:  # record, don't abort the artifact
+            traceback.print_exc()
+            results["configs"][name] = {"error": repr(err)}
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json")
+    with open(out_path, "w") as sink:
+        json.dump(results, sink, indent=1)
+    print(json.dumps(results), file=sys.stderr if quiet else sys.stdout)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,8 @@ gate math uses sigmoid/tanh ops directly.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from .. import symbol
 from ..base import MXNetError
 from ..ops.rnn import rnn_param_size
@@ -141,9 +143,13 @@ class BaseRNNCell(object):
         args = args.copy()
         if not self._gate_names:
             return args
-        from .. import ndarray as nd
         for fused, split, _h in self._fused_entries():
-            args[fused] = nd.concatenate([args.pop(name) for name in split])
+            parts = [args.pop(name) for name in split]
+            if isinstance(parts[0], np.ndarray):
+                args[fused] = np.concatenate(parts)
+            else:
+                from .. import ndarray as nd
+                args[fused] = nd.concatenate(parts)
         return args
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
@@ -356,12 +362,16 @@ class FusedRNNCell(BaseRNNCell):
 
     def pack_weights(self, args):
         args = args.copy()
-        from .. import ndarray as nd
         probe = f"{self._prefix}l0_i2h{self._gate_names[0]}_weight"
         num_input = args[probe].shape[1]
         flat = [args.pop(name).reshape((-1,))
                 for name, _size, _shape in self._blob_spec(num_input)]
-        packed = nd.concatenate(flat)
+        if isinstance(flat[0], np.ndarray):
+            # initializer path works on host numpy buffers
+            packed = np.concatenate(flat)
+        else:
+            from .. import ndarray as nd
+            packed = nd.concatenate(flat)
         want = rnn_param_size(self._num_layers, self._num_hidden, num_input,
                               self._mode, self._bidirectional)
         if packed.size != want:
