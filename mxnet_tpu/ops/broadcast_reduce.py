@@ -190,6 +190,16 @@ def _register_broadcast_shape_ops():
                     ([i[0]], [tuple(d if t == 0 else t
                                     for d, t in zip(i[0], attrs.shape))], a)))
 
+    def broadcast_like(attrs, lhs, rhs):
+        # rhs contributes only its shape (its gradient is zero), matching
+        # the reference broadcast_like (broadcast_reduce_op_value.cc)
+        return jnp.broadcast_to(lhs, rhs.shape)
+
+    register_op("broadcast_like", broadcast_like, num_inputs=2,
+                input_names=["lhs", "rhs"],
+                infer_shape=lambda attrs, i, a: (
+                    None if i[1] is None else (i, [i[1]], a)))
+
     def broadcast_axis(attrs, x):
         tgt = list(x.shape)
         axes = attrs.axis if isinstance(attrs.axis, tuple) else (attrs.axis,)

@@ -1,0 +1,179 @@
+"""Per-op dtype matrix + multi-shape/broadcast edge coverage.
+
+Reference pattern: python/mxnet/test_utils.py:467 per-dtype tolerance
+tiers + tests/python/gpu/test_operator_gpu.py check_consistency runs each
+op across dtypes. Here each representative op family runs under
+fp64/fp32/bf16 against an fp64 numpy reference with dtype-tiered
+tolerances, and the broadcast/reduce families are exercised over edge
+shapes (degenerate 1-dims, scalars, high rank, asymmetric broadcast).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+# dtype -> (rtol, atol): bf16 has ~8 mantissa bits
+TOLS = {
+    np.dtype(np.float64): (1e-9, 1e-10),
+    np.dtype(np.float32): (1e-5, 1e-6),
+    np.dtype("bfloat16"): (4e-2, 1e-2),
+}
+DTYPES = [np.float64, np.float32, "bfloat16"]
+
+_r = np.random.RandomState(11)
+
+
+def _run(op, np_ref, arrays, dtype, params=None, rtol_scale=1.0):
+    """Run op under dtype; compare against the fp64 numpy reference."""
+    rtol, atol = TOLS[np.dtype(dtype)]
+    ins = [mx.nd.array(a, dtype=dtype) for a in arrays]
+    out = getattr(mx.nd, op)(*ins, **(params or {}))
+    got = out.asnumpy().astype(np.float64)
+    want = np_ref(*arrays)
+    np.testing.assert_allclose(got, want, rtol=rtol * rtol_scale,
+                               atol=atol + rtol * rtol_scale * np.abs(want).max(),
+                               err_msg="%s @ %s" % (op, dtype))
+
+
+# ------------------------------- dtype matrix over representative families
+_UNARY = [
+    ("exp", np.exp, lambda: [_r.uniform(-2, 2, (3, 5))]),
+    ("log", np.log, lambda: [_r.uniform(0.5, 3, (3, 5))]),
+    ("sqrt", np.sqrt, lambda: [_r.uniform(0.1, 4, (7,))]),
+    ("tanh", np.tanh, lambda: [_r.uniform(-2, 2, (2, 3, 4))]),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)),
+     lambda: [_r.uniform(-3, 3, (4, 4))]),
+    ("relu", lambda x: np.maximum(x, 0), lambda: [_r.randn(5, 5)]),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("op,ref,gen", _UNARY,
+                         ids=[u[0] for u in _UNARY])
+def test_unary_dtype_matrix(op, ref, gen, dtype):
+    _run(op, ref, gen(), dtype)
+
+
+_BINARY = [
+    ("broadcast_add", np.add),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_sub", np.subtract),
+    ("broadcast_div", np.divide),
+    ("broadcast_maximum", np.maximum),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("op,ref", _BINARY, ids=[b[0] for b in _BINARY])
+def test_binary_dtype_matrix(op, ref, dtype):
+    a = _r.uniform(0.5, 2, (4, 1, 3))
+    b = _r.uniform(0.5, 2, (1, 5, 3))
+    _run(op, ref, [a, b], dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_dot_dtype_matrix(dtype):
+    a = _r.randn(8, 16)
+    b = _r.randn(16, 4)
+    # matmul accumulates 16 terms; scale tolerance accordingly
+    _run("dot", np.dot, [a, b], dtype, rtol_scale=4.0)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_fullyconnected_dtype_matrix(dtype):
+    x = _r.randn(4, 12)
+    w = _r.randn(6, 12)
+    bias = _r.randn(6)
+    _run("FullyConnected", lambda x, w, b: x @ w.T + b, [x, w, bias],
+         dtype, params={"num_hidden": 6}, rtol_scale=4.0)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_softmax_dtype_matrix(dtype):
+    def ref(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+    _run("softmax", ref, [_r.randn(3, 10)], dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_reduce_dtype_matrix(dtype):
+    x = _r.uniform(0.1, 1, (4, 5, 6))
+    _run("sum", lambda x: x.sum(axis=1), [x], dtype,
+         params={"axis": 1}, rtol_scale=4.0)
+    _run("mean", lambda x: x.mean(axis=(0, 2)), [x], dtype,
+         params={"axis": (0, 2)}, rtol_scale=4.0)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+def test_convolution_dtype_matrix(dtype):
+    import torch
+    import torch.nn.functional as F
+
+    x = _r.randn(2, 3, 8, 8).astype(np.float32)
+    w = _r.randn(4, 3, 3, 3).astype(np.float32)
+    want = F.conv2d(torch.tensor(x), torch.tensor(w), padding=1).numpy()
+    out = mx.nd.Convolution(mx.nd.array(x, dtype=dtype),
+                            mx.nd.array(w, dtype=dtype),
+                            num_filter=4, kernel=(3, 3), pad=(1, 1),
+                            no_bias=True).asnumpy().astype(np.float32)
+    rtol = 1e-4 if np.dtype(dtype) == np.float32 else 6e-2
+    np.testing.assert_allclose(out, want, rtol=rtol,
+                               atol=rtol * np.abs(want).max())
+
+
+# ---------------------------------------------------- shape / broadcast edges
+EDGE_SHAPE_PAIRS = [
+    ((1,), (1,)),                       # scalar-ish
+    ((1, 1, 1), (4, 5, 6)),             # full expansion
+    ((4, 1, 6), (1, 5, 1)),             # interleaved broadcast
+    ((2, 3, 4, 5), (1, 3, 1, 5)),       # rank-4
+    ((7, 1), (7, 9)),                   # tail expansion
+]
+
+
+@pytest.mark.parametrize("sa,sb", EDGE_SHAPE_PAIRS,
+                         ids=[str(p) for p in EDGE_SHAPE_PAIRS])
+def test_broadcast_edge_shapes(sa, sb):
+    a = _r.uniform(0.5, 2, sa)
+    b = _r.uniform(0.5, 2, sb)
+    for op, ref in _BINARY:
+        got = getattr(mx.nd, op)(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+        np.testing.assert_allclose(got, ref(a, b), rtol=1e-5,
+                                   err_msg="%s %s %s" % (op, sa, sb))
+
+
+@pytest.mark.parametrize("shape", [(1,), (3,), (2, 1, 1, 1, 5), (6, 1)])
+def test_reduce_edge_shapes(shape):
+    x = _r.uniform(0.1, 1, shape)
+    got = mx.nd.sum(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, x.sum(), rtol=1e-5)
+    got = mx.nd.max(mx.nd.array(x), axis=0).asnumpy()
+    np.testing.assert_allclose(got, x.max(axis=0), rtol=1e-6)
+
+
+def test_broadcast_to_and_like_edges():
+    x = _r.randn(1, 3, 1)
+    got = mx.nd.broadcast_to(mx.nd.array(x), shape=(4, 3, 2)).asnumpy()
+    np.testing.assert_allclose(got, np.broadcast_to(x, (4, 3, 2)))
+
+    tgt = mx.nd.zeros((4, 3, 2))
+    got = mx.nd.broadcast_like(mx.nd.array(x), tgt).asnumpy()
+    np.testing.assert_allclose(got, np.broadcast_to(x, (4, 3, 2)))
+
+
+def test_gradient_dtype_fp32_vs_bf16():
+    """Gradients computed in bf16 stay within bf16 tolerance of fp32."""
+    from mxnet_tpu import autograd
+
+    x32 = mx.nd.array(_r.randn(4, 8).astype(np.float32))
+    x16 = x32.astype("bfloat16")
+    grads = {}
+    for tag, x in (("fp32", x32), ("bf16", x16)):
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.sum(mx.nd.tanh(x) * mx.nd.tanh(x))
+        y.backward()
+        grads[tag] = x.grad.asnumpy().astype(np.float32)
+    np.testing.assert_allclose(grads["bf16"], grads["fp32"],
+                               rtol=6e-2, atol=2e-2)

@@ -123,7 +123,8 @@ _REDUCE_FWD = ["max", "max_axis", "min", "min_axis", "argmax", "argmin",
 _SHAPE_GRAD = ["Reshape", "reshape", "Flatten", "flatten", "transpose",
                "expand_dims", "slice", "slice_axis", "crop", "clip",
                "repeat", "tile", "reverse", "flip", "SwapAxis", "swapaxes",
-               "broadcast_to", "broadcast_axes", "broadcast_axis", "Pad",
+               "broadcast_to", "broadcast_like", "broadcast_axes",
+               "broadcast_axis", "Pad",
                "pad", "stack", "Concat", "concat", "where",
                "reshape_like", "Cast", "cast", "stop_gradient",
                "BlockGrad", "ElementWiseSum", "add_n", "take", "pick",
@@ -305,6 +306,7 @@ def _build_cases():
         "SwapAxis": ([_sym(2, 3, 4)], {"dim1": 0, "dim2": 2}),
         "swapaxes": ([_sym(2, 3, 4)], {"dim1": 1, "dim2": 2}),
         "broadcast_to": ([_sym(1, 4)], {"shape": (3, 4)}),
+        "broadcast_like": ([_sym(1, 4), _sym(3, 4)], {}),
         "broadcast_axes": ([_sym(1, 4)], {"axis": 0, "size": 3}),
         "broadcast_axis": ([_sym(3, 1)], {"axis": 1, "size": 5}),
         "Pad": ([_sym(1, 2, 3, 3)],

@@ -1,0 +1,109 @@
+"""Statistical moment checks for every registered sampler (reference
+pattern: tests/python/unittest/test_random.py — verify sample mean/var
+against the distribution's analytic moments, not just shapes/dtypes)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = 200_000
+# sampling error at N=2e5 is ~1/sqrt(N) ≈ 0.22%; 5-sigma-ish slack
+MEAN_TOL = 0.05
+VAR_TOL = 0.10
+
+
+def _draw(fn, **kwargs):
+    mx.random.seed(42)
+    return fn(shape=(N,), **kwargs).asnumpy().astype(np.float64)
+
+
+def _check(samples, mean, var, mean_tol=MEAN_TOL, var_tol=VAR_TOL):
+    got_mean = samples.mean()
+    got_var = samples.var()
+    # absolute slack: ~6 standard errors of the sample mean
+    se = np.sqrt(max(var, 1e-4) / samples.size)
+    scale = max(abs(mean), 1e-2)
+    assert abs(got_mean - mean) < mean_tol * scale + 6 * se, \
+        "mean %g vs analytic %g" % (got_mean, mean)
+    vscale = max(var, 1e-2)
+    assert abs(got_var - var) < var_tol * vscale + 1e-2, \
+        "var %g vs analytic %g" % (got_var, var)
+
+
+def test_uniform_moments():
+    lo, hi = -1.5, 2.5
+    s = _draw(mx.nd.random_uniform, low=lo, high=hi)
+    _check(s, (lo + hi) / 2, (hi - lo) ** 2 / 12)
+    assert s.min() >= lo and s.max() < hi
+
+
+def test_normal_moments():
+    loc, scale = 1.2, 0.7
+    s = _draw(mx.nd.random_normal, loc=loc, scale=scale)
+    _check(s, loc, scale ** 2)
+    # third central moment of a Gaussian is 0 (skewness check)
+    skew = ((s - s.mean()) ** 3).mean() / s.std() ** 3
+    assert abs(skew) < 0.05
+
+
+def test_gamma_moments():
+    alpha, beta = 2.5, 1.5  # shape, scale: mean=a*b, var=a*b^2
+    s = _draw(mx.nd.random_gamma, alpha=alpha, beta=beta)
+    _check(s, alpha * beta, alpha * beta ** 2)
+    assert (s > 0).all()
+
+
+def test_exponential_moments():
+    lam = 2.0  # mean=1/lam, var=1/lam^2
+    s = _draw(mx.nd.random_exponential, lam=lam)
+    _check(s, 1 / lam, 1 / lam ** 2)
+
+
+def test_poisson_moments():
+    lam = 3.5  # mean=var=lam
+    s = _draw(mx.nd.random_poisson, lam=lam)
+    _check(s, lam, lam)
+    assert np.allclose(s, np.round(s))  # integer support
+
+
+def test_negative_binomial_moments():
+    k, p = 4, 0.4  # failures before k successes: mean=k(1-p)/p
+    s = _draw(mx.nd.random_negative_binomial, k=k, p=p)
+    _check(s, k * (1 - p) / p, k * (1 - p) / p ** 2)
+    assert (s >= 0).all() and np.allclose(s, np.round(s))
+
+
+def test_generalized_negative_binomial_moments():
+    mu, alpha = 2.0, 0.3  # mean=mu, var=mu+alpha*mu^2
+    s = _draw(mx.nd.random_generalized_negative_binomial, mu=mu, alpha=alpha)
+    _check(s, mu, mu + alpha * mu * mu)
+
+
+def test_uniform_like_and_normal_like():
+    ref = mx.nd.zeros((50_000,))
+    mx.random.seed(0)
+    u = mx.nd._internal._random_uniform_like(ref).asnumpy()
+    n = mx.nd._internal._random_normal_like(ref).asnumpy()
+    assert u.shape == n.shape == (50_000,)
+    _check(u.astype(np.float64), 0.5, 1 / 12)
+    _check(n.astype(np.float64), 0.0, 1.0)
+
+
+def test_multinomial_distribution():
+    probs = np.array([[0.2, 0.3, 0.5]], np.float32)
+    mx.random.seed(7)
+    draws = mx.nd.sample_multinomial(
+        mx.nd.array(np.repeat(probs, 1, 0)), shape=N).asnumpy().ravel()
+    freq = np.bincount(draws.astype(np.int64), minlength=3) / draws.size
+    assert np.abs(freq - probs[0]).max() < 0.01, freq
+
+
+def test_multinomial_seed_determinism():
+    probs = mx.nd.array([[0.4, 0.6]])
+    mx.random.seed(123)
+    a = mx.nd.sample_multinomial(probs, shape=64).asnumpy()
+    mx.random.seed(123)
+    b = mx.nd.sample_multinomial(probs, shape=64).asnumpy()
+    assert np.array_equal(a, b)
+    c = mx.nd.sample_multinomial(probs, shape=64).asnumpy()
+    assert not np.array_equal(a, c)  # stream advances between calls
