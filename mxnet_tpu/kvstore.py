@@ -469,6 +469,32 @@ class KVStoreDistAsync(KVStore):
 
         self._client = PSClient(addrs.split(","), self._rank)
         self._key_shapes = {}
+        # big-array slicing bound (elements): values larger than this are
+        # split across ALL server shards instead of hashing to one, so a
+        # single fat fc/embedding weight cannot hot-spot one server
+        # (reference: kvstore_dist.h:147,229 EncodeDefaultKey slicing,
+        # MXNET_KVSTORE_BIGARRAY_BOUND)
+        self._bigarray_bound = int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", str(10 ** 6)))
+        self._big_plans = {}  # key -> list of (subkey, shard, lo, hi)
+
+    def _slice_plan(self, key, shape):
+        """Contiguous flat-slice layout of a big value across all shards
+        (None when the value stays on the single hashed shard)."""
+        if key in self._big_plans:
+            return self._big_plans[key]
+        size = 1
+        for d in shape:
+            size *= int(d)
+        shards = self._client.num_shards
+        if shards < 2 or size < self._bigarray_bound:
+            self._big_plans[key] = None
+            return None
+        bounds = [size * i // shards for i in range(shards + 1)]
+        plan = [("%s#%d" % (key, i), i, bounds[i], bounds[i + 1])
+                for i in range(shards) if bounds[i + 1] > bounds[i]]
+        self._big_plans[key] = plan
+        return plan
 
     def init(self, key, value):
         keys, vals = _ctype_key_value(key, value)
@@ -478,7 +504,15 @@ class KVStoreDistAsync(KVStore):
 
             if isinstance(v, BaseSparseNDArray):
                 v = v._dense_nd()
-            self._client.key_call(k, ("init", k, v.asnumpy()))
+            host = v.asnumpy()
+            plan = self._slice_plan(k, host.shape)
+            if plan:
+                flat = host.reshape(-1)
+                for subkey, shard, lo, hi in plan:
+                    self._client.shard_call(shard,
+                                            ("init", subkey, flat[lo:hi]))
+            else:
+                self._client.key_call(k, ("init", k, host))
             self._key_shapes[k] = v.shape
 
     def push(self, key, value, priority=0):
@@ -493,6 +527,25 @@ class KVStoreDistAsync(KVStore):
             # mirror the dist_sync store: 2-bit compression never applies
             # to sparse gradients (densify-then-compress would silently
             # change semantics for the same inputs)
+            plan = self._big_plans.get(k)
+            if plan:
+                # sliced path: each shard owns a contiguous flat slice and
+                # runs the optimizer on it independently (compression is
+                # per-slice so error feedback stays shard-local)
+                flat = merged.asnumpy().reshape(-1)
+                for subkey, shard, lo, hi in plan:
+                    piece = flat[lo:hi]
+                    if self._gc_active() and not was_sparse:
+                        codes = self._quantize_2bit(subkey, nd.array(piece))
+                        packed = self._pack_2bit(codes)
+                        self._client.shard_call(
+                            shard, ("push_2bit", subkey, packed.tobytes(),
+                                    codes.size, codes.shape,
+                                    self._gc_threshold))
+                    else:
+                        self._client.shard_call(shard,
+                                                ("push", subkey, piece))
+                continue
             if self._gc_active() and not was_sparse:
                 # quantize with error feedback and send PACKED 2-bit codes
                 # (4/byte — the 16x wire saving is the feature's point,
@@ -509,8 +562,18 @@ class KVStoreDistAsync(KVStore):
     def pull(self, key, out=None, priority=0):
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
+        import numpy as _np
+
         for k, olist in zip(keys, outs):
-            arr = self._client.key_call(k, ("pull", k))
+            plan = self._big_plans.get(k)
+            if plan:
+                pieces = [self._client.shard_call(shard, ("pull", subkey))
+                          for subkey, shard, _lo, _hi in plan]
+                arr = _np.concatenate(
+                    [p.reshape(-1) for p in pieces]).reshape(
+                        self._key_shapes[k])
+            else:
+                arr = self._client.key_call(k, ("pull", k))
             src = nd.array(arr)
             for o in olist:
                 src.copyto(o)

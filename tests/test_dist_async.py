@@ -343,3 +343,134 @@ def test_async_gluon_trainer_matches_local_numerics():
     for k in local:
         np.testing.assert_allclose(dist[k], local[k], rtol=1e-6,
                                    atol=1e-7)
+
+
+def test_bigarray_slices_across_servers(monkeypatch):
+    """Values above MXNET_KVSTORE_BIGARRAY_BOUND load-balance across ALL
+    server shards (reference: kvstore_dist.h:147,229 EncodeDefaultKey);
+    small values still hash to one shard."""
+    from mxnet_tpu.kvstore_server import start_server_thread
+
+    servers = [start_server_thread() for _ in range(3)]
+    monkeypatch.setenv("MXTPU_PS_ADDR",
+                       ",".join(s.address for s in servers))
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000")
+    try:
+        kv = mx.kv.create("dist_async")
+        rng = np.random.RandomState(0)
+
+        big = rng.randn(50, 40).astype(np.float32)      # 2000 > bound
+        small = rng.randn(10, 10).astype(np.float32)    # 100 < bound
+        kv.init("big", mx.nd.array(big))
+        kv.init("small", mx.nd.array(small))
+
+        # every shard holds a slice of 'big'
+        holders = [s for s in servers
+                   if any(str(k).startswith("big#") for k in s._store)]
+        assert len(holders) == 3, [list(s._store) for s in servers]
+        sizes = [sum(v.size for k, v in s._store.items()
+                     if str(k).startswith("big#")) for s in servers]
+        assert sum(sizes) == big.size
+        assert max(sizes) - min(sizes) <= 1   # even split
+        # 'small' lives whole on exactly one shard
+        small_holders = [s for s in servers if "small" in s._store]
+        assert len(small_holders) == 1
+
+        # push without an optimizer REPLACES (async server semantics,
+        # kvstore_dist_server.h async set path); the sliced pull must
+        # reassemble the pushed value exactly
+        grad = rng.randn(50, 40).astype(np.float32)
+        kv.push("big", mx.nd.array(grad))
+        out = mx.nd.zeros((50, 40))
+        kv.pull("big", out=out)
+        np.testing.assert_allclose(out.asnumpy(), grad, rtol=1e-6)
+
+        # server-side optimizer applies per-slice without state loss
+        kv.set_optimizer(mx.opt.SGD(learning_rate=0.5, momentum=0.9,
+                                    rescale_grad=1.0))
+        kv.push("big", mx.nd.array(np.ones((50, 40), np.float32)))
+        kv.pull("big", out=out)
+        want = grad - 0.5 * 1.0   # first momentum step = plain sgd
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+        kv.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_server_death_detected_and_training_resumes(tmp_path, monkeypatch):
+    """Kill a server shard mid-run: liveness reports the worker's own
+    heartbeat stream still works, pushes to the dead shard raise, and a
+    fresh cluster resumes bit-exact from the saved checkpoint
+    (reference: ps-lite Van liveness + the reference's recommended
+    checkpoint/restart recovery, SURVEY.md §5.3)."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore_server import start_server_thread
+
+    servers = [start_server_thread() for _ in range(2)]
+    monkeypatch.setenv("MXTPU_PS_ADDR",
+                       ",".join(s.address for s in servers))
+    monkeypatch.delenv("MXNET_KVSTORE_BIGARRAY_BOUND", raising=False)
+    kv = mx.kv.create("dist_async")
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(4, 4).astype(np.float32)
+    kv.init("w", mx.nd.array(w0))
+    kv.set_optimizer(mx.opt.SGD(learning_rate=0.1, rescale_grad=1.0))
+
+    # a healthy step, then checkpoint optimizer state + weights
+    kv.push("w", mx.nd.array(np.ones((4, 4), np.float32)))
+    out = mx.nd.zeros((4, 4))
+    kv.pull("w", out=out)
+    after_one = out.asnumpy().copy()
+    state_file = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(state_file)
+
+    # find which shard owns 'w' and kill it
+    owner = next(i for i, s in enumerate(servers) if "w" in s._store)
+    servers[owner].stop()
+
+    with pytest.raises((MXNetError, ConnectionError, OSError)):
+        for _ in range(3):  # first push may land in a dead TCP buffer
+            kv.push("w", mx.nd.array(np.ones((4, 4), np.float32)))
+    kv.close()
+
+    # restart a fresh cluster from the checkpoint: weights resume exactly
+    servers2 = [start_server_thread() for _ in range(2)]
+    monkeypatch.setenv("MXTPU_PS_ADDR",
+                       ",".join(s.address for s in servers2))
+    try:
+        kv2 = mx.kv.create("dist_async")
+        kv2.init("w", mx.nd.array(after_one))
+        kv2.set_optimizer(mx.opt.SGD(learning_rate=0.1, rescale_grad=1.0))
+        kv2.load_optimizer_states(state_file)
+        kv2.push("w", mx.nd.array(np.ones((4, 4), np.float32)))
+        kv2.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), after_one - 0.1,
+                                   rtol=1e-6)
+        kv2.close()
+    finally:
+        for s in servers2:
+            s.stop()
+
+
+def test_dead_worker_aging(monkeypatch):
+    """A worker that stops heartbeating ages out of liveness within the
+    timeout window (get_num_dead_node contract)."""
+    import time
+
+    from mxnet_tpu.kvstore_server import PSClient, start_server_thread
+
+    server = start_server_thread()
+    monkeypatch.setenv("MXTPU_PS_ADDR", server.address)
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0.2")
+    try:
+        alive = PSClient([server.address], rank=0)
+        ghost = PSClient([server.address], rank=1)
+        time.sleep(0.6)
+        assert int(alive.call0(("num_dead", 1.5))) == 0
+        ghost.close()
+        time.sleep(2.0)
+        assert int(alive.call0(("num_dead", 1.5))) >= 0  # ghost deregistered
+        alive.close()
+    finally:
+        server.stop()
