@@ -17,7 +17,7 @@ from . import symbol as sym
 from . import kvstore as kvs
 from .context import cpu
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+__all__ = ["BatchEndParam", "FeedForward", "save_checkpoint", "load_checkpoint",
            "convert_conv_weight_layout"]
 
 BatchEndParam = namedtuple("BatchEndParams",
@@ -146,3 +146,206 @@ def convert_conv_weight_layout(weight, direction="ref_to_tpu"):
         raise ValueError("direction must be 'ref_to_tpu' or 'tpu_to_ref'")
     out = np.ascontiguousarray(a.transpose(perm))
     return _nd_array(out) if hasattr(weight, "asnumpy") else out
+
+
+class FeedForward:
+    """Legacy estimator API: fit/predict/score/save/load over one symbol.
+
+    Behavioral parity with the reference ``python/mxnet/model.py``
+    FeedForward (the BASELINE-era training surface predating Module).
+    Independent implementation: a thin adapter that owns parameters and
+    delegates the training loop to ``mxnet_tpu.module.Module`` — the same
+    relationship the reference's class has to its executor_manager.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .context import current_context
+        from .initializer import Uniform
+
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [current_context()]
+        elif not isinstance(ctx, (list, tuple)):
+            ctx = [ctx]
+        self.ctx = list(ctx)
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # ----------------------------------------------------------- plumbing
+    def _as_iter(self, X, y=None, shuffle=False):
+        """Accept numpy pairs or DataIters like the reference _init_iter."""
+        from .io import DataIter, NDArrayIter
+
+        if isinstance(X, DataIter):
+            return X
+        import numpy as _np
+
+        X = _np.asarray(X)
+        if y is not None:
+            y = _np.asarray(y)
+        return NDArrayIter(X, y, batch_size=min(self.numpy_batch_size,
+                                                len(X)),
+                           shuffle=shuffle, label_name="softmax_label")
+
+    def _label_args(self):
+        """Symbol arguments that are labels, by the reference's naming
+        convention (model.py _is_data_arg: ...endswith 'label')."""
+        return [a for a in self.symbol.list_arguments()
+                if a.endswith("label")]
+
+    def _make_module(self, train_iter):
+        from .module import Module
+
+        label_names = ([d[0] for d in (train_iter.provide_label or [])]
+                       or self._label_args())
+        self._module = Module(self.symbol,
+                              data_names=[d[0] for d in
+                                          train_iter.provide_data],
+                              label_names=label_names or None,
+                              context=self.ctx)
+        return self._module
+
+    # ------------------------------------------------------------ training
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """Train for ``num_epoch`` epochs over X/y (arrays or a DataIter)."""
+        if self.num_epoch is None:
+            raise ValueError("num_epoch must be set to call fit")
+        train = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        mod = self._make_module(train)
+
+        optimizer_params = dict(self.kwargs)
+        optimizer = self.optimizer
+        if isinstance(optimizer, str):
+            optimizer_params.setdefault("learning_rate", 0.01)
+        mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=optimizer,
+                optimizer_params=tuple(optimizer_params.items()),
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=self.arg_params is not None,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    # ----------------------------------------------------------- inference
+    def _bound_for_predict(self, data_iter):
+        from .module import Module
+
+        mod = Module(self.symbol,
+                     data_names=[d[0] for d in data_iter.provide_data],
+                     label_names=self._label_args() or None,
+                     context=self.ctx)
+        mod.bind(data_shapes=data_iter.provide_data, for_training=False)
+        mod.set_params(self.arg_params or {}, self.aux_params or {},
+                       allow_missing=False,
+                       allow_extra=self.allow_extra_params)
+        return mod
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Class probabilities for X; optionally also return (data, label)."""
+        data_iter = self._as_iter(X)
+        if reset:
+            data_iter.reset()
+        mod = self._bound_for_predict(data_iter)
+        outputs = []
+        datas, labels = [], []
+        for i, batch in enumerate(data_iter):
+            if num_batch is not None and i == num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            keep = batch.data[0].shape[0] - batch.pad
+            outputs.append(mod.get_outputs()[0].asnumpy()[:keep])
+            if return_data:
+                datas.append(batch.data[0].asnumpy()[:keep])
+                if batch.label:
+                    labels.append(batch.label[0].asnumpy()[:keep])
+        import numpy as _np
+
+        preds = _np.concatenate(outputs)
+        if not return_data:
+            return preds
+        return (preds, _np.concatenate(datas),
+                _np.concatenate(labels) if labels else None)
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """Metric value over X (requires labels in the iterator)."""
+        from . import metric as metric_mod
+
+        data_iter = self._as_iter(X)
+        if reset:
+            data_iter.reset()
+        metric = metric_mod.create(eval_metric)
+        mod = self._bound_for_predict(data_iter)
+        metric.reset()
+        for i, batch in enumerate(data_iter):
+            if num_batch is not None and i == num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            metric.update(batch.label, mod.get_outputs())
+            if batch_end_callback is not None:
+                cbs = (batch_end_callback
+                       if isinstance(batch_end_callback, list)
+                       else [batch_end_callback])
+                for cb in cbs:
+                    cb(BatchEndParam(epoch=0, nbatch=i, eval_metric=metric,
+                                     locals=locals()))
+        return metric.get()[1]
+
+    # ---------------------------------------------------------- checkpoints
+    def save(self, prefix, epoch=None):
+        """Write prefix-symbol.json + prefix-NNNN.params."""
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Rebuild a FeedForward from a checkpoint."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Construct + fit in one call (reference: model.py:930)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -1,0 +1,144 @@
+"""Pretrained-weight forward parity (reference pattern:
+tests/python/gpu/test_forward.py + gluon/model_zoo/model_store.py: load a
+reference-format .params file and check predictions).
+
+No downloads exist offline, so the reference-format fixture is generated
+locally: weights are written in the reference's binary .params layout and
+NCHW conv weight convention, then loaded back through the converters, and
+the network forward is checked against an independent numpy/torch
+re-implementation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.model import convert_conv_weight_layout
+
+
+def test_resnet18_reference_params_roundtrip(tmp_path):
+    """model_zoo resnet18_v1 eval-mode logits are identical after a trip
+    through a reference-format binary .params file."""
+    rng = np.random.RandomState(0)
+    net = mx.gluon.model_zoo.vision.resnet18_v1()
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(rng.rand(2, 3, 64, 64).astype(np.float32))
+    want = net(x).asnumpy()
+
+    fname = str(tmp_path / "resnet18.params")
+    # strip the per-instance auto prefix so the file holds the canonical
+    # names the model store publishes
+    net.collect_params().save(fname, strip_prefix=net.prefix)
+
+    fresh = mx.gluon.model_zoo.vision.resnet18_v1()
+    fresh.collect_params().load(fname, ignore_extra=False,
+                                restore_prefix=fresh.prefix)
+    got = fresh(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_module_checkpoint_cross_loader(tmp_path):
+    """A Module checkpoint written here loads through the arg:/aux: path of
+    gluon ParameterDict.load (the reference's shared format contract)."""
+    rng = np.random.RandomState(1)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=3, name="dense0"),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+
+    gnet = mx.gluon.nn.Dense(3, in_units=8, prefix="dense0_")
+    gnet.collect_params().load(prefix + "-0001.params", allow_missing=False,
+                               ignore_extra=True)
+    x = rng.rand(4, 8).astype(np.float32)
+    args, _ = mod.get_params()
+    want = x @ args["dense0_weight"].asnumpy().T \
+        + args["dense0_bias"].asnumpy()
+    got = gnet(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _np_conv_nchw(x, w, stride=1, pad=0):
+    """Plain-numpy NCHW cross-correlation (the reference conv semantics)."""
+    n, c, h, wid = x.shape
+    o, _, kh, kw = w.shape
+    if pad:
+        x = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (x.shape[2] - kh) // stride + 1
+    ow = (x.shape[3] - kw) // stride + 1
+    out = np.zeros((n, o, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_nhwc_graph_with_reference_weights():
+    """A reference-format NCHW conv weight converted via
+    convert_conv_weight_layout drives the NHWC graph to the same values as
+    an independent numpy NCHW forward (gluon/model_zoo/model_store.py
+    pretrained-load analog for the TPU layout)."""
+    rng = np.random.RandomState(2)
+    x_nchw = rng.rand(2, 3, 10, 10).astype(np.float32)
+    w_oihw = (rng.randn(8, 3, 3, 3) * 0.1).astype(np.float32)
+
+    want = _np_conv_nchw(x_nchw, w_oihw, stride=1, pad=1)
+
+    # the reference's NHWC-layout graphs store conv weights as
+    # (num_filter, kernel..., C) = OHWI; that is what the converter takes
+    w_ref = np.ascontiguousarray(w_oihw.transpose(0, 2, 3, 1))
+    w_tpu = convert_conv_weight_layout(mx.nd.array(w_ref),
+                                       direction="ref_to_tpu")
+    assert w_tpu.shape == (3, 3, 3, 8)  # HWIO
+
+    x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+    out = mx.nd.Convolution(mx.nd.array(x_nhwc), w_tpu, num_filter=8,
+                            kernel=(3, 3), pad=(1, 1), no_bias=True,
+                            layout="NHWC").asnumpy()
+    got = out.transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # the inverse conversion restores the reference layout bit-exact
+    back = convert_conv_weight_layout(w_tpu, direction="tpu_to_ref")
+    np.testing.assert_array_equal(back.asnumpy(), w_ref)
+    np.testing.assert_array_equal(back.asnumpy().transpose(0, 3, 1, 2),
+                                  w_oihw)
+
+
+def test_reference_binary_params_fixture_loads(tmp_path):
+    """Write a .params file with the reference's exact binary wire format
+    (magic + dense blobs + arg:/aux: names) and load it through nd.load +
+    set_params — the model_store download path minus the network."""
+    rng = np.random.RandomState(3)
+    blobs = {"arg:fc_weight": mx.nd.array(rng.randn(4, 6).astype("float32")),
+             "arg:fc_bias": mx.nd.array(rng.randn(4).astype("float32")),
+             "aux:bn_moving_mean": mx.nd.array(np.zeros(4, "float32"))}
+    fname = str(tmp_path / "store.params")
+    mx.nd.save(fname, blobs)
+
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == set(blobs)
+    for k in blobs:
+        np.testing.assert_array_equal(loaded[k].asnumpy(),
+                                      blobs[k].asnumpy())
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"), name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 6))], for_training=False)
+    arg = {k[4:]: v for k, v in loaded.items() if k.startswith("arg:")}
+    mod.init_params(arg_params=arg, aux_params={}, allow_missing=False)
+    x = rng.rand(2, 6).astype(np.float32)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)], label=None),
+                is_train=False)
+    logits = x @ arg["fc_weight"].asnumpy().T + arg["fc_bias"].asnumpy()
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               e / e.sum(1, keepdims=True), rtol=1e-5)

@@ -1,0 +1,70 @@
+"""Legacy FeedForward estimator tests (reference pattern:
+tests/python/train/test_mlp.py drives FeedForward.create/fit and asserts
+final accuracy; python/mxnet/model.py:434)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _dataset(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(4, 6) * 3
+    x = np.concatenate([centers[i] + 0.4 * rng.randn(n // 4, 6)
+                        for i in range(4)]).astype(np.float32)
+    y = np.repeat(np.arange(4), n // 4).astype(np.float32)
+    order = rng.permutation(len(x))
+    return x[order], y[order]
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=24,
+                                                name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=4,
+                                                      name="fc2"),
+                                name="softmax")
+
+
+def test_feedforward_fit_predict_score():
+    x, y = _dataset()
+    model = mx.model.FeedForward(_mlp(), num_epoch=12, numpy_batch_size=50,
+                                 learning_rate=0.2, momentum=0.9)
+    model.fit(x, y)
+
+    acc = model.score(mx.io.NDArrayIter(x, y, batch_size=50,
+                                        label_name="softmax_label"))
+    assert acc >= 0.95, "FeedForward failed to converge: %.3f" % acc
+
+    probs = model.predict(x)
+    assert probs.shape == (len(x), 4)
+    assert (probs.argmax(1) == y).mean() >= 0.95
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+
+
+def test_feedforward_save_load_roundtrip(tmp_path):
+    x, y = _dataset(n=200, seed=1)
+    model = mx.model.FeedForward(_mlp(), num_epoch=4, numpy_batch_size=50,
+                                 learning_rate=0.2)
+    model.fit(x, y)
+    before = model.predict(x)
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+
+    loaded = mx.model.FeedForward.load(prefix, 4)
+    after = loaded.predict(x)
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+    # the checkpoint is Module-compatible too (shared format)
+    mod = mx.mod.Module.load(prefix, 4)
+    assert mod.symbol.list_outputs() == model.symbol.list_outputs()
+
+
+def test_feedforward_create_with_eval():
+    x, y = _dataset(n=240, seed=2)
+    model = mx.model.FeedForward.create(
+        _mlp(), x[:200], y[:200], num_epoch=10, numpy_batch_size=40,
+        learning_rate=0.2, momentum=0.9, eval_data=(x[200:], y[200:]))
+    assert model.score(mx.io.NDArrayIter(x[200:], y[200:], batch_size=40,
+                                         label_name="softmax_label")) >= 0.9
