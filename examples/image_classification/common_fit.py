@@ -1,0 +1,151 @@
+"""Shared training harness for the image-classification examples.
+
+Reference workflow: example/image-classification/common/fit.py — one
+``fit(args, network, data_loader)`` entry with the full CLI contract:
+lr-step schedules, optimizer/kvstore flags, top-k eval, periodic
+checkpoints, and resume from ``--load-epoch``.
+"""
+import argparse
+import logging
+import os
+import time
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    """The reference's common/fit.py argument set (TPU-relevant subset)."""
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str, default="resnet-18",
+                       help="the neural network to use (resnet-<depth>)")
+    train.add_argument("--num-layers", type=int, default=None,
+                       help="number of layers, overrides --network depth")
+    train.add_argument("--gpus", type=str, default=None,
+                       help="device list; default uses the first accelerator")
+    train.add_argument("--kv-store", type=str, default="local",
+                       help="key-value store type (local|device|dist_*)")
+    train.add_argument("--num-epochs", type=int, default=10)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1,
+                       help="lr decay ratio at each step")
+    train.add_argument("--lr-step-epochs", type=str, default="30,60",
+                       help="epochs at which the lr decays, comma-separated")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20,
+                       help="show progress every N batches")
+    train.add_argument("--model-prefix", type=str, default=None,
+                       help="checkpoint prefix (enables saving)")
+    train.add_argument("--load-epoch", type=int, default=None,
+                       help="resume from this saved epoch")
+    train.add_argument("--top-k", type=int, default=0,
+                       help="also report top-k accuracy when > 0")
+    train.add_argument("--monitor", type=int, default=0,
+                       help="monitor stats every N batches (0 = off)")
+    return train
+
+
+def _contexts(args):
+    if args.gpus:
+        return [mx.gpu(int(i)) for i in args.gpus.split(",")]
+    return [mx.gpu()] if mx.context.num_gpus() else [mx.cpu()]
+
+
+def _lr_schedule(args, epoch_size):
+    """MultiFactorScheduler at --lr-step-epochs, shifted for resume."""
+    begin = args.load_epoch or 0
+    steps = [int(e) for e in args.lr_step_epochs.split(",") if e.strip()]
+    lr = args.lr
+    for e in steps:
+        if begin >= e:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d", lr, begin)
+    remaining = [epoch_size * (e - begin) for e in steps if e > begin]
+    if not remaining:
+        return lr, None
+    return lr, mx.lr_scheduler.MultiFactorScheduler(step=remaining,
+                                                    factor=args.lr_factor)
+
+
+def _metrics(args):
+    metrics = [mx.metric.create("accuracy"),
+               mx.metric.create("ce")]
+    if args.top_k > 0:
+        metrics.append(mx.metric.create("top_k_accuracy", top_k=args.top_k))
+    return mx.metric.CompositeEvalMetric(metrics)
+
+
+def fit(args, network, data_loader):
+    """Train ``network`` with the data from ``data_loader(args)``.
+
+    data_loader returns (train_iter, val_iter_or_None, epoch_size).
+    """
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    train, val, epoch_size = data_loader(args)
+    ctx = _contexts(args)
+
+    # resume
+    arg_params = aux_params = None
+    if args.model_prefix and args.load_epoch is not None:
+        network, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        logging.info("Resumed from %s-%04d", args.model_prefix,
+                     args.load_epoch)
+
+    lr, lr_sched = _lr_schedule(args, epoch_size)
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+    }
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.mom
+    if lr_sched is not None:
+        optimizer_params["lr_scheduler"] = lr_sched
+
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix else None)
+    batch_cbs = [mx.callback.Speedometer(args.batch_size,
+                                         args.disp_batches)]
+    monitor = (mx.monitor.Monitor(args.monitor, pattern=".*weight")
+               if args.monitor > 0 else None)
+
+    mod = mx.mod.Module(network, context=ctx)
+    tic = time.time()
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=_metrics(args),
+            begin_epoch=args.load_epoch or 0,
+            num_epoch=args.num_epochs,
+            kvstore=args.kv_store,
+            optimizer=args.optimizer,
+            optimizer_params=tuple(optimizer_params.items()),
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in", magnitude=2),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            allow_missing=arg_params is not None,
+            batch_end_callback=batch_cbs,
+            epoch_end_callback=checkpoint,
+            monitor=monitor)
+    logging.info("Total training time: %.1fs", time.time() - tic)
+    return mod
+
+
+def build_network(args, num_classes, image_shape):
+    """Resolve --network/--num-layers to a symbol."""
+    from mxnet_tpu.models import get_resnet
+
+    name = args.network
+    depth = args.num_layers
+    if depth is None:
+        if "-" in name:
+            depth = int(name.split("-")[1])
+        else:
+            raise ValueError("--network must look like resnet-50, or pass "
+                             "--num-layers")
+    return get_resnet(num_classes=num_classes, num_layers=depth,
+                      image_shape=image_shape)

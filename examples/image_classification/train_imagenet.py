@@ -1,0 +1,135 @@
+"""ImageNet-style training CLI over .rec data (or synthetic fallback).
+
+Reference workflow: example/image-classification/train_imagenet.py +
+common/fit.py + common/data.py — full CLI: --network/--num-layers,
+--lr/--lr-step-epochs schedule, augmentation flags, --top-k eval,
+--model-prefix checkpoints, --load-epoch resume, --kv-store choice, and a
+--benchmark synthetic-data mode.
+
+Examples:
+    # CIFAR-style .rec training with augmentation + checkpoints
+    python train_imagenet.py --data-train train.rec --image-shape 3,32,32 \
+        --num-classes 10 --model-prefix ckpt/run1 --top-k 5
+    # resume
+    python train_imagenet.py ... --load-epoch 3
+    # synthetic-data benchmark mode
+    python train_imagenet.py --benchmark 1 --network resnet-50
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import common_fit
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "data loading")
+    data.add_argument("--data-train", type=str, default=None,
+                      help="training .rec file (synthetic when omitted)")
+    data.add_argument("--data-val", type=str, default=None)
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--num-examples", type=int, default=1280,
+                      help="examples per epoch for synthetic/benchmark mode")
+    data.add_argument("--rand-crop", type=int, default=1)
+    data.add_argument("--rand-mirror", type=int, default=1)
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="1 = synthetic data benchmark mode")
+    return data
+
+
+class SyntheticIter(mx.io.DataIter):
+    """The reference's --benchmark 1 synthetic feeder (common/fit.py)."""
+
+    def __init__(self, batch_size, image_shape, num_classes, num_examples):
+        super().__init__()
+        rng = np.random.RandomState(0)
+        self.batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.rand(batch_size, *image_shape)
+                              .astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, num_classes, batch_size)
+                               .astype(np.float32))])
+        self._nbatch = max(1, num_examples // batch_size)
+        self._cur = 0
+        self.provide_data = [mx.io.DataDesc("data",
+                                            (batch_size,) + image_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label", (batch_size,))]
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= self._nbatch:
+            raise StopIteration
+        self._cur += 1
+        return self.batch
+
+
+def get_data(args):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.benchmark or not args.data_train:
+        train = SyntheticIter(args.batch_size, shape, args.num_classes,
+                              args.num_examples)
+        return train, None, args.num_examples // args.batch_size
+
+    train = mx.image.ImageIter(
+        batch_size=args.batch_size, data_shape=shape,
+        path_imgrec=args.data_train, shuffle=True,
+        rand_crop=bool(args.rand_crop), rand_mirror=bool(args.rand_mirror))
+    val = None
+    if args.data_val:
+        val = mx.image.ImageIter(batch_size=args.batch_size,
+                                 data_shape=shape,
+                                 path_imgrec=args.data_val)
+    epoch_size = (train.num_image or args.num_examples) // args.batch_size
+    return train, val, epoch_size
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train an image classifier",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common_fit.add_fit_args(parser)
+    add_data_args(parser)
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny fast run for CI")
+    args = parser.parse_args()
+    if args.smoke:
+        args.network = "resnet-18"
+        args.image_shape = "3,32,32"
+        args.num_classes = 10
+        args.batch_size = 8
+        args.num_examples = 64
+        args.num_epochs = 2
+        args.lr_step_epochs = "1"
+        args.disp_batches = 4
+        args.top_k = 3
+        args.benchmark = 1
+        if args.model_prefix is None:
+            import tempfile
+            args.model_prefix = _os.path.join(
+                tempfile.mkdtemp(prefix="train_imagenet_"), "ckpt")
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = common_fit.build_network(args, args.num_classes, shape)
+    mod = common_fit.fit(args, net, get_data)
+
+    if args.smoke:
+        # resume path must produce a Module that scores
+        assert _os.path.exists("%s-%04d.params"
+                               % (args.model_prefix, args.num_epochs))
+        args.load_epoch = args.num_epochs
+        args.num_epochs += 1
+        net2 = common_fit.build_network(args, args.num_classes, shape)
+        common_fit.fit(args, net2, get_data)
+        print("smoke ok: trained, checkpointed, resumed")
+
+
+if __name__ == "__main__":
+    main()

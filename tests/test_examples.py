@@ -10,6 +10,7 @@ _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 _EXAMPLES = [
     "examples/image_classification/train_mnist.py",
+    "examples/image_classification/train_imagenet.py",
     "examples/image_classification/benchmark_score.py",
     "examples/rnn/lstm_bucketing.py",
     "examples/ssd/train_ssd_toy.py",
