@@ -1,9 +1,14 @@
-"""The pre-1.0 experimental autograd API (reference:
-python/mxnet/contrib/autograd.py — kept so old user code keeps running;
-the modern surface is ``mx.autograd``). Everything delegates to the
-current tape."""
+"""The pre-1.0 experimental autograd surface.
+
+Parity surface: reference contrib/autograd.py (set_is_training,
+train_section/test_section, mark_variables, backward, compute_gradient,
+grad_and_loss, grad) — kept so old user code keeps running; the modern
+surface is ``mx.autograd``. The old API coupled recording and training
+into one flag, so every toggle here flips both on the current tape.
+"""
 from __future__ import annotations
 
+import contextlib
 import functools
 
 from .. import autograd as _ag
@@ -14,85 +19,80 @@ __all__ = ["set_is_training", "train_section", "test_section",
 
 
 def set_is_training(is_train):
-    """Set training mode globally; returns the previous mode
-    (reference: contrib/autograd.py:32 — the old API coupled recording
-    and training into one flag)."""
-    prev_t = _ag.set_training(is_train)
+    """Flip training+recording together; returns the previous train flag."""
+    previous = _ag.set_training(is_train)
     _ag.set_recording(is_train)
-    return prev_t
+    return previous
 
 
-class TrainingStateScope(object):
-    """(reference: contrib/autograd.py:54)"""
-
-    def __init__(self, enter_state):
-        self._enter_state = enter_state
-        self._prev = None
-
-    def __enter__(self):
-        self._prev = set_is_training(self._enter_state)
-
-    def __exit__(self, ptype, value, trace):
-        set_is_training(self._prev)
+@contextlib.contextmanager
+def _coupled_scope(state):
+    outer = set_is_training(state)
+    try:
+        yield
+    finally:
+        set_is_training(outer)
 
 
 def train_section():
-    """Scope with training (and recording) on (reference:
-    contrib/autograd.py:74)."""
-    return TrainingStateScope(True)
+    """Scope with training (and recording) on."""
+    return _coupled_scope(True)
 
 
 def test_section():
-    """Scope with training off (reference: contrib/autograd.py:88)."""
-    return TrainingStateScope(False)
+    """Scope with training (and recording) off."""
+    return _coupled_scope(False)
 
 
 mark_variables = _ag.mark_variables
 
 
 def backward(outputs, out_grads=None, retain_graph=False):
-    """(reference: contrib/autograd.py:123)"""
+    """Old-API spelling of autograd.backward."""
     return _ag.backward(outputs, head_grads=out_grads,
                         retain_graph=retain_graph)
 
 
 def compute_gradient(outputs):
-    """(reference: contrib/autograd.py:158)"""
+    """Backward with implicit all-ones head gradients."""
     backward(outputs)
 
 
 def grad_and_loss(func, argnum=None):
-    """Wrap ``func`` to return (gradients, outputs)
-    (reference: contrib/autograd.py:163)."""
+    """Wrap ``func`` so calls return (gradients, outputs)."""
+
     @functools.wraps(func)
     def wrapped(*args):
         from ..ndarray import NDArray, zeros_like
 
-        argnums = ([argnum] if isinstance(argnum, int)
-                   else list(argnum) if argnum is not None
-                   else list(range(len(args))))
-        variables = [args[i] for i in argnums]
-        for x in variables:
-            assert isinstance(x, NDArray), \
-                "type of autograd input should be NDArray"
-        grads = [zeros_like(x) for x in variables]
-        mark_variables(variables, grads)
+        if argnum is None:
+            chosen = list(range(len(args)))
+        elif isinstance(argnum, int):
+            chosen = [argnum]
+        else:
+            chosen = list(argnum)
+        leaves = [args[i] for i in chosen]
+        for leaf in leaves:
+            if not isinstance(leaf, NDArray):
+                raise AssertionError(
+                    "type of autograd input should be NDArray")
+        buffers = [zeros_like(leaf) for leaf in leaves]
+        mark_variables(leaves, buffers)
         with train_section():
             outputs = func(*args)
-            backward([outputs] if isinstance(outputs, NDArray)
-                     else outputs)
-        return grads, outputs
+            heads = [outputs] if isinstance(outputs, NDArray) else outputs
+            backward(heads)
+        return buffers, outputs
 
     return wrapped
 
 
 def grad(func, argnum=None):
-    """Wrap ``func`` to return only gradients
-    (reference: contrib/autograd.py:195)."""
-    grad_with_loss_func = grad_and_loss(func, argnum)
+    """Wrap ``func`` so calls return only the gradients."""
+    paired = grad_and_loss(func, argnum)
 
-    @functools.wraps(grad_with_loss_func)
+    @functools.wraps(paired)
     def wrapped(*args):
-        return grad_with_loss_func(*args)[0]
+        return paired(*args)[0]
 
     return wrapped

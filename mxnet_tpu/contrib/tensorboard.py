@@ -1,41 +1,46 @@
-"""TensorBoard metric logging (reference:
-python/mxnet/contrib/tensorboard.py — LogMetricsCallback writing eval
-metrics as scalars per batch)."""
+"""TensorBoard metric bridge.
+
+Parity surface: reference contrib/tensorboard.py LogMetricsCallback — a
+batch-end callback emitting every eval-metric value as a scalar. Accepts a
+log directory (resolving a SummaryWriter from torch or tensorboardX) or any
+ready writer object exposing ``add_scalar(name, value, global_step)``.
+"""
 from __future__ import annotations
 
 __all__ = ["LogMetricsCallback"]
 
 
+def _resolve_writer(logging_dir):
+    for module in ("torch.utils.tensorboard", "tensorboardX"):
+        try:
+            import importlib
+
+            mod = importlib.import_module(module)
+            return mod.SummaryWriter(logging_dir)
+        except ImportError:
+            continue
+    raise ImportError(
+        "LogMetricsCallback needs a SummaryWriter: install "
+        "tensorboard/tensorboardX, or pass summary_writer=")
+
+
 class LogMetricsCallback(object):
-    """Batch-end callback pushing eval metrics to TensorBoard
-    (reference: contrib/tensorboard.py:25). Pass either a logging
-    directory (requires a tensorboard ``SummaryWriter`` implementation
-    to be importable) or a ready writer object exposing
-    ``add_scalar(name, value, global_step)``."""
+    """Push eval-metric scalars to a SummaryWriter every batch."""
 
     def __init__(self, logging_dir=None, prefix=None, summary_writer=None):
         self.prefix = prefix
         self.step = 0
-        if summary_writer is not None:
-            self.summary_writer = summary_writer
-            return
-        try:
-            from torch.utils.tensorboard import SummaryWriter
-        except ImportError:
-            try:
-                from tensorboardX import SummaryWriter
-            except ImportError:
-                raise ImportError(
-                    "LogMetricsCallback needs a SummaryWriter: install "
-                    "tensorboard/tensorboardX, or pass summary_writer=")
-        self.summary_writer = SummaryWriter(logging_dir)
+        self.summary_writer = (summary_writer if summary_writer is not None
+                               else _resolve_writer(logging_dir))
+
+    def _tagged(self, metric):
+        for name, value in metric.get_name_value():
+            yield (name if self.prefix is None
+                   else "%s-%s" % (self.prefix, name)), value
 
     def __call__(self, param):
-        """(reference: contrib/tensorboard.py __call__)"""
         if param.eval_metric is None:
             return
         self.step += 1
-        for name, value in param.eval_metric.get_name_value():
-            if self.prefix is not None:
-                name = "%s-%s" % (self.prefix, name)
-            self.summary_writer.add_scalar(name, value, self.step)
+        for tag, value in self._tagged(param.eval_metric):
+            self.summary_writer.add_scalar(tag, value, self.step)

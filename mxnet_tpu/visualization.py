@@ -1,5 +1,10 @@
-"""Network visualization (reference: python/mxnet/visualization.py, 355 LoC):
-print_summary (layer table with param counts) and plot_network (graphviz)."""
+"""Network visualization: layer-table summary and graphviz plotting.
+
+Parity surface: reference visualization.py (print_summary column layout and
+param counting; plot_network node styling). Independent implementation:
+the summary is built as a row list by a small per-op param-counting table
+and rendered in one pass; graphviz styling is a declarative op→style map.
+"""
 from __future__ import annotations
 
 import json
@@ -9,203 +14,179 @@ from .symbol import Symbol
 __all__ = ["print_summary", "plot_network"]
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
-    """Layer-table summary (reference: visualization.py:47)."""
+def _tuple_attr(text):
+    body = str(text).strip("()[] ")
+    return tuple(int(x) for x in body.split(",") if x.strip()) if body else ()
+
+
+def _truthy(attrs, key):
+    return attrs.get(key, "False") in ("True", "true", "1")
+
+
+def _graph_and_shapes(symbol, shape):
+    """Parsed node list + name→shape map (when input shapes are given)."""
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be Symbol")
-    show_shape = False
+    shape_dict = None
     if shape is not None:
-        show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
+        internals = symbol.get_internals()
+        _args, out_shapes, _auxs = internals.infer_shape(**shape)
         if out_shapes is None:
             raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
     conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
     heads = set(conf["heads"][0] if conf["heads"]
                 and isinstance(conf["heads"][0], list) else [])
+    return conf["nodes"], heads, shape_dict
+
+
+def _node_shape(node, shape_dict):
+    """This node's output shape minus the batch axis ([] when unknown)."""
+    if shape_dict is None:
+        return []
+    key = node["name"] + ("_output" if node["op"] != "null" else "")
+    return list(shape_dict.get(key, ())[1:])
+
+
+def _count_params(node, fan_in, out_shape):
+    """Learnable parameter count contributed by one node."""
+    op = node["op"]
+    attrs = node.get("attrs", node.get("param", {})) or {}
+    if op == "Convolution":
+        filters = int(attrs["num_filter"])
+        count = fan_in * filters
+        for k in _tuple_attr(attrs.get("kernel", "()")):
+            count *= k
+        return count + (0 if _truthy(attrs, "no_bias") else filters)
+    if op == "FullyConnected":
+        hidden = int(attrs["num_hidden"])
+        per_unit = fan_in if _truthy(attrs, "no_bias") else fan_in + 1
+        return per_unit * hidden
+    if op == "BatchNorm":
+        return 2 * int(out_shape[0]) if out_shape else 0
+    if op == "Embedding":
+        return int(attrs["input_dim"]) * int(attrs["output_dim"])
+    return 0
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print the layer table; returns the total parameter count."""
+    nodes, heads, shape_dict = _graph_and_shapes(symbol, shape)
     if positions[-1] <= 1:
         positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
 
-    def print_row(fields, positions):
+    def emit(fields):
         line = ""
-        for i, field in enumerate(fields):
-            line += str(field)
-            line = line[:positions[i]]
-            line += " " * (positions[i] - len(line))
+        for text, stop in zip(fields, positions):
+            line = (line + str(text))[:stop].ljust(stop)
         print(line)
 
     print("_" * line_length)
-    print_row(to_display, positions)
+    emit(["Layer (type)", "Output Shape", "Param #", "Previous Layer"])
     print("=" * line_length)
 
-    total_params = [0]
-
-    def print_layer_summary(node, out_shape):
-        op = node["op"]
-        pre_node = []
-        pre_filter = 0
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-                    if show_shape:
-                        key = input_name
-                        if input_node["op"] != "null":
-                            key += "_output"
-                        if key in shape_dict:
-                            shape = shape_dict[key][1:]
-                            pre_filter = pre_filter + int(shape[0]) if shape \
-                                else pre_filter
-        cur_param = 0
-        attrs = node.get("attrs", node.get("param", {})) or {}
-        if op == "Convolution":
-            num_filter = int(attrs["num_filter"])
-            cur_param = pre_filter * num_filter
-            for k in _parse_tuple(attrs.get("kernel", "()")):
-                cur_param *= k
-            if attrs.get("no_bias", "False") not in ("True", "true", "1"):
-                cur_param += num_filter
-        elif op == "FullyConnected":
-            num_hidden = int(attrs["num_hidden"])
-            if attrs.get("no_bias", "False") in ("True", "true", "1"):
-                cur_param = pre_filter * num_hidden
-            else:
-                cur_param = (pre_filter + 1) * num_hidden
-        elif op == "BatchNorm":
-            key = node["name"] + "_output"
-            if show_shape:
-                num_filter = shape_dict[key][1]
-                cur_param = int(num_filter) * 2
-        elif op == "Embedding":
-            cur_param = int(attrs["input_dim"]) * int(attrs["output_dim"])
-        first_connection = pre_node[0] if pre_node else ""
-        fields = [node["name"] + "(" + op + ")",
-                  "x".join([str(x) for x in out_shape]),
-                  cur_param, first_connection]
-        print_row(fields, positions)
-        for i in range(1, len(pre_node)):
-            fields = ["", "", "", pre_node[i]]
-            print_row(fields, positions)
-        total_params[0] += cur_param
-
+    total = 0
     for i, node in enumerate(nodes):
-        out_shape = []
         op = node["op"]
         if op == "null" and i > 0:
             continue
-        if op != "null" or i in heads:
-            if show_shape:
-                key = node["name"]
-                if op != "null":
-                    key += "_output"
-                if key in shape_dict:
-                    out_shape = shape_dict[key][1:]
-        print_layer_summary(node, out_shape)
-        if i == len(nodes) - 1:
-            print("=" * line_length)
-        else:
-            print("_" * line_length)
-    print("Total params: {params}".format(params=total_params[0]))
+        out_shape = (_node_shape(node, shape_dict)
+                     if (op != "null" or i in heads) else [])
+
+        # predecessors that are ops (or graph heads) + their channel sum
+        parents, fan_in = [], 0
+        if op != "null":
+            for src_idx, *_rest in node["inputs"]:
+                src = nodes[src_idx]
+                if src["op"] == "null" and src_idx not in heads:
+                    continue
+                parents.append(src["name"])
+                if shape_dict is not None:
+                    src_shape = _node_shape(src, shape_dict)
+                    if src_shape:
+                        fan_in += int(src_shape[0])
+
+        count = _count_params(node, fan_in, out_shape)
+        total += count
+        emit(["%s(%s)" % (node["name"], op),
+              "x".join(str(d) for d in out_shape), count,
+              parents[0] if parents else ""])
+        for extra in parents[1:]:
+            emit(["", "", "", extra])
+        print(("=" if i == len(nodes) - 1 else "_") * line_length)
+    print("Total params: %d" % total)
     print("_" * line_length)
-    return total_params[0]
+    return total
 
 
-def _parse_tuple(s):
-    s = s.strip("()[] ")
-    if not s:
-        return ()
-    return tuple(int(x) for x in s.split(",") if x.strip())
+_HIDDEN_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta", "_moving_mean",
+                    "_moving_var")
+
+# op -> (fill color, label builder)
+_STYLES = {
+    "null": ("#8dd3c7", None),
+    "Convolution": ("#fb8072", lambda a: "Convolution\n%s/%s, %s" % (
+        a.get("kernel", "?"), a.get("stride", "(1,1)"),
+        a.get("num_filter", "?"))),
+    "FullyConnected": ("#fb8072",
+                       lambda a: "FullyConnected\n%s" % a.get("num_hidden",
+                                                              "?")),
+    "BatchNorm": ("#bebada", None),
+    "Activation": ("#ffffb3", lambda a: "Activation\n%s" % a.get("act_type",
+                                                                 "")),
+    "LeakyReLU": ("#ffffb3", lambda a: "LeakyReLU\n%s" % a.get("act_type",
+                                                               "")),
+    "Pooling": ("#80b1d3", lambda a: "Pooling\n%s, %s/%s" % (
+        a.get("pool_type", "?"), a.get("kernel", "?"),
+        a.get("stride", "(1,1)"))),
+    "Concat": ("#fdb462", None),
+    "Flatten": ("#fdb462", None),
+    "Reshape": ("#fdb462", None),
+    "Softmax": ("#fccde5", None),
+    "SoftmaxOutput": ("#fccde5", None),
+}
+_DEFAULT_FILL = "#b3de69"
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    """Graphviz digraph of the symbol (reference: visualization.py:192).
-    Requires the optional ``graphviz`` package."""
+    """Build a graphviz Digraph of the symbol (graphviz is optional)."""
     try:
         from graphviz import Digraph
     except ImportError:
         raise ImportError("Draw network requires graphviz library")
-    if not isinstance(symbol, Symbol):
-        raise TypeError("symbol must be a Symbol")
-    draw_shape = False
-    shape_dict = {}
-    if shape is not None:
-        draw_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+    nodes, _heads, shape_dict = _graph_and_shapes(symbol, shape)
+
+    base_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
                  "height": "0.8034", "style": "filled"}
     if node_attrs:
-        node_attr.update(node_attrs)
+        base_attr.update(node_attrs)
     dot = Digraph(name=title, format=save_format)
-    hidden_nodes = set()
+
+    hidden = set()
     for node in nodes:
-        op = node["op"]
-        name = node["name"]
+        op, name = node["op"], node["name"]
         attrs = node.get("attrs", {}) or {}
-        label = name
-        if op == "null":
-            if name.endswith("_weight") or name.endswith("_bias") or \
-                    name.endswith("_gamma") or name.endswith("_beta") or \
-                    name.endswith("_moving_mean") or name.endswith("_moving_var"):
-                if hide_weights:
-                    hidden_nodes.add(name)
-                continue
-            attr = dict(node_attr, fillcolor="#8dd3c7")
-        elif op == "Convolution":
-            label = "Convolution\n%s/%s, %s" % (
-                attrs.get("kernel", "?"), attrs.get("stride", "(1,1)"),
-                attrs.get("num_filter", "?"))
-            attr = dict(node_attr, fillcolor="#fb8072")
-        elif op == "FullyConnected":
-            label = "FullyConnected\n%s" % attrs.get("num_hidden", "?")
-            attr = dict(node_attr, fillcolor="#fb8072")
-        elif op == "BatchNorm":
-            attr = dict(node_attr, fillcolor="#bebada")
-        elif op == "Activation" or op == "LeakyReLU":
-            label = "%s\n%s" % (op, attrs.get("act_type", ""))
-            attr = dict(node_attr, fillcolor="#ffffb3")
-        elif op == "Pooling":
-            label = "Pooling\n%s, %s/%s" % (
-                attrs.get("pool_type", "?"), attrs.get("kernel", "?"),
-                attrs.get("stride", "(1,1)"))
-            attr = dict(node_attr, fillcolor="#80b1d3")
-        elif op in ("Concat", "Flatten", "Reshape"):
-            attr = dict(node_attr, fillcolor="#fdb462")
-        elif op == "Softmax" or op == "SoftmaxOutput":
-            attr = dict(node_attr, fillcolor="#fccde5")
-        else:
-            attr = dict(node_attr, fillcolor="#b3de69")
-        dot.node(name=name, label=label, **attr)
-    for i, node in enumerate(nodes):
-        op = node["op"]
-        name = node["name"]
-        if op == "null":
+        if op == "null" and hide_weights and name.endswith(_HIDDEN_SUFFIXES):
+            hidden.add(name)
             continue
-        inputs = node["inputs"]
-        for item in inputs:
-            input_node = nodes[item[0]]
-            input_name = input_node["name"]
-            if input_name in hidden_nodes:
+        fill, labeler = _STYLES.get(op, (_DEFAULT_FILL, None))
+        label = labeler(attrs) if labeler else name
+        dot.node(name=name, label=label, **dict(base_attr, fillcolor=fill))
+
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for src_idx, *_rest in node["inputs"]:
+            src = nodes[src_idx]
+            if src["name"] in hidden:
                 continue
-            attr = {"dir": "back", "arrowtail": "open"}
-            if draw_shape:
-                key = input_name
-                if input_node["op"] != "null":
-                    key += "_output"
-                if key in shape_dict:
-                    shape = shape_dict[key][1:]
-                    attr["label"] = "x".join([str(x) for x in shape])
-            dot.edge(tail_name=name, head_name=input_name, **attr)
+            edge_attr = {"dir": "back", "arrowtail": "open"}
+            if shape_dict is not None:
+                src_shape = _node_shape(src, shape_dict)
+                if src_shape:
+                    edge_attr["label"] = "x".join(str(d) for d in src_shape)
+            dot.edge(tail_name=node["name"], head_name=src["name"],
+                     **edge_attr)
     return dot
