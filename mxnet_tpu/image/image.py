@@ -482,7 +482,7 @@ class ImageIter(_io.DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, dtype="float32",
-                 last_batch_handle="pad", **kwargs):
+                 last_batch_handle="pad", preprocess_threads=0, **kwargs):
         super().__init__()
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
         assert len(data_shape) == 3 and data_shape[0] in (1, 3)
@@ -553,6 +553,15 @@ class ImageIter(_io.DataIter):
                          if aug_list is None else aug_list)
         self.cur = 0
         self._allow_read = True
+        # parallel decode+augment pool (the ImageRecordIter
+        # preprocess_threads analog, iter_image_recordio_2.cc:139-145's
+        # OMP decode loop): PIL decode and the numpy augmenters release
+        # the GIL in their C kernels, so threads scale
+        self._pool = None
+        if preprocess_threads and preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self.last_batch_handle = last_batch_handle
         self.num_image = len(self.seq) if self.seq is not None else None
         self._cache_data = None
@@ -572,8 +581,8 @@ class ImageIter(_io.DataIter):
             self.imgrec.reset()
         self.cur = 0
 
-    def next_sample(self):
-        """Return (label, decoded HWC image) for the next sample."""
+    def _next_raw(self):
+        """(label, payload, kind) with decode deferred — the IO half."""
         if self.seq is not None:
             if self.cur >= len(self.seq):
                 raise StopIteration
@@ -582,16 +591,24 @@ class ImageIter(_io.DataIter):
             if self.imgrec is not None:
                 s = self.imgrec.read_idx(idx)
                 header, img = recordio.unpack(s)
-                if self.imglist is None:
-                    return header.label, imdecode(img)
-                return self.imglist[idx][0], imdecode(img)
+                label = (header.label if self.imglist is None
+                         else self.imglist[idx][0])
+                return label, img, "bytes"
             label, fname = self.imglist[idx]
-            return label, self.read_image(fname)
+            return label, fname, "file"
         s = self.imgrec.read()
         if s is None:
             raise StopIteration
         header, img = recordio.unpack(s)
-        return header.label, imdecode(img)
+        return header.label, img, "bytes"
+
+    def _decode_raw(self, payload, kind):
+        return imdecode(payload) if kind == "bytes"             else self.read_image(payload)
+
+    def next_sample(self):
+        """Return (label, decoded HWC image) for the next sample."""
+        label, payload, kind = self._next_raw()
+        return label, self._decode_raw(payload, kind)
 
     def next(self):
         c, h, w = self.data_shape

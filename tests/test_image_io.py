@@ -323,3 +323,20 @@ def test_libsvm_fallback_error_contract(tmp_path):
         f.write("1 -2:3 5:1\n")
     with pytest.raises(mx.MXNetError, match="-2"):
         LibSVMIter._parse(path2, 10)
+
+
+def test_preprocess_threads_match_serial(tmp_path):
+    """preprocess_threads (the ImageRecordIter OMP-decode analog,
+    iter_image_recordio_2.cc:139-145) yields the same batches as the
+    serial path for deterministic augmenters."""
+    rec, idx = _write_rec(tmp_path, n=24, size=20)
+    kw = dict(batch_size=8, data_shape=(3, 16, 16), path_imgrec=rec,
+              path_imgidx=idx, shuffle=False)
+    serial = mx.image.ImageIter(**kw)
+    threaded = mx.image.ImageIter(preprocess_threads=4, **kw)
+    for bs, bt in zip(serial, threaded):
+        np.testing.assert_allclose(bs.data[0].asnumpy(),
+                                   bt.data[0].asnumpy())
+        np.testing.assert_allclose(bs.label[0].asnumpy(),
+                                   bt.label[0].asnumpy())
+        assert bs.pad == bt.pad

@@ -59,19 +59,29 @@ def main():
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--train-rate", type=float, default=2730.0,
                     help="chip's measured ResNet-50 train img/s")
+    ap.add_argument("--tpu", action="store_true",
+                    help="keep the ambient accelerator backend")
     args = ap.parse_args()
 
     with tempfile.TemporaryDirectory(prefix="iprec_") as tmp:
         rec, idx = build_rec(os.path.join(tmp, "bench"), args.n, args.size)
 
+        threads = os.cpu_count() or 8
         configs = {
             "decode_only": dict(),
             "decode_augment": dict(rand_crop=True, rand_mirror=True),
             "decode_augment_color": dict(rand_crop=True, rand_mirror=True,
                                          brightness=0.2, contrast=0.2,
                                          saturation=0.2),
+            "decode_augment_mt": dict(rand_crop=True, rand_mirror=True,
+                                      preprocess_threads=threads),
+            "decode_augment_color_mt": dict(rand_crop=True, rand_mirror=True,
+                                            brightness=0.2, contrast=0.2,
+                                            saturation=0.2,
+                                            preprocess_threads=threads),
         }
         out = {"image_size": args.size, "n_images": args.n,
+               "cpu_cores": os.cpu_count(),
                "train_rate_img_s": args.train_rate, "rates": {}}
         for name, kw in configs.items():
             it = mx.image.ImageIter(batch_size=args.batch_size,
@@ -82,7 +92,8 @@ def main():
             out["rates"][name] = round(rate, 1)
             print("[input-pipeline] %-22s %8.1f img/s  (%.2fx train rate)"
                   % (name, rate, rate / args.train_rate), file=sys.stderr)
-        out["feeds_chip"] = out["rates"]["decode_augment"] >= args.train_rate
+        out["feeds_chip"] = (out["rates"]["decode_augment_mt"]
+                     >= args.train_rate)
         print(json.dumps(out))
 
 
