@@ -196,13 +196,35 @@ class Augmenter(object):
         raise NotImplementedError
 
 
+def _aug_class(name, fields, call, doc=""):
+    """Build a simple Augmenter subclass: stores ``fields`` (name or
+    (name, default) pairs) and runs ``call(self, src)``."""
+    specs = [(f, None) if isinstance(f, str) else f for f in fields]
+
+    def __init__(self, *args, **kwargs):
+        bound = {}
+        for (fname, default), value in zip(specs, args):
+            bound[fname] = value
+        for fname, default in specs[len(args):]:
+            bound[fname] = kwargs.pop(fname, default)
+        Augmenter.__init__(self, **dict(bound))
+        for fname, value in bound.items():
+            setattr(self, fname, value)
+
+    cls = type(name, (Augmenter,), {"__init__": __init__,
+                                    "__call__": call, "__doc__": doc})
+    return cls
+
+
 class SequentialAug(Augmenter):
+    """Run sub-augmenters in order."""
+
     def __init__(self, ts):
         super().__init__()
         self.ts = ts
 
     def dumps(self):
-        return [self.__class__.__name__.lower(), [t.dumps() for t in self.ts]]
+        return [type(self).__name__.lower(), [t.dumps() for t in self.ts]]
 
     def __call__(self, src):
         for t in self.ts:
@@ -210,73 +232,8 @@ class SequentialAug(Augmenter):
         return src
 
 
-class ResizeAug(Augmenter):
-    """Short-edge resize (reference: image.py:531)."""
-
-    def __init__(self, size, interp=2):
-        super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
-
-    def __call__(self, src):
-        return resize_short(src, self.size, self.interp)
-
-
-class ForceResizeAug(Augmenter):
-    """Exact-size resize ignoring aspect (reference: image.py:551)."""
-
-    def __init__(self, size, interp=2):
-        super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
-
-    def __call__(self, src):
-        return imresize(src, self.size[0], self.size[1], self.interp)
-
-
-class RandomCropAug(Augmenter):
-    def __init__(self, size, interp=2):
-        super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
-
-    def __call__(self, src):
-        return random_crop(src, self.size, self.interp)[0]
-
-
-class RandomSizedCropAug(Augmenter):
-    def __init__(self, size, min_area, ratio, interp=2):
-        super().__init__(size=size, min_area=min_area, ratio=ratio,
-                         interp=interp)
-        self.size = size
-        self.min_area = min_area
-        self.ratio = ratio
-        self.interp = interp
-
-    def __call__(self, src):
-        return random_size_crop(src, self.size, self.min_area, self.ratio,
-                                self.interp)[0]
-
-
-class CenterCropAug(Augmenter):
-    def __init__(self, size, interp=2):
-        super().__init__(size=size, interp=interp)
-        self.size = size
-        self.interp = interp
-
-    def __call__(self, src):
-        return center_crop(src, self.size, self.interp)[0]
-
-
-class RandomOrderAug(Augmenter):
-    """Apply sub-augmenters in random order (reference: image.py:639)."""
-
-    def __init__(self, ts):
-        super().__init__()
-        self.ts = ts
-
-    def dumps(self):
-        return [self.__class__.__name__.lower(), [t.dumps() for t in self.ts]]
+class RandomOrderAug(SequentialAug):
+    """Run sub-augmenters in a fresh random order each call."""
 
     def __call__(self, src):
         order = list(self.ts)
@@ -286,46 +243,71 @@ class RandomOrderAug(Augmenter):
         return src
 
 
-class BrightnessJitterAug(Augmenter):
-    def __init__(self, brightness):
-        super().__init__(brightness=brightness)
-        self.brightness = brightness
+ResizeAug = _aug_class(
+    "ResizeAug", ["size", ("interp", 2)],
+    lambda self, src: resize_short(src, self.size, self.interp),
+    doc="Short-edge resize.")
 
-    def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
-        return np.asarray(src, np.float32) * alpha
+ForceResizeAug = _aug_class(
+    "ForceResizeAug", ["size", ("interp", 2)],
+    lambda self, src: imresize(src, self.size[0], self.size[1], self.interp),
+    doc="Exact-size resize ignoring aspect ratio.")
+
+RandomCropAug = _aug_class(
+    "RandomCropAug", ["size", ("interp", 2)],
+    lambda self, src: random_crop(src, self.size, self.interp)[0],
+    doc="Uniform random crop.")
+
+RandomSizedCropAug = _aug_class(
+    "RandomSizedCropAug", ["size", "min_area", "ratio", ("interp", 2)],
+    lambda self, src: random_size_crop(src, self.size, self.min_area,
+                                       self.ratio, self.interp)[0],
+    doc="Inception-style random area+aspect crop.")
+
+CenterCropAug = _aug_class(
+    "CenterCropAug", ["size", ("interp", 2)],
+    lambda self, src: center_crop(src, self.size, self.interp)[0],
+    doc="Center crop.")
 
 
-class ContrastJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
-
-    def __init__(self, contrast):
-        super().__init__(contrast=contrast)
-        self.contrast = contrast
-
-    def __call__(self, src):
-        src = np.asarray(src, np.float32)
-        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
-        gray = (src * self._coef).sum(axis=2, keepdims=True)
-        return src * alpha + gray.mean() * (1.0 - alpha)
+_LUMA = np.array([[[0.299, 0.587, 0.114]]], np.float32)
 
 
-class SaturationJitterAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+def _luma(img):
+    """Per-pixel luminance, keepdims."""
+    return (img * _LUMA).sum(axis=2, keepdims=True)
 
-    def __init__(self, saturation):
-        super().__init__(saturation=saturation)
-        self.saturation = saturation
 
-    def __call__(self, src):
-        src = np.asarray(src, np.float32)
-        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
-        gray = (src * self._coef).sum(axis=2, keepdims=True)
-        return src * alpha + gray * (1.0 - alpha)
+def _jitter(limit):
+    return 1.0 + pyrandom.uniform(-limit, limit)
+
+
+def _brightness_call(self, src):
+    return np.asarray(src, np.float32) * _jitter(self.brightness)
+
+
+def _contrast_call(self, src):
+    src = np.asarray(src, np.float32)
+    alpha = _jitter(self.contrast)
+    return src * alpha + _luma(src).mean() * (1.0 - alpha)
+
+
+def _saturation_call(self, src):
+    src = np.asarray(src, np.float32)
+    alpha = _jitter(self.saturation)
+    return src * alpha + _luma(src) * (1.0 - alpha)
+
+
+BrightnessJitterAug = _aug_class("BrightnessJitterAug", ["brightness"],
+                                 _brightness_call)
+ContrastJitterAug = _aug_class("ContrastJitterAug", ["contrast"],
+                               _contrast_call)
+SaturationJitterAug = _aug_class("SaturationJitterAug", ["saturation"],
+                                 _saturation_call)
 
 
 class HueJitterAug(Augmenter):
-    """Hue rotation in YIQ space (reference: image.py:729)."""
+    """Hue rotation in YIQ space."""
 
     _yiq = np.array([[0.299, 0.587, 0.114],
                      [0.596, -0.274, -0.321],
@@ -340,28 +322,26 @@ class HueJitterAug(Augmenter):
 
     def __call__(self, src):
         src = np.asarray(src, np.float32)
-        alpha = pyrandom.uniform(-self.hue, self.hue)
-        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
-        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
-                      np.float32)
-        t = self._yiq_inv @ bt @ self._yiq
-        return src @ t.T
+        theta = pyrandom.uniform(-self.hue, self.hue) * np.pi
+        u, w = np.cos(theta), np.sin(theta)
+        rot = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       np.float32)
+        return src @ (self._yiq_inv @ rot @ self._yiq).T
 
 
 class ColorJitterAug(RandomOrderAug):
+    """Brightness/contrast/saturation jitters in random order."""
+
     def __init__(self, brightness, contrast, saturation):
-        ts = []
-        if brightness > 0:
-            ts.append(BrightnessJitterAug(brightness))
-        if contrast > 0:
-            ts.append(ContrastJitterAug(contrast))
-        if saturation > 0:
-            ts.append(SaturationJitterAug(saturation))
-        super().__init__(ts)
+        parts = [cls(v) for cls, v in
+                 ((BrightnessJitterAug, brightness),
+                  (ContrastJitterAug, contrast),
+                  (SaturationJitterAug, saturation)) if v > 0]
+        super().__init__(parts)
 
 
 class LightingAug(Augmenter):
-    """PCA lighting noise (reference: image.py:786)."""
+    """PCA lighting noise (AlexNet-style)."""
 
     def __init__(self, alphastd, eigval, eigvec):
         super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
@@ -371,103 +351,96 @@ class LightingAug(Augmenter):
 
     def __call__(self, src):
         alpha = np.random.normal(0, self.alphastd, size=(3,))
-        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
-        return np.asarray(src, np.float32) + rgb
+        shift = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return np.asarray(src, np.float32) + shift
 
 
-class ColorNormalizeAug(Augmenter):
-    def __init__(self, mean, std):
-        super().__init__(mean=mean, std=std)
-        self.mean = None if mean is None else np.asarray(mean, np.float32)
-        self.std = None if std is None else np.asarray(std, np.float32)
-
-    def __call__(self, src):
-        return color_normalize(src, self.mean, self.std)
+def _normalize_call(self, src):
+    return color_normalize(src,
+                           None if self.mean is None
+                           else np.asarray(self.mean, np.float32),
+                           None if self.std is None
+                           else np.asarray(self.std, np.float32))
 
 
-class RandomGrayAug(Augmenter):
-    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
-
-    def __init__(self, p):
-        super().__init__(p=p)
-        self.p = p
-
-    def __call__(self, src):
-        if pyrandom.random() < self.p:
-            src = np.broadcast_to(
-                (np.asarray(src, np.float32) * self._coef).sum(
-                    axis=2, keepdims=True), src.shape)
-        return src
+def _gray_call(self, src):
+    if pyrandom.random() < self.p:
+        src = np.broadcast_to(_luma(np.asarray(src, np.float32)), src.shape)
+    return src
 
 
-class HorizontalFlipAug(Augmenter):
-    def __init__(self, p):
-        super().__init__(p=p)
-        self.p = p
-
-    def __call__(self, src):
-        if pyrandom.random() < self.p:
-            src = np.asarray(src)[:, ::-1]
-        return src
+def _flip_call(self, src):
+    return np.asarray(src)[:, ::-1] if pyrandom.random() < self.p else src
 
 
-class CastAug(Augmenter):
-    def __init__(self, typ="float32"):
-        super().__init__(type=typ)
-        self.typ = typ
+def _cast_call(self, src):
+    return np.asarray(src, dtype=self.typ)
 
-    def __call__(self, src):
-        return np.asarray(src, dtype=self.typ)
+
+ColorNormalizeAug = _aug_class("ColorNormalizeAug", ["mean", "std"],
+                               _normalize_call)
+RandomGrayAug = _aug_class("RandomGrayAug", ["p"], _gray_call)
+HorizontalFlipAug = _aug_class("HorizontalFlipAug", ["p"], _flip_call)
+CastAug = _aug_class("CastAug", [("typ", "float32")], _cast_call)
+
+
+def _imagenet_stat(value, default):
+    """Resolve mean/std flags: True -> ImageNet constants, arrays pass
+    through validated."""
+    if value is True:
+        return np.array(default)
+    if value is None:
+        return None
+    value = np.asarray(value)
+    if value.shape[0] not in (1, 3):
+        raise AssertionError("mean/std must have 1 or 3 channels")
+    return value
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
                     inter_method=2):
-    """Build the standard augmenter list (reference: image.py:885) —
-    resize → crop → mirror → cast → color jitter → lighting → gray →
-    normalize, same ordering and defaults."""
-    auglist = []
+    """Standard classification augmentation chain: resize → crop → mirror
+    → cast → color jitter → hue → lighting → gray → normalize (the
+    reference's ordering and defaults, image.py:885)."""
+    chain = []
     if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
+        chain.append(ResizeAug(resize, inter_method))
+
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
-        assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.08,
-                                          (3.0 / 4.0, 4.0 / 3.0),
-                                          inter_method))
-    elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        if not rand_crop:
+            raise AssertionError("rand_resize requires rand_crop")
+        chain.append(RandomSizedCropAug(crop_size, 0.08,
+                                        (3.0 / 4.0, 4.0 / 3.0),
+                                        inter_method))
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
+        crop_cls = RandomCropAug if rand_crop else CenterCropAug
+        chain.append(crop_cls(crop_size, inter_method))
+
     if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
+        chain.append(HorizontalFlipAug(0.5))
+    chain.append(CastAug())
     if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+        chain.append(ColorJitterAug(brightness, contrast, saturation))
     if hue:
-        auglist.append(HueJitterAug(hue))
+        chain.append(HueJitterAug(hue))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        chain.append(LightingAug(
+            pca_noise,
+            np.array([55.46, 4.794, 1.148]),
+            np.array([[-0.5675, 0.7192, 0.4009],
+                      [-0.5808, -0.0045, -0.8140],
+                      [-0.5836, -0.6948, 0.4203]])))
     if rand_gray > 0:
-        auglist.append(RandomGrayAug(rand_gray))
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
-    elif mean is not None:
-        mean = np.asarray(mean)
-        assert mean.shape[0] in (1, 3)
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375])
-    elif std is not None:
-        std = np.asarray(std)
-        assert std.shape[0] in (1, 3)
+        chain.append(RandomGrayAug(rand_gray))
+
+    mean = _imagenet_stat(mean, [123.68, 116.28, 103.53])
+    std = _imagenet_stat(std, [58.395, 57.12, 57.375])
     if mean is not None or std is not None:
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        chain.append(ColorNormalizeAug(mean, std))
+    return chain
 
 
 class ImageIter(_io.DataIter):
