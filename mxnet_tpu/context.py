@@ -40,30 +40,27 @@ class Context:
     def device_type(self):
         return Context.devtype2str[self.device_typeid]
 
+    @property
+    def _key(self):
+        return (self.device_typeid, self.device_id)
+
     def __hash__(self):
-        return hash((self.device_typeid, self.device_id))
+        return hash(self._key)
 
     def __eq__(self, other):
-        return (
-            isinstance(other, Context)
-            and self.device_typeid == other.device_typeid
-            and self.device_id == other.device_id
-        )
+        return isinstance(other, Context) and self._key == other._key
 
     def __str__(self):
         return "%s(%d)" % (self.device_type, self.device_id)
 
-    def __repr__(self):
-        return self.__str__()
+    __repr__ = __str__
 
     def __enter__(self):
-        if not hasattr(_thread_state, "ctx_stack"):
-            _thread_state.ctx_stack = []
-        _thread_state.ctx_stack.append(self)
+        _ctx_stack().append(self)
         return self
 
     def __exit__(self, *exc):
-        _thread_state.ctx_stack.pop()
+        _ctx_stack().pop()
 
     # --- JAX resolution -------------------------------------------------
     def jax_device(self):
@@ -131,9 +128,13 @@ def num_gpus():
     )
 
 
+def _ctx_stack():
+    if not hasattr(_thread_state, "ctx_stack"):
+        _thread_state.ctx_stack = []
+    return _thread_state.ctx_stack
+
+
 def current_context():
-    """Default context (reference: python/mxnet/context.py:216)."""
-    stack = getattr(_thread_state, "ctx_stack", None)
-    if stack:
-        return stack[-1]
-    return Context("cpu", 0)
+    """The innermost ``with Context`` scope, else cpu(0)."""
+    stack = _ctx_stack()
+    return stack[-1] if stack else Context("cpu", 0)
