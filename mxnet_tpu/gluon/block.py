@@ -58,14 +58,15 @@ class _BlockScope:
                 params = ParameterDict(params.prefix, params)
             return prefix, params
         if prefix is None:
-            count = current._counter.get(hint, 0)
-            current._counter[hint] = count + 1
-            prefix = "%s%d_" % (hint, count)
-        if params is None:
-            parent = current._block.params
-            params = ParameterDict(parent.prefix + prefix, parent._shared)
-        else:
+            ordinal = current._counter.get(hint, 0)
+            current._counter[hint] = ordinal + 1
+            prefix = "%s%d_" % (hint, ordinal)
+        if params is not None:
             params = ParameterDict(params.prefix, params)
+        else:
+            enclosing = current._block.params
+            params = ParameterDict(enclosing.prefix + prefix,
+                                   enclosing._shared)
         return current._block.prefix + prefix, params
 
     def __enter__(self):
@@ -95,36 +96,32 @@ class Block:
         self._reg_params = {}
 
     def __repr__(self):
-        s = "{name}(\n{modstr}\n)"
-        modstr = "\n".join(
-            ["  ({key}): {block}".format(
-                key=key, block=repr(block).replace("\n", "\n  "))
-             for key, block in self.__dict__.items()
-             if isinstance(block, Block)])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
+        body = "\n".join(
+            "  (%s): %s" % (attr, repr(child).replace("\n", "\n  "))
+            for attr, child in self.__dict__.items()
+            if isinstance(child, Block))
+        return "%s(\n%s\n)" % (type(self).__name__, body)
 
     def __setattr__(self, name, value):
         """Register parameters and children blocks."""
-        if hasattr(self, name):
-            existing = getattr(self, name)
-            if isinstance(existing, (Parameter, Block)) and \
-                    not isinstance(value, type(existing)):
-                raise TypeError("Changing attribute type for {name} from "
-                                "{type1} to {type2} is not allowed.".format(
-                                    name=name, type1=type(existing),
-                                    type2=type(value)))
-            if isinstance(existing, Block):
-                for i, c in enumerate(self._children):
-                    if c is existing:
-                        self._children[i] = value
-            elif isinstance(value, Block):
-                self.register_child(value)
+        existing = getattr(self, name, None)
+        if isinstance(existing, (Parameter, Block)) and \
+                not isinstance(value, type(existing)):
+            raise TypeError(
+                "Changing attribute type for %s from %s to %s is not "
+                "allowed." % (name, type(existing), type(value)))
+        if isinstance(existing, Block):
+            # in-place replacement keeps the child's position
+            self._children = [value if c is existing else c
+                              for c in self._children]
         elif isinstance(value, Block):
             self.register_child(value)
         if isinstance(value, Parameter):
-            assert name not in self._reg_params or \
-                self._reg_params[name] is value, \
-                "Overriding Parameter attribute %s is not allowed." % name
+            if name in self._reg_params and \
+                    self._reg_params[name] is not value:
+                raise AssertionError(
+                    "Overriding Parameter attribute %s is not allowed."
+                    % name)
             self._reg_params[name] = value
         super().__setattr__(name, value)
 
@@ -163,14 +160,16 @@ class Block:
         return ret
 
     def save_params(self, filename):
-        """(reference: block.py:239)"""
+        """Write all parameters with this block's prefix stripped."""
         self.collect_params().save(filename, strip_prefix=self.prefix)
 
     def load_params(self, filename, ctx=None, allow_missing=False,
                     ignore_extra=False):
-        """(reference: block.py:load_params)"""
-        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
-                                   self.prefix)
+        """Inverse of save_params (restores this block's prefix)."""
+        self.collect_params().load(filename, ctx,
+                                   allow_missing=allow_missing,
+                                   ignore_extra=ignore_extra,
+                                   restore_prefix=self.prefix)
 
     def register_child(self, block):
         """(reference: block.py:register_child)"""
@@ -185,15 +184,15 @@ class Block:
                                          force_reinit)
 
     def hybridize(self, active=True):
-        """(reference: block.py:hybridize)"""
-        for cld in self._children:
-            cld.hybridize(active)
+        """Recursively switch children to cached-graph execution."""
+        for child in self._children:
+            child.hybridize(active)
 
     def cast(self, dtype):
-        """(reference: block.py:cast)"""
+        """Recursively cast parameters (children first)."""
         for child in self._children:
             child.cast(dtype)
-        for _, param in self.params.items():
+        for param in self.params.values():
             param.cast(dtype)
 
     def __call__(self, *args):
