@@ -46,14 +46,20 @@ def create(name, **kwargs):
 
 
 class InitDesc(str):
-    """Name + attrs descriptor passed to initializers
-    (reference: initializer.py:InitDesc)."""
+    """A parameter name that carries its symbol attrs and the enclosing
+    global initializer (so composite inits can delegate)."""
 
     def __new__(cls, name, attrs=None, global_init=None):
-        ret = super().__new__(cls, name)
-        ret.attrs = attrs or {}
-        ret.global_init = global_init
-        return ret
+        desc = str.__new__(cls, name)
+        desc.attrs = attrs or {}
+        desc.global_init = global_init
+        return desc
+
+
+def _ctor_kwargs(local_vars):
+    """Everything from a ctor's locals() except self (for dumps)."""
+    return {k: v for k, v in local_vars.items()
+            if k not in ("self", "__class__")}
 
 
 class Initializer:
@@ -62,8 +68,8 @@ class Initializer:
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
-        self._verbose = False
         self._print_func = None
+        self._verbose = False
 
     def set_verbosity(self, verbose=False, print_func=None):
         self._verbose = verbose
@@ -201,7 +207,7 @@ class Orthogonal(Initializer):
     """Orthogonal matrix init (reference: initializer.py:Orthogonal)."""
 
     def __init__(self, scale=1.414, rand_type="uniform"):
-        super().__init__(scale=scale, rand_type=rand_type)
+        super().__init__(**_ctor_kwargs(locals()))
         self.scale = scale
         self.rand_type = rand_type
 
@@ -222,8 +228,7 @@ class Xavier(Initializer):
     """Xavier/Glorot (reference: initializer.py:Xavier)."""
 
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
-        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
-                         magnitude=magnitude)
+        super().__init__(**_ctor_kwargs(locals()))
         self.rnd_type = rnd_type
         self.factor_type = factor_type
         self.magnitude = float(magnitude)
@@ -297,10 +302,11 @@ class FusedRNN(Initializer):
         if isinstance(init, str):
             klass, kwargs = json.loads(init)
             init = create(klass, **kwargs)
-        super().__init__(init=init.dumps() if init is not None else None,
-                         num_hidden=num_hidden, num_layers=num_layers,
-                         mode=mode, bidirectional=bidirectional,
-                         forget_bias=forget_bias)
+        spec = _ctor_kwargs(locals())
+        spec.pop("klass", None)
+        spec.pop("kwargs", None)
+        spec["init"] = init.dumps() if init is not None else None
+        super().__init__(**spec)
         self._init = init
         self._num_hidden = num_hidden
         self._num_layers = num_layers
