@@ -37,7 +37,7 @@ class Trainer:
     """Pushes gradients and pulls (or locally updates) weights each step."""
 
     def __init__(self, params, optimizer, optimizer_params=None,
-                 kvstore="device", compression_params=None):
+                 kvstore="device", compression_params=None, fuse_step=True):
         self._params = _as_param_list(params)
         self._compression_params = compression_params
         optimizer_params = dict(optimizer_params or {})
@@ -48,6 +48,11 @@ class Trainer:
                           for _ in self._contexts]
         self._kv_initialized = False
         self._kvstore = kvstore
+        # fused local update: ALL parameter updates as ONE compiled XLA
+        # program (the TPU answer to the reference's update aggregation,
+        # model.py MXNET_UPDATE_AGGREGATION_SIZE / engine bulk mode)
+        self._fuse_step = fuse_step
+        self._fused = None  # (signature, jitted fn)
 
     def _common_contexts(self):
         """All parameters must live on one identical context list."""
@@ -138,6 +143,10 @@ class Trainer:
             if self._server_side_optimizer():
                 self._reship_optimizer()
 
+        if self._kvstore is None and self._can_fuse():
+            self._fused_local_step()
+            return
+
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -150,6 +159,92 @@ class Trainer:
             for updater, weight, grad in zip(self._updaters, p.list_data(),
                                              p.list_grad()):
                 updater(i, grad, weight)
+
+    # ------------------------------------------------------ fused updates
+    def _can_fuse(self):
+        """Fusing bakes hyperparameters into one compiled program, so it
+        requires a step-index-free optimizer: no lr scheduler (lr would
+        freeze) and no per-step bias correction (Adam's t)."""
+        o = self._optimizer
+        return (self._fuse_step and len(self._contexts) == 1
+                and type(o).__name__ in ("SGD", "NAG")
+                and o.lr_scheduler is None
+                and not getattr(o, "multi_precision", False))
+
+    def _live_params(self):
+        return [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+
+    def _fused_signature(self):
+        o = self._optimizer
+        return (tuple((p.shape, str(p.dtype)) for _i, p in
+                      self._live_params()),
+                o.lr, o.wd, getattr(o, "momentum", 0.0), o.rescale_grad,
+                o.clip_gradient)
+
+    def _build_fused(self):
+        """One jitted function applying the optimizer to every parameter;
+        traces the ordinary Updater over NDArray-wrapped tracers, so ANY
+        eligible optimizer fuses without a parallel implementation."""
+        import jax
+
+        from ..ndarray.ndarray import _from_data
+
+        live = self._live_params()
+        updater = self._updaters[0]
+        # materialize states eagerly so save/load_states keep working
+        for i, p in live:
+            if i not in updater.states:
+                updater.states[i] = self._optimizer.create_state(
+                    i, p.list_data()[0])
+                updater.states_synced[i] = True
+
+        opt_ref = self._optimizer
+
+        def run(w_datas, g_datas, s_datas):
+            fresh = opt.get_updater(opt_ref)
+            new_w, new_s = [], []
+            for (i, _p), wd, gd, sd in zip(live, w_datas, g_datas, s_datas):
+                w = _from_data(wd)
+                g = _from_data(gd)
+                state = None if sd is None else _from_data(sd)
+                fresh.states[i] = state
+                fresh.states_synced[i] = True
+                opt_ref.update(i, w, g, state)
+                new_w.append(w._data)
+                new_s.append(None if state is None else state._data)
+            return new_w, new_s
+
+        return jax.jit(run, donate_argnums=(0, 2))
+
+    def _fused_local_step(self):
+        from ..ndarray.ndarray import NDArray
+
+        sig = self._fused_signature()
+        if self._fused is None or self._fused[0] != sig:
+            self._fused = (sig, self._build_fused())
+        fn = self._fused[1]
+        live = self._live_params()
+        updater = self._updaters[0]
+
+        # loaded checkpoints hold host-side numpy until first use; the
+        # eager path syncs lazily per call, do the same here
+        for i, p in live:
+            if not updater.states_synced.get(i, True):
+                updater.states[i] = updater.sync_state_context(
+                    updater.states[i], p.list_data()[0].context)
+                updater.states_synced[i] = True
+
+        w_datas = [p.list_data()[0]._data for _i, p in live]
+        g_datas = [p.list_grad()[0]._data for _i, p in live]
+        s_datas = [updater.states[i]._data
+                   if isinstance(updater.states[i], NDArray) else None
+                   for i, _p in live]
+        new_w, new_s = fn(w_datas, g_datas, s_datas)
+        for (i, p), wd, sd in zip(live, new_w, new_s):
+            p.list_data()[0]._set_data(wd)
+            if sd is not None:
+                updater.states[i]._set_data(sd)
 
     def save_states(self, fname):
         """Persist optimizer state (server-side when update_on_kvstore)."""

@@ -261,6 +261,12 @@ def _jitted(opdef, attrs, is_train, n_in, n_aux):
 
 
 def _is_single_device(x):
+    import jax.core
+
+    if isinstance(x, jax.core.Tracer):
+        # under an outer jit trace (fused train/update steps) there is no
+        # device to normalize; placement is the outer program's concern
+        return False
     get = getattr(x, "devices", None)
     return get is not None and len(get()) == 1
 
