@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Sparse operator micro-benchmarks.
+
+Reference workflow: benchmark/python/sparse/{dot,sparse_op,cast_storage}.py
+— measure csr·dense dot, sparse elementwise, and storage casts across
+densities. One JSON line per config.
+
+Usage: python benchmark/sparse_bench.py [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+if "--cpu" in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.ndarray import sparse as sp  # noqa: E402
+
+
+def _rand_csr(rng, shape, density):
+    mask = rng.rand(*shape) < density
+    data = (rng.randn(*shape) * mask).astype(np.float32)
+    return sp.csr_matrix(data), data
+
+
+def _rand_rsp(rng, shape, density):
+    nrows = max(1, int(shape[0] * density))
+    rows = np.sort(rng.choice(shape[0], nrows, replace=False))
+    vals = rng.randn(nrows, *shape[1:]).astype(np.float32)
+    dense = np.zeros(shape, np.float32)
+    dense[rows] = vals
+    return sp.row_sparse_array((rows, vals), shape=shape), dense
+
+
+def _timeit(fn, n=20):
+    out = fn()
+    out.asnumpy() if hasattr(out, "asnumpy") else out
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    out.asnumpy() if hasattr(out, "asnumpy") else out
+    return (time.perf_counter() - t0) / n
+
+
+def bench_dot(rng, m=2048, k=4096, n=512):
+    rows = []
+    rhs = mx.nd.array(rng.randn(k, n).astype(np.float32))
+    for density in (0.01, 0.05, 0.2):
+        csr, dense = _rand_csr(rng, (m, k), density)
+        dt_sparse = _timeit(lambda: sp.dot(csr, rhs))
+        dnd = mx.nd.array(dense)
+        dt_dense = _timeit(lambda: mx.nd.dot(dnd, rhs))
+        rows.append({"bench": "csr_dot", "shape": [m, k, n],
+                     "density": density,
+                     "sparse_ms": round(dt_sparse * 1e3, 3),
+                     "dense_ms": round(dt_dense * 1e3, 3)})
+    return rows
+
+
+def bench_cast_storage(rng, shape=(4096, 1024)):
+    rows = []
+    for density in (0.01, 0.1):
+        _, dense = _rand_csr(rng, shape, density)
+        dnd = mx.nd.array(dense)
+        for stype in ("csr", "row_sparse"):
+            dt = _timeit(lambda: mx.nd.cast_storage(dnd, stype=stype), n=10)
+            rows.append({"bench": "cast_storage", "stype": stype,
+                         "density": density, "ms": round(dt * 1e3, 3)})
+    return rows
+
+
+def bench_sparse_elemwise(rng, shape=(8192, 512)):
+    rows = []
+    for density in (0.01, 0.1):
+        a, _ = _rand_rsp(rng, shape, density)
+        b, _ = _rand_rsp(rng, shape, density)
+        dt = _timeit(lambda: sp.rsp_add(a, b), n=10)
+        rows.append({"bench": "rsp_add", "density": density,
+                     "ms": round(dt * 1e3, 3)})
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.parse_args()
+    rng = np.random.RandomState(0)
+    results = bench_dot(rng) + bench_cast_storage(rng) \
+        + bench_sparse_elemwise(rng)
+    for row in results:
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
