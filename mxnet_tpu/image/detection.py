@@ -415,13 +415,14 @@ class ImageDetIter(ImageIter):
                  path_imglist=None, path_root=None, path_imgidx=None,
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="label",
-                 **kwargs):
+                 preprocess_threads=0, **kwargs):
         super().__init__(batch_size=batch_size, data_shape=data_shape,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, path_imgidx=path_imgidx,
                          shuffle=shuffle, part_index=part_index,
                          num_parts=num_parts, aug_list=[], imglist=imglist,
-                         label_width=1)
+                         label_width=1,
+                         preprocess_threads=preprocess_threads)
         self._data_name = data_name
         self._label_name = label_name
         self.auglist = (CreateDetAugmenter(data_shape, **kwargs)
@@ -509,6 +510,25 @@ class ImageDetIter(ImageIter):
             data, label = aug(data, label)
         return data, label
 
+    def _prepare_det(self, row, raw_label, payload, kind, images, labels):
+        """Decode + augment one sample into row ``row``; returns False when
+        the sample is invalid and the row must be refilled."""
+        img = self._decode_raw(payload, kind)
+        try:
+            self.check_valid_image([img])
+            objects = self._parse_label(raw_label)
+            img, objects = self.augmentation_transform(img, objects)
+            self._check_valid_label(objects)
+        except RuntimeError as err:
+            logging.debug("Invalid image, skipping: %s", str(err))
+            return False
+        if img.ndim == 2:
+            img = img[:, :, None]
+        images[row] = img
+        count = min(objects.shape[0], self.label_shape[0])
+        labels[row, :count] = objects[:count]
+        return True
+
     def next(self):
         c, h, w = self.data_shape
         images = np.zeros((self.batch_size, h, w, c), np.float32)
@@ -516,22 +536,48 @@ class ImageDetIter(ImageIter):
                          np.float32)
         filled = 0
         try:
-            while filled < self.batch_size:
-                raw, img = self.next_sample()
-                try:
-                    self.check_valid_image([img])
-                    objects = self._parse_label(raw)
-                    img, objects = self.augmentation_transform(img, objects)
-                    self._check_valid_label(objects)
-                except RuntimeError as err:
-                    logging.debug("Invalid image, skipping: %s", str(err))
-                    continue
-                if img.ndim == 2:
-                    img = img[:, :, None]
-                images[filled] = img
-                count = min(objects.shape[0], self.label_shape[0])
-                labels[filled, :count] = objects[:count]
-                filled += 1
+            if self._pool is not None:
+                while filled < self.batch_size:
+                    want = self.batch_size - filled
+                    raws = []
+                    try:
+                        while len(raws) < want:
+                            raws.append(self._next_raw())
+                    except StopIteration:
+                        if not raws:
+                            raise
+                    futures = [
+                        self._pool.submit(self._prepare_det, filled + j,
+                                          lab, payload, kind, images, labels)
+                        for j, (lab, payload, kind) in enumerate(raws)]
+                    ok = [f.result() for f in futures]
+                    # compact rejected rows so the batch stays contiguous
+                    good = [filled + j for j, o in enumerate(ok) if o]
+                    for dst, src in enumerate(good, start=filled):
+                        if dst != src:
+                            images[dst] = images[src]
+                            labels[dst] = labels[src]
+                    filled += len(good)
+                    if len(raws) < want:
+                        raise StopIteration
+            else:
+                while filled < self.batch_size:
+                    raw, img = self.next_sample()
+                    try:
+                        self.check_valid_image([img])
+                        objects = self._parse_label(raw)
+                        img, objects = self.augmentation_transform(img,
+                                                                   objects)
+                        self._check_valid_label(objects)
+                    except RuntimeError as err:
+                        logging.debug("Invalid image, skipping: %s", str(err))
+                        continue
+                    if img.ndim == 2:
+                        img = img[:, :, None]
+                    images[filled] = img
+                    count = min(objects.shape[0], self.label_shape[0])
+                    labels[filled, :count] = objects[:count]
+                    filled += 1
         except StopIteration:
             if not filled:
                 raise

@@ -184,3 +184,18 @@ def test_sync_label_shape(tmp_path):
     b.reshape(label_shape=(a.label_shape[0] + 3, 6))
     unified = a.sync_label_shape(b)
     assert a.label_shape == b.label_shape == unified
+
+
+def test_det_iter_preprocess_threads(tmp_path):
+    """Parallel decode path yields the same batches as serial for
+    deterministic settings."""
+    rec, idx, _ = _write_det_rec(tmp_path, n=10)
+    kw = dict(batch_size=4, data_shape=(3, 32, 32), path_imgrec=rec,
+              path_imgidx=idx, shuffle=False)
+    a = det.ImageDetIter(**kw)
+    b = det.ImageDetIter(preprocess_threads=4, **kw)
+    for ba, bb in zip(a, b):
+        np.testing.assert_allclose(ba.data[0].asnumpy(),
+                                   bb.data[0].asnumpy())
+        np.testing.assert_allclose(ba.label[0].asnumpy(),
+                                   bb.label[0].asnumpy())
