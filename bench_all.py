@@ -154,8 +154,11 @@ def bench_gluon_resnet():
     loss.asnumpy()
     dt = time.perf_counter() - t0
     return {"value": round(bs * steps / dt, 1), "unit": "images/sec",
-            "protocol": "hybridized resnet18_v1 bs%d %dx%d autograd step"
-                        % (bs, size, size)}
+            "protocol": ("hybridized resnet18_v1 bs%d %dx%d autograd step, "
+                         "fused local update" % (bs, size, size)),
+            "note": ("eager-path dispatches ride the remote tunnel in this "
+                     "environment; on a local TPU host per-dispatch cost "
+                     "is microseconds")}
 
 
 def bench_lstm_ptb():
