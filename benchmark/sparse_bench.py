@@ -40,7 +40,7 @@ def _rand_rsp(rng, shape, density):
     vals = rng.randn(nrows, *shape[1:]).astype(np.float32)
     dense = np.zeros(shape, np.float32)
     dense[rows] = vals
-    return sp.row_sparse_array((rows, vals), shape=shape), dense
+    return sp.row_sparse_array((vals, rows), shape=shape), dense
 
 
 def _timeit(fn, n=20):
@@ -53,7 +53,7 @@ def _timeit(fn, n=20):
     return (time.perf_counter() - t0) / n
 
 
-def bench_dot(rng, m=2048, k=4096, n=512):
+def bench_dot(rng, m=1024, k=2048, n=256):
     rows = []
     rhs = mx.nd.array(rng.randn(k, n).astype(np.float32))
     for density in (0.01, 0.05, 0.2):
@@ -68,7 +68,7 @@ def bench_dot(rng, m=2048, k=4096, n=512):
     return rows
 
 
-def bench_cast_storage(rng, shape=(4096, 1024)):
+def bench_cast_storage(rng, shape=(2048, 512)):
     rows = []
     for density in (0.01, 0.1):
         _, dense = _rand_csr(rng, shape, density)
@@ -80,7 +80,7 @@ def bench_cast_storage(rng, shape=(4096, 1024)):
     return rows
 
 
-def bench_sparse_elemwise(rng, shape=(8192, 512)):
+def bench_sparse_elemwise(rng, shape=(4096, 256)):
     rows = []
     for density in (0.01, 0.1):
         a, _ = _rand_rsp(rng, shape, density)
