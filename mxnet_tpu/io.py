@@ -486,7 +486,7 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         path_imgidx=path_imgidx, shuffle=shuffle, part_index=part_index,
         num_parts=num_parts, dtype=dtype, resize=resize,
         rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
-        **kwargs)
+        preprocess_threads=preprocess_threads, **kwargs)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
 
 
