@@ -25,9 +25,12 @@ def main():
     p.add_argument("--hybridize", action="store_true",
                    help="compile the block to one XLA program per shape")
     p.add_argument("--smoke", action="store_true")
+    p.add_argument("--seed", type=int, default=42)
     args = p.parse_args()
     if args.smoke:
         args.epochs = 2
+    np.random.seed(args.seed)
+    mx.random.seed(args.seed)
 
     mnist = mx.test_utils.get_mnist()
     n = 2000 if args.smoke else 10000
