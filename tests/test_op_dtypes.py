@@ -212,12 +212,17 @@ def test_conv_bn_backward_bf16_direction():
 
     x_np = _r.randn(2, 3, 8, 8).astype(np.float32)
     w_np = (_r.randn(4, 3, 3, 3) * 0.2).astype(np.float32)
+    # NOTE: sum(z^2) after BN is ~invariant to w (normalization fixes the
+    # per-channel second moment), so its gradient is pure epsilon-noise;
+    # weight the output with a fixed mask to get a real gradient
+    mask_np = _r.randn(2, 4, 8, 8).astype(np.float32)
     grads = {}
     for dtype in ("float32", "bfloat16"):
         x = mx.nd.array(x_np, dtype=dtype)
         w = mx.nd.array(w_np, dtype=dtype)
         g = mx.nd.array(np.ones(4, np.float32), dtype=dtype)
         b = mx.nd.array(np.zeros(4, np.float32), dtype=dtype)
+        mask = mx.nd.array(mask_np, dtype=dtype)
         mean = mx.nd.zeros(4, dtype="float32")
         var = mx.nd.ones(4, dtype="float32")
         for arr in (x, w, g, b):
@@ -226,7 +231,7 @@ def test_conv_bn_backward_bf16_direction():
             y = mx.nd.Convolution(x, w, num_filter=4, kernel=(3, 3),
                                   pad=(1, 1), no_bias=True)
             z = mx.nd.BatchNorm(y, g, b, mean, var)
-            loss = mx.nd.sum(z * z)
+            loss = mx.nd.sum(z * mask)
         loss.backward()
         grads[dtype] = w.grad.asnumpy().astype(np.float32).ravel()
     a, b_ = grads["float32"], grads["bfloat16"]
