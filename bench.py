@@ -75,8 +75,31 @@ def _bench_one(batch_size, layout, dtype, n_iters):
     return batch_size * n_iters / dt
 
 
+def _arm_watchdog():
+    """The remote-tunnel backend can wedge during client creation; fail
+    loudly instead of eating the driver's whole time budget."""
+    import threading
+
+    limit = float(os.environ.get("BENCH_WATCHDOG_S", "5400"))
+
+    def boom():
+        print(json.dumps({"metric": "resnet50_train_img_per_sec",
+                          "value": None, "unit": "images/sec",
+                          "error": "watchdog: no result within %ss "
+                                   "(accelerator tunnel wedged?)" % limit}),
+              flush=True)
+        os._exit(3)
+
+    t = threading.Timer(limit, boom)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     import jax
+
+    watchdog = _arm_watchdog()
 
     dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
@@ -139,6 +162,7 @@ def main():
         except Exception as err:  # noqa: BLE001
             print("bench_all sidecar failed: %r" % err, file=sys.stderr)
 
+    watchdog.cancel()
     print(json.dumps(result))
 
 
