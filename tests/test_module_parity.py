@@ -217,3 +217,19 @@ def test_symbol_astype_and_multi_output_list_attr():
 def test_symbol_attr_multi_output_single_node():
     s = mx.sym.split(mx.sym.Variable("d"), num_outputs=2)
     assert s.attr("num_outputs") == "2"
+
+
+def test_round4_import_locations():
+    """Round-4 surfaces live at their reference import paths."""
+    import mxnet_tpu as mx
+
+    assert mx.image.ImageDetIter is mx.image.detection.ImageDetIter
+    assert callable(mx.image.CreateDetAugmenter)
+    assert mx.image.det is mx.image.detection  # mx.image.det alias
+    assert callable(mx.model.FeedForward.create)
+    # the detection augmenter family is importable by name
+    from mxnet_tpu.image import (DetBorrowAug, DetHorizontalFlipAug,
+                                 DetRandomCropAug, DetRandomPadAug)
+    for cls in (DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug,
+                DetRandomPadAug):
+        assert hasattr(cls, "dumps")
