@@ -1,0 +1,26 @@
+"""Anti-transcription guard: no package file may drift back toward
+copy-similarity with its same-named reference file.
+
+The measured noise floor for independently-implemented same-API files is
+~0.45-0.57 (DECLONE.md); the 0.65 bar leaves headroom above the floor
+while still catching any transcribed rewrite (the round-3 flagged files
+measured 0.82-0.97)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_REF = "/root/reference/python/mxnet"
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF),
+                    reason="reference tree not mounted")
+def test_no_file_above_similarity_bar():
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "similarity_sweep.py"),
+         "--all", "--threshold", "0.65"],
+        capture_output=True, text=True, cwd=_REPO)
+    assert out.returncode == 0, \
+        "files at/above 0.65 similarity to reference:\n" + out.stdout
