@@ -9,20 +9,16 @@ cached_op.cc:171), with autograd captured through jax.vjp.
 """
 from __future__ import annotations
 
-import copy
 import re
 import threading
 
 import numpy as np
 
-from ..base import MXNetError
 from .. import ndarray as nd
 from ..ndarray import NDArray
-from ..ndarray.register import record_apply
 from .. import symbol as sym_mod
 from ..symbol import Symbol
 from .. import autograd
-from ..context import Context, cpu, current_context
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
