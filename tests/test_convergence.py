@@ -116,3 +116,44 @@ def test_gluon_convergence_with_validation():
     logits = net(mx.nd.array(xv)).asnumpy()
     acc = (logits.argmax(1) == yv).mean()
     assert acc >= 0.90, "gluon validation acc %.3f" % acc
+
+
+def test_lstm_bucketing_convergence():
+    """BucketingModule + fused-RNN LSTM learns a deterministic next-token
+    pattern (perplexity anchor for BASELINE config #4)."""
+    rng = np.random.RandomState(3)
+    vocab = 12
+    # cyclic sequences: next token is (t + 3) % vocab — fully learnable
+    sentences = []
+    for _ in range(120):
+        start = rng.randint(0, vocab)
+        length = rng.choice([8, 12])
+        sentences.append([(start + 3 * t) % vocab for t in range(length)])
+
+    train = mx.rnn.BucketSentenceIter(sentences, batch_size=20,
+                                      buckets=[8, 12], invalid_label=-1)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=16,
+                                 name="embed")
+        stack = mx.rnn.FusedRNNCell(32, num_layers=1, mode="lstm",
+                                    prefix="lstm_")
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 32))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        net = mx.sym.SoftmaxOutput(pred, lab, name="softmax",
+                                   use_ignore=True, ignore_label=-1)
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key)
+    mod.fit(train, num_epoch=30, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.02),),
+            eval_metric=mx.metric.Perplexity(ignore_label=-1))
+
+    metric = mx.metric.Perplexity(ignore_label=-1)
+    score = dict(mod.score(train, metric))
+    assert score["perplexity"] < 2.0, score
