@@ -157,3 +157,32 @@ def test_lstm_bucketing_convergence():
     metric = mx.metric.Perplexity(ignore_label=-1)
     score = dict(mod.score(train, metric))
     assert score["perplexity"] < 2.0, score
+
+
+def test_training_determinism():
+    """Same seeds → bit-identical parameters after training (the
+    reproducibility contract behind bit-identical checkpoint/resume)."""
+    def run():
+        rng = np.random.RandomState(9)
+        x, y = _blob_dataset(300, rng)
+        mx.random.seed(123)
+        data = mx.sym.Variable("data")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(
+                mx.sym.Activation(
+                    mx.sym.FullyConnected(data, num_hidden=16, name="fc1"),
+                    act_type="relu"),
+                num_hidden=3, name="fc2"), name="softmax")
+        train = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=False,
+                                  label_name="softmax_label")
+        np.random.seed(77)  # initializer draws from numpy global RNG
+        mod = mx.mod.Module(net)
+        mod.fit(train, num_epoch=3, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),
+                                  ("momentum", 0.9)))
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    a, b = run(), run()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
