@@ -24,7 +24,7 @@ from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
-           "ImageRecordIter", "LibSVMIter"]
+           "ImageRecordIter", "ImageDetRecordIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -488,6 +488,32 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
         preprocess_threads=preprocess_threads, **kwargs)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
+
+
+def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
+                       path_imgidx=None, shuffle=False, num_parts=1,
+                       part_index=0, preprocess_threads=4,
+                       label_pad_width=0, label_pad_value=-1.0, **kwargs):
+    """Factory mirroring the C++ ImageDetRecordIter registration
+    (reference: src/io/iter_image_det_recordio.cc:582): a record-file
+    detection source feeding ImageDetIter's augmenter chain with padded
+    variable-box labels.
+
+    ``label_pad_width`` optionally forces the padded object count
+    (otherwise scanned from the data); extra kwargs flow to
+    CreateDetAugmenter (rand_crop / rand_pad / rand_mirror / mean / std
+    ...).
+    """
+    from .image.detection import ImageDetIter
+
+    it = ImageDetIter(batch_size=batch_size, data_shape=tuple(data_shape),
+                      path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                      shuffle=shuffle, num_parts=num_parts,
+                      part_index=part_index,
+                      preprocess_threads=preprocess_threads, **kwargs)
+    if label_pad_width and label_pad_width > it.label_shape[0]:
+        it.reshape(label_shape=(label_pad_width, it.label_shape[1]))
+    return it
 
 
 class LibSVMIter(DataIter):
