@@ -199,3 +199,15 @@ def test_det_iter_preprocess_threads(tmp_path):
                                    bb.data[0].asnumpy())
         np.testing.assert_allclose(ba.label[0].asnumpy(),
                                    bb.label[0].asnumpy())
+
+
+def test_image_det_record_iter_factory(tmp_path):
+    """mx.io.ImageDetRecordIter (the C++-registered iterator name) builds
+    an ImageDetIter with optional forced label padding."""
+    rec, idx, max_objs = _write_det_rec(tmp_path, n=8)
+    it = mx.io.ImageDetRecordIter(rec, (3, 32, 32), 4, path_imgidx=idx,
+                                  rand_mirror=True,
+                                  label_pad_width=max_objs + 3)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4, max_objs + 3, 6)
