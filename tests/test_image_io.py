@@ -340,3 +340,21 @@ def test_preprocess_threads_match_serial(tmp_path):
         np.testing.assert_allclose(bs.label[0].asnumpy(),
                                    bt.label[0].asnumpy())
         assert bs.pad == bt.pad
+
+
+def test_preprocess_threads_random_augs_smoke(tmp_path):
+    """Thread-pool decode with RANDOM augmenters: batches stay well-formed
+    (per-sample RNG interleaving across threads is allowed to differ from
+    serial; shapes/ranges must not)."""
+    rec, idx = _write_rec(tmp_path, n=32, size=28)
+    it = mx.image.ImageIter(batch_size=8, data_shape=(3, 24, 24),
+                            path_imgrec=rec, path_imgidx=idx, shuffle=True,
+                            rand_crop=True, rand_mirror=True,
+                            preprocess_threads=4)
+    seen = 0
+    for batch in it:
+        arr = batch.data[0].asnumpy()
+        assert arr.shape == (8, 3, 24, 24)
+        assert np.isfinite(arr).all()
+        seen += arr.shape[0] - batch.pad
+    assert seen == 32
