@@ -39,6 +39,7 @@ from . import callback
 from . import io
 from . import recordio
 from . import image
+from . import image as img  # reference alias (python/mxnet/__init__.py:75)
 from . import config
 from . import kvstore as kv
 from . import kvstore
