@@ -22,6 +22,7 @@ from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gp
 from . import ndarray
 from . import ndarray as nd
 from . import random
+from . import random as rnd  # reference alias (__init__.py:40)
 from . import autograd
 from . import symbol
 from . import symbol as sym
@@ -39,14 +40,17 @@ from . import callback
 from . import io
 from . import recordio
 from . import image
-from . import image as img  # reference alias (python/mxnet/__init__.py:75)
+from . import image as img  # reference alias (python/mxnet/__init__.py:75)  # reference alias (python/mxnet/__init__.py:75)
 from . import config
-from . import kvstore as kv
 from . import kvstore
+from . import kvstore_server as kv
+from . import kvstore
+from . import kvstore_server
 from . import model
 from . import module
 from . import module as mod
 from . import monitor
+from . import monitor as mon  # reference alias (__init__.py:63)
 from .monitor import Monitor
 from . import profiler
 from . import rtc
