@@ -43,8 +43,7 @@ from . import image
 from . import image as img  # reference alias (python/mxnet/__init__.py:75)  # reference alias (python/mxnet/__init__.py:75)
 from . import config
 from . import kvstore
-from . import kvstore_server as kv
-from . import kvstore
+from . import kvstore as kv
 from . import kvstore_server
 from . import model
 from . import module
