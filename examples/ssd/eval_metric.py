@@ -1,0 +1,157 @@
+"""VOC-style mean-average-precision over MultiBoxDetection outputs.
+
+Reference surface: example/ssd/evaluate/eval_metric.py (MApMetric +
+VOC07MApMetric). Inputs per batch:
+
+- preds: detections ``(batch, num_det, 6)`` rows
+  ``[cls_id, score, xmin, ymin, xmax, ymax]`` with cls_id==-1 for
+  suppressed rows — exactly what MultiBoxDetection emits.
+- labels: ground truth ``(batch, num_gt, 5[+])`` rows
+  ``[cls_id, xmin, ymin, xmax, ymax, (difficult)]``, cls_id==-1 padding.
+
+Greedy per-image matching at ``ovp_thresh`` IoU (each gt matched at most
+once, detections visited in score order), then AP per class from the
+precision/recall curve: monotone-envelope area (VOC10+/COCO-style) in
+MApMetric, the 11-point interpolation in VOC07MApMetric.
+"""
+import os as _os
+import sys as _sys
+
+import numpy as np
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import mxnet_tpu as mx
+
+
+def _iou(box, boxes):
+    """IoU of one [x1,y1,x2,y2] box against an (N,4) array."""
+    ix1 = np.maximum(box[0], boxes[:, 0])
+    iy1 = np.maximum(box[1], boxes[:, 1])
+    ix2 = np.minimum(box[2], boxes[:, 2])
+    iy2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    area = np.maximum(box[2] - box[0], 0) * np.maximum(box[3] - box[1], 0)
+    areas = (np.maximum(boxes[:, 2] - boxes[:, 0], 0)
+             * np.maximum(boxes[:, 3] - boxes[:, 1], 0))
+    union = area + areas - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+class MApMetric(mx.metric.EvalMetric):
+    """Mean AP with the monotone-envelope (area-under-PR) integration."""
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False,
+                 class_names=None, pred_idx=0):
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = int(pred_idx)
+        if class_names is not None:
+            self.num = len(class_names) + 1
+        else:
+            self.num = None
+        super().__init__("mAP")
+
+    def reset(self):
+        # per-class: list of (score, is_tp) records + total gt count
+        self._records = {}
+        self._gt_counts = {}
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        def to_np(x):
+            return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+        dets_batch = to_np(preds[self.pred_idx])
+        labels_batch = to_np(labels[0])
+        for dets, gts in zip(dets_batch, labels_batch):
+            dets = dets[dets[:, 0] >= 0]
+            valid = gts[gts[:, 0] >= 0]
+            difficult = (valid[:, 5] > 0 if valid.shape[1] > 5
+                         else np.zeros(len(valid), bool))
+            for cid in np.unique(np.concatenate(
+                    [dets[:, 0], valid[:, 0]])).astype(int):
+                cd = dets[dets[:, 0] == cid]
+                cg = valid[valid[:, 0] == cid]
+                cdiff = difficult[valid[:, 0] == cid]
+                if not self.use_difficult:
+                    self._gt_counts[cid] = (self._gt_counts.get(cid, 0)
+                                            + int((~cdiff).sum()))
+                else:
+                    self._gt_counts[cid] = self._gt_counts.get(cid, 0) \
+                        + len(cg)
+                recs = self._records.setdefault(cid, [])
+                order = np.argsort(-cd[:, 1])
+                matched = np.zeros(len(cg), bool)
+                for row in cd[order]:
+                    if len(cg) == 0:
+                        recs.append((row[1], 0))
+                        continue
+                    ious = _iou(row[2:6], cg[:, 1:5])
+                    j = int(np.argmax(ious))
+                    if ious[j] >= self.ovp_thresh:
+                        if cdiff[j] and not self.use_difficult:
+                            # difficult gt: ignore the det entirely and do
+                            # NOT consume the gt — every later detection
+                            # overlapping it is also ignored (VOC rules)
+                            continue
+                        if not matched[j]:
+                            matched[j] = True
+                            recs.append((row[1], 1))
+                        else:
+                            recs.append((row[1], 0))
+                    else:
+                        recs.append((row[1], 0))
+        self.num_inst += len(dets_batch)
+
+    # ---------------------------------------------------------------- AP
+    def _average_precision(self, recall, precision):
+        """Monotone-envelope area under the PR curve."""
+        mrec = np.concatenate([[0.0], recall, [1.0]])
+        mpre = np.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def _class_ap(self, cid):
+        npos = self._gt_counts.get(cid, 0)
+        recs = self._records.get(cid, [])
+        if npos == 0:
+            return None
+        if not recs:
+            return 0.0
+        recs = sorted(recs, key=lambda r: -r[0])
+        tp = np.cumsum([r[1] for r in recs]).astype(np.float64)
+        fp = np.cumsum([1 - r[1] for r in recs]).astype(np.float64)
+        recall = tp / npos
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        return self._average_precision(recall, precision)
+
+    def get(self):
+        cids = sorted(set(self._records) | set(self._gt_counts))
+        names, values = [], []
+        for cid in cids:
+            ap = self._class_ap(cid)
+            if ap is None:
+                continue
+            label = (self.class_names[cid] if self.class_names is not None
+                     and cid < len(self.class_names) else "class%d" % cid)
+            names.append("%s_ap" % label)
+            values.append(ap)
+        mean = float(np.mean(values)) if values else float("nan")
+        return (["mAP"] + names, [mean] + values)
+
+
+class VOC07MApMetric(MApMetric):
+    """mAP with the VOC2007 11-point interpolated AP."""
+
+    def _average_precision(self, recall, precision):
+        ap = 0.0
+        for t in np.linspace(0, 1, 11):
+            mask = recall >= t
+            ap += (float(np.max(precision[mask])) if mask.any() else 0.0) / 11
+        return ap

@@ -81,6 +81,55 @@ def build(num_classes, bs, size, mode):
                            else "null", **shapes)
 
 
+def init_params(ex, seed=0):
+    """Small-gaussian init for every non-data executor arg."""
+    rng = np.random.RandomState(seed)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            arr[:] = (rng.randn(*arr.shape) * 0.05).astype(np.float32)
+
+
+def train(ex, train_iter, steps, lr, max_objs, log_every=None):
+    """Clip-SGD training loop shared by train_ssd and evaluate; returns
+    (first, last) anchor-classification NLL."""
+    first = last = None
+    step = 0
+    while step < steps:
+        for batch in train_iter:
+            if step >= steps:
+                break
+            labels = batch.label[0].asnumpy()[:, :2, :5]
+            if max_objs < 2:  # pad to the bound executor's label shape
+                labels = np.concatenate(
+                    [labels, -np.ones((labels.shape[0], 2 - max_objs, 5),
+                                      np.float32)], axis=1)
+            ex.arg_dict["data"][:] = batch.data[0]
+            ex.arg_dict["label"][:] = labels
+            ex.forward(is_train=True)
+            ex.backward()
+
+            cls_prob = ex.outputs[0].asnumpy()
+            cls_target = ex.outputs[2].asnumpy()
+            valid = cls_target >= 0
+            nll = -np.log(np.maximum(np.take_along_axis(
+                cls_prob, cls_target.clip(0)[:, None].astype(int),
+                axis=1)[:, 0][valid], 1e-9)).mean()
+            if first is None:
+                first = nll
+            last = nll
+            for name, grad in ex.grad_dict.items():
+                if name in ("data", "label") or grad is None:
+                    continue
+                ex.arg_dict[name][:] = (
+                    ex.arg_dict[name].asnumpy()
+                    - lr * np.clip(grad.asnumpy(), -1, 1))
+            if log_every and step % log_every == 0:
+                print("step %4d cls-loss %.4f" % (step, nll))
+            step += 1
+        train_iter.reset()
+    return first, last
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=400)
@@ -107,48 +156,10 @@ def main():
         mean=True, std=True)
     print("label shape:", train_iter.label_shape)
 
-    rng = np.random.RandomState(0)
     ex = build(len(CLASS_COLORS), args.batch_size, args.size, "train")
-    for name, arr in ex.arg_dict.items():
-        if name not in ("data", "label"):
-            arr[:] = (rng.randn(*arr.shape) * 0.05).astype(np.float32)
-
-    first = last = None
-    step = 0
-    max_objs = train_iter.label_shape[0]
-    while step < args.steps:
-        for batch in train_iter:
-            if step >= args.steps:
-                break
-            labels = batch.label[0].asnumpy()[:, :2, :5]
-            if max_objs < 2:  # pad to the bound executor's label shape
-                labels = np.concatenate(
-                    [labels, -np.ones((labels.shape[0], 2 - max_objs, 5),
-                                      np.float32)], axis=1)
-            ex.arg_dict["data"][:] = batch.data[0]
-            ex.arg_dict["label"][:] = labels
-            ex.forward(is_train=True)
-            ex.backward()
-
-            cls_prob = ex.outputs[0].asnumpy()
-            cls_target = ex.outputs[2].asnumpy()
-            valid = cls_target >= 0
-            nll = -np.log(np.maximum(np.take_along_axis(
-                cls_prob, cls_target.clip(0)[:, None].astype(int),
-                axis=1)[:, 0][valid], 1e-9)).mean()
-            if first is None:
-                first = nll
-            last = nll
-            for name, grad in ex.grad_dict.items():
-                if name in ("data", "label") or grad is None:
-                    continue
-                ex.arg_dict[name][:] = (
-                    ex.arg_dict[name].asnumpy()
-                    - args.lr * np.clip(grad.asnumpy(), -1, 1))
-            if step % 50 == 0:
-                print("step %4d cls-loss %.4f" % (step, nll))
-            step += 1
-        train_iter.reset()
+    init_params(ex)
+    first, last = train(ex, train_iter, args.steps, args.lr,
+                        train_iter.label_shape[0], log_every=50)
 
     print("cls loss: %.4f -> %.4f" % (first, last))
     assert last < first * (0.98 if args.smoke else 0.9), (first, last)

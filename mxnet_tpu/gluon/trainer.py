@@ -161,34 +161,82 @@ class Trainer:
                 updater(i, grad, weight)
 
     # ------------------------------------------------------ fused updates
+    # Optimizers whose only per-step HOST-computed scalar is the resolved
+    # learning rate (incl. schedulers and Adam's t-dependent bias
+    # correction): that scalar enters the compiled program as a TRACED
+    # argument, so schedules and bias correction stay dynamic without
+    # recompiles. Excluded: SGLD (host randomness + math.sqrt on lr),
+    # Nadam (mutates m_schedule host-side per step), Adamax/DCASGD
+    # (inline host scalars / host state mutation in update()).
+    _FUSABLE = ("SGD", "NAG", "Adam", "RMSProp", "AdaGrad", "AdaDelta",
+                "Ftrl")
+
     def _can_fuse(self):
-        """Fusing bakes hyperparameters into one compiled program, so it
-        requires a step-index-free optimizer: no lr scheduler (lr would
-        freeze) and no per-step bias correction (Adam's t)."""
         o = self._optimizer
         return (self._fuse_step and len(self._contexts) == 1
-                and type(o).__name__ in ("SGD", "NAG")
-                and o.lr_scheduler is None
-                and not getattr(o, "multi_precision", False))
+                and type(o).__name__ in self._FUSABLE)
 
     def _live_params(self):
         return [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]
 
     def _fused_signature(self):
+        """Everything BAKED into the compiled program (lr is excluded —
+        it is a traced input, so schedulers/set_learning_rate don't
+        recompile)."""
         o = self._optimizer
-        return (tuple((p.shape, str(p.dtype)) for _i, p in
+        static = tuple(
+            (k, getattr(o, k)) for k in
+            ("wd", "rescale_grad", "clip_gradient", "momentum",
+             "multi_precision", "beta1", "beta2", "epsilon", "gamma1",
+             "gamma2", "centered", "clip_weights", "rho", "lamda1",
+             "beta", "float_stable_eps") if hasattr(o, k))
+        return (type(o).__name__,
+                tuple((p.shape, str(p.dtype)) for _i, p in
                       self._live_params()),
-                o.lr, o.wd, getattr(o, "momentum", 0.0), o.rescale_grad,
-                o.clip_gradient)
+                static, tuple(sorted(o.wd_mult.items())))
+
+    def _step_scalar_fn(self):
+        """Host computation of the per-step lr scalar (after update
+        counts advance): Adam resolves through its bias correction."""
+        o = self._optimizer
+        return getattr(o, "_corrected_lr", None) or o._get_lr
+
+    @staticmethod
+    def _state_data(state):
+        """NDArray state pytree (None / NDArray / nested tuples) -> raw
+        jax-array pytree of the same shape."""
+        from ..ndarray.ndarray import NDArray
+
+        if state is None:
+            return None
+        if isinstance(state, NDArray):
+            return state._data
+        if isinstance(state, (tuple, list)):
+            return tuple(Trainer._state_data(s) for s in state)
+        return state
+
+    @staticmethod
+    def _writeback_state(state, data):
+        """Write new raw data back into the host NDArray state pytree."""
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(state, NDArray):
+            state._set_data(data)
+        elif isinstance(state, (tuple, list)):
+            for s, d in zip(state, data):
+                Trainer._writeback_state(s, d)
 
     def _build_fused(self):
-        """One jitted function applying the optimizer to every parameter;
-        traces the ordinary Updater over NDArray-wrapped tracers, so ANY
-        eligible optimizer fuses without a parallel implementation."""
+        """One jitted function applying the optimizer to every parameter:
+        the ordinary ``update`` is traced over NDArray-wrapped tracers, so
+        any eligible optimizer fuses without a parallel implementation.
+        Per-step lr scalars arrive as traced arguments via patched
+        ``_get_lr``/``_corrected_lr`` (and ``_update_count`` no-ops in
+        trace — the host advances the real counts each step)."""
         import jax
 
-        from ..ndarray.ndarray import _from_data
+        from ..ndarray.ndarray import NDArray, _from_data
 
         live = self._live_params()
         updater = self._updaters[0]
@@ -201,31 +249,55 @@ class Trainer:
 
         opt_ref = self._optimizer
 
-        def run(w_datas, g_datas, s_datas):
-            fresh = opt.get_updater(opt_ref)
-            new_w, new_s = [], []
-            for (i, _p), wd, gd, sd in zip(live, w_datas, g_datas, s_datas):
-                w = _from_data(wd)
-                g = _from_data(gd)
-                state = None if sd is None else _from_data(sd)
-                fresh.states[i] = state
-                fresh.states_synced[i] = True
-                opt_ref.update(i, w, g, state)
-                new_w.append(w._data)
-                new_s.append(None if state is None else state._data)
-            return new_w, new_s
+        def wrap_state(sd):
+            if sd is None:
+                return None
+            if isinstance(sd, tuple):
+                return tuple(wrap_state(s) for s in sd)
+            return _from_data(sd)
+
+        def state_out(state):
+            if state is None:
+                return None
+            if isinstance(state, tuple):
+                return tuple(state_out(s) for s in state)
+            return state._data
+
+        def run(w_datas, g_datas, s_datas, lr_scalars):
+            lr_map = {i: lr for (i, _p), lr in zip(live, lr_scalars)}
+            patched = {"_get_lr": lambda idx: lr_map[idx],
+                       "_update_count": lambda idx: None}
+            if hasattr(type(opt_ref), "_corrected_lr"):
+                patched["_corrected_lr"] = lambda idx: lr_map[idx]
+            for name, fn in patched.items():
+                setattr(opt_ref, name, fn)
+            try:
+                new_w, new_s = [], []
+                for (i, _p), wd, gd, sd in zip(live, w_datas, g_datas,
+                                               s_datas):
+                    w = _from_data(wd)
+                    g = _from_data(gd)
+                    state = wrap_state(sd)
+                    opt_ref.update(i, w, g, state)
+                    new_w.append(w._data)
+                    new_s.append(state_out(state))
+                return new_w, new_s
+            finally:
+                # instance attrs would shadow the class methods for the
+                # eager path AND break optimizer pickling (dist re-ship)
+                for name in patched:
+                    opt_ref.__dict__.pop(name, None)
 
         return jax.jit(run, donate_argnums=(0, 2))
 
     def _fused_local_step(self):
-        from ..ndarray.ndarray import NDArray
-
         sig = self._fused_signature()
         if self._fused is None or self._fused[0] != sig:
             self._fused = (sig, self._build_fused())
         fn = self._fused[1]
         live = self._live_params()
         updater = self._updaters[0]
+        o = self._optimizer
 
         # loaded checkpoints hold host-side numpy until first use; the
         # eager path syncs lazily per call, do the same here
@@ -235,16 +307,22 @@ class Trainer:
                     updater.states[i], p.list_data()[0].context)
                 updater.states_synced[i] = True
 
+        # advance update counts on the HOST (the traced update's count
+        # call is a no-op), then resolve each per-step lr scalar —
+        # scheduler lookups and Adam's bias correction happen here, and
+        # the results enter the program as traced inputs
+        for i, _p in live:
+            o._update_count(i)
+        scalar = self._step_scalar_fn()
+        lr_scalars = [float(scalar(i)) for i, _p in live]
+
         w_datas = [p.list_data()[0]._data for _i, p in live]
         g_datas = [p.list_grad()[0]._data for _i, p in live]
-        s_datas = [updater.states[i]._data
-                   if isinstance(updater.states[i], NDArray) else None
-                   for i, _p in live]
-        new_w, new_s = fn(w_datas, g_datas, s_datas)
+        s_datas = [self._state_data(updater.states[i]) for i, _p in live]
+        new_w, new_s = fn(w_datas, g_datas, s_datas, lr_scalars)
         for (i, p), wd, sd in zip(live, new_w, new_s):
             p.list_data()[0]._set_data(wd)
-            if sd is not None:
-                updater.states[i]._set_data(sd)
+            self._writeback_state(updater.states[i], sd)
 
     def save_states(self, fname):
         """Persist optimizer state (server-side when update_on_kvstore)."""

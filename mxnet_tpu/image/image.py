@@ -202,11 +202,17 @@ def _aug_class(name, fields, call, doc=""):
     specs = [(f, None) if isinstance(f, str) else f for f in fields]
 
     def __init__(self, *args, **kwargs):
+        if len(args) > len(specs):
+            raise TypeError("%s() takes at most %d arguments (%d given)"
+                            % (name, len(specs), len(args)))
         bound = {}
         for (fname, default), value in zip(specs, args):
             bound[fname] = value
         for fname, default in specs[len(args):]:
             bound[fname] = kwargs.pop(fname, default)
+        if kwargs:
+            raise TypeError("%s() got unexpected keyword argument(s) %s"
+                            % (name, ", ".join(sorted(kwargs))))
         Augmenter.__init__(self, **dict(bound))
         for fname, value in bound.items():
             setattr(self, fname, value)
@@ -330,7 +336,15 @@ class HueJitterAug(Augmenter):
 
 
 class ColorJitterAug(RandomOrderAug):
-    """Brightness/contrast/saturation jitters in random order."""
+    """Brightness/contrast/saturation jitters in random order.
+
+    Applied as ONE fused pass: each jitter is affine in the algebra
+    spanned by {x, luma(x), mean(luma(x))} (luma is a linear functional,
+    so the random-order composition stays inside it). Composing the
+    (a, l, m) coefficients host-side and materializing once replaces the
+    3+ full-image passes of the sequential chain — the round-4 profile's
+    color-jitter outlier (171 img/s/core vs 326 without it,
+    PERF_NOTES.md input-pipeline table)."""
 
     def __init__(self, brightness, contrast, saturation):
         parts = [cls(v) for cls, v in
@@ -338,6 +352,35 @@ class ColorJitterAug(RandomOrderAug):
                   (ContrastJitterAug, contrast),
                   (SaturationJitterAug, saturation)) if v > 0]
         super().__init__(parts)
+
+    def __call__(self, src):
+        a, l, m = 1.0, 0.0, 0.0   # image = a*x + l*luma(x) + m*mean(luma)
+        order = list(self.ts)
+        pyrandom.shuffle(order)
+        for t in order:
+            if isinstance(t, BrightnessJitterAug):
+                alpha = _jitter(t.brightness)
+                a, l, m = alpha * a, alpha * l, alpha * m
+            elif isinstance(t, ContrastJitterAug):
+                alpha = _jitter(t.contrast)
+                a, l, m = alpha * a, alpha * l, \
+                    alpha * m + (1.0 - alpha) * (a + l + m)
+            elif isinstance(t, SaturationJitterAug):
+                alpha = _jitter(t.saturation)
+                a, l, m = alpha * a, \
+                    alpha * l + (1.0 - alpha) * (a + l), m
+            else:   # user-extended chains fall back to sequential
+                src = np.asarray(src, np.float32)
+                if (a, l, m) != (1.0, 0.0, 0.0):
+                    lum = _luma(src)
+                    src = a * src + l * lum + m * lum.mean()
+                    a, l, m = 1.0, 0.0, 0.0
+                src = t(src)
+        src = np.asarray(src, np.float32)
+        if (a, l, m) == (1.0, 0.0, 0.0):
+            return src
+        lum = _luma(src)
+        return a * src + l * lum + float(m) * lum.mean()
 
 
 class LightingAug(Augmenter):
@@ -373,15 +416,23 @@ def _flip_call(self, src):
     return np.asarray(src)[:, ::-1] if pyrandom.random() < self.p else src
 
 
-def _cast_call(self, src):
-    return np.asarray(src, dtype=self.typ)
+class CastAug(Augmenter):
+    """Cast to a dtype. Reference API: ctor keyword is ``typ`` but the
+    serialized kwarg is ``type`` (image.py:624 passes
+    ``super().__init__(type=typ)``)."""
+
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return np.asarray(src, dtype=self.typ)
 
 
 ColorNormalizeAug = _aug_class("ColorNormalizeAug", ["mean", "std"],
                                _normalize_call)
 RandomGrayAug = _aug_class("RandomGrayAug", ["p"], _gray_call)
 HorizontalFlipAug = _aug_class("HorizontalFlipAug", ["p"], _flip_call)
-CastAug = _aug_class("CastAug", [("typ", "float32")], _cast_call)
 
 
 def _imagenet_stat(value, default):
@@ -554,6 +605,19 @@ class ImageIter(_io.DataIter):
             self.imgrec.reset()
         self.cur = 0
 
+    def close(self):
+        """Release the decode pool's worker threads (iterators rebuilt
+        per epoch would otherwise accumulate idle threads)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def _next_raw(self):
         """(label, payload, kind) with decode deferred — the IO half."""
         if self.seq is not None:
@@ -583,27 +647,50 @@ class ImageIter(_io.DataIter):
         label, payload, kind = self._next_raw()
         return label, self._decode_raw(payload, kind)
 
+    def _prepare_sample(self, row, label, payload, kind,
+                        batch_data, batch_label):
+        """Decode+augment one sample into its batch row (pool worker)."""
+        data = self.augmentation_transform(self._decode_raw(payload, kind))
+        self.check_valid_image(data)
+        if data.ndim == 2:
+            data = data[:, :, None]
+        batch_data[row] = data
+        lab = np.asarray(label, np.float32).reshape(-1)
+        batch_label[row, :len(lab[:self.label_width])] = \
+            lab[:self.label_width]
+
     def next(self):
         c, h, w = self.data_shape
         batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
         batch_label = np.zeros((self.batch_size, self.label_width),
                                np.float32)
         i = 0
-        try:
-            while i < self.batch_size:
-                label, data = self.next_sample()
-                data = self.augmentation_transform(data)
-                self.check_valid_image(data)
-                if data.ndim == 2:
-                    data = data[:, :, None]
-                batch_data[i] = data
-                lab = np.asarray(label, np.float32).reshape(-1)
-                batch_label[i, :len(lab[:self.label_width])] = \
-                    lab[:self.label_width]
-                i += 1
-        except StopIteration:
-            if i == 0 or self.last_batch_handle == "discard":
-                raise
+        if self._pool is not None:
+            # raw record IO stays serial (preserves sample order); decode
+            # + augment fan out, each worker owning one batch row
+            raws = []
+            try:
+                while len(raws) < self.batch_size:
+                    raws.append(self._next_raw())
+            except StopIteration:
+                if not raws or self.last_batch_handle == "discard":
+                    raise
+            futs = [self._pool.submit(self._prepare_sample, j, label,
+                                      payload, kind, batch_data, batch_label)
+                    for j, (label, payload, kind) in enumerate(raws)]
+            for f in futs:
+                f.result()
+            i = len(raws)
+        else:
+            try:
+                while i < self.batch_size:
+                    label, payload, kind = self._next_raw()
+                    self._prepare_sample(i, label, payload, kind,
+                                         batch_data, batch_label)
+                    i += 1
+            except StopIteration:
+                if i == 0 or self.last_batch_handle == "discard":
+                    raise
         pad = self.batch_size - i
         data_nchw = np.ascontiguousarray(
             batch_data.transpose(0, 3, 1, 2)).astype(self.dtype)

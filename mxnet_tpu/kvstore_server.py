@@ -223,9 +223,15 @@ class KVStoreServer:
         with self._lock:
             if key not in self._store:
                 return ("err", "key %r not initialized" % (key,))
-            updater = self._updater
             weight = self._store[key]
         with self._key_lock(key):
+            # re-read the updater INSIDE the key lock: an optimizer swap
+            # (set_optimizer/refresh_optimizer) acquires all key locks to
+            # quiesce, so any push that runs after the swap completes must
+            # observe the NEW updater — a snapshot taken before the key
+            # lock could apply state into the old, discarded updater
+            with self._lock:
+                updater = self._updater
             if updater is not None:
                 updater(key, grad, weight)   # in-place on the stored array
             else:
@@ -315,9 +321,28 @@ class _NumpyUpdater:
     def __call__(self, key, grad, weight):
         from . import ndarray as nd
 
+        key = _int_key(key)
+        self._alias_subkey(key)
         w = nd.array(weight)
-        self._updater(_int_key(key), nd.array(np.asarray(grad)), w)
+        self._updater(key, nd.array(np.asarray(grad)), w)
         weight[...] = w.asnumpy()
+
+    def _alias_subkey(self, key):
+        """Big-array slices arrive as 'name#i' subkeys; teach the
+        optimizer's idx2name to resolve them to the base parameter so
+        lr_mult/wd_mult (and the no-decay bias/gamma default) still apply
+        (reference slices re-use the base key's hyperparams implicitly,
+        kvstore_dist.h:229). Optimizer STATE stays per-subkey."""
+        if not isinstance(key, str) or "#" not in key:
+            return
+        opt = getattr(self._updater, "optimizer", None)
+        if opt is None or key in opt.idx2name:
+            return
+        base, _, suffix = key.rpartition("#")
+        if not suffix.isdigit():
+            return
+        base = _int_key(base)
+        opt.idx2name[key] = opt.idx2name.get(base, base)
 
     def get_states(self):
         return self._updater.get_states()

@@ -323,6 +323,14 @@ class NDArray:
         if np.isscalar(other) or isinstance(other, (np.generic,)):
             f = getattr(_internal, scalar_op_name)
             return f(self, scalar=float(other))
+        import jax.core
+
+        if isinstance(other, jax.core.Tracer) and np.ndim(other) == 0:
+            # traced scalar (fused Trainer feeds lr as a program input):
+            # dispatch the scalar op with the tracer riding through the
+            # Float param field's pass-through
+            f = getattr(_internal, scalar_op_name)
+            return f(self, scalar=other)
         raise TypeError("type %s not supported" % str(type(other)))
 
     def __add__(self, other):

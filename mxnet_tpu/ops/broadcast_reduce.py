@@ -129,11 +129,27 @@ def _register_reductions():
     alias_op("min", "min_axis")
 
     def norm(attrs, x):
-        return jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,))
+        if attrs.ord not in (1, 2):
+            from ..base import MXNetError
+
+            raise MXNetError("norm only supports ord=1 or ord=2, got %r"
+                             % (attrs.ord,))
+        ax = attrs.axis
+        if attrs.ord == 1:
+            red = jnp.sum(jnp.abs(x), axis=ax, keepdims=attrs.keepdims)
+        else:
+            red = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax,
+                                   keepdims=attrs.keepdims))
+        if ax is None and not attrs.keepdims:
+            red = red.reshape((1,))   # reference full-reduce returns (1,)
+        return red
 
     register_op("norm", norm, num_inputs=1,
-                infer_shape=lambda attrs, i, a: ([i[0]], [(1,)], a) if i[0] else None,
-                doc="L2 norm over all elements (reference: broadcast_reduce_op_value.cc norm)")
+                params={"ord": Int(default=2),
+                        "axis": Shape(default=None),
+                        "keepdims": Bool(default=False)},
+                doc="L1/L2 norm over all elements or the given axes "
+                    "(reference: broadcast_reduce_op_value.cc NormParam)")
 
 
 def _register_arg_reductions():

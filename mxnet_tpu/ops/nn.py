@@ -228,14 +228,28 @@ def _register_conv():
         # by d*(k-1)-p on the low side and d*(k-1)-p+adj on the high side.
         pad_cfg = [(d * (k - 1) - p, d * (k - 1) - p + a)
                    for k, p, a, d in zip(attrs.kernel, pad, adj, dilate)]
-        out = jax.lax.conv_transpose(
-            data, weight,
-            strides=stride,
-            padding=pad_cfg,
-            rhs_dilation=dilate,
-            dimension_numbers=_conv_dims(nd),
-            transpose_kernel=True,
-        )
+
+        def one_group(x, w):
+            return jax.lax.conv_transpose(
+                x, w,
+                strides=stride,
+                padding=pad_cfg,
+                rhs_dilation=dilate,
+                dimension_numbers=_conv_dims(nd),
+                transpose_kernel=True,
+            )
+
+        g = attrs.num_group
+        if g == 1:
+            out = one_group(data, weight)
+        else:
+            # lax.conv_transpose has no feature_group_count: run each
+            # group's (C/g -> num_filter/g) transpose and concat on C
+            jnp = jax.numpy
+            outs = [one_group(x, w) for x, w in
+                    zip(jnp.split(data, g, axis=1),
+                        jnp.split(weight, g, axis=0))]
+            out = jnp.concatenate(outs, axis=1)
         if not attrs.no_bias:
             out = out + rest[0].reshape((1, -1) + (1,) * nd)
         return out

@@ -68,6 +68,13 @@ class Float(Field):
             return None
         if isinstance(v, str):
             v = ast.literal_eval(v)
+        import jax
+
+        if isinstance(v, jax.core.Tracer):
+            # a traced scalar (e.g. the fused Trainer passing lr as a
+            # program INPUT so schedulers don't recompile) flows through:
+            # jnp math treats it exactly like a python float
+            return v
         return float(v)
 
 
@@ -105,12 +112,15 @@ class Shape(Field):
             v = ast.literal_eval(s)
         if isinstance(v, (int, np.integer)):
             return (int(v),)
-        return tuple(int(x) for x in v)
+        # None elements stay None (slice begin/end use them for "full
+        # extent", reference: optional<int> tuples in slice-inl.h)
+        return tuple(None if x is None else int(x) for x in v)
 
     def to_str(self, v):
         if v is None:
             return "None"
-        return "(" + ", ".join(str(int(x)) for x in v) + ")"
+        return "(" + ", ".join(
+            "None" if x is None else str(int(x)) for x in v) + ")"
 
 
 class Enum(Field):

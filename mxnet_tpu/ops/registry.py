@@ -293,9 +293,17 @@ def normalize_device_placement(arrays):
 
 def eager_call(opdef, attrs, input_datas, aux_datas=(), is_train=False, rng=None):
     """Run one op eagerly on raw JAX arrays, compiled and cached."""
+    import jax.core
+
     n_in = len(input_datas)
     normalized = normalize_device_placement(tuple(input_datas) +
                                             tuple(aux_datas))
     input_datas, aux_datas = normalized[:n_in], normalized[n_in:]
+    if any(isinstance(v, jax.core.Tracer) for _k, v in attrs.key):
+        # a TRACED attr (e.g. the fused Trainer feeding lr as a program
+        # input) cannot key the compile cache; we are already inside an
+        # outer trace, so apply directly and let the outer jit compile
+        return opdef.apply(attrs, input_datas, aux_datas,
+                           is_train=is_train, rng=rng)
     f = _jitted(opdef, attrs, bool(is_train), len(input_datas), len(aux_datas))
     return f(tuple(input_datas), tuple(aux_datas), rng)

@@ -501,3 +501,80 @@ def test_deformable_psroi_pooling_matches_reference():
         assert out.shape == exp.shape
         np.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_map_metric_known_values():
+    """MApMetric / VOC07MApMetric against hand-computed AP values
+    (reference: example/ssd/evaluate/eval_metric.py)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ssd_eval_metric",
+        os.path.join(os.path.dirname(__file__), os.pardir, "examples",
+                     "ssd", "eval_metric.py"))
+    em = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(em)
+
+    # one image, two gt boxes of class 0; three detections:
+    #   det A score .9 IoU 1.0 with gt1 -> TP
+    #   det B score .8 IoU 0   -> FP
+    #   det C score .7 IoU 1.0 with gt2 -> TP
+    gts = np.array([[[0, 0.0, 0.0, 0.4, 0.4],
+                     [0, 0.6, 0.6, 1.0, 1.0]]], np.float32)
+    dets = np.array([[[0, 0.9, 0.0, 0.0, 0.4, 0.4],
+                      [0, 0.8, 0.45, 0.45, 0.55, 0.55],
+                      [0, 0.7, 0.6, 0.6, 1.0, 1.0],
+                      [-1, 0.0, 0, 0, 0, 0]]], np.float32)
+    m = em.MApMetric()
+    m.update([gts], [dets])
+    names, values = m.get()
+    # PR points: (r=.5, p=1), (r=.5, p=.5), (r=1, p=2/3)
+    # envelope: p=1 for r<=.5, p=2/3 for .5<r<=1 -> AP = .5 + .5*2/3
+    want = 0.5 + 0.5 * (2.0 / 3.0)
+    assert abs(values[names.index("mAP")] - want) < 1e-6
+
+    v = em.VOC07MApMetric()
+    v.update([gts], [dets])
+    names07, values07 = v.get()
+    # 11-point: max precision at r in {0,.1..,.5} is 1.0, at .6..1.0 is 2/3
+    want07 = (6 * 1.0 + 5 * (2.0 / 3.0)) / 11
+    assert abs(values07[names07.index("mAP")] - want07) < 1e-6
+
+    # duplicate detection on an already-matched gt counts as FP
+    dup = np.array([[[0, 0.95, 0.0, 0.0, 0.4, 0.4],
+                     [0, 0.9, 0.01, 0.0, 0.41, 0.4],
+                     [-1, 0, 0, 0, 0, 0]]], np.float32)
+    one_gt = np.array([[[0, 0.0, 0.0, 0.4, 0.4],
+                        [-1, 0, 0, 0, 0]]], np.float32)
+    m2 = em.MApMetric()
+    m2.update([one_gt], [dup])
+    _n2, v2 = m2.get()
+    assert abs(v2[0] - 1.0) < 1e-6   # recall 1 at precision 1 first
+
+
+def test_map_metric_multiclass_and_missing_class():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ssd_eval_metric2",
+        os.path.join(os.path.dirname(__file__), os.pardir, "examples",
+                     "ssd", "eval_metric.py"))
+    em = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(em)
+
+    # class 1 perfectly detected; class 0 gt never detected -> AP 0;
+    # class 2 detected but has no gt -> excluded from the mean
+    gts = np.array([[[0, 0.0, 0.0, 0.3, 0.3],
+                     [1, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+    dets = np.array([[[1, 0.9, 0.5, 0.5, 0.9, 0.9],
+                      [2, 0.8, 0.1, 0.1, 0.2, 0.2]]], np.float32)
+    m = em.MApMetric(class_names=["a", "b", "c"])
+    m.update([gts], [dets])
+    names, values = m.get()
+    byname = dict(zip(names, values))
+    assert byname["a_ap"] == 0.0
+    assert abs(byname["b_ap"] - 1.0) < 1e-6
+    assert "c_ap" not in byname
+    assert abs(byname["mAP"] - 0.5) < 1e-6

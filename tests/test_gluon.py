@@ -257,3 +257,86 @@ def test_trainer_fused_step_matches_unfused():
         loss = loss_fn(net(x), y)
     loss.backward()
     tr.step(8)  # still works after the roundtrip
+
+
+def test_trainer_fused_step_dynamic_optimizers():
+    """VERDICT r4 item 2: Adam (t-dependent bias correction) and
+    SGD+MultiFactorScheduler fuse WITH fusion actually engaged — the
+    per-step lr enters the compiled program as a traced scalar, so the
+    schedule/bias correction stays dynamic and matches the eager path."""
+    import numpy as np
+
+    from mxnet_tpu import autograd
+
+    def build(fuse, optimizer, opt_params):
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(16, activation="relu", in_units=8))
+        net.add(mx.gluon.nn.Dense(4, in_units=16))
+        net.initialize(mx.initializer.Xavier())
+        tr = mx.gluon.Trainer(net.collect_params(), optimizer,
+                              dict(opt_params), kvstore=None,
+                              fuse_step=fuse)
+        return net, tr
+
+    rng = np.random.RandomState(3)
+    x = mx.nd.array(rng.randn(8, 8).astype("float32"))
+    y = mx.nd.array(rng.randint(0, 4, 8).astype("float32"))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    configs = [
+        ("adam", {"learning_rate": 0.01, "wd": 1e-3}),
+        ("sgd", {"learning_rate": 0.2, "momentum": 0.9,
+                 "lr_scheduler": mx.lr_scheduler.MultiFactorScheduler(
+                     step=[2, 4], factor=0.1)}),
+        ("rmsprop", {"learning_rate": 0.01}),
+        # python-scalar-math optimizers: traced lr must ride through the
+        # NDArray scalar dispatch (round-5 review found NAG/AdaGrad broke)
+        ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+        ("adagrad", {"learning_rate": 0.05}),
+        ("adadelta", {}),
+        ("ftrl", {"learning_rate": 0.05}),
+    ]
+    for name, params in configs:
+        nets = {fuse: build(fuse, name, params) for fuse in (False, True)}
+        assert nets[True][1]._can_fuse(), name  # fusion actually engages
+        vals = [v.data().asnumpy() for v in
+                nets[False][0].collect_params().values()]
+        for net, _tr in nets.values():
+            for p, w in zip(net.collect_params().values(), vals):
+                p.set_data(mx.nd.array(w))
+        for step in range(6):
+            outs = {}
+            for fuse, (net, tr) in nets.items():
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                tr.step(8)
+                outs[fuse] = [p.data().asnumpy()
+                              for p in net.collect_params().values()]
+            for a, b in zip(outs[False], outs[True]):
+                np.testing.assert_allclose(
+                    a, b, rtol=2e-5, atol=1e-6,
+                    err_msg="%s step %d" % (name, step))
+
+
+def test_trainer_fused_lr_change_no_recompile():
+    """set_learning_rate and scheduler decay do NOT rebuild the fused
+    program (lr is a traced input, not a baked constant)."""
+    import numpy as np
+
+    from mxnet_tpu import autograd
+
+    net = mx.gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.initializer.Xavier())
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore=None)
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    for lr in (0.1, 0.05, 0.01):
+        tr.set_learning_rate(lr)
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        tr.step(4)
+    # one signature, one compiled fn across all three lrs
+    assert tr._fused is not None
+    assert tr._fused[0] == tr._fused_signature()

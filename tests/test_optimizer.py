@@ -128,3 +128,22 @@ def test_multi_precision_sgd():
     assert state[1].dtype == np.float32
     opt.update(0, w, mx.nd.array(g, dtype=np.float16), state)
     assert w.dtype == np.float16
+
+def test_create_optimizer_ctor_keyerror_propagates():
+    """A KeyError raised INSIDE an optimizer ctor must not be misreported
+    as an unknown-optimizer lookup miss (round-4 advisor finding)."""
+    import pytest
+    from mxnet_tpu.optimizer import Optimizer
+
+    @Optimizer.register
+    class BrokenCtorOpt(Optimizer):
+        def __init__(self, **kwargs):
+            kwargs["missing_key_raises"]  # KeyError inside the ctor
+
+    try:
+        with pytest.raises(KeyError, match="missing_key_raises"):
+            Optimizer.create_optimizer("brokenctoropt")
+        with pytest.raises(ValueError, match="Cannot find"):
+            Optimizer.create_optimizer("no_such_optimizer")
+    finally:
+        del Optimizer.opt_registry["brokenctoropt"]

@@ -1,0 +1,37 @@
+"""Fast-failing TPU availability probe.
+
+PJRT client creation hangs (not errors) when the tunnel is down, so the
+probe runs device discovery in a child process and kills it after a
+deadline.  Exit 0 = TPU reachable, 1 = not.
+"""
+import os
+import subprocess
+import sys
+
+CHILD = (
+    "import jax; ds = jax.devices(); "
+    "assert ds and ds[0].platform == 'tpu', ds; "
+    "print(len(ds), ds[0].device_kind)"
+)
+
+
+def probe(timeout: float = 45.0) -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", CHILD],
+            timeout=timeout, env=env, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("tpu_probe: TIMEOUT (tunnel down)", file=sys.stderr)
+        return False
+    if out.returncode == 0:
+        print("tpu_probe: OK", out.stdout.strip())
+        return True
+    print("tpu_probe: FAIL", out.stderr.strip()[-200:], file=sys.stderr)
+    return False
+
+
+if __name__ == "__main__":
+    sys.exit(0 if probe(float(sys.argv[1]) if len(sys.argv) > 1 else 45.0) else 1)

@@ -1,0 +1,76 @@
+"""Two-controller (multi-host) dryrun worker.
+
+The single-process dryrun in ``__graft_entry__.py`` exercises the sharded
+train step over one controller's mesh; THIS script is one rank of a
+2-process fake cluster (the reference's ``tools/launch.py -n N --launcher
+local`` analog, tests/nightly/dist_sync_kvstore.py): each process owns 4
+virtual CPU devices, ``jax.distributed.initialize`` wires the controllers
+together, and one data-parallel ResNet train step runs over the GLOBAL
+8-device mesh so the cross-process psum path (ICI/DCN collectives on real
+hardware, gloo here) actually executes.
+
+Run by ``__graft_entry__.dryrun_multichip`` via subprocess; also usable
+standalone:
+
+    python tools/two_controller_dryrun.py <rank> <nprocs> <coordinator>
+"""
+import os
+import sys
+
+
+def main(rank, nprocs, coordinator, devices_per_proc=4):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=%d" % devices_per_proc
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nprocs, process_id=rank)
+
+    import numpy as np
+
+    n_global = nprocs * devices_per_proc
+    assert len(jax.devices()) == n_global, jax.devices()
+    assert jax.process_count() == nprocs
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    from mxnet_tpu.models import get_resnet
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    mesh = make_mesh({"dp": n_global})
+    symbol = get_resnet(num_classes=10, num_layers=18,
+                        image_shape=(3, 32, 32))
+    trainer = ShardedTrainer(
+        symbol, mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    batch = 2 * n_global
+    shapes = {"data": (batch, 3, 32, 32), "softmax_label": (batch,)}
+    state = trainer.init(shapes)
+    rng = np.random.RandomState(0)   # same batch on every controller
+    sharded = trainer.shard_batch({
+        "data": rng.uniform(0, 1, shapes["data"]).astype(np.float32),
+        "softmax_label": rng.randint(0, 10, batch).astype(np.float32)})
+    state, outs = trainer.step(state, sharded)
+    jax.block_until_ready(state["params"])
+
+    # the loss is psum-reduced across BOTH controllers: read this rank's
+    # ADDRESSABLE shards (the global value spans the other controller's
+    # devices too) and check finiteness
+    shards = outs[0].addressable_shards
+    assert shards, "no addressable output shards on rank %d" % rank
+    vals = np.concatenate([np.asarray(s.data).ravel() for s in shards])
+    assert np.isfinite(vals).all(), vals
+    print("rank %d/%d OK loss=%.6f devices=%d" %
+          (rank, nprocs, float(vals[0]), n_global))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])
