@@ -456,10 +456,13 @@ def _register_act():
             return jnp.tanh(x)
         if t == "softrelu":
             return jax.nn.softplus(x)
+        if t == "softsign":
+            return x / (1.0 + jnp.abs(x))
         raise MXNetError("unknown act_type %r" % t)
 
     register_op("Activation", activation,
-                params={"act_type": Enum(["relu", "sigmoid", "tanh", "softrelu"])},
+                params={"act_type": Enum(["relu", "sigmoid", "tanh",
+                                          "softrelu", "softsign"])},
                 num_inputs=1,
                 infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a),
                 doc="Activation (reference: src/operator/activation-inl.h)")
@@ -665,14 +668,20 @@ def _register_dropout():
     jnp = _jnp()
 
     def dropout(attrs, x, is_train=False, rng=None):
-        if not is_train or attrs.p <= 0.0:
+        if (not is_train and attrs.mode != "always") or attrs.p <= 0.0:
             return x
         keep = 1.0 - attrs.p
-        mask = jax.random.bernoulli(rng, keep, x.shape)
+        # axes = broadcast dropout: the mask collapses to size 1 on the
+        # listed axes, dropping whole slices together (variational/
+        # spatial dropout, reference dropout-inl.h DropoutParam::axes)
+        mask_shape = tuple(1 if i in (attrs.axes or ()) else s
+                           for i, s in enumerate(x.shape))
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
         return jnp.where(mask, x / keep, 0.0)
 
     register_op("Dropout", dropout,
                 params={"p": Float(default=0.5),
+                        "axes": Shape(default=()),
                         "mode": Enum(["training", "always"], default="training")},
                 num_inputs=1, needs_is_train=True, needs_rng=True,
                 infer_shape=lambda attrs, i, a: None if i[0] is None else ([i[0]], [i[0]], a),

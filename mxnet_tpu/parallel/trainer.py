@@ -263,6 +263,17 @@ class ShardedTrainer:
         return ({"params": params, "aux": aux, "opt": opt,
                  "step": step0 + n_steps}, outs)
 
+    def lower_step(self, state, batch):
+        """``jax.jit(...).lower(...)`` of the fused train step, for HLO
+        inspection (tools/hlo_layout_audit.py counts layout-moving ops
+        in the optimized module)."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        lr = self._lr(state["step"]) if callable(self._lr) else self._lr
+        return self._step_fn.lower(
+            state["params"], state["aux"], state["opt"], batch,
+            np.float32(lr), np.int32(state["step"]))
+
     def step(self, state, batch):
         """Run one training step; returns (new_state, outputs).
 
