@@ -674,7 +674,8 @@ def _register_dropout():
         # axes = broadcast dropout: the mask collapses to size 1 on the
         # listed axes, dropping whole slices together (variational/
         # spatial dropout, reference dropout-inl.h DropoutParam::axes)
-        mask_shape = tuple(1 if i in (attrs.axes or ()) else s
+        axes = tuple(a % x.ndim for a in (attrs.axes or ()))
+        mask_shape = tuple(1 if i in axes else s
                            for i, s in enumerate(x.shape))
         mask = jax.random.bernoulli(rng, keep, mask_shape)
         return jnp.where(mask, x / keep, 0.0)

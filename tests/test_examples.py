@@ -20,6 +20,8 @@ _EXAMPLES = [
     "examples/sparse/linear_classification.py",
     "examples/gluon/mnist_gluon.py",
     "examples/transformer/train_lm.py",
+    "examples/gan/dcgan.py",
+    "examples/recommenders/matrix_factorization.py",
 ]
 
 

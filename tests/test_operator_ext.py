@@ -322,3 +322,16 @@ def test_where_broadcast_condition_1d():
     got = _fwd(sym, {"c": cond, "a": a, "b": b})[0]
     want = np.where(cond[:, None] != 0, a, b)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_dropout_axes_negative():
+    """Negative axes normalize like positive ones (spatial dropout via
+    axes=(-2,-1))."""
+    mx.random.seed(11)
+    x = np.ones((3, 4, 5, 5), np.float32)
+    sym = mx.sym.Dropout(mx.sym.Variable("data"), p=0.5, axes=(-2, -1))
+    ex = sym.bind(mx.cpu(), args={"data": mx.nd.array(x)})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    for n in range(3):
+        for c in range(4):
+            assert len(np.unique(out[n, c])) == 1

@@ -1,0 +1,116 @@
+"""Fused RNN op vs torch.nn.{RNN,LSTM,GRU} across a mode/layers/
+bidirectional grid (VERDICT r4 item 4 — high-risk family depth; the
+reference validates its cuDNN RNN against CPU reimplementations in
+tests/python/gpu/test_operator_gpu.py).
+
+The packed flat parameter vector follows FusedRNNCell's convention
+(weights layer-major direction-minor, then all biases; gate order LSTM
+i,f,c,o / GRU r,z,n — the cuDNN order torch shares), so torch module
+weights map in directly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.rnn import rnn_param_size
+
+_r = np.random.RandomState(31)
+
+
+def _pack_torch_params(tmod, num_layers, bidirectional):
+    """Flatten torch RNN weights into the FusedRNNCell layout."""
+    dirs = 2 if bidirectional else 1
+    ws, bs = [], []
+    for layer in range(num_layers):
+        for d in range(dirs):
+            sfx = "_l%d%s" % (layer, "_reverse" if d else "")
+            ws.append(getattr(tmod, "weight_ih" + sfx).detach().numpy()
+                      .ravel())
+            ws.append(getattr(tmod, "weight_hh" + sfx).detach().numpy()
+                      .ravel())
+    for layer in range(num_layers):
+        for d in range(dirs):
+            sfx = "_l%d%s" % (layer, "_reverse" if d else "")
+            bs.append(getattr(tmod, "bias_ih" + sfx).detach().numpy())
+            bs.append(getattr(tmod, "bias_hh" + sfx).detach().numpy())
+    return np.concatenate(ws + bs).astype(np.float64)
+
+
+_GRID = [(mode, L, bi)
+         for mode in ("rnn_tanh", "rnn_relu", "lstm", "gru")
+         for L in (1, 2)
+         for bi in (False, True)]
+
+
+@pytest.mark.parametrize("mode,num_layers,bidirectional", _GRID,
+                         ids=["%s-L%d-%s" % (m, l, "bi" if b else "uni")
+                              for m, l, b in _GRID])
+def test_fused_rnn_torch_parity(mode, num_layers, bidirectional):
+    import torch
+
+    T, N, I, H = 5, 3, 4, 6
+    dirs = 2 if bidirectional else 1
+    torch.manual_seed(0)
+    cls = {"rnn_tanh": torch.nn.RNN, "rnn_relu": torch.nn.RNN,
+           "lstm": torch.nn.LSTM, "gru": torch.nn.GRU}[mode]
+    kw = {"nonlinearity": "tanh" if mode == "rnn_tanh" else "relu"} \
+        if mode.startswith("rnn") else {}
+    tmod = cls(I, H, num_layers=num_layers, bidirectional=bidirectional,
+               **kw).double()
+
+    params = _pack_torch_params(tmod, num_layers, bidirectional)
+    assert params.size == rnn_param_size(num_layers, H, I, mode,
+                                         bidirectional)
+
+    x = _r.randn(T, N, I)
+    h0 = _r.randn(num_layers * dirs, N, H) * 0.3
+    c0 = _r.randn(num_layers * dirs, N, H) * 0.3
+
+    tin = torch.tensor(x)
+    th0 = torch.tensor(h0)
+    if mode == "lstm":
+        tout, (thT, tcT) = tmod(tin, (th0, torch.tensor(c0)))
+    else:
+        tout, thT = tmod(tin, th0)
+
+    args = {"data": mx.nd.array(x),
+            "parameters": mx.nd.array(params),
+            "state": mx.nd.array(h0)}
+    syms = [mx.sym.Variable("data"), mx.sym.Variable("parameters"),
+            mx.sym.Variable("state")]
+    if mode == "lstm":
+        args["state_cell"] = mx.nd.array(c0)
+        syms.append(mx.sym.Variable("state_cell"))
+    sym = mx.sym.RNN(*syms, state_size=H, num_layers=num_layers,
+                     mode=mode, bidirectional=bidirectional,
+                     state_outputs=True)
+    ex = sym.bind(mx.cpu(), args=args)
+    ex.forward(is_train=False)
+    got = [o.asnumpy() for o in ex.outputs]
+
+    np.testing.assert_allclose(got[0], tout.detach().numpy(),
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(got[1], thT.detach().numpy(),
+                               rtol=1e-6, atol=1e-8)
+    if mode == "lstm":
+        np.testing.assert_allclose(got[2], tcT.detach().numpy(),
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_fused_rnn_gradient_check():
+    """Finite-difference gradients through the fused LSTM (data + packed
+    params + initial states)."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    T, N, I, H = 3, 2, 3, 4
+    psize = rnn_param_size(1, H, I, "lstm")
+    loc = {"data": _r.randn(T, N, I),
+           "parameters": _r.randn(psize) * 0.2,
+           "state": np.zeros((1, N, H)),
+           "state_cell": np.zeros((1, N, H))}
+    sym = mx.sym.RNN(mx.sym.Variable("data"), mx.sym.Variable("parameters"),
+                     mx.sym.Variable("state"),
+                     mx.sym.Variable("state_cell"),
+                     state_size=H, num_layers=1, mode="lstm")
+    check_numeric_gradient(sym, loc, numeric_eps=1e-4, rtol=1e-2,
+                           atol=1e-3, dtype=np.float64)
