@@ -1,0 +1,133 @@
+"""Stacked MLP autoencoder on MNIST digits (reference:
+example/autoencoder/autoencoder.py + model.py — 784-500-250-128 encoder,
+mirrored decoder, MSE reconstruction).
+
+Trains end-to-end (no layer-wise pretraining; Adam makes it redundant),
+reports reconstruction MSE, and checks the bottleneck code carries class
+information via a linear probe — the quality signal the reference's
+clustering demo (mnist_sae.py) relies on.
+
+Usage:
+    python examples/autoencoder/autoencoder.py
+    python examples/autoencoder/autoencoder.py --smoke
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_ae(dims=(784, 500, 250, 128)):
+    data = mx.sym.Variable("data")
+    x = mx.sym.Flatten(data)
+    for i, d in enumerate(dims[1:]):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="enc%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu")
+    code = x
+    for i, d in enumerate(reversed(dims[:-1])):
+        x = mx.sym.Activation(x, act_type="relu")
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="dec%d" % i)
+    recon = mx.sym.Activation(x, act_type="sigmoid")
+    loss = mx.sym.LinearRegressionOutput(
+        data=mx.sym.Flatten(recon), label=mx.sym.Variable("label"))
+    return mx.sym.Group([loss, mx.sym.BlockGrad(code)])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--n", type=int, default=8000)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.epochs, args.n = 2, 1500
+
+    mnist = mx.test_utils.get_mnist()
+    args.n = min(args.n, len(mnist["train_data"]))
+    imgs = mnist["train_data"][:args.n].reshape(args.n, -1)
+    labels = mnist["train_label"][:args.n]
+
+    sym = build_ae()
+    N = args.batch_size
+    ex = sym.simple_bind(mx.cpu(), grad_req="write",
+                         data=(N, 784), label=(N, 784))
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "label"):
+            continue
+        fan_in = arr.shape[-1] if arr.ndim > 1 else 1
+        arr[:] = (rng.randn(*arr.shape)
+                  * np.sqrt(2.0 / fan_in)).astype(np.float32)
+
+    # Adam state
+    mstate = {k: (np.zeros(v.shape, np.float32), np.zeros(v.shape,
+                                                          np.float32))
+              for k, v in ex.arg_dict.items() if k not in ("data", "label")}
+    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+    t = 0
+    first = last = None
+    for epoch in range(args.epochs):
+        order = rng.permutation(args.n)
+        losses = []
+        for b0 in range(0, args.n - N + 1, N):
+            idx = order[b0:b0 + N]
+            ex.arg_dict["data"][:] = imgs[idx]
+            ex.arg_dict["label"][:] = imgs[idx]
+            ex.forward(is_train=True)
+            recon = ex.outputs[0].asnumpy()
+            losses.append(float(((recon - imgs[idx]) ** 2).mean()))
+            ex.backward()
+            t += 1
+            for name, grad in ex.grad_dict.items():
+                if grad is None or name in ("data", "label"):
+                    continue
+                g = grad.asnumpy() / N
+                m, v = mstate[name]
+                m[:] = b1 * m + (1 - b1) * g
+                v[:] = b2 * v + (1 - b2) * g * g
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+                ex.arg_dict[name][:] = (
+                    ex.arg_dict[name].asnumpy()
+                    - lr * mhat / (np.sqrt(vhat) + eps))
+        mean = float(np.mean(losses))
+        if first is None:
+            first = mean
+        last = mean
+        print("epoch %2d  recon MSE %.5f" % (epoch, mean))
+
+    print("recon MSE: %.5f -> %.5f" % (first, last))
+    assert last < first * (0.8 if args.smoke else 0.5), (first, last)
+
+    # linear probe on the 128-d bottleneck code: the representation must
+    # be linearly separable well above chance (10 classes -> 0.1)
+    codes = []
+    for b0 in range(0, args.n - N + 1, N):
+        ex.arg_dict["data"][:] = imgs[b0:b0 + N]
+        ex.arg_dict["label"][:] = imgs[b0:b0 + N]
+        ex.forward(is_train=False)
+        codes.append(ex.outputs[1].asnumpy())
+    codes = np.concatenate(codes)
+    y = labels[:len(codes)].astype(int)
+    n_tr = int(0.8 * len(codes))
+    # one ridge-regression probe per class (closed form)
+    Xp = np.concatenate([codes, np.ones((len(codes), 1))], axis=1)
+    Yp = np.eye(10)[y]
+    A = Xp[:n_tr].T @ Xp[:n_tr] + 1e-2 * np.eye(Xp.shape[1])
+    W = np.linalg.solve(A, Xp[:n_tr].T @ Yp[:n_tr])
+    acc = float((np.argmax(Xp[n_tr:] @ W, 1) == y[n_tr:]).mean())
+    print("bottleneck linear-probe accuracy: %.3f" % acc)
+    assert acc > (0.4 if args.smoke else 0.7), acc
+    print("AUTOENCODER_OK")
+
+
+if __name__ == "__main__":
+    main()

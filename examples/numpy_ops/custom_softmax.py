@@ -1,0 +1,113 @@
+"""Custom numpy operator in a training graph (reference:
+example/numpy-ops/custom_softmax.py — a Softmax head implemented in
+numpy through CustomOp/CustomOpProp, then trained with Module).
+
+Shows the full custom-op surface: forward/backward in numpy, shape
+inference via CustomOpProp, registration, symbolic use, and a training
+run that matches the built-in SoftmaxOutput's learning curve.
+
+Usage:
+    python examples/numpy_ops/custom_softmax.py [--smoke]
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(e / e.sum(axis=1, keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        prob = out_data[0].asnumpy()
+        label = in_data[1].asnumpy().astype(int)
+        grad = prob.copy()
+        grad[np.arange(len(label)), label] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(grad))
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def build(use_custom):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    if use_custom:
+        return mx.sym.Custom(net, label, op_type="numpy_softmax",
+                             name="softmax")
+    return mx.sym.SoftmaxOutput(net, label, name="softmax")
+
+
+def run(use_custom, epochs, train, val):
+    mod = mx.mod.Module(build(use_custom), context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    train.reset()
+    val.reset()
+    mod.fit(train, eval_data=val, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric=metric)
+    val.reset()
+    m = mx.metric.Accuracy()
+    mod.score(val, m)
+    return m.get()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.epochs = 2
+
+    mnist = mx.test_utils.get_mnist()
+    n = 1500 if args.smoke else 5000
+    train = mx.io.NDArrayIter(mnist["train_data"][:n],
+                              mnist["train_label"][:n],
+                              batch_size=100, shuffle=True)
+    val = mx.io.NDArrayIter(mnist["train_data"][n:n + 500],
+                            mnist["train_label"][n:n + 500],
+                            batch_size=100)
+
+    acc_custom = run(True, args.epochs, train, val)
+    acc_builtin = run(False, args.epochs, train, val)
+    print("val acc: custom numpy softmax %.4f, built-in %.4f"
+          % (acc_custom, acc_builtin))
+    assert acc_custom > 0.8, acc_custom
+    assert abs(acc_custom - acc_builtin) < 0.1, (acc_custom, acc_builtin)
+    print("CUSTOM_OP_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,10 @@ _EXAMPLES = [
     "examples/transformer/train_lm.py",
     "examples/gan/dcgan.py",
     "examples/recommenders/matrix_factorization.py",
+    "examples/rnn/char_rnn.py",
+    "examples/autoencoder/autoencoder.py",
+    "examples/numpy_ops/custom_softmax.py",
+    "examples/profiler/profile_training.py",
 ]
 
 
