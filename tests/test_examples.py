@@ -26,6 +26,7 @@ _EXAMPLES = [
     "examples/autoencoder/autoencoder.py",
     "examples/numpy_ops/custom_softmax.py",
     "examples/profiler/profile_training.py",
+    "examples/reinforcement_learning/dqn_gridworld.py",
 ]
 
 

@@ -32,6 +32,14 @@ def main():
     accel = [d for d in jax.devices() if d.platform != "cpu"]
     if not accel:
         print("no accelerator attached; nothing to compare")
+        out_path = os.environ.get("CONSISTENCY_JSON")
+        if out_path:
+            import json
+
+            with open(out_path, "w") as f:
+                json.dump({"device": None, "checked": 0,
+                           "error": "no accelerator attached"}, f)
+            print("artifact:", out_path)
         return 0
     dev = accel[0]
     print("comparing cpu(%s) vs %s over %d op cases"
@@ -78,6 +86,15 @@ def main():
     checked -= skipped
     print("checked %d cases (%d rng-skipped), %d failures"
           % (checked, skipped, len(failures)))
+    out_path = os.environ.get("CONSISTENCY_JSON")
+    if out_path:
+        import json
+
+        with open(out_path, "w") as f:
+            json.dump({"device": str(dev), "checked": checked,
+                       "rng_skipped": skipped,
+                       "failures": [list(x) for x in failures]}, f)
+        print("artifact:", out_path)
     return 1 if failures else 0
 
 
