@@ -100,7 +100,10 @@ def main():
     # fast-fail probe BEFORE creating the in-process PJRT client: when
     # the tunnel is down, client creation hangs (not errors), and even
     # the watchdog then burns its whole limit. The probe pays <=90s.
-    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    non_tpu_requested = plat and not any(
+        p.strip() in ("tpu", "axon") for p in plat.split(","))
+    if os.environ.get("BENCH_SKIP_PROBE") != "1" and not non_tpu_requested:
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         from tpu_probe import probe

@@ -1,0 +1,13 @@
+"""bi-lstm-sort data: sequences of random digits and their sorted order
+(reference: example/bi-lstm-sort/sort_io.py)."""
+import numpy as np
+
+
+def make_batches(n, seq_len, vocab, batch_size, seed=0):
+    """Yield (input, target) int arrays of shape (batch, seq_len)."""
+    rng = np.random.RandomState(seed)
+    xs = rng.randint(0, vocab, (n, seq_len))
+    ys = np.sort(xs, axis=1)
+    for b0 in range(0, n - batch_size + 1, batch_size):
+        yield (xs[b0:b0 + batch_size].astype(np.float32),
+               ys[b0:b0 + batch_size].astype(np.float32))

@@ -90,10 +90,10 @@ def main():
                          rnn_params=(psize,),
                          rnn_state=(1, N, args.hidden),
                          rnn_state_cell=(1, N, args.hidden))
+    NON_PARAMS = ("data", "softmax_label", "rnn_state", "rnn_state_cell")
     rng = np.random.RandomState(0)
     for name, arr in ex.arg_dict.items():
-        if name in ("data", "softmax_label", "rnn_state",
-                    "rnn_state_cell"):
+        if name in NON_PARAMS:
             continue
         arr[:] = (rng.randn(*arr.shape) * 0.08).astype(np.float32)
 
@@ -115,7 +115,7 @@ def main():
                 prob[np.arange(len(tgt)), tgt], 1e-9)).mean())
             ex.backward()
             for name, grad in ex.grad_dict.items():
-                if grad is None or name in ("data", "softmax_label"):
+                if grad is None or name in NON_PARAMS:
                     continue
                 ex.arg_dict[name][:] = (ex.arg_dict[name].asnumpy()
                                         - lr * np.clip(grad.asnumpy(),

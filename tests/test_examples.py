@@ -27,6 +27,7 @@ _EXAMPLES = [
     "examples/numpy_ops/custom_softmax.py",
     "examples/profiler/profile_training.py",
     "examples/reinforcement_learning/dqn_gridworld.py",
+    "examples/bi_lstm_sort/lstm_sort.py",
 ]
 
 

@@ -11,6 +11,7 @@ within per-dtype tolerances.
 Run on a TPU machine:  python tools/check_device_consistency.py
 Prints one line per mismatch and a summary; exit code 1 on any failure.
 """
+import json
 import os
 import sys
 
@@ -18,6 +19,16 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir, "tests"))
+
+
+def _write_artifact(payload):
+    """Write the CONSISTENCY_JSON artifact (uniform schema: device,
+    checked, rng_skipped, failures, error)."""
+    out_path = os.environ.get("CONSISTENCY_JSON")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(payload, f)
+        print("artifact:", out_path)
 
 
 def main():
@@ -32,14 +43,9 @@ def main():
     accel = [d for d in jax.devices() if d.platform != "cpu"]
     if not accel:
         print("no accelerator attached; nothing to compare")
-        out_path = os.environ.get("CONSISTENCY_JSON")
-        if out_path:
-            import json
-
-            with open(out_path, "w") as f:
-                json.dump({"device": None, "checked": 0,
-                           "error": "no accelerator attached"}, f)
-            print("artifact:", out_path)
+        _write_artifact({"device": None, "checked": 0, "rng_skipped": 0,
+                         "failures": [],
+                         "error": "no accelerator attached"})
         return 0
     dev = accel[0]
     print("comparing cpu(%s) vs %s over %d op cases"
@@ -86,15 +92,10 @@ def main():
     checked -= skipped
     print("checked %d cases (%d rng-skipped), %d failures"
           % (checked, skipped, len(failures)))
-    out_path = os.environ.get("CONSISTENCY_JSON")
-    if out_path:
-        import json
-
-        with open(out_path, "w") as f:
-            json.dump({"device": str(dev), "checked": checked,
-                       "rng_skipped": skipped,
-                       "failures": [list(x) for x in failures]}, f)
-        print("artifact:", out_path)
+    _write_artifact({"device": str(dev), "checked": checked,
+                     "rng_skipped": skipped,
+                     "failures": [list(x) for x in failures],
+                     "error": None})
     return 1 if failures else 0
 
 
