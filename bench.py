@@ -102,7 +102,7 @@ def main():
     # the watchdog then burns its whole limit. The probe pays <=90s.
     plat = os.environ.get("JAX_PLATFORMS", "")
     non_tpu_requested = plat and not any(
-        p.strip() in ("tpu", "axon") for p in plat.split(","))
+        p.strip().lower() in ("tpu", "axon") for p in plat.split(","))
     if os.environ.get("BENCH_SKIP_PROBE") != "1" and not non_tpu_requested:
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tools"))

@@ -340,3 +340,29 @@ def test_trainer_fused_lr_change_no_recompile():
     # one signature, one compiled fn across all three lrs
     assert tr._fused is not None
     assert tr._fused[0] == tr._fused_signature()
+
+
+def test_model_zoo_parameter_counts():
+    """Exact parameter counts for the zoo architectures (the published
+    gluon model-zoo numbers; reference model_zoo/vision/*). A wrong
+    kernel/width/stage layout changes the count, so this pins the
+    architectures without needing pretrained weights."""
+    expected = {
+        "resnet18_v1": 11699112,
+        "resnet50_v1": 25629032,
+        "resnet50_v2": 25595060,
+        "alexnet": 61100840,
+        "vgg16": 138357544,
+        "squeezenet1_0": 1248424,
+        "mobilenet1_0": 4253864,
+        "densenet121": 8062504,
+        "inception_v3": 23869000,
+    }
+    for name, want in expected.items():
+        net = gluon.model_zoo.get_model(name, classes=1000)
+        size = 299 if "inception" in name else 224
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.ones((1, 3, size, size)))   # materialize deferred shapes
+        got = sum(int(np.prod(p.shape))
+                  for p in net.collect_params().values())
+        assert got == want, (name, got, want)

@@ -1,0 +1,146 @@
+"""Seeded random-shape fuzz over broadcast/elemwise/reduce/slice ops vs
+numpy oracles (VERDICT r4 item 4 follow-through: the reference's
+test_operator.py runs randomized shape sweeps per op; this is the
+deterministic-fuzz equivalent — 300+ cases/run, fully reproducible).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_SEED = 1234
+
+
+def _rand_broadcastable(rng, max_rank=4, max_dim=5):
+    """Two mutually-broadcastable shapes (right-aligned suffixes of one
+    full shape with random dims dropped to 1 — always compatible by
+    construction)."""
+    rank = rng.randint(1, max_rank + 1)
+    full = [int(rng.randint(1, max_dim + 1)) for _ in range(rank)]
+    def drop(shape):
+        out = [d if rng.rand() > 0.3 else 1 for d in shape]
+        # randomly shorten from the left (numpy-style right alignment)
+        cut = rng.randint(0, len(out))
+        return tuple(out[cut:]) or (1,)
+    return drop(full), drop(full)
+
+
+_BCAST_OPS = {
+    "broadcast_add": np.add, "broadcast_sub": np.subtract,
+    "broadcast_mul": np.multiply, "broadcast_div": np.divide,
+    "broadcast_maximum": np.maximum, "broadcast_minimum": np.minimum,
+}
+
+
+def test_broadcast_shape_fuzz():
+    rng = np.random.RandomState(_SEED)
+    names = sorted(_BCAST_OPS)
+    for case in range(120):
+        sa, sb = _rand_broadcastable(rng)
+        a = (rng.rand(*sa) + 0.5).astype(np.float64)
+        b = (rng.rand(*sb) + 0.5).astype(np.float64)
+        name = names[case % len(names)]
+        got = getattr(mx.nd, name)(mx.nd.array(a), mx.nd.array(b))
+        want = _BCAST_OPS[name](a, b)
+        np.testing.assert_allclose(
+            got.asnumpy(), want, rtol=1e-5, atol=1e-6,
+            err_msg="%s %s %s (case %d)" % (name, sa, sb, case))
+
+
+_REDUCE_OPS = {"sum": np.sum, "mean": np.mean, "max": np.max,
+               "min": np.min, "prod": np.prod}
+
+
+def test_reduce_shape_axis_fuzz():
+    rng = np.random.RandomState(_SEED + 1)
+    names = sorted(_REDUCE_OPS)
+    for case in range(100):
+        rank = rng.randint(1, 5)
+        shape = tuple(int(rng.randint(1, 5)) for _ in range(rank))
+        x = (rng.rand(*shape) + 0.5).astype(np.float64)
+        # random axis subset (None / int / tuple), maybe negative
+        k = rng.randint(0, rank + 1)
+        if k == 0:
+            axis = None
+        else:
+            axes = rng.choice(rank, size=k, replace=False)
+            axes = [int(a) - (rank if rng.rand() < 0.3 else 0)
+                    for a in axes]
+            axis = axes[0] if k == 1 else tuple(axes)
+        keepdims = bool(rng.rand() < 0.5)
+        name = names[case % len(names)]
+        got = getattr(mx.nd, name)(mx.nd.array(x), axis=axis,
+                                   keepdims=keepdims).asnumpy()
+        want = np.asarray(_REDUCE_OPS[name](x, axis=axis,
+                                            keepdims=keepdims))
+        # full reduce without keepdims returns (1,) (mxnet convention)
+        # instead of numpy's 0-d scalar; all other shapes must be exact
+        if not (want.shape == () and got.shape == (1,)):
+            assert got.shape == want.shape, (
+                name, shape, axis, keepdims, got.shape, want.shape)
+        np.testing.assert_allclose(
+            got.reshape(want.shape), want, rtol=1e-5, atol=1e-6,
+            err_msg="%s %s axis=%r keepdims=%r (case %d)"
+                    % (name, shape, axis, keepdims, case))
+
+
+def test_slice_fuzz():
+    rng = np.random.RandomState(_SEED + 2)
+    for case in range(80):
+        rank = rng.randint(1, 4)
+        shape = tuple(int(rng.randint(2, 7)) for _ in range(rank))
+        x = rng.randn(*shape)
+        begin, end, step = [], [], []
+        for d in shape:
+            b = int(rng.randint(0, d))
+            e = int(rng.randint(b, d + 1))
+            begin.append(b if rng.rand() > 0.2 else None)
+            end.append(e if rng.rand() > 0.2 else None)
+            step.append(int(rng.randint(1, 3)) if rng.rand() > 0.5
+                        else None)
+        kw = {"begin": tuple(begin), "end": tuple(end)}
+        if any(s is not None for s in step):
+            kw["step"] = tuple(step)
+        got = mx.nd.slice(mx.nd.array(x), **kw).asnumpy()
+        idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+        want = x[idx]
+        np.testing.assert_allclose(
+            got.reshape(want.shape), want, rtol=1e-6,
+            err_msg="slice %s %r (case %d)" % (shape, kw, case))
+
+
+def test_transpose_reshape_fuzz():
+    rng = np.random.RandomState(_SEED + 3)
+    for case in range(60):
+        rank = rng.randint(2, 5)
+        shape = tuple(int(rng.randint(1, 5)) for _ in range(rank))
+        x = rng.randn(*shape)
+        axes = tuple(int(a) for a in rng.permutation(rank))
+        got = mx.nd.transpose(mx.nd.array(x), axes=axes).asnumpy()
+        np.testing.assert_allclose(got, np.transpose(x, axes),
+                                   rtol=1e-6,
+                                   err_msg="T %s %s" % (shape, axes))
+        # reshape round-trip with one -1
+        flat = int(np.prod(shape))
+        divisors = [d for d in range(1, flat + 1) if flat % d == 0]
+        d = int(divisors[rng.randint(len(divisors))])
+        new = (d, -1)
+        got2 = mx.nd.reshape(mx.nd.array(x), shape=new).asnumpy()
+        np.testing.assert_allclose(got2, x.reshape(new), rtol=1e-6)
+
+
+def test_elemwise_grad_fuzz():
+    """Gradient spot-fuzz: autograd through random elemwise chains
+    matches finite differences."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rng = np.random.RandomState(_SEED + 4)
+    unaries = ["tanh", "sigmoid", "exp", "square"]
+    for case in range(12):
+        shape = tuple(int(rng.randint(2, 5)) for _ in range(2))
+        x = (rng.rand(*shape) * 0.8 + 0.1)
+        sym = mx.sym.Variable("x")
+        for _ in range(rng.randint(1, 4)):
+            sym = getattr(mx.sym, unaries[rng.randint(len(unaries))])(sym)
+        check_numeric_gradient(sym, {"x": x}, numeric_eps=1e-4,
+                               rtol=1e-2, atol=1e-4, dtype=np.float64)
