@@ -31,6 +31,14 @@ Flags currently honored:
     Debug switch: run graph programs eagerly (op-by-op) instead of one
     compiled XLA program — the analog of MXNET_ENGINE_TYPE=NaiveEngine
     for hunting numeric/tracing bugs.
+
+``MXNET_DEBUG_NANS`` (default 0)
+    Turn on jax_debug_nans: any NaN produced by a compiled program
+    raises at the producing op (SURVEY §5.2's debug lever — the TPU
+    analog of the reference's NaiveEngine + MXNET_ENGINE_INFO hunt for
+    silent corruption). Set the env var before import, or call
+    ``config.set_flag("MXNET_DEBUG_NANS", 1)`` at runtime. Combine with
+    MXNET_EXEC_DISABLE_JIT=1 to localize to a single eager op.
 """
 import os
 
@@ -46,7 +54,17 @@ _DEFAULTS = {
     # SelectAndScatter (each window's gradient splits evenly across
     # tied maxima; see ops/nn.py _maxpool_mask_bwd)
     "MXNET_POOLING_MASK_BWD": 0,
+    "MXNET_DEBUG_NANS": 0,
 }
+
+
+def _apply_debug_nans(value):
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(value))
+
+
+_APPLIERS = {"MXNET_DEBUG_NANS": _apply_debug_nans}
 
 
 def get_flag(name, default=None):
@@ -67,7 +85,15 @@ def set_flag(name, value):
         _overrides.pop(name, None)
     else:
         _overrides[name] = int(value)
+    if name in _APPLIERS:
+        _APPLIERS[name](get_flag(name))
 
 
 def flag_doc():
     return __doc__
+
+
+# env-set appliers take effect at import (flag levers that configure
+# the backend rather than being polled per call)
+if get_flag("MXNET_DEBUG_NANS"):
+    _apply_debug_nans(1)

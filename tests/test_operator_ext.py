@@ -335,3 +335,21 @@ def test_dropout_axes_negative():
     for n in range(3):
         for c in range(4):
             assert len(np.unique(out[n, c])) == 1
+
+
+def test_debug_nans_lever():
+    """MXNET_DEBUG_NANS (SURVEY §5.2's race/corruption-hunt lever, the
+    NaiveEngine-debug analog): compiled programs raise at the op that
+    produces a NaN instead of propagating it silently."""
+    from mxnet_tpu import config
+
+    x = mx.nd.array(np.array([0.0, 1.0], np.float32))
+    config.set_flag("MXNET_DEBUG_NANS", 1)
+    try:
+        with pytest.raises(FloatingPointError):
+            (mx.nd.log(x) * 0.0).asnumpy()   # log(0) = -inf; -inf*0 = nan
+    finally:
+        config.set_flag("MXNET_DEBUG_NANS", None)
+    # cleared: NaN propagates silently again
+    out = (mx.nd.log(x) * 0.0).asnumpy()
+    assert np.isnan(out[0])
