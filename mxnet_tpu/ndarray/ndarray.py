@@ -37,6 +37,23 @@ def _ctx_of(data):
     return Context("gpu", dev.id)
 
 
+def _unwrap_index(key):
+    """Normalize an indexing key: NDArray index arrays (bare or inside a
+    tuple) become raw integer arrays — the reference accepts NDArray
+    advanced indices, float-typed, truncating to int (ndarray.py
+    _get_nd_basic/advanced_indexing)."""
+    def one(k):
+        if isinstance(k, NDArray):
+            k = k._data
+            if k.dtype.kind == "f":
+                k = k.astype("int32")
+        return k
+
+    if isinstance(key, tuple):
+        return tuple(one(k) for k in key)
+    return one(key)
+
+
 def _from_data(data, ctx=None):
     """Wrap a raw jax array into NDArray without copy."""
     arr = NDArray.__new__(NDArray)
@@ -174,6 +191,7 @@ class NDArray:
             value = value._data
         elif not np.isscalar(value):
             value = np.asarray(value)
+        key = _unwrap_index(key)
         if isinstance(key, slice) and key == slice(None):
             jnp = _jnp()
             self._set_data(jnp.broadcast_to(value, self.shape).astype(self._data.dtype))
@@ -183,8 +201,7 @@ class NDArray:
     def __getitem__(self, key):
         from .register import record_apply
 
-        if isinstance(key, NDArray):
-            key = key._data
+        key = _unwrap_index(key)
         return record_apply(lambda x: x[key], [self], name="index")[0]
 
     # --- autograd ---------------------------------------------------------

@@ -185,3 +185,63 @@ def test_tools_im2rec_rec2idx(tmp_path):
     n = rec2idx.rec2idx(prefix + ".rec", prefix + ".idx")
     assert n == 6
     assert open(prefix + ".idx").read() == idx_before
+
+
+def test_ndarray_indexing_grid():
+    """__getitem__ grid vs numpy: ints, negative ints, stepped slices,
+    tuples, Ellipsis, None (newaxis), integer-array indexing
+    (reference: test_ndarray.py test_ndarray_indexing)."""
+    base = np.arange(2 * 3 * 4 * 5, dtype=np.float32).reshape(2, 3, 4, 5)
+    nd = mx.nd.array(base)
+    cases = [
+        1, -1, (0,), (slice(None), 1), (slice(1, None), slice(None, 2)),
+        (slice(None, None, 2), slice(None), slice(1, 4, 2)),
+        (Ellipsis, 0), (0, Ellipsis, -2), (None, 0), (0, None, 1),
+        (slice(None), np.array([0, 2])), np.array([1, 0, 1]),
+        (np.array([0, 1]), np.array([2, 0])),
+        (0, slice(None, None, -1)),
+    ]
+    def to_mx(k):
+        """Index arrays go through NDArray (the reference accepts
+        NDArray advanced indices, bare or inside tuples)."""
+        if isinstance(k, np.ndarray):
+            return mx.nd.array(k)
+        if isinstance(k, tuple):
+            return tuple(to_mx(e) for e in k)
+        return k
+
+    for key in cases:
+        got = nd[to_mx(key)]
+        want = base[key]
+        np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6,
+                                   err_msg=str(key))
+        assert got.shape == want.shape, key
+
+
+def test_ndarray_setitem_grid():
+    base = np.zeros((4, 5), np.float32)
+    cases = [
+        (1, 7.0),
+        ((slice(1, 3), slice(0, 2)), 3.0),
+        ((slice(None), 4), np.arange(4, dtype=np.float32)),
+        ((slice(None, None, 2),), -1.0),
+    ]
+    for key, value in cases:
+        nd = mx.nd.array(base)
+        want = base.copy()
+        nd[key] = value
+        want[key] = value
+        np.testing.assert_allclose(nd.asnumpy(), want, rtol=1e-6,
+                                   err_msg=str(key))
+    # NDArray advanced index to __setitem__ (round-5 review found this
+    # path raised IndexError)
+    nd = mx.nd.array(base)
+    want = base.copy()
+    nd[mx.nd.array(np.array([1, 3], np.float32))] = 5.0
+    want[np.array([1, 3])] = 5.0
+    np.testing.assert_allclose(nd.asnumpy(), want, rtol=1e-6)
+    nd2 = mx.nd.array(base)
+    want2 = base.copy()
+    nd2[(mx.nd.array(np.array([0, 2])), slice(0, 2))] = 9.0
+    want2[(np.array([0, 2]), slice(0, 2))] = 9.0
+    np.testing.assert_allclose(nd2.asnumpy(), want2, rtol=1e-6)
