@@ -366,3 +366,29 @@ def test_model_zoo_parameter_counts():
         got = sum(int(np.prod(p.shape))
                   for p in net.collect_params().values())
         assert got == want, (name, got, want)
+
+
+def test_bench_gluon_config_engages_fusion():
+    """Guard for the BENCH_ALL gluon config: the exact bench_all setup
+    (hybridized zoo net + Trainer(kvstore='local') on one device) must
+    take the FUSED update path — the recorded 2.0 img/s came from the
+    per-param dispatch path riding tunnel RTT (PERF_NOTES round 4)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05}, kvstore="local")
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(2, 3, 32, 32).astype(np.float32))
+    y = mx.nd.array(np.array([1.0, 3.0], np.float32))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.step(2)
+    assert tr._kvstore is None          # single-device local -> no kv
+    assert tr._can_fuse()
+    assert tr._fused is not None        # the fused program actually ran
