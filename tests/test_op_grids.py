@@ -339,3 +339,95 @@ def test_split_grid(shape, nout, axis):
     assert len(ex.outputs) == nout
     for o, w in zip(ex.outputs, wants):
         np.testing.assert_allclose(o.asnumpy(), w, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("stride,pad,dilate", [
+    (1, 0, 1), (2, 1, 1), (1, 2, 2),
+], ids=["s1", "s2p1", "d2"])
+def test_convolution_1d_torch_parity(stride, pad, dilate):
+    """1-D Convolution (reference conv supports 1/2/3-D kernels)."""
+    import torch
+    import torch.nn.functional as F
+
+    x, w, b = _nd(2, 3, 12), _nd(5, 3, 3) * 0.3, _nd(5) * 0.1
+    sym = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3,),
+                             stride=(stride,), pad=(pad,),
+                             dilate=(dilate,), num_filter=5, name="c")
+    ex = sym.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                  "c_weight": mx.nd.array(w),
+                                  "c_bias": mx.nd.array(b)})
+    ex.forward(is_train=False)
+    want = F.conv1d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=stride, padding=pad, dilation=dilate).numpy()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want,
+                               rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(sym, {"data": x, "c_weight": w, "c_bias": b},
+                           numeric_eps=1e-4, rtol=1e-2, atol=1e-3,
+                           dtype=np.float64)
+
+
+def test_convolution_3d_torch_parity():
+    import torch
+    import torch.nn.functional as F
+
+    x = _nd(2, 3, 5, 6, 7)
+    w = _nd(4, 3, 2, 3, 2) * 0.3
+    b = _nd(4) * 0.1
+    sym = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(2, 3, 2),
+                             stride=(1, 2, 1), pad=(1, 0, 1),
+                             num_filter=4, name="c")
+    ex = sym.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                  "c_weight": mx.nd.array(w),
+                                  "c_bias": mx.nd.array(b)})
+    ex.forward(is_train=False)
+    want = F.conv3d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=(1, 2, 1), padding=(1, 0, 1)).numpy()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pooling_1d_3d_torch_parity(ptype):
+    import torch
+    import torch.nn.functional as F
+
+    # 1-D
+    x1 = _nd(2, 3, 11)
+    s1 = mx.sym.Pooling(mx.sym.Variable("data"), kernel=(3,),
+                        stride=(2,), pad=(1,), pool_type=ptype)
+    e1 = s1.bind(mx.cpu(), args={"data": mx.nd.array(x1)})
+    e1.forward(is_train=False)
+    t1 = torch.tensor(x1)
+    w1 = (F.max_pool1d(t1, 3, 2, 1) if ptype == "max"
+          else F.avg_pool1d(t1, 3, 2, 1, count_include_pad=True)).numpy()
+    np.testing.assert_allclose(e1.outputs[0].asnumpy(), w1,
+                               rtol=1e-4, atol=1e-5)
+    # 3-D
+    x3 = _nd(2, 3, 4, 6, 8)
+    s3 = mx.sym.Pooling(mx.sym.Variable("data"), kernel=(2, 2, 2),
+                        stride=(2, 2, 2), pool_type=ptype)
+    e3 = s3.bind(mx.cpu(), args={"data": mx.nd.array(x3)})
+    e3.forward(is_train=False)
+    t3 = torch.tensor(x3)
+    w3 = (F.max_pool3d(t3, 2, 2) if ptype == "max"
+          else F.avg_pool3d(t3, 2, 2)).numpy()
+    np.testing.assert_allclose(e3.outputs[0].asnumpy(), w3,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_1d_torch_parity():
+    import torch
+    import torch.nn.functional as F
+
+    x = _nd(2, 4, 9)
+    w = _nd(4, 3, 4) * 0.3
+    sym = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(4,),
+                               stride=(2,), pad=(1,), num_filter=3,
+                               no_bias=True, name="d")
+    ex = sym.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                  "d_weight": mx.nd.array(w)})
+    ex.forward(is_train=False)
+    want = F.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                              stride=2, padding=1).numpy()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want,
+                               rtol=1e-4, atol=1e-5)
