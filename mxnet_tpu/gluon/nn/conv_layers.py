@@ -14,6 +14,7 @@ from ..block import HybridBlock
 from .basic_layers import Activation, _init
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose",
            "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
            "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D",
            "GlobalMaxPool3D", "GlobalAvgPool1D", "GlobalAvgPool2D",
@@ -199,7 +200,7 @@ for _n, _layout in _LAYOUTS.items():
             "%sPool%dD" % (_title, _n), _n, _kind, _layout)
         globals()["Global%sPool%dD" % (_title, _n)] = _global_pool_factory(
             "Global%sPool%dD" % (_title, _n), _n, _kind, _layout)
-for _n in (1, 2):
+for _n in (1, 2, 3):
     globals()["Conv%dDTranspose" % _n] = _conv_factory(
         "Conv%dDTranspose" % _n, _n, _LAYOUTS[_n], transpose=True)
 del _n, _layout, _kind, _title

@@ -392,3 +392,38 @@ def test_bench_gluon_config_engages_fusion():
     assert tr._kvstore is None          # single-device local -> no kv
     assert tr._can_fuse()
     assert tr._fused is not None        # the fused program actually ran
+
+
+def test_gluon_nd_conv_pool_blocks():
+    """1-D/3-D conv, transpose-conv and pool blocks (reference
+    conv_layers.py surface — Conv3DTranspose was missing r5)."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(4)
+    x1 = rng.randn(2, 3, 12).astype(np.float32)
+    c1 = nn.Conv1D(5, 3, strides=2, padding=1, in_channels=3)
+    c1.initialize(mx.init.Xavier())
+    out1 = c1(mx.nd.array(x1))
+    want1 = F.conv1d(torch.tensor(x1),
+                     torch.tensor(c1.weight.data().asnumpy()),
+                     torch.tensor(c1.bias.data().asnumpy()),
+                     stride=2, padding=1).numpy()
+    np.testing.assert_allclose(out1.asnumpy(), want1, rtol=1e-4,
+                               atol=1e-5)
+
+    x3 = rng.randn(1, 2, 4, 5, 6).astype(np.float32)
+    t3 = nn.Conv3DTranspose(3, (2, 2, 2), strides=(2, 2, 2),
+                            in_channels=2)
+    t3.initialize(mx.init.Xavier())
+    out3 = t3(mx.nd.array(x3))
+    want3 = F.conv_transpose3d(
+        torch.tensor(x3), torch.tensor(t3.weight.data().asnumpy()),
+        torch.tensor(t3.bias.data().asnumpy()), stride=2).numpy()
+    np.testing.assert_allclose(out3.asnumpy(), want3, rtol=1e-4,
+                               atol=1e-5)
+
+    p3 = nn.MaxPool3D(pool_size=2, strides=2)
+    outp = p3(mx.nd.array(x3))
+    wantp = F.max_pool3d(torch.tensor(x3), 2, 2).numpy()
+    np.testing.assert_allclose(outp.asnumpy(), wantp, rtol=1e-5)
