@@ -1,6 +1,7 @@
 """Gluon dataset / sampler / loader API (reference import surface)."""
 from . import vision  # noqa: F401
 from .dataloader import DataLoader  # noqa: F401
-from .dataset import ArrayDataset, Dataset, SimpleDataset  # noqa: F401
+from .dataset import (ArrayDataset, Dataset,  # noqa: F401
+                      RecordFileDataset, SimpleDataset)
 from .sampler import (BatchSampler, RandomSampler,  # noqa: F401
                       SequentialSampler, Sampler)

@@ -3,7 +3,7 @@ from __future__ import annotations
 
 from ... import ndarray as nd
 
-__all__ = ["Dataset", "ArrayDataset", "SimpleDataset"]
+__all__ = ["Dataset", "ArrayDataset", "RecordFileDataset", "SimpleDataset"]
 
 
 class Dataset:
@@ -81,3 +81,28 @@ class ArrayDataset(Dataset):
 
     def __len__(self):
         return self._length
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO (.rec) file with its .idx sidecar
+    (reference: gluon/data/dataset.py:74)."""
+
+    def __init__(self, filename):
+        import os
+
+        from ... import recordio
+        from ...base import MXNetError
+
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        if not os.path.exists(idx_file):
+            raise MXNetError(
+                "RecordFileDataset needs the .idx sidecar for random "
+                "access; %r not found (generate with tools/rec2idx.py)"
+                % (idx_file,))
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

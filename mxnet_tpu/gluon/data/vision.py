@@ -15,9 +15,11 @@ import numpy as np
 
 from ... import ndarray as nd
 from ...base import MXNetError
-from .dataset import Dataset, ArrayDataset
+from ... import image, recordio
+from .dataset import ArrayDataset, Dataset, RecordFileDataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "ImageFolderDataset",
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset",
            "SyntheticImageDataset"]
 
 
@@ -116,6 +118,53 @@ class CIFAR10(_DownloadedDataset):
         data = np.concatenate(data).transpose(0, 2, 3, 1)
         self._data = nd.array(data.astype(np.float32) / 255)
         self._label = np.concatenate(label).astype(np.int32)
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 from the local binary archive (reference:
+    vision.py:222 — fine_label picks 100 classes vs 20 coarse)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", fine_label=False,
+                 train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _get_data(self):
+        files = ["train.bin"] if self._train else ["test.bin"]
+        data, label = [], []
+        for fname in files:
+            path = os.path.join(self._root, fname)
+            if not os.path.exists(path):
+                raise MXNetError(
+                    "CIFAR100 file %s not found (offline environment: "
+                    "place the binary batches under %s)"
+                    % (fname, self._root))
+            raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3074)
+            # byte 0 = coarse label, byte 1 = fine label (reference
+            # vision.py _read_batch uses column 0 + fine_label)
+            label.append(raw[:, 0 + int(self._fine_label)])
+            data.append(raw[:, 2:].reshape(-1, 3, 32, 32))
+        data = np.concatenate(data).transpose(0, 2, 3, 1)
+        self._data = nd.array(data.astype(np.float32) / 255)
+        self._label = np.concatenate(label).astype(np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a packed .rec file (reference:
+    vision.py:258). Random access via the .idx sidecar."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        out = image.imdecode(img, self._flag)
+        if self._transform is not None:
+            return self._transform(out, header.label)
+        return out, header.label
 
 
 class ImageFolderDataset(Dataset):

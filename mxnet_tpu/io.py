@@ -22,9 +22,10 @@ from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
-           "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
-           "ImageRecordIter", "ImageDetRecordIter", "LibSVMIter"]
+__all__ = ["DataDesc", "DataBatch", "DataIter", "MXDataIter",
+           "ResizeIter", "PrefetchingIter", "NDArrayIter", "MNISTIter",
+           "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
+           "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -676,3 +677,11 @@ class LibSVMIter(DataIter):
         else:
             lab = labels
         return DataBatch(data=[data], label=[nd.array(lab)], pad=pad)
+
+
+# The reference returns MXDataIter (a wrapper over the C++ iterator
+# handle, io.py:762) from factory iterators like CSVIter/ImageRecordIter;
+# here the factories return Python DataIter subclasses directly, so the
+# name aliases the base class — isinstance(it, mx.io.MXDataIter) keeps
+# working for every built-in iterator.
+MXDataIter = DataIter

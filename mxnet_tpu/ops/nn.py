@@ -184,6 +184,16 @@ def _register_conv():
         shapes = [d, w] + ([] if attrs.no_bias else [(attrs.num_filter,)])
         return (shapes, [out], aux_shapes)
 
+    def conv_infer_backward(attrs, out_shapes, in_shapes):
+        # batch dim flows back from the output (nnvm ConvolutionShape
+        # behavior) — conv-RNN begin-state zeros rely on this to resolve
+        # their unknown batch size
+        o = out_shapes[0] if out_shapes else None
+        d = in_shapes[0]
+        if o is None or not o or o[0] == 0 or d is None or not d:
+            return None
+        return [(o[0],) + tuple(d[1:])] + list(in_shapes[1:])
+
     register_op(
         "Convolution", convolution,
         params={"kernel": Shape(), "stride": Shape(default=()),
@@ -194,7 +204,7 @@ def _register_conv():
                 "layout": Str(default=None)},
         num_inputs=lambda attrs: 2 if attrs.no_bias else 3,
         input_names=lambda attrs: ["data", "weight"] + ([] if attrs.no_bias else ["bias"]),
-        infer_shape=conv_infer,
+        infer_shape=conv_infer, infer_backward=conv_infer_backward,
         doc="N-d convolution → XLA ConvGeneralDilated on the MXU (reference: "
             "src/operator/convolution-inl.h; cudnn_* params accepted and "
             "ignored). LAYOUT DEVIATION: with a channels-last layout (NHWC/"

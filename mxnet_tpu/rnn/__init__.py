@@ -1,6 +1,8 @@
 """Symbolic (pre-Gluon) RNN toolkit — BucketingModule's companion
 (BASELINE config #4 surface: lstm_bucketing)."""
 from .io import BucketSentenceIter, encode_sentences  # noqa: F401
+from .conv_rnn_cell import (BaseConvRNNCell, ConvGRUCell,  # noqa: F401
+                            ConvLSTMCell, ConvRNNCell)
 from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,  # noqa: F401
-                  save_rnn_checkpoint)
+                  rnn_unroll, save_rnn_checkpoint)
 from .rnn_cell import *  # noqa: F401,F403

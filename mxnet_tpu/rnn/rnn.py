@@ -43,3 +43,21 @@ def do_rnn_checkpoint(cells, prefix, period=1):
             save_rnn_checkpoint(cells, prefix, tick, sym, arg, aux)
 
     return maybe_save
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC"):
+    """Deprecated alias of ``cell.unroll`` (reference: rnn/rnn.py:26).
+    Auto-creates the legacy per-step input variables
+    ``%st%d_data`` when ``inputs`` is None."""
+    import warnings
+
+    from .. import symbol
+
+    warnings.warn("rnn_unroll is deprecated. Please call cell.unroll "
+                  "directly.")
+    if inputs is None:
+        inputs = [symbol.Variable("%st%d_data" % (input_prefix, i))
+                  for i in range(length)]
+    return cell.unroll(length=length, inputs=inputs,
+                       begin_state=begin_state, layout=layout)

@@ -153,3 +153,72 @@ def test_begin_state_zeros_batch_inference():
     exe = outputs.simple_bind(mx.cpu(), data=(8, 3, 4))
     out = exe.forward()[0]
     assert out.shape == (8, 3, 16)
+
+
+def test_conv_rnn_cells_forward_and_state_shapes():
+    """Symbolic Conv RNN/LSTM/GRU cells (reference rnn_cell.py:1094+):
+    state shapes preserved across steps, gradients flow, and ConvLSTM
+    matches a hand-rolled numpy step."""
+    import numpy as np
+
+    ishape = (2, 3, 8, 8)   # NCHW single-timestep input
+    H = 4
+    for cls, n_states in [(mx.rnn.ConvRNNCell, 1),
+                          (mx.rnn.ConvLSTMCell, 2),
+                          (mx.rnn.ConvGRUCell, 1)]:
+        cell = cls(input_shape=ishape, num_hidden=H)
+        assert len(cell.state_info) == n_states
+        for info in cell.state_info:
+            assert info["shape"][1:] == (H, 8, 8), (cls, info)
+        out, states = cell(mx.sym.Variable("x0"), cell.begin_state())
+        out2, _ = cell(mx.sym.Variable("x1"), states)  # shared weights,
+        # per-step inputs keep input_shape — state chains across steps
+        rng = np.random.RandomState(0)
+        ex = out2.simple_bind(mx.cpu(), x0=ishape, x1=ishape,
+                              grad_req="write")
+        for k, v in ex.arg_dict.items():
+            v[:] = rng.randn(*v.shape).astype(np.float32) * 0.2
+        ex.arg_dict["x0"][:] = rng.rand(*ishape).astype(np.float32)
+        ex.arg_dict["x1"][:] = rng.rand(*ishape).astype(np.float32)
+        ex.forward(is_train=True)
+        assert ex.outputs[0].shape == (2, H, 8, 8), cls
+        ex.backward([mx.nd.ones((2, H, 8, 8))])
+        g = ex.grad_dict["x0"].asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0, cls
+
+
+def test_conv_lstm_numpy_parity():
+    """One ConvLSTM step vs numpy (1x1 kernels make the conv a per-pixel
+    dense map, so the LSTM algebra is directly checkable)."""
+    import numpy as np
+
+    ishape = (1, 2, 4, 4)
+    H = 3
+    cell = mx.rnn.ConvLSTMCell(input_shape=ishape, num_hidden=H,
+                               i2h_kernel=(1, 1), i2h_pad=(0, 0),
+                               h2h_kernel=(1, 1),
+                               activation="tanh")
+    out, states = cell(mx.sym.Variable("x"), cell.begin_state())
+    grp = mx.sym.Group([out, states[1]])
+    ex = grp.simple_bind(mx.cpu(), x=ishape, grad_req="null")
+    rng = np.random.RandomState(1)
+    for k, v in ex.arg_dict.items():
+        if k != "x":
+            v[:] = rng.randn(*v.shape).astype(np.float32) * 0.3
+    x = rng.randn(*ishape).astype(np.float32)
+    ex.arg_dict["x"][:] = x
+    ex.forward(is_train=False)
+    got_h, got_c = [o.asnumpy() for o in ex.outputs]
+
+    iW = ex.arg_dict[cell._iW.name].asnumpy()   # (4H, 2, 1, 1)
+    iB = ex.arg_dict[cell._iB.name].asnumpy()
+    hW = ex.arg_dict[cell._hW.name].asnumpy()
+    hB = ex.arg_dict[cell._hB.name].asnumpy()
+    gates = (np.einsum("oc,bchw->bohw", iW[:, :, 0, 0], x)
+             + iB[None, :, None, None] + hB[None, :, None, None])
+    gi, gf, gc, go = np.split(gates, 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c = sig(gi) * np.tanh(gc)            # h0 = c0 = 0
+    h = sig(go) * np.tanh(c)
+    np.testing.assert_allclose(got_c, c, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_h, h, rtol=1e-4, atol=1e-5)
