@@ -15,7 +15,7 @@ from .. import autograd as _ag
 
 __all__ = ["set_is_training", "train_section", "test_section",
            "mark_variables", "backward", "compute_gradient",
-           "grad_and_loss", "grad"]
+           "grad_and_loss", "grad", "TrainingStateScope"]
 
 
 def set_is_training(is_train):
@@ -96,3 +96,19 @@ def grad(func, argnum=None):
         return paired(*args)[0]
 
     return wrapped
+
+
+class TrainingStateScope:
+    """Scope that sets/restores the training flag (reference:
+    contrib/autograd.py:54)."""
+
+    def __init__(self, enter_state):
+        self._enter_state = enter_state
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_is_training(self._enter_state)
+
+    def __exit__(self, ptype, value, trace):
+        if self._prev != self._enter_state:
+            set_is_training(self._prev)

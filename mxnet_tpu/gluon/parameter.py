@@ -51,6 +51,13 @@ def _merge_shapes(declared, incoming):
     return tuple(merged)
 
 
+# (reference gluon/parameter.py: accepted tensor classes)
+from ..symbol import Symbol as _Symbol  # noqa: E402
+from ..ndarray.ndarray import NDArray as _NDArray  # noqa: E402
+
+tensor_types = (_Symbol, _NDArray)
+
+
 class Parameter:
     """One named tensor with optional gradient, replicated per context."""
 

@@ -1,0 +1,148 @@
+"""Modules implemented directly in Python (reference:
+python/mxnet/module/python_module.py — PythonModule base +
+PythonLossModule). Useful for heads whose loss/gradient is easier to
+write as host code than as a symbol, while still composing inside a
+SequentialModule pipeline.
+"""
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..initializer import Uniform
+from .base_module import BaseModule
+
+__all__ = ["PythonModule", "PythonLossModule"]
+
+
+class PythonModule(BaseModule):
+    """A parameter-free module whose compute is plain Python: subclasses
+    implement ``forward``/``backward`` (and ``_compute_output_shapes``);
+    every parameter/optimizer API is a no-op."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    # --- shapes/names -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError()
+
+    # --- parameters: none -------------------------------------------------
+    def get_params(self):
+        return ({}, {})
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        self.params_initialized = True
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels):
+        pass
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        self._data_shapes = [tuple(s) if not hasattr(s, "shape") else s
+                             for s in data_shapes]
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    def install_monitor(self, mon):
+        pass
+
+    def get_states(self, merge_multi_context=True):
+        return []
+
+    def set_states(self, states=None, value=None):
+        pass
+
+
+class PythonLossModule(PythonModule):
+    """Pass-through scores forward; backward produces the loss gradient
+    from ``grad_func(scores, labels)`` (reference default: softmax-style
+    ``scores - onehot(labels)`` is NOT assumed — the caller supplies
+    grad_func, or overrides ``backward``)."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(list(data_names), list(label_names),
+                         [name + "_output"], logger=logger)
+        assert len(self._data_names) == 1
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        if grad_func is not None:
+            assert callable(grad_func)
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        first = self._data_shapes[0]
+        shape = first[1] if isinstance(first, tuple) else first.shape
+        return [(self._name + "_output", tuple(shape))]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if is_train is None:
+            is_train = self.for_training
+        if is_train and data_batch.label:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        assert out_grads is None, (
+            "PythonLossModule is a loss head; it takes no incoming "
+            "gradient")
+        assert self._grad_func is not None, (
+            "PythonLossModule needs grad_func (or override backward)")
+        grad = self._grad_func(self._scores, self._labels)
+        if not isinstance(grad, nd.NDArray):
+            grad = nd.array(np.asarray(grad))
+        self._scores_grad = grad
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]

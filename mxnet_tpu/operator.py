@@ -248,3 +248,131 @@ def _install_frontends():
     nd_pkg.Custom = nd_custom
     nd_pkg.op.Custom = nd_custom
     sym_pkg.Custom = sym_custom
+
+
+# --- legacy PythonOp family (reference: operator.py:37-336) -----------------
+# Pre-CustomOp API: an op object with numpy forward/backward plus
+# shape/name introspection, turned into a symbol via get_symbol().
+# Implemented as an adapter onto the CustomOp machinery above.
+
+class PythonOp:
+    """Base of the deprecated python-op API (reference operator.py:37).
+    Subclass NumpyOp or NDArrayOp instead of this directly."""
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError("Must override this")
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError("Must override this")
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def _legacy_prop(op, numpy_arrays):
+    """Build a CustomOpProp bridging a PythonOp instance."""
+
+    class _LegacyOp(CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            if numpy_arrays:
+                import numpy as _np
+
+                ins = [d.asnumpy() for d in in_data]
+                outs = [_np.array(d.asnumpy()) for d in out_data]
+                op.forward(in_data=ins, out_data=outs)
+                for dst, src, r in zip(out_data, outs, req):
+                    self.assign(dst, r, _nd_array(src))
+            else:
+                op.forward(in_data=in_data, out_data=out_data)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            if numpy_arrays:
+                import numpy as _np
+
+                ogs = [d.asnumpy() for d in out_grad]
+                ins = [d.asnumpy() for d in in_data]
+                outs = [d.asnumpy() for d in out_data]
+                igs = [_np.array(d.asnumpy()) for d in in_grad]
+                op.backward(out_grad=ogs, in_data=ins, out_data=outs,
+                            in_grad=igs)
+                for dst, src, r in zip(in_grad, igs, req):
+                    self.assign(dst, r, _nd_array(src))
+            else:
+                op.backward(out_grad=out_grad, in_data=in_data,
+                            out_data=out_data, in_grad=in_grad)
+
+    class _LegacyProp(CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=op.need_top_grad())
+
+        def list_arguments(self):
+            return op.list_arguments()
+
+        def list_outputs(self):
+            return op.list_outputs()
+
+        def infer_shape(self, in_shape):
+            res = op.infer_shape(in_shape)
+            ins, outs = res[0], res[1]
+            return ins, outs, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _LegacyOp()
+
+    return _LegacyProp
+
+
+def _nd_array(a):
+    from . import ndarray as nd
+
+    return nd.array(a)
+
+
+class NumpyOp(PythonOp):
+    """Legacy custom op with numpy-array forward/backward (reference
+    operator.py:144). Deprecated; prefer CustomOp/CustomOpProp."""
+
+    _counter = [0]
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+
+        self._counter[0] += 1
+        reg_name = "_legacy_numpy_op_%d" % self._counter[0]
+        register(reg_name)(_legacy_prop(self, numpy_arrays=True))
+        return sym.Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy custom op operating on NDArrays in place (reference
+    operator.py:246). Deprecated; prefer CustomOp/CustomOpProp."""
+
+    _counter = [0]
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+
+        self._counter[0] += 1
+        reg_name = "_legacy_ndarray_op_%d" % self._counter[0]
+        register(reg_name)(_legacy_prop(self, numpy_arrays=False))
+        return sym.Custom(*args, op_type=reg_name, **kwargs)

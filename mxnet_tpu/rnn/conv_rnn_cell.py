@@ -57,10 +57,7 @@ class BaseConvRNNCell(BaseRNNCell):
         out_shape = probe.infer_shape(data=self._input_shape)[1][0]
         self._state_shape = (0,) + tuple(out_shape[1:])
 
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._bind_gate_params()
 
     @property
     def _num_gates(self):

@@ -233,3 +233,74 @@ def test_round4_import_locations():
     for cls in (DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug,
                 DetRandomPadAug):
         assert hasattr(cls, "dumps")
+
+
+def test_python_loss_module():
+    """PythonLossModule (reference module/python_module.py): scores pass
+    through, backward produces grad_func(scores, labels)."""
+    import numpy as np
+
+    from mxnet_tpu.module import PythonLossModule
+
+    mod = PythonLossModule(
+        grad_func=lambda scores, labels:
+            scores.asnumpy() - labels.asnumpy())
+    mod.bind(data_shapes=[("data", (4, 3))],
+             label_shapes=[("softmax_label", (4, 3))])
+    mod.init_params()
+    rng = np.random.RandomState(0)
+    s = rng.rand(4, 3).astype(np.float32)
+    l = rng.rand(4, 3).astype(np.float32)
+    batch = mx.io.DataBatch(data=[mx.nd.array(s)],
+                            label=[mx.nd.array(l)])
+    mod.forward(batch, is_train=True)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), s)
+    mod.backward()
+    np.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(),
+                               s - l, rtol=1e-6)
+    assert mod.output_shapes == [("pyloss_output", (4, 3))]
+
+
+def test_legacy_numpy_op_trains():
+    """Legacy NumpyOp API (reference operator.py:144) adapts onto the
+    CustomOp machinery: a numpy softmax head trains through Module."""
+    import numpy as np
+
+    class NumpySoftmax(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            x = in_data[0]
+            e = np.exp(x - x.max(axis=1, keepdims=True))
+            out_data[0][:] = e / e.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            lab = in_data[1].astype(int)
+            dx = out_data[0].copy()
+            dx[np.arange(len(lab)), lab] -= 1.0
+            in_grad[0][:] = dx
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=4,
+                               name="fc")
+    net = NumpySoftmax()(fc, mx.sym.Variable("softmax_label"),
+                         name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 6).astype(np.float32)
+    w = rng.randn(6, 4) * 0.5
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=50, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric=metric)
+    assert metric.get()[1] > 0.85, metric.get()
