@@ -27,8 +27,16 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _timed_scan(step, init_carry, n_iters, n_repeats=3):
-    """Best wall time of scan(step, carry, length=n_iters) — one program."""
+    """Best wall time of scan(step, carry, length=n_iters) — one program.
+
+    The program returns a scalar checksum which is fetched to host each
+    repeat: on the tunneled axon platform block_until_ready() can return
+    before the device has finished, so only a host-side data dependency
+    (a D2H transfer of a value derived from the result) is a trustworthy
+    completion fence. The transfer is 4 bytes — noise at these runtimes.
+    """
     import jax
+    import jax.numpy as jnp
 
     def body(carry, _):
         return step(carry), None
@@ -36,15 +44,37 @@ def _timed_scan(step, init_carry, n_iters, n_repeats=3):
     @jax.jit
     def run(carry):
         out, _ = jax.lax.scan(body, carry, None, length=n_iters)
-        return out
+        leaves = jax.tree_util.tree_leaves(out)
+        acc = jnp.float32(0)
+        for leaf in leaves:
+            acc = acc + jnp.sum(leaf.astype(jnp.float32))
+        return acc
 
-    out = run(init_carry)
-    jax.block_until_ready(out)  # compile + warm
+    float(run(init_carry))  # compile + warm, fenced by D2H
     best = float("inf")
     for _ in range(n_repeats):
         t0 = time.perf_counter()
-        out = run(init_carry)
-        jax.block_until_ready(out)
+        float(run(init_carry))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_dispatch_rtt():
+    """Round-trip time of an empty compiled program — the tunnel tax that
+    must be amortized out of every wall-clock measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def nop(x):
+        return jnp.sum(x + 0)
+
+    x = jnp.zeros((8,), "float32")
+    float(nop(x))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(nop(x))
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -56,18 +86,40 @@ def bench_matmul():
     for dtype in ("bfloat16", "float32"):
         for m, k, n in ((4096, 4096, 4096), (8192, 8192, 8192),
                         (16384, 8192, 8192), (8192, 16384, 8192),
-                        (12288, 12288, 12288)):
+                        (12288, 12288, 12288), (16384, 16384, 16384)):
             try:
+                import jax
+
                 a = jnp.ones((m, k), dtype)
-                b = jnp.ones((k, n), dtype)
-                iters = max(4, int(2e12 / (2 * m * k * n)))
+                # B must NOT be a constant splat: XLA's algebraic simplifier
+                # rewrites dot(x, splat(c)) into a broadcast reduction and
+                # the "matmul" disappears. Random values are irreducible.
+                b = jax.random.normal(
+                    jax.random.PRNGKey(0), (k, n)).astype(dtype)
+                # ≥20 TFLOP per run so a ~10ms dispatch RTT is <0.1% noise
+                iters = max(4, int(2e13 / (2 * m * k * n)))
 
-                def step(x, b=b, k=k):
-                    # dependent chain: each matmul consumes the previous
+                def step(carry):
+                    # dependent chain: each matmul consumes the previous.
+                    # B rides in the carry so it stays a runtime buffer —
+                    # as a closure constant it would be baked into the HLO
+                    # (huge remote-compile payload) and, if splat, XLA's
+                    # algebraic simplifier would delete the dot entirely.
+                    # Normalize per iteration so the chain neither explodes
+                    # nor underflows; couple through a full reduction when
+                    # the output shape differs from the carry shape so XLA
+                    # cannot dead-code any part of the product.
+                    x, b = carry
                     y = x @ b
-                    return y * (1.0 / k)  # keep magnitudes bounded
+                    scale = jax.lax.rsqrt(
+                        jnp.mean(jnp.square(y.astype(jnp.float32)))
+                        + 1e-30).astype(x.dtype)
+                    if y.shape == x.shape:
+                        return y * scale, b
+                    return x * (1.0 + 1e-30
+                                * (jnp.sum(y) * scale).astype(x.dtype)), b
 
-                dt = _timed_scan(step, a, iters)
+                dt = _timed_scan(step, (a, b), iters)
                 tf_s = 2.0 * m * k * n * iters / dt / 1e12
                 results.append({"shape": [m, k, n], "dtype": dtype,
                                 "tflops": round(tf_s, 1)})
@@ -87,13 +139,18 @@ def bench_hbm():
     for dtype, bytes_per in (("bfloat16", 2), ("float32", 4)):
         x = jnp.ones((n_elem,), dtype)
 
+        # NOTE: the scale constant must be exactly representable in bf16
+        # (1 + 2^-7 — bf16 has 7 mantissa bits); a constant that rounds to
+        # 1.0 lets XLA fold the whole kernel to identity and report
+        # impossible bandwidth.
+        c = 1.0078125
         kernels = {
             # name: (step fn, bytes touched per iteration)
-            "scale": (lambda v: v * 1.0000001, 2 * n_elem * bytes_per),
-            "triad": (lambda v: v * 1.0000001 + 0.5, 2 * n_elem * bytes_per),
+            "scale": (lambda v: v * c, 2 * n_elem * bytes_per),
+            "triad": (lambda v: v * c + 0.5, 2 * n_elem * bytes_per),
         }
         for name, (step, nbytes) in kernels.items():
-            iters = max(8, int(2e11 / nbytes))
+            iters = max(8, int(1e12 / nbytes))
             dt = _timed_scan(step, x, iters)
             gb_s = nbytes * iters / dt / 1e9
             results.append({"kernel": name, "dtype": dtype,
@@ -107,10 +164,14 @@ def main():
     import jax
 
     dev = jax.devices()[0]
+    rtt = measure_dispatch_rtt()
+    print("[rtt] empty-program dispatch: %.1f ms" % (rtt * 1e3),
+          file=sys.stderr)
     matmul = bench_matmul()
     hbm = bench_hbm()
     out = {
         "device": dev.device_kind,
+        "dispatch_rtt_ms": round(rtt * 1e3, 2),
         "matmul": matmul,
         "hbm": hbm,
         "best_tflops": max((r["tflops"] for r in matmul), default=None),
