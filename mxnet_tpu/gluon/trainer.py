@@ -227,25 +227,26 @@ class Trainer:
             for s, d in zip(state, data):
                 Trainer._writeback_state(s, d)
 
-    def _build_fused(self):
-        """One jitted function applying the optimizer to every parameter:
-        the ordinary ``update`` is traced over NDArray-wrapped tracers, so
-        any eligible optimizer fuses without a parallel implementation.
-        Per-step lr scalars arrive as traced arguments via patched
-        ``_get_lr``/``_corrected_lr`` (and ``_update_count`` no-ops in
-        trace — the host advances the real counts each step)."""
-        import jax
-
-        from ..ndarray.ndarray import NDArray, _from_data
-
-        live = self._live_params()
+    def _materialize_states(self, live):
+        """Ensure optimizer state exists host-side for each live param so
+        save/load_states keep working around the fused paths."""
         updater = self._updaters[0]
-        # materialize states eagerly so save/load_states keep working
         for i, p in live:
             if i not in updater.states:
                 updater.states[i] = self._optimizer.create_state(
                     i, p.list_data()[0])
                 updater.states_synced[i] = True
+
+    def _apply_updates_traced(self, live, w_datas, g_datas, s_datas,
+                              lr_scalars):
+        """Apply the optimizer to every live param INSIDE a trace: the
+        ordinary ``update`` runs over NDArray-wrapped tracers, so any
+        eligible optimizer fuses without a parallel implementation.
+        Per-step lr scalars arrive as traced arguments via patched
+        ``_get_lr``/``_corrected_lr`` (and ``_update_count`` no-ops in
+        trace — the host advances the real counts each step). Returns
+        (new_weights, new_states) as raw-array pytrees."""
+        from ..ndarray.ndarray import _from_data
 
         opt_ref = self._optimizer
 
@@ -263,32 +264,84 @@ class Trainer:
                 return tuple(state_out(s) for s in state)
             return state._data
 
+        lr_map = {i: lr for (i, _p), lr in zip(live, lr_scalars)}
+        patched = {"_get_lr": lambda idx: lr_map[idx],
+                   "_update_count": lambda idx: None}
+        if hasattr(type(opt_ref), "_corrected_lr"):
+            patched["_corrected_lr"] = lambda idx: lr_map[idx]
+        for name, fn in patched.items():
+            setattr(opt_ref, name, fn)
+        try:
+            new_w, new_s = [], []
+            for (i, _p), wd, gd, sd in zip(live, w_datas, g_datas,
+                                           s_datas):
+                w = _from_data(wd)
+                g = _from_data(gd)
+                state = wrap_state(sd)
+                opt_ref.update(i, w, g, state)
+                new_w.append(w._data)
+                new_s.append(state_out(state))
+            return new_w, new_s
+        finally:
+            # instance attrs would shadow the class methods for the
+            # eager path AND break optimizer pickling (dist re-ship)
+            for name in patched:
+                opt_ref.__dict__.pop(name, None)
+
+    def _build_fused(self):
+        """One jitted function applying the optimizer to every parameter
+        (see _apply_updates_traced)."""
+        import jax
+
+        live = self._live_params()
+        self._materialize_states(live)
+
         def run(w_datas, g_datas, s_datas, lr_scalars):
-            lr_map = {i: lr for (i, _p), lr in zip(live, lr_scalars)}
-            patched = {"_get_lr": lambda idx: lr_map[idx],
-                       "_update_count": lambda idx: None}
-            if hasattr(type(opt_ref), "_corrected_lr"):
-                patched["_corrected_lr"] = lambda idx: lr_map[idx]
-            for name, fn in patched.items():
-                setattr(opt_ref, name, fn)
-            try:
-                new_w, new_s = [], []
-                for (i, _p), wd, gd, sd in zip(live, w_datas, g_datas,
-                                               s_datas):
-                    w = _from_data(wd)
-                    g = _from_data(gd)
-                    state = wrap_state(sd)
-                    opt_ref.update(i, w, g, state)
-                    new_w.append(w._data)
-                    new_s.append(state_out(state))
-                return new_w, new_s
-            finally:
-                # instance attrs would shadow the class methods for the
-                # eager path AND break optimizer pickling (dist re-ship)
-                for name in patched:
-                    opt_ref.__dict__.pop(name, None)
+            return self._apply_updates_traced(live, w_datas, g_datas,
+                                              s_datas, lr_scalars)
 
         return jax.jit(run, donate_argnums=(0, 2))
+
+    def _host_prestep(self, live):
+        """The per-step HOST work shared by the fused paths: sync loaded
+        checkpoint states to device, advance update counts, and resolve
+        each per-step lr scalar (scheduler lookups and Adam's bias
+        correction happen here — the results enter the compiled program
+        as traced inputs). Returns the lr scalar list."""
+        updater = self._updaters[0]
+        for i, p in live:
+            if not updater.states_synced.get(i, True):
+                updater.states[i] = updater.sync_state_context(
+                    updater.states[i], p.list_data()[0].context)
+                updater.states_synced[i] = True
+        o = self._optimizer
+        for i, _p in live:
+            o._update_count(i)
+        scalar = self._step_scalar_fn()
+        return [float(scalar(i)) for i, _p in live]
+
+    def compile_step(self, net, loss_fn, batch_axis=0):
+        """Compile ``(data, label) -> loss`` where forward, backward AND
+        the optimizer update run as ONE XLA program — the TPU-native
+        Gluon train step.
+
+        The eager pattern (``record()``/``backward()``/``step()``) pays
+        one device dispatch per tape node; on hosts where dispatch is
+        expensive that overhead dominates. ``compile_step`` composes
+        ``loss_fn(net(data), label)`` symbolically (both must be
+        HybridBlocks), differentiates the whole graph, and fuses the
+        update via the same traced-optimizer machinery as the fused
+        local step, so schedulers and Adam bias correction stay dynamic
+        (traced lr scalars — no recompiles).
+
+        Semantics match ``loss.backward()`` (cotangent of ones, i.e. the
+        gradient of ``sum(loss)``) followed by ``step(batch_size)`` with
+        ``batch_size = data.shape[batch_axis]``. BatchNorm moving stats
+        update exactly as in eager training.
+
+        Returns a callable ``step(data, label) -> loss`` NDArray.
+        """
+        return _FusedTrainStep(self, net, loss_fn, batch_axis)
 
     def _fused_local_step(self):
         sig = self._fused_signature()
@@ -297,24 +350,7 @@ class Trainer:
         fn = self._fused[1]
         live = self._live_params()
         updater = self._updaters[0]
-        o = self._optimizer
-
-        # loaded checkpoints hold host-side numpy until first use; the
-        # eager path syncs lazily per call, do the same here
-        for i, p in live:
-            if not updater.states_synced.get(i, True):
-                updater.states[i] = updater.sync_state_context(
-                    updater.states[i], p.list_data()[0].context)
-                updater.states_synced[i] = True
-
-        # advance update counts on the HOST (the traced update's count
-        # call is a no-op), then resolve each per-step lr scalar —
-        # scheduler lookups and Adam's bias correction happen here, and
-        # the results enter the program as traced inputs
-        for i, _p in live:
-            o._update_count(i)
-        scalar = self._step_scalar_fn()
-        lr_scalars = [float(scalar(i)) for i, _p in live]
+        lr_scalars = self._host_prestep(live)
 
         w_datas = [p.list_data()[0]._data for _i, p in live]
         g_datas = [p.list_grad()[0]._data for _i, p in live]
@@ -352,3 +388,183 @@ class Trainer:
         for updater in self._updaters:
             updater.set_states(blob)
             updater.optimizer = self._optimizer
+
+
+class _FusedTrainStep:
+    """Whole-train-step program built by :meth:`Trainer.compile_step`:
+    ``loss_fn(net(data), label)`` traced symbolically, differentiated with
+    ``jax.value_and_grad`` over the live parameters, optimizer applied via
+    the Trainer's traced-update machinery — ONE compiled XLA program per
+    (input signature, optimizer signature). BN moving stats (aux states)
+    update inside the same program.
+
+    TPU-first rationale: the eager tape pays a dispatch per node; here a
+    ResNet-18 train step is a single dispatch regardless of depth.
+    """
+
+    def __init__(self, trainer, net, loss_fn, batch_axis=0):
+        self._trainer = trainer
+        self._net = net
+        self._loss_fn = loss_fn
+        self._batch_axis = batch_axis
+        self._built = None   # (prog, plan, live, aux_params, grad_pos)
+        self._compiled = None  # (key, jitted fn)
+        self.compile_count = 0  # observability: recompiles are bugs
+
+    # ---------------------------------------------------------- build
+    def _build(self, data, label):
+        from ..executor import _GraphProgram
+        from ..symbol import symbol as sym_mod
+
+        trainer = self._trainer
+        if trainer._kvstore is not None and trainer._kv_initialized:
+            raise ValueError(
+                "compile_step fuses the update locally; it does not "
+                "support kvstore-backed training (use trainer.step)")
+        if not trainer._can_fuse():
+            raise ValueError(
+                "compile_step requires a fusable optimizer (%s) and a "
+                "single context" % (Trainer._FUSABLE,))
+
+        # deferred-shape nets: finish parameter init from the sample input
+        try:
+            for _name, p in self._net.collect_params().items():
+                p.data(data.context)
+        except Exception:
+            self._net._deferred_infer_shape(data)
+            for _name, p in self._net.collect_params().items():
+                p._finish_deferred_init()
+
+        data_var = sym_mod.Variable("data")
+        label_var = sym_mod.Variable("label")
+        loss_sym = self._loss_fn(self._net(data_var), label_var)
+        if isinstance(loss_sym, (list, tuple)):
+            raise ValueError("loss_fn must produce a single output")
+        prog = _GraphProgram(loss_sym)
+
+        params = dict(self._net.collect_params().items())
+        params.update(self._loss_fn.collect_params().items())
+        plan = []
+        for name in prog.arg_names:
+            if name == "data":
+                plan.append(("input", 0))
+            elif name == "label":
+                plan.append(("input", 1))
+            else:
+                plan.append(("param", params[name]))
+        aux_params = [params[name] for name in prog.aux_names]
+
+        # live = trainer params that appear in this graph with grads on
+        graph_param_ids = {id(p) for kind, p in plan if kind == "param"}
+        live = [(i, p) for i, p in trainer._live_params()
+                if id(p) in graph_param_ids]
+        if not live:
+            raise ValueError("no trainable parameter of this Trainer "
+                             "appears in the traced graph")
+        live_ids = {id(p): j for j, (_i, p) in enumerate(live)}
+        # position in the plan's param-entry list -> live slot (or None)
+        grad_pos = []
+        for kind, p in plan:
+            if kind == "param":
+                grad_pos.append(live_ids.get(id(p)))
+        return prog, plan, live, aux_params, grad_pos
+
+    def _compile(self):
+        import jax
+
+        prog, plan, live, aux_params, grad_pos = self._built
+        trainer = self._trainer
+        param_names = [p.name for kind, p in plan if kind == "param"]
+        aux_names = list(prog.aux_names)
+
+        # live (updated, donated) and frozen (read-only, NOT donated)
+        # weights travel as separate arguments: donating a buffer that is
+        # not written back would leave the host NDArray pointing at a
+        # deleted device array
+        def raw(w_live, w_frozen, aux_all, data, label, s_datas,
+                lr_scalars, rngs):
+            def loss_of(wg):
+                import jax.numpy as jnp
+
+                arg_d = {"data": data, "label": label}
+                k = 0
+                for name, slot in zip(param_names, grad_pos):
+                    if slot is None:
+                        arg_d[name] = w_frozen[k]
+                        k += 1
+                    else:
+                        arg_d[name] = wg[slot]
+                aux_d = dict(zip(aux_names, aux_all))
+                outs, aux_upd = prog._eval(arg_d, aux_d, rngs, True)
+                loss = outs[0]
+                new_aux = tuple(aux_upd.get(n, aux_d[n]) for n in aux_names)
+                # loss.backward() seeds ones == d(sum(loss))
+                return jnp.sum(loss), (loss, new_aux)
+
+            (_tot, (loss, new_aux)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tuple(w_live))
+            new_w, new_s = trainer._apply_updates_traced(
+                live, list(w_live), list(grads), s_datas, lr_scalars)
+            return loss, new_w, new_s, new_aux
+
+        self.compile_count += 1
+        return jax.jit(raw, donate_argnums=(0, 2, 5))
+
+    # ---------------------------------------------------------- call
+    def __call__(self, data, label):
+        from ..ndarray.ndarray import _from_data
+        from .block import _next_keys
+
+        trainer = self._trainer
+        if self._built is None:
+            # build first: it finishes deferred-shape parameter init,
+            # which _init_kvstore's weight sampling needs
+            self._built = self._build(data, label)
+        if not trainer._kv_initialized:
+            # resolve the local-vs-kvstore decision without creating a
+            # store for the pure-local case compile_step supports
+            trainer._init_kvstore()
+        if trainer._kvstore is not None:
+            raise ValueError(
+                "compile_step fuses the update locally; it does not "
+                "support kvstore-backed training (use trainer.step)")
+        prog, plan, live, aux_params, grad_pos = self._built
+
+        batch_size = data.shape[self._batch_axis]
+        rescale = trainer._scale / batch_size
+        if trainer._optimizer.rescale_grad != rescale:
+            trainer._optimizer.rescale_grad = rescale
+
+        key = (tuple(data.shape), str(data.dtype), tuple(label.shape),
+               str(label.dtype), trainer._fused_signature())
+        if self._compiled is None or self._compiled[0] != key:
+            trainer._materialize_states(live)
+            self._compiled = (key, self._compile())
+        fn = self._compiled[1]
+
+        updater = trainer._updaters[0]
+        lr_scalars = trainer._host_prestep(live)
+        ctx = data.context
+        w_live = [None] * len(live)
+        w_frozen = []
+        graph_params = [p for kind, p in plan if kind == "param"]
+        for p, slot in zip(graph_params, grad_pos):
+            if slot is None:
+                w_frozen.append(p.data(ctx)._data)
+            else:
+                w_live[slot] = p.data(ctx)._data
+        aux_all = [p.data(ctx)._data for p in aux_params]
+        s_datas = [Trainer._state_data(updater.states[i]) for i, _p in live]
+        rngs = tuple(_next_keys(len(prog.rng_nodes)))
+
+        loss, new_w, new_s, new_aux = fn(
+            w_live, w_frozen, aux_all, data._data, label._data, s_datas,
+            lr_scalars, rngs)
+
+        for (i, p), wd, sd in zip(live, new_w, new_s):
+            p.list_data()[0]._set_data(wd)
+            Trainer._writeback_state(updater.states[i], sd)
+        for p, v in zip(aux_params, new_aux):
+            for arr in p._data.values():
+                arr._set_data(v)
+        return _from_data(loss)

@@ -1,92 +1,52 @@
-"""Modules implemented directly in Python (reference:
-python/mxnet/module/python_module.py — PythonModule base +
-PythonLossModule). Useful for heads whose loss/gradient is easier to
-write as host code than as a symbol, while still composing inside a
-SequentialModule pipeline.
+"""Modules whose compute is host Python rather than a compiled graph
+(reference: python/mxnet/module/python_module.py — PythonModule +
+PythonLossModule). The use case is a loss head whose gradient is easier
+to state as numpy than as a symbol, composed inside SequentialModule.
+
+Almost everything on PythonModule is *deliberately inert* (it owns no
+parameters, no optimizer, no device state); the only real logic lives
+in ``bind``.
 """
 import logging
 
 import numpy as np
 
 from .. import ndarray as nd
-from ..initializer import Uniform
 from .base_module import BaseModule
 
 __all__ = ["PythonModule", "PythonLossModule"]
 
-
 class PythonModule(BaseModule):
-    """A parameter-free module whose compute is plain Python: subclasses
-    implement ``forward``/``backward`` (and ``_compute_output_shapes``);
-    every parameter/optimizer API is a no-op."""
+    """Parameter-free module: subclasses supply ``forward``/``backward``
+    plus ``_compute_output_shapes``; the rest of the BaseModule contract
+    is inert (see class docstring)."""
 
     def __init__(self, data_names, label_names, output_names,
                  logger=logging):
         super().__init__(logger=logger)
-        self._data_names = list(data_names)
-        self._label_names = list(label_names or [])
-        self._output_names = list(output_names)
-        self._data_shapes = None
-        self._label_shapes = None
-        self._output_shapes = None
+        self._names = {
+            "data": list(data_names),
+            "label": list(label_names) if label_names else [],
+            "output": list(output_names),
+        }
+        self._shapes = {"data": None, "label": None, "output": None}
 
-    # --- shapes/names -----------------------------------------------------
-    @property
-    def data_names(self):
-        return self._data_names
-
-    @property
-    def output_names(self):
-        return self._output_names
-
-    @property
-    def data_shapes(self):
-        return self._data_shapes
-
-    @property
-    def label_shapes(self):
-        return self._label_shapes
-
-    @property
-    def output_shapes(self):
-        return self._output_shapes
+    data_names = property(lambda self: self._names["data"])
+    output_names = property(lambda self: self._names["output"])
+    data_shapes = property(lambda self: self._shapes["data"])
+    label_shapes = property(lambda self: self._shapes["label"])
+    output_shapes = property(lambda self: self._shapes["output"])
 
     def _compute_output_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError
 
-    # --- parameters: none -------------------------------------------------
-    def get_params(self):
-        return ({}, {})
-
-    def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
-        self.params_initialized = True
-
+    # the parameter/optimizer/state surface is deliberately inert for a
+    # parameter-free module (see module docstring)
     def update(self):
         pass
 
     def update_metric(self, eval_metric, labels):
         pass
-
-    def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False,
-             shared_module=None, grad_req="write"):
-        if self.binded and not force_rebind:
-            self.logger.warning("Already bound, ignoring bind()")
-            return
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-        self.binded = True
-        self._data_shapes = [tuple(s) if not hasattr(s, "shape") else s
-                             for s in data_shapes]
-        self._label_shapes = label_shapes
-        self._output_shapes = self._compute_output_shapes()
-
-    def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        self.optimizer_initialized = True
 
     def install_monitor(self, mon):
         pass
@@ -97,52 +57,79 @@ class PythonModule(BaseModule):
     def set_states(self, states=None, value=None):
         pass
 
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("PythonModule.bind: already bound; pass "
+                                "force_rebind=True to rebind")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+        self._shapes["data"] = [
+            s if hasattr(s, "shape") else tuple(s) for s in data_shapes]
+        self._shapes["label"] = label_shapes
+        self._shapes["output"] = self._compute_output_shapes()
+
 
 class PythonLossModule(PythonModule):
-    """Pass-through scores forward; backward produces the loss gradient
-    from ``grad_func(scores, labels)`` (reference default: softmax-style
-    ``scores - onehot(labels)`` is NOT assumed — the caller supplies
-    grad_func, or overrides ``backward``)."""
+    """Identity on the forward pass; on the backward pass emits
+    ``grad_func(scores, labels)`` as the input gradient. The caller must
+    supply ``grad_func`` (or subclass and override ``backward``) — no
+    default loss is assumed."""
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        super().__init__(list(data_names), list(label_names),
-                         [name + "_output"], logger=logger)
-        assert len(self._data_names) == 1
+        data_names = tuple(data_names)  # consume any iterator exactly once
+        if len(data_names) != 1:
+            raise ValueError("PythonLossModule takes exactly one data input")
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
         self._name = name
-        self._scores = None
-        self._labels = None
-        self._scores_grad = None
-        if grad_func is not None:
-            assert callable(grad_func)
         self._grad_func = grad_func
+        self._cache = {"scores": None, "labels": None, "grad": None}
 
     def _compute_output_shapes(self):
-        first = self._data_shapes[0]
-        shape = first[1] if isinstance(first, tuple) else first.shape
+        desc = self._shapes["data"][0]
+        shape = desc.shape if hasattr(desc, "shape") else desc[1]
         return [(self._name + "_output", tuple(shape))]
 
     def forward(self, data_batch, is_train=None):
-        self._scores = data_batch.data[0]
-        if is_train is None:
-            is_train = self.for_training
-        if is_train and data_batch.label:
-            self._labels = data_batch.label[0]
+        self._cache["scores"] = data_batch.data[0]
+        train = self.for_training if is_train is None else is_train
+        if train and data_batch.label:
+            self._cache["labels"] = data_batch.label[0]
 
     def get_outputs(self, merge_multi_context=True):
-        return [self._scores]
+        return [self._cache["scores"]]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, (
-            "PythonLossModule is a loss head; it takes no incoming "
-            "gradient")
-        assert self._grad_func is not None, (
-            "PythonLossModule needs grad_func (or override backward)")
-        grad = self._grad_func(self._scores, self._labels)
+        if out_grads is not None:
+            raise ValueError("PythonLossModule is a loss head and accepts "
+                             "no incoming gradient")
+        if self._grad_func is None:
+            raise RuntimeError("PythonLossModule requires grad_func "
+                               "(or override backward)")
+        grad = self._grad_func(self._cache["scores"], self._cache["labels"])
         if not isinstance(grad, nd.NDArray):
             grad = nd.array(np.asarray(grad))
-        self._scores_grad = grad
+        self._cache["grad"] = grad
 
     def get_input_grads(self, merge_multi_context=True):
-        return [self._scores_grad]
+        return [self._cache["grad"]]

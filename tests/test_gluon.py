@@ -393,6 +393,12 @@ def test_bench_gluon_config_engages_fusion():
     assert tr._can_fuse()
     assert tr._fused is not None        # the fused program actually ran
 
+    # the BENCH_ALL config itself drives compile_step (whole-step fusion);
+    # guard that this exact setup compiles and runs it
+    step = tr.compile_step(net, loss_fn)
+    step(x, y)
+    assert step.compile_count == 1
+
 
 def test_gluon_nd_conv_pool_blocks():
     """1-D/3-D conv, transpose-conv and pool blocks (reference
@@ -427,3 +433,135 @@ def test_gluon_nd_conv_pool_blocks():
     outp = p3(mx.nd.array(x3))
     wantp = F.max_pool3d(torch.tensor(x3), 2, 2).numpy()
     np.testing.assert_allclose(outp.asnumpy(), wantp, rtol=1e-5)
+
+
+def test_compile_step_matches_eager():
+    """Trainer.compile_step (whole fwd+bwd+update as ONE program) matches
+    the eager record/backward/step path: weights, loss values, and BN
+    moving stats, across SGD-momentum and Adam+MultiFactorScheduler."""
+    import numpy as np
+
+    from mxnet_tpu import autograd
+
+    def build(opt_name, opt_params):
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(16, in_units=8))
+        net.add(mx.gluon.nn.BatchNorm())
+        net.add(mx.gluon.nn.Activation("relu"))
+        net.add(mx.gluon.nn.Dense(4, in_units=16))
+        net.initialize(mx.initializer.Xavier())
+        net.hybridize()
+        net(mx.nd.zeros((2, 8)))  # materialize deferred-shape params (BN)
+        tr = mx.gluon.Trainer(net.collect_params(), opt_name,
+                              dict(opt_params), kvstore=None)
+        return net, tr
+
+    rng = np.random.RandomState(7)
+    x = mx.nd.array(rng.randn(8, 8).astype("float32"))
+    y = mx.nd.array(rng.randint(0, 4, 8).astype("float32"))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.5)
+    for opt_name, opt_params in (
+            ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+            ("adam", {"learning_rate": 0.01, "lr_scheduler": sched})):
+        eager_net, eager_tr = build(opt_name, opt_params)
+        fused_net, fused_tr = build(opt_name, opt_params)
+        for pe, pf in zip(eager_net.collect_params().values(),
+                          fused_net.collect_params().values()):
+            pf.set_data(mx.nd.array(pe.data().asnumpy()))
+
+        step = fused_tr.compile_step(fused_net, loss_fn)
+        for it in range(5):
+            with autograd.record():
+                loss_e = loss_fn(eager_net(x), y)
+            loss_e.backward()
+            eager_tr.step(8)
+            loss_f = step(x, y)
+            np.testing.assert_allclose(loss_f.asnumpy(), loss_e.asnumpy(),
+                                       rtol=1e-5, atol=1e-6)
+        for (ne, pe), (nf, pf) in zip(
+                sorted(eager_net.collect_params().items()),
+                sorted(fused_net.collect_params().items())):
+            np.testing.assert_allclose(
+                pf.data().asnumpy(), pe.data().asnumpy(),
+                rtol=2e-5, atol=2e-6,
+                err_msg="%s/%s diverged under %s" % (ne, nf, opt_name))
+        # BN moving stats must have moved off init AND match
+        bn_moved = any("running_mean" in n and
+                       np.abs(p.data().asnumpy()).max() > 0
+                       for n, p in fused_net.collect_params().items())
+        assert bn_moved, "fused step did not update BN moving stats"
+        # the scheduler's lr changes must NOT have recompiled the program
+        assert step.compile_count == 1, \
+            "compile_step recompiled %d times" % step.compile_count
+
+
+def test_compile_step_rng_ops():
+    """Dropout inside a compiled step draws fresh randomness per call."""
+    import numpy as np
+
+    from mxnet_tpu import autograd  # noqa: F401
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, in_units=8))
+    net.add(mx.gluon.nn.Dropout(0.5))
+    net.add(mx.gluon.nn.Dense(4, in_units=32))
+    net.initialize()
+    net.hybridize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.0}, kvstore=None)
+    step = tr.compile_step(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    rng = np.random.RandomState(3)
+    x = mx.nd.array(rng.randn(8, 8).astype("float32"))
+    y = mx.nd.array(rng.randint(0, 4, 8).astype("float32"))
+    losses = {tuple(step(x, y).asnumpy().tolist()) for _ in range(4)}
+    assert len(losses) > 1, "dropout mask appears frozen across steps"
+
+
+def test_compile_step_frozen_params():
+    """grad_req='null' params must survive the fused step intact (the
+    donation set excludes them) and remain usable by later steps and
+    eager forwards."""
+    import numpy as np
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, in_units=8))
+    net.add(mx.gluon.nn.Dense(4, in_units=16))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    frozen = list(net.collect_params().values())[0]
+    frozen.grad_req = "null"
+    before = frozen.data().asnumpy().copy()
+
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore=None)
+    step = tr.compile_step(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    rng = np.random.RandomState(11)
+    x = mx.nd.array(rng.randn(8, 8).astype("float32"))
+    y = mx.nd.array(rng.randint(0, 4, 8).astype("float32"))
+    step(x, y)
+    step(x, y)  # second step reads the frozen buffer again
+    np.testing.assert_array_equal(frozen.data().asnumpy(), before)
+    net(x).asnumpy()  # eager forward still works
+
+
+def test_compile_step_rejects_kvstore():
+    """compile_step is a local fused path; kvstore-backed trainers must
+    be rejected loudly, not silently update locally."""
+    import pytest as _pytest
+
+    net = mx.gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore="device")
+    tr._init_kvstore()
+    if tr._kvstore is None:  # single-device local resolves to no store
+        import mxnet_tpu.kvstore as kvs
+        tr._kvstore = kvs.create("local")
+    step = tr.compile_step(net, mx.gluon.loss.SoftmaxCrossEntropyLoss())
+    x = mx.nd.ones((4, 8))
+    y = mx.nd.zeros((4,))
+    with _pytest.raises(ValueError, match="kvstore"):
+        step(x, y)

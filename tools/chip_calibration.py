@@ -26,14 +26,19 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
 
+_RTT = 0.0  # set once in main(); subtracted from every timed run
+
+
 def _timed_scan(step, init_carry, n_iters, n_repeats=3):
-    """Best wall time of scan(step, carry, length=n_iters) — one program.
+    """Best device time of scan(step, carry, length=n_iters) — one program.
 
     The program returns a scalar checksum which is fetched to host each
     repeat: on the tunneled axon platform block_until_ready() can return
     before the device has finished, so only a host-side data dependency
     (a D2H transfer of a value derived from the result) is a trustworthy
     completion fence. The transfer is 4 bytes — noise at these runtimes.
+    The measured dispatch RTT (~86 ms on the tunnel) is subtracted so the
+    result is device time, not wall time.
     """
     import jax
     import jax.numpy as jnp
@@ -56,7 +61,8 @@ def _timed_scan(step, init_carry, n_iters, n_repeats=3):
         t0 = time.perf_counter()
         float(run(init_carry))
         best = min(best, time.perf_counter() - t0)
-    return best
+    # one dispatch+fetch round trip per run is overhead, not device time
+    return max(best - _RTT, 1e-9)
 
 
 def measure_dispatch_rtt():
@@ -96,7 +102,8 @@ def bench_matmul():
                 # the "matmul" disappears. Random values are irreducible.
                 b = jax.random.normal(
                     jax.random.PRNGKey(0), (k, n)).astype(dtype)
-                # ≥20 TFLOP per run so a ~10ms dispatch RTT is <0.1% noise
+                # the measured dispatch RTT is subtracted from each run;
+                # ≥20 TFLOP per run keeps the residual variance small
                 iters = max(4, int(2e13 / (2 * m * k * n)))
 
                 def step(carry):
@@ -163,8 +170,10 @@ def bench_hbm():
 def main():
     import jax
 
+    global _RTT
+
     dev = jax.devices()[0]
-    rtt = measure_dispatch_rtt()
+    _RTT = rtt = measure_dispatch_rtt()
     print("[rtt] empty-program dispatch: %.1f ms" % (rtt * 1e3),
           file=sys.stderr)
     matmul = bench_matmul()
