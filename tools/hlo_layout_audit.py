@@ -57,6 +57,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=50)
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--layout", default="NHWC", choices=("NHWC", "NCHW"),
+                    help="NHWC is the bench.py protocol")
+    ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--dump", default=None,
                     help="also write the full optimized HLO here")
@@ -71,11 +74,15 @@ def main():
     from mxnet_tpu.parallel import ShardedTrainer, make_mesh
 
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    symbol = get_resnet(num_classes=1000, num_layers=args.layers)
+    symbol = get_resnet(num_classes=1000, num_layers=args.layers,
+                        layout=args.layout)
     trainer = ShardedTrainer(symbol, mesh, optimizer="sgd",
                              optimizer_params={"learning_rate": 0.1,
-                                               "momentum": 0.9})
-    shapes = {"data": (args.batch, 3, 224, 224),
+                                               "momentum": 0.9},
+                             dtype=np.dtype(args.dtype))
+    shapes = {"data": ((args.batch, 3, 224, 224)
+                       if args.layout == "NCHW"
+                       else (args.batch, 224, 224, 3)),
               "softmax_label": (args.batch,)}
     state = trainer.init(shapes)
     rng = np.random.RandomState(0)
