@@ -1,0 +1,160 @@
+"""Fully-convolutional segmentation, FCN-xs style (reference:
+example/fcn-xs/ — VGG backbone + 1x1 score head + transposed-conv
+upsampling with skip fusion, trained with per-pixel softmax).
+
+Offline stand-in for PASCAL: a generated dataset of images containing
+colored geometric shapes (disk / square / stripe) over textured
+background; the task is per-pixel 4-way classification. The network is
+a scaled-down FCN-8s: conv backbone downsampling 8x, score head, 2x
+transposed-conv upsample fused with the stride-4 skip score, then a
+final 4x bilinear-initialized transposed conv — the same
+skip-and-upsample topology as the reference, exercising Convolution,
+Deconvolution (bilinear init), elementwise fusion, and per-pixel
+SoftmaxOutput with multi_output.
+
+Usage:
+    python examples/segmentation/fcn_xs.py            # full
+    python examples/segmentation/fcn_xs.py --smoke    # CI-sized
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                  _os.pardir, _os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+N_CLASS = 4  # background, disk, square, stripe
+
+
+def make_shapes_dataset(n, size, rng):
+    """Images (n,3,size,size) float32 + per-pixel labels (n,size,size)."""
+    imgs = np.empty((n, 3, size, size), np.float32)
+    labels = np.zeros((n, size, size), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        img = rng.uniform(0.0, 0.25, (3, size, size)).astype(np.float32)
+        lab = np.zeros((size, size), np.float32)
+        # disk
+        cx, cy, r = rng.randint(8, size - 8, 2).tolist() + [rng.randint(4, 9)]
+        mask = (xx - cx) ** 2 + (yy - cy) ** 2 < r * r
+        img[0][mask] += 0.7
+        lab[mask] = 1
+        # square
+        sx, sy = rng.randint(2, size - 12, 2)
+        w = rng.randint(6, 12)
+        mask = np.zeros_like(lab, bool)
+        mask[sy:sy + w, sx:sx + w] = True
+        img[1][mask] += 0.7
+        lab[mask] = 2
+        # horizontal stripe
+        s0 = rng.randint(0, size - 4)
+        mask = np.zeros_like(lab, bool)
+        mask[s0:s0 + 3, :] = True
+        img[2][mask] += 0.7
+        lab[mask] = 3
+        imgs[i] = np.clip(img + rng.normal(0, 0.05, img.shape), 0, 1)
+        labels[i] = lab
+    return imgs, labels
+
+
+def fcn_symbol(size):
+    """Scaled-down FCN-8s: 8x-downsampling backbone, skip fusion at 4x."""
+    data = mx.sym.Variable("data")
+
+    def block(x, nf, name, stride=2):
+        x = mx.sym.Convolution(x, num_filter=nf, kernel=(3, 3), pad=(1, 1),
+                               stride=(stride, stride), name=name)
+        x = mx.sym.BatchNorm(x, name=name + "_bn")
+        return mx.sym.Activation(x, act_type="relu")
+
+    c1 = block(data, 16, "conv1")            # size/2
+    c2 = block(c1, 32, "conv2")              # size/4
+    c3 = block(c2, 64, "conv3")              # size/8
+    c3 = block(c3, 64, "conv3b", stride=1)
+
+    score8 = mx.sym.Convolution(c3, num_filter=N_CLASS, kernel=(1, 1),
+                                name="score8")
+    score4 = mx.sym.Convolution(c2, num_filter=N_CLASS, kernel=(1, 1),
+                                name="score4")
+    # 2x up from stride-8 to stride-4, fuse with the skip score
+    up4 = mx.sym.Deconvolution(score8, num_filter=N_CLASS, kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1), no_bias=True,
+                               name="up2x")
+    fused = up4 + score4
+    # final 4x bilinear-style upsample to full resolution
+    up = mx.sym.Deconvolution(fused, num_filter=N_CLASS, kernel=(8, 8),
+                              stride=(4, 4), pad=(2, 2), no_bias=True,
+                              name="up4x")
+    return mx.sym.SoftmaxOutput(up, multi_output=True, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    size = 32 if args.smoke else 64
+    n_train = 200 if args.smoke else 1200
+    n_val = 60 if args.smoke else 200
+    epochs = 7 if args.smoke else 12
+    bs = 20
+
+    xtr, ytr = make_shapes_dataset(n_train, size, rng)
+    xva, yva = make_shapes_dataset(n_val, size, rng)
+
+    train_iter = mx.io.NDArrayIter(xtr, {"softmax_label": ytr},
+                                   batch_size=bs, shuffle=True)
+    ctx = mx.gpu() if mx.context.num_gpus() else mx.cpu()
+    mod = mx.mod.Module(fcn_symbol(size), context=ctx,
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    # bilinear init for the upsampling deconvs, Xavier elsewhere — the
+    # reference's init recipe (example/fcn-xs/init_fcnxs.py)
+    mod.init_params(mx.init.Mixed([".*up.*_weight", ".*"],
+                                  [mx.init.Bilinear(), mx.init.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 2e-3})
+
+    metric = mx.metric.create("acc")  # per-pixel accuracy (multi_output)
+    for epoch in range(epochs):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        print("epoch %d  train pixel-acc %.4f" % (epoch, metric.get()[1]))
+
+    # validation pixel accuracy + per-class IoU
+    out = []
+    for lo in range(0, n_val, bs):
+        mod.forward(mx.io.DataBatch([mx.nd.array(xva[lo:lo + bs], ctx=ctx)],
+                                    []), is_train=False)
+        out.append(mod.get_outputs()[0].asnumpy())
+    pred = np.concatenate(out).argmax(1)
+    pix_acc = (pred == yva).mean()
+    ious = []
+    for c in range(N_CLASS):
+        inter = ((pred == c) & (yva == c)).sum()
+        union = ((pred == c) | (yva == c)).sum()
+        if union:
+            ious.append(inter / union)
+    miou = float(np.mean(ious))
+    print("val pixel-acc %.4f  mIoU %.4f" % (pix_acc, miou))
+
+    floor = 0.80 if args.smoke else 0.90
+    assert pix_acc > floor, "pixel accuracy %.3f below %.2f" % (pix_acc,
+                                                                floor)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,9 @@ def _invoke_impl(opdef, args, kwargs):
         else:
             attr_kwargs[k] = v
 
+    # reference signatures allow trailing positional params: nd.clip(x,0,1)
+    args = opdef.bind_positional_params(args, attr_kwargs, NDArray)
+
     # variadic ops: auto-fill num_args from positional inputs (Concat, add_n...)
     if "num_args" in opdef.params and "num_args" not in attr_kwargs:
         attr_kwargs["num_args"] = len(args) + len(tensor_kwargs)

@@ -113,6 +113,32 @@ class OpDef:
     def parse_attrs(self, kwargs):
         return OpAttrs(parse_params(self.params, kwargs, self.name))
 
+    def bind_positional_params(self, args, attr_kwargs, tensor_type):
+        """Reference-signature positional params: the generated functions
+        accept ``op(data, p1, p2, ...)`` (e.g. ``nd.clip(x, 0, 1)``,
+        ``nd.reshape(x, shape)``). Trailing non-tensor positional args
+        bind to declared params in registration order; leading tensor
+        args are returned as the op inputs. ``attr_kwargs`` is mutated.
+        """
+        tensors = list(args)
+        trailing = []
+        while tensors and not isinstance(tensors[-1], tensor_type):
+            trailing.append(tensors.pop())
+        trailing.reverse()
+        if trailing:
+            names = [k for k in self.params if k != "num_args"]
+            if len(trailing) > len(names):
+                raise MXNetError(
+                    "%s: %d positional parameter(s) given but the op "
+                    "declares only %s" % (self.name, len(trailing), names))
+            for value, key in zip(trailing, names):
+                if key in attr_kwargs:
+                    raise MXNetError(
+                        "%s: got multiple values for parameter %r"
+                        % (self.name, key))
+                attr_kwargs[key] = value
+        return tensors
+
     def attrs_to_str_dict(self, attrs):
         return params_to_str_dict(self.params, attrs._d)
 

@@ -26,6 +26,9 @@ def make_symbol(opdef, args, kwargs):
         else:
             attr_kwargs[k] = v
 
+    # reference signatures allow trailing positional params: sym.clip(x,0,1)
+    args = opdef.bind_positional_params(args, attr_kwargs, Symbol)
+
     if "num_args" in opdef.params and "num_args" not in attr_kwargs:
         attr_kwargs["num_args"] = len(args) + len(sym_kwargs)
 

@@ -28,6 +28,8 @@ _EXAMPLES = [
     "examples/profiler/profile_training.py",
     "examples/reinforcement_learning/dqn_gridworld.py",
     "examples/bi_lstm_sort/lstm_sort.py",
+    "examples/adversary/fgsm.py",
+    "examples/segmentation/fcn_xs.py",
 ]
 
 
@@ -49,7 +51,7 @@ def test_example_smoke(script):
                             ).strip()
     res = subprocess.run(
         [sys.executable, os.path.join(_REPO, script), "--smoke"],
-        env=env, cwd=_REPO, capture_output=True, timeout=600)
+        env=env, cwd=_REPO, capture_output=True, timeout=900)
     assert res.returncode == 0, "%s failed:\n%s\n%s" % (
         script, res.stdout.decode()[-3000:], res.stderr.decode()[-3000:])
 

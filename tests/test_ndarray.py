@@ -245,3 +245,22 @@ def test_ndarray_setitem_grid():
     nd2[(mx.nd.array(np.array([0, 2])), slice(0, 2))] = 9.0
     want2[(np.array([0, 2]), slice(0, 2))] = 9.0
     np.testing.assert_allclose(nd2.asnumpy(), want2, rtol=1e-6)
+
+
+def test_positional_op_params():
+    """Reference generated signatures accept trailing positional params:
+    nd.clip(x, 0, 1), nd.reshape(x, shape), sym.clip(s, 0, 1)."""
+    x = mx.nd.array([[-1.0, 2.0], [0.5, 3.0]])
+    np.testing.assert_allclose(mx.nd.clip(x, 0.0, 1.0).asnumpy(),
+                               [[0.0, 1.0], [0.5, 1.0]])
+    assert mx.nd.reshape(x, (4,)).shape == (4,)
+    assert mx.nd.one_hot(mx.nd.array([1, 2]), 4).shape == (2, 4)
+    assert mx.nd.expand_dims(x, 0).shape == (1, 2, 2)
+    s = mx.sym.Variable("a")
+    assert mx.sym.clip(s, 0.0, 1.0).list_arguments() == ["a"]
+    # a positional AND keyword value for the same param is an error
+    with pytest.raises(mx.base.MXNetError):
+        mx.nd.clip(x, 0.0, 1.0, a_max=2.0)
+    # more positionals than declared params is an error
+    with pytest.raises(mx.base.MXNetError):
+        mx.nd.expand_dims(x, 0, 1)
