@@ -1,0 +1,236 @@
+"""Operator grids, part 2: shape/axis/mode grids for families the first
+grid pass (test_op_grids.py) did not reach — Pad modes, batch_dot
+transpose flags, tile/repeat/reverse, pick, swapaxes/transpose axes,
+sequence ops over length grids, broadcast binary shape grid with
+gradients. Oracles are numpy/torch (reference test strategy:
+tests/python/unittest/test_operator.py grids)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RNG = np.random.RandomState(42)
+
+
+# ---------------------------------------------------------------- Pad
+@pytest.mark.parametrize("mode", ["constant", "edge", "reflect"])
+@pytest.mark.parametrize("pw", [(1, 1, 2, 2), (0, 2, 1, 0)])
+def test_pad_modes_grid(mode, pw):
+    x = RNG.randn(2, 3, 5, 6).astype(np.float32)
+    pad_width = (0, 0, 0, 0) + pw
+    kw = {"constant_value": 2.5} if mode == "constant" else {}
+    out = mx.nd.Pad(mx.nd.array(x), mode=mode, pad_width=pad_width,
+                    **kw).asnumpy()
+    np_mode = {"constant": "constant", "edge": "edge",
+               "reflect": "reflect"}[mode]
+    np_kw = {"constant_values": 2.5} if mode == "constant" else {}
+    want = np.pad(x, [(0, 0), (0, 0), (pw[0], pw[1]), (pw[2], pw[3])],
+                  mode=np_mode, **np_kw)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_pad_gradient_constant():
+    x = mx.nd.array(RNG.randn(1, 1, 3, 3).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Pad(x, mode="constant",
+                      pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    y.backward(mx.nd.ones(y.shape))
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones((1, 1, 3, 3)))
+
+
+# ----------------------------------------------------------- batch_dot
+@pytest.mark.parametrize("ta", [False, True])
+@pytest.mark.parametrize("tb", [False, True])
+def test_batch_dot_transpose_grid(ta, tb):
+    a = RNG.randn(4, 3, 5).astype(np.float32)
+    b = RNG.randn(4, 5, 2).astype(np.float32)
+    an = a.transpose(0, 2, 1) if ta else a
+    bn = b.transpose(0, 2, 1) if tb else b
+    out = mx.nd.batch_dot(mx.nd.array(an), mx.nd.array(bn),
+                          transpose_a=ta, transpose_b=tb).asnumpy()
+    want = np.einsum("bij,bjk->bik", a, b)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, True)])
+def test_dot_2d_transpose_grid(ta, tb):
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(4, 5).astype(np.float32)
+    an = a.T if ta else a
+    bn = b.T if tb else b
+    out = mx.nd.dot(mx.nd.array(an), mx.nd.array(bn),
+                    transpose_a=ta, transpose_b=tb).asnumpy()
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+# ---------------------------------------------------- tile/repeat/reverse
+@pytest.mark.parametrize("reps", [(2,), (2, 3), (1, 2, 2)])
+def test_tile_grid(reps):
+    x = RNG.randn(2, 3).astype(np.float32)
+    out = mx.nd.tile(mx.nd.array(x), reps=reps).asnumpy()
+    np.testing.assert_allclose(out, np.tile(x, reps), rtol=1e-6)
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1, None])
+def test_repeat_grid(axis):
+    x = RNG.randn(2, 3).astype(np.float32)
+    out = mx.nd.repeat(mx.nd.array(x), repeats=3, axis=axis).asnumpy()
+    np.testing.assert_allclose(out, np.repeat(x, 3, axis=axis), rtol=1e-6)
+
+
+@pytest.mark.parametrize("axis", [(0,), (1,), (0, 2), (1, 2)])
+def test_reverse_grid(axis):
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    out = mx.nd.reverse(mx.nd.array(x), axis=axis).asnumpy()
+    np.testing.assert_allclose(out, np.flip(x, axis), rtol=1e-6)
+
+
+def test_flip_alias():
+    x = RNG.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(mx.nd.flip(mx.nd.array(x), axis=1).asnumpy(),
+                               np.flip(x, 1), rtol=1e-6)
+
+
+# ------------------------------------------------------------------ pick
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_pick_grid(axis, keepdims):
+    x = RNG.randn(4, 5).astype(np.float32)
+    ax = axis % 2
+    idx = RNG.randint(0, x.shape[ax], x.shape[1 - ax]).astype(np.float32)
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=axis,
+                     keepdims=keepdims).asnumpy()
+    want = (np.take_along_axis(x, idx[None].astype(int), 0)[0] if ax == 0
+            else np.take_along_axis(x, idx[:, None].astype(int), 1)[:, 0])
+    if keepdims:
+        want = np.expand_dims(want, ax)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_pick_gradient():
+    x = mx.nd.array(RNG.randn(3, 4).astype(np.float32))
+    idx = mx.nd.array(np.array([0, 2, 3], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.pick(x, idx, axis=1)
+    y.backward(mx.nd.ones(y.shape))
+    want = np.zeros((3, 4), np.float32)
+    want[np.arange(3), [0, 2, 3]] = 1
+    np.testing.assert_allclose(x.grad.asnumpy(), want)
+
+
+# ------------------------------------------------- transpose / swapaxes
+@pytest.mark.parametrize("axes", [(1, 0, 2), (2, 0, 1), (0, 2, 1)])
+def test_transpose_axes_grid(axes):
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    out = mx.nd.transpose(mx.nd.array(x), axes=axes).asnumpy()
+    np.testing.assert_allclose(out, x.transpose(axes), rtol=1e-6)
+
+
+@pytest.mark.parametrize("d1,d2", [(0, 1), (1, 2), (0, 2)])
+def test_swapaxes_grid(d1, d2):
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    out = mx.nd.SwapAxis(mx.nd.array(x), dim1=d1, dim2=d2).asnumpy()
+    np.testing.assert_allclose(out, np.swapaxes(x, d1, d2), rtol=1e-6)
+
+
+# ------------------------------------------------------- sequence ops
+@pytest.mark.parametrize("lengths", [[1, 3, 5], [5, 5, 5], [2, 1, 4]])
+def test_sequence_ops_length_grid(lengths):
+    T, B, D = 5, 3, 2
+    x = RNG.randn(T, B, D).astype(np.float32)
+    ln = np.array(lengths, np.float32)
+    nd_x, nd_l = mx.nd.array(x), mx.nd.array(ln)
+
+    masked = mx.nd.SequenceMask(nd_x, nd_l, use_sequence_length=True,
+                                value=-1.0).asnumpy()
+    last = mx.nd.SequenceLast(nd_x, nd_l,
+                              use_sequence_length=True).asnumpy()
+    rev = mx.nd.SequenceReverse(nd_x, nd_l,
+                                use_sequence_length=True).asnumpy()
+    for b, L in enumerate(map(int, lengths)):
+        np.testing.assert_allclose(masked[:L, b], x[:L, b])
+        assert (masked[L:, b] == -1.0).all()
+        np.testing.assert_allclose(last[b], x[L - 1, b])
+        np.testing.assert_allclose(rev[:L, b], x[:L, b][::-1])
+        np.testing.assert_allclose(rev[L:, b], x[L:, b])
+
+
+# ------------------------------------- broadcast binary ops: shape grid
+_BSHAPES = [((2, 3), (2, 3)), ((2, 3), (1, 3)), ((2, 1, 4), (1, 3, 1)),
+            ((3,), (2, 3)), ((2, 3, 4), (4,))]
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("broadcast_add", np.add), ("broadcast_mul", np.multiply),
+    ("broadcast_sub", np.subtract), ("broadcast_maximum", np.maximum),
+    ("broadcast_power", lambda a, b: np.power(np.abs(a) + 0.5, b)),
+])
+@pytest.mark.parametrize("sa,sb", _BSHAPES)
+def test_broadcast_binary_shape_grid(op, npop, sa, sb):
+    a = RNG.randn(*sa).astype(np.float32)
+    b = RNG.randn(*sb).astype(np.float32)
+    if op == "broadcast_power":
+        a = np.abs(a) + 0.5
+    out = getattr(mx.nd, op)(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    want = npop(a, b) if op != "broadcast_power" else np.power(a, b)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("sa,sb", _BSHAPES)
+def test_broadcast_mul_gradient_reduces(sa, sb):
+    """Gradients of broadcast ops must sum over the broadcast axes."""
+    a = mx.nd.array(RNG.randn(*sa).astype(np.float32))
+    b = mx.nd.array(RNG.randn(*sb).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.broadcast_mul(a, b)
+    y.backward(mx.nd.ones(y.shape))
+    ones = np.ones(y.shape, np.float32)
+
+    def reduce_to(g, shape):
+        g = np.asarray(g)
+        while g.ndim > len(shape):
+            g = g.sum(0)
+        for i, s in enumerate(shape):
+            if s == 1 and g.shape[i] != 1:
+                g = g.sum(i, keepdims=True)
+        return g
+
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               reduce_to(ones * b.asnumpy(), sa), rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               reduce_to(ones * a.asnumpy(), sb), rtol=1e-5)
+
+
+# ----------------------------------------------------------- Crop / slice
+def test_crop_center_and_offset():
+    x = RNG.randn(1, 3, 8, 8).astype(np.float32)
+    out = mx.nd.Crop(mx.nd.array(x), h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_allclose(out, x[:, :, 2:6, 2:6], rtol=1e-6)
+    out2 = mx.nd.Crop(mx.nd.array(x), h_w=(3, 5), offset=(1, 2)).asnumpy()
+    np.testing.assert_allclose(out2, x[:, :, 1:4, 2:7], rtol=1e-6)
+
+
+@pytest.mark.parametrize("axis,num_outputs", [(1, 3), (2, 2), (-1, 2)])
+def test_slice_channel_grid(axis, num_outputs):
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    outs = mx.nd.SliceChannel(mx.nd.array(x), num_outputs=num_outputs,
+                              axis=axis)
+    want = np.split(x, num_outputs, axis)
+    for o, w in zip(outs, want):
+        np.testing.assert_allclose(o.asnumpy(), w, rtol=1e-6)
+
+
+# -------------------------------------------------- expand/squeeze grid
+@pytest.mark.parametrize("axis", [0, 1, 2, -1, -2])
+def test_expand_dims_reshape_roundtrip(axis):
+    # (the reference snapshot predates the squeeze op; the inverse of
+    # expand_dims in its vocabulary is reshape to the original shape)
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = mx.nd.expand_dims(mx.nd.array(x), axis=axis)
+    assert y.shape == tuple(np.expand_dims(x, axis).shape)
+    z = mx.nd.reshape(y, x.shape)
+    np.testing.assert_allclose(z.asnumpy(), x, rtol=1e-6)
