@@ -122,7 +122,9 @@ def main():
 
     dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
-    big_bs = int(os.environ.get("BENCH_BIG_BATCH", "512"))
+    # bs128 is the measured throughput peak on v5e (r5 sweep: 2527 bs64 /
+    # 2918 bs128 / 2751 bs256 / 2640 bs512)
+    big_bs = int(os.environ.get("BENCH_BIG_BATCH", "128"))
 
     # peak table is bf16; MFU is only meaningful for the bf16 protocol
     peak = (_PEAK_BF16.get(jax.devices()[0].device_kind)
@@ -146,14 +148,15 @@ def main():
     if peak:
         result["mfu_bs32"] = round(img_s_32 * FLOPS_PER_IMG / peak, 4)
         result["mfu_capability"] = round(img_s_big * FLOPS_PER_IMG / peak, 4)
-        # measured ceilings for this chip (PERF_NOTES.md): 8192^3 bf16
-        # matmul sustains 128.6 TF/s (65% of spec) and bf16 HBM streams
-        # 442 GB/s (54% of spec); ResNet-50 at ~82 flops/byte is
-        # bandwidth-bound on this part, roofline ~2950 img/s
+        # measured ceilings for this chip (CALIBRATION.json, round-5
+        # RTT-subtracted run): bf16 matmul peaks at 157.8 TF/s (80% of
+        # spec) and HBM streams 634 GB/s (77% of spec); ResNet-50 at
+        # ~82 flops/byte is bandwidth-bound on this part — roofline
+        # 634 GB/s / ~150 MB/img ~= 4200 img/s
         result["mfu_vs_measured_matmul_peak"] = round(
-            max(img_s_32, img_s_big) * FLOPS_PER_IMG / 128.6e12, 4)
-        result["roofline_img_per_sec"] = 2950
-        result["vs_roofline"] = round(max(img_s_32, img_s_big) / 2950.0, 3)
+            max(img_s_32, img_s_big) * FLOPS_PER_IMG / 157.8e12, 4)
+        result["roofline_img_per_sec"] = 4200
+        result["vs_roofline"] = round(max(img_s_32, img_s_big) / 4200.0, 3)
 
     # sidecar: all-config artifact (BENCH_ALL.json) covering every
     # BASELINE.json config — best-effort, never blocks the headline line

@@ -27,7 +27,7 @@ def probe(timeout: float = 45.0) -> bool:
         print("tpu_probe: TIMEOUT (tunnel down)", file=sys.stderr)
         return False
     if out.returncode == 0:
-        print("tpu_probe: OK", out.stdout.strip())
+        print("tpu_probe: OK", out.stdout.strip(), file=sys.stderr)
         return True
     print("tpu_probe: FAIL", out.stderr.strip()[-200:], file=sys.stderr)
     return False
