@@ -148,15 +148,27 @@ def main():
     if peak:
         result["mfu_bs32"] = round(img_s_32 * FLOPS_PER_IMG / peak, 4)
         result["mfu_capability"] = round(img_s_big * FLOPS_PER_IMG / peak, 4)
-        # measured ceilings for this chip (CALIBRATION.json, round-5
-        # RTT-subtracted run): bf16 matmul peaks at 157.8 TF/s (80% of
-        # spec) and HBM streams 634 GB/s (77% of spec); ResNet-50 at
-        # ~82 flops/byte is bandwidth-bound on this part — roofline
-        # 634 GB/s / ~150 MB/img ~= 4200 img/s
+        # measured ceilings come from CALIBRATION.json (regenerated by
+        # tools/chip_calibration.py; RTT-subtracted) so a recalibration
+        # cannot leave stale constants here. Fallbacks are the round-5
+        # numbers: 157.8 TF/s bf16 peak, 634 GB/s HBM. ResNet-50 at ~82
+        # flops/byte is bandwidth-bound on this part; the roofline is
+        # HBM GB/s over the ~150 MB/img the step streams.
+        tflops, gb_s = 157.8, 634.0
+        try:
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "CALIBRATION.json")) as f:
+                cal = json.load(f)
+            tflops = float(cal["best_tflops"])
+            gb_s = float(cal["best_gb_s"])
+        except Exception:
+            pass
+        roofline = round(gb_s * 1e9 / 150e6)
+        best = max(img_s_32, img_s_big)
         result["mfu_vs_measured_matmul_peak"] = round(
-            max(img_s_32, img_s_big) * FLOPS_PER_IMG / 157.8e12, 4)
-        result["roofline_img_per_sec"] = 4200
-        result["vs_roofline"] = round(max(img_s_32, img_s_big) / 4200.0, 3)
+            best * FLOPS_PER_IMG / (tflops * 1e12), 4)
+        result["roofline_img_per_sec"] = roofline
+        result["vs_roofline"] = round(best / roofline, 3)
 
     # sidecar: all-config artifact (BENCH_ALL.json) covering every
     # BASELINE.json config — best-effort, never blocks the headline line

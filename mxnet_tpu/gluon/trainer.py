@@ -388,6 +388,10 @@ class Trainer:
         for updater in self._updaters:
             updater.set_states(blob)
             updater.optimizer = self._optimizer
+            # the swap above replaced the optimizer the counts were
+            # restored into — re-apply (Adam bias-correction t, scheduler
+            # num_update)
+            updater._apply_counts(self._optimizer)
 
 
 class _FusedTrainStep:
