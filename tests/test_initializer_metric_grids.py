@@ -1,0 +1,202 @@
+"""Initializer statistical-property grid + metric oracle grid
+(reference: tests/python/unittest/test_init.py, test_metric.py).
+
+Initializers are checked for the DISTRIBUTIONAL property each one
+promises (variance formulas, orthonormality, bilinear interpolation
+kernel, LSTM forget-bias slice), not just shape; metrics run against
+independently computed numpy oracles across update patterns (multiple
+batches, resets, ignore labels)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _init_array(init, name, shape):
+    arr = mx.nd.zeros(shape)
+    desc = mx.init.InitDesc(name)
+    init(desc, arr)
+    return arr.asnumpy()
+
+
+# ----------------------------------------------------------- initializers
+def test_uniform_normal_ranges():
+    mx.random.seed(0)
+    u = _init_array(mx.init.Uniform(0.3), "w_weight", (200, 50))
+    assert abs(u.mean()) < 0.02 and u.min() >= -0.3 and u.max() <= 0.3
+    n = _init_array(mx.init.Normal(2.0), "w_weight", (200, 50))
+    assert abs(n.std() - 2.0) < 0.05
+
+
+@pytest.mark.parametrize("rnd_type,factor,expect", [
+    ("uniform", "avg", lambda fi, fo: np.sqrt(3.0 / ((fi + fo) / 2.0)) / np.sqrt(3)),
+    ("gaussian", "in", lambda fi, fo: np.sqrt(3.0 / fi)),
+    ("gaussian", "out", lambda fi, fo: np.sqrt(3.0 / fo)),
+])
+def test_xavier_variance_grid(rnd_type, factor, expect):
+    """Xavier's promised std = sqrt(3/factor_scale) (uniform draws have
+    std = bound/sqrt(3))."""
+    mx.random.seed(1)
+    shape = (128, 256)
+    fan_in, fan_out = shape[1], shape[0]
+    w = _init_array(mx.init.Xavier(rnd_type=rnd_type, factor_type=factor,
+                                   magnitude=3), "w_weight", shape)
+    assert abs(w.std() - expect(fan_in, fan_out)) / expect(fan_in, fan_out) \
+        < 0.1
+
+
+def test_orthogonal_is_orthonormal():
+    mx.random.seed(2)
+    w = _init_array(mx.init.Orthogonal(scale=1.0), "w_weight", (64, 256))
+    gram = w @ w.T
+    np.testing.assert_allclose(gram, np.eye(64), atol=1e-4)
+
+
+def test_bilinear_kernel_interpolates():
+    """Bilinear deconv weights must upsample a constant to a constant."""
+    w = _init_array(mx.init.Bilinear(), "up_weight", (1, 1, 4, 4))
+    # classic bilinear kernel: rows/cols sum so that stride-2 deconv of
+    # ones stays ones away from borders
+    k = w[0, 0]
+    assert abs(k[1, 1] - 0.5625) < 1e-6  # (1-|0.5|/2)^2 at the center taps
+    assert k.max() <= 1.0 and k.min() >= 0.0
+
+
+def test_lstmbias_forget_gate_slice():
+    """LSTMBias reaches its _init_weight via the __init__ attr override
+    (the rnn-cell wiring); a bare *_bias name pattern-dispatches to
+    zeros in the reference too."""
+    init = mx.init.LSTMBias(forget_bias=1.0)
+    arr = mx.nd.zeros((32,))  # 4 gates x 8 hidden
+    desc = mx.init.InitDesc("lstm_i2h_bias",
+                            attrs={"__init__": init.dumps()})
+    mx.init.Uniform()(desc, arr)  # outer init delegates to the override
+    b = arr.asnumpy()
+    np.testing.assert_allclose(b[8:16], np.ones(8))   # forget gate slice
+    np.testing.assert_allclose(np.delete(b, np.s_[8:16]), np.zeros(24))
+    # without the override, reference pattern dispatch zeroes any *_bias
+    arr2 = mx.nd.zeros((32,))
+    init(mx.init.InitDesc("lstm_i2h_bias"), arr2)
+    np.testing.assert_allclose(arr2.asnumpy(), np.zeros(32))
+
+
+def test_constant_zero_one_and_pattern_dispatch():
+    c = _init_array(mx.init.Constant(2.5), "w_weight", (3, 3))
+    np.testing.assert_allclose(c, 2.5)
+    # Initializer.__call__ pattern dispatch: *_bias -> zero even under One
+    one = mx.init.One()
+    arr = mx.nd.zeros((4,))
+    one(mx.init.InitDesc("fc_bias"), arr)
+    np.testing.assert_allclose(arr.asnumpy(), 0.0)
+
+
+def test_mixed_initializer_patterns():
+    """First matching pattern wins; the selected initializer still runs
+    the reference suffix dispatch (so *_bias under Constant -> 0)."""
+    mixed = mx.init.Mixed([".*up.*", ".*"],
+                          [mx.init.Constant(9.0), mx.init.One()])
+    a = mx.nd.zeros((4, 4))
+    mixed(mx.init.InitDesc("net_up2x_weight"), a)
+    np.testing.assert_allclose(a.asnumpy(), 9.0)
+    b = mx.nd.zeros((4, 4))
+    mixed(mx.init.InitDesc("net_q_weight"), b)
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+    c = mx.nd.zeros((4,))
+    mixed(mx.init.InitDesc("net_q_bias"), c)  # suffix dispatch -> zero
+    np.testing.assert_allclose(c.asnumpy(), 0.0)
+
+
+def test_msraprelu_variance():
+    mx.random.seed(3)
+    shape = (256, 128)
+    w = _init_array(mx.init.MSRAPrelu(factor_type="in", slope=0.0),
+                    "w_weight", shape)
+    want = np.sqrt(2.0 / shape[1])
+    assert abs(w.std() - want) / want < 0.1
+
+
+# ---------------------------------------------------------------- metrics
+def test_accuracy_multibatch_and_reset():
+    m = mx.metric.Accuracy()
+    rng = np.random.RandomState(0)
+    total, correct = 0, 0
+    for _ in range(3):
+        labels = rng.randint(0, 4, 20)
+        preds = rng.rand(20, 4).astype(np.float32)
+        m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+        correct += (preds.argmax(1) == labels).sum()
+        total += 20
+    assert abs(m.get()[1] - correct / total) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1]) or m.get()[1] == 0.0
+
+
+def test_topk_accuracy_oracle():
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 6, 50)
+    preds = rng.rand(50, 6).astype(np.float32)
+    import pytest as _pytest
+    with _pytest.raises(AssertionError):
+        mx.metric.TopKAccuracy(top_k=1)  # reference asserts top_k > 1
+    for k in (2, 3):
+        m = mx.metric.TopKAccuracy(top_k=k)
+        m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+        topk = np.argsort(-preds, axis=1)[:, :k]
+        want = np.mean([labels[i] in topk[i] for i in range(50)])
+        assert abs(m.get()[1] - want) < 1e-6, k
+
+
+def test_f1_oracle_binary():
+    rng = np.random.RandomState(2)
+    labels = rng.randint(0, 2, 40)
+    preds = rng.rand(40, 2).astype(np.float32)
+    m = mx.metric.F1()
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    yhat = preds.argmax(1)
+    tp = int(((yhat == 1) & (labels == 1)).sum())
+    fp = int(((yhat == 1) & (labels == 0)).sum())
+    fn = int(((yhat == 0) & (labels == 1)).sum())
+    prec = tp / (tp + fp) if tp + fp else 0.0
+    rec = tp / (tp + fn) if tp + fn else 0.0
+    want = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    assert abs(m.get()[1] - want) < 1e-6
+
+
+def test_perplexity_ignore_label():
+    rng = np.random.RandomState(3)
+    labels = rng.randint(0, 5, 30)
+    labels[:6] = 0  # will be ignored
+    preds = rng.rand(30, 5).astype(np.float32)
+    preds /= preds.sum(1, keepdims=True)
+    m = mx.metric.Perplexity(ignore_label=0)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    mask = labels != 0
+    picked = preds[np.arange(30), labels][mask]
+    want = float(np.exp(-np.log(picked).sum() / mask.sum()))
+    assert abs(m.get()[1] - want) / want < 1e-5
+
+
+def test_mae_mse_rmse_oracles():
+    rng = np.random.RandomState(4)
+    labels = rng.randn(3, 7).astype(np.float32)
+    preds = rng.randn(3, 7).astype(np.float32)
+    oracles = {
+        "mae": np.abs(labels - preds).mean(),
+        "mse": ((labels - preds) ** 2).mean(),
+        "rmse": np.sqrt(((labels - preds) ** 2).mean()),
+    }
+    for name, want in oracles.items():
+        m = mx.metric.create(name)
+        m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+        assert abs(m.get()[1] - want) < 1e-5, name
+
+
+def test_cross_entropy_metric_oracle():
+    rng = np.random.RandomState(5)
+    labels = rng.randint(0, 4, 25)
+    preds = rng.rand(25, 4).astype(np.float32)
+    preds /= preds.sum(1, keepdims=True)
+    m = mx.metric.create("ce")
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    want = -np.log(preds[np.arange(25), labels]).mean()
+    assert abs(m.get()[1] - want) < 1e-5
