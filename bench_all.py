@@ -292,23 +292,40 @@ def bench_flash_attention():
     v = jnp.asarray(np.random.randn(b, h, t, d), jnp.bfloat16)
 
     def dense(q, k, v):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
-        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+        # causal-masked, like the flash kernel — an unmasked dense
+        # baseline would be an apples-to-oranges comparison
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) \
+            / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, jnp.float32(-1e30))
+        p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p.astype(jnp.bfloat16), v)
 
-    jd = jax.jit(dense)
-    jf = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    def timeit(attn, n=100):
+        # n must be large: one dispatch RTT (~50-90 ms on the tunnel) is
+        # amortized across the chain, and at n=20 it still adds ~2-4 ms
+        # per iteration — comparable to the flash kernel itself
+        # N dependent iterations inside ONE program + a value-bearing
+        # D2H fetch: block_until_ready can return early on the tunneled
+        # backend and a host loop under-measures (the round-4 artifact
+        # recorded dense 4x faster than it really is)
+        @jax.jit
+        def run(q, k, v):
+            def body(carry, _):
+                return attn(carry, k, v), None
+            out, _ = jax.lax.scan(body, q, None, length=n)
+            return jnp.sum(out.astype(jnp.float32))
 
-    def timeit(fn, n=20):
-        fn(q, k, v).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn(q, k, v)
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / n
+        float(run(q, k, v))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(q, k, v))
+            best = min(best, time.perf_counter() - t0)
+        return best / n
 
-    td = timeit(jd)
-    tf = timeit(jf)
+    td = timeit(dense)
+    tf = timeit(lambda q, k, v: flash_attention(q, k, v, causal=True))
     return {"value": round(td / tf, 2), "unit": "x speedup vs dense XLA",
             "protocol": "causal attention b1 h8 T=%d d64 bf16" % t,
             "dense_ms": round(td * 1e3, 2), "flash_ms": round(tf * 1e3, 2)}

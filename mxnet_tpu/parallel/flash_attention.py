@@ -85,14 +85,16 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                     / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512, interpret=False):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
+                    block_k=1024, interpret=False):
     """Blocked attention; q/k/v: (batch, heads, T, d).
 
     block_q/block_k are upper bounds; the largest divisors of T at or
-    below them are used. The vjp falls back to XLA autodiff of the
-    reference formula (a backward Pallas kernel is a further
-    optimization).
+    below them are used. Defaults come from an on-chip sweep at T=4096
+    (v5e, round 5): 1024/1024 measures 2.49 ms vs 2.67 ms for 512/512
+    and 35.5 ms for the dense XLA formula (14x). The vjp falls back to
+    XLA autodiff of the reference formula (a backward Pallas kernel is
+    a further optimization).
     """
     import jax
     import jax.numpy as jnp
