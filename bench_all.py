@@ -331,6 +331,44 @@ def bench_flash_attention():
             "dense_ms": round(td * 1e3, 2), "flash_ms": round(tf * 1e3, 2)}
 
 
+def bench_transformer_lm():
+    """Beyond-reference config: causal-LM transformer train step (flash
+    attention, whole step one XLA program) — the long-context story's
+    single-chip anchor."""
+    import jax
+
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+
+    B, T = (2, 256) if QUICK else (8, 2048)
+    d_model, n_layers = (64, 2) if QUICK else (512, 8)
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tp = TransformerParallel(mesh, vocab=32768, d_model=d_model,
+                             n_heads=8, n_layers=n_layers,
+                             d_ff=4 * d_model, n_experts=1,
+                             dtype=np.dtype("bfloat16"))
+    params = tp.init(0)
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 32768, (B, T)).astype(np.int32)
+    tok, tgt = tp.shard_batch(tok, np.roll(tok, -1, axis=1))
+    step = tp.step_fn(lr=0.01)
+    params, loss = step(params, tok, tgt)
+    float(loss)  # compile + warm, D2H fence
+    steps = 3 if QUICK else 30
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = step(params, tok, tgt)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    n_par = sum(v.size for v in jax.tree_util.tree_leaves(params))
+    return {"value": round(B * T / dt), "unit": "tokens/sec",
+            "protocol": ("%dM-param causal LM, T=%d bs%d bf16, flash "
+                         "attention, fwd+bwd+sgd one program"
+                         % (round(n_par / 1e6), T, B)),
+            "ms_per_step": round(dt * 1e3, 2),
+            "mfu_spec": round(6 * n_par * B * T / dt / 197e12, 4)}
+
+
 BENCHES = [
     ("resnet50_train_bs32", bench_resnet50_train),
     ("resnet50_infer_bs32", bench_resnet50_infer),
@@ -339,6 +377,7 @@ BENCHES = [
     ("lstm_ptb_train", bench_lstm_ptb),
     ("ssd300_train", bench_ssd300),
     ("flash_attention_T4096", bench_flash_attention),
+    ("transformer_lm_T2048", bench_transformer_lm),
 ]
 
 
