@@ -139,6 +139,17 @@ class Parameter:
         for c, arr in table.items():  # relaxed: same device type, any id
             if c.device_type == ctx.device_type:
                 return arr
+        # contexts of different TYPES can alias the same physical device
+        # (on a CPU-only host mx.gpu(0) maps onto a cpu jax device, and
+        # eager results there report context cpu) — match by the actual
+        # jax device before declaring a miss
+        try:
+            want = ctx.jax_device()
+            for c, arr in table.items():
+                if c.jax_device() == want:
+                    return arr
+        except Exception:
+            pass
         raise RuntimeError(
             "Parameter %s was not initialized on context %s. It was only "
             "initialized on %s." % (self.name, str(ctx),
