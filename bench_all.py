@@ -9,12 +9,16 @@ Configs (BASELINE.json "configs" + VERDICT r3 item 3):
   4. LSTM PTB training step (2x200, bs32, T=35) — samples/sec
   5. SSD-300 training step (VGG-reduced)        — img/sec
   +  ResNet-50 inference bs32 (benchmark_score protocol, P100 713.17)
-  +  flash vs dense attention at T=4096         — speedup ratio
+  +  flash vs dense attention fwd at T=4096     — speedup ratio
+  +  flash vs dense attention TRAIN (fwd+bwd, Pallas recompute backward
+     vs dense autodiff) at T in {1024..8192}    — speedup + residual MB
+  +  transformer-LM train step at T=2048 and T=4096 — tokens/sec, MFU
 
 Writes BENCH_ALL.json (repo root by default) and prints it. Each entry is
 measured independently and failures are recorded, not fatal, so one slow
 compile cannot sink the artifact. Set BENCH_QUICK=1 for a fast smoke pass.
 """
+import functools
 import json
 import os
 import sys
@@ -331,16 +335,108 @@ def bench_flash_attention():
             "dense_ms": round(td * 1e3, 2), "flash_ms": round(tf * 1e3, 2)}
 
 
-def bench_transformer_lm():
+def bench_flash_attention_train():
+    """Training-mode microbench: fwd+bwd through the flash kernel (tiled
+    recompute Pallas backward, residuals O(T) per head) vs XLA autodiff
+    of the dense formula (T x T score matrix materialized in the
+    backward), causal, across sequence lengths. Also records the actual
+    vjp residual footprint of each path — the memory claim, measured."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.flash_attention import (flash_attention,
+                                                    _dense_with_lse)
+
+    b, h, d = 1, 8, 64
+    seq_lens = (512,) if QUICK else (1024, 2048, 4096, 8192)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    def dense_loss(q, k, v):
+        out, _ = _dense_with_lse(q, k, v, causal=True)
+        return jnp.sum(out.astype(jnp.float32))
+
+    def residual_bytes(loss, q, k, v):
+        # the real vjp residual set, via abstract evaluation — nothing
+        # executes, so measuring the dense path at T=8192 (10+ GB of
+        # residuals) cannot itself OOM the chip
+        vjp_fn = jax.eval_shape(
+            lambda q, k, v: jax.vjp(loss, q, k, v)[1], q, k, v)
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(vjp_fn)
+                   if hasattr(x, "dtype"))
+
+    def timeit(loss, q, k, v, n):
+        grad = jax.grad(loss, argnums=(0, 1, 2))
+
+        @jax.jit
+        def run(q, k, v):
+            # chain iterations through dq (keeps every fwd+bwd live and
+            # dependent — same one-program protocol as the fwd bench);
+            # the 1e-30 factor keeps dk/dv from being dead code
+            def body(carry, _):
+                dq, dk, dv = grad(carry, k, v)
+                return dq + 1e-30 * (dk + dv), None
+            out, _ = jax.lax.scan(body, q, None, length=n)
+            return jnp.sum(out.astype(jnp.float32))
+
+        float(run(q, k, v))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(q, k, v))
+            best = min(best, time.perf_counter() - t0)
+        return best / n
+
+    rng = np.random.RandomState(0)
+    per_t = {}
+    best = None
+    for t in seq_lens:
+        q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+        entry = {
+            "flash_residual_mb": round(
+                residual_bytes(flash_loss, q, k, v) / 2**20, 1),
+            "dense_residual_mb": round(
+                residual_bytes(dense_loss, q, k, v) / 2**20, 1),
+        }
+        per_t["T%d" % t] = entry
+        n = max(8, (204800 if not QUICK else 4096) // t)
+        try:
+            # flash first: if the DENSE side OOMs at long T (its T x T
+            # backward is exactly what this kernel exists to avoid),
+            # keep the flash timing and record the failure per-T
+            # instead of sinking the whole entry
+            tf = timeit(flash_loss, q, k, v, n)
+            entry["flash_ms"] = round(tf * 1e3, 2)
+            td = timeit(dense_loss, q, k, v, n)
+            entry["dense_ms"] = round(td * 1e3, 2)
+            entry["speedup"] = round(td / tf, 2)
+            best = (t, entry["speedup"])
+        except Exception as err:
+            entry["error"] = repr(err)
+    if best is None:
+        raise RuntimeError("no T completed: %r" % per_t)
+    return {"value": best[1],
+            "unit": "x fwd+bwd speedup vs dense autodiff (T=%d)" % best[0],
+            "protocol": "causal attention grad(q,k,v) b1 h8 d64 bf16",
+            "per_T": per_t}
+
+
+def bench_transformer_lm(B=None, T=None):
     """Beyond-reference config: causal-LM transformer train step (flash
-    attention, whole step one XLA program) — the long-context story's
-    single-chip anchor."""
+    attention fwd AND bwd as Pallas kernels, whole step one XLA program)
+    — the long-context story's single-chip anchor."""
     import jax
 
     from mxnet_tpu.parallel import make_mesh
     from mxnet_tpu.parallel.transformer import TransformerParallel
 
-    B, T = (2, 256) if QUICK else (8, 2048)
+    if B is None:
+        B, T = (2, 256) if QUICK else (8, 2048)
     d_model, n_layers = (64, 2) if QUICK else (512, 8)
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
     tp = TransformerParallel(mesh, vocab=32768, d_model=d_model,
@@ -377,7 +473,13 @@ BENCHES = [
     ("lstm_ptb_train", bench_lstm_ptb),
     ("ssd300_train", bench_ssd300),
     ("flash_attention_T4096", bench_flash_attention),
+    ("flash_attention_train", bench_flash_attention_train),
     ("transformer_lm_T2048", bench_transformer_lm),
+    # long-context training anchor: same tokens/step as T2048 but the
+    # attention working set only fits because the backward is tiled
+    ("transformer_lm_T4096",
+     functools.partial(bench_transformer_lm, B=2 if QUICK else 4,
+                       T=256 if QUICK else 4096)),
 ]
 
 

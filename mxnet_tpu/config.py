@@ -39,6 +39,30 @@ Flags currently honored:
     silent corruption). Set the env var before import, or call
     ``config.set_flag("MXNET_DEBUG_NANS", 1)`` at runtime. Combine with
     MXNET_EXEC_DISABLE_JIT=1 to localize to a single eager op.
+
+``MXNET_FLASH_ATTENTION_BWD`` (default 1)
+    Run the flash-attention backward as the tiled recompute Pallas
+    kernels (parallel/flash_attention.py): the forward saves only
+    (q, k, v, o, lse) and the backward recomputes block scores, so
+    training is O(T) in attention memory. 0 restores the pre-kernel
+    behavior — XLA autodiff of the dense formula, which materializes
+    the T x T score matrix in the backward.
+
+``MXNET_FLASH_BLOCK_Q`` / ``MXNET_FLASH_BLOCK_K`` (default 1024)
+    Upper bounds for the forward kernel's q/k block sizes (the largest
+    divisor of T at or below the bound is used). Defaults from the
+    round-5 on-chip sweep at T=4096 on v5e.
+
+``MXNET_FLASH_BWD_BLOCK_Q`` / ``MXNET_FLASH_BWD_BLOCK_K`` (default 512)
+    Same bounds for the backward kernels. The backward holds more live
+    tiles per grid step (q, k, v, do and two fp32 accumulators), so the
+    default is one notch below the forward's to stay inside VMEM.
+
+``MXNET_RING_ATTENTION_FLASH`` (default 1)
+    Per-ring-step local attention in ring_attention: 1 = use the Pallas
+    flash kernel for each K/V block when running on TPU (dense XLA
+    elsewhere), 0 = always the dense blockwise formula, 2 = force the
+    kernel on any backend (interpret mode off-TPU; for tests).
 """
 import os
 
@@ -55,6 +79,12 @@ _DEFAULTS = {
     # tied maxima; see ops/nn.py _maxpool_mask_bwd)
     "MXNET_POOLING_MASK_BWD": 0,
     "MXNET_DEBUG_NANS": 0,
+    "MXNET_FLASH_ATTENTION_BWD": 1,
+    "MXNET_FLASH_BLOCK_Q": 1024,
+    "MXNET_FLASH_BLOCK_K": 1024,
+    "MXNET_FLASH_BWD_BLOCK_Q": 512,
+    "MXNET_FLASH_BWD_BLOCK_K": 512,
+    "MXNET_RING_ATTENTION_FLASH": 1,
 }
 
 

@@ -125,6 +125,21 @@ class OpDef:
         while tensors and not isinstance(tensors[-1], tensor_type):
             trailing.append(tensors.pop())
         trailing.reverse()
+        for value in trailing:
+            # a raw numpy array (or a list/tuple holding arrays) in a
+            # param slot is almost always a forgotten mx.nd.array() wrap;
+            # binding it to a scalar param produces a baffling error deep
+            # inside attr parsing — reject it here with the real story
+            if isinstance(value, np.ndarray) and value.ndim > 0 or \
+                    isinstance(value, (list, tuple)) and any(
+                        isinstance(e, tensor_type)
+                        or (isinstance(e, np.ndarray) and e.ndim > 0)
+                        for e in value):
+                raise MXNetError(
+                    "%s: positional argument %r looks like tensor data; "
+                    "op inputs must be NDArray (wrap raw arrays with "
+                    "mx.nd.array) — only scalar/shape parameters may "
+                    "follow the input tensors" % (self.name, type(value)))
         if trailing:
             names = [k for k in self.params if k != "num_args"]
             if len(trailing) > len(names):

@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 The hot op of the long-context path: computes softmax(QK^T)V in VMEM-sized
 blocks with an online-softmax accumulator, so the T x T score matrix never
@@ -7,9 +7,23 @@ of fix PERF_NOTES.md shows this chip needs). Composes with
 :mod:`ring_attention`: the ring shards the sequence ACROSS chips while this
 kernel blocks it WITHIN a chip.
 
+Training is O(T) in memory end to end: the forward saves only
+(q, k, v, o, lse) — lse is the per-row logsumexp of the scaled scores —
+and the backward recomputes block scores on the fly in two tiled passes:
+
+- a dq pass gridded over q blocks (k blocks as the innermost,
+  sequential axis), and
+- a dk/dv pass gridded over k blocks (q blocks innermost),
+
+each accumulating in fp32 VMEM scratch and honoring the same causal
+dead-block skipping as the forward. No pass ever materializes a T x T
+tensor in HBM.
+
 Standard flash-attention recurrence (Dao et al. 2022, public algorithm);
 the kernel implementation is original. Falls back to the XLA reference
-implementation when Pallas is unavailable on the backend.
+implementation when the sequence length has no usable block divisor, and
+to XLA autodiff of the dense formula for the backward when
+``MXNET_FLASH_ATTENTION_BWD=0`` (see config.py for the knobs).
 """
 from __future__ import annotations
 
@@ -17,7 +31,16 @@ import functools
 
 import numpy as np
 
+from ..config import get_flag
+
 __all__ = ["flash_attention"]
+
+
+def _compiler_params(pltpu, **kw):
+    # renamed upstream: CompilerParams (new) vs TPUCompilerParams (0.4.x)
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
 
 
 def _pick_block(T, bound):
@@ -27,9 +50,18 @@ def _pick_block(T, bound):
     return 1
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _positions(q_idx, kv_idx, bq, bk):
+    import jax
+    import jax.numpy as jnp
+
+    q_pos = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos, k_pos
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             scale, causal, block_k, seq_len):
-    """One (batch*head, q_block, k_block) grid step."""
+    """One (batch*head, q_block, k_block) forward grid step."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -49,7 +81,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     bk = k_ref.shape[1]
     # a block is live unless it lies entirely above the causal diagonal:
     # last query position >= first key position
-    live = ((q_idx + 1) * bq - 1 >= kv_idx * bk) if causal         else (kv_idx >= 0)
+    live = ((q_idx + 1) * bq - 1 >= kv_idx * bk) if causal else (kv_idx >= 0)
 
     @pl.when(live)
     def _compute():
@@ -59,10 +91,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_idx * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_pos = kv_idx * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
+            q_pos, k_pos = _positions(q_idx, kv_idx, bq, bk)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         m_prev = m_ref[...]                       # (bq, 1)
         block_max = jnp.max(s, axis=1, keepdims=True)
@@ -83,18 +112,142 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finish():
         o_ref[0] = (acc_ref[...]
                     / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        # the O(T) softmax residual: lse = m + log(l). -inf rows (fully
+        # masked — only reachable through ring blocks above the causal
+        # diagonal) stay -inf: -inf + log(eps) = -inf
+        lse_ref[0] = (m_ref[...]
+                      + jnp.log(jnp.maximum(l_ref[...], 1e-30)))[:, 0]
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
-                    block_k=1024, interpret=False):
+def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+               scale, causal, q_idx, kv_idx):
+    """Recompute one (q_block, k_block) tile of p and ds from residuals.
+
+    Shared by both backward passes: p = exp(s - lse) is the EXACT softmax
+    (no renormalization needed — lse is the forward's true row
+    logsumexp), ds = p * (do.v^T - delta) with delta = rowsum(do * o)
+    (+ any lse cotangent, folded into delta by the caller).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    qs = q_ref[0].astype(jnp.float32) * scale           # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                    # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                           # (bq, 1)
+    delta = delta_ref[0][:, None]
+    s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos, k_pos = _positions(q_idx, kv_idx, bq, bk)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    # fully-masked rows have lse = -inf; exp(s - 0) would explode, so
+    # zero them explicitly (s is -inf there too, but -inf - -inf is nan)
+    lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    p = jnp.exp(s - lse_safe)
+    p = jnp.where(jnp.isneginf(s) | jnp.isneginf(lse), 0.0, p)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    return qs, k, do, p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, scale, causal, block_k, seq_len):
+    """dq pass: grid (batch*head, q_block, k_block); k is the sequential
+    axis, dq accumulates in fp32 scratch across it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    live = ((q_idx + 1) * bq - 1 >= kv_idx * bk) if causal else (kv_idx >= 0)
+
+    @pl.when(live)
+    def _compute():
+        _, k, _, _, ds = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, causal=causal, q_idx=q_idx, kv_idx=kv_idx)
+        # ds/dq_i = scale * sum_j ds_ij k_j
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kv_idx == (seq_len // block_k) - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, block_q, seq_len):
+    """dk/dv pass: grid (batch*head, k_block, q_block); q is the
+    sequential axis, dk and dv accumulate in fp32 scratch across it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(2)
+    kv_idx = pl.program_id(1)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+    live = ((q_idx + 1) * bq - 1 >= kv_idx * bk) if causal else (q_idx >= 0)
+
+    @pl.when(live)
+    def _compute():
+        qs, _, do, p, ds = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, causal=causal, q_idx=q_idx, kv_idx=kv_idx)
+        # dv_j = sum_i p_ij do_i ; dk_j = sum_i ds_ij (scale q_i) — qs is
+        # already scaled, so no extra factor here
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == (seq_len // block_q) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, block_q_bwd=None, block_k_bwd=None,
+                    interpret=False, return_lse=False):
     """Blocked attention; q/k/v: (batch, heads, T, d).
 
-    block_q/block_k are upper bounds; the largest divisors of T at or
-    below them are used. Defaults come from an on-chip sweep at T=4096
-    (v5e, round 5): 1024/1024 measures 2.49 ms vs 2.67 ms for 512/512
-    and 35.5 ms for the dense XLA formula (14x). The vjp falls back to
-    XLA autodiff of the reference formula (a backward Pallas kernel is
-    a further optimization).
+    Block arguments are upper bounds; the largest divisors of T at or
+    below them are used. Unset bounds come from config.py
+    (MXNET_FLASH_BLOCK_Q/K for the forward, MXNET_FLASH_BWD_BLOCK_Q/K for
+    the backward; forward defaults from an on-chip sweep at T=4096, v5e,
+    round 5: 1024/1024 measures 2.49 ms vs 2.67 ms for 512/512 and
+    35.5 ms for the dense XLA formula). Differentiable: the vjp runs the
+    tiled recompute backward kernels above (dense XLA autodiff of the
+    reference formula when MXNET_FLASH_ATTENTION_BWD=0).
+
+    With ``return_lse`` the per-row logsumexp of the scaled scores is
+    returned alongside the output, shape (batch, heads, T) fp32 — the
+    streaming-combine hook :mod:`ring_attention` uses to merge per-ring-
+    step partial results (gradients flow through both outputs).
     """
     import jax
     import jax.numpy as jnp
@@ -103,6 +256,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
 
     B, H, T, D = q.shape
     scale = float(scale) if scale is not None else float(1.0 / np.sqrt(D))
+    block_q = int(block_q or get_flag("MXNET_FLASH_BLOCK_Q"))
+    block_k = int(block_k or get_flag("MXNET_FLASH_BLOCK_K"))
+    block_q_bwd = int(block_q_bwd or get_flag("MXNET_FLASH_BWD_BLOCK_Q"))
+    block_k_bwd = int(block_k_bwd or get_flag("MXNET_FLASH_BWD_BLOCK_K"))
     # block sizes are upper bounds: the largest divisor of T at or below
     # the bound is used. When T has no reasonable divisor (prime-ish), a
     # "block" would balloon toward T and defeat the kernel — fall back to
@@ -113,28 +270,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
     if block_q * 8 < bq_req or block_k * 8 < bk_req:
         # prime-ish T: only tiny divisors exist; tiny blocks waste the
         # MXU and the grid explodes — the XLA formula is faster
-        from .ring_attention import attention_reference
-
-        return attention_reference(q, k, v, causal=causal, scale=scale)
-    @jax.custom_vjp
-    def _flash(q, k, v):
-        return _flash_fwd_impl(q, k, v)
-
-    def _fwd(q, k, v):
-        return _flash_fwd_impl(q, k, v), (q, k, v)
-
-    def _bwd(res, g):
-        # backward via XLA autodiff of the dense formula (the forward's
-        # memory win stands; a backward Pallas kernel is future work)
-        from .ring_attention import attention_reference
-
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q, k, v: attention_reference(q, k, v, causal=causal,
-                                                scale=scale), q, k, v)
-        return vjp(g)
-
-    _flash.defvjp(_fwd, _bwd)
+        out, lse = _dense_with_lse(q, k, v, causal=causal, scale=scale)
+        return (out, lse) if return_lse else out
+    block_q_bwd = _pick_block(T, min(block_q_bwd, T))
+    block_k_bwd = _pick_block(T, min(block_k_bwd, T))
 
     def _flash_fwd_impl(q, k, v):
         qf = q.reshape(B * H, T, D)
@@ -143,7 +282,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
         grid = (B * H, T // block_q, T // block_k)
         kernel = functools.partial(_kernel, scale=scale, causal=causal,
                                    block_k=block_k, seq_len=T)
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
@@ -157,19 +296,122 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
                 pl.BlockSpec((1, block_k, D),
                              lambda b, i, j: (b, j, i * 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda b, i, j: (b, i, j * 0)),
-            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, D),
+                             lambda b, i, j: (b, i, j * 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+                jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+            ],
             scratch_shapes=[
                 pltpu.VMEM((block_q, D), jnp.float32),
                 pltpu.VMEM((block_q, 1), jnp.float32),
                 pltpu.VMEM((block_q, 1), jnp.float32),
             ],
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel",
-                                     "arbitrary")),
+            compiler_params=_compiler_params(
+                pltpu, dimension_semantics=("parallel", "parallel",
+                                            "arbitrary")),
         )(qf, kf, vf)
-        return out.reshape(B, H, T, D)
+        return out.reshape(B, H, T, D), lse.reshape(B, H, T)
 
-    return _flash(q, k, v)
+    def _flash_bwd_impl(q, k, v, o, lse, do, dlse):
+        bq, bk = block_q_bwd, block_k_bwd
+        qf, kf, vf, dof = (a.reshape(B * H, T, D) for a in (q, k, v, do))
+        lsef = lse.reshape(B * H, T)
+        # delta_i = rowsum(do_i * o_i); an lse cotangent adds
+        # glse_i * p_ij to ds_ij, which folds in as delta - glse
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1).reshape(B * H, T)
+        if dlse is not None:
+            delta = delta - dlse.astype(jnp.float32).reshape(B * H, T)
+        # dq pass grid is (b, q_idx, kv_idx): q/do/rows follow dim 1,
+        # k/v follow dim 2
+        q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, j * 0))
+        k_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, i * 0))
+        row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                              block_k=bk, seq_len=T),
+            grid=(B * H, T // bq, T // bk),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            interpret=interpret,
+            compiler_params=_compiler_params(
+                pltpu, dimension_semantics=("parallel", "parallel",
+                                            "arbitrary")),
+        )(qf, kf, vf, dof, lsef, delta)
+        # dk/dv pass: grid dim 1 walks k blocks, dim 2 scans q blocks
+        q_spec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, j * 0))
+        k_spec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, i * 0))
+        row_spec2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                              block_q=bq, seq_len=T),
+            grid=(B * H, T // bk, T // bq),
+            in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2,
+                      row_spec2],
+            out_specs=[k_spec2, k_spec2],
+            out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                       jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+            interpret=interpret,
+            compiler_params=_compiler_params(
+                pltpu, dimension_semantics=("parallel", "parallel",
+                                            "arbitrary")),
+        )(qf, kf, vf, dof, lsef, delta)
+        return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+                dv.reshape(B, H, T, D))
+
+    @jax.custom_vjp
+    def _flash(q, k, v):
+        return _flash_fwd_impl(q, k, v)
+
+    def _fwd(q, k, v):
+        out, lse = _flash_fwd_impl(q, k, v)
+        # O(T)-per-head residuals — no T x T tensor survives the forward
+        return (out, lse), (q, k, v, out, lse)
+
+    def _bwd(res, g):
+        q, k, v, out, lse = res
+        do, dlse = g
+        if not get_flag("MXNET_FLASH_ATTENTION_BWD"):
+            # escape hatch: XLA autodiff of the dense formula (the
+            # forward's memory win stands; backward materializes T x T)
+            _, vjp = jax.vjp(
+                lambda q, k, v: _dense_with_lse(q, k, v, causal=causal,
+                                                scale=scale), q, k, v)
+            return vjp((do, dlse))
+        return _flash_bwd_impl(q, k, v, out, lse, do, dlse)
+
+    _flash.defvjp(_fwd, _bwd)
+
+    out, lse = _flash(q, k, v)
+    return (out, lse) if return_lse else out
+
+
+def _dense_with_lse(q, k, v, causal=False, scale=None):
+    """XLA reference returning (out, lse) — the fallback for prime-ish T
+    and the MXNET_FLASH_ATTENTION_BWD=0 escape hatch."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision="highest").astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    lse = jax.nn.logsumexp(scores, axis=-1)
+    w = jnp.exp(scores - jnp.where(jnp.isneginf(lse), 0.0, lse)[..., None])
+    w = jnp.where(jnp.isneginf(scores), 0.0, w)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(q.dtype), v,
+                     precision="highest")
+    return out, lse

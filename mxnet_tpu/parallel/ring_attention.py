@@ -40,13 +40,71 @@ def attention_reference(q, k, v, causal=False, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", w, v, precision="highest")
 
 
+def _merge_partials(o1, lse1, o2, lse2):
+    """Combine two normalized partial attention results via their row
+    logsumexps (associative — the streaming-softmax merge)."""
+    import jax.numpy as jnp
+
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - m_safe))
+    l = w1 + w2
+    o = ((o1.astype(jnp.float32) * w1[..., None]
+          + o2.astype(jnp.float32) * w2[..., None])
+         / jnp.maximum(l, 1e-30)[..., None])
+    return o, m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _ring_attention_local_flash(q, k, v, axis_name, causal, scale,
+                                interpret=False):
+    """Ring body with the per-step block attention run as the Pallas
+    flash kernel (parallel/flash_attention.py — forward AND backward are
+    tiled kernels, so the sharded path inherits the O(T) training
+    memory). The ring is unrolled (n is static): step 0 is the local
+    diagonal block (causal within the shard); later steps are full
+    blocks whose contribution is discarded via lse = -inf when the
+    source shard is in the causal future. Gradients ride each kernel's
+    custom_vjp plus the differentiable logsumexp merge."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .flash_attention import flash_attention
+
+    n = lax.psum(1, axis_name)  # static (mesh shape is static)
+    my_idx = lax.axis_index(axis_name)
+    o_acc, lse_acc = flash_attention(q, k, v, causal=causal, scale=scale,
+                                     interpret=interpret, return_lse=True)
+    o_acc = o_acc.astype(jnp.float32)
+    k_cur, v_cur = k, v
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for i in range(1, n):
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        o_b, lse_b = flash_attention(q, k_cur, v_cur, causal=False,
+                                     scale=scale, interpret=interpret,
+                                     return_lse=True)
+        if causal:
+            # src strictly before us: fully visible; after us: fully
+            # masked (lse = -inf zeroes it out of the merge)
+            src = (my_idx - i) % n
+            lse_b = jnp.where(src < my_idx, lse_b, -jnp.inf)
+        o_acc, lse_acc = _merge_partials(o_acc, lse_acc, o_b, lse_b)
+    return o_acc.astype(q.dtype)
+
+
 def _ring_attention_local(q, k, v, axis_name, causal, scale,
-                          vary_axes=None):
+                          vary_axes=None, use_flash=False,
+                          interpret=False):
     """shard_map body: q/k/v are the LOCAL sequence shards
     (batch, heads, T_local, d); returns the local output shard."""
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    if use_flash:
+        return _ring_attention_local_flash(q, k, v, axis_name, causal,
+                                           scale, interpret=interpret)
 
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -111,13 +169,23 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale,
 
 
 def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
-                   head_axis=None, batch_axis=None):
+                   head_axis=None, batch_axis=None, use_flash=None,
+                   interpret=False):
     """Sequence-parallel attention over ``mesh`` axis ``axis``.
 
     q/k/v are GLOBAL (batch, heads, T, head_dim) arrays (or already
     sharded on the sequence dim); T must divide by the axis size. Returns
     the global attention output with the same sharding. Differentiable —
-    the vjp rides the same ring in reverse (autodiff of scan+ppermute).
+    the vjp rides the same ring in reverse (autodiff of scan+ppermute,
+    or the flash kernels' custom vjp on the flash path).
+
+    ``use_flash`` selects the per-ring-step local attention: the Pallas
+    flash kernel (forward and backward both tiled — the within-chip
+    blocking composes with the across-chip ring) or the dense blockwise
+    XLA formula. Default (None) follows config.py's
+    MXNET_RING_ATTENTION_FLASH: the kernel on TPU backends, dense
+    elsewhere. ``interpret`` runs the kernel in the Pallas interpreter
+    (tests on CPU).
     """
     import jax
     try:
@@ -125,6 +193,17 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
     except ImportError:
         from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..config import get_flag
+
+    if use_flash is None:
+        flag = get_flag("MXNET_RING_ATTENTION_FLASH")
+        use_flash = flag == 2 or (
+            flag == 1 and jax.default_backend() == "tpu")
+        if flag == 2 and jax.default_backend() != "tpu":
+            # documented contract: 2 forces the kernel on any backend —
+            # off-TPU that means the Pallas interpreter
+            interpret = True
 
     d = q.shape[-1]
     # python float stays weakly typed (a np.float64 scalar would promote
@@ -137,6 +216,10 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
     vary = tuple(a for a in (batch_axis, head_axis, axis) if a is not None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
-                          causal=causal, scale=scale, vary_axes=vary),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, scale=scale, vary_axes=vary,
+                          use_flash=use_flash, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call has no shard_map replication rule; the flash body
+        # is per-device SPMD anyway, so skip the rep check there
+        check_rep=not use_flash)
     return fn(q, k, v)

@@ -116,8 +116,7 @@ class TransformerParallel:
                     head_axis="tp" if "tp" in self.axes else None,
                     batch_axis="dp" if "dp" in self.axes else None)
             else:
-                att = _local_attention(q, k, v,
-                                       self.mesh.devices.size)
+                att = _local_attention(q, k, v, self.mesh)
             att = att.transpose(0, 2, 1, 3).reshape(B, T, d)
             x = x + att @ params[p + "wo"]
             # --- MoE FFN: soft top-2-ish gate over ep-sharded experts ---
@@ -210,19 +209,42 @@ class TransformerParallel:
                     for k in shardings}
 
 
-def _local_attention(q, k, v, mesh_size=1):
-    """Single-device attention: the Pallas flash kernel on TPU (no T x T
-    HBM materialization), XLA reference elsewhere. pallas_call has no
-    GSPMD partitioning rule, so the kernel only engages on a trivial
-    (single-device) mesh; sharded meshes keep the XLA formula, which
-    GSPMD partitions correctly."""
+def _local_attention(q, k, v, mesh=None):
+    """Non-sequence-sharded attention: the Pallas flash kernel on TPU
+    (forward AND backward tiled — no T x T HBM materialization in
+    training either), XLA reference elsewhere.
+
+    pallas_call has no GSPMD partitioning rule, so on a dp/tp-sharded
+    mesh the kernel runs under shard_map: attention is embarrassingly
+    parallel over batch (dp) and heads (tp), each device invoking the
+    kernel on its local shard. Meshes with other sharded axes (or
+    non-divisible batch/head counts) keep the XLA formula, which GSPMD
+    partitions correctly."""
     import jax
 
-    if jax.default_backend() == "tpu" and mesh_size == 1 \
-            and q.shape[2] >= 128:
+    B, H, T, _ = q.shape
+    if jax.default_backend() == "tpu" and T >= 128:
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        if mesh is None or mesh.devices.size == 1:
+            return flash_attention(q, k, v, causal=True)
+        axes = dict(mesh.shape)
+        ndp, ntp = axes.get("dp", 1), axes.get("tp", 1)
+        sharded = {a for a, s in axes.items() if s > 1}
+        if sharded <= {"dp", "tp"} and B % ndp == 0 and H % ntp == 0:
+            try:
+                from jax import shard_map
+            except ImportError:
+                from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            spec = P("dp" if ndp > 1 else None,
+                     "tp" if ntp > 1 else None, None, None)
+            fn = shard_map(
+                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_rep=False)
+            return fn(q, k, v)
     from .ring_attention import attention_reference
 
     return attention_reference(q, k, v, causal=True)

@@ -241,7 +241,11 @@ class PallasKernel(object):
         # grid/index lowering wants i32 indices, so kernels trace with
         # x64 scoped off (kernel dtypes come from the signature and are
         # unaffected)
-        with jax.enable_x64(False):
+        # jax.enable_x64 moved out of jax.experimental after 0.4.x
+        scoped_x64 = getattr(jax, "enable_x64", None)
+        if scoped_x64 is None:
+            from jax.experimental import enable_x64 as scoped_x64
+        with scoped_x64(False):
             outs = call(*in_arrays, *seed_arrays)
         if len(out_shapes) == 1:
             outs = (outs,)

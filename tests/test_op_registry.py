@@ -1,0 +1,94 @@
+"""Operator-registry contract tests.
+
+Two things the generated ``mx.nd.*`` surface promises (ops/registry.py
+``bind_positional_params``):
+
+- trailing positional args bind to declared params in *registration
+  order*, so registration order must match the reference signatures
+  (python/mxnet/ndarray/register.py generates positional signatures from
+  the same order) — a silent swap here produces wrong results, not
+  errors;
+- raw tensor data (np.ndarray, or a list of arrays) in a param slot is
+  rejected with a clear "inputs must be NDArray" message instead of a
+  baffling failure deep in attr parsing.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops.registry import get_op
+
+# reference positional signatures (python/mxnet docs, 1.0.0):
+#   slice_axis(data, axis, begin, end)
+#   repeat(data, repeats, axis=None)
+#   topk(data, axis=-1, k=1, ret_typ='indices', is_ascend=0)
+#   one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype='float32')
+#   clip(data, a_min, a_max)
+REFERENCE_PARAM_ORDER = {
+    "slice_axis": ["axis", "begin", "end"],
+    "repeat": ["repeats", "axis"],
+    "topk": ["axis", "k", "ret_typ", "is_ascend"],
+    "one_hot": ["depth", "on_value", "off_value", "dtype"],
+    "clip": ["a_min", "a_max"],
+}
+
+
+@pytest.mark.parametrize("name", sorted(REFERENCE_PARAM_ORDER))
+def test_param_registration_order(name):
+    op = get_op(name)
+    declared = [k for k in op.params if k != "num_args"]
+    assert declared == REFERENCE_PARAM_ORDER[name], (
+        "%s: positional binding order diverges from the reference "
+        "signature" % name)
+
+
+def test_positional_binding_matches_reference():
+    """End-to-end: positional calls compute what the reference computes."""
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_array_equal(
+        mx.nd.slice_axis(x, 1, 0, 2).asnumpy(), x.asnumpy()[:, 0:2, :])
+    np.testing.assert_array_equal(
+        mx.nd.repeat(x, 2, 1).asnumpy(), np.repeat(x.asnumpy(), 2, axis=1))
+    np.testing.assert_array_equal(
+        mx.nd.clip(x, 3.0, 11.0).asnumpy(),
+        np.clip(x.asnumpy(), 3.0, 11.0))
+    idx = mx.nd.array(np.array([0, 2], np.float32))
+    np.testing.assert_array_equal(
+        mx.nd.one_hot(idx, 3).asnumpy(),
+        np.eye(3, dtype=np.float32)[[0, 2]])
+    v = mx.nd.array(np.array([[3.0, 1.0, 2.0]], np.float32))
+    np.testing.assert_array_equal(
+        mx.nd.topk(v, 1, 2, "value").asnumpy(),
+        np.array([[3.0, 2.0]], np.float32))
+
+
+@pytest.mark.parametrize("bad", [
+    np.arange(5, dtype=np.float32),                       # raw ndarray
+    [np.zeros(3, np.float32), np.ones(3, np.float32)],    # list of arrays
+])
+def test_tensor_like_param_rejected(bad):
+    x = mx.nd.array(np.arange(5, dtype=np.float32))
+    with pytest.raises(MXNetError, match="must be NDArray"):
+        mx.nd.clip(x, bad, 1.0)
+
+
+def test_list_of_ndarray_param_rejected():
+    x = mx.nd.array(np.arange(5, dtype=np.float32))
+    with pytest.raises(MXNetError, match="must be NDArray"):
+        mx.nd.clip(x, [mx.nd.array(np.zeros(3, np.float32))], 1.0)
+
+
+def test_scalar_and_tuple_params_still_bind():
+    """The rejection must not catch legitimate scalar/shape params."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    out = mx.nd.reshape(x, (2, 3))          # tuple param
+    assert out.shape == (2, 3)
+    out = mx.nd.clip(x, 1.0, np.float32(4.0))  # np scalar is fine
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.clip(np.arange(6, dtype=np.float32), 1, 4))
+    # 0-d numpy arrays are scalars — bare or inside a shape tuple
+    out = mx.nd.clip(x, np.array(1.0, np.float32), 4.0)
+    assert float(out.asnumpy().min()) == 1.0
+    out = mx.nd.reshape(x, (np.array(2), np.array(3)))
+    assert out.shape == (2, 3)
