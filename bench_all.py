@@ -483,9 +483,49 @@ BENCHES = [
 ]
 
 
-def main(out_path=None, skip=(), quiet=False):
+def _start_telemetry():
+    """--telemetry: metrics registry on + profiler session over the whole
+    bench run. Measurement mode, NOT headline-number mode: the eager
+    dispatcher fences per op under telemetry, so eager sub-measurements
+    slow down; compiled-step numbers are unaffected (one fence per
+    program run, which the benches do anyway)."""
+    from mxnet_tpu import observability, profiler
+
+    observability.set_enabled(True)
+    observability.reset_metrics()
+    profiler.set_config(mode="all", filename=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_TRACE.json"))
+    profiler.set_state("run")
+
+
+def _collect_telemetry(results):
+    """Attach dump_metrics() + the trace_report top-K table to the bench
+    artifact (the per-op time budget riding along with the numbers)."""
+    from mxnet_tpu import observability, profiler
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import trace_report
+
+    trace_path = profiler.dump_profile()
+    top = trace_report.report(trace_path, k=15)
+    print(trace_report.format_table(
+        top, "top 15 by total time — %s" % trace_path), file=sys.stderr)
+    results["telemetry"] = {
+        "trace": trace_path,
+        "top_ops": top,
+        "metrics": observability.dump_metrics(),
+        "note": ("telemetry mode fences eager dispatches per op; eager "
+                 "sub-measurements are attribution numbers, not "
+                 "throughput claims"),
+    }
+
+
+def main(out_path=None, skip=(), quiet=False, telemetry=False):
     import jax
 
+    if telemetry:
+        _start_telemetry()
     results = {"device": jax.devices()[0].device_kind,
                "quick": QUICK, "configs": {}}
     for name, fn in BENCHES:
@@ -500,6 +540,12 @@ def main(out_path=None, skip=(), quiet=False):
         except Exception as err:  # record, don't abort the artifact
             traceback.print_exc()
             results["configs"][name] = {"error": repr(err)}
+    if telemetry:
+        try:
+            _collect_telemetry(results)
+        except Exception as err:
+            traceback.print_exc()
+            results["telemetry"] = {"error": repr(err)}
     out_path = out_path or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json")
     with open(out_path, "w") as sink:
@@ -509,4 +555,4 @@ def main(out_path=None, skip=(), quiet=False):
 
 
 if __name__ == "__main__":
-    main()
+    main(telemetry="--telemetry" in sys.argv[1:])

@@ -158,6 +158,13 @@ def _collect_graph(head_arrays):
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # pylint: disable=redefined-outer-name
     """Run backward from heads, accumulating into marked variables' ``.grad``
     (reference: autograd.py:243 → Imperative::Backward imperative.cc:361)."""
+    from .observability.tracing import trace_span
+
+    with trace_span("autograd.backward", "autograd"):
+        return _backward_impl(heads, head_grads, retain_graph, train_mode)
+
+
+def _backward_impl(heads, head_grads, retain_graph, train_mode):
     import jax.numpy as jnp
 
     from .ndarray.ndarray import NDArray
@@ -278,6 +285,21 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # py
             arr.grad._set_data(arr.grad._data + g.astype(arr.grad._data.dtype))
         else:  # write
             arr.grad._set_data(g.astype(arr.grad._data.dtype))
+
+    from .observability import metrics as _metrics
+
+    if _metrics.enabled():
+        # fence the written grads so the enclosing autograd.backward span
+        # means "tape replay + device compute", matching the measured-
+        # split protocol of the eager dispatcher (measurement mode)
+        pending = [leaf_grads["_arr%d" % lid].grad._data
+                   for lid in leaf_grads
+                   if not isinstance(lid, str)
+                   and leaf_grads["_arr%d" % lid].grad is not None]
+        if pending:
+            jax.block_until_ready(pending)
+        _metrics.counter("tape.backward").inc()
+        _metrics.counter("tape.nodes").inc(len(topo))
 
     if not retain_graph:
         for node in topo:

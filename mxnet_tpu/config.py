@@ -63,6 +63,33 @@ Flags currently honored:
     flash kernel for each K/V block when running on TPU (dense XLA
     elsewhere), 0 = always the dense blockwise formula, 2 = force the
     kernel on any backend (interpret mode off-TPU; for tests).
+
+``MXNET_TELEMETRY`` (default 0)
+    Master switch for the observability/ metrics registry. 0 = no-op
+    instruments (< 1 µs per call, regression-tested); 1 = counters,
+    gauges and histograms record, the eager dispatcher measures its
+    host-dispatch vs device-compute split (it fences per op — a
+    measurement mode, not a fast path), the executor records per-program
+    run latency, and jax.monitoring compile hooks are installed.
+
+``MXNET_TELEMETRY_MEMSTATS`` (default 1)
+    Under telemetry, sample ``device.memory_stats()`` into the
+    ``hbm.live_bytes`` / ``hbm.peak_bytes`` gauges once per training
+    step (host RSS fallback on backends without allocator stats). 0
+    skips the sampling (it is one PJRT call per step).
+
+``MXNET_TELEMETRY_RETRACE`` (default 0)
+    Also flip jax's ``explain_cache_misses`` and keep the most recent
+    retrace-cause explanations for ``dump_metrics()``. Off by default:
+    it makes jax log a WARNING per tracing cache miss.
+
+``MXNET_PROFILER_MODE`` (default ``symbolic``)
+    Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
+    trace can be captured from an unmodified script via env alone;
+    ``profiler.set_config(mode=...)`` still overrides at runtime.
+    String-valued and read by profiler.py straight from the
+    environment — env-only, NOT routed through the integer-coercing
+    ``get_flag``/``set_flag`` machinery below.
 """
 import os
 
@@ -85,6 +112,9 @@ _DEFAULTS = {
     "MXNET_FLASH_BWD_BLOCK_Q": 512,
     "MXNET_FLASH_BWD_BLOCK_K": 512,
     "MXNET_RING_ATTENTION_FLASH": 1,
+    "MXNET_TELEMETRY": 0,
+    "MXNET_TELEMETRY_MEMSTATS": 1,
+    "MXNET_TELEMETRY_RETRACE": 0,
 }
 
 
@@ -94,7 +124,20 @@ def _apply_debug_nans(value):
     jax.config.update("jax_debug_nans", bool(value))
 
 
-_APPLIERS = {"MXNET_DEBUG_NANS": _apply_debug_nans}
+def _apply_telemetry(value):
+    # keep the registry's cached switch in sync with the flag (and
+    # install the jax.monitoring hooks on first enable)
+    from .observability import metrics as _metrics
+
+    _metrics._enabled = bool(value)
+    if value:
+        from .observability import instruments as _instruments
+
+        _instruments.install_jax_hooks()
+
+
+_APPLIERS = {"MXNET_DEBUG_NANS": _apply_debug_nans,
+             "MXNET_TELEMETRY": _apply_telemetry}
 
 
 def get_flag(name, default=None):

@@ -317,9 +317,11 @@ class Executor:
                                                 rngs)
 
         from . import profiler as _profiler
+        from .observability import metrics as _metrics
 
         profiled = _profiler.symbolic_active()
-        t0 = _profiler._now_us() if profiled else 0
+        telemetry = _metrics.enabled()
+        t0 = _profiler._now_us() if (profiled or telemetry) else 0
 
         if not is_train:
             outs = self._prog.infer_fn()(arg_d, aux_d, rngs)
@@ -339,15 +341,19 @@ class Executor:
             for n, nv in aux_upd.items():
                 self.aux_dict[n]._set_data(nv)
             self._stashed_grads = grads
-        if profiled:
+        if profiled or telemetry:
             # one event per compiled-program run — the engine-op analog
             # (a whole graph is ONE engine push here, SURVEY.md §7.1)
             import jax
 
             jax.block_until_ready(outs)
-            _profiler.record(
-                "forward_backward" if is_train else "forward",
-                "executor", t0, _profiler._now_us() - t0)
+            dur_us = _profiler._now_us() - t0
+            name = "forward_backward" if is_train else "forward"
+            if profiled:
+                _profiler.record(name, "executor", t0, dur_us)
+            if telemetry:
+                _metrics.counter("dispatch.graph").inc()
+                _metrics.histogram("executor.run_ms").observe(dur_us / 1e3)
         self.outputs = [_from_data(o) for o in outs]
         return self.outputs
 

@@ -332,25 +332,43 @@ class HybridBlock(Block):
             jit_cache[is_train] = _maybe_jit(raw)
         compiled = jit_cache[is_train]
 
-        all_arrays = arrays + aux_arrays
-        if autograd.is_recording():
-            # one TapeNode for the whole block — the _CachedOp-records-as-one-
-            # node behavior (cached_op.cc:401); forward AND vjp run compiled
-            def f(*xs):
-                return compiled(xs[:n_args], xs[n_args:], rngs)
+        from .. import profiler as _profiler
+        from ..observability import metrics as _metrics
+        from ..observability.tracing import trace_span
 
-            raw_outs, new_aux, node = _record(f, all_arrays, self.name)
-            outs = []
-            for i, o in enumerate(raw_outs):
-                arr = _from_data(o)
-                arr._autograd_node = node
-                arr._autograd_index = i
-                outs.append(arr)
-        else:
-            raw_outs, new_aux = compiled(
-                tuple(a._data for a in arrays),
-                tuple(a._data for a in aux_arrays), rngs)
-            outs = [_from_data(o) for o in raw_outs]
+        telemetry = _metrics.enabled()
+        all_arrays = arrays + aux_arrays
+        with trace_span("cached_op", "gluon"):
+            t0 = _profiler._now_us() if telemetry else 0
+            if autograd.is_recording():
+                # one TapeNode for the whole block — the _CachedOp-records-
+                # as-one-node behavior (cached_op.cc:401); forward AND vjp
+                # run compiled
+                def f(*xs):
+                    return compiled(xs[:n_args], xs[n_args:], rngs)
+
+                raw_outs, new_aux, node = _record(f, all_arrays, self.name)
+                outs = []
+                for i, o in enumerate(raw_outs):
+                    arr = _from_data(o)
+                    arr._autograd_node = node
+                    arr._autograd_index = i
+                    outs.append(arr)
+            else:
+                raw_outs, new_aux = compiled(
+                    tuple(a._data for a in arrays),
+                    tuple(a._data for a in aux_arrays), rngs)
+                outs = [_from_data(o) for o in raw_outs]
+            if telemetry:
+                # same measured-split protocol as the eager dispatcher
+                # (ndarray/register.py invoke): host cost to the call
+                # return, then a fence for the device-compute remainder
+                t1 = _profiler._now_us()
+                jax.block_until_ready(raw_outs)
+                t2 = _profiler._now_us()
+                _metrics.counter("dispatch.cached_op").inc()
+                _metrics.histogram("cached_op.host_us").observe(t1 - t0)
+                _metrics.histogram("cached_op.device_us").observe(t2 - t1)
         if is_train:
             for p, v in zip(aux_params, new_aux):
                 for arr in p._data.values():

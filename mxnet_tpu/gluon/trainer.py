@@ -134,31 +134,46 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Push grads, then pull updated weights (kvstore) or run the
         local updaters. ``batch_size`` normalizes the gradient scale."""
-        if not self._kv_initialized:
-            self._init_kvstore()
+        import time
 
-        rescale = self._scale / batch_size
-        if self._optimizer.rescale_grad != rescale:
-            self._optimizer.rescale_grad = rescale
-            if self._server_side_optimizer():
-                self._reship_optimizer()
+        from ..observability import record_step, trace_span
 
-        if self._kvstore is None and self._can_fuse():
-            self._fused_local_step()
-            return
+        started = time.perf_counter()
+        with trace_span("trainer.step", "gluon"):
+            if not self._kv_initialized:
+                self._init_kvstore()
 
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            if self._kvstore:
-                self._kvstore.push(p.name, p.list_grad(), priority=-i)
-                if self._update_on_kvstore:
-                    self._kvstore.pull(p.name, p.list_data(), priority=-i)
-                    continue
-                self._kvstore.pull(p.name, p.list_grad(), priority=-i)
-            for updater, weight, grad in zip(self._updaters, p.list_data(),
-                                             p.list_grad()):
-                updater(i, grad, weight)
+            rescale = self._scale / batch_size
+            if self._optimizer.rescale_grad != rescale:
+                self._optimizer.rescale_grad = rescale
+                if self._server_side_optimizer():
+                    self._reship_optimizer()
+
+            if self._kvstore is None and self._can_fuse():
+                with trace_span("fused_update", "gluon"):
+                    self._fused_local_step()
+                record_step(time.perf_counter() - started,
+                            self._contexts[0] if self._contexts else None)
+                return
+
+            with trace_span("optimizer_update", "gluon"):
+                for i, p in enumerate(self._params):
+                    if p.grad_req == "null":
+                        continue
+                    if self._kvstore:
+                        self._kvstore.push(p.name, p.list_grad(),
+                                           priority=-i)
+                        if self._update_on_kvstore:
+                            self._kvstore.pull(p.name, p.list_data(),
+                                               priority=-i)
+                            continue
+                        self._kvstore.pull(p.name, p.list_grad(),
+                                           priority=-i)
+                    for updater, weight, grad in zip(
+                            self._updaters, p.list_data(), p.list_grad()):
+                        updater(i, grad, weight)
+        record_step(time.perf_counter() - started,
+                    self._contexts[0] if self._contexts else None)
 
     # ------------------------------------------------------ fused updates
     # Optimizers whose only per-step HOST-computed scalar is the resolved

@@ -196,6 +196,13 @@ class KVStore:
                                 ctx=merged.context)
 
     def push(self, key, value, priority=0):
+        from .observability import counter, trace_span
+
+        with trace_span("kvstore.push", "kvstore"):
+            self._push_impl(key, value, priority)
+        counter("kvstore.push").inc()
+
+    def _push_impl(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._data:
@@ -245,7 +252,14 @@ class KVStore:
                 self._data[k] = merged
 
     def pull(self, key, out=None, priority=0):
+        from .observability import counter, trace_span
+
         assert out is not None
+        with trace_span("kvstore.pull", "kvstore"):
+            self._pull_impl(key, out, priority)
+        counter("kvstore.pull").inc()
+
+    def _pull_impl(self, key, out, priority=0):
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._data:
@@ -515,7 +529,9 @@ class KVStoreDistAsync(KVStore):
                 self._client.key_call(k, ("init", k, host))
             self._key_shapes[k] = v.shape
 
-    def push(self, key, value, priority=0):
+    def _push_impl(self, key, value, priority=0):
+        # the base KVStore.push wraps this with the kvstore.push
+        # span + counter; only the implementation is overridden here
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             merged = self._reduce(vlist)   # local multi-device reduce
@@ -559,8 +575,9 @@ class KVStoreDistAsync(KVStore):
             else:
                 self._client.key_call(k, ("push", k, merged.asnumpy()))
 
-    def pull(self, key, out=None, priority=0):
-        assert out is not None
+    def _pull_impl(self, key, out, priority=0):
+        # the base KVStore.pull wraps this with the kvstore.pull
+        # span + counter; only the implementation is overridden here
         keys, outs = _ctype_key_value(key, out)
         import numpy as _np
 

@@ -17,6 +17,7 @@ from ..model import BatchEndParam
 from .. import ndarray as nd
 from ..context import cpu
 from ..initializer import Uniform
+from ..observability import record_step, trace_span
 
 _PARAM_KINDS = ("arg", "aux")
 _WEIGHT_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
@@ -152,15 +153,20 @@ class BaseModule:
         nbatch = 0
         eval_metric = train_metric  # keep legacy name visible in locals()
         for data_batch, _is_last, upcoming in _lookahead(train_data):
+            step_started = time.perf_counter()
             if monitor is not None:
                 monitor.tic()
-            self.forward_backward(data_batch)
-            self.update()
+            with trace_span("step", "module"):
+                self.forward_backward(data_batch)
+                with trace_span("update", "module"):
+                    self.update()
             if upcoming is not None:
                 self.prepare(upcoming)
-            self.update_metric(train_metric, data_batch.label)
+            with trace_span("update_metric", "module"):
+                self.update_metric(train_metric, data_batch.label)
             if monitor is not None:
                 monitor.toc_print()
+            record_step(time.perf_counter() - step_started)
             _fire(batch_end_callback,
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=train_metric, locals=locals()))

@@ -13,6 +13,8 @@ from __future__ import annotations
 import logging
 import re
 
+import numpy as np
+
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -23,19 +25,29 @@ def _default_stat(x):
     return x.abs().sum() / x.size
 
 
-def _render(values):
-    """Format a stat result (NDArray or list of them) for logging."""
+def _fetch(values):
+    """Host-fetch a stat result ONCE: [(numpy value, scalarish)].
+
+    Non-NDArray results raise AssertionError (a stat_func bug must stay
+    loud); an aborted/deleted device buffer raises RuntimeError, which
+    the caller treats as a per-entry skip."""
     if isinstance(values, NDArray):
         values = [values]
     if not isinstance(values, list):
         raise AssertionError("stat_func must return NDArray(s)")
-    pieces = []
+    out = []
     for v in values:
         if not isinstance(v, NDArray):
             raise AssertionError("stat_func must return NDArray(s)")
-        scalarish = v.shape in ((1,), ())
-        pieces.append(str(v.asscalar() if scalarish else v.asnumpy()) + "\t")
-    return "".join(pieces)
+        out.append((np.asarray(v.asnumpy()), v.shape in ((1,), ())))
+    return out
+
+
+def _render(fetched):
+    """Format host-fetched stat values for logging."""
+    return "".join(
+        str(arr.reshape(-1)[0] if scalarish else arr) + "\t"
+        for arr, scalarish in fetched)
 
 
 class Monitor:
@@ -69,19 +81,46 @@ class Monitor:
         yield from exe.aux_dict.items()
 
     def toc(self):
-        """Call at batch end; returns [(step, name, rendered stat)]."""
+        """Call at batch end; returns [(step, name, rendered stat)].
+
+        Aborted arrays (donated/deleted device buffers raise on access)
+        and all-NaN stats are skipped with a debug log instead of
+        aborting the whole collection pass — one poisoned tensor must
+        not hide every other statistic of the batch.
+        """
         if not self.activated:
             return []
         for exe in self.exes:
             for name, array in self._scan(exe):
-                if self.re_prog.match(name):
-                    self.queue.append(
-                        (self.step, name, self.stat_func(array)))
+                if not self.re_prog.match(name):
+                    continue
+                try:
+                    stat = self.stat_func(array)
+                except RuntimeError as err:
+                    # aborted/deleted device buffer; anything else (a
+                    # stat_func bug: NameError, TypeError) stays loud
+                    logging.debug("monitor: skipping %s (stat aborted: %s)",
+                                  name, err)
+                    continue
+                self.queue.append((self.step, name, stat))
         self.activated = False
         if self.sort:
+            # reference parity (python/mxnet/monitor.py toc): stable sort
+            # by entry name so grouped weights/grads log adjacently
             self.queue.sort(key=lambda entry: entry[1])
-        rendered = [(step, name, _render(stat))
-                    for step, name, stat in self.queue]
+        rendered = []
+        for step, name, stat in self.queue:
+            try:
+                fetched = _fetch(stat)  # one host fetch per value
+            except RuntimeError as err:  # aborted/deleted device buffer
+                logging.debug("monitor: skipping %s (stat aborted: %s)",
+                              name, err)
+                continue
+            if any(arr.size and np.issubdtype(arr.dtype, np.inexact)
+                   and np.isnan(arr).all() for arr, _ in fetched):
+                logging.debug("monitor: skipping %s (all-NaN stat)", name)
+                continue
+            rendered.append((step, name, _render(fetched)))
         self.queue = []
         return rendered
 

@@ -85,22 +85,35 @@ def record_apply(f, inputs, name="fn"):
 def invoke(opdef, args, kwargs):
     """Invoke one registered op imperatively (Imperative::Invoke analog)."""
     from .. import profiler as _profiler
+    from ..observability import metrics as _metrics
 
-    if _profiler.imperative_active():
-        # profiled path: run synchronously and record a chrome-trace
-        # event per op (the reference measures inside the engine worker,
-        # src/engine/profiler.cc SetOprStart/SetOprEnd)
-        import jax
+    profiled = _profiler.imperative_active()
+    telemetry = _metrics.enabled()
+    if not (profiled or telemetry):
+        return _invoke_impl(opdef, args, kwargs)
 
-        t0 = _profiler._now_us()
-        res = _invoke_impl(opdef, args, kwargs)
-        jax.block_until_ready(
-            [r._data for r in
-             (res if isinstance(res, (list, tuple)) else [res])])
-        _profiler.record(opdef.name, "operator", t0,
-                         _profiler._now_us() - t0)
-        return res
-    return _invoke_impl(opdef, args, kwargs)
+    # measured path: run synchronously so durations mean compute, not
+    # dispatch (the reference measures inside the engine worker,
+    # src/engine/profiler.cc SetOprStart/SetOprEnd). The host-side
+    # dispatch cost (t1 - t0: attr parsing, tracing, enqueue RTT) vs the
+    # device-compute remainder (t2 - t1: block_until_ready delta) is THE
+    # eager-gap decomposition VERDICT.md asks for — see PERF_NOTES.md.
+    import jax
+
+    t0 = _profiler._now_us()
+    res = _invoke_impl(opdef, args, kwargs)
+    t1 = _profiler._now_us()
+    jax.block_until_ready(
+        [r._data for r in
+         (res if isinstance(res, (list, tuple)) else [res])])
+    t2 = _profiler._now_us()
+    if profiled:
+        _profiler.record(opdef.name, "operator", t0, t2 - t0)
+    if telemetry:
+        _metrics.counter("dispatch.eager").inc()
+        _metrics.histogram("dispatch.host_us").observe(t1 - t0)
+        _metrics.histogram("dispatch.device_us").observe(t2 - t1)
+    return res
 
 
 def _invoke_impl(opdef, args, kwargs):

@@ -1,0 +1,34 @@
+"""Unified training telemetry (no reference counterpart — the reference
+scatters this across the engine profiler and ad-hoc logging).
+
+Three pillars, one import:
+
+* :mod:`.metrics` — process-wide counters/gauges/histograms with a
+  Prometheus text exposition (:func:`dump_metrics`) and a zero-overhead
+  no-op mode (MXNET_TELEMETRY flag).
+* :mod:`.tracing` — :func:`trace_span` nested chrome://tracing spans
+  into the profiler buffer; :func:`device_scope` for labels inside
+  compiled programs.
+* :mod:`.instruments` — ready-made wiring: XLA compile accounting via
+  jax.monitoring, HBM watermark sampling, per-step accounting.
+
+See docs/observability.md for the metrics catalog and the "where did my
+step time go" workflow (profiler dump → tools/trace_report.py).
+"""
+from . import metrics
+from . import instruments
+from . import tracing
+from .metrics import (counter, gauge, histogram, dump_metrics,
+                      reset_metrics, set_enabled, enabled)
+from .tracing import trace_span, device_scope
+from .instruments import sample_memory, record_step, retrace_causes
+
+__all__ = ["metrics", "instruments", "tracing",
+           "counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
+           "set_enabled", "enabled", "trace_span", "device_scope",
+           "sample_memory", "record_step", "retrace_causes"]
+
+# honor an env-set MXNET_TELEMETRY at import: installs the jax.monitoring
+# hooks so compiles are counted from the first jit call
+if metrics.enabled():
+    instruments.install_jax_hooks()

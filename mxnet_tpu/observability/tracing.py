@@ -1,0 +1,83 @@
+"""Span tracing: nested chrome://tracing events over the profiler buffer.
+
+``trace_span(name, cat)`` wraps any host-side phase (module forward,
+trainer step, kvstore push) in a complete-event span. Spans land in the
+same event buffer as the profiler's per-op / per-program events
+(profiler.py), so one ``dump_profile()`` shows framework phases AND the
+ops they contain on a shared timeline — nesting falls out of chrome's
+duration-containment rendering because a span records its own ts/dur and
+runs on the same thread as its children.
+
+Spans are recorded whenever the profiler session is running (any mode —
+phases are not ops, so the imperative/symbolic mode split does not gate
+them). Independent of the profiler, when telemetry is enabled each span
+also feeds a per-name duration histogram (``span.<name>.ms``) so
+long-running training exposes phase-time distributions without a trace
+file.
+
+For code *inside* a jitted program (ring-attention steps, fused train
+steps) host spans cannot see run time — use :func:`device_scope`, which
+wraps ``jax.named_scope`` so the XLA/XPlane device trace carries the
+label instead.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from . import metrics
+
+__all__ = ["trace_span", "device_scope"]
+
+
+class _Span:
+    """Reusable context manager for one span instance."""
+
+    __slots__ = ("name", "cat", "_t0", "_prof_on", "_telem_on")
+
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+        self._t0 = 0.0
+        self._prof_on = False
+        self._telem_on = False
+
+    def __enter__(self):
+        from .. import profiler
+
+        self._prof_on = profiler.spans_active()
+        self._telem_on = metrics.enabled()
+        if self._prof_on or self._telem_on:
+            self._t0 = profiler._now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not (self._prof_on or self._telem_on):
+            return False
+        from .. import profiler
+
+        dur = profiler._now_us() - self._t0
+        if self._prof_on:
+            profiler.record(self.name, self.cat, self._t0, dur)
+        if self._telem_on:
+            metrics.histogram("span.%s.ms" % self.name).observe(dur / 1e3)
+        return False
+
+
+def trace_span(name, cat="phase"):
+    """Context manager: record ``name`` as a chrome trace span of
+    category ``cat`` covering the with-block (no-op unless the profiler
+    is running or telemetry is enabled)."""
+    return _Span(name, cat)
+
+
+def device_scope(name):
+    """Label the ops traced inside the with-block in the device (XPlane)
+    trace — `jax.named_scope` with a lazy import, safe to call in traced
+    code. Host spans cannot time compiled-program interiors; this is the
+    device-side analog."""
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except Exception:  # pragma: no cover - jax always present in-tree
+        return contextlib.nullcontext()

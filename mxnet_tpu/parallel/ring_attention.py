@@ -71,19 +71,28 @@ def _ring_attention_local_flash(q, k, v, axis_name, causal, scale,
 
     from .flash_attention import flash_attention
 
+    from ..observability import device_scope
+
     n = lax.psum(1, axis_name)  # static (mesh shape is static)
     my_idx = lax.axis_index(axis_name)
-    o_acc, lse_acc = flash_attention(q, k, v, causal=causal, scale=scale,
-                                     interpret=interpret, return_lse=True)
+    # device_scope labels land in the XPlane device trace, so
+    # tools/trace_report.py can attribute ring time to per-step comms
+    # (ring_comm_*) vs per-step block attention (ring_attn_step_*)
+    with device_scope("ring_attn_step_0"):
+        o_acc, lse_acc = flash_attention(q, k, v, causal=causal,
+                                         scale=scale, interpret=interpret,
+                                         return_lse=True)
     o_acc = o_acc.astype(jnp.float32)
     k_cur, v_cur = k, v
     perm = [(j, (j + 1) % n) for j in range(n)]
     for i in range(1, n):
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
-        o_b, lse_b = flash_attention(q, k_cur, v_cur, causal=False,
-                                     scale=scale, interpret=interpret,
-                                     return_lse=True)
+        with device_scope("ring_comm_%d" % i):
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        with device_scope("ring_attn_step_%d" % i):
+            o_b, lse_b = flash_attention(q, k_cur, v_cur, causal=False,
+                                         scale=scale, interpret=interpret,
+                                         return_lse=True)
         if causal:
             # src strictly before us: fully visible; after us: fully
             # masked (lse = -inf zeroes it out of the merge)
@@ -137,13 +146,17 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale,
                                 precision="highest"))
         return new_acc, new_m, new_l
 
+    from ..observability import device_scope
+
     def step(carry, i):
         k_cur, v_cur, acc, m, l = carry
-        acc, m, l = combine(acc, m, l, k_cur, v_cur, i)
+        with device_scope("ring_attn_step"):
+            acc, m, l = combine(acc, m, l, k_cur, v_cur, i)
         # rotate K/V to the next ring position (ICI neighbor exchange)
-        perm = [(j, (j + 1) % n) for j in range(n)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        with device_scope("ring_comm"):
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt, acc, m, l), None
 
     acc0 = jnp.zeros(q.shape, jnp.float32)
@@ -222,4 +235,11 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None,
         # pallas_call has no shard_map replication rule; the flash body
         # is per-device SPMD anyway, so skip the rep check there
         check_rep=not use_flash)
-    return fn(q, k, v)
+    from ..observability import counter, trace_span
+
+    # host span = the whole sharded dispatch; per-ring-step attribution
+    # lives in the device trace via the device_scope labels above
+    with trace_span("ring_attention", "parallel"):
+        out = fn(q, k, v)
+    counter("ring_attention.calls").inc()
+    return out

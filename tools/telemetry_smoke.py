@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Telemetry smoke: 3-step toy fit -> trace dump -> trace_report.
+
+The end-to-end pipeline guard CI runs (and the doc example for "where
+did my step time go"): train a tiny MLP for one epoch of 3 batches with
+``MXNET_TELEMETRY=1`` and the profiler in 'all' mode, dump the chrome
+trace, run tools/trace_report.py over it, and print ``dump_metrics()``.
+Exits nonzero if any pillar produced nothing (no spans, no ops, zero
+dispatch/compile/step/memory metrics), so a silent telemetry regression
+fails the build rather than shipping a dead dashboard.
+
+Usage: python tools/telemetry_smoke.py [out_trace.json]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def toy_fit(num_batches=3, bs=8):
+    """The canonical 3-step toy fit (also reused by
+    tests/test_observability.py so the acceptance test and this smoke
+    exercise the identical scenario)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs * num_batches, 10).astype(np.float32)
+    y = rng.randint(0, 4, bs * num_batches).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=bs, label_name="softmax_label")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),))
+
+
+def main():
+    os.environ.setdefault("MXNET_TELEMETRY", "1")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    out = sys.argv[1] if len(sys.argv) > 1 else "telemetry_smoke.json"
+    import mxnet_tpu as mx
+    from mxnet_tpu import observability as obs
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+
+    mx.profiler.set_config(mode="all", filename=out)
+    mx.profiler.set_state("run")
+    toy_fit()
+    path = mx.profiler.dump_profile()
+
+    rows = trace_report.report(path, k=15)
+    print(trace_report.format_table(rows, "top 15 by total time — " + path))
+    print()
+    metrics_text = obs.dump_metrics()
+    print(metrics_text)
+
+    failures = []
+    if not rows:
+        failures.append("trace has no events")
+    if not any(r["cat"] == "module" for r in rows):
+        failures.append("no module phase spans in trace")
+    for required in ("dispatch.eager", "jit.compile_count", "step.count"):
+        if not obs.metrics.get_value(required, 0):
+            failures.append("metric %s is zero/absent" % required)
+    if not obs.metrics.get_value("hbm.peak_bytes", 0):
+        failures.append("hbm.peak_bytes watermark is zero")
+    if obs.metrics.get_value("step.ms", 0) != 3:
+        failures.append("step.ms histogram did not record 3 steps (got %r)"
+                        % obs.metrics.get_value("step.ms"))
+    if failures:
+        print("TELEMETRY SMOKE FAILED:\n  - " + "\n  - ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("telemetry smoke OK: trace at %s" % path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

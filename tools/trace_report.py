@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Trace analysis: top-K ops/phases by time from a profiler dump.
+
+The per-HLO time budget VERDICT.md's roofline ask demands, as a tool:
+feed it any chrome://tracing JSON — the framework profiler's
+``dump_profile()`` output, or the ``*.trace.json.gz`` the JAX/XLA
+profiler (XPlane) writes under ``<filename>_trace/`` — and it prints the
+top-K event names by total time with per-row percent and
+cumulative-percent columns, so "where did my step time go" is one
+command:
+
+    python tools/trace_report.py profile.json
+    python tools/trace_report.py profile_trace/           # XPlane dir
+    python tools/trace_report.py profile.json --cat operator -k 20
+    python tools/trace_report.py --compare before.json after.json
+
+``--compare`` prints a per-name regression diff (total-ms delta, sorted
+by |delta|) between two traces — the artifact a perf PR should paste to
+prove its claim.
+
+Accepted inputs: a ``.json`` trace, a ``.json.gz`` / ``.gz`` trace, or a
+directory that contains one (searched recursively, newest wins — the
+layout ``jax.profiler`` writes: ``plugins/profile/<run>/*.trace.json.gz``).
+
+Library use: :func:`load_events`, :func:`aggregate`, :func:`report_rows`
+are importable (bench_all.py --telemetry and tests use them).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_events(path):
+    """Complete ('X') events from a chrome trace file or XPlane trace
+    dir; returns a list of {name, cat, ts, dur, pid, tid} dicts."""
+    path = _resolve(path)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        payload = json.load(f)
+    events = payload.get("traceEvents", payload) if isinstance(
+        payload, dict) else payload
+    out = []
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        if dur is None:
+            continue
+        out.append(ev)
+    return out
+
+
+def _resolve(path):
+    """Map a directory to the newest trace file inside it."""
+    if not os.path.isdir(path):
+        return path
+    candidates = []
+    for pattern in ("**/*.trace.json.gz", "**/*.trace.json", "**/*.json"):
+        candidates = glob.glob(os.path.join(path, pattern), recursive=True)
+        if candidates:
+            break
+    if not candidates:
+        raise FileNotFoundError("no trace file under %r" % path)
+    return max(candidates, key=os.path.getmtime)
+
+
+def _self_times(events):
+    """id(event) -> exclusive (self) duration in us.
+
+    Per (pid, tid) timeline sweep: each event's duration minus the time
+    spent in the events nested directly inside it. Self times are
+    non-overlapping, so they sum to actual wall time — unlike inclusive
+    durations, where a phase span and every op it contains would count
+    the same wall time twice."""
+    groups = {}
+    for ev in events:
+        groups.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    selfs = {}
+    for evs in groups.values():
+        # parents first at equal start (longer duration = outer span)
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # [(id(ev), end_ts)]
+        for ev in evs:
+            ts, dur = float(ev["ts"]), float(ev["dur"])
+            while stack and ts >= stack[-1][1]:
+                stack.pop()
+            selfs[id(ev)] = dur
+            if stack:
+                selfs[stack[-1][0]] -= dur
+            stack.append((id(ev), ts + dur))
+    return selfs
+
+
+def aggregate(events, cat=None):
+    """Sum durations per (name, cat) ->
+    {(name, cat): {count, total_us, self_us}}.
+
+    Keyed by category as well as name: a framework phase span and an op
+    can share a name (Module.forward's 'forward' span vs the executor's
+    'forward' program event) and merging them would double-count the
+    same wall time under one mislabeled row. Self times are computed on
+    the FULL event set before any category filter, so a filtered view
+    still subtracts children of other categories."""
+    selfs = _self_times(events)
+    agg = {}
+    for ev in events:
+        if cat is not None and ev.get("cat") != cat:
+            continue
+        key = (ev.get("name", "?"), ev.get("cat", ""))
+        slot = agg.get(key)
+        if slot is None:
+            slot = agg[key] = {"count": 0, "total_us": 0.0, "self_us": 0.0}
+        slot["count"] += 1
+        slot["total_us"] += float(ev["dur"])
+        slot["self_us"] += max(selfs.get(id(ev), 0.0), 0.0)
+    return agg
+
+
+def report_rows(agg, k=15):
+    """Ranked rows [{rank, name, cat, count, total_ms, self_ms, avg_ms,
+    pct, cum_pct}] for the top-k (name, cat) pairs by total time.
+
+    pct/cum_pct are shares of summed SELF time (= wall time actually
+    attributable to each row): with nested spans in the trace, inclusive
+    totals overlap and percentages of their sum would deflate parents
+    and overstate coverage."""
+    total_self = sum(v["self_us"] for v in agg.values()) or 1.0
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1]["total_us"])
+    rows, cum = [], 0.0
+    for i, ((name, ecat), v) in enumerate(ranked[:k]):
+        cum += v["self_us"]
+        rows.append({
+            "rank": i + 1, "name": name, "cat": ecat,
+            "count": v["count"],
+            "total_ms": round(v["total_us"] / 1e3, 3),
+            "self_ms": round(v["self_us"] / 1e3, 3),
+            "avg_ms": round(v["total_us"] / v["count"] / 1e3, 4),
+            "pct": round(100.0 * v["self_us"] / total_self, 1),
+            "cum_pct": round(100.0 * cum / total_self, 1),
+        })
+    return rows
+
+
+def format_table(rows, title="top ops by time"):
+    if not rows:
+        return "(no events)"
+    width = max([len(r["name"]) for r in rows] + [4])
+    lines = ["# %s (pct = share of self time; total includes nested)"
+             % title,
+             "%-4s %-*s %-10s %8s %12s %12s %10s %7s %7s"
+             % ("rank", width, "name", "cat", "count", "total_ms",
+                "self_ms", "avg_ms", "%", "cum%")]
+    for r in rows:
+        lines.append("%-4d %-*s %-10s %8d %12.3f %12.3f %10.4f %7.1f %7.1f"
+                     % (r["rank"], width, r["name"], r["cat"][:10],
+                        r["count"], r["total_ms"], r["self_ms"],
+                        r["avg_ms"], r["pct"], r["cum_pct"]))
+    return "\n".join(lines)
+
+
+def report(path, k=15, cat=None):
+    """One-call convenience: path -> ranked rows."""
+    return report_rows(aggregate(load_events(path), cat=cat), k=k)
+
+
+def compare(path_a, path_b, k=15, cat=None):
+    """Per-(name, cat) total-time regression diff rows between two
+    traces, sorted by |delta| (b minus a: positive = b is slower)."""
+    a = aggregate(load_events(path_a), cat=cat)
+    b = aggregate(load_events(path_b), cat=cat)
+    rows = []
+    for key in set(a) | set(b):
+        ta = a.get(key, {}).get("total_us", 0.0)
+        tb = b.get(key, {}).get("total_us", 0.0)
+        rows.append({
+            "name": key[0], "cat": key[1],
+            "a_ms": round(ta / 1e3, 3), "b_ms": round(tb / 1e3, 3),
+            "delta_ms": round((tb - ta) / 1e3, 3),
+            "ratio": round(tb / ta, 3) if ta else None,
+            "a_count": a.get(key, {}).get("count", 0),
+            "b_count": b.get(key, {}).get("count", 0),
+        })
+    rows.sort(key=lambda r: -abs(r["delta_ms"]))
+    return rows[:k]
+
+
+def format_compare(rows, path_a, path_b):
+    if not rows:
+        return "(no events)"
+    width = max([len(r["name"]) for r in rows] + [4])
+    lines = ["# regression diff: %s -> %s (positive delta = slower)"
+             % (path_a, path_b),
+             "%-*s %-10s %12s %12s %12s %8s %9s"
+             % (width, "name", "cat", "a_ms", "b_ms", "delta_ms", "ratio",
+                "counts")]
+    for r in rows:
+        lines.append("%-*s %-10s %12.3f %12.3f %+12.3f %8s %5d/%-5d"
+                     % (width, r["name"], r["cat"][:10], r["a_ms"],
+                        r["b_ms"], r["delta_ms"],
+                        "-" if r["ratio"] is None else "%.3f" % r["ratio"],
+                        r["a_count"], r["b_count"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="top-K op/phase time report from a chrome/XPlane trace")
+    ap.add_argument("trace", nargs="?",
+                    help="trace file (.json/.json.gz) or XPlane trace dir")
+    ap.add_argument("-k", "--top-k", type=int, default=15)
+    ap.add_argument("--cat", default=None,
+                    help="only events of this category (e.g. operator, "
+                         "executor, module, kvstore)")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    help="diff two traces instead of reporting one")
+    ap.add_argument("--json", action="store_true",
+                    help="emit rows as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        rows = compare(args.compare[0], args.compare[1], k=args.top_k,
+                       cat=args.cat)
+        print(json.dumps(rows, indent=1) if args.json
+              else format_compare(rows, *args.compare))
+        return 0
+    if not args.trace:
+        ap.error("trace path required (or use --compare A B)")
+    rows = report(args.trace, k=args.top_k, cat=args.cat)
+    title = "top %d by total time — %s" % (args.top_k, args.trace)
+    if args.cat:
+        title += " [cat=%s]" % args.cat
+    print(json.dumps(rows, indent=1) if args.json
+          else format_table(rows, title))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
