@@ -6,15 +6,26 @@ Two complementary layers here:
 1. **Framework events** — when profiling runs, the eager op dispatcher
    and the graph executor record per-op / per-program events with host
    timestamps and write the reference's chrome://tracing JSON format on
-   ``dump_profile()`` (load it in chrome://tracing or Perfetto). Mode
+   ``dump_profile()`` (load it in chrome://tracing or Perfetto, or feed
+   it to ``tools/trace_report.py`` for a top-K op-time table). Mode
    'symbolic' records only whole-program executor runs (the engine-op
    analog); 'imperative' only eager ops; 'all' records both. While
    profiling, eager ops run synchronously (block_until_ready) so
    durations mean compute, not dispatch — the reference's profiler
-   measures inside the engine worker the same way.
+   measures inside the engine worker the same way. Framework *phase
+   spans* (observability.trace_span: fit-loop forward/backward/update,
+   trainer step, kvstore push/pull) record in ANY mode while the session
+   runs — phases are not ops, so the mode split does not gate them.
 2. **XLA device trace** — set_state('run') also starts the JAX/XLA
    profiler (XPlane → TensorBoard/Perfetto) in ``<filename>_trace/``
-   for kernel-level device timing.
+   for kernel-level device timing; ``tools/trace_report.py`` reads the
+   ``*.trace.json.gz`` it contains.
+
+The initial mode can be set from the environment (``MXNET_PROFILER_MODE``)
+so unmodified scripts can be traced. All state transitions take the
+module lock, and ``dump_profile()`` writes via temp-file + atomic rename
+so a concurrent reader (a dashboard tailing the file, the CI artifact
+scraper) never observes truncated JSON.
 """
 from __future__ import annotations
 
@@ -26,10 +37,19 @@ import time
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "pause", "resume"]
 
-_state = {"mode": "symbolic", "filename": "profile.json", "running": False,
+_VALID_MODES = ("symbolic", "imperative", "all")
+
+
+def _env_mode():
+    mode = os.environ.get("MXNET_PROFILER_MODE", "symbolic")
+    return mode if mode in _VALID_MODES else "symbolic"
+
+
+_state = {"mode": _env_mode(), "filename": "profile.json", "running": False,
           "paused": False}
 _events = []
 _lock = threading.Lock()
+_trace_lock = threading.Lock()  # serializes jax device-trace start/stop
 _t0 = time.perf_counter()
 
 
@@ -47,23 +67,31 @@ def symbolic_active():
             and _state["mode"] in ("symbolic", "all"))
 
 
+def spans_active():
+    """Phase spans (observability.trace_span) record in any mode while
+    the session runs."""
+    return _state["running"] and not _state["paused"]
+
+
 def record(name, cat, ts_us, dur_us):
     """Append one complete ('ph':'X') event."""
+    ev = {"name": name, "cat": cat, "ph": "X",
+          "ts": ts_us, "dur": dur_us,
+          "pid": os.getpid(),
+          "tid": threading.get_ident() % (1 << 20)}
     with _lock:
-        _events.append({"name": name, "cat": cat, "ph": "X",
-                        "ts": ts_us, "dur": dur_us,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident() % (1 << 20)})
+        _events.append(ev)
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """(reference: profiler.py:profiler_set_config); mode is 'symbolic',
     'imperative', or 'all'."""
-    if mode not in ("symbolic", "imperative", "all"):
+    if mode not in _VALID_MODES:
         raise ValueError("mode must be symbolic/imperative/all, got %r"
                          % (mode,))
-    _state["mode"] = mode
-    _state["filename"] = filename
+    with _lock:
+        _state["mode"] = mode
+        _state["filename"] = filename
 
 
 def profiler_set_state(state="stop"):
@@ -74,46 +102,82 @@ def profiler_set_state(state="stop"):
     if state not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop', got %r"
                          % (state,))
-    if state == "run" and not _state["running"]:
-        trace_dir = os.path.splitext(_state["filename"])[0] + "_trace"
-        try:
-            jax.profiler.start_trace(trace_dir)
-            _state["trace_dir"] = trace_dir
-        except Exception:  # device trace is best-effort (tunnel backends)
-            _state["trace_dir"] = None
-        _state["running"] = True
-        _state["paused"] = False
-    elif state == "stop" and _state["running"]:
-        if _state.get("trace_dir"):
+    with _lock:
+        if state == "run" and not _state["running"]:
+            trace_dir = os.path.splitext(_state["filename"])[0] + "_trace"
+            start_trace = True
+            _state["running"] = True
+            _state["paused"] = False
+        elif state == "stop" and _state["running"]:
+            start_trace = False
+            _state["running"] = False
+        else:
+            return
+    # the jax profiler calls run outside _lock (start_trace can spend
+    # tens of ms in the backend and must not serialize against record())
+    # but under _trace_lock, which serializes start vs stop so a stop
+    # racing a just-started run cannot leak a running device trace
+    if start_trace:
+        with _trace_lock:
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception:  # device trace best-effort (tunnel backends)
+                trace_dir = None
+            with _lock:
+                _state["trace_dir"] = trace_dir
+                still_running = _state["running"]
+        if trace_dir and not still_running:
+            # a concurrent stop won the race before our trace_dir was
+            # visible to it; the stop is on us
+            _stop_device_trace(jax)
+    else:
+        _stop_device_trace(jax)
+
+
+def _stop_device_trace(jax):
+    """Stop the XLA device trace if one is recorded in _state."""
+    with _trace_lock:
+        with _lock:
+            trace_dir, _state["trace_dir"] = _state.get("trace_dir"), None
+        if trace_dir:
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-        _state["running"] = False
 
 
 def pause():
     """Suspend event recording without ending the session
     (reference: profiler.py pause)."""
-    _state["paused"] = True
+    with _lock:
+        _state["paused"] = True
 
 
 def resume():
     """(reference: profiler.py resume)"""
-    _state["paused"] = False
+    with _lock:
+        _state["paused"] = False
 
 
 def dump_profile():
     """Stop profiling and write the chrome://tracing JSON
     (reference: profiler.py:dump_profile → DumpProfile,
-    src/engine/profiler.h:107)."""
+    src/engine/profiler.h:107). The write is atomic (temp file +
+    rename): a concurrent reader sees either the previous dump or the
+    complete new one, never a truncated file."""
     profiler_set_state("stop")
     with _lock:
         events, _events[:] = list(_events), []
+        filename = _state["filename"]
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
-    with open(_state["filename"], "w") as f:
-        json.dump(payload, f)
-    return _state["filename"]
+    tmp = "%s.tmp.%d.%d" % (filename, os.getpid(), threading.get_ident())
+    with open(tmp, "w") as f:
+        # json.dumps hits the C encoder; json.dump streams through the
+        # pure-Python one — 10-50x slower, which matters at profiler
+        # event volumes (hundreds of thousands of events per dump)
+        f.write(json.dumps(payload))
+    os.replace(tmp, filename)
+    return filename
 
 
 # aliased modern names
