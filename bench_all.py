@@ -521,6 +521,29 @@ def _collect_telemetry(results):
     }
 
 
+def assert_lint_clean():
+    """--lint-clean: graftlint must exit 0 against the committed baseline.
+
+    Bench artifacts are the repo's perf claims; refusing to bench a tree
+    with NEW static-analysis violations (hidden host syncs, retrace
+    hazards — exactly what corrupts bench numbers) keeps the baseline
+    from silently rotting. Pure assertion: exits 0 on a clean tree."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rc = subprocess.call(
+        [sys.executable, "-m", "tools.graftlint", "mxnet_tpu",
+         "--baseline", os.path.join("tools", "graftlint", "baseline.json")],
+        cwd=here)
+    if rc != 0:
+        raise SystemExit(
+            "bench_all --lint-clean: graftlint found NEW violations "
+            "(rc %d); fix them or baseline with a justification "
+            "(docs/static_analysis.md)" % rc)
+    print("[bench_all] graftlint clean against committed baseline",
+          file=sys.stderr)
+
+
 def main(out_path=None, skip=(), quiet=False, telemetry=False):
     import jax
 
@@ -555,4 +578,9 @@ def main(out_path=None, skip=(), quiet=False, telemetry=False):
 
 
 if __name__ == "__main__":
-    main(telemetry="--telemetry" in sys.argv[1:])
+    if "--lint-clean" in sys.argv[1:]:
+        # standalone smoke: assert the committed tree is graftlint-clean
+        # and exit without benching (CI/driver guard; seconds, no TPU)
+        assert_lint_clean()
+    else:
+        main(telemetry="--telemetry" in sys.argv[1:])

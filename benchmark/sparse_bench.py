@@ -74,7 +74,8 @@ def bench_cast_storage(rng, shape=(2048, 512)):
         _, dense = _rand_csr(rng, shape, density)
         dnd = mx.nd.array(dense)
         for stype in ("csr", "row_sparse"):
-            dt = _timeit(lambda: mx.nd.cast_storage(dnd, stype=stype), n=10)
+            dt = _timeit(lambda _s=stype: mx.nd.cast_storage(dnd, stype=_s),
+                         n=10)
             rows.append({"bench": "cast_storage", "stype": stype,
                          "density": density, "ms": round(dt * 1e3, 3)})
     return rows

@@ -19,7 +19,6 @@ _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                                   _os.pardir, _os.pardir))
 import argparse
 
-import numpy as np
 
 import logging
 import mxnet_tpu as mx

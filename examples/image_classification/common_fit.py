@@ -5,9 +5,7 @@ Reference workflow: example/image-classification/common/fit.py — one
 lr-step schedules, optimizer/kvstore flags, top-k eval, periodic
 checkpoints, and resume from ``--load-epoch``.
 """
-import argparse
 import logging
-import os
 import time
 
 import mxnet_tpu as mx

@@ -19,7 +19,6 @@ import tempfile
 _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                                   _os.pardir, _os.pardir))
 
-import numpy as np
 
 import mxnet_tpu as mx
 from mxnet_tpu import profiler

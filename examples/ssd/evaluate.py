@@ -20,9 +20,7 @@ _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
 _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                                   _os.pardir, _os.pardir))
 
-import numpy as np
 
-import mxnet_tpu as mx
 from mxnet_tpu.image import ImageDetIter
 
 from eval_metric import MApMetric, VOC07MApMetric

@@ -36,8 +36,10 @@ _state = threading.local()
 
 def _st():
     if not hasattr(_state, "recording"):
-        _state.recording = False
-        _state.train_mode = False
+        # host thread-local tape flags: written at trace time by
+        # design (the tape records DURING tracing)
+        _state.recording = False  # graftlint: disable=G003
+        _state.train_mode = False  # graftlint: disable=G003
     return _state
 
 
@@ -280,7 +282,7 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode):
             if req == "add":
                 dense = _from_data(
                     arr.grad._to_dense_raw() + dense._data, arr.grad.context)
-            cast_storage(dense, arr.grad.stype).copyto(arr.grad)
+            cast_storage(dense, arr.grad.stype).copyto(arr.grad)  # graftlint: disable=G001 — sparse grad writeback is host-format by design
         elif req == "add":
             arr.grad._set_data(arr.grad._data + g.astype(arr.grad._data.dtype))
         else:  # write

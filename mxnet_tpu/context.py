@@ -130,7 +130,7 @@ def num_gpus():
 
 def _ctx_stack():
     if not hasattr(_thread_state, "ctx_stack"):
-        _thread_state.ctx_stack = []
+        _thread_state.ctx_stack = []  # graftlint: disable=G003 — host ctx bookkeeping, idempotent at trace time
     return _thread_state.ctx_stack
 
 

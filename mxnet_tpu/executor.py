@@ -18,10 +18,6 @@ just applies the stashed gradients according to grad_req. An explicit
 """
 from __future__ import annotations
 
-import functools
-
-import numpy as np
-
 from .base import MXNetError
 from .context import Context
 
@@ -104,7 +100,7 @@ class _GraphProgram:
                     "cannot infer shape for %s node %r with declared shape "
                     "%s" % (n.op, n.name, n.parsed_attrs().get("shape")))
             overrides[id(n)] = shape
-        self._init_shape_cache[key] = overrides
+        self._init_shape_cache[key] = overrides  # graftlint: disable=G003 — idempotent memo of trace-time shape inference
         return overrides
 
     def assign_contexts(self, group2ctx, default_ctx):

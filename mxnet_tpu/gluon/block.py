@@ -12,8 +12,6 @@ from __future__ import annotations
 import re
 import threading
 
-import numpy as np
-
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import symbol as sym_mod
@@ -386,7 +384,7 @@ class HybridBlock(Block):
                 except DeferredInitializationError:
                     self._deferred_infer_shape(x, *args)
                     for _, param in self.collect_params().items():
-                        param._finish_deferred_init()
+                        param._finish_deferred_init()  # graftlint: disable=G001 — one-time deferred init
                     return self._call_cached_op(x, *args)
             ctx = x.context
             try:
@@ -394,7 +392,7 @@ class HybridBlock(Block):
             except DeferredInitializationError:
                 self._deferred_infer_shape(x, *args)
                 for _, param in self._reg_params.items():
-                    param._finish_deferred_init()
+                    param._finish_deferred_init()  # graftlint: disable=G001 — one-time deferred init
                 params = {i: j.data(ctx) for i, j in self._reg_params.items()}
             return self.hybrid_forward(nd, x, *args, **params)
         assert isinstance(x, Symbol), \
@@ -486,7 +484,7 @@ class SymbolBlock(HybridBlock):
             except DeferredInitializationError:
                 self._deferred_infer_shape(x, *args)
                 for _, param in self.collect_params().items():
-                    param._finish_deferred_init()
+                    param._finish_deferred_init()  # graftlint: disable=G001 — one-time deferred init
                 return self._call_cached_op(x, *args)
         assert isinstance(x, Symbol), \
             "HybridBlock requires the first argument to forward be either " \

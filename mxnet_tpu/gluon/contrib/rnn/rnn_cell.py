@@ -7,8 +7,7 @@ DropoutCell's fresh mask per step).
 """
 from __future__ import annotations
 
-from ...rnn.rnn_cell import (BidirectionalCell, ModifierCell,
-                             SequentialRNNCell)
+from ...rnn.rnn_cell import BidirectionalCell, ModifierCell
 
 __all__ = ["VariationalDropoutCell"]
 

@@ -71,7 +71,7 @@ class ArrayDataset(Dataset):
                 "All arrays must have the same length; array[0] has length " \
                 "%d while array[%d] has %d." % (self._length, i, len(data))
             if isinstance(data, nd.NDArray) and data.ndim == 1:
-                data = data.asnumpy()
+                data = data.asnumpy()  # graftlint: disable=G001 — one-time conversion at dataset construction
             self._data.append(data)
 
     def __getitem__(self, idx):

@@ -16,7 +16,7 @@ import numpy as np
 from ... import ndarray as nd
 from ...base import MXNetError
 from ... import image, recordio
-from .dataset import ArrayDataset, Dataset, RecordFileDataset
+from .dataset import Dataset, RecordFileDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageRecordDataset", "ImageFolderDataset",

@@ -309,7 +309,7 @@ class Parameter:
         if not isinstance(data, nd.NDArray):
             data = nd.array(np.asarray(data), dtype=self.dtype)
         for c, arr in self._data.items():
-            arr._set_data(data.as_in_context(c)._data)
+            arr._set_data(data.as_in_context(c)._data)  # graftlint: disable=G001 — replicating a new value to every ctx is the set_data contract
 
     def zero_grad(self):
         if self._grad is None:
@@ -494,4 +494,4 @@ class ParameterDict:
                         "Parameter %s loaded from file %s is not present in "
                         "ParameterDict" % (name[cut:], filename))
                 continue
-            self[name]._load_init(value, ctx)
+            self[name]._load_init(value, ctx)  # graftlint: disable=G001 — one-time checkpoint load

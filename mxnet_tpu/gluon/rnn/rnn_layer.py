@@ -113,7 +113,7 @@ class _RNNLayer(Block):
                     i2h_bias_initializer=self._i2h_bias_initializer,
                     h2h_bias_initializer=self._h2h_bias_initializer)
 
-                def make(side):
+                def make(side, layer=layer, common=common):
                     return step_cls(self._hidden_size,
                                     prefix="%s%d_" % (side, layer), **common)
 
@@ -147,7 +147,7 @@ class _RNNLayer(Block):
             first = getattr(self, "%s0_i2h_weight" % side)
             first.shape = (self._gates * self._hidden_size, feature_size)
         for p in self.collect_params().values():
-            p._finish_deferred_init()
+            p._finish_deferred_init()  # graftlint: disable=G001 — one-time deferred init
         self._input_size = feature_size
 
     def forward(self, inputs, states=None):

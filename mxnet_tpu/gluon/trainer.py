@@ -285,7 +285,7 @@ class Trainer:
         if hasattr(type(opt_ref), "_corrected_lr"):
             patched["_corrected_lr"] = lambda idx: lr_map[idx]
         for name, fn in patched.items():
-            setattr(opt_ref, name, fn)
+            setattr(opt_ref, name, fn)  # graftlint: disable=G003 — trace-time lr patch, restored in the finally below
         try:
             new_w, new_s = [], []
             for (i, _p), wd, gd, sd in zip(live, w_datas, g_datas,
@@ -452,7 +452,7 @@ class _FusedTrainStep:
         except Exception:
             self._net._deferred_infer_shape(data)
             for _name, p in self._net.collect_params().items():
-                p._finish_deferred_init()
+                p._finish_deferred_init()  # graftlint: disable=G001 — one-time deferred init
 
         data_var = sym_mod.Variable("data")
         label_var = sym_mod.Variable("label")

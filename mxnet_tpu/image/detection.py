@@ -66,7 +66,7 @@ class DetAugmenter(object):
         for key in ("mean", "std"):
             value = kwargs.get(key)
             if isinstance(value, np.ndarray):
-                kwargs[key] = value.tolist()
+                kwargs[key] = value.tolist()  # graftlint: disable=G001 — one-time config parse at augmenter construction
 
     def dumps(self):
         return json.dumps([type(self).__name__.lower(), self._kwargs])

@@ -9,8 +9,6 @@ reference's OpenCV-on-CPU decode threads); images flow as HWC numpy arrays
 transfer per batch. Random state comes from module-level numpy RandomState
 seeded by mxnet_tpu.random.seed for reproducibility.
 """
-import logging
-import numbers
 import os
 import random as pyrandom
 
@@ -185,7 +183,7 @@ class Augmenter(object):
         self._kwargs = kwargs
         for k, v in kwargs.items():
             if isinstance(v, np.ndarray):
-                kwargs[k] = v.tolist()
+                kwargs[k] = v.tolist()  # graftlint: disable=G001 — one-time config parse at augmenter construction
 
     def dumps(self):
         import json

@@ -137,7 +137,9 @@ class _PairwiseMetric(EvalMetric):
         if self.check_shapes:
             check_label_shapes(labels, preds)
         for raw_label, raw_pred in zip(labels, preds):
-            value, weight = self._accumulate(_host(raw_label), _host(raw_pred))
+            # metrics are host-numpy by design (module docstring): one
+            # fetch per output, outside the compiled train step
+            value, weight = self._accumulate(_host(raw_label), _host(raw_pred))  # graftlint: disable=G001
             self.sum_metric += value
             self.num_inst += weight
 
@@ -286,8 +288,8 @@ class Perplexity(EvalMetric):
         assert len(labels) == len(preds)
         total_nll, total_count = 0.0, 0
         for raw_label, raw_pred in zip(labels, preds):
-            label = _host(raw_label)
-            pred = _host(raw_pred)
+            label = _host(raw_label)  # graftlint: disable=G001 — host-numpy metric by module design
+            pred = _host(raw_pred)  # graftlint: disable=G001 — host-numpy metric by module design
             if label.size != pred.size // pred.shape[-1]:
                 raise AssertionError("shape mismatch: %s vs. %s"
                                      % (label.shape, pred.shape))
@@ -399,15 +401,38 @@ class PearsonCorrelation(_PairwiseMetric):
 
 @register
 class Loss(EvalMetric):
-    """Running mean of a loss output (labels are ignored)."""
+    """Running mean of a loss output (labels are ignored).
+
+    The per-batch reduction stays ON DEVICE: ``pred.sum()`` dispatches
+    async and accumulates into a device scalar, so a fit loop logging
+    Loss every batch no longer pays one blocking device->host transfer
+    per update — the single transfer happens in :meth:`get` (graftlint
+    G001 finding; the other metrics are host-numpy by module design)."""
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, **_fwd(locals()))
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += _host(pred).sum()
+            # reduce in float32 regardless of the loss dtype: a bf16
+            # running sum silently drops every batch once it crosses
+            # ~256 (8-bit mantissa); float32 matches what the compiled
+            # step itself accumulates in
+            if isinstance(pred, NDArray):
+                part = pred.astype("float32").sum()
+            else:
+                part = numpy.asarray(pred, dtype=numpy.float64).sum()
+            # NDArray + float and NDArray + NDArray both stay on device
+            self.sum_metric = part + self.sum_metric
             self.num_inst += pred.size
+
+    def get(self):
+        if not self.num_inst:
+            return (self.name, float("nan"))
+        total = self.sum_metric
+        if isinstance(total, NDArray):
+            total = float(total.asnumpy())
+        return (self.name, total / self.num_inst)
 
 
 @register
@@ -445,7 +470,7 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
         for raw_pred, raw_label in zip(preds, labels):
-            outcome = self._feval(_host(raw_label), _host(raw_pred))
+            outcome = self._feval(_host(raw_label), _host(raw_pred))  # graftlint: disable=G001 — user feval consumes numpy by contract
             if isinstance(outcome, tuple):
                 part, weight = outcome
             else:

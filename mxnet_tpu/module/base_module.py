@@ -185,7 +185,7 @@ class BaseModule:
             if num_batch is not None and i == num_batch:
                 return
             self.forward(batch, is_train=False)
-            keep = lambda o: o[0:o.shape[0] - batch.pad]  # noqa: E731
+            keep = lambda o, _pad=batch.pad: o[0:o.shape[0] - _pad]  # noqa: E731
             yield i, batch, [keep(o) for o in self.get_outputs()]
 
     def score(self, eval_data, eval_metric, num_batch=None,

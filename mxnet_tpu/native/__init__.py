@@ -13,7 +13,7 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
-_LIBS = {}
+_LIBS = {}  # guarded-by: _LOCK
 
 
 # per-library extra compile flags

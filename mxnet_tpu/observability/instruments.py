@@ -40,7 +40,7 @@ __all__ = ["install_jax_hooks", "sample_memory", "record_step",
            "retrace_causes"]
 
 _install_lock = threading.Lock()
-_installed = False
+_installed = False  # guarded-by: _install_lock
 _retrace_log = collections.deque(maxlen=32)
 
 # jax.monitoring event -> short metric stem

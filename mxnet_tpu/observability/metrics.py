@@ -33,7 +33,7 @@ __all__ = ["counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "enabled", "set_enabled", "get_value", "all_instruments"]
 
 _lock = threading.Lock()
-_registry = {}  # name -> instrument
+_registry = {}  # name -> instrument  # guarded-by: _lock
 
 
 def _read_flag():
@@ -290,8 +290,13 @@ def get_value(name, default=None):
 
 
 def all_instruments():
-    """Snapshot of the registry ({name: instrument})."""
-    return dict(_registry)
+    """Snapshot of the registry ({name: instrument}).
+
+    Copied under the registry lock: an unlocked ``dict(_registry)`` can
+    raise "dictionary changed size during iteration" when a recording
+    thread registers a new instrument mid-copy (graftlint G004 finding)."""
+    with _lock:
+        return dict(_registry)
 
 
 def reset_metrics():

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .param import Bool, Float, Int, Shape, Str
+from .param import Bool, Int, Shape
 from .registry import register_op, alias_op
 
 

@@ -7,9 +7,8 @@ handles natively, replacing the hand-written CUDA kernels.
 """
 from __future__ import annotations
 
-import numpy as np
 
-from .param import Bool, Enum, Float, Int
+from .param import Bool, Enum, Int
 from .registry import register_op, alias_op
 
 

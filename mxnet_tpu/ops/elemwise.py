@@ -9,9 +9,8 @@ supplies the gradients the reference wrote by hand.
 """
 from __future__ import annotations
 
-import numpy as np
 
-from .param import Bool, Float, Int, Shape, Str
+from .param import Float, Int
 from .registry import register_op, alias_op
 
 

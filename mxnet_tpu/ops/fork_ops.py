@@ -24,7 +24,7 @@ import math
 import numpy as np
 
 from ..base import MXNetError
-from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .param import Bool, Float, Int
 from .registry import register_op
 
 

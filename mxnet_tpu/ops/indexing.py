@@ -5,9 +5,8 @@ the transpose of gather, which XLA derives automatically.
 """
 from __future__ import annotations
 
-import numpy as np
 
-from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .param import Bool, Float, Int, Shape, Enum, DType
 from .registry import register_op, alias_op
 
 

@@ -6,7 +6,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..base import np_dtype
-from .param import Bool, Float, Int, Shape, Str, DType
+from .param import Float, Int, Shape, Str, DType
 from .registry import register_op
 
 

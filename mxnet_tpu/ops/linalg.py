@@ -10,11 +10,9 @@ decomposition custom calls.
 """
 from __future__ import annotations
 
-import numpy as np
 
-from ..base import MXNetError
-from .param import Bool, Float, Int, Shape, Str, Enum, DType
-from .registry import register_op, alias_op
+from .param import Bool, Float
+from .registry import register_op
 
 
 def _jnp():

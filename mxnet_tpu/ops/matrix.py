@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..base import MXNetError
-from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .param import Bool, Float, Int, Shape, Enum, DType
 from .registry import register_op, alias_op
 
 

@@ -17,7 +17,7 @@ import functools
 import numpy as np
 
 from ..base import MXNetError
-from .param import Bool, Float, Int, Shape, Str, Enum, DType
+from .param import Bool, Float, Int, Shape, Str, Enum
 from .registry import register_op, alias_op
 
 

@@ -10,9 +10,8 @@ the reference ops' in-place state mutation.
 """
 from __future__ import annotations
 
-import numpy as np
 
-from .param import Bool, Float, Int, Shape
+from .param import Bool, Float
 from .registry import register_op
 
 

@@ -12,9 +12,8 @@ Layout: data (T, N, I) ("TNC"), states (L*D, N, H).
 """
 from __future__ import annotations
 
-import numpy as np
 
-from .param import Bool, Enum, Float, Int, Shape
+from .param import Bool, Enum, Float, Int
 from .registry import register_op
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}

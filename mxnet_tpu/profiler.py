@@ -46,8 +46,8 @@ def _env_mode():
 
 
 _state = {"mode": _env_mode(), "filename": "profile.json", "running": False,
-          "paused": False}
-_events = []
+          "paused": False}  # guarded-by: _lock
+_events = []  # guarded-by: _lock
 _lock = threading.Lock()
 _trace_lock = threading.Lock()  # serializes jax device-trace start/stop
 _t0 = time.perf_counter()

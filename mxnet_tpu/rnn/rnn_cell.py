@@ -424,7 +424,7 @@ class FusedRNNCell(BaseRNNCell):
 
         stack = SequentialRNNCell()
         for layer in range(self._num_layers):
-            def cell_for(side):
+            def cell_for(side, layer=layer):  # bind: invoked per iteration
                 return step_cls(self._num_hidden,
                                 prefix="%s%s%d_" % (self._prefix, side,
                                                     layer),

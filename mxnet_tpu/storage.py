@@ -11,7 +11,6 @@ tuning maps to XLA's own ``XLA_PYTHON_CLIENT_MEM_FRACTION``.
 """
 from __future__ import annotations
 
-import numpy as np
 
 from .base import MXNetError
 from .context import Context, current_context

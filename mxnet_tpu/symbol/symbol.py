@@ -14,8 +14,8 @@ import json
 
 import numpy as np
 
-from ..base import AttrScope, MXNetError, NameManager
-from ..ops.registry import OP_REGISTRY, get_op
+from ..base import AttrScope, MXNetError
+from ..ops.registry import get_op
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
@@ -65,7 +65,7 @@ class _Node:
 
     def parsed_attrs(self):
         if self._attrs_cache is None:
-            self._attrs_cache = self.opdef().parse_attrs(self.attrs)
+            self._attrs_cache = self.opdef().parse_attrs(self.attrs)  # graftlint: disable=G003 — idempotent parse memo
         return self._attrs_cache
 
     def num_main_inputs(self):
@@ -422,13 +422,13 @@ class Symbol:
                         merged = _merge_shape(var_shape.get(n.name), s,
                                               n.name)
                         if merged != var_shape.get(n.name):
-                            var_shape[n.name] = merged
+                            var_shape[n.name] = merged  # graftlint: disable=G003 — host shape-inference scratch
                             changed = True
                     else:
                         merged = _merge_shape(entry_shape.get((id(n), i)), s,
                                               "%s[%d]" % (n.name, i))
                         if merged != entry_shape.get((id(n), i)):
-                            entry_shape[(id(n), i)] = merged
+                            entry_shape[(id(n), i)] = merged  # graftlint: disable=G003 — host shape-inference scratch
                             changed = True
 
                 for e, s in zip(node.inputs, list(new_in) + list(new_aux)):

@@ -5,13 +5,16 @@ tolerances, ``assert_almost_equal``, finite-difference
 ``check_numeric_gradient``, ``check_symbolic_forward/backward`` against numpy
 closures, and ``check_consistency`` (same symbol across contexts/dtypes — the
 reference's GPU-vs-CPU pattern reused as TPU-vs-CPU)."""
+# graftlint: disable-file=G001 — numeric checkers compare against host
+# numpy closures by contract; every helper here fetches deliberately
 from __future__ import annotations
 
 import numbers
 
 import numpy as np
 
-from .context import Context, cpu, current_context
+from .base import MXNetError
+from .context import cpu, current_context
 from . import ndarray as nd
 from . import symbol as sym
 from .ndarray import NDArray

@@ -2,7 +2,6 @@
 tests/python/train/test_mlp.py and test_conv.py — train to completion and
 require a hard accuracy bar, not just 'loss went down')."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 

@@ -6,7 +6,7 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
-from mxnet_tpu.models.ssd import get_ssd, tiny_features, SSD300_SIZES
+from mxnet_tpu.models.ssd import get_ssd, tiny_features
 
 
 def test_multibox_prior_values():

@@ -3,7 +3,6 @@ tests/nightly/dist_sync_kvstore.py pattern: N local processes (here wired by
 jax.distributed over the CPU backend instead of ps-lite ZMQ), asserting
 dist_sync push/pull semantics and sync-SGD parity with single-process."""
 import os
-import subprocess
 import sys
 import textwrap
 

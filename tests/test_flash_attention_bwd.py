@@ -8,7 +8,6 @@ no T x T tensor survives the forward."""
 import numpy as np
 import pytest
 
-import mxnet_tpu as mx  # noqa: F401  (registers ops; keeps import order)
 from mxnet_tpu import config
 
 

@@ -2,7 +2,6 @@
 tests/python/train/test_spn.py, test_scn.py, test_nAvg.py — python
 ground-truth reimplementations compared against the ops, plus
 finite-difference gradient checks)."""
-import math
 
 import numpy as np
 import pytest

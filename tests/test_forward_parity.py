@@ -9,7 +9,6 @@ the network forward is checked against an independent numpy/torch
 re-implementation.
 """
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.model import convert_conv_weight_layout

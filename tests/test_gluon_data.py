@@ -2,7 +2,6 @@
 import numpy as np
 import pytest
 
-import mxnet_tpu as mx
 from mxnet_tpu import gluon
 
 

@@ -1,6 +1,5 @@
 """Gluon RNN cells + layers (reference: tests/python/unittest/test_gluon_rnn.py)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon

@@ -3,7 +3,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu.io import NDArrayIter, ResizeIter, PrefetchingIter, DataBatch
+from mxnet_tpu.io import NDArrayIter, ResizeIter, PrefetchingIter
 
 
 def test_ndarray_iter_basic():

@@ -2,11 +2,10 @@
 (reference patterns: tests/python/unittest/test_operator.py test_laop*,
 test_stn, test_bilinear_sampler, test_svmoutput)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
-from mxnet_tpu.test_utils import check_numeric_gradient, check_symbolic_forward
+from mxnet_tpu.test_utils import check_numeric_gradient
 
 
 def _rs(seed=0):

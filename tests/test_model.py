@@ -2,7 +2,6 @@
 tests/python/train/test_mlp.py drives FeedForward.create/fit and asserts
 final accuracy; python/mxnet/model.py:434)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 

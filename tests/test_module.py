@@ -1,7 +1,6 @@
 """Module API + convergence (reference: tests/python/unittest/test_module.py,
 tests/python/train/test_mlp.py, test_conv.py)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.test_utils import assert_almost_equal

@@ -4,7 +4,6 @@ test_operator.py runs randomized shape sweeps per op; this is the
 deterministic-fuzz equivalent — 300+ cases/run, fully reproducible).
 """
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 

@@ -4,9 +4,10 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
-                                  check_symbolic_forward,
-                                  check_symbolic_backward)
+from mxnet_tpu.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+    check_symbolic_forward)
 
 
 def test_fully_connected():

@@ -2,7 +2,6 @@
 pattern: tests/python/unittest/test_random.py — verify sample mean/var
 against the distribution's analytic moments, not just shapes/dtypes)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 

@@ -1,6 +1,5 @@
 """Symbolic mx.rnn toolkit (reference: tests/python/unittest/test_rnn.py)."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 

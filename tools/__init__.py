@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.graftlint` works from the repo
+# root; the standalone scripts in here still run directly as before.

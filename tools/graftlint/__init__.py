@@ -1,0 +1,24 @@
+"""graftlint — JAX/TPU-aware static analysis for the mxnet_tpu frontend.
+
+Rules (see docs/static_analysis.md for the full catalog):
+
+* **G001 host-sync** — device->host transfers (``asnumpy``/``item``/
+  ``asscalar``/``tolist``, ``np.asarray`` under trace) in loops, in
+  traced functions, or in anything reachable from a jit entry point via
+  the call graph.
+* **G002 retrace hazard** — Python branches on traced values, jit
+  construction in loops, mutable ``static_argnums``, closure capture of
+  host scalars/arrays in jitted functions.
+* **G003 traced side effects** — wall clocks, host RNG, prints, and
+  global/attribute mutation inside traced code.
+* **G004 lock discipline** — state annotated ``# guarded-by: <lock>``
+  mutated (or copy/iterated) outside a ``with <lock>:`` block.
+
+Silence a single line with ``# graftlint: disable=G00x``; accept
+pre-existing findings via ``tools/graftlint/baseline.json`` (every entry
+carries a one-line justification).
+"""
+from .cli import build_report, main
+from .core import RULES, Violation
+
+__all__ = ["build_report", "main", "RULES", "Violation"]

@@ -1,0 +1,299 @@
+"""graftlint call graph: which functions run under a JAX trace?
+
+G001/G003 must flag host syncs and side effects not only in functions
+literally passed to ``jax.jit`` but in anything those functions call —
+the executor's jitted closures delegate the whole graph walk to
+``_GraphProgram._eval``, and a sync buried there would poison every
+compiled program in the framework.
+
+The graph is intentionally lightweight and name-based:
+
+* **nodes** — every def/lambda in the analyzed fileset, with a qualname
+  like ``mxnet_tpu/executor.py::_GraphProgram.train_fn.<locals>.f``;
+* **traced entries** — functions passed (as a bare name or lambda) to a
+  jit-family wrapper (``jax.jit``, ``_maybe_jit``, ``pmap``, ``vjp``,
+  ``grad``, ``value_and_grad``, ``checkpoint``, ``shard_map``,
+  ``pallas_call``, ``custom_vjp`` …), decorated with one, or named
+  ``hybrid_forward`` (traced on hybridize);
+* **edges** — resolved conservatively: a bare-name call binds to the
+  lexically nearest def, else to a package-unique function of that name;
+  ``self.m()`` binds within the enclosing class, else falls through the
+  same chain. Ambiguous names get NO edge — a missed edge costs a
+  finding, a wrong edge costs a false positive, and false positives are
+  what kill linters.
+
+The same index powers the one-hop sync propagation G001 uses: a function
+whose body host-syncs marks every resolved caller-in-a-loop.
+"""
+from __future__ import annotations
+
+import ast
+
+# Everything that traces a function argument (entry-point detection):
+# wrapper CONSTRUCTORS that return a cached compiled callable, plus
+# application-style transforms/control-flow that trace their operand in
+# place (lax.scan, grad(f)(x), ...).
+JIT_CONSTRUCTORS = {
+    "jit", "pmap", "pallas_call", "shard_map", "_maybe_jit",
+}
+JIT_WRAPPERS = JIT_CONSTRUCTORS | {
+    "vmap", "grad", "value_and_grad", "vjp", "jvp",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "scan", "while_loop", "fori_loop", "cond", "switch",
+}
+
+TRACED_METHOD_NAMES = {"hybrid_forward"}
+
+_DEF_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_BUILTIN_NAMES = frozenset(dir(__import__("builtins")))
+
+
+def call_kind(call):
+    """'self' for self.m()/cls.m(), 'attr' for x.m(), 'bare' for m()."""
+    if isinstance(call.func, ast.Attribute):
+        if isinstance(call.func.value, ast.Name) \
+                and call.func.value.id in ("self", "cls"):
+            return "self"
+        return "attr"
+    return "bare"
+
+
+def callee_name(call):
+    """The simple name a Call dispatches on ('f' for f(...) and x.f(...)),
+    or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def is_jit_wrapper_call(call):
+    """Is this Call one of the jit-family wrappers?"""
+    name = callee_name(call)
+    if name in JIT_WRAPPERS:
+        return True
+    # functools.partial(jax.jit, ...) used as decorator/wrapper
+    if name == "partial" and call.args:
+        inner = call.args[0]
+        if isinstance(inner, (ast.Name, ast.Attribute)):
+            attr = inner.id if isinstance(inner, ast.Name) else inner.attr
+            return attr in JIT_WRAPPERS
+    return False
+
+
+class FuncInfo:
+    """One def/lambda node plus resolution context."""
+
+    __slots__ = ("node", "name", "qualname", "path", "cls", "parent",
+                 "calls", "traced_entry")
+
+    def __init__(self, node, name, qualname, path, cls, parent):
+        self.node = node
+        self.name = name
+        self.qualname = qualname
+        self.path = path
+        self.cls = cls              # enclosing ClassDef name or None
+        self.parent = parent        # enclosing FuncInfo or None
+        self.calls = []             # (simple_name, kind: bare|attr|self)
+        self.traced_entry = False
+
+
+def own_nodes(fi, by_node):
+    """Yield the AST nodes belonging to fi's own body — pruning the
+    subtrees of nested defs/lambdas (they are their own FuncInfo)."""
+    stack = [fi.node]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            sub = by_node.get(child)
+            if sub is not None and sub is not fi and child is not fi.node:
+                continue
+            stack.append(child)
+
+
+class CallGraph:
+    """Function index + traced-reachability over a set of SourceFiles."""
+
+    def __init__(self):
+        self.functions = []         # all FuncInfo
+        self.by_node = {}           # ast node -> FuncInfo
+        self._by_name = {}          # simple name -> [FuncInfo]
+        self._traced = None
+        self._finalized = False
+
+    # --- pass 1: indexing -------------------------------------------------
+    def add_file(self, sf):
+        self._index_scope(sf, sf.tree, prefix="", cls=None, parent=None)
+
+    def _index_scope(self, sf, node, prefix, cls, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (prefix + "." + child.name) if prefix else child.name
+                fi = self._register(sf, child, child.name, qual, cls, parent)
+                self._index_scope(sf, child, qual + ".<locals>", cls, fi)
+            elif isinstance(child, ast.Lambda):
+                qual = (prefix or "<module>") + ".<lambda>"
+                fi = self._register(sf, child, "<lambda>", qual, cls, parent)
+                self._index_scope(sf, child, qual, cls, fi)
+            elif isinstance(child, ast.ClassDef):
+                qual = (prefix + "." + child.name) if prefix else child.name
+                self._index_scope(sf, child, qual, child.name, parent)
+            else:
+                self._index_scope(sf, child, prefix, cls, parent)
+
+    def _register(self, sf, node, name, qual, cls, parent):
+        fi = FuncInfo(node, name, sf.path + "::" + qual, sf.path, cls,
+                      parent)
+        self.functions.append(fi)
+        self.by_node[node] = fi
+        self._by_name.setdefault(name, []).append(fi)
+        if name in TRACED_METHOD_NAMES:
+            fi.traced_entry = True
+        return fi
+
+    # --- pass 2: edges + entry marking (after ALL files indexed) ----------
+    def finalize(self):
+        if self._finalized:
+            return
+        self._finalized = True
+        for fi in self.functions:
+            for node in own_nodes(fi, self.by_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = callee_name(node)
+                if name is not None:
+                    fi.calls.append((name, call_kind(node)))
+                if is_jit_wrapper_call(node):
+                    self._mark_jit_args(fi, node)
+        # functions decorated with a jit wrapper are entries
+        for fi in self.functions:
+            for deco in getattr(fi.node, "decorator_list", []):
+                if isinstance(deco, (ast.Name, ast.Attribute)):
+                    attr = (deco.id if isinstance(deco, ast.Name)
+                            else deco.attr)
+                    if attr in JIT_WRAPPERS:
+                        fi.traced_entry = True
+                elif isinstance(deco, ast.Call) and is_jit_wrapper_call(deco):
+                    fi.traced_entry = True
+
+    def _mark_jit_args(self, fi, call):
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name):
+                target = self._resolve_local(fi, arg.id)
+                if target is not None:
+                    target.traced_entry = True
+            elif isinstance(arg, ast.Lambda):
+                sub = self.by_node.get(arg)
+                if sub is not None:
+                    sub.traced_entry = True
+
+    # --- resolution -------------------------------------------------------
+    def _resolve_local(self, fi, name):
+        """Nearest def named `name` whose parent is on fi's scope chain
+        (fi itself first), else a module-level def in the same file."""
+        scope = fi
+        while scope is not None:
+            for cand in self._by_name.get(name, ()):
+                if cand.parent is scope:
+                    return cand
+            scope = scope.parent
+        for cand in self._by_name.get(name, ()):
+            if cand.path == fi.path and cand.parent is None \
+                    and cand.cls is None:
+                return cand
+        return None
+
+    def resolve(self, fi, name, kind):
+        """Call edge resolution (see module docstring); None if ambiguous.
+
+        ``kind``: 'self' binds within the class first; 'bare' never binds
+        to a method or a builtin shadow (a bare ``setattr(...)`` must not
+        link to some class's ``setattr`` method); 'attr' binds to a
+        package-unique def of that name."""
+        if kind == "self" and fi.cls is not None:
+            same_class = [c for c in self._by_name.get(name, ())
+                          if c.cls == fi.cls and c.path == fi.path]
+            if len(same_class) == 1:
+                return same_class[0]
+        if kind == "bare":
+            local = self._resolve_local(fi, name)
+            if local is not None:
+                return local
+            if name in _BUILTIN_NAMES:
+                return None
+            cands = [c for c in self._by_name.get(name, ())
+                     if c.cls is None]
+        else:
+            cands = self._by_name.get(name, ())
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    # --- reachability -----------------------------------------------------
+    def traced_set(self):
+        """All functions reachable from traced entries (entries included),
+        plus defs lexically nested inside traced functions."""
+        if self._traced is not None:
+            return self._traced
+        self.finalize()
+        work = [fi for fi in self.functions if fi.traced_entry]
+        traced = set(work)
+        self.traced_via = {fi: None for fi in work}  # child -> caller
+        while work:
+            fi = work.pop()
+            for name, kind in fi.calls:
+                target = self.resolve(fi, name, kind)
+                if target is not None and target not in traced:
+                    traced.add(target)
+                    self.traced_via[target] = fi
+                    work.append(target)
+        for fi in self.functions:
+            anc = fi.parent
+            while anc is not None:
+                if anc in traced:
+                    traced.add(fi)
+                    self.traced_via.setdefault(fi, anc)
+                    break
+                anc = anc.parent
+        self._traced = traced
+        return traced
+
+    def explain_traced(self, qualname_substr):
+        """Call chains from jit entries to matching functions — the
+        --why debugging aid."""
+        self.traced_set()
+        lines = []
+        for fi in self._traced:
+            if qualname_substr not in fi.qualname:
+                continue
+            chain = [fi]
+            while self.traced_via.get(chain[-1]) is not None:
+                chain.append(self.traced_via[chain[-1]])
+            lines.append(" <- ".join(c.qualname for c in chain))
+        return lines
+
+    def sync_closure(self, direct_sync_funcs):
+        """Functions that transfer device->host, directly or through any
+        resolvable callee (fixpoint over the graph).
+
+        ``direct_sync_funcs``: set of FuncInfo whose bodies contain a
+        literal sync call (computed by the G001 rule)."""
+        self.finalize()
+        syncing = set(direct_sync_funcs)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions:
+                if fi in syncing:
+                    continue
+                for name, kind in fi.calls:
+                    target = self.resolve(fi, name, kind)
+                    if target in syncing:
+                        syncing.add(fi)
+                        changed = True
+                        break
+        return syncing

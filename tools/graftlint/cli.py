@@ -1,0 +1,165 @@
+"""graftlint CLI.
+
+Typical invocations::
+
+    # gate: fail only on violations not in the committed baseline
+    python -m tools.graftlint mxnet_tpu --baseline tools/graftlint/baseline.json
+
+    # audit: list everything, including baselined findings
+    python -m tools.graftlint mxnet_tpu --all
+
+    # accept the current state (then edit the justifications!)
+    python -m tools.graftlint mxnet_tpu --baseline ... --write-baseline
+
+Exit codes: 0 clean (vs baseline), 1 new violations (or parse errors),
+2 bad usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import core
+from .callgraph import CallGraph
+from .rules import RULES_DOC, run_rules
+
+
+def build_report(paths, select=None, root=None):
+    """Analyze paths -> (violations, parse_errors, file_count).
+
+    Paths are stored relative to ``root`` (default: the current working
+    directory) when they live under it, so fingerprints match the
+    committed baseline no matter how the target was spelled on the
+    command line."""
+    root = root or os.getcwd()
+    files = []
+    errors = []
+    for path in core.collect_files(paths):
+        rel = os.path.relpath(path, root)
+        try:
+            files.append(core.SourceFile(
+                path, root=None if rel.startswith("..") else root))
+        except SyntaxError as err:
+            errors.append("%s: syntax error: %s" % (path, err))
+    graph = CallGraph()
+    for sf in files:
+        graph.add_file(sf)
+    violations = run_rules(files, graph, select=select)
+    violations = core.apply_suppressions(
+        violations, {sf.path: sf.lines for sf in files})
+    core.finalize_fingerprints(violations)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations, errors, len(files)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX/TPU-aware static analysis for mxnet_tpu "
+                    "(rules: %s)" % ", ".join(sorted(core.RULES)))
+    ap.add_argument("paths", nargs="+", help="files or directories")
+    ap.add_argument("--baseline", help="baseline.json of accepted findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to accept the current state "
+                         "(existing justifications are kept)")
+    ap.add_argument("--select", help="comma list of rules (default: all)")
+    ap.add_argument("--all", action="store_true",
+                    help="list baselined findings too, not just new ones")
+    ap.add_argument("--report", help="write a JSON report to this path")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print the catalog entry for one rule and exit")
+    ap.add_argument("--why", metavar="QUALNAME",
+                    help="show the call chain(s) that make matching "
+                         "functions traced, then exit")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.explain:
+        doc = RULES_DOC.get(args.explain.upper())
+        if doc is None:
+            print("unknown rule %r (have: %s)"
+                  % (args.explain, ", ".join(sorted(core.RULES))))
+            return 2
+        print(doc)
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip().upper() for r in args.select.split(",")}
+        unknown = select - set(core.RULES)
+        if unknown:
+            print("unknown rules: %s" % ", ".join(sorted(unknown)),
+                  file=sys.stderr)
+            return 2
+
+    if args.why:
+        files = [core.SourceFile(p) for p in core.collect_files(args.paths)]
+        graph = CallGraph()
+        for sf in files:
+            graph.add_file(sf)
+        chains = graph.explain_traced(args.why)
+        print("\n".join(chains) if chains
+              else "no traced function matches %r" % args.why)
+        return 0
+
+    violations, errors, n_files = build_report(args.paths, select=select)
+
+    baseline = core.load_baseline(args.baseline)
+    if args.write_baseline:
+        if not args.baseline:
+            print("--write-baseline requires --baseline", file=sys.stderr)
+            return 2
+        keep = {fp: e.get("justification", "")
+                for fp, e in baseline.items()}
+        # under --select, rules outside the selection were not analyzed:
+        # carry their accepted entries through unchanged instead of
+        # silently deleting them
+        carried = ([e for e in baseline.values()
+                    if e.get("rule") not in select] if select else [])
+        n = core.save_baseline(args.baseline, violations, keep,
+                               extra_entries=carried)
+        print("wrote %d entries to %s" % (n, args.baseline))
+        return 0
+
+    new, accepted, stale = core.diff_baseline(violations, baseline)
+
+    if args.report:
+        payload = {
+            "files": n_files,
+            "errors": errors,
+            "new": [v.to_dict() for v in new],
+            "baselined": [v.to_dict() for v in accepted],
+            "stale_baseline_fingerprints": stale,
+        }
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+
+    for err in errors:
+        print(err, file=sys.stderr)
+    shown = violations if args.all else new
+    for v in shown:
+        tag = "" if v.fingerprint not in baseline else " (baselined)"
+        if not args.quiet or not tag:
+            print(v.format() + tag)
+    if not args.quiet:
+        per_rule = {}
+        for v in violations:
+            per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+        summary = " ".join("%s=%d" % kv for kv in sorted(per_rule.items()))
+        print("graftlint: %d files, %d finding(s) [%s], %d new, "
+              "%d baselined%s"
+              % (n_files, len(violations), summary or "-", len(new),
+                 len(accepted),
+                 ", %d stale baseline entr(ies)" % len(stale)
+                 if stale else ""))
+        if stale:
+            print("  (stale entries no longer match any finding — prune "
+                  "them with --write-baseline)")
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

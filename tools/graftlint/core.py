@@ -1,0 +1,242 @@
+"""graftlint core: violations, suppressions, baseline handling.
+
+The analyzer reports :class:`Violation` records. Each violation carries a
+*fingerprint* that is stable under unrelated edits (it hashes the rule,
+file, enclosing scope, and the offending source line — NOT the line
+number), so a committed baseline keeps matching while the file above a
+finding churns.
+
+Suppression layers, from most to least targeted:
+
+1. inline  — ``# graftlint: disable=G001`` (comma-list) on the offending
+   line silences those rules for that line;
+2. baseline — ``baseline.json`` records accepted pre-existing findings
+   (with a one-line justification each); the CLI fails only on
+   violations whose fingerprint is absent from the baseline.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+
+RULES = {
+    "G001": "host-sync: device->host transfer in a loop or traced code",
+    "G002": "retrace hazard: data-dependent branch / per-value compile",
+    "G003": "side effect inside traced code",
+    "G004": "lock discipline: guarded state touched outside its lock",
+}
+
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Z0-9,\s]+)")
+_FILE_DIRECTIVE_WINDOW = 30  # disable-file must appear near the top
+
+
+class Violation:
+    """One finding: rule + location + message + stable fingerprint."""
+
+    __slots__ = ("rule", "path", "line", "col", "scope", "message",
+                 "snippet", "fingerprint")
+
+    def __init__(self, rule, path, line, col, scope, message, snippet):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.scope = scope or "<module>"
+        self.message = message
+        self.snippet = snippet.strip()
+        self.fingerprint = None  # assigned by finalize_fingerprints
+
+    def key(self):
+        """Identity under line drift (fingerprint input, minus the
+        duplicate-occurrence index)."""
+        return (self.rule, self.path, self.scope, self.snippet)
+
+    def format(self):
+        return "%s:%d:%d: %s [%s] %s" % (
+            self.path, self.line, self.col, self.rule, self.scope,
+            self.message)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "scope": self.scope,
+                "message": self.message, "snippet": self.snippet,
+                "fingerprint": self.fingerprint}
+
+
+def finalize_fingerprints(violations):
+    """Assign stable fingerprints; identical (rule, path, scope, snippet)
+    tuples are disambiguated by their in-file occurrence index, so two
+    textually identical findings in one function stay distinct without
+    depending on absolute line numbers."""
+    seen = {}
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.col)):
+        k = v.key()
+        idx = seen.get(k, 0)
+        seen[k] = idx + 1
+        raw = "|".join((v.rule, v.path, v.scope, v.snippet, str(idx)))
+        v.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+    return violations
+
+
+def suppressed_lines(source_lines):
+    """{lineno: set(rules)} from inline ``# graftlint: disable=...``."""
+    out = {}
+    for i, line in enumerate(source_lines, 1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def file_suppressions(source_lines):
+    """Rules disabled for the whole file via ``# graftlint:
+    disable-file=G00x`` in the file's top comment block."""
+    out = set()
+    for line in source_lines[:_FILE_DIRECTIVE_WINDOW]:
+        m = _DISABLE_FILE_RE.search(line)
+        if m:
+            out |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def apply_suppressions(violations, source_lines_by_path):
+    """Drop violations silenced by an inline directive on their line or a
+    file-level ``disable-file`` directive."""
+    kept = []
+    supp_cache = {}
+    for v in violations:
+        if v.path not in supp_cache:
+            lines = source_lines_by_path.get(v.path, ())
+            supp_cache[v.path] = (suppressed_lines(lines),
+                                  file_suppressions(list(lines)))
+        per_line, per_file = supp_cache[v.path]
+        if v.rule in per_file or v.rule in per_line.get(v.line, ()):
+            continue
+        kept.append(v)
+    return kept
+
+
+# --- source collection ----------------------------------------------------
+
+def collect_files(paths):
+    """Expand files/directories into a sorted list of .py files."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(root, f) for f in files
+                           if f.endswith(".py"))
+    return sorted(set(out))
+
+
+class SourceFile:
+    """Parsed module + the per-node parent map the rules navigate with."""
+
+    def __init__(self, path, root=None):
+        self.path = os.path.relpath(path, root) if root else path
+        with open(path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        self.parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def ancestors(self, node):
+        """node's enclosing chain, innermost first."""
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+    def enclosing_function(self, node):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    def in_loop(self, node):
+        """Is node inside a for/while body within its own function scope?
+        (A loop in an *outer* function does not count — the inner def is
+        its own dispatch unit.)"""
+        fn = self.enclosing_function(node)
+        for anc in self.ancestors(node):
+            if anc is fn:
+                return False
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+        return False
+
+    def snippet(self, node):
+        line = getattr(node, "lineno", 0)
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+# --- baseline -------------------------------------------------------------
+
+def load_baseline(path):
+    """baseline.json -> {fingerprint: entry-dict}. Missing file = empty."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def save_baseline(path, violations, justifications=None,
+                  extra_entries=None):
+    """Write every current violation as an accepted baseline entry.
+    ``justifications``: {fingerprint: text} to carry through (entries
+    without one get a placeholder a human is expected to edit).
+    ``extra_entries``: pre-existing entry dicts to preserve verbatim
+    (rules excluded from the current run). Returns the entry count."""
+    justifications = justifications or {}
+    entries = []
+    for v in sorted(violations, key=lambda v: (v.path, v.line)):
+        entries.append({
+            "fingerprint": v.fingerprint,
+            "rule": v.rule,
+            "path": v.path,
+            "scope": v.scope,
+            "snippet": v.snippet,
+            "justification": justifications.get(
+                v.fingerprint, "TODO: justify or fix"),
+        })
+    seen = {e["fingerprint"] for e in entries}
+    for e in (extra_entries or []):
+        if e.get("fingerprint") not in seen:
+            entries.append(e)
+    entries.sort(key=lambda e: (e.get("path", ""), e.get("rule", ""),
+                                e.get("scope", "")))
+    payload = {"version": 1, "entries": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(entries)
+
+
+def diff_baseline(violations, baseline):
+    """Split into (new, accepted, stale_fingerprints)."""
+    new, accepted = [], []
+    live = set()
+    for v in violations:
+        if v.fingerprint in baseline:
+            accepted.append(v)
+            live.add(v.fingerprint)
+        else:
+            new.append(v)
+    stale = [fp for fp in baseline if fp not in live]
+    return new, accepted, stale

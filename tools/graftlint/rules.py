@@ -1,0 +1,659 @@
+"""graftlint rules G001-G004.
+
+Each rule is a function ``(sf, graph, ctx) -> [Violation]`` over one
+parsed :class:`~tools.graftlint.core.SourceFile`, with the cross-file
+call graph for reachability questions. Rules are deliberately
+conservative: an ambiguous name gets no finding. The catalog (with fix
+patterns) lives in docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .callgraph import (JIT_CONSTRUCTORS, call_kind, callee_name,
+                        is_jit_wrapper_call, own_nodes)
+from .core import Violation
+
+# device->host sync method names on NDArray/jax values
+SYNC_ATTRS = {"asnumpy", "asscalar", "item", "tolist"}
+
+# mutating container-method names (G004)
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+            "clear", "update", "setdefault", "add", "discard", "sort",
+            "reverse"}
+
+# whole-container copy/iteration constructors (G004 racy-read shapes:
+# these raise "changed size during iteration" under concurrent mutation)
+COPIERS = {"dict", "list", "tuple", "set", "sorted", "frozenset"}
+
+# host-side impure calls banned under a trace (G003); matched against the
+# unparsed callee prefix
+IMPURE_PREFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "datetime.now", "datetime.datetime.now",
+    "np.random.", "numpy.random.", "random.",
+)
+IMPURE_NAMES = {"print", "input", "setattr", "delattr", "open"}
+
+# calls producing NDArray handles (G002 closure-capture classification)
+NDARRAY_PRODUCERS = {"_from_data", "array", "zeros", "ones", "full",
+                     "data", "list_data"}
+
+# G004 annotation: trailing comment, lock is a dotted identifier
+_GUARDED_BY_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z_0-9]*(?:\.[A-Za-z_][A-Za-z_0-9]*)*)\s*$")
+
+
+def _scope_of(sf, graph, node):
+    fn = sf.enclosing_function(node)
+    if fn is None:
+        return None, "<module>"
+    fi = graph.by_node.get(fn)
+    if fi is None:
+        return None, "<module>"
+    return fi, fi.qualname.split("::", 1)[1]
+
+
+def _v(rule, sf, node, scope, message):
+    return Violation(rule, sf.path, getattr(node, "lineno", 1),
+                     getattr(node, "col_offset", 0), scope, message,
+                     sf.snippet(node))
+
+
+def _unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def direct_sync_funcs(graph):
+    """FuncInfos whose own body contains a literal sync call."""
+    out = set()
+    for fi in graph.functions:
+        for node in own_nodes(fi, graph.by_node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_ATTRS:
+                out.add(fi)
+                break
+    return out
+
+
+# --- G001: host sync ------------------------------------------------------
+
+def check_g001(sf, graph, ctx):
+    out = []
+    traced = ctx["traced"]
+    syncing = ctx["syncing"]
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fi, scope = _scope_of(sf, graph, node)
+        in_trace = fi in traced
+        fname = callee_name(node)
+        # direct sync method call: X.asnumpy() / X.item() / ...
+        if isinstance(node.func, ast.Attribute) and fname in SYNC_ATTRS:
+            if in_trace:
+                out.append(_v("G001", sf, node, scope,
+                              ".%s() forces a device->host transfer inside "
+                              "traced code; return the array and fetch "
+                              "outside the compiled function" % fname))
+            elif sf.in_loop(node):
+                out.append(_v("G001", sf, node, scope,
+                              ".%s() inside a loop: one blocking "
+                              "device->host transfer per iteration; batch "
+                              "on device and fetch once after the loop"
+                              % fname))
+            continue
+        # np.asarray(x.asnumpy()) — the transfer already yields numpy
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "asarray" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in ("np", "numpy"):
+            if node.args and isinstance(node.args[0], ast.Call) \
+                    and isinstance(node.args[0].func, ast.Attribute) \
+                    and node.args[0].func.attr == "asnumpy" \
+                    and len(node.keywords) == 0:
+                out.append(_v("G001", sf, node, scope,
+                              "redundant np.asarray() around .asnumpy(): "
+                              "the transfer already returns a numpy array"))
+                continue
+            if in_trace:
+                out.append(_v("G001", sf, node, scope,
+                              "np.asarray() materializes the value on host "
+                              "inside traced code; use jnp"))
+                continue
+        # float(X.asscalar()) — the sync call inside is already flagged;
+        # float()/int() of bare params is NOT checked: parameters of
+        # traced functions routinely carry static host config (scale
+        # factors, axis numbers) and a type-blind check drowns the rule.
+        # call into a function that (transitively) syncs, from a loop or
+        # traced context
+        if fi is not None and fname is not None:
+            target = graph.resolve(fi, fname, call_kind(node))
+            if target is not None and target in syncing and target is not fi:
+                if in_trace:
+                    out.append(_v("G001", sf, node, scope,
+                                  "%s() transfers device->host (via %s) "
+                                  "inside traced code"
+                                  % (fname, target.qualname)))
+                elif sf.in_loop(node):
+                    out.append(_v("G001", sf, node, scope,
+                                  "%s() transfers device->host (via %s) "
+                                  "inside a loop; keep the reduction on "
+                                  "device and fetch once"
+                                  % (fname, target.qualname)))
+    return out
+
+
+def _param_names(fn_node):
+    a = fn_node.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    return set(params)
+
+
+def _params_without_defaults(fn_node):
+    """Positional params with no default — the ones that carry traced
+    values (defaulted params are configuration baked at def time)."""
+    a = fn_node.args
+    pos = a.posonlyargs + a.args
+    n_default = len(a.defaults)
+    take = pos[:len(pos) - n_default] if n_default else pos
+    return [p.arg for p in take]
+
+
+# --- G002: retrace hazards ------------------------------------------------
+
+def _cache_guarded(sf, node):
+    """Is this jit call under an `if key not in cache:` style guard?"""
+    for anc in sf.ancestors(node):
+        if isinstance(anc, ast.If):
+            for sub in ast.walk(anc.test):
+                if isinstance(sub, ast.Compare) and any(
+                        isinstance(op, (ast.NotIn, ast.In))
+                        for op in sub.ops):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            break
+    return False
+
+
+def _is_jit_constructor(call):
+    """Does this call build a cached compiled callable (vs applying a
+    transform in place)? partial(jax.jit, ...) counts."""
+    name = callee_name(call)
+    if name in JIT_CONSTRUCTORS:
+        return True
+    if name == "partial" and call.args:
+        inner = call.args[0]
+        if isinstance(inner, (ast.Name, ast.Attribute)):
+            attr = inner.id if isinstance(inner, ast.Name) else inner.attr
+            return attr in JIT_CONSTRUCTORS
+    return False
+
+
+def check_g002(sf, graph, ctx):
+    out = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and is_jit_wrapper_call(node):
+            fi, scope = _scope_of(sf, graph, node)
+            # (a) fresh jit wrapper built per loop iteration — only for
+            # CONSTRUCTORS that carry a compile cache; application-style
+            # transforms (lax.scan, cond, grad(f)(x)) trace in place and
+            # are fine inside host loops
+            if _is_jit_constructor(node) and sf.in_loop(node) \
+                    and not _cache_guarded(sf, node):
+                out.append(_v("G002", sf, node, scope,
+                              "%s() constructed inside a loop: a fresh "
+                              "compile cache per iteration; hoist or "
+                              "memoize the jitted callable"
+                              % callee_name(node)))
+            # (b) mutable static_argnums / static_argnames
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") \
+                        and isinstance(kw.value,
+                                       (ast.List, ast.Set, ast.Dict)):
+                    out.append(_v("G002", sf, node, scope,
+                                  "%s as a mutable %s literal; use a tuple "
+                                  "(shared aliasing of the spec is a "
+                                  "silent-retrace footgun)"
+                                  % (kw.arg,
+                                     type(kw.value).__name__.lower())))
+            # (c) closure capture of host scalars / NDArrays
+            if fi is not None:
+                out.extend(_check_closure_capture(sf, graph, fi, scope,
+                                                  node))
+    # (d) data-dependent python branches in traced entry functions
+    for fi in graph.functions:
+        if fi.path != sf.path or not fi.traced_entry:
+            continue
+        out.extend(_check_tracer_branches(sf, graph, fi))
+    return out
+
+
+def _check_closure_capture(sf, graph, fi, scope, jit_call):
+    """Names free in a locally-defined jitted function that the enclosing
+    scope binds to host scalars (float()/int()) or NDArray handles bake
+    into the compiled program: a new value means a full recompile (scalars)
+    or a stale constant (arrays)."""
+    out = []
+    assigns = {}
+    for node in own_nodes(fi, graph.by_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            assigns[node.targets[0].id] = node.value
+    for arg in list(jit_call.args) + [kw.value for kw in jit_call.keywords]:
+        target = None
+        if isinstance(arg, ast.Name):
+            target = graph._resolve_local(fi, arg.id)
+        elif isinstance(arg, ast.Lambda):
+            target = graph.by_node.get(arg)
+        if target is None:
+            continue
+        bound = _bound_names(target.node)
+        for sub in own_nodes(target, graph.by_node):
+            if not (isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)):
+                continue
+            name = sub.id
+            if name in bound or name not in assigns:
+                continue
+            src = assigns[name]
+            src_name = callee_name(src)
+            if src_name in ("float", "int"):
+                out.append(_v("G002", sf, sub, scope,
+                              "jitted %r closure-captures host scalar %r: "
+                              "every new value compiles a new program; "
+                              "pass it as a traced argument"
+                              % (target.name, name)))
+                bound.add(name)  # one finding per captured name
+            elif src_name in NDARRAY_PRODUCERS:
+                out.append(_v("G002", sf, sub, scope,
+                              "jitted %r closure-captures array %r: it "
+                              "bakes in as a constant (stale data, "
+                              "recompile on change); pass it as an "
+                              "argument" % (target.name, name)))
+                bound.add(name)
+    return out
+
+
+def _bound_names(fn_node):
+    bound = set(_param_names(fn_node))
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn_node:
+                bound.add(node.name)
+    return bound
+
+
+_EXEMPT_TEST_CALLS = {"isinstance", "len", "hasattr", "getattr",
+                      "callable", "issubclass"}
+
+
+def _check_tracer_branches(sf, graph, fi):
+    """Python `if`/`while` on a positional (traced) parameter of a
+    traced-entry function: concretizes the tracer (error under jit) or
+    forces a specialization per value (hybrid_forward shape branches)."""
+    out = []
+    node = fi.node
+    if isinstance(node, ast.Lambda):
+        return out
+    # self/cls never carry tracers; F is hybrid_forward's symbol-module
+    flagged = [p for p in _params_without_defaults(node)
+               if p not in ("self", "cls", "F")]
+    if not flagged:
+        return out
+    scope = fi.qualname.split("::", 1)[1]
+    for sub in own_nodes(fi, graph.by_node):
+        if not isinstance(sub, (ast.If, ast.While, ast.IfExp)):
+            continue
+        test = sub.test
+        hit = _tracer_operand(test, set(flagged), fi.name)
+        if hit is None:
+            continue
+        kind, name = hit
+        if kind == "shape":
+            out.append(_v("G002", sf, sub, scope,
+                          "branch on %s.shape inside %r: every new input "
+                          "shape specializes (retraces) the cached "
+                          "program; pad/bucket shapes or move the branch "
+                          "to bind time" % (name, fi.name)))
+        else:
+            out.append(_v("G002", sf, sub, scope,
+                          "python branch on traced parameter %r in %r: "
+                          "concretizes under jit (TracerBoolConversion"
+                          "Error) or silently retraces per value; use "
+                          "jnp.where/lax.cond" % (name, fi.name)))
+    return out
+
+
+def _tracer_operand(test, params, fn_name):
+    """(kind, param) if the test hinges on a traced param; else None.
+    `is None` identity checks, isinstance/len/hasattr guards, and
+    attribute reads other than .shape are exempt (static under trace)."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return None  # identity check on optionals: static
+        if isinstance(node, ast.Call):
+            cn = callee_name(node)
+            if cn in _EXEMPT_TEST_CALLS:
+                return None
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr == "shape" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in params \
+                and fn_name == "hybrid_forward":
+            return ("shape", node.value.id)
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in params \
+                and isinstance(node.ctx, ast.Load):
+            # only bare-name operands count: attribute reads (x.ndim,
+            # x.dtype) are static under trace and stay exempt
+            parent_is_attr = False
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Attribute) and sub.value is node:
+                    parent_is_attr = True
+                    break
+            if not parent_is_attr:
+                return ("value", node.id)
+    return None
+
+
+# --- G003: side effects in traced code ------------------------------------
+
+def check_g003(sf, graph, ctx):
+    out = []
+    traced = ctx["traced"]
+    for fi in graph.functions:
+        if fi.path != sf.path or fi not in traced:
+            continue
+        scope = fi.qualname.split("::", 1)[1]
+        bound = _bound_names(fi.node)
+        for node in own_nodes(fi, graph.by_node):
+            if isinstance(node, ast.Call):
+                callee = _unparse(node.func)
+                if callee in IMPURE_NAMES and isinstance(node.func,
+                                                        ast.Name):
+                    out.append(_v("G003", sf, node, scope,
+                                  "%s() inside traced code runs at TRACE "
+                                  "time only (not per step) and is "
+                                  "invisible to XLA; use jax.debug or "
+                                  "hoist it out" % callee))
+                elif any(callee == p or callee.startswith(p)
+                         for p in IMPURE_PREFIXES):
+                    out.append(_v("G003", sf, node, scope,
+                                  "%s inside traced code: evaluated once "
+                                  "at trace time, then frozen into the "
+                                  "program — wall clocks and host RNG "
+                                  "must stay outside jit (use the rng "
+                                  "plumbing for randomness)" % callee))
+            elif isinstance(node, ast.Global):
+                out.append(_v("G003", sf, node, scope,
+                              "global-state rebinding inside traced code "
+                              "runs at trace time, not per step"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    root = _store_root(tgt)
+                    if root is None:
+                        continue
+                    if root == "self" or root not in bound:
+                        out.append(_v("G003", sf, node, scope,
+                                      "mutation of %r inside traced code: "
+                                      "the write happens at trace time "
+                                      "and is silently dropped on cached "
+                                      "replays" % _unparse(tgt)))
+                        break
+    return out
+
+
+def _store_root(target):
+    """Root name of an attribute/subscript store (None for plain locals)."""
+    node = target
+    seen_deref = False
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        seen_deref = True
+        node = node.value
+    if seen_deref and isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# --- G004: lock discipline ------------------------------------------------
+
+def _guard_annotations(sf):
+    """Parse ``# guarded-by: <lock>`` trailing comments.
+
+    Returns (module_guards, attr_guards):
+      module_guards: {name: lock_src}       (module-level state)
+      attr_guards:   {(class, attr): lock_src}
+    """
+    annotated = {}
+    for i, line in enumerate(sf.lines, 1):
+        # the lock must be a (dotted) identifier ending the line, so a
+        # string literal merely CONTAINING the marker never matches
+        m = _GUARDED_BY_RE.search(line)
+        if m:
+            annotated[i] = m.group(1)
+    module_guards, attr_guards = {}, {}
+    if not annotated:
+        return module_guards, attr_guards
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        # the annotation may sit on any physical line of a multi-line
+        # assignment (profiler._state spans two lines)
+        lock = None
+        for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+            lock = annotated.get(ln)
+            if lock is not None:
+                break
+        if lock is None:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                module_guards[tgt.id] = lock
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                cls = None
+                for anc in sf.ancestors(node):
+                    if isinstance(anc, ast.ClassDef):
+                        cls = anc.name
+                        break
+                if cls:
+                    attr_guards[(cls, tgt.attr)] = lock
+    return module_guards, attr_guards
+
+
+def _holds_lock(sf, node, lock_src):
+    """Is node lexically inside `with <lock_src>:`?"""
+    for anc in sf.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if _unparse(item.context_expr) == lock_src:
+                    return True
+    return False
+
+
+def _enclosing_class(sf, node):
+    for anc in sf.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc.name
+    return None
+
+
+def _chain_guard(tgt, guard_for):
+    """Walk a store target's container chain (X, X[...], X.y, self.X[k])
+    looking for guarded state; index/value expressions are reads and do
+    not count."""
+    node = tgt
+    while True:
+        hit = guard_for(node)
+        if hit:
+            return hit
+        if isinstance(node, (ast.Subscript, ast.Attribute)):
+            node = node.value
+        else:
+            return None
+
+
+def check_g004(sf, graph, ctx):
+    out = []
+    module_guards, attr_guards = _guard_annotations(sf)
+    if not module_guards and not attr_guards:
+        return out
+
+    def report(node, name, lock, what):
+        fi, scope = _scope_of(sf, graph, node)
+        out.append(_v("G004", sf, node, scope,
+                      "%s of %s outside `with %s:` (declared guarded-by)"
+                      % (what, name, lock)))
+
+    def guard_for(node):
+        """(display_name, lock) if node references guarded state."""
+        if isinstance(node, ast.Name):
+            lock = module_guards.get(node.id)
+            if lock:
+                return node.id, lock
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            cls = _enclosing_class(sf, node)
+            lock = attr_guards.get((cls, node.attr))
+            if lock:
+                return "self." + node.attr, lock
+        return None
+
+    for node in ast.walk(sf.tree):
+        fn = sf.enclosing_function(node)
+        if fn is None:
+            continue  # import-time module scope is single-threaded
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and fn.name == "__init__":
+            continue  # construction happens-before publication
+        # stores: X = / X[...] = / X.y = / self.X[...] = / del X[...]
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Delete)):
+            if isinstance(node, (ast.Delete, ast.Assign)):
+                targets = list(node.targets)
+            else:
+                targets = [node.target]
+            # tuple-unpacking targets mutate each element
+            flat = []
+            for tgt in targets:
+                flat.extend(tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                         ast.List))
+                            else [tgt])
+            for tgt in flat:
+                hit = _chain_guard(tgt, guard_for)
+                if hit and not _holds_lock(sf, node, hit[1]):
+                    report(node, hit[0], hit[1], "mutation")
+                    break
+        # mutating method calls: X.append(...), self.X.update(...)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATORS:
+            hit = guard_for(node.func.value)
+            if hit and not _holds_lock(sf, node, hit[1]):
+                report(node, hit[0], hit[1], "mutating call .%s()"
+                       % node.func.attr)
+        # racy whole-container reads: dict(X)/sorted(X)/iteration
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in COPIERS and node.args:
+            hit = guard_for(node.args[0])
+            if hit is None and isinstance(node.args[0], ast.Call) \
+                    and isinstance(node.args[0].func, ast.Attribute) \
+                    and node.args[0].func.attr in ("values", "items",
+                                                   "keys"):
+                hit = guard_for(node.args[0].func.value)
+            if hit and not _holds_lock(sf, node, hit[1]):
+                report(node, hit[0], hit[1],
+                       "unlocked %s() copy" % node.func.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            hit = guard_for(it)
+            if hit is None and isinstance(it, ast.Call) \
+                    and isinstance(it.func, ast.Attribute) \
+                    and it.func.attr in ("values", "items", "keys"):
+                hit = guard_for(it.func.value)
+            anchor = node if isinstance(node, ast.For) else it
+            if hit and not _holds_lock(sf, anchor, hit[1]):
+                report(anchor, hit[0], hit[1], "unlocked iteration")
+    return out
+
+
+RULES_DOC = {
+    "G001": """G001 host-sync
+A device->host transfer (.asnumpy()/.asscalar()/.item()/.tolist(), or
+np.asarray inside traced code) blocks on the async dispatch queue.
+Flagged when it happens per loop iteration, inside traced code, or
+through a helper that (transitively) syncs. float()/int() of bare
+values is deliberately NOT checked — parameters routinely carry static
+host config, and a type-blind check would drown the rule.
+Fix patterns: accumulate on device and fetch once after the loop; return
+arrays from jitted functions and fetch outside; drop the redundant
+np.asarray around .asnumpy().""",
+    "G002": """G002 retrace hazard
+Work that silently recompiles: python `if`/`while` on traced parameters
+(TracerBoolConversionError under jit, per-value retrace otherwise),
+jit wrappers constructed inside loops, mutable static_argnums specs, and
+jitted closures capturing host scalars/arrays (each new value = a new
+program; stale constants for arrays).
+Fix patterns: jnp.where/lax.cond; hoist/memoize the jitted callable;
+pass captured values as traced arguments.""",
+    "G003": """G003 side effects in traced code
+Inside a traced function, wall clocks (time.time), host RNG
+(numpy.random / random), print/open, setattr, and global/attribute
+mutation run ONCE at trace time and are frozen into (or dropped from)
+the compiled program — they do not happen per step.
+Fix patterns: hoist host work out of the traced function; thread PRNG
+keys explicitly; jax.debug.print for in-program logging.""",
+    "G004": """G004 lock discipline
+State annotated `# guarded-by: <lock>` must only be mutated — or
+whole-copied/iterated (dict(x), sorted(x), for ... in x) — inside a
+lexical `with <lock>:` block. Unlocked mutation loses writes at bytecode
+preemption points; unlocked iteration throws 'changed size during
+iteration' under a concurrent writer.
+Fix patterns: take the lock; snapshot under the lock and iterate the
+snapshot; keep __init__ free (construction happens-before publication).""",
+}
+
+
+ALL_RULES = {
+    "G001": check_g001,
+    "G002": check_g002,
+    "G003": check_g003,
+    "G004": check_g004,
+}
+
+
+def run_rules(files, graph, select=None):
+    """Run all (or selected) rules over parsed files; returns violations
+    without fingerprints/suppressions applied (the driver does that)."""
+    traced = graph.traced_set()
+    syncing = graph.sync_closure(direct_sync_funcs(graph))
+    ctx = {"traced": traced, "syncing": syncing}
+    rules = {k: v for k, v in ALL_RULES.items()
+             if select is None or k in select}
+    out = []
+    for sf in files:
+        for check in rules.values():
+            out.extend(check(sf, graph, ctx))
+    return out
