@@ -521,6 +521,93 @@ def _collect_telemetry(results):
     }
 
 
+def bench_health_overhead(threshold_pct=None):
+    """--health-overhead: gate the warn-mode per-step cost of the
+    training-health layer (observability/health.py) on the transformer
+    microbench. Runs the SAME compiled train-step loop twice — policy
+    ``off`` (the zero-cost no-op path) and policy ``warn`` (one fused
+    non-finite reduction + one tiny host fetch + a flight-recorder ring
+    record per step) — and fails if warn adds more than ``threshold_pct``
+    (default 2%, env MXNET_HEALTH_GATE_PCT) to the per-step wall time.
+    Best-of-3 per arm to shave scheduler noise."""
+    import jax
+
+    from mxnet_tpu.observability import flight_recorder, health
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+
+    if threshold_pct is None:
+        threshold_pct = float(os.environ.get("MXNET_HEALTH_GATE_PCT", "2.0"))
+    B, T = (2, 128) if QUICK else (4, 512)
+    d_model, n_layers = (64, 2) if QUICK else (128, 4)
+    steps = 10 if QUICK else 30
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tp = TransformerParallel(mesh, vocab=2048, d_model=d_model, n_heads=8,
+                             n_layers=n_layers, d_ff=4 * d_model,
+                             n_experts=1, dtype=np.dtype("bfloat16"))
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 2048, (B, T)).astype(np.int32)
+    tok, tgt = tp.shard_batch(tok, np.roll(tok, -1, axis=1))
+    step = tp.step_fn(lr=0.01)
+
+    def run(policy):
+        health.set_policy(policy)
+        # the step program donates its params, so each arm chains one
+        # fresh parameter pytree through every iteration
+        params = tp.init(0)
+        names = [jax.tree_util.keystr(path) for path, _leaf in
+                 jax.tree_util.tree_flatten_with_path(params)[0]]
+        params, loss = step(params, tok, tgt)
+        float(loss)  # compile + warm (also warms the fused check below)
+        if policy != "off":
+            named = list(zip(names, jax.tree_util.tree_leaves(params)))
+            health.guard_step("bench.transformer", losses=[("loss", loss)],
+                              params=named, lr=0.01, step=0)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                params, loss = step(params, tok, tgt)
+                if policy != "off":
+                    named = list(zip(names,
+                                     jax.tree_util.tree_leaves(params)))
+                    health.guard_step(
+                        "bench.transformer", losses=[("loss", loss)],
+                        params=named, lr=0.01, step=i + 1)
+            float(loss)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best
+
+    try:
+        off_s = run("off")
+        warn_s = run("warn")
+    finally:
+        # settle the warn arm's lag-1 stash BEFORE the reset, or a later
+        # dump/atexit flush would commit a bench record into a user ring
+        health.flush(allow_dump=False)
+        health.set_policy(None)
+        flight_recorder.reset()
+    pct = 100.0 * (warn_s - off_s) / off_s
+    result = {"off_ms_per_step": round(off_s * 1e3, 3),
+              "warn_ms_per_step": round(warn_s * 1e3, 3),
+              "overhead_pct": round(pct, 2),
+              "threshold_pct": threshold_pct,
+              "protocol": ("transformer LM d%d x%d T=%d bs%d, warn = fused "
+                           "non-finite check over loss+params + ring record "
+                           "per step" % (d_model, n_layers, T, B))}
+    print("[bench_all] health overhead: %s" % json.dumps(result),
+          file=sys.stderr)
+    if pct > threshold_pct:
+        raise SystemExit(
+            "bench_all --health-overhead: warn-mode costs %.2f%% per step "
+            "(> %.2f%% gate) — the health check must stay cheap enough to "
+            "leave on" % (pct, threshold_pct))
+    print("[bench_all] health-overhead gate passed (%.2f%% <= %.2f%%)"
+          % (pct, threshold_pct), file=sys.stderr)
+    return result
+
+
 def assert_lint_clean():
     """--lint-clean: graftlint must exit 0 against the committed baseline.
 
@@ -582,5 +669,9 @@ if __name__ == "__main__":
         # standalone smoke: assert the committed tree is graftlint-clean
         # and exit without benching (CI/driver guard; seconds, no TPU)
         assert_lint_clean()
+    elif "--health-overhead" in sys.argv[1:]:
+        # standalone gate: warn-mode health checking must cost <= 2% per
+        # step on the transformer microbench (docs/health.md)
+        bench_health_overhead()
     else:
         main(telemetry="--telemetry" in sys.argv[1:])

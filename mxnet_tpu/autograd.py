@@ -303,11 +303,34 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode):
         _metrics.counter("tape.backward").inc()
         _metrics.counter("tape.nodes").inc(len(topo))
 
+    from .observability import health as _health
+
+    health_heads = None
+    if _health.active():
+        # capture head names BEFORE the tape cleanup clears the nodes
+        def head_name(i, h):
+            node = h._autograd_node
+            return getattr(node, "name", "") or "head%d" % i
+
+        health_heads = [(head_name(i, h), h) for i, h in enumerate(heads)]
+
     if not retain_graph:
         for node in topo:
             node.vjp_fn = None
         for arr in heads:
             arr._autograd_node = None
+
+    if health_heads is not None:
+        # loss-head check at the earliest point a NaN can be observed in
+        # the eager path (before the Trainer sees the grads) — AFTER the
+        # tape release above, so a raise-policy TrainingHealthError does
+        # not retain every vjp closure (and the activations they pin)
+        # right when the user is trying to recover. Backward cannot
+        # withhold an update, so can_skip=False: skip_step is applied by
+        # the update site's own grad check (Trainer.step).
+        _health.guard_step("autograd.backward", losses=health_heads,
+                           step=_health.next_step("autograd.backward"),
+                           can_skip=False)
 
 
 def _run_backward_symbolic(heads, head_grads):

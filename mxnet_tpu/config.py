@@ -83,6 +83,27 @@ Flags currently honored:
     retrace-cause explanations for ``dump_metrics()``. Off by default:
     it makes jax log a WARNING per tracing cache miss.
 
+``MXNET_HEALTH`` (default ``off``)
+    Active training-health policy (observability/health.py): one fused
+    non-finite reduction per step over loss/grads/params with grad-norm
+    and update-to-param-ratio gauges. ``off`` keeps every wired call
+    site on its zero-cost no-op path; ``warn`` logs anomalies and dumps
+    the flight recorder; ``raise`` raises TrainingHealthError on the
+    faulting step; ``skip_step`` additionally withholds the parameter
+    update so weights stay finite. String-valued and read straight from
+    the environment (override at runtime with
+    ``observability.health.set_policy``) — like MXNET_PROFILER_MODE,
+    NOT routed through the integer get_flag machinery.
+
+``MXNET_HEALTH_RING`` (default 256)
+    Capacity of the flight recorder's last-K ring of per-step health
+    records (observability/flight_recorder.py).
+
+``MXNET_HEALTH_DUMP_DIR`` (default ``.``)
+    Directory flight-recorder triage dumps are written into (atomic
+    temp+rename). String-valued, env-only;
+    ``flight_recorder.configure(dump_dir=...)`` overrides at runtime.
+
 ``MXNET_PROFILER_MODE`` (default ``symbolic``)
     Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
     trace can be captured from an unmodified script via env alone;
@@ -115,6 +136,7 @@ _DEFAULTS = {
     "MXNET_TELEMETRY": 0,
     "MXNET_TELEMETRY_MEMSTATS": 1,
     "MXNET_TELEMETRY_RETRACE": 0,
+    "MXNET_HEALTH_RING": 256,
 }
 
 

@@ -245,6 +245,9 @@ class Executor:
         self.outputs = []
         self._stashed_grads = None
         self._monitor_callback = None
+        self._monitor_use_jit = False
+        self._monitor_jit_cache = {}
+        self._health_steps = 0
 
     # --- properties mirroring the reference -------------------------------
     @property
@@ -291,13 +294,25 @@ class Executor:
             # per-node spy pass: fire the callback for every node output
             # entry (reference: graph_executor.cc:199 ExecuteMonCallback;
             # monitoring disables bulk exec there too — here it runs one
-            # eager un-jitted forward, and in train mode the compiled
-            # fwd+bwd still runs below for gradients, so a monitored
-            # train step pays roughly two forwards; a debug-only cost)
-            outs, aux_upd = self._prog._eval(
-                arg_d, aux_d, rngs, is_train, ctx_map=self._ctx_map,
-                callback=lambda name, v: self._monitor_callback(
-                    name, _from_data(v)))
+            # eager un-jitted forward, OR — with use_jit — one compiled
+            # forward whose interior values reach the host through
+            # jax.debug.callback; in train mode the compiled fwd+bwd
+            # still runs below for gradients, so a monitored train step
+            # pays roughly two forwards; a debug-only cost)
+            if self._monitor_use_jit and not self._ctx_map:
+                import jax
+
+                outs, aux_upd = self._monitored_jit(is_train)(
+                    arg_d, aux_d, rngs)
+                # debug.callback delivery is asynchronous on accelerator
+                # backends; the monitor reads its stats dict right after
+                # forward() returns, so drain the effects queue here
+                jax.effects_barrier()
+            else:
+                outs, aux_upd = self._prog._eval(
+                    arg_d, aux_d, rngs, is_train, ctx_map=self._ctx_map,
+                    callback=lambda name, v: self._monitor_callback(
+                        name, _from_data(v)))
             if not is_train:
                 for n, nv in aux_upd.items():
                     self.aux_dict[n]._set_data(nv)
@@ -497,7 +512,73 @@ class Executor:
                       group2ctx=self._group2ctx)
         return ex
 
-    def set_monitor_callback(self, callback):
+    def _monitored_jit(self, is_train):
+        """One compiled forward whose per-node outputs reach the host
+        monitor through ``jax.debug.callback`` — the in-jit analog of the
+        eager spy pass (monitor.py docstring's promised path). The host
+        side reads ``self._monitor_callback`` at fire time, so the cached
+        program survives callback swaps."""
+        key = bool(is_train)
+        fn = self._monitor_jit_cache.get(key)
+        if fn is None:
+            import functools
+
+            import jax
+
+            from . import ndarray as nd
+
+            def fire(name, host_val):
+                cb = self._monitor_callback
+                if cb is not None:
+                    cb(name, nd.array(host_val))
+
+            def traced_cb(name, value):
+                jax.debug.callback(functools.partial(fire, name), value)
+
+            def f(arg_d, aux_d, rngs):
+                return self._prog._eval(arg_d, aux_d, rngs, is_train,
+                                        callback=traced_cb)
+
+            fn = _maybe_jit(f)
+            self._monitor_jit_cache[key] = fn
+        return fn
+
+    def set_monitor_callback(self, callback, use_jit=False):
         """Install a per-output monitor (reference: MXExecutorSetMonitorCallback;
-        executes an uncompiled node-by-node pass when used via debug tools)."""
+        executes an uncompiled node-by-node pass when used via debug
+        tools). With ``use_jit`` the monitored forward runs as ONE
+        compiled program and interior node values reach the callback via
+        ``jax.debug.callback`` instead of an eager per-op walk (ignored
+        for model-parallel group2ctx graphs, which always run eagerly)."""
         self._monitor_callback = callback
+        self._monitor_use_jit = bool(use_jit)
+
+    def named_health_arrays(self):
+        """``(kind, name, NDArray)`` triples for the health layer: every
+        output and every gradient buffer this executor exposes."""
+        out = [("loss", name, o)
+               for name, o in zip(self._symbol.list_outputs(), self.outputs)]
+        out.extend(("grad", name, g)
+                   for name, g in sorted(self.grad_dict.items())
+                   if g is not None)
+        return out
+
+    def health_check(self, wall_s=None):
+        """Fused non-finite check over this executor's outputs and grads
+        (observability.health.guard_step) — the wiring point for code
+        that drives executors directly rather than through Module/fit.
+        Returns the Verdict, or None when MXNET_HEALTH is off."""
+        from .observability import health
+
+        if not health.active():
+            return None
+        named = self.named_health_arrays()
+        self._health_steps += 1
+        return health.guard_step(
+            "executor",
+            losses=[(n, a) for k, n, a in named if k == "loss"],
+            grads=[(n, a) for k, n, a in named if k == "grad"],
+            params=[(n, a) for n, a in sorted(self.arg_dict.items())
+                    if n in self.grad_dict],
+            step=self._health_steps, wall_s=wall_s, can_skip=False,
+            sync=True)  # one-shot diagnostic: the caller wants THIS step

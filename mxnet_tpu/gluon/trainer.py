@@ -48,6 +48,7 @@ class Trainer:
                           for _ in self._contexts]
         self._kv_initialized = False
         self._kvstore = kvstore
+        self._health_steps = 0  # monotonic step index (flight recorder)
         # fused local update: ALL parameter updates as ONE compiled XLA
         # program (the TPU answer to the reference's update aggregation,
         # model.py MXNET_UPDATE_AGGREGATION_SIZE / engine bulk mode)
@@ -136,7 +137,7 @@ class Trainer:
         local updaters. ``batch_size`` normalizes the gradient scale."""
         import time
 
-        from ..observability import record_step, trace_span
+        from ..observability import health, record_step, trace_span
 
         started = time.perf_counter()
         with trace_span("trainer.step", "gluon"):
@@ -148,6 +149,16 @@ class Trainer:
                 self._optimizer.rescale_grad = rescale
                 if self._server_side_optimizer():
                     self._reship_optimizer()
+
+            if health.active():
+                # fused grad/param check BEFORE any push or update, so
+                # skip_step drops the whole step and weights stay finite
+                verdict = self._health_check(time.perf_counter() - started)
+                if verdict is not None and verdict.skip:
+                    record_step(time.perf_counter() - started,
+                                self._contexts[0] if self._contexts
+                                else None)
+                    return
 
             if self._kvstore is None and self._can_fuse():
                 with trace_span("fused_update", "gluon"):
@@ -174,6 +185,26 @@ class Trainer:
                         updater(i, grad, weight)
         record_step(time.perf_counter() - started,
                     self._contexts[0] if self._contexts else None)
+
+    def _health_check(self, wall_s):
+        """Fused non-finite check over every live parameter's gradient
+        (all contexts) and its weight — one device program, one host
+        fetch (observability.health.guard_step)."""
+        from ..observability import health
+
+        live = self._live_params()
+        multi = len(self._contexts or ()) > 1
+        grads, params = [], []
+        for _i, p in live:
+            for k, g in enumerate(p.list_grad()):
+                grads.append(("%s@%d" % (p.name, k) if multi else p.name, g))
+            params.append((p.name, p.list_data()[0]))
+        self._health_steps += 1
+        return health.guard_step(
+            "gluon.trainer", grads=grads, params=params,
+            lr=getattr(self._optimizer, "lr", None),
+            step=self._health_steps, wall_s=wall_s,
+            can_skip=health.skip_allowed(self._kvstore))
 
     # ------------------------------------------------------ fused updates
     # Optimizers whose only per-step HOST-computed scalar is the resolved
@@ -527,7 +558,17 @@ class _FusedTrainStep:
             return loss, new_w, new_s, new_aux
 
         self.compile_count += 1
-        return jax.jit(raw, donate_argnums=(0, 2, 5))
+        from ..observability import health
+
+        # under skip_step AND raise the old weight/state buffers must
+        # survive the program (a skipped writeback keeps them live; a
+        # raise aborts BEFORE the writeback, and the caller may catch it
+        # to checkpoint the pre-NaN params), so donation is off; off/warn
+        # always write back and keep the memory optimization
+        donate = () if (health.active()
+                        and health.policy() in ("skip_step", "raise")) \
+            else (0, 2, 5)
+        return jax.jit(raw, donate_argnums=donate)
 
     # ---------------------------------------------------------- call
     def __call__(self, data, label):
@@ -554,8 +595,12 @@ class _FusedTrainStep:
         if trainer._optimizer.rescale_grad != rescale:
             trainer._optimizer.rescale_grad = rescale
 
+        from ..observability import health as _health
+
         key = (tuple(data.shape), str(data.dtype), tuple(label.shape),
-               str(label.dtype), trainer._fused_signature())
+               str(label.dtype), trainer._fused_signature(),
+               _health.active()
+               and _health.policy() in ("skip_step", "raise"))
         if self._compiled is None or self._compiled[0] != key:
             trainer._materialize_states(live)
             self._compiled = (key, self._compile())
@@ -579,6 +624,22 @@ class _FusedTrainStep:
         loss, new_w, new_s, new_aux = fn(
             w_live, w_frozen, aux_all, data._data, label._data, s_datas,
             lr_scalars, rngs)
+
+        if _health.active():
+            # grads never leave the fused program, so the check watches
+            # the loss and the POST-update weights: a non-finite gradient
+            # surfaces as a non-finite updated weight, and skip_step
+            # drops the writeback (old weights stay live — donation is
+            # off under this policy, see _compile)
+            trainer._health_steps += 1
+            verdict = _health.guard_step(
+                "gluon.compile_step", losses=[("loss", loss)],
+                params=[("%s(updated)" % p.name, wd)
+                        for (_i, p), wd in zip(live, new_w)],
+                lr=getattr(trainer._optimizer, "lr", None),
+                step=trainer._health_steps)
+            if verdict is not None and verdict.skip:
+                return _from_data(loss)
 
         for (i, p), wd, sd in zip(live, new_w, new_s):
             p.list_data()[0]._set_data(wd)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 
 from .base import MXNetError
 from . import ndarray as nd
@@ -71,12 +72,35 @@ def _ensure_distributed():
                                process_id=int(worker_id))
 
 
+import weakref
+
+_live_stores = weakref.WeakSet()  # every constructed KVStore, GC-pruned
+
+
+def _stores_staleness():
+    """Flight-recorder provider: per-key push staleness of EVERY live
+    store — one store dumps as its dict, several as {"stores": [...]}."""
+    views = []
+    for kv in list(_live_stores):
+        try:
+            view = kv.push_staleness()
+        except Exception as err:
+            view = {"error": repr(err)}
+        if view:
+            views.append(view)
+    if not views:
+        return None
+    return views[0] if len(views) == 1 else {"stores": views}
+
+
 class KVStore:
     """Key-value store for parameter synchronization."""
 
     def __init__(self, kv_type="local"):
         self.type = kv_type
         self._data = {}          # key -> merged NDArray (the "server" copy)
+        self._push_lock = threading.Lock()
+        self._push_stats = {}    # key -> [push count, last push ts]  # guarded-by: self._push_lock
         self._updater = None
         self._optimizer = None
         self._compression_params = None
@@ -84,6 +108,39 @@ class KVStore:
         self._dist = kv_type.startswith("dist")
         if self._dist:
             _ensure_distributed()
+        self._register_health_provider()
+
+    def _register_health_provider(self):
+        """Expose per-key push staleness to the crash flight recorder.
+        Every live store joins a module-level WeakSet walked by ONE
+        'kvstore' provider — a fixed per-instance registration would let
+        a later throwaway store shadow the main one, and a weak set never
+        pins a dropped store."""
+        from .observability import flight_recorder
+
+        _live_stores.add(self)
+        flight_recorder.register_provider("kvstore", _stores_staleness)
+
+    def push_staleness(self):
+        """{key: {"pushes", "age_s"}} as seen by this worker — the dist
+        variants also gather the server-side view."""
+        import time as _time
+
+        now = _time.time()
+        with self._push_lock:  # a concurrent push must not tear this walk
+            stats = {k: tuple(v) for k, v in self._push_stats.items()}
+        return {"type": self.type,
+                "per_key": {str(k): {"pushes": count,
+                                     "age_s": round(now - last_ts, 3)}
+                            for k, (count, last_ts) in stats.items()}}
+
+    def _note_push(self, key):
+        import time as _time
+
+        with self._push_lock:
+            entry = self._push_stats.setdefault(key, [0, 0.0])
+            entry[0] += 1
+            entry[1] = _time.time()
 
     # --- basic ops (reference: kvstore.py init/push/pull) -----------------
     def init(self, key, value):
@@ -201,6 +258,8 @@ class KVStore:
         with trace_span("kvstore.push", "kvstore"):
             self._push_impl(key, value, priority)
         counter("kvstore.push").inc()
+        for k in (key if isinstance(key, (list, tuple)) else (key,)):
+            self._note_push(k)
 
     def _push_impl(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
@@ -491,6 +550,85 @@ class KVStoreDistAsync(KVStore):
         self._bigarray_bound = int(os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", str(10 ** 6)))
         self._big_plans = {}  # key -> list of (subkey, shard, lo, hi)
+        self._push_lock = threading.Lock()
+        self._push_stats = {}  # guarded-by: self._push_lock
+        self._register_health_provider()
+
+    def push_staleness(self):
+        """Worker-side view plus every server shard's per-key push
+        staleness (kvstore_server health op) — the section the flight
+        recorder embeds so a dump shows which keys stopped flowing.
+
+        This runs inside the CRASH-DUMP path (excepthook/atexit), so it
+        must be bounded: a plain ``gather_call`` would block forever on a
+        shard's socket lock if another thread is parked in a long server
+        barrier, hanging the dying process inside its own crash handler.
+        Every lock acquire and socket read here carries a short timeout;
+        a busy or dead shard becomes an ``error`` entry, never a hang."""
+        from .kvstore_server import _recv_msg, _send_msg
+
+        out = super().push_staleness()
+        servers = []
+        client = self._client
+        for i in range(client.num_shards):
+            lock = client._locks[i]
+            if not lock.acquire(timeout=2.0):
+                servers.append({"error": "shard busy (lock timeout)"})
+                continue
+            try:
+                sock = client._socks[i]
+                old_timeout = sock.gettimeout()
+                sock.settimeout(5.0)
+                try:
+                    _send_msg(sock, ("health",))
+                    resp = _recv_msg(sock)
+                    sock.settimeout(old_timeout)
+                    servers.append(resp[1] if resp[0] == "ok"
+                                   else {"error": resp[1]})
+                except Exception as err:
+                    servers.append({"error": repr(err)})
+                    # a timed-out exchange leaves the (late) health reply
+                    # queued on the length-prefixed stream — the NEXT
+                    # push/pull would read it as its own response and
+                    # silently corrupt a pull. Drop the socket and try
+                    # one quick reconnect; if that fails the next data
+                    # call errors loudly instead of desyncing.
+                    self._reconnect_shard(i)
+            except Exception as err:  # dead shard must not sink the dump
+                servers.append({"error": repr(err)})
+            finally:
+                lock.release()
+        out["servers"] = servers
+        return out
+
+    def _reconnect_shard(self, i):
+        """Replace shard i's data socket after a mid-exchange failure
+        (caller holds the shard lock). Short one-shot connect — this runs
+        in the crash-dump path and must stay bounded."""
+        import socket as _socket
+
+        client = self._client
+        try:
+            client._socks[i].close()
+        except OSError:
+            pass
+        try:
+            host, _, port = client._addresses[i].rpartition(":")
+            fresh = _socket.create_connection((host, int(port)), timeout=2)
+            fresh.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            from .kvstore_server import _recv_msg, _send_msg
+
+            # hello still under the 2s crash-path budget (a shard that
+            # accepts but whose handler is wedged must not block the
+            # dying process); only then widen to the normal 30s data
+            # window (matching PSClient._connect) so a slow-but-healthy
+            # pull on the recovered socket doesn't spuriously time out
+            _send_msg(fresh, ("hello", client.rank))
+            _recv_msg(fresh)
+            fresh.settimeout(30)
+            client._socks[i] = fresh
+        except Exception:
+            pass  # closed socket: the next data call fails loudly
 
     def _slice_plan(self, key, shape):
         """Contiguous flat-slice layout of a big value across all shards

@@ -78,6 +78,7 @@ class KVStoreServer:
         self._sock.listen(64)
         self.address = "%s:%d" % self._sock.getsockname()
         self._store = {}          # key -> np.ndarray  # guarded-by: self._lock
+        self._push_stats = {}     # key -> [push count, last push ts]  # guarded-by: self._lock
         self._updater = None      # guarded-by: self._lock
         self._lock = threading.Lock()
         self._key_locks = {}      # key -> Lock  # guarded-by: self._lock
@@ -167,6 +168,8 @@ class KVStoreServer:
             return ("err", "unknown command head %r" % (head,))
         if op == "barrier":
             return self._barrier(msg[1])
+        if op == "health":
+            return ("ok", self.health_snapshot())
         if op == "num_dead":
             _, timeout = msg
             with self._lock:
@@ -200,6 +203,20 @@ class KVStoreServer:
                 pass
             return ("ok",)
         return ("err", "unknown op %r" % (op,))
+
+    def health_snapshot(self):
+        """Per-key push staleness for the flight recorder: how many
+        pushes each key has seen and how long ago the last one landed —
+        a straggling/stuck worker shows up as one stale key family."""
+        now = time.time()
+        with self._lock:
+            per_key = {
+                str(key): {"pushes": count,
+                           "age_s": round(now - last_ts, 3)}
+                for key, (count, last_ts) in self._push_stats.items()}
+            workers = {str(rank): round(now - ts, 3)
+                       for rank, ts in self._last_seen.items()}
+        return {"per_key": per_key, "worker_age_s": workers}
 
     def _key_lock(self, key):
         with self._lock:
@@ -248,6 +265,10 @@ class KVStoreServer:
             else:
                 with self._lock:
                     self._store[key] = np.array(grad)
+        with self._lock:
+            entry = self._push_stats.setdefault(key, [0, 0.0])
+            entry[0] += 1
+            entry[1] = time.time()
         return ("ok",)
 
     def _barrier(self, num_workers):

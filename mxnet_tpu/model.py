@@ -223,6 +223,13 @@ class FeedForward:
         """Train for ``num_epoch`` epochs over X/y (arrays or a DataIter)."""
         if self.num_epoch is None:
             raise ValueError("num_epoch must be set to call fit")
+        from .observability import flight_recorder, health
+
+        if health.active():
+            # the delegated Module.fit loop runs the per-step fused
+            # checks; arming here too covers a crash in FeedForward's own
+            # setup (iterator coercion, module construction)
+            flight_recorder.install()
         train = self._as_iter(X, y, shuffle=True)
         if eval_data is not None and not hasattr(eval_data, "provide_data"):
             eval_data = self._as_iter(eval_data[0], eval_data[1])

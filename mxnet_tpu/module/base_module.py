@@ -17,7 +17,7 @@ from ..model import BatchEndParam
 from .. import ndarray as nd
 from ..context import cpu
 from ..initializer import Uniform
-from ..observability import record_step, trace_span
+from ..observability import flight_recorder, health, record_step, trace_span
 
 _PARAM_KINDS = ("arg", "aux")
 _WEIGHT_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
@@ -87,6 +87,7 @@ class BaseModule:
         self.optimizer_initialized = False
         self._symbol = None
         self._total_exec_bytes = 0
+        self._health_steps = 0  # monotonic across epochs (flight recorder)
 
     # ------------------------------------------------------------------ fit
     def forward_backward(self, data_batch):
@@ -105,6 +106,10 @@ class BaseModule:
         """Train over ``train_data`` for ``num_epoch`` epochs."""
         if num_epoch is None:
             raise ValueError("please specify number of epochs")
+        if health.active():
+            # arm the crash hooks so an OOM/preemption/raise mid-fit
+            # still leaves the last-K step records on disk
+            flight_recorder.install()
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -146,6 +151,10 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
             train_data.reset()
+        if health.active():
+            # settle the warn-mode lag-1 stash so the final step's
+            # verdict is recorded before fit returns
+            health.flush()
 
     def _fit_epoch(self, train_data, train_metric, monitor,
                    batch_end_callback, epoch):
@@ -158,12 +167,25 @@ class BaseModule:
                 monitor.tic()
             with trace_span("step", "module"):
                 self.forward_backward(data_batch)
-                with trace_span("update", "module"):
-                    self.update()
+                skip_update = False
+                if health.active():
+                    # fused non-finite check over this step's loss/grads/
+                    # params BEFORE the update, so skip_step can withhold
+                    # it and keep the parameters finite
+                    verdict = self._health_check(
+                        time.perf_counter() - step_started)
+                    skip_update = verdict is not None and verdict.skip
+                if not skip_update:
+                    with trace_span("update", "module"):
+                        self.update()
             if upcoming is not None:
                 self.prepare(upcoming)
-            with trace_span("update_metric", "module"):
-                self.update_metric(train_metric, data_batch.label)
+            if not skip_update:
+                # a skipped step's outputs are the non-finite values the
+                # skip protects against — feeding them to a sum-based
+                # metric would print Train-<m>=nan for the whole epoch
+                with trace_span("update_metric", "module"):
+                    self.update_metric(train_metric, data_batch.label)
             if monitor is not None:
                 monitor.toc_print()
             record_step(time.perf_counter() - step_started)
@@ -172,6 +194,13 @@ class BaseModule:
                                 eval_metric=train_metric, locals=locals()))
             nbatch += 1
         return nbatch
+
+    def _health_check(self, wall_s):
+        """Hook: run observability.health's fused per-step check over this
+        module's tensors; returns the Verdict (``verdict.skip`` withholds
+        the update) or None. Subclasses with bound executors override —
+        the base implementation watches nothing."""
+        return None
 
     # ---------------------------------------------------------- inference
     def _inference_batches(self, eval_data, num_batch, reset):

@@ -11,22 +11,34 @@ Three pillars, one import:
   compiled programs.
 * :mod:`.instruments` — ready-made wiring: XLA compile accounting via
   jax.monitoring, HBM watermark sampling, per-step accounting.
+* :mod:`.health` — active training-health layer: one fused non-finite
+  reduction per step over loss/grads/params plus grad-norm and
+  update-ratio gauges, with an MXNET_HEALTH policy
+  (off|warn|raise|skip_step).
+* :mod:`.flight_recorder` — lock-guarded last-K ring of per-step health
+  records; dumps one atomic triage file on anomaly, uncaught exception,
+  or demand (render with tools/health_report.py).
 
 See docs/observability.md for the metrics catalog and the "where did my
-step time go" workflow (profiler dump → tools/trace_report.py).
+step time go" workflow (profiler dump → tools/trace_report.py), and
+docs/health.md for the "why did my run go bad" workflow.
 """
 from . import metrics
 from . import instruments
 from . import tracing
+from . import health
+from . import flight_recorder
 from .metrics import (counter, gauge, histogram, dump_metrics,
                       reset_metrics, set_enabled, enabled)
 from .tracing import trace_span, device_scope
 from .instruments import sample_memory, record_step, retrace_causes
+from .health import TrainingHealthError
 
-__all__ = ["metrics", "instruments", "tracing",
+__all__ = ["metrics", "instruments", "tracing", "health", "flight_recorder",
            "counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "set_enabled", "enabled", "trace_span", "device_scope",
-           "sample_memory", "record_step", "retrace_causes"]
+           "sample_memory", "record_step", "retrace_causes",
+           "TrainingHealthError"]
 
 # honor an env-set MXNET_TELEMETRY at import: installs the jax.monitoring
 # hooks so compiles are counted from the first jit call

@@ -133,6 +133,28 @@ def _host_memory():
     return live, max(peak, live)
 
 
+def device_peak_bytes():
+    """Ungated peak-memory read: PJRT allocator stats on backends that
+    expose them, process VmHWM otherwise; None when nothing is readable.
+    Shared by the health layer's per-step flight-recorder records (which
+    must work without MXNET_TELEMETRY) and available to callers that
+    don't want sample_memory's gauge writes/flag gating."""
+    try:
+        import jax
+
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        if stats:
+            return int(stats.get("peak_bytes_in_use",
+                                 stats.get("bytes_in_use", 0)))
+    except Exception:
+        pass
+    try:
+        _live, peak = _host_memory()
+        return peak or None
+    except Exception:
+        return None
+
+
 def sample_memory(context=None):
     """Record device-memory gauges: ``hbm.live_bytes`` (point-in-time)
     and ``hbm.peak_bytes`` (watermark across samples). Honors the

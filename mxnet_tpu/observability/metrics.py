@@ -138,7 +138,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "buckets", "_counts", "_sum", "_count",
-                 "_min", "_max")
+                 "_min", "_max", "_nonfinite")
 
     def __init__(self, name, buckets=_DEFAULT_BUCKETS):
         self.name = name
@@ -148,9 +148,20 @@ class Histogram:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._nonfinite = 0
 
     def observe(self, v):
         v = float(v)
+        if not math.isfinite(v):
+            # a single NaN observation would poison _sum (and every
+            # later rendered _sum line) forever; Inf would do the same
+            # to _sum/_max — clamp non-finite observations into the
+            # +Inf bucket plus a dedicated dropped count instead
+            with _lock:
+                self._counts[-1] += 1
+                self._count += 1
+                self._nonfinite += 1
+            return
         # linear scan is fine: observe() sits behind enabled() guards and
         # the ladder is ~27 entries; bisect would win nothing measurable
         i = 0
@@ -178,15 +189,25 @@ class Histogram:
 
     @property
     def mean(self):
-        return self._sum / self._count if self._count else 0.0
+        # over the FINITE observations: _sum excludes the clamped
+        # NaN/Inf ones, so the denominator must too
+        n = self._count - self._nonfinite
+        return self._sum / n if n else 0.0
 
     @property
     def min(self):
-        return self._min if self._count else 0.0
+        # finite observations only: _min/_max never see the clamped
+        # NaN/Inf ones, so the guard must not count them either
+        return self._min if self._count - self._nonfinite else 0.0
 
     @property
     def max(self):
-        return self._max if self._count else 0.0
+        return self._max if self._count - self._nonfinite else 0.0
+
+    @property
+    def nonfinite(self):
+        """Observations dropped into the +Inf bucket for being NaN/Inf."""
+        return self._nonfinite
 
     def _reset(self):
         self._counts = [0] * (len(self.buckets) + 1)
@@ -194,6 +215,7 @@ class Histogram:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._nonfinite = 0
 
     def _render(self, out, pname):
         cum = 0
@@ -204,6 +226,8 @@ class Histogram:
         out.append('%s_bucket{le="+Inf"} %d' % (pname, cum))
         out.append("%s_sum %s" % (pname, _fmt(self._sum)))
         out.append("%s_count %d" % (pname, self._count))
+        if self._nonfinite:
+            out.append("%s_nonfinite %d" % (pname, self._nonfinite))
 
 
 class _Noop:

@@ -35,7 +35,7 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "pause", "resume"]
+           "pause", "resume", "events_tail"]
 
 _VALID_MODES = ("symbolic", "imperative", "all")
 
@@ -81,6 +81,13 @@ def record(name, cat, ts_us, dur_us):
           "tid": threading.get_ident() % (1 << 20)}
     with _lock:
         _events.append(ev)
+
+
+def events_tail(n=256):
+    """Copy of the most recent ``n`` recorded events (the flight
+    recorder embeds this tail in its crash dump)."""
+    with _lock:
+        return list(_events[-int(n):])
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):

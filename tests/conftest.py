@@ -22,3 +22,43 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) >= 8, (
     "test harness expected 8 virtual CPU devices, got %s" % jax.devices())
+
+# ---- crash flight recorder: armed for the whole tier-1 run ----------------
+# A failing test dumps the recorder (ring + metrics snapshot + span tail +
+# env fingerprint) into MXNET_HEALTH_DUMP_DIR; CI uploads the directory as
+# a workflow artifact (.github/workflows/ci.yml, if: always()).
+os.environ.setdefault("MXNET_HEALTH_DUMP_DIR", "health_dumps")
+
+import pytest  # noqa: E402
+
+_FAILURE_DUMPS = {"n": 0, "max": 5}  # bound artifact size on mass failures
+
+
+def pytest_configure(config):
+    from mxnet_tpu.observability import flight_recorder
+
+    flight_recorder.install()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed \
+            and _FAILURE_DUMPS["n"] < _FAILURE_DUMPS["max"]:
+        _FAILURE_DUMPS["n"] += 1
+        try:
+            from mxnet_tpu.observability import flight_recorder
+
+            # explicit path: this hook fires BEFORE fixture teardown, so
+            # a failing health test's tmp_path dump_dir override is still
+            # in effect — the CI artifact uploads health_dumps/ only
+            out_dir = os.environ.get("MXNET_HEALTH_DUMP_DIR",
+                                     "health_dumps")
+            os.makedirs(out_dir, exist_ok=True)
+            flight_recorder.dump(
+                "test-failure:%s" % item.nodeid,
+                path=os.path.join(out_dir, "health_dump_failure_%02d.json"
+                                  % _FAILURE_DUMPS["n"]))
+        except Exception:
+            pass  # triage must never turn one failure into two

@@ -1,0 +1,357 @@
+"""Training health monitor + crash flight recorder (ISSUE 4 acceptance):
+fused non-finite detection on the step it occurs, policy semantics
+(warn/raise/skip_step, off = no-op), triage report naming the faulting
+step and tensor, kvstore staleness in the dump."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.observability import TrainingHealthError, flight_recorder, health
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import health_report  # noqa: E402
+
+
+@pytest.fixture
+def health_mode(tmp_path):
+    """Parametrizable health policy with an isolated dump dir; restores
+    the off state (and clears ring/throttle bookkeeping) afterwards."""
+    def arm(policy):
+        health.set_policy(policy)
+        flight_recorder.reset()
+        flight_recorder.configure(ring=64, dump_dir=str(tmp_path))
+        return tmp_path
+
+    yield arm
+    health.flush(allow_dump=False)   # settle any warn-mode lag-1 stash
+    health.set_policy(None)          # back to the env default (off)
+    flight_recorder.reset()
+
+
+def _toy_fit(nan_batch=None, num_batches=3, bs=4):
+    """3-step Module fit; ``nan_batch`` poisons that batch's data."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs * num_batches, 6).astype(np.float32)
+    if nan_batch is not None:
+        x[nan_batch * bs:(nan_batch + 1) * bs] = np.nan
+    y = rng.randint(0, 4, bs * num_batches).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=bs, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),))
+    return mod
+
+
+# ------------------------------------------------------------------ policy
+def test_policy_resolution_and_validation(health_mode):
+    health_mode("warn")
+    assert health.policy() == "warn" and health.active()
+    health.set_policy("off")
+    assert not health.active()
+    with pytest.raises(ValueError):
+        health.set_policy("panic")
+
+
+def test_off_policy_is_noop(health_mode):
+    health_mode("off")
+    v = health.guard_step("test", losses=[("l", mx.nd.array([np.nan]))])
+    assert v is None
+    assert flight_recorder.snapshot() == []
+
+
+# ------------------------------------------------------- fused check itself
+def test_check_fused_stats_and_first_bad_order(health_mode):
+    health_mode("warn")
+    loss = mx.nd.array(np.array([1.0, 3.0], np.float32))
+    g_ok = mx.nd.array(np.array([3.0, 4.0], np.float32))      # ||g|| = 5
+    g_bad = mx.nd.array(np.array([np.inf, 1.0], np.float32))
+    w = mx.nd.array(np.array([0.0, 2.0], np.float32))         # ||w|| > 0
+    ints = mx.nd.array(np.array([1, 2]), dtype=np.int32)      # never watched
+    v = health.check(losses=[("loss", loss)],
+                     grads=[("g_ok", g_ok), ("g_bad", g_bad), ("i", ints)],
+                     params=[("w", w)], lr=0.5, step=7)
+    assert not v.ok
+    assert v.first_bad == "grad:g_bad"          # check order loss->grad
+    assert dict(v.bad) == {"grad:g_bad": 1}
+    assert v.loss == pytest.approx(2.0)         # mean of the loss tensor
+    # norms are FINITE-masked: the inf element contributes 0, so the
+    # trajectory stays readable on the bad step
+    assert v.grad_norm == pytest.approx(np.sqrt(25.0 + 1.0))
+    assert v.param_norm == pytest.approx(2.0)
+    assert v.update_ratio == pytest.approx(0.5 * v.grad_norm / 2.0, rel=1e-5)
+
+
+def test_warn_mode_lag1_fetch_keeps_attribution(health_mode):
+    health_mode("warn")
+    good = mx.nd.array(np.ones(3, np.float32))
+    bad = mx.nd.array(np.array([np.nan, 1.0, 1.0], np.float32))
+    # warn stashes the device stats and returns the PREVIOUS verdict
+    assert health.guard_step("t", losses=[("l", good)], step=1) is None
+    v1 = health.guard_step("t", losses=[("l", bad)], step=2)
+    assert v1 is not None and v1.ok and v1.step == 1
+    v2 = health.flush()                       # settles step 2's stash
+    assert not v2.ok and v2.step == 2 and v2.first_bad == "loss:l"
+    assert v2.dump_path                       # anomaly dumped on flush
+    recs = flight_recorder.snapshot()
+    assert [r["step"] for r in recs] == [1, 2]
+
+
+# --------------------------------------------- acceptance: warn + triage
+def test_module_fit_nan_detected_on_the_step_with_triage(health_mode):
+    tmp = health_mode("warn")
+    _toy_fit(nan_batch=1)
+    recs = flight_recorder.snapshot()
+    assert [r["step"] for r in recs] == [1, 2, 3]
+    assert recs[0]["ok"] and not recs[1]["ok"]   # detected ON step 2
+    assert recs[1]["first_bad"] == "loss:softmax_output"
+    assert any(name == "grad:fc_weight" for name, _c in recs[1]["bad"])
+    assert recs[0]["grad_norm"] > 0 and recs[0]["wall_ms"] > 0
+    # HBM watermark per record (host VmHWM fallback on CPU backends)
+    assert recs[0]["hbm_bytes"] > 0
+
+    dump = flight_recorder.last_dump_path()
+    assert dump and os.path.dirname(dump) == str(tmp)
+    analysis = health_report.report(dump)
+    assert analysis["first_bad"]["step"] == 2
+    assert analysis["first_bad"]["first_bad_tensor"] == "loss:softmax_output"
+    text = health_report.format_report(analysis)
+    assert "FIRST BAD STEP: step 2" in text
+    assert "loss:softmax_output" in text
+    # dump is self-contained: env fingerprint + span tail + records
+    payload = json.load(open(dump))
+    assert payload["fingerprint"]["jax"]["version"]
+    assert payload["reason"].startswith("anomaly:module.fit")
+
+
+def test_module_fit_skip_step_keeps_params_finite(health_mode):
+    health_mode("skip_step")
+    mod = _toy_fit(nan_batch=1)
+    args, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in args.values())
+    recs = flight_recorder.snapshot()
+    assert sum(1 for r in recs if r.get("skipped")) == 1
+    assert recs[1]["skipped"] and not recs[2].get("skipped")
+
+
+# -------------------------------------------------- gluon trainer paths
+def _gluon_pair():
+    net = mx.gluon.nn.Dense(3)
+    net.initialize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1}, kvstore=None)
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 5).astype(np.float32))
+    y = mx.nd.array(np.array([0, 2], np.float32))
+    return net, loss_fn, trainer, x, y
+
+
+def test_gluon_eager_skip_step(health_mode):
+    health_mode("skip_step")
+    net, loss_fn, trainer, x, y = _gluon_pair()
+    with autograd.record():
+        loss_fn(net(x), y).backward()
+    trainer.step(2)
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.collect_params().items()}
+    bad = mx.nd.array(np.full((2, 5), np.nan, np.float32))
+    with autograd.record():
+        loss_fn(net(bad), y).backward()
+    trainer.step(2)                       # grads NaN -> update withheld
+    for k, p in net.collect_params().items():
+        now = p.data().asnumpy()
+        assert np.isfinite(now).all()
+        assert np.array_equal(now, before[k])
+    wheres = {r["where"] for r in flight_recorder.snapshot()}
+    assert "autograd.backward" in wheres and "gluon.trainer" in wheres
+
+
+def test_gluon_raise_policy_fires_in_backward(health_mode):
+    health_mode("raise")
+    net, loss_fn, trainer, x, y = _gluon_pair()
+    bad = mx.nd.array(np.full((2, 5), np.nan, np.float32))
+    with autograd.record():
+        loss = loss_fn(net(bad), y)
+    with pytest.raises(TrainingHealthError) as err:
+        loss.backward()
+    assert err.value.verdict.first_bad.startswith("loss:")
+    assert flight_recorder.last_dump_path()   # dumped before raising
+
+
+def test_compile_step_skip_keeps_params_finite(health_mode):
+    health_mode("skip_step")
+    net = mx.gluon.nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1}, kvstore=None)
+    step = trainer.compile_step(net, loss_fn)
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 5).astype(np.float32))
+    y = mx.nd.array(np.array([0, 2], np.float32))
+    step(x, y).asnumpy()
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.collect_params().items()}
+    bad = mx.nd.array(np.full((2, 5), np.nan, np.float32))
+    step(bad, y).asnumpy()
+    for k, p in net.collect_params().items():
+        now = p.data().asnumpy()
+        assert np.isfinite(now).all() and np.array_equal(now, before[k])
+    # training continues after the skipped step, no recompile
+    assert np.isfinite(step(x, y).asnumpy()).all()
+    assert step.compile_count == 1
+    skipped = [r for r in flight_recorder.snapshot() if r.get("skipped")]
+    assert len(skipped) == 1
+    assert skipped[0]["first_bad"] == "loss:loss"
+
+
+def test_skip_step_degrades_to_warn_under_dist_sync(health_mode):
+    """A worker-local skip in front of a dist_sync collective push would
+    hang the healthy workers — skip is only honored where withholding is
+    safe (local/device stores, dist_async, no store)."""
+    import types
+
+    health_mode("skip_step")
+    net, loss_fn, trainer, x, y = _gluon_pair()
+    bad = mx.nd.array(np.full((2, 5), np.nan, np.float32))
+    with autograd.record():
+        loss_fn(net(bad), y).backward()
+    trainer._kv_initialized = True
+    trainer._kvstore = types.SimpleNamespace(type="dist_sync")
+    v = trainer._health_check(0.0)
+    assert v is not None and not v.ok and not v.skip   # degraded to warn
+    trainer._kvstore = types.SimpleNamespace(type="dist_async")
+    assert trainer._health_check(0.0).skip             # async: safe to skip
+    trainer._kvstore = None
+    assert trainer._health_check(0.0).skip
+
+
+# ------------------------------------------------------------- executor
+def test_executor_health_check_names_tensor(health_mode):
+    health_mode("warn")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 4))
+    for v in ex.arg_dict.values():
+        v[:] = np.random.RandomState(0).rand(*v.shape).astype(np.float32)
+    ex.arg_dict["data"][:] = np.full((2, 4), np.nan, np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    v = ex.health_check()
+    assert v is not None and not v.ok
+    assert v.first_bad == "loss:fc_output"
+    assert any(name.startswith("grad:fc_") for name, _c in v.bad)
+
+
+# ------------------------------------------------- kvstore staleness dump
+def test_kvstore_push_staleness_lands_in_dump(health_mode):
+    health_mode("warn")
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.array(np.zeros(3, np.float32)))
+    kv.push("w", mx.nd.array(np.ones(3, np.float32)))
+    kv.push("w", mx.nd.array(np.ones(3, np.float32)))
+    path = flight_recorder.dump("test")
+    payload = json.load(open(path))
+    section = payload["providers"]["kvstore"]
+    # one live store dumps as its dict, several as {"stores": [...]} —
+    # stores leaked alive by other tests must not flake this one
+    stores = section.get("stores", [section])
+    per_key = next(s["per_key"] for s in stores
+                   if "w" in s.get("per_key", {}))
+    assert per_key["w"]["pushes"] == 2
+    assert per_key["w"]["age_s"] >= 0
+    text = health_report.format_report(health_report.report(path))
+    assert "kvstore push staleness" in text
+
+
+def test_kvstore_provider_walks_every_live_store(health_mode):
+    from mxnet_tpu.kvstore import _stores_staleness
+
+    health_mode("warn")
+    kv1 = mx.kv.create("local")
+    kv2 = mx.kv.create("local")
+    kv1.init("a", mx.nd.array(np.zeros(2, np.float32)))
+    kv1.push("a", mx.nd.array(np.ones(2, np.float32)))
+    kv2.init("b", mx.nd.array(np.zeros(2, np.float32)))
+    kv2.push("b", mx.nd.array(np.ones(2, np.float32)))
+    view = _stores_staleness()
+    stores = view.get("stores", [view])
+    keys = {k for s in stores for k in s.get("per_key", {})}
+    # a second store must not shadow the first one's staleness
+    assert {"a", "b"} <= keys
+
+
+def test_kvstore_server_health_op():
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    server = KVStoreServer()
+    try:
+        state = {}
+        server._handle(("hello", 0), state)
+        server._handle(("init", "w", np.zeros(3, np.float32)), state)
+        server._handle(("push", "w", np.ones(3, np.float32)), state)
+        ok, snap = server._handle(("health",), state)
+        assert ok == "ok"
+        assert snap["per_key"]["w"]["pushes"] == 1
+        assert snap["per_key"]["w"]["age_s"] >= 0
+        assert "0" in snap["worker_age_s"]
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------- report tool edges
+def test_health_report_compile_storm_detection(tmp_path):
+    records = [
+        {"seq": i + 1, "step": i + 1, "where": "module.fit", "ok": True,
+         "loss": 0.5, "grad_norm": 1.0, "compiles": c}
+        for i, c in enumerate([1, 3, 3, 3, 3, 5, 5, 7])]
+    path = tmp_path / "dump.json"
+    json.dump({"version": 1, "reason": "synthetic", "records": records},
+              open(path, "w"))
+    analysis = health_report.report(str(path))
+    # the seq<=3 climb (lazy first-batch compiles) is warm-up; the deep
+    # ones are storms — even a LONE recompile late in the window counts
+    assert [s["step"] for s in analysis["compile_storms"]] == [6, 8]
+    text = health_report.format_report(analysis)
+    assert "COMPILE STORM" in text
+
+    lone = [{"seq": 200 + i, "step": 200 + i, "where": "module.fit",
+             "ok": True, "compiles": 9 + (1 if i >= 5 else 0)}
+            for i in range(10)]
+    json.dump({"version": 1, "reason": "x", "records": lone},
+              open(path, "w"))
+    # a single mid-run recompile, first delta visible in the ring window,
+    # must NOT be swallowed as warm-up
+    assert [s["step"] for s in
+            health_report.report(str(path))["compile_storms"]] == [205]
+
+
+def test_health_gauges_under_telemetry(health_mode):
+    import mxnet_tpu.observability as obs
+
+    health_mode("warn")
+    obs.set_enabled(True)
+    obs.reset_metrics()
+    try:
+        g = mx.nd.array(np.array([3.0, 4.0], np.float32))
+        w = mx.nd.array(np.array([1.0, 0.0], np.float32))
+        assert health.guard_step("test", grads=[("g", g)],
+                                 params=[("w", w)], lr=0.1, step=1) is None
+        # warn mode is lag-1: the verdict lands on flush (or next call)
+        v = health.flush()
+        assert v is not None and v.step == 1 and v.ok
+        assert obs.metrics.get_value("health.checks") == 1
+        assert obs.metrics.get_value("health.grad_norm") == pytest.approx(5.0)
+        assert obs.metrics.get_value("health.update_ratio") == \
+            pytest.approx(0.5, rel=1e-5)
+    finally:
+        obs.reset_metrics()
+        obs.set_enabled(False)

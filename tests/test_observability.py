@@ -78,6 +78,37 @@ def test_counter_gauge_histogram_semantics(telemetry):
     assert c.value == 0 and h.count == 0
 
 
+def test_histogram_nonfinite_observations_do_not_poison_sum(telemetry):
+    h = obs.histogram("t.nanhist")
+    h.observe(1.0)
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe(float("-inf"))
+    h.observe(3.0)
+    # non-finite observations land in the +Inf bucket + a dropped count;
+    # sum/mean/min/max stay finite forever
+    assert h.count == 5
+    assert h.nonfinite == 3
+    assert h.sum == pytest.approx(4.0)
+    assert h.mean == pytest.approx(2.0)
+    assert h.min == 1.0 and h.max == 3.0
+
+    text = obs.dump_metrics()
+    assert "mxnet_t_nanhist_sum 4" in text          # NOT NaN
+    assert "mxnet_t_nanhist_count 5" in text
+    assert 'mxnet_t_nanhist_bucket{le="+Inf"} 5' in text
+    assert "mxnet_t_nanhist_nonfinite 3" in text
+    assert "NaN" not in text
+    # bucket monotonicity holds: +Inf cumulative equals _count
+    h._reset()
+    assert h.nonfinite == 0
+
+    # only-non-finite histogram: min/max stay 0.0, not inf/-inf
+    h.observe(float("nan"))
+    assert h.count == 1 and h.nonfinite == 1
+    assert h.min == 0.0 and h.max == 0.0 and h.mean == 0.0
+
+
 def test_noop_mode_overhead_under_1us():
     assert not M.enabled()
     assert obs.counter("noop.probe") is M.NOOP
@@ -325,3 +356,165 @@ def test_monitor_sort_orders_by_name():
     ex.forward(is_train=False)
     names = [name for _step, name, _stat in mon.toc()]
     assert names and names == sorted(names)
+
+
+def test_monitor_callback_inside_jitted_forward():
+    """The monitor docstring's jax.debug.callback path: with use_jit the
+    monitored forward runs as ONE compiled program and interior node
+    values still reach the host callback (vs the eager per-op walk)."""
+    ex = _bound_executor()
+    seen = {}
+    ex.set_monitor_callback(
+        lambda name, arr: seen.setdefault(name, arr.asnumpy()), use_jit=True)
+    outs = ex.forward(is_train=False)
+    # interior node entry fired from inside the jitted program
+    assert "fc_output" in seen
+    np.testing.assert_allclose(seen["fc_output"], outs[0].asnumpy(),
+                               rtol=1e-6)
+    assert False in ex._monitor_jit_cache  # the compiled spy program
+    # second forward reuses the cached program, callback still fires
+    seen.clear()
+    ex.forward(is_train=False)
+    assert "fc_output" in seen
+    # swapping the callback must NOT recompile (read at fire time)
+    prog = ex._monitor_jit_cache[False]
+    count = {"n": 0}
+    ex.set_monitor_callback(lambda name, arr: count.__setitem__(
+        "n", count["n"] + 1), use_jit=True)
+    ex.forward(is_train=False)
+    assert count["n"] > 0
+    assert ex._monitor_jit_cache[False] is prog
+
+
+# ------------------------------------------------------- flight recorder
+from mxnet_tpu.observability import flight_recorder  # noqa: E402
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    flight_recorder.reset()
+    flight_recorder.configure(ring=32, dump_dir=str(tmp_path))
+    yield tmp_path
+    flight_recorder.reset()
+
+
+def test_flight_recorder_ring_wraparound(recorder):
+    flight_recorder.configure(ring=8)
+    for i in range(20):
+        flight_recorder.record({"step": i})
+    recs = flight_recorder.snapshot()
+    assert len(recs) == 8
+    assert [r["step"] for r in recs] == list(range(12, 20))
+    assert recs[-1]["seq"] == 20            # seq keeps global ordering
+    # shrinking keeps the newest tail
+    flight_recorder.configure(ring=4)
+    assert [r["step"] for r in flight_recorder.snapshot()] == [16, 17, 18, 19]
+
+
+def test_flight_recorder_concurrent_record_and_dump(recorder):
+    """record() hammering from a thread while the main thread dumps:
+    every dump is complete, parseable JSON with internally-consistent
+    records, and no temp file survives (atomic rename)."""
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            flight_recorder.record({"step": i, "grad_norm": float(i)})
+            i += 1
+            if i % 64 == 0:
+                time.sleep(0.0005)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for k in range(10):
+            path = flight_recorder.dump("concurrency-%d" % k)
+            payload = json.load(open(path))      # never truncated
+            assert payload["reason"] == "concurrency-%d" % k
+            seqs = [r["seq"] for r in payload["records"]]
+            assert seqs == sorted(seqs)          # a consistent snapshot
+    finally:
+        stop.set()
+        t.join()
+    assert not [f for f in os.listdir(recorder) if ".tmp" in f]
+
+
+def test_flight_recorder_provider_errors_never_sink_dump(recorder):
+    flight_recorder.register_provider("good", lambda: {"v": 1})
+    flight_recorder.register_provider("bad", lambda: 1 / 0)
+    flight_recorder.register_provider("gone", lambda: None)
+    try:
+        payload = json.load(open(flight_recorder.dump("providers")))
+    finally:
+        # drop the test providers so later dumps stay clean
+        with flight_recorder._lock:
+            for name in ("good", "bad", "gone"):
+                flight_recorder._providers.pop(name, None)
+    assert payload["providers"]["good"] == {"v": 1}
+    assert "error" in payload["providers"]["bad"]
+    assert "gone" not in payload["providers"]
+
+
+_CRASH_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["MXNET_HEALTH_DUMP_DIR"] = %(tmp)r
+from mxnet_tpu.observability import flight_recorder
+flight_recorder.install()
+flight_recorder.record({"step": 1, "loss": 0.5})
+flight_recorder.record({"step": 2, "loss": float("nan")}, anomaly=%(anomaly)s)
+if %(raise_it)s:
+    raise RuntimeError("injected crash")
+"""
+
+
+def _run_crash(tmp_path, anomaly, raise_it):
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = _CRASH_SCRIPT % {"repo": repo, "tmp": str(tmp_path),
+                            "anomaly": anomaly, "raise_it": raise_it}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_dump_on_anomaly_throttled_claims_no_stale_path(recorder):
+    assert flight_recorder.dump_on_anomaly("first")      # fresh dump
+    flight_recorder.record({"step": 2}, anomaly=True)
+    # within the throttle window: the recent file does NOT contain this
+    # anomaly's record, so no path may be claimed for it
+    assert flight_recorder.dump_on_anomaly("second") is None
+
+
+def test_flight_recorder_clean_exit_writes_no_dump(tmp_path):
+    # records but no anomaly, clean exit: the atexit safety net must
+    # NOT write a spurious 'undumped-anomaly' file on every green run
+    proc = _run_crash(tmp_path, anomaly=False, raise_it=False)
+    assert proc.returncode == 0
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith("health_dump")]
+
+
+def test_flight_recorder_dump_on_excepthook_subprocess(tmp_path):
+    proc = _run_crash(tmp_path, anomaly=False, raise_it=True)
+    assert proc.returncode != 0
+    assert "injected crash" in proc.stderr    # original traceback preserved
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("health_dump")]
+    assert len(dumps) == 1
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert payload["reason"].startswith("uncaught:RuntimeError")
+    assert [r["step"] for r in payload["records"]] == [1, 2]
+
+
+def test_flight_recorder_atexit_flushes_undumped_anomaly(tmp_path):
+    # anomaly recorded, exception swallowed, orderly exit: the atexit
+    # safety net must still flush the story
+    proc = _run_crash(tmp_path, anomaly=True, raise_it=False)
+    assert proc.returncode == 0
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("health_dump")]
+    assert len(dumps) == 1
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert payload["reason"] == "atexit:undumped-anomaly"
+    assert payload["records"][-1]["anomaly"] is True

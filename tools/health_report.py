@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Render a flight-recorder dump into a first-bad-step triage report.
+
+Answers, from one ``health_dump_*.json`` (observability/flight_recorder.py):
+
+* **Which step went bad first, and in which tensor** — the first ring
+  record with non-finite counts, with the per-tensor breakdown.
+* **The grad-norm trajectory** — the last-K table of loss / grad norm /
+  update ratio / wall time / HBM so the blow-up's run-in is visible
+  (a steadily climbing update ratio is the classic pre-NaN signature).
+* **Compile storms** — steps whose cumulative compile counter moved
+  after warm-up (a steady-state loop must show a flat delta column).
+* **KVStore push staleness** — the per-key section dist runs embed.
+
+Usage::
+
+    python tools/health_report.py health_dump_1234_001.json
+    python tools/health_report.py dump.json --json     # machine-readable
+
+Pure stdlib; importable (``report(path)`` returns the analysis dict,
+``format_report(analysis)`` the text) for tests and notebooks.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["report", "format_report", "main"]
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if abs(v) >= 1e5 or (v != 0 and abs(v) < 1e-3):
+            return "%.3e" % v
+        return ("%%.%df" % nd) % v
+    return str(v)
+
+
+def report(path):
+    """Analyze one dump; returns a JSON-safe dict."""
+    with open(path) as f:
+        payload = json.load(f)
+    records = payload.get("records", [])
+
+    first_bad = None
+    anomalies = []
+    for rec in records:
+        if rec.get("bad"):
+            anomalies.append(rec)
+            if first_bad is None:
+                first_bad = {
+                    "step": rec.get("step"),
+                    "seq": rec.get("seq"),
+                    "where": rec.get("where"),
+                    "first_bad_tensor": rec.get("first_bad"),
+                    "bad": rec.get("bad"),
+                    "loss": rec.get("loss"),
+                    "grad_norm": rec.get("grad_norm"),
+                }
+
+    # compile-storm scan: per-record delta of the cumulative counter. An
+    # increase only counts as warm-up when it happened in the RUN's first
+    # few steps (seq is the global step counter — training front-ends
+    # compile their programs lazily over the first batches); a lone
+    # recompile deep into the run IS the storm signal, even if it is the
+    # first delta visible in the ring window.
+    storms = []
+    prev = None
+    for rec in records:
+        c = rec.get("compiles")
+        if c is None:
+            continue
+        if prev is not None and c > prev and rec.get("seq", 0) > 3:
+            storms.append({"step": rec.get("step"), "seq": rec.get("seq"),
+                           "delta": c - prev, "where": rec.get("where")})
+        prev = c
+
+    skipped = sum(1 for r in records if r.get("skipped"))
+    return {
+        "path": path,
+        "reason": payload.get("reason"),
+        "time": payload.get("time"),
+        "num_records": len(records),
+        "num_anomalies": len(anomalies),
+        "num_skipped": skipped,
+        "first_bad": first_bad,
+        "compile_storms": storms,
+        "records": records,
+        "fingerprint": payload.get("fingerprint", {}),
+        "kvstore": payload.get("providers", {}).get("kvstore"),
+        "has_metrics": bool(payload.get("metrics")),
+    }
+
+
+def _trajectory_table(records, k=24):
+    cols = ("step", "where", "loss", "grad_norm", "update_ratio",
+            "wall_ms", "hbm_mb", "compiles", "bad")
+    rows = [cols]
+    prev_compiles = None
+    for rec in records[-k:]:
+        compiles = rec.get("compiles")
+        delta = ("+%d" % (compiles - prev_compiles)
+                 if compiles is not None and prev_compiles is not None
+                 and compiles > prev_compiles else "")
+        prev_compiles = compiles if compiles is not None else prev_compiles
+        flag = ""
+        if rec.get("bad"):
+            flag = "SKIP" if rec.get("skipped") else "BAD"
+        hbm = rec.get("hbm_bytes")
+        rows.append((
+            _fmt(rec.get("step")), str(rec.get("where", ""))[:18],
+            _fmt(rec.get("loss")), _fmt(rec.get("grad_norm")),
+            _fmt(rec.get("update_ratio"), 6), _fmt(rec.get("wall_ms"), 2),
+            _fmt(hbm / 2**20 if hbm else None, 1),
+            (_fmt(compiles, 0) + delta), flag))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    return "\n".join(
+        "  ".join(cell.rjust(w) for cell, w in zip(row, widths))
+        for row in rows)
+
+
+def format_report(analysis):
+    out = []
+    out.append("flight recorder triage — %s" % analysis["path"])
+    out.append("reason: %s   dumped: %s   records: %d   anomalies: %d"
+               "   skipped updates: %d"
+               % (analysis["reason"], analysis["time"],
+                  analysis["num_records"], analysis["num_anomalies"],
+                  analysis["num_skipped"]))
+    out.append("")
+
+    fb = analysis["first_bad"]
+    if fb:
+        out.append("FIRST BAD STEP: step %s (%s)" % (fb["step"], fb["where"]))
+        out.append("  first non-finite tensor: %s" % fb["first_bad_tensor"])
+        for name, count in fb["bad"]:
+            out.append("    %-40s %d non-finite element(s)" % (name, count))
+        out.append("  loss=%s  grad_norm=%s"
+                   % (_fmt(fb["loss"]), _fmt(fb["grad_norm"])))
+    else:
+        out.append("no non-finite step in the recorded window")
+    out.append("")
+
+    storms = analysis["compile_storms"]
+    if storms:
+        out.append("COMPILE STORM: %d post-warmup recompile event(s) — a "
+                   "steady-state loop should show none" % len(storms))
+        for s in storms[:8]:
+            out.append("  step %s (%s): +%d compile(s)"
+                       % (s["step"], s["where"], s["delta"]))
+    else:
+        out.append("compile count flat after warm-up (no recompile storm)")
+    out.append("")
+
+    out.append("trajectory (last %d records):"
+               % min(24, analysis["num_records"]))
+    out.append(_trajectory_table(analysis["records"]))
+
+    kv = analysis.get("kvstore")
+    if kv:
+        out.append("")
+        out.append("kvstore push staleness:")
+        per_key = {}
+        if isinstance(kv, dict):
+            # one live store dumps as its dict, several as {"stores": []}
+            stores = kv.get("stores", [kv])
+            for i, store in enumerate(stores):
+                prefix = ("%s[%d]:" % (store.get("type", "kv"), i)
+                          if len(stores) > 1 else "")
+                for key, ent in (store.get("per_key") or {}).items():
+                    per_key[prefix + key] = ent
+        stale = sorted(per_key.items(),
+                       key=lambda it: -it[1].get("age_s", 0))
+        for key, ent in stale[:12]:
+            out.append("  %-32s pushes=%-6s last push %ss ago"
+                       % (key, ent.get("pushes"), _fmt(ent.get("age_s"), 1)))
+        if isinstance(kv, dict) and any(
+                s.get("servers") for s in kv.get("stores", [kv])):
+            out.append("  (+ per-shard server view embedded in the dump)")
+
+    fp = analysis.get("fingerprint", {})
+    env = fp.get("env", {})
+    health_env = {k: v for k, v in env.items()
+                  if k.startswith(("MXNET_HEALTH", "MXNET_TELEMETRY"))}
+    if health_env or fp.get("jax"):
+        out.append("")
+        out.append("fingerprint: jax=%s  %s"
+                   % (fp.get("jax", {}).get("version"),
+                      " ".join("%s=%s" % kv for kv in
+                               sorted(health_env.items()))))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="health_dump_*.json from the flight recorder")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as JSON instead of text")
+    args = ap.parse_args(argv)
+    analysis = report(args.dump)
+    if args.json:
+        json.dump(analysis, sys.stdout, indent=1)
+        print()
+    else:
+        print(format_report(analysis))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
