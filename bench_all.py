@@ -13,6 +13,8 @@ Configs (BASELINE.json "configs" + VERDICT r3 item 3):
   +  flash vs dense attention TRAIN (fwd+bwd, Pallas recompute backward
      vs dense autodiff) at T in {1024..8192}    — speedup + residual MB
   +  transformer-LM train step at T=2048 and T=4096 — tokens/sec, MFU
+  +  serving engine vs naive per-request loop under Poisson arrivals
+     (resnet50 inference)                       — throughput ratio + p50/p99
 
 Writes BENCH_ALL.json (repo root by default) and prints it. Each entry is
 measured independently and failures are recorded, not fatal, so one slow
@@ -465,6 +467,120 @@ def bench_transformer_lm(B=None, T=None):
             "mfu_spec": round(6 * n_par * B * T / dt / 197e12, 4)}
 
 
+def bench_serving_resnet50():
+    """Serving engine vs the naive per-request executor-forward loop,
+    same Poisson arrival schedule for both (ISSUE 5 acceptance: >=3x
+    throughput at equal-or-better p99). The offered rate is set to ~4x
+    the measured per-request capacity, so the naive loop saturates while
+    the engine absorbs the backlog by coalescing into batch buckets."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    size, layers = (32, 18) if QUICK else (224, 50)
+    buckets = (1, 2, 4) if QUICK else (1, 2, 4, 8, 16, 32)
+    n_req = 24 if QUICK else 256
+    sym = mx.models.get_resnet(num_classes=1000, num_layers=layers,
+                               image_shape=(3, size, size), layout="NHWC")
+    ctx = mx.gpu() if mx.context.num_gpus() else mx.cpu()
+    rng = np.random.RandomState(0)
+    ex = sym.simple_bind(ctx, data=(1, size, size, 3), grad_req="null")
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = (rng.randn(*v.shape) * 0.01).astype(np.float32)
+    img = rng.rand(size, size, 3).astype(np.float32)
+    ex.arg_dict["data"][:] = img[None]
+    ex.forward()
+    ex.outputs[0].asnumpy()  # compile + warm
+
+    # per-request capacity of the naive loop -> offered Poisson rate.
+    # The measured ratio is capped by this overload factor (the engine
+    # cannot beat the arrival rate once it keeps up), so the full run
+    # offers 8x to leave the >=3x acceptance bar real headroom.
+    t0 = time.perf_counter()
+    probe = 3 if QUICK else 10
+    for _ in range(probe):
+        ex.forward()
+        ex.outputs[0].asnumpy()
+    t1 = (time.perf_counter() - t0) / probe
+    overload = 4.0 if QUICK else 8.0
+    arrivals = np.cumsum(rng.exponential(t1 / overload, n_req))
+
+    def percentiles(lat):
+        return (round(float(np.percentile(lat, 50)) * 1e3, 2),
+                round(float(np.percentile(lat, 99)) * 1e3, 2))
+
+    def run_baseline():
+        lat = []
+        start = time.perf_counter()
+        for a in arrivals:
+            now = time.perf_counter() - start
+            if now < a:
+                time.sleep(a - now)
+            ex.forward()
+            ex.outputs[0].asnumpy()
+            lat.append(time.perf_counter() - start - a)
+        wall = (time.perf_counter() - start) - arrivals[0]
+        return lat, n_req / wall
+
+    def run_serving():
+        arg_params = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+        server = InferenceServer(
+            sym, arg_params, aux_params=dict(ex.aux_dict),
+            data_shapes=[("data", (1, size, size, 3))],
+            config=ServingConfig(buckets=buckets, max_wait_ms=5))
+        try:
+            server.warmup()
+            lat = [None] * n_req
+            start = time.perf_counter()
+
+            def make_cb(i, a):
+                def cb(_fut):
+                    lat[i] = time.perf_counter() - start - a
+                return cb
+
+            futs = []
+            for i, a in enumerate(arrivals):
+                now = time.perf_counter() - start
+                if now < a:
+                    time.sleep(a - now)
+                fut = server.submit(img)
+                fut.add_done_callback(make_cb(i, a))
+                futs.append(fut)
+            for f in futs:
+                f.result()
+            wall = (time.perf_counter() - start) - arrivals[0]
+            # result() waiters wake BEFORE done-callbacks run, so the
+            # last lat[i] writes can still be in flight — settle them
+            deadline = time.perf_counter() + 10.0
+            while any(v is None for v in lat):
+                if time.perf_counter() > deadline:
+                    raise RuntimeError("latency callbacks never settled")
+                time.sleep(0.001)
+            return lat, n_req / wall, server.get_stats()
+        finally:
+            server.stop()
+
+    base_lat, base_rps = run_baseline()
+    srv_lat, srv_rps, stats = run_serving()
+    b50, b99 = percentiles(base_lat)
+    s50, s99 = percentiles(srv_lat)
+    return {"value": round(srv_rps / base_rps, 2),
+            "unit": "x throughput vs per-request executor loop",
+            "protocol": ("resnet%d %dx%d NHWC bs1 requests, Poisson "
+                         "arrivals at %gx naive capacity, %d requests, "
+                         "buckets %s" % (layers, size, size, overload,
+                                         n_req, list(buckets))),
+            "baseline_rps": round(base_rps, 1),
+            "serving_rps": round(srv_rps, 1),
+            "baseline_p50_ms": b50, "baseline_p99_ms": b99,
+            "serving_p50_ms": s50, "serving_p99_ms": s99,
+            "p99_ok": s99 <= b99,
+            "batches": stats["batches"],
+            "mean_batch_rows": round(stats["rows_real"]
+                                     / max(1, stats["batches"]), 2),
+            "bucket_programs": stats["bucket_programs"]}
+
+
 BENCHES = [
     ("resnet50_train_bs32", bench_resnet50_train),
     ("resnet50_infer_bs32", bench_resnet50_infer),
@@ -480,6 +596,8 @@ BENCHES = [
     ("transformer_lm_T4096",
      functools.partial(bench_transformer_lm, B=2 if QUICK else 4,
                        T=256 if QUICK else 4096)),
+    # request path: micro-batched bucketed serving vs the naive loop
+    ("serving_resnet50", bench_serving_resnet50),
 ]
 
 

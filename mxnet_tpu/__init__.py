@@ -66,6 +66,7 @@ from . import contrib
 from . import visualization
 from . import visualization as viz
 from . import parallel
+from . import serving
 from . import models
 from . import gluon
 from . import rnn

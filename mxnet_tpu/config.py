@@ -104,6 +104,33 @@ Flags currently honored:
     temp+rename). String-valued, env-only;
     ``flight_recorder.configure(dump_dir=...)`` overrides at runtime.
 
+``MXNET_SERVING_MAX_WAIT_MS`` (default 5)
+    Micro-batching deadline of the serving engine (serving/engine.py):
+    the dispatcher coalesces queued requests into the largest batch
+    bucket available within this many milliseconds of the oldest queued
+    request's admission; a full bucket flushes immediately. 0 disables
+    coalescing-by-waiting (every collect flushes whatever is queued).
+
+``MXNET_SERVING_QUEUE`` (default 1024)
+    Admission-queue bound, in ROWS. Beyond it the configured
+    backpressure applies: ``MXNET_SERVING_BACKPRESSURE=block`` (default)
+    stalls submitters, ``reject`` raises QueueFullError. The
+    backpressure policy itself is a string env var (not integer
+    get_flag machinery), like MXNET_HEALTH.
+
+``MXNET_SERVING_PIPELINE`` (default 2)
+    In-flight batch window of the pipelined dispatcher: batch N+1 is
+    staged and dispatched while batch N executes; host fetches drain
+    when the window is full. 2 = classic double buffering; 1 disables
+    the overlap (debug).
+
+``MXNET_SERVING_BUCKETS`` (default ``1,2,4,8,16,32``)
+    Comma-separated batch-bucket ladder of the serving engine. Requests
+    are padded up to the smallest fitting bucket, so the steady-state
+    compile count is bounded by len(buckets) x replicas, never by
+    traffic. String-valued, env-only (pass ``buckets=`` to
+    ServingConfig to override at runtime).
+
 ``MXNET_PROFILER_MODE`` (default ``symbolic``)
     Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
     trace can be captured from an unmodified script via env alone;
@@ -137,6 +164,9 @@ _DEFAULTS = {
     "MXNET_TELEMETRY_MEMSTATS": 1,
     "MXNET_TELEMETRY_RETRACE": 0,
     "MXNET_HEALTH_RING": 256,
+    "MXNET_SERVING_MAX_WAIT_MS": 5,
+    "MXNET_SERVING_QUEUE": 1024,
+    "MXNET_SERVING_PIPELINE": 2,
 }
 
 

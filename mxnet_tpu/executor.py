@@ -73,7 +73,10 @@ class _GraphProgram:
             if n.op in self._INIT_OPS
             and 0 in tuple(n.parsed_attrs().get("shape", ()))]
         self._init_shape_cache = {}
-        self._jit_cache = {}
+        import threading
+
+        self._jit_cache = {}  # guarded-by: self._jit_lock
+        self._jit_lock = threading.Lock()
 
     def _resolve_init_shapes(self, arg_shapes):
         """Infer concrete shapes for deferred init-op nodes given the bound
@@ -164,8 +167,10 @@ class _GraphProgram:
                 import jax
 
                 dev = ctx_map[id(node)]
-                ins = [jax.device_put(x, dev) for x in ins]
-                auxs = [jax.device_put(x, dev) for x in auxs]
+                # ONE pytree transfer instead of len(ins)+len(auxs)
+                # per-array dispatches — device_put batches the whole
+                # cross-device copy into a single host round-trip
+                ins, auxs = jax.device_put((ins, auxs), dev)
             rng = None
             if opdef.needs_rng:
                 rng = rngs[rng_i[0]]
@@ -187,36 +192,39 @@ class _GraphProgram:
 
     # --- compiled entry points --------------------------------------------
     def infer_fn(self):
-        import jax
+        # locked check-then-set: concurrent callers (serving warmup vs
+        # its dispatcher thread) must share ONE jit wrapper, or the same
+        # bucket shape compiles twice
+        with self._jit_lock:
+            if "infer" not in self._jit_cache:
+                def f(arg_d, aux_d, rngs):
+                    outs, _ = self._eval(arg_d, aux_d, rngs, False)
+                    return outs
 
-        if "infer" not in self._jit_cache:
-            def f(arg_d, aux_d, rngs):
-                outs, _ = self._eval(arg_d, aux_d, rngs, False)
-                return outs
-
-            self._jit_cache["infer"] = _maybe_jit(f)
-        return self._jit_cache["infer"]
+                self._jit_cache["infer"] = _maybe_jit(f)
+            return self._jit_cache["infer"]
 
     def train_fn(self, grad_names):
         """One fused program: outputs + aux updates + grads w.r.t. grad_names."""
         import jax
 
         key = ("train", tuple(grad_names))
-        if key not in self._jit_cache:
-            def f(nograd_d, grad_d, aux_d, rngs, seeds):
-                def inner(gd):
-                    merged = dict(nograd_d)
-                    merged.update(gd)
-                    outs, aux_upd = self._eval(merged, aux_d, rngs, True)
-                    return tuple(outs), aux_upd
+        with self._jit_lock:
+            if key not in self._jit_cache:
+                def f(nograd_d, grad_d, aux_d, rngs, seeds):
+                    def inner(gd):
+                        merged = dict(nograd_d)
+                        merged.update(gd)
+                        outs, aux_upd = self._eval(merged, aux_d, rngs, True)
+                        return tuple(outs), aux_upd
 
-                inner = _maybe_mirror(inner)
-                outs, vjp, aux_upd = jax.vjp(inner, grad_d, has_aux=True)
-                grads = vjp(tuple(seeds))[0]
-                return outs, aux_upd, grads
+                    inner = _maybe_mirror(inner)
+                    outs, vjp, aux_upd = jax.vjp(inner, grad_d, has_aux=True)
+                    grads = vjp(tuple(seeds))[0]
+                    return outs, aux_upd, grads
 
-            self._jit_cache[key] = _maybe_jit(f)
-        return self._jit_cache[key]
+                self._jit_cache[key] = _maybe_jit(f)
+            return self._jit_cache[key]
 
 
 class Executor:

@@ -25,7 +25,7 @@ from .ndarray import NDArray
 __all__ = ["DataDesc", "DataBatch", "DataIter", "MXDataIter",
            "ResizeIter", "PrefetchingIter", "NDArrayIter", "MNISTIter",
            "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
-           "LibSVMIter"]
+           "LibSVMIter", "pad_batch_to_bound"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -77,6 +77,55 @@ class DataBatch:
         label_shapes = [l.shape for l in self.label] if self.label else None
         return "{}: data shapes: {} label shapes: {}".format(
             self.__class__.__name__, data_shapes, label_shapes)
+
+
+def _pad_rows(arr, extra):
+    return nd.concatenate(
+        [arr, nd.zeros((extra,) + tuple(arr.shape[1:]), dtype=arr.dtype)])
+
+
+def pad_batch_to_bound(batch, data_descs, label_descs=None):
+    """Pad a trailing short batch up to the bound batch size.
+
+    A short final batch used to re-bind (and re-compile) the executor
+    for its one-off shape — one XLA program per leftover size. Instead,
+    pad the batch's data (and labels, when bound) with zero rows up to
+    the shapes in ``data_descs``/``label_descs`` and let the caller
+    slice the outputs back down; the bound-shape program serves every
+    batch of the epoch. Returns ``(batch, extra)`` where ``extra`` is
+    the number of synthetic rows appended (0 means the original batch
+    came back untouched — full-size batches, non-leading batch axes,
+    and bucketing batches, whose shapes the bucket key owns).
+    """
+    if batch.bucket_key is not None or not batch.data:
+        return batch, 0
+    # accept bare (name, shape) pairs — the form user iterators may
+    # expose as provide_data — alongside DataDesc
+    data_descs = [d if isinstance(d, DataDesc) else DataDesc(d[0], d[1])
+                  for d in data_descs]
+    if label_descs:
+        label_descs = [d if isinstance(d, DataDesc) else DataDesc(d[0], d[1])
+                       for d in label_descs]
+    axes = [DataDesc.get_batch_axis(getattr(d, "layout", None) or "NCHW")
+            for d in data_descs]
+    if any(axis != 0 for axis in axes):
+        return batch, 0
+    incoming = batch.data[0].shape[0]
+    bound = data_descs[0].shape[0]
+    extra = bound - incoming
+    if extra <= 0:
+        return batch, 0
+    data = [_pad_rows(arr, desc.shape[0] - arr.shape[0])
+            if desc.shape[0] > arr.shape[0] else arr
+            for arr, desc in zip(batch.data, data_descs)]
+    label = batch.label
+    if label and label_descs:
+        label = [_pad_rows(arr, desc.shape[0] - arr.shape[0])
+                 if desc.shape[0] > arr.shape[0] else arr
+                 for arr, desc in zip(label, label_descs)]
+    padded = DataBatch(data=data, label=label, pad=(batch.pad or 0) + extra,
+                       index=batch.index)
+    return padded, extra
 
 
 class DataIter:

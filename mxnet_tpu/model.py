@@ -290,12 +290,18 @@ class FeedForward:
             sink.extend(a.asnumpy() for a in buf)
             del buf[:]
 
+        from .io import pad_batch_to_bound
+
         host_out, host_data, host_label = [], [], []
         for i, batch in enumerate(data_iter):
             if num_batch is not None and i == num_batch:
                 break
-            mod.forward(batch, is_train=False)
-            keep = batch.data[0].shape[0] - batch.pad
+            # a trailing short batch is padded up to the bound shape and
+            # sliced back, instead of re-binding (one XLA compile per
+            # leftover size) — same discipline as base_module predict
+            fwd, _extra = pad_batch_to_bound(batch, data_iter.provide_data)
+            mod.forward(fwd, is_train=False)
+            keep = batch.data[0].shape[0] - (batch.pad or 0)
             outputs.append(mod.get_outputs()[0][:keep])
             if return_data:
                 datas.append(batch.data[0][:keep])
@@ -321,6 +327,8 @@ class FeedForward:
         """Metric value over X (requires labels in the iterator)."""
         from . import metric as metric_mod
 
+        from .io import pad_batch_to_bound
+
         data_iter = self._as_iter(X)
         if reset:
             data_iter.reset()
@@ -330,8 +338,13 @@ class FeedForward:
         for i, batch in enumerate(data_iter):
             if num_batch is not None and i == num_batch:
                 break
-            mod.forward(batch, is_train=False)
-            metric.update(batch.label, mod.get_outputs())
+            fwd, extra = pad_batch_to_bound(batch, data_iter.provide_data)
+            mod.forward(fwd, is_train=False)
+            outs = mod.get_outputs()
+            if extra:
+                n = batch.data[0].shape[0]
+                outs = [o[:n] for o in outs]
+            metric.update(batch.label, outs)
             if batch_end_callback is not None:
                 cbs = (batch_end_callback
                        if isinstance(batch_end_callback, list)

@@ -205,7 +205,15 @@ class BaseModule:
     # ---------------------------------------------------------- inference
     def _inference_batches(self, eval_data, num_batch, reset):
         """Forward (is_train=False) over eval_data, yielding
-        (index, batch, depadded outputs)."""
+        (index, original batch, depadded outputs, extra pad rows).
+
+        A trailing short batch is padded up to the bound batch size and
+        the outputs are sliced back, instead of re-binding (and
+        re-compiling) the executor for the leftover shape — the bound
+        program serves every batch (regression-tested via the jit
+        compile counter in tests/test_serving.py)."""
+        from ..io import pad_batch_to_bound
+
         if not (self.binded and self.params_initialized):
             raise AssertionError("call bind and init_params first")
         if reset:
@@ -213,9 +221,12 @@ class BaseModule:
         for i, batch in enumerate(eval_data):
             if num_batch is not None and i == num_batch:
                 return
-            self.forward(batch, is_train=False)
-            keep = lambda o, _pad=batch.pad: o[0:o.shape[0] - _pad]  # noqa: E731
-            yield i, batch, [keep(o) for o in self.get_outputs()]
+            fwd, extra = pad_batch_to_bound(batch, self.data_shapes,
+                                            self.label_shapes)
+            self.forward(fwd, is_train=False)
+            pad = (batch.pad or 0) + extra
+            keep = lambda o, _pad=pad: o[0:o.shape[0] - _pad]  # noqa: E731
+            yield i, batch, [keep(o) for o in self.get_outputs()], extra
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
@@ -224,9 +235,19 @@ class BaseModule:
         eval_metric = _resolve_metric(eval_metric)
         eval_metric.reset()
         seen = 0
-        for nbatch, batch, _outs in self._inference_batches(
+        for nbatch, batch, outs, extra in self._inference_batches(
                 eval_data, num_batch, reset):
-            self.update_metric(eval_metric, batch.label)
+            if extra:
+                # the executors ran on a padded batch; score the true
+                # rows exactly (synthetic zero rows never reach the
+                # metric — unlike pad-mode iterators, whose wrap-around
+                # rows the reference metric path has always counted)
+                pad = batch.pad or 0
+                labels = [lbl[0:lbl.shape[0] - pad]
+                          for lbl in (batch.label or [])]
+                eval_metric.update(labels, outs)
+            else:
+                self.update_metric(eval_metric, batch.label)
             _fire(batch_end_callback,
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=eval_metric, locals=locals()))
@@ -238,8 +259,8 @@ class BaseModule:
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         """Generator over (outputs, batch index, batch)."""
-        for i, batch, outs in self._inference_batches(eval_data, num_batch,
-                                                      reset):
+        for i, batch, outs, _extra in self._inference_batches(
+                eval_data, num_batch, reset):
             yield outs, i, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
@@ -247,8 +268,8 @@ class BaseModule:
         """Collect predictions; optionally concatenate across batches."""
         collected = [
             [o.copy() for o in outs]
-            for _i, _batch, outs in self._inference_batches(eval_data,
-                                                            num_batch, reset)]
+            for _i, _batch, outs, _extra in self._inference_batches(
+                eval_data, num_batch, reset)]
         if not collected:
             return collected
         if not merge_batches:

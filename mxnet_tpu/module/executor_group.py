@@ -170,7 +170,12 @@ class DataParallelExecutorGroup:
         if (data_shapes, label_shapes) == (self.data_shapes,
                                            self.label_shapes):
             return
-        self.bind_exec(data_shapes, label_shapes, reshape=True)
+        # share the outgoing executors' compiled-program cache: a
+        # re-bind for a new batch shape is then a jit cache re-key on
+        # one shared program object, so a shape seen before (e.g.
+        # alternating batch sizes) never recompiles
+        self.bind_exec(data_shapes, label_shapes, shared_group=self,
+                       reshape=True)
 
     def _replica_descs(self, shapes, i, axes):
         """Input descs for replica ``i``: batch axis cut to its slice."""

@@ -5,7 +5,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["make_mesh", "data_parallel_sharding", "replicated_sharding"]
+__all__ = ["make_mesh", "data_parallel_sharding", "replicated_sharding",
+           "replica_devices"]
 
 
 def make_mesh(axes=None, devices=None):
@@ -29,6 +30,31 @@ def make_mesh(axes=None, devices=None):
                          % (total, len(devices)))
     dev_array = np.array(devices[:total]).reshape(sizes)
     return Mesh(dev_array, axis_names=names)
+
+
+def replica_devices(mesh=None, axis=None):
+    """Flat device list for replica round-robin dispatch (the serving
+    engine's multi-chip layout). With ``axis`` the list is the devices
+    along that mesh axis (one serving replica per data-parallel slot,
+    e.g. ``axis='dp'`` on a {'dp': 4, 'mp': 2} mesh picks the 4 dp-axis
+    leads); without it, every device in the mesh (or, with no mesh,
+    every visible device) is a replica."""
+    import jax
+
+    if mesh is None:
+        if axis is not None:
+            raise ValueError(
+                "axis=%r needs a mesh to select from; pass mesh= or drop "
+                "axis" % (axis,))
+        return list(jax.devices())
+    if axis is None:
+        return [d for d in mesh.devices.flat]
+    if axis not in mesh.axis_names:
+        raise ValueError("axis %r not in mesh axes %s"
+                         % (axis, list(mesh.axis_names)))
+    sel = [0] * mesh.devices.ndim
+    sel[list(mesh.axis_names).index(axis)] = slice(None)
+    return [d for d in mesh.devices[tuple(sel)].flat]
 
 
 def data_parallel_sharding(mesh, axis="dp"):

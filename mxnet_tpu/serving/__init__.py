@@ -1,0 +1,9 @@
+"""TPU-native inference serving: shape-bucketed compiled-program cache,
+dynamic micro-batching, pipelined dispatch (docs/serving.md)."""
+from .buckets import DEFAULT_BUCKETS, parse_buckets, pick_bucket
+from .engine import (InferenceServer, QueueFullError, ServerClosedError,
+                     ServingConfig)
+
+__all__ = ["InferenceServer", "ServingConfig", "QueueFullError",
+           "ServerClosedError", "parse_buckets", "pick_bucket",
+           "DEFAULT_BUCKETS"]

@@ -1,0 +1,60 @@
+"""Batch-shape bucketing: the compile-key discipline of the serving engine.
+
+One XLA program exists per input *shape*; a serving path that binds one
+program per observed request size compiles without bound (the
+``base_module.predict`` failure mode this subsystem replaces, and the
+batch-shape-as-compile-key treatment the TVM lineage applies to serving —
+ISSUE 5 / arXiv:1802.04799). Requests are therefore padded up to a small
+fixed ladder of batch buckets; the steady-state compile count is bounded
+by ``len(buckets) * n_replicas``, never by traffic.
+
+Power-of-two buckets keep the ladder short (waste is bounded by 2x minus
+one row) and keep every bucket a multiple of the TPU's 8-row sublane
+tiling once the ladder passes 8.
+"""
+from __future__ import annotations
+
+import bisect
+import os
+
+__all__ = ["parse_buckets", "pick_bucket", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def parse_buckets(spec=None):
+    """Resolve the bucket ladder: explicit ``spec`` (iterable or
+    comma-separated string) > ``MXNET_SERVING_BUCKETS`` env > the
+    power-of-two default. Returns a sorted tuple of unique positive ints.
+    """
+    if spec is None:
+        spec = os.environ.get("MXNET_SERVING_BUCKETS", "")
+        if not spec.strip():
+            return DEFAULT_BUCKETS
+    if isinstance(spec, str):
+        try:
+            spec = [int(tok) for tok in spec.replace(",", " ").split()]
+        except ValueError:
+            raise ValueError(
+                "bucket spec must be comma-separated ints, got %r" % (spec,))
+    buckets = tuple(sorted(set(int(b) for b in spec)))
+    if not buckets:
+        raise ValueError("bucket spec resolved to an empty ladder")
+    if buckets[0] < 1:
+        raise ValueError("buckets must be positive, got %s" % (buckets,))
+    return buckets
+
+
+def pick_bucket(n_rows, buckets):
+    """Smallest bucket that fits ``n_rows`` (the padding target). Rows
+    beyond the largest bucket are the *caller's* problem — the engine
+    splits oversize requests at admission so the dispatcher only ever
+    sees request groups that fit one bucket."""
+    if n_rows < 1:
+        raise ValueError("need at least one row, got %d" % n_rows)
+    i = bisect.bisect_left(buckets, n_rows)
+    if i == len(buckets):
+        raise ValueError(
+            "%d rows exceed the largest bucket %d (the engine must chunk "
+            "oversize requests before bucketing)" % (n_rows, buckets[-1]))
+    return buckets[i]

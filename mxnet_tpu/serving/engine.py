@@ -1,0 +1,679 @@
+"""High-throughput inference serving on top of the symbolic executor.
+
+The training side already went TPU-native — ``compile_step`` fuses the
+whole optimization step into one XLA program and amortizes dispatch.
+This module gives the *request path* the same treatment (ISSUE 5):
+
+* **Shape-bucketed compiled-program cache.** Every request is padded up
+  to a small ladder of batch buckets (:mod:`.buckets`), so the
+  steady-state compile count is ``len(buckets) * n_replicas`` — bounded
+  by configuration, never by traffic. Outputs are sliced back to each
+  request's true row count before delivery.
+* **Dynamic micro-batching.** An admission queue coalesces concurrent
+  requests into the largest bucket available within a latency deadline
+  (``MXNET_SERVING_MAX_WAIT_MS``); a full bucket flushes immediately.
+  The queue is bounded (``MXNET_SERVING_QUEUE`` rows) with configurable
+  backpressure: ``block`` stalls submitters, ``reject`` raises
+  :class:`QueueFullError`. Results route back through per-request
+  futures; batching never reorders requests (FIFO admission, FIFO
+  completion).
+* **Pipelined dispatch.** The dispatcher keeps up to
+  ``MXNET_SERVING_PIPELINE`` batches in flight: batch N+1 is staged
+  (one pytree ``device_put``) and dispatched while batch N executes,
+  and host fetches drain in that bounded window — the serving-path
+  extension of the bounded-window fetch fix in ``FeedForward.predict``.
+  Replicas (one per device, round-robin) come from an explicit device
+  list or the mesh utilities (:func:`parallel.mesh.replica_devices`).
+
+The compute itself reuses the executor's :class:`_GraphProgram`: ONE
+jitted whole-graph program per (bucket shape, device), shared across
+every request that lands in that bucket.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..base import MXNetError
+from ..executor import _GraphProgram
+from .buckets import parse_buckets, pick_bucket
+
+__all__ = ["ServingConfig", "InferenceServer", "QueueFullError",
+           "ServerClosedError"]
+
+
+class QueueFullError(MXNetError):
+    """Raised by ``submit`` under ``backpressure='reject'`` when the
+    admission queue has no room for the request's rows."""
+
+
+class ServerClosedError(MXNetError):
+    """Raised by ``submit`` after ``stop()`` (or for requests aborted by
+    a non-draining shutdown)."""
+
+
+class ServingConfig:
+    """Tuning knobs for :class:`InferenceServer`.
+
+    Defaults come from the ``MXNET_SERVING_*`` environment (see
+    docs/serving.md for the tuning table); every field can be overridden
+    per-instance.
+    """
+
+    def __init__(self, buckets=None, max_wait_ms=None, max_queue_rows=None,
+                 backpressure=None, pipeline_depth=None):
+        import os
+
+        from ..config import get_flag
+
+        self.buckets = parse_buckets(buckets)
+        self.max_wait_ms = (get_flag("MXNET_SERVING_MAX_WAIT_MS")
+                            if max_wait_ms is None else float(max_wait_ms))
+        self.max_queue_rows = (get_flag("MXNET_SERVING_QUEUE")
+                               if max_queue_rows is None
+                               else int(max_queue_rows))
+        self.backpressure = (backpressure if backpressure is not None
+                             else os.environ.get("MXNET_SERVING_BACKPRESSURE",
+                                                 "block"))
+        self.pipeline_depth = (get_flag("MXNET_SERVING_PIPELINE")
+                               if pipeline_depth is None
+                               else int(pipeline_depth))
+        if self.backpressure not in ("block", "reject"):
+            raise ValueError("backpressure must be 'block' or 'reject', "
+                             "got %r" % (self.backpressure,))
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if self.max_queue_rows < self.buckets[-1]:
+            raise ValueError(
+                "max_queue_rows (%d) must fit at least one largest bucket "
+                "(%d)" % (self.max_queue_rows, self.buckets[-1]))
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+
+
+class _Assembly:
+    """Routes one submitted request's slices back to its future.
+
+    Oversize requests are chunked at admission (each chunk <= the
+    largest bucket, so the dispatcher never splits mid-batch); the parts
+    reassemble here. ``n_parts == 1`` is the common, unchunked case.
+    """
+
+    __slots__ = ("future", "parts", "remaining", "squeeze", "lock")
+
+    def __init__(self, future, n_parts, squeeze):
+        self.future = future
+        self.parts = [None] * n_parts
+        self.remaining = n_parts
+        self.squeeze = squeeze
+        self.lock = threading.Lock()
+
+    def deliver(self, idx, pieces):
+        """``pieces``: one host array of this part's rows per output.
+        Returns True when this delivery completed the whole request."""
+        with self.lock:
+            self.parts[idx] = pieces
+            self.remaining -= 1
+            done = self.remaining == 0
+        if not done:
+            return False
+        outs = [np.concatenate([p[i] for p in self.parts])
+                if len(self.parts) > 1 else self.parts[0][i]
+                for i in range(len(self.parts[0]))]
+        if self.squeeze:
+            outs = [o[0] for o in outs]
+        try:
+            self.future.set_result(outs[0] if len(outs) == 1 else outs)
+        except Exception:
+            # the caller cancelled (or a racing fail() landed first) —
+            # the dispatcher must never die over one dead future
+            return False
+        return True
+
+    def fail(self, err):
+        try:
+            if not self.future.done():
+                self.future.set_exception(err)
+        except Exception:
+            pass  # cancelled between the check and the set: same outcome
+
+
+class _Request:
+    """One admission-queue entry (a whole request, or one chunk of an
+    oversize one)."""
+
+    __slots__ = ("arrays", "n", "assembly", "part", "t_submit")
+
+    def __init__(self, arrays, n, assembly, part, t_submit):
+        self.arrays = arrays
+        self.n = n
+        self.assembly = assembly
+        self.part = part
+        self.t_submit = t_submit
+
+
+_InFlight = collections.namedtuple(
+    "_InFlight", ["outs", "reqs", "bucket", "rows", "replica"])
+
+# every live server, GC-pruned — walked by ONE "serving" flight-recorder
+# provider so crash dumps carry queue/in-flight state without a per-
+# instance registration that a later throwaway server could shadow
+# (same discipline as kvstore._live_stores)
+_live_servers = weakref.WeakSet()
+
+
+def _servers_state():
+    views = []
+    for srv in list(_live_servers):
+        try:
+            views.append(srv.get_stats())
+        except Exception as err:
+            views.append({"error": repr(err)})
+    if not views:
+        return None
+    return views[0] if len(views) == 1 else {"servers": views}
+
+
+class InferenceServer:
+    """Micro-batching, shape-bucketing inference engine for one Symbol.
+
+    ::
+
+        server = serving.InferenceServer(
+            sym, arg_params, aux_params,
+            data_shapes=[("data", (1, 224, 224, 3))])
+        server.warmup()                      # compile every bucket
+        fut = server.submit(one_image)       # -> concurrent Future
+        probs = fut.result()
+        server.stop()                        # drains in-flight requests
+
+    ``data_shapes`` follows the Module convention — (name, shape) pairs
+    whose leading dim is the batch axis; the batch entry itself is
+    ignored (buckets replace it). All non-data arguments missing from
+    ``arg_params`` (e.g. a SoftmaxOutput label) are zero-filled at their
+    inferred per-bucket shapes, matching ``simple_bind``.
+    """
+
+    def __init__(self, symbol, arg_params, aux_params=None, data_shapes=None,
+                 devices=None, mesh=None, config=None, start=True):
+        import jax
+
+        if data_shapes is None:
+            raise ValueError("data_shapes is required: [(name, shape), ...] "
+                             "with the batch axis leading")
+        self._symbol = symbol
+        self._prog = _GraphProgram(symbol)
+        self._cfg = config or ServingConfig()
+        self._data_names = [d[0] for d in data_shapes]
+        self._row_shapes = [tuple(d[1][1:]) for d in data_shapes]
+        unknown = [n for n in self._data_names
+                   if n not in symbol.list_arguments()]
+        if unknown:
+            raise MXNetError("data names %s not in symbol arguments"
+                             % unknown)
+
+        if devices is None:
+            from ..parallel.mesh import replica_devices
+
+            devices = replica_devices(mesh) if mesh is not None \
+                else jax.devices()[:1]
+        self._devices = list(devices)
+
+        # per-replica resident parameters: ONE pytree transfer per device
+        # at construction; requests only ever move activations
+        host_args = {k: self._as_np(v) for k, v in (arg_params or {}).items()
+                     if k not in self._data_names}
+        host_aux = {k: self._as_np(v) for k, v in (aux_params or {}).items()}
+        self._replica_args = [jax.device_put(host_args, dev)
+                              for dev in self._devices]
+        self._replica_aux = [jax.device_put(host_aux, dev)
+                             for dev in self._devices]
+        self._arg_dtypes = self._infer_dtypes()
+
+        self._lock = threading.Lock()
+        self._stats = collections.Counter()   # guarded-by: self._lock
+        self._programs = set()  # (replica, bucket) pairs dispatched  # guarded-by: self._lock
+        self._bucket_extras = {}  # (replica, bucket) -> (extra args, aux)  # guarded-by: self._lock
+
+        self._cond = threading.Condition()
+        self._queue = collections.deque()     # guarded-by: self._cond
+        self._queued_rows = 0                 # guarded-by: self._cond
+        self._stop = False                    # guarded-by: self._cond
+        self._abort = False                   # guarded-by: self._cond
+
+        # dispatcher-thread-only state (no lock): the bounded in-flight
+        # window and the round-robin replica cursor
+        self._inflight = collections.deque()
+        self._rr = 0
+
+        self._thread = None
+        self._life = threading.Lock()  # serializes start()/stop()
+        _live_servers.add(self)
+        from ..observability import flight_recorder
+
+        flight_recorder.register_provider("serving", _servers_state)
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ plumbing
+    @staticmethod
+    def _as_np(arr):
+        """Host staging at the admission boundary: NDArray inputs fetch
+        here ONCE, before queuing — never on the dispatch hot path."""
+        if hasattr(arr, "asnumpy"):
+            return arr.asnumpy()  # graftlint: disable=G001 — admission-time host staging, not a hot-loop sync
+        return np.asarray(arr)
+
+    def _infer_dtypes(self):
+        """Argument dtypes from graph type inference (float32 fallback)."""
+        try:
+            arg_types, _, _ = self._symbol.infer_type()
+            return {n: t for n, t in zip(self._symbol.list_arguments(),
+                                         arg_types) if t is not None}
+        except Exception:
+            return {}
+
+    @classmethod
+    def from_module(cls, module, **kwargs):
+        """Serve a bound, initialized Module's symbol + parameters."""
+        arg_params, aux_params = module.get_params()
+        kwargs.setdefault("data_shapes",
+                          [(d.name, d.shape) for d in module.data_shapes])
+        return cls(module.symbol, arg_params, aux_params, **kwargs)
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, **kwargs):
+        """Serve a ``prefix-symbol.json`` + ``prefix-NNNN.params`` pair."""
+        from ..model import load_checkpoint
+
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(symbol, arg_params, aux_params, **kwargs)
+
+    def _bindings(self, replica, bucket):
+        """(extra zero args, aux dict) for one (replica, bucket) pair —
+        inferred once, device-resident thereafter."""
+        key = (replica, bucket)
+        with self._lock:
+            cached = self._bucket_extras.get(key)
+        if cached is not None:
+            return cached
+        import jax
+        import jax.numpy as jnp
+
+        feed = {n: (bucket,) + s
+                for n, s in zip(self._data_names, self._row_shapes)}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**feed)
+        dev = self._devices[replica]
+        args = self._replica_args[replica]
+        extras = {}
+        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
+            if name in self._data_names or name in args:
+                continue
+            dt = self._arg_dtypes.get(name, np.float32)
+            extras[name] = jax.device_put(jnp.zeros(shape, dtype=dt), dev)
+        aux = dict(self._replica_aux[replica])
+        for name, shape in zip(self._symbol.list_auxiliary_states(),
+                               aux_shapes):
+            if name not in aux:
+                aux[name] = jax.device_put(
+                    jnp.zeros(shape, dtype=np.float32), dev)
+        with self._lock:
+            self._bucket_extras[key] = (extras, aux)
+        return extras, aux
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        """Launch the dispatcher thread (idempotent)."""
+        with self._life:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            with self._cond:
+                self._stop = False
+                self._abort = False
+            self._thread = threading.Thread(target=self._dispatch_loop,
+                                            name="mxnet-serving-dispatch",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Shut down. ``drain=True`` (default) serves every admitted
+        request before returning; ``drain=False`` fails queued requests
+        with :class:`ServerClosedError` (in-flight batches still
+        complete — their results are already paid for)."""
+        with self._cond:
+            self._stop = True
+            self._abort = not drain
+            self._cond.notify_all()
+        with self._life:  # concurrent stop()s must not race the join
+            thread, self._thread = self._thread, None
+            if thread is not None:
+                thread.join()
+            elif self._queue or self._inflight:
+                # never started (start=False): honor the drain contract
+                # by running the dispatch loop inline — with _stop set
+                # it flushes (or abort-fails) the queue and returns
+                self._dispatch_loop()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def warmup(self, replicas=None):
+        """Compile every (bucket, replica) program up front by pushing a
+        zero batch through each, so the first real request never pays a
+        compile. Returns the number of programs warmed."""
+        import jax
+
+        n = 0
+        for rep in (range(len(self._devices)) if replicas is None
+                    else replicas):
+            for bucket in self._cfg.buckets:
+                outs = self._run_bucket(rep, bucket, self._zero_batch(bucket))
+                jax.block_until_ready(outs)
+                n += 1
+        return n
+
+    def _zero_batch(self, bucket):
+        return [np.zeros((bucket,) + s,
+                         dtype=self._arg_dtypes.get(n, np.float32))
+                for n, s in zip(self._data_names, self._row_shapes)]
+
+    # ------------------------------------------------------------- submit
+    def submit(self, data):
+        """Enqueue one request; returns a ``concurrent.futures.Future``.
+
+        ``data``: one array per data input (a bare array for
+        single-input symbols), either a single row (no batch axis — the
+        result is unbatched the same way) or a stack of rows. Requests
+        larger than the biggest bucket are split into bucket-size chunks
+        at admission and reassembled transparently.
+        """
+        import concurrent.futures
+
+        from ..observability import metrics
+
+        arrays, n_rows, squeeze = self._validate(data)
+        future = concurrent.futures.Future()
+        max_bucket = self._cfg.buckets[-1]
+        n_parts = -(-n_rows // max_bucket)
+        assembly = _Assembly(future, n_parts, squeeze)
+        t0 = time.monotonic()
+        parts = []
+        for p in range(n_parts):
+            lo, hi = p * max_bucket, min((p + 1) * max_bucket, n_rows)
+            parts.append(_Request([a[lo:hi] for a in arrays], hi - lo,
+                                  assembly, p, t0))
+        bound = self._cfg.max_queue_rows
+        with self._cond:
+            if self._stop:
+                raise ServerClosedError("submit() after stop()")
+            if self._cfg.backpressure == "reject":
+                if self._queued_rows + n_rows > bound:
+                    with self._lock:
+                        self._stats["rejected"] += 1
+                    metrics.counter("serving.rejected").inc()
+                    if n_rows > bound:
+                        raise QueueFullError(
+                            "%d-row request can never fit the %d-row "
+                            "admission queue under backpressure='reject'; "
+                            "raise MXNET_SERVING_QUEUE or use "
+                            "backpressure='block' (chunk-wise admission)"
+                            % (n_rows, bound))
+                    raise QueueFullError(
+                        "admission queue full (%d queued + %d new > %d); "
+                        "raise MXNET_SERVING_QUEUE or use "
+                        "backpressure='block'"
+                        % (self._queued_rows, n_rows, bound))
+                self._queue.extend(parts)
+                self._queued_rows += n_rows
+            else:
+                # chunk-wise admission: each part fits one largest
+                # bucket (<= bound by config), so even a request larger
+                # than the whole queue drains through instead of
+                # deadlocking on space for its total row count
+                for part in parts:
+                    while self._queued_rows + part.n > bound:
+                        self._cond.wait()
+                        if self._stop:
+                            # already-admitted chunks will be aborted or
+                            # drained by stop(); fail the whole request
+                            assembly.fail(ServerClosedError(
+                                "server stopped while submit() was "
+                                "blocked"))
+                            raise ServerClosedError(
+                                "server stopped while submit() was "
+                                "blocked")
+                    self._queue.append(part)
+                    self._queued_rows += part.n
+                    self._cond.notify_all()
+            depth = self._queued_rows
+            self._cond.notify_all()
+        with self._lock:
+            self._stats["requests"] += 1
+            self._stats["rows_in"] += n_rows
+            if n_parts > 1:
+                self._stats["chunked"] += 1
+        metrics.counter("serving.requests").inc()
+        metrics.gauge("serving.queue_depth").set(depth)
+        return future
+
+    def predict(self, data, timeout=None):
+        """Synchronous convenience: ``submit(data).result(timeout)``."""
+        return self.submit(data).result(timeout)
+
+    def _validate(self, data):
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        if len(data) != len(self._data_names):
+            raise ValueError("expected %d data inputs %s, got %d"
+                             % (len(self._data_names), self._data_names,
+                                len(data)))
+        arrays, squeeze = [], None
+        n_rows = None
+        for x, name, row_shape in zip(data, self._data_names,
+                                      self._row_shapes):
+            # host staging of request payloads (2-3 inputs, not a sync loop)
+            x = self._as_np(x)  # graftlint: disable=G001
+            if x.shape == row_shape:
+                x = x[None]
+                sq = True
+            elif x.shape[1:] == row_shape:
+                sq = False
+            else:
+                raise ValueError(
+                    "input %r: expected row shape %s (or a leading batch "
+                    "axis), got %s" % (name, row_shape, x.shape))
+            if squeeze is None:
+                squeeze, n_rows = sq, x.shape[0]
+            elif sq != squeeze or x.shape[0] != n_rows:
+                raise ValueError("all data inputs must agree on batching")
+            dt = self._arg_dtypes.get(name)
+            if dt is not None and x.dtype != dt:
+                x = x.astype(dt)
+            arrays.append(x)
+        if n_rows == 0:
+            raise ValueError("empty request (0 rows)")
+        return arrays, n_rows, squeeze
+
+    # --------------------------------------------------------- dispatcher
+    def _dispatch_loop(self):
+        """Collect → pad → stage → dispatch, completing the oldest
+        in-flight batch whenever the window is full or no work is ready
+        — host fetch of batch N overlaps device execution of N+1."""
+        while True:
+            while len(self._inflight) >= self._cfg.pipeline_depth:
+                self._complete_oldest()
+            reqs = self._collect(block=not self._inflight)
+            if reqs is None:
+                break
+            if not reqs:
+                # nothing ready yet: spend the wait draining the window
+                self._complete_oldest()
+                continue
+            try:
+                self._launch(reqs)
+            except Exception as err:  # deliver, don't kill the thread
+                for r in reqs:
+                    r.assembly.fail(err)
+        while self._inflight:
+            self._complete_oldest()
+
+    def _collect(self, block):
+        """Pop the next batch's requests (FIFO, filling at most the
+        largest bucket). Returns [] when nothing is ready and
+        ``block=False``; None when stopped and fully drained."""
+        max_bucket = self._cfg.buckets[-1]
+        wait_s = self._cfg.max_wait_ms / 1e3
+        with self._cond:
+            while True:
+                if self._queue:
+                    deadline = self._queue[0].t_submit + wait_s
+                    if (self._queued_rows >= max_bucket or self._stop
+                            or time.monotonic() >= deadline):
+                        return self._pop_locked()
+                    timeout = deadline - time.monotonic()
+                elif self._stop:
+                    return None
+                else:
+                    timeout = None
+                if not block:
+                    return []
+                self._cond.wait(timeout)
+
+    def _pop_locked(self):
+        # caller (_collect) holds self._cond — the _locked suffix contract
+        if self._abort:
+            err = ServerClosedError("server stopped without draining")
+            while self._queue:
+                self._queue.popleft().assembly.fail(err)
+            self._queued_rows = 0  # graftlint: disable=G004 — under self._cond via _collect
+            self._cond.notify_all()
+            return None
+        max_bucket = self._cfg.buckets[-1]
+        reqs, rows = [], 0
+        while self._queue and rows + self._queue[0].n <= max_bucket:
+            r = self._queue.popleft()
+            reqs.append(r)
+            rows += r.n
+        self._queued_rows -= rows  # graftlint: disable=G004 — under self._cond via _collect
+        self._cond.notify_all()  # wake submitters blocked on backpressure
+        from ..observability import metrics
+
+        metrics.gauge("serving.queue_depth").set(self._queued_rows)
+        return reqs
+
+    def _launch(self, reqs):
+        """Pad to the bucket, stage with ONE pytree device_put, dispatch
+        the compiled program (async), and append to the in-flight window."""
+        from ..observability import metrics
+
+        rows = sum(r.n for r in reqs)
+        bucket = pick_bucket(rows, self._cfg.buckets)
+        rep = self._rr
+        self._rr = (self._rr + 1) % len(self._devices)
+
+        batch = []
+        for i, (name, shape) in enumerate(zip(self._data_names,
+                                              self._row_shapes)):
+            pieces = [r.arrays[i] for r in reqs]
+            if rows < bucket:
+                pieces.append(np.zeros(
+                    (bucket - rows,) + shape,
+                    dtype=self._arg_dtypes.get(name, np.float32)))
+            batch.append(pieces[0] if len(pieces) == 1
+                         else np.concatenate(pieces))
+        outs = self._run_bucket(rep, bucket, batch)
+        self._inflight.append(_InFlight(outs, reqs, bucket, rows, rep))
+        with self._lock:
+            self._stats["batches"] += 1
+            self._stats["rows_real"] += rows
+            self._stats["rows_padded"] += bucket - rows
+        metrics.counter("serving.batches").inc()
+        metrics.counter("serving.rows_real").inc(rows)
+        metrics.counter("serving.rows_padded").inc(bucket - rows)
+        metrics.histogram("serving.occupancy_pct").observe(
+            100.0 * rows / bucket)
+
+    def _run_bucket(self, replica, bucket, batch_arrays):
+        """One compiled-program dispatch of a padded bucket batch."""
+        import jax
+
+        from .. import random as _random
+        from ..observability import metrics
+
+        extras, aux = self._bindings(replica, bucket)
+        dev = self._devices[replica]
+        staged = jax.device_put(batch_arrays, dev)  # one pytree transfer
+        args = dict(self._replica_args[replica])
+        args.update(extras)
+        args.update(zip(self._data_names, staged))
+        rngs = tuple(_random.next_key() for _ in self._prog.rng_nodes)
+        key = (replica, bucket)
+        with self._lock:
+            fresh = key not in self._programs
+            if fresh:
+                self._programs.add(key)
+                self._stats["bucket_programs"] += 1
+        if fresh:
+            metrics.counter("serving.bucket_compiles").inc()
+        return self._prog.infer_fn()(args, aux, rngs)
+
+    def _complete_oldest(self):
+        """Fetch the oldest in-flight batch and route each request's
+        rows to its future (FIFO — completion order == admission order)."""
+        from ..observability import metrics
+
+        ent = self._inflight.popleft()
+        # bounded-window host fetch (the G001 drain pattern): this is the
+        # ONE place serving blocks on the device, and by now batch N+1 is
+        # already dispatched
+        try:
+            host = [np.asarray(o) for o in ent.outs]  # graftlint: disable=G001
+        except Exception as err:  # device failure: fail THIS batch only
+            for r in ent.reqs:
+                r.assembly.fail(err)
+            return
+        now = time.monotonic()
+        offset = 0
+        finished = 0
+        for r in ent.reqs:
+            done = r.assembly.deliver(
+                r.part, [o[offset:offset + r.n] for o in host])
+            offset += r.n
+            if done:  # count (and time) whole requests, not chunks
+                finished += 1
+                metrics.histogram("serving.latency_ms").observe(
+                    (now - r.t_submit) * 1e3)
+        with self._lock:
+            self._stats["completed"] += finished
+
+    # -------------------------------------------------------------- stats
+    def get_stats(self):
+        """JSON-safe operational snapshot (also the flight-recorder
+        provider section for crash dumps)."""
+        with self._cond:
+            depth = self._queued_rows
+            stopped = self._stop
+        with self._lock:
+            stats = dict(self._stats)
+        stats.update(
+            queue_rows=depth,
+            inflight=len(self._inflight),
+            buckets=list(self._cfg.buckets),
+            replicas=len(self._devices),
+            max_wait_ms=self._cfg.max_wait_ms,
+            running=self.running,
+            stopped=stopped)
+        return stats

@@ -1,0 +1,577 @@
+"""Inference serving engine (ISSUE 5): bucket math, micro-batching
+deadline/flush semantics, backpressure, result routing under concurrency,
+compile-count bounds, clean shutdown, and the trailing-partial-batch
+recompile fix in the predict paths."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+from mxnet_tpu import serving
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.serving import (InferenceServer, QueueFullError,
+                               ServerClosedError, ServingConfig,
+                               parse_buckets, pick_bucket)
+
+
+@pytest.fixture
+def telemetry():
+    obs.set_enabled(True)
+    obs.reset_metrics()
+    yield
+    obs.reset_metrics()
+    obs.set_enabled(False)
+
+
+def _mlp():
+    """Tiny deterministic single-input net: out = softmax(x @ W.T)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"fc_weight": mx.nd.array(rng.randn(5, 7).astype(np.float32)),
+            "fc_bias": mx.nd.array(rng.randn(5).astype(np.float32))}
+
+
+def _reference(params, x):
+    """Host-side forward matching _mlp for arbitrary row counts."""
+    logits = x @ params["fc_weight"].asnumpy().T + params["fc_bias"].asnumpy()
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _server(buckets=(1, 2, 4), max_wait_ms=5, start=True, **cfg_kwargs):
+    params = _params()
+    cfg = ServingConfig(buckets=buckets, max_wait_ms=max_wait_ms,
+                        **cfg_kwargs)
+    srv = InferenceServer(_mlp(), params, data_shapes=[("data", (1, 7))],
+                          config=cfg, start=start)
+    return srv, params
+
+
+# ------------------------------------------------------------ bucket math
+def test_parse_buckets():
+    assert parse_buckets("1,2,4,8") == (1, 2, 4, 8)
+    assert parse_buckets([8, 2, 2, 32]) == (2, 8, 32)  # sorted, deduped
+    assert parse_buckets(None) == serving.DEFAULT_BUCKETS
+    with pytest.raises(ValueError):
+        parse_buckets("0,4")
+    with pytest.raises(ValueError):
+        parse_buckets("")
+    with pytest.raises(ValueError):
+        parse_buckets("a,b")
+
+
+def test_parse_buckets_env(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVING_BUCKETS", "4, 16")
+    assert parse_buckets() == (4, 16)
+    monkeypatch.setenv("MXNET_SERVING_BUCKETS", "  ")
+    assert parse_buckets() == serving.DEFAULT_BUCKETS
+
+
+def test_pick_bucket():
+    ladder = (1, 2, 4, 8)
+    assert pick_bucket(1, ladder) == 1
+    assert pick_bucket(2, ladder) == 2
+    assert pick_bucket(3, ladder) == 4
+    assert pick_bucket(5, ladder) == 8
+    assert pick_bucket(8, ladder) == 8
+    with pytest.raises(ValueError):
+        pick_bucket(9, ladder)   # oversize is chunked before bucketing
+    with pytest.raises(ValueError):
+        pick_bucket(0, ladder)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        ServingConfig(backpressure="drop")
+    with pytest.raises(ValueError):
+        ServingConfig(buckets=(8,), max_queue_rows=4)  # queue < bucket
+    with pytest.raises(ValueError):
+        ServingConfig(pipeline_depth=0)
+
+
+# -------------------------------------------------------------- correctness
+def test_results_match_reference_and_squeeze():
+    srv, params = _server()
+    try:
+        rng = np.random.RandomState(1)
+        x = rng.rand(3, 7).astype(np.float32)
+        out = srv.predict(x)
+        assert out.shape == (3, 5)
+        np.testing.assert_allclose(out, _reference(params, x), atol=1e-5)
+        # single row (no batch axis) comes back unbatched
+        row = srv.predict(x[0])
+        assert row.shape == (5,)
+        np.testing.assert_allclose(row, _reference(params, x)[0], atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_oversize_request_chunked_and_reassembled():
+    srv, params = _server(buckets=(1, 2, 4))
+    try:
+        rng = np.random.RandomState(2)
+        x = rng.rand(11, 7).astype(np.float32)   # 11 > largest bucket 4
+        out = srv.predict(x)
+        assert out.shape == (11, 5)
+        np.testing.assert_allclose(out, _reference(params, x), atol=1e-5)
+        assert srv.get_stats()["chunked"] == 1
+    finally:
+        srv.stop()
+
+
+def test_submit_validation():
+    srv, _ = _server()
+    try:
+        with pytest.raises(ValueError):
+            srv.submit(np.zeros((2, 3), np.float32))     # wrong row shape
+        with pytest.raises(ValueError):
+            srv.submit(np.zeros((0, 7), np.float32))     # empty
+        with pytest.raises(ValueError):
+            srv.submit([np.zeros((1, 7), np.float32)] * 2)  # input arity
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- batching semantics
+def test_deadline_flush_pads_partial_bucket():
+    """One lone row must not wait forever for bucket-mates: the deadline
+    flushes it, padded up to the smallest fitting bucket."""
+    srv, params = _server(buckets=(4, 8), max_wait_ms=20)
+    try:
+        x = np.ones((1, 7), np.float32)
+        t0 = time.monotonic()
+        out = srv.submit(x).result(timeout=10)
+        wall = time.monotonic() - t0
+        np.testing.assert_allclose(out, _reference(params, x), atol=1e-5)
+        stats = srv.get_stats()
+        # padded 1 real row out to the 4-bucket
+        assert stats["rows_real"] == 1
+        assert stats["rows_padded"] == 3
+        assert wall < 8.0  # flushed by deadline, not stuck
+    finally:
+        srv.stop()
+
+
+def test_full_bucket_flushes_before_deadline():
+    """A full largest bucket dispatches immediately — an absurdly long
+    deadline must not delay it."""
+    srv, _ = _server(buckets=(1, 2, 4), max_wait_ms=60_000)
+    try:
+        srv.warmup()  # exclude compile time from the wall-clock bound
+        x = np.ones((4, 7), np.float32)
+        t0 = time.monotonic()
+        srv.submit(x).result(timeout=30)
+        wall = time.monotonic() - t0
+        assert wall < 30.0  # nowhere near the 60 s deadline
+        assert srv.get_stats()["rows_padded"] == 0
+    finally:
+        srv.stop()
+
+
+def test_micro_batch_coalesces_concurrent_requests():
+    """Requests admitted together ride one bucket dispatch, not one
+    dispatch each."""
+    srv, params = _server(buckets=(8,), max_wait_ms=200, start=False)
+    try:
+        xs = [np.full((2, 7), i, np.float32) for i in range(4)]
+        futs = [srv.submit(x) for x in xs]   # all queued pre-dispatcher
+        srv.start()
+        for x, f in zip(xs, futs):
+            np.testing.assert_allclose(f.result(timeout=30),
+                                       _reference(params, x), atol=1e-5)
+        stats = srv.get_stats()
+        assert stats["batches"] == 1, \
+            "8 queued rows should flush as ONE full 8-bucket"
+        assert stats["rows_padded"] == 0
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- backpressure
+def test_backpressure_reject():
+    srv, _ = _server(buckets=(1, 2, 4), max_queue_rows=4,
+                     backpressure="reject", start=False)
+    x = np.ones((4, 7), np.float32)
+    srv.submit(x)       # fills the queue bound exactly
+    with pytest.raises(QueueFullError):
+        srv.submit(np.ones((1, 7), np.float32))
+    assert srv.get_stats()["rejected"] == 1
+    # restart serves the queued request and drains cleanly
+    srv.start()
+    srv.stop(drain=True)
+    assert srv.get_stats()["queue_rows"] == 0
+
+
+def test_backpressure_block_unblocks_when_drained():
+    srv, params = _server(buckets=(1, 2), max_queue_rows=2,
+                          backpressure="block", start=False)
+    first = srv.submit(np.ones((2, 7), np.float32))  # fills the queue
+    results = {}
+
+    def blocked_submit():
+        results["fut"] = srv.submit(np.zeros((1, 7), np.float32))
+
+    t = threading.Thread(target=blocked_submit)
+    t.start()
+    t.join(0.2)
+    assert t.is_alive(), "submit should block while the queue is full"
+    srv.start()                      # dispatcher drains -> submitter wakes
+    t.join(10)
+    assert not t.is_alive()
+    first.result(timeout=10)
+    results["fut"].result(timeout=10)
+    srv.stop()
+
+
+def test_submit_after_stop_raises():
+    srv, _ = _server()
+    srv.stop()
+    with pytest.raises(ServerClosedError):
+        srv.submit(np.ones((1, 7), np.float32))
+
+
+def test_block_mode_admits_request_larger_than_queue_bound():
+    """A request bigger than the whole admission queue drains through
+    chunk-wise under backpressure='block' instead of deadlocking on
+    space for its total row count."""
+    srv, params = _server(buckets=(1, 2, 4), max_queue_rows=4,
+                          backpressure="block")
+    try:
+        x = np.random.RandomState(8).rand(10, 7).astype(np.float32)
+        out = srv.predict(x, timeout=30)
+        np.testing.assert_allclose(out, _reference(params, x), atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_reject_mode_oversize_raises_queue_full():
+    srv, _ = _server(buckets=(1, 2, 4), max_queue_rows=4,
+                     backpressure="reject")
+    try:
+        with pytest.raises(QueueFullError):
+            srv.submit(np.ones((10, 7), np.float32))  # can never fit
+    finally:
+        srv.stop()
+
+
+def test_cancelled_future_does_not_kill_dispatcher():
+    srv, params = _server(start=False)
+    doomed = srv.submit(np.ones((1, 7), np.float32))
+    assert doomed.cancel()          # pending: cancel succeeds
+    srv.start()                     # delivery into the cancelled future
+    x = np.full((2, 7), 3.0, np.float32)
+    out = srv.predict(x, timeout=30)  # dispatcher must still be alive
+    np.testing.assert_allclose(out, _reference(params, x), atol=1e-5)
+    srv.stop()
+
+
+def test_stop_drain_without_started_dispatcher():
+    """stop(drain=True) on a never-started server must still honor the
+    drain contract for admitted requests (inline dispatch)."""
+    srv, params = _server(start=False)
+    x = np.ones((3, 7), np.float32)
+    fut = srv.submit(x)
+    srv.stop(drain=True)
+    assert fut.done()
+    np.testing.assert_allclose(fut.result(), _reference(params, x),
+                               atol=1e-5)
+
+
+def test_stop_abort_without_started_dispatcher():
+    srv, _ = _server(start=False)
+    fut = srv.submit(np.ones((1, 7), np.float32))
+    srv.stop(drain=False)
+    with pytest.raises(ServerClosedError):
+        fut.result(timeout=5)
+
+
+# ------------------------------------------------- ordering / concurrency
+def test_result_order_preserved_under_concurrent_submitters():
+    """Each of N threads streams tagged requests; every future must get
+    exactly its own rows back, and within a thread completions follow
+    submission order (FIFO admission, FIFO completion)."""
+    srv, params = _server(buckets=(1, 2, 4, 8), max_wait_ms=2)
+    n_threads, per_thread = 4, 12
+    errors = []
+
+    def worker(tid):
+        try:
+            futs = []
+            for i in range(per_thread):
+                tag = float(tid * 100 + i)
+                x = np.full((1 + (i % 3), 7), tag, np.float32)
+                futs.append((tag, x, srv.submit(x)))
+            done_order = []
+            for tag, x, f in futs:
+                out = f.result(timeout=30)
+                np.testing.assert_allclose(out, _reference(params, x),
+                                           atol=1e-5)
+                done_order.append(f)
+            # FIFO per thread: by the time an earlier future's result()
+            # returns, every earlier one is done — and futures complete
+            # in submission order
+            for f in done_order:
+                assert f.done()
+        except Exception as err:  # surface across the thread boundary
+            errors.append(err)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    srv.stop()
+    assert not errors, errors
+    stats = srv.get_stats()
+    assert stats["completed"] == n_threads * per_thread
+
+
+# ------------------------------------------------------- compile bounding
+def test_compile_count_bounded_by_bucket_set(telemetry):
+    """After warmup, traffic of every size must add ZERO compiles: the
+    bucket ladder is the complete compile-key set (ISSUE 5 acceptance)."""
+    srv, _ = _server(buckets=(1, 2, 4))
+    try:
+        warmed = srv.warmup()
+        assert warmed == 3  # one program per (bucket, replica=1)
+        after_warmup = M.get_value("jit.compile_count", 0)
+        rng = np.random.RandomState(3)
+        for n in (1, 2, 3, 4, 1, 3, 2, 4, 7):   # 7 -> chunked 4+3
+            srv.predict(rng.rand(n, 7).astype(np.float32))
+        assert M.get_value("jit.compile_count", 0) == after_warmup, \
+            "request traffic triggered recompiles beyond the bucket set"
+        stats = srv.get_stats()
+        assert stats["bucket_programs"] == 3
+        assert M.get_value("serving.bucket_compiles", 0) == 3
+    finally:
+        srv.stop()
+
+
+def test_serving_metrics_and_flight_recorder_provider(telemetry, tmp_path):
+    srv, _ = _server(buckets=(2, 4), max_wait_ms=1)
+    try:
+        srv.predict(np.ones((3, 7), np.float32))
+        assert M.get_value("serving.requests", 0) == 1
+        assert M.get_value("serving.rows_real", 0) == 3
+        assert M.get_value("serving.rows_padded", 0) == 1
+        assert M.get_value("serving.latency_ms", 0) == 1  # one observation
+        dump = obs.flight_recorder.dump(
+            "test", path=str(tmp_path / "dump.json"))
+        import json
+
+        with open(dump) as f:
+            payload = json.load(f)
+        section = payload["providers"]["serving"]
+        # other servers from the suite may still be alive in the WeakSet
+        views = section["servers"] if "servers" in section else [section]
+        assert any(v.get("buckets") == [2, 4] and v.get("rows_real") == 3
+                   for v in views), views
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- shutdown
+def test_clean_shutdown_drains_in_flight():
+    srv, params = _server(buckets=(1, 2, 4), max_wait_ms=50)
+    xs = [np.full((2, 7), i, np.float32) for i in range(6)]
+    futs = [srv.submit(x) for x in xs]
+    srv.stop(drain=True)   # must serve everything already admitted
+    for x, f in zip(xs, futs):
+        assert f.done()
+        np.testing.assert_allclose(f.result(), _reference(params, x),
+                                   atol=1e-5)
+
+
+def test_abort_shutdown_fails_queued_requests():
+    srv, _ = _server(start=False)
+    fut = srv.submit(np.ones((1, 7), np.float32))  # queued, no dispatcher
+    srv.start()
+    srv.stop(drain=False)
+    # the request either completed before the abort landed or was failed
+    # with ServerClosedError — never left hanging
+    assert fut.done()
+    try:
+        fut.result()
+    except ServerClosedError:
+        pass
+
+
+def test_context_manager_and_from_module():
+    X = np.random.RandomState(4).rand(8, 7).astype(np.float32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 7))], for_training=False)
+    mod.init_params()
+    ref = mod.predict(mx.io.NDArrayIter(X, batch_size=4)).asnumpy()
+    with InferenceServer.from_module(
+            mod, config=ServingConfig(buckets=(4, 8))) as srv:
+        out = srv.predict(X)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_multi_replica_round_robin():
+    import jax
+
+    params = _params()
+    cfg = ServingConfig(buckets=(2,), max_wait_ms=1)
+    srv = InferenceServer(_mlp(), params, data_shapes=[("data", (1, 7))],
+                          devices=jax.devices()[:2], config=cfg)
+    try:
+        rng = np.random.RandomState(5)
+        xs = [rng.rand(2, 7).astype(np.float32) for _ in range(6)]
+        futs = [srv.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_allclose(f.result(timeout=30),
+                                       _reference(params, x), atol=1e-5)
+        assert srv.get_stats()["replicas"] == 2
+    finally:
+        srv.stop()
+
+
+def test_replica_devices_mesh_axis():
+    import jax
+
+    from mxnet_tpu.parallel.mesh import make_mesh, replica_devices
+
+    assert replica_devices() == list(jax.devices())
+    mesh = make_mesh({"dp": 4, "mp": 2})
+    assert len(replica_devices(mesh)) == 8
+    dp = replica_devices(mesh, axis="dp")
+    assert len(dp) == 4
+    with pytest.raises(ValueError):
+        replica_devices(mesh, axis="nope")
+
+
+# ------------------------- trailing-partial-batch recompile fix (predict)
+class _ShortTailIter(DataIter):
+    """Yields full batches then one SHORT trailing batch (pad=0) — the
+    shape a generic DataIter hands predict/score, which used to re-bind
+    and recompile the executor for the leftover size."""
+
+    def __init__(self, X, y, bs):
+        super().__init__(bs)
+        self.X, self.y, self.bs, self.i = X, y, bs, 0
+        self.provide_data = [DataDesc("data", (bs,) + X.shape[1:])]
+        self.provide_label = [DataDesc("softmax_label", (bs,))]
+
+    def reset(self):
+        self.i = 0
+
+    def next(self):
+        if self.i >= len(self.X):
+            raise StopIteration
+        lo, hi = self.i, min(self.i + self.bs, len(self.X))
+        self.i = hi
+        return DataBatch(data=[mx.nd.array(self.X[lo:hi])],
+                         label=[mx.nd.array(self.y[lo:hi])], pad=0)
+
+
+def _short_tail_data(n=10, bs=4, seed=6):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 7).astype(np.float32)
+    y = rng.randint(0, 5, n).astype(np.float32)
+    return X, y, _ShortTailIter(X, y, bs)
+
+
+def test_module_predict_no_recompile_on_partial_batch(telemetry):
+    X, y, it = _short_tail_data()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, for_training=False)
+    mod.init_params()
+    out1 = mod.predict(it)
+    c1 = M.get_value("jit.compile_count", 0)
+    out2 = mod.predict(it)
+    assert M.get_value("jit.compile_count", 0) == c1, \
+        "trailing partial batch recompiled on a warmed predict pass"
+    assert out1.shape == (10, 5)
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), atol=1e-6)
+    # exactness vs a full-size bound executor
+    ex = _mlp().simple_bind(mx.cpu(), data=(10, 7), grad_req="null")
+    arg_params, _ = mod.get_params()
+    ex.copy_params_from(arg_params, allow_extra_params=True)
+    ex.arg_dict["data"][:] = X
+    ref = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out1.asnumpy(), ref, atol=1e-5)
+
+
+def test_module_reshape_keeps_parameters():
+    """Explicit Module.reshape re-binds through simple_bind, which
+    allocates fresh zero arrays — the parameters must ride across (the
+    docstring said 'keeping parameters'; it used to be silently false)."""
+    X = np.random.RandomState(9).rand(4, 7).astype(np.float32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 7))], for_training=False)
+    mod.init_params()
+    it4 = mx.io.NDArrayIter(X, None, batch_size=4)
+    ref = mod.predict(it4).asnumpy()
+    assert not np.allclose(ref, ref[0][0])  # real weights, not uniform
+    mod.reshape([("data", (2, 7))])
+    it2 = mx.io.NDArrayIter(X, None, batch_size=2)
+    np.testing.assert_allclose(mod.predict(it2).asnumpy(), ref, atol=1e-5)
+    mod.reshape([("data", (4, 7))])  # and back up
+    np.testing.assert_allclose(mod.predict(it4).asnumpy(), ref, atol=1e-5)
+
+
+def test_module_score_exact_on_partial_batch(telemetry):
+    X, y, it = _short_tail_data()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label, for_training=False)
+    mod.init_params()
+    preds = mod.predict(it).asnumpy()
+    acc_ref = float((preds.argmax(1) == y).mean())
+    c1 = M.get_value("jit.compile_count", 0)
+    name_val = mod.score(it, "acc")
+    assert M.get_value("jit.compile_count", 0) == c1
+    assert abs(name_val[0][1] - acc_ref) < 1e-9  # synthetic rows excluded
+
+
+def test_feedforward_predict_no_recompile_on_partial_batch(telemetry):
+    X, y, it = _short_tail_data()
+    ff = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), numpy_batch_size=4)
+    ff.arg_params = _params(7)
+    ff.aux_params = {}
+    out1 = ff.predict(it)   # warms every eager helper op en route
+    c1 = M.get_value("jit.compile_count", 0)
+    out2 = ff.predict(it)
+    # each predict() binds a fresh module, so ONE program compile per
+    # pass is inherent; the trailing short batch must not add a second
+    assert M.get_value("jit.compile_count", 0) == c1 + 1, \
+        "FeedForward.predict recompiled on the trailing partial batch"
+    assert out1.shape == (10, 5)
+    np.testing.assert_allclose(out1, _reference(_params(7), X), atol=1e-5)
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+
+
+def test_feedforward_predict_tuple_provide_data_partial_batch():
+    """User iterators may expose bare (name, shape) pairs instead of
+    DataDesc; the pad path must accept both."""
+    X, y, it = _short_tail_data()
+    it.provide_data = [("data", (4, 7))]
+    it.provide_label = [("softmax_label", (4,))]
+    ff = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), numpy_batch_size=4)
+    ff.arg_params = _params(7)
+    ff.aux_params = {}
+    out = ff.predict(it)
+    assert out.shape == (10, 5)
+    np.testing.assert_allclose(out, _reference(_params(7), X), atol=1e-5)
+
+
+def test_feedforward_score_partial_batch(telemetry):
+    X, y, it = _short_tail_data()
+    ff = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), numpy_batch_size=4)
+    ff.arg_params = _params(7)
+    ff.aux_params = {}
+    preds = _reference(_params(7), X)
+    acc_ref = float((preds.argmax(1) == y).mean())
+    acc = ff.score(it)
+    assert abs(acc - acc_ref) < 1e-9

@@ -48,6 +48,18 @@ _DEF_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 _BUILTIN_NAMES = frozenset(dir(__import__("builtins")))
 
+# method names of ubiquitous stdlib concurrency objects: an
+# ``x.submit(...)`` or ``fut.add_done_callback(...)`` is almost always a
+# ThreadPoolExecutor / Future / lock, not a package-unique def that
+# happens to share the name — binding those by attr produces sync-closure
+# false positives package-wide the moment anyone defines e.g. a
+# ``submit`` method (the attr analog of the _BUILTIN_NAMES guard)
+_STDLIB_METHOD_NAMES = frozenset({
+    "submit", "shutdown", "add_done_callback", "set_result",
+    "set_exception", "put_nowait", "get_nowait", "acquire", "release",
+    "notify", "notify_all",
+})
+
 
 def call_kind(call):
     """'self' for self.m()/cls.m(), 'attr' for x.m(), 'bare' for m()."""
@@ -228,6 +240,8 @@ class CallGraph:
             cands = [c for c in self._by_name.get(name, ())
                      if c.cls is None]
         else:
+            if kind == "attr" and name in _STDLIB_METHOD_NAMES:
+                return None
             cands = self._by_name.get(name, ())
         if len(cands) == 1:
             return cands[0]
