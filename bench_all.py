@@ -19,6 +19,11 @@ Configs (BASELINE.json "configs" + VERDICT r3 item 3):
 Writes BENCH_ALL.json (repo root by default) and prints it. Each entry is
 measured independently and failures are recorded, not fatal, so one slow
 compile cannot sink the artifact. Set BENCH_QUICK=1 for a fast smoke pass.
+
+Standalone gates/modes: --lint-clean (graftlint vs baseline),
+--health-overhead (warn-mode <=2%/step), --autotune (tuned-vs-default on
+the autotuner's knob families + the warm-cache <1%/step gate;
+docs/autotune.md).
 """
 import functools
 import json
@@ -726,6 +731,356 @@ def bench_health_overhead(threshold_pct=None):
     return result
 
 
+def bench_autotune(gate_pct=None):
+    """--autotune: drive the search-based autotuner (ISSUE 6) over its
+    three knob families and record tuned-vs-default numbers, so the perf
+    trajectory shows what the tuner bought:
+
+    * flash-attention fwd+bwd block bounds — measured sweep, then the
+      SAME train-microbench protocol times the config defaults against
+      the tuned blocks,
+    * the serving bucket ladder — candidate ladders replay one traffic
+      sample on a live InferenceServer,
+    * per-graph layout (NHWC vs NCHW) — measured ResNet train step, plus
+      an hlo_layout_audit artifact (LAYOUT_AUDIT_BENCH.json) diffing the
+      two layouts' layout-moving bytes,
+
+    and gates the warm-cache overhead: consulting a warm tuning cache
+    (MXNET_TUNE=0 + entries present) must add < MXNET_TUNE_GATE_PCT
+    (default 1%) per step over a full bypass (MXNET_TUNE=-1) — same gate
+    style as --health-overhead. Off-TPU the kernels run in Pallas
+    interpret mode: the recorded flash numbers are only meaningful
+    relative to each other (on-chip numbers land with the next bench
+    pass); the search space always contains the incumbent defaults, so
+    tuned can only beat or match them modulo noise.
+
+    Results merge into BENCH_ALL.json under "autotune".
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune
+    from mxnet_tpu import config as mxconfig
+    from mxnet_tpu.autotune import median_time
+    from mxnet_tpu.config import get_flag
+
+    if gate_pct is None:
+        gate_pct = float(os.environ.get("MXNET_TUNE_GATE_PCT", "1.0"))
+    interpret = jax.default_backend() != "tpu"
+    results = {"device": jax.devices()[0].device_kind, "quick": QUICK,
+               "interpret_mode": interpret,
+               "fingerprint": autotune.device_fingerprint(),
+               "cache": autotune.cache_path()}
+    if interpret:
+        results["note"] = ("off-TPU run: flash kernels in Pallas "
+                           "interpret mode — numbers are relative only; "
+                           "on-chip numbers pending next bench pass")
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    # ---- flash-attention block bounds: default vs tuned ------------------
+    from mxnet_tpu.parallel.flash_attention import flash_attention
+
+    T, D, H = (256, 32, 2) if QUICK else (4096, 64, 8)
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, H, T, D), jnp.bfloat16)
+               for _ in range(3))
+
+    def flash_train_ms(bq, bk, bqb, bkb):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                block_q_bwd=bqb, block_k_bwd=bkb,
+                interpret=interpret).astype(jnp.float32))
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return median_time(lambda: jax.block_until_ready(fn(q, k, v)),
+                           repeats=3, warmup=1) * 1e3
+
+    default_blocks = (get_flag("MXNET_FLASH_BLOCK_Q"),
+                      get_flag("MXNET_FLASH_BLOCK_K"),
+                      get_flag("MXNET_FLASH_BWD_BLOCK_Q"),
+                      get_flag("MXNET_FLASH_BWD_BLOCK_K"))
+    default_ms = flash_train_ms(*default_blocks)
+    tuned = autotune.tune_flash_attention(
+        T=T, D=D, B=1, H=H, dtype="bfloat16", causal=True,
+        interpret=interpret, trials=4 if QUICK else None)
+    tf_, tb = tuned["flash_attention.fwd"], tuned["flash_attention.bwd"]
+    tuned_blocks = (tf_["block_q"], tf_["block_k"],
+                    tb["block_q"], tb["block_k"])
+    tuned_ms = flash_train_ms(*tuned_blocks)
+    results["flash_attention"] = {
+        "protocol": "fwd+bwd grad(q,k,v) b1 h%d T=%d d%d bf16 causal"
+                    % (H, T, D),
+        "default_blocks": list(default_blocks),
+        "tuned_blocks": list(tuned_blocks),
+        "default_ms": round(default_ms, 3), "tuned_ms": round(tuned_ms, 3),
+        "speedup": round(default_ms / tuned_ms, 3),
+    }
+    print("[bench_all] autotune flash: default %.2f ms -> tuned %.2f ms "
+          "(blocks %s -> %s)" % (default_ms, tuned_ms,
+                                 list(default_blocks), list(tuned_blocks)),
+          file=sys.stderr)
+
+    # ---- serving bucket ladder: default vs tuned -------------------------
+    from mxnet_tpu.autotune.tuners import serving_replay_measurer
+    from mxnet_tpu.serving.buckets import parse_buckets
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=32, name="fc"),
+        name="softmax")
+    arg_params = {"fc_weight": mx.nd.array(
+        rng.randn(32, 24).astype(np.float32) * 0.1),
+        "fc_bias": mx.nd.zeros((32,))}
+    data_shapes = [("data", (1, 24))]
+    n_req = 64 if QUICK else 240
+    # skewed request-size traffic: mostly singles, a p95 tail of 6-20
+    sizes = [int(s) for s in
+             rng.choice([1, 1, 1, 1, 2, 2, 3, 4, 6, 20], size=n_req)]
+
+    # the SAME protocol the search uses (tuners.serving_replay_measurer)
+    _srv_measure = serving_replay_measurer(net, arg_params, data_shapes,
+                                           sizes, max_wait_ms=2)
+
+    def serving_ms(ladder):
+        return _srv_measure({"buckets": ladder}) * 1e3
+
+    default_ladder = list(parse_buckets(None))
+    default_srv_ms = serving_ms(default_ladder)
+    tuned_ladder = autotune.tune_serving_buckets(
+        net, arg_params, data_shapes, sizes,
+        trials=3 if QUICK else None)
+    tuned_srv_ms = serving_ms(tuned_ladder)
+    kept_default = False
+    if tuned_srv_ms > default_srv_ms and tuned_ladder != default_ladder:
+        # head-to-head confirmation: if the search's pick loses the
+        # re-measure (noise on tiny CPU runs), keep the incumbent in the
+        # cache — a shipped cache must never regress below the default
+        from mxnet_tpu.autotune.tuners import model_key
+        from mxnet_tpu.serving.buckets import traffic_signature
+
+        mkey = model_key(net)
+        for tk in ("default", traffic_signature(sizes)):
+            autotune.record("serving.buckets", (mkey, tk),
+                            {"buckets": default_ladder},
+                            ms=default_srv_ms,
+                            extra={"note": "head-to-head kept default"})
+        tuned_ladder, tuned_srv_ms = default_ladder, default_srv_ms
+        kept_default = True
+    results["serving_buckets"] = {
+        "protocol": "%d requests, sizes p50=1 p95=6 max=20, MLP fc32"
+                    % n_req,
+        "default_ladder": default_ladder, "tuned_ladder": tuned_ladder,
+        "default_ms": round(default_srv_ms, 1),
+        "tuned_ms": round(tuned_srv_ms, 1),
+        "speedup": round(default_srv_ms / tuned_srv_ms, 3),
+        "kept_default": kept_default,
+    }
+    print("[bench_all] autotune serving: default %s %.0f ms -> tuned %s "
+          "%.0f ms" % (default_ladder, default_srv_ms, tuned_ladder,
+                       tuned_srv_ms), file=sys.stderr)
+
+    # ---- per-graph layout: measured NHWC vs NCHW + audit artifact --------
+    from mxnet_tpu.models import get_resnet
+
+    layers, size, bs, steps = (18, 32, 2, 2) if QUICK else (50, 224, 16, 8)
+
+    def layout_step_s(cand):
+        layout = cand["layout"]
+        sym = get_resnet(num_classes=1000, num_layers=layers,
+                         image_shape=(3, size, size), layout=layout)
+        shape = ((bs, 3, size, size) if layout == "NCHW"
+                 else (bs, size, size, 3))
+        mod = mx.mod.Module(sym, context=mx.gpu()
+                            if mx.context.num_gpus() else mx.cpu())
+        mod.bind(data_shapes=[("data", shape)],
+                 label_shapes=[("softmax_label", (bs,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),))
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.rand(*shape).astype(np.float32))],
+            label=[mx.nd.array(
+                rng.randint(0, 1000, bs).astype(np.float32))])
+
+        def run():
+            for _ in range(steps):
+                mod.forward_backward(batch)
+                mod.update()
+            mod.get_outputs()[0].asnumpy()
+
+        return median_time(run, repeats=2, warmup=1) / steps
+
+    layout_key = ("resnet%d" % layers, "b%d" % bs, "s%d" % size)
+    per_layout = {}
+
+    def layout_measure(c):  # the tuner's measure hook doubles as the log
+        s = layout_step_s(c)
+        per_layout[c["layout"]] = round(s * 1e3, 2)
+        return s
+
+    layout_winner = autotune.tune_layout(layout_measure, key=layout_key,
+                                         default="NHWC")
+    results["layout"] = {
+        "protocol": "resnet%d bs%d %dx%d fused train step" % (
+            layers, bs, size, size),
+        "per_layout_ms": per_layout,
+        "tuned": layout_winner, "key": list(layout_key),
+    }
+    print("[bench_all] autotune layout: %s (%s)" % (
+        layout_winner, per_layout), file=sys.stderr)
+
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import hlo_layout_audit
+
+    audit_layers, audit_bs, audit_size = (18, 2, 64) if QUICK \
+        else (50, 32, 224)
+    audits = {lay: hlo_layout_audit.run_audit(
+        layers=audit_layers, batch=audit_bs, size=audit_size, layout=lay)
+        for lay in ("NHWC", "NCHW")}
+    audit_path = os.path.join(here, "LAYOUT_AUDIT_BENCH.json")
+    with open(audit_path, "w") as f:
+        json.dump({"nhwc": audits["NHWC"], "nchw": audits["NCHW"],
+                   "diff_nchw_to_nhwc": hlo_layout_audit.compare_reports(
+                       audits["NCHW"], audits["NHWC"])}, f, indent=1)
+    results["layout"]["audit_artifact"] = os.path.basename(audit_path)
+    results["layout"]["transpose_mb"] = {
+        lay.lower(): round(audits[lay]["transpose"]["bytes_total"] / 2**20,
+                           2) for lay in audits}
+
+    # ---- warm-cache overhead gate (<1% per step, health-gate style) ------
+    from mxnet_tpu.executor import _GraphProgram
+
+    fc1 = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=512, name="g1"), act_type="relu")
+    fc2 = mx.sym.Activation(mx.sym.FullyConnected(
+        fc1, num_hidden=512, name="g2"), act_type="relu")
+    gate_net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        fc2, num_hidden=16, name="g3"), name="softmax")
+    # the gate's warm entry is SYNTHETIC (never measured) — stage it in
+    # a scratch cache file so it can never leak into the user's
+    # persistent cache and silently override a real remat flag later
+    import tempfile
+
+    gate_cache = os.path.join(tempfile.mkdtemp(prefix="mxtune_gate_"),
+                              "tuning.json")
+    prev_cache = os.environ.get("MXNET_TUNE_CACHE")
+    os.environ["MXNET_TUNE_CACHE"] = gate_cache
+    autotune.cache.reset()
+    autotune.record("exec.remat", _GraphProgram(gate_net).tuning_key(),
+                    {"mirror": 0})
+    # step must be big enough (several ms) that constant
+    # per-instance CPU noise sits well under the 1% gate
+    gbs, gsteps = 128, (20 if QUICK else 60)
+    gbatch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.rand(gbs, 64).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 16, gbs).astype(np.float32))])
+
+    def gate_build(mode):
+        # the cache consult happens at program-build (trace) time, so
+        # the mode is pinned while this module compiles its train step
+        mxconfig.set_flag("MXNET_TUNE", mode)
+        mod = mx.mod.Module(gate_net, context=mx.cpu(),
+                            data_names=("data",))
+        mod.bind(data_shapes=[("data", (gbs, 64))],
+                 label_shapes=[("softmax_label", (gbs,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),))
+        for _ in range(3):  # compile + warm
+            mod.forward_backward(gbatch)
+            mod.update()
+        mod.get_outputs()[0].asnumpy()
+        return mod
+
+    def gate_steps(mod):
+        t0 = time.perf_counter()
+        for _ in range(gsteps):
+            mod.forward_backward(gbatch)
+            mod.update()
+        mod.get_outputs()[0].asnumpy()
+        return (time.perf_counter() - t0) / gsteps
+
+    gate_key = _GraphProgram(gate_net).tuning_key()
+    try:
+        mod_bypass = gate_build(-1)   # no lookups at all
+        mod_consult = gate_build(0)   # warm cache consulted at build
+        bypass_s = consult_s = float("inf")
+        # interleaved A/B walls — INFORMATIONAL: two separately-built
+        # executables of the same program differ by a few percent on
+        # their own (codegen/allocator instance variance), so the hard
+        # gate below is on the stable quantities instead
+        for _ in range(6):
+            bypass_s = min(bypass_s, gate_steps(mod_bypass))
+            consult_s = min(consult_s, gate_steps(mod_consult))
+        # (a) the steady-state step path performs ZERO cache lookups —
+        # consults happen at program-build time only
+        autotune.reset_stats()
+        gate_steps(mod_consult)
+        lk = autotune.stats()
+        per_step_lookups = lk["hits"] + lk["misses"]
+        # (b) even if every step DID pay one warm lookup, it would be
+        # invisible: measure the warm-probe latency head-on
+        n_probe = 2000
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            autotune.lookup("exec.remat", gate_key)
+        lookup_s = (time.perf_counter() - t0) / n_probe
+    finally:
+        mxconfig.set_flag("MXNET_TUNE", None)
+        if prev_cache is None:
+            os.environ.pop("MXNET_TUNE_CACHE", None)
+        else:
+            os.environ["MXNET_TUNE_CACHE"] = prev_cache
+        autotune.cache.reset()
+    pct = 100.0 * lookup_s / consult_s
+    results["warm_cache_overhead"] = {
+        "bypass_ms_per_step": round(bypass_s * 1e3, 4),
+        "consult_ms_per_step": round(consult_s * 1e3, 4),
+        "ab_delta_pct": round(100.0 * (consult_s - bypass_s) / bypass_s,
+                              2),
+        "per_step_lookups": per_step_lookups,
+        "warm_lookup_us": round(lookup_s * 1e6, 2),
+        "overhead_pct": round(pct, 4), "threshold_pct": gate_pct,
+        "protocol": "MLP 64-512-512-16 bs%d fused train step; gate = "
+                    "zero per-step lookups + one warm lookup as %% of a "
+                    "step (A/B walls informational: separately-built "
+                    "executables carry instance variance)" % gbs,
+    }
+    print("[bench_all] autotune warm-cache overhead: %d per-step "
+          "lookups, warm lookup %.1f us = %.4f%% of a %.2f ms step "
+          "(gate %.2f%%)" % (per_step_lookups, lookup_s * 1e6, pct,
+                             consult_s * 1e3, gate_pct), file=sys.stderr)
+
+    # merge into the bench artifact
+    out_path = os.path.join(here, "BENCH_ALL.json")
+    try:
+        with open(out_path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["autotune"] = results
+    tmp = out_path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps({"autotune": results}))
+    if per_step_lookups:
+        raise SystemExit(
+            "bench_all --autotune: %d cache lookups on the steady-state "
+            "step path — consults must stay at program-build time"
+            % per_step_lookups)
+    if pct > gate_pct:
+        raise SystemExit(
+            "bench_all --autotune: a warm lookup costs %.4f%% of a step "
+            "(> %.2f%% gate) — trace-time lookups must stay free"
+            % (pct, gate_pct))
+    print("[bench_all] autotune gate passed (%.2f%% <= %.2f%%)"
+          % (pct, gate_pct), file=sys.stderr)
+    return results
+
+
 def assert_lint_clean():
     """--lint-clean: graftlint must exit 0 against the committed baseline.
 
@@ -791,5 +1146,10 @@ if __name__ == "__main__":
         # standalone gate: warn-mode health checking must cost <= 2% per
         # step on the transformer microbench (docs/health.md)
         bench_health_overhead()
+    elif "--autotune" in sys.argv[1:]:
+        # tuned-vs-default on the autotuner's three knob families +
+        # the warm-cache (<1%/step) overhead gate (docs/autotune.md);
+        # merges an "autotune" section into BENCH_ALL.json
+        bench_autotune()
     else:
         main(telemetry="--telemetry" in sys.argv[1:])

@@ -53,6 +53,7 @@ from . import monitor as mon  # reference alias (__init__.py:63)
 from .monitor import Monitor
 from . import profiler
 from . import observability
+from . import autotune
 from . import rtc
 from . import storage
 from . import attribute

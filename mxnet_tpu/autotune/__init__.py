@@ -1,0 +1,117 @@
+"""Search-based autotuner (ISSUE 6; ROADMAP open item 2).
+
+Turns the repo's hand-picked performance constants — Pallas
+flash-attention block bounds, the serving bucket ladder, per-graph
+layout and remat policy — into one tuned, persisted, observable
+subsystem:
+
+* :mod:`.registry` — call sites declare their knob + search space
+  (``flash_attention.fwd``/``.bwd``, ``serving.buckets``,
+  ``graph.layout``, ``exec.remat``),
+* :mod:`.cost_model` — analytic roofline estimates prune candidates
+  (measured ceilings from PERF_NOTES.md, VMEM feasibility),
+* :mod:`.search` — measured search decides (median-of-k, warmup
+  discarded, incumbent default always in the running),
+* :mod:`.cache` — winners persist per device fingerprint in
+  ``MXNET_TUNE_CACHE`` (default ``~/.cache/mxnet_tpu/tuning.json``),
+  written atomically; consumers pay one dict probe at trace time.
+
+Modes (``MXNET_TUNE``): ``0`` (default) consult the cache, never
+measure; ``1`` additionally search on a miss at shape-local call sites
+(outside any jax trace); ``-1`` bypass lookups entirely (A/B baseline).
+Quick start: docs/autotune.md.
+"""
+from . import cache, cost_model, registry, search
+from .cache import (cache_path, device_fingerprint, lookup, lookup_entry,
+                    record, reload, reset, reset_stats, scrub_stale, stats)
+from .registry import declare, get as get_tunable, names as tunable_names
+from .search import SearchConfig, SearchResult, median_time, tune_and_record
+
+__all__ = ["cache", "registry", "cost_model", "search",
+           "cache_path", "device_fingerprint", "lookup", "lookup_entry",
+           "lookup_or_tune", "record", "reload", "reset", "reset_stats",
+           "scrub_stale", "stats", "declare", "get_tunable",
+           "tunable_names", "SearchConfig", "SearchResult", "median_time",
+           "tune_and_record", "mode", "enabled",
+           "tune_flash_attention", "tune_serving_buckets", "tune_layout",
+           "tune_remat", "flash_shape_key"]
+
+
+# the layout knob has no single in-package call site (models take
+# layout= at construction), so unlike the flash/serving/remat tunables
+# it is declared here at package import — registry.get("graph.layout")
+# must work without the lazily-loaded tuners module; its generic
+# measured-choice tuner is tuners.tune_layout
+declare(
+    "graph.layout",
+    space={"layout": ("NHWC", "NCHW")},
+    default=lambda ctx: {"layout": str(ctx.get("default", "NHWC"))},
+    doc="Per-graph data layout: NHWC feeds the MXU lanes on TPU "
+        "(LAYOUT_AUDIT*.json); NCHW can win on other backends. Measured "
+        "through a caller-supplied train/infer step (tune_layout).")
+
+
+def mode():
+    """MXNET_TUNE: -1 bypass, 0 consult-only (default), 1 search on
+    miss."""
+    from ..config import get_flag
+
+    return get_flag("MXNET_TUNE")
+
+
+def enabled():
+    return mode() >= 0
+
+
+def lookup_or_tune(op, key, dtype=None, ctx=None):
+    """The consulting call sites' trace-time entry point.
+
+    Hit → the tuned value (one dict probe). Miss → None (caller falls
+    back to its config.py default), EXCEPT when ``MXNET_TUNE=1`` and the
+    call happens outside any jax trace: then the op's auto-tuner runs a
+    measured search on the spot, records the winner, and returns it.
+    Mid-trace misses never search — a measurement storm inside someone
+    else's jit would corrupt both the trace and the timings.
+    """
+    if mode() < 0:
+        return None
+    val = cache.lookup(op, key, dtype)
+    if val is not None or mode() != 1:
+        return val
+    try:
+        from jax.core import trace_state_clean
+
+        if not trace_state_clean():
+            return None
+    except Exception:
+        return None
+    # the guard above proves we are OUTSIDE any jax trace here; resolve
+    # the tuner through getattr so the static traced-closure analysis
+    # (graftlint) doesn't drag the whole measurement stack into the
+    # consulting call site's trace context
+    import importlib
+
+    _fn = getattr(importlib.import_module(__name__ + ".tuners"),
+                  "auto_tune")
+    try:
+        return _fn(op, key, dict(ctx or {}))
+    except Exception as err:  # tuning is an optimization, never a crash
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "autotune: search for %s failed (%r); using defaults", op, err)
+        return None
+
+
+def __getattr__(name):
+    # concrete tuners import serving/parallel lazily; loading them on
+    # first use keeps `import mxnet_tpu` free of the heavy path.
+    # (importlib, not `from . import`: the latter probes this very
+    # __getattr__ through hasattr and recurses)
+    if name in ("tune_flash_attention", "tune_serving_buckets",
+                "tune_layout", "tune_remat", "flash_shape_key", "tuners"):
+        import importlib
+
+        tuners = importlib.import_module(__name__ + ".tuners")
+        return tuners if name == "tuners" else getattr(tuners, name)
+    raise AttributeError(name)

@@ -1,0 +1,136 @@
+"""Analytic roofline cost model — the cheap pruning half of the search
+(ISSUE 6; "A Learned Performance Model for TPUs" is the graduation path,
+this is the start-analytic rung ROADMAP item 2 names).
+
+Estimates are in SECONDS and deliberately coarse: the model's only job is
+to rank candidates well enough that the measured search (search.py) never
+wastes a compile on a block pair that overflows VMEM or a ladder that
+pads 4x, not to predict absolute times. Ceilings are the repo's own
+measured numbers (PERF_NOTES.md round-5 calibration, the same basis as
+tools/flops_anchor.py), not spec-sheet values.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["MEASURED_MATMUL_TF", "MEASURED_HBM_GBPS", "VMEM_BYTES",
+           "roofline_seconds", "flash_fwd_cost", "flash_bwd_cost",
+           "flash_vmem_bytes", "ladder_cost", "expected_padding",
+           "pow2_at_least"]
+
+
+def pow2_at_least(n):
+    """Smallest power of two >= n (shape-bucket / ladder-top rounding)."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+# measured ceilings (PERF_NOTES.md: 8192^3 matmul scan; bf16 stream,
+# round-5 recalibration) — one consistent basis with flops_anchor.py
+MEASURED_MATMUL_TF = 128.6
+MEASURED_HBM_GBPS = 634.0
+# per-core VMEM; Pallas tiles + double-buffered input windows must fit
+VMEM_BYTES = 16 * 2 ** 20
+_VMEM_BUDGET = int(VMEM_BYTES * 0.75)  # headroom for Mosaic's own buffers
+# fixed cost per grid step (loop + DMA issue) — dominates tiny blocks
+_GRID_STEP_S = 2e-7
+
+
+def roofline_seconds(flops, hbm_bytes):
+    """max(compute, bandwidth) time at the measured ceilings."""
+    return max(flops / (MEASURED_MATMUL_TF * 1e12),
+               hbm_bytes / (MEASURED_HBM_GBPS * 1e9))
+
+
+def _dtype_bytes(ctx):
+    return int(ctx.get("dtype_bytes", 2))  # bf16 default
+
+
+def flash_vmem_bytes(bq, bk, D, dtype_bytes, backward=False):
+    """Live VMEM of one grid step (input tiles double-buffered by the
+    pipeline, fp32 accumulators single-buffered)."""
+    db = dtype_bytes
+    if not backward:
+        tiles = (bq * D * db          # q
+                 + 2 * bk * D * db    # k, v
+                 + bq * D * db)       # out
+        scratch = bq * D * 4 + 2 * bq * 4      # acc, m, l (fp32)
+    else:
+        # worst of the two passes: dkv holds q/k/v/do tiles + two fp32
+        # accumulators; dq holds the same tiles + one accumulator
+        tiles = (2 * bq * D * db      # q, do
+                 + 2 * bk * D * db    # k, v
+                 + 2 * bq * 4)        # lse, delta rows
+        scratch = 2 * bk * D * 4      # dk_acc, dv_acc
+    # block score/probability tile s/p: (bq, bk) fp32 intermediates
+    inter = bq * bk * 4 * (2 if backward else 1)
+    return 2 * tiles + scratch + inter
+
+
+def _flash_cost(ctx, bq, bk, backward):
+    T = int(ctx["T"])
+    D = int(ctx.get("D", 64))
+    BH = int(ctx.get("B", 1)) * int(ctx.get("H", 1))
+    causal = bool(ctx.get("causal", False))
+    db = _dtype_bytes(ctx)
+    bq = min(bq, T)
+    bk = min(bk, T)
+    if flash_vmem_bytes(bq, bk, D, db, backward=backward) > _VMEM_BUDGET:
+        return math.inf
+    n_q, n_k = -(-T // bq), -(-T // bk)
+    live = 0.5 if causal else 1.0  # dead-block skip halves the grid work
+    steps = BH * n_q * n_k
+    # fwd: qk^T + pv = 4*bq*bk*D flops/block; bwd recompute ~2.5x (s, dp,
+    # ds, dq/dk/dv accumulation across two passes)
+    flops = 4 * bq * bk * D * steps * live * (2.5 if backward else 1.0)
+    traffic = steps * (bq * D + 2 * bk * D) * db * (2.0 if backward else 1.0)
+    return roofline_seconds(flops, traffic) + steps * _GRID_STEP_S
+
+
+def flash_fwd_cost(candidate, ctx):
+    """Estimated seconds of one flash-attention forward at this block
+    pair; inf when the tiles overflow VMEM."""
+    return _flash_cost(ctx, int(candidate["block_q"]),
+                       int(candidate["block_k"]), backward=False)
+
+
+def flash_bwd_cost(candidate, ctx):
+    """Estimated seconds of the two tiled backward passes."""
+    return _flash_cost(ctx, int(candidate["block_q"]),
+                       int(candidate["block_k"]), backward=True)
+
+
+# ----------------------------------------------------------- bucket ladders
+def expected_padding(ladder, sizes):
+    """(padded_rows / real_rows) of serving ``sizes`` under ``ladder``,
+    with oversize requests chunked at the largest bucket first — the
+    engine's admission behavior (serving/engine.py)."""
+    ladder = sorted(set(int(b) for b in ladder))
+    top = ladder[-1]
+    real = alloc = 0
+    for n in sizes:
+        n = int(n)
+        real += n
+        while n > top:
+            alloc += top
+            n -= top
+        if n:
+            i = 0
+            while ladder[i] < n:
+                i += 1
+            alloc += ladder[i]
+    if not real:
+        return 0.0
+    return (alloc - real) / real
+
+
+def ladder_cost(candidate, ctx):
+    """Rank bucket ladders: expected pad-waste ratio (the per-request
+    compute overhead) plus a small per-bucket compile penalty — compile
+    count is len(ladder) x replicas forever (serving/buckets.py)."""
+    ladder = candidate["buckets"]
+    sizes = ctx.get("sizes") or (1,)
+    if not ladder:
+        return math.inf
+    return expected_padding(ladder, sizes) + 0.02 * len(ladder)

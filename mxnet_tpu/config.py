@@ -99,10 +99,11 @@ Flags currently honored:
     Capacity of the flight recorder's last-K ring of per-step health
     records (observability/flight_recorder.py).
 
-``MXNET_HEALTH_DUMP_DIR`` (default ``.``)
+``MXNET_HEALTH_DUMP_DIR`` (default ``health_dumps/``)
     Directory flight-recorder triage dumps are written into (atomic
-    temp+rename). String-valued, env-only;
-    ``flight_recorder.configure(dump_dir=...)`` overrides at runtime.
+    temp+rename; created on demand, never the repo root). String-valued,
+    env-only; ``flight_recorder.configure(dump_dir=...)`` overrides at
+    runtime.
 
 ``MXNET_SERVING_MAX_WAIT_MS`` (default 5)
     Micro-batching deadline of the serving engine (serving/engine.py):
@@ -130,6 +131,26 @@ Flags currently honored:
     compile count is bounded by len(buckets) x replicas, never by
     traffic. String-valued, env-only (pass ``buckets=`` to
     ServingConfig to override at runtime).
+
+``MXNET_TUNE`` (default 0)
+    Autotuner mode (autotune/, docs/autotune.md): ``0`` consults the
+    persistent tuning cache at the wired call sites (flash-attention
+    block bounds, serving bucket ladder, executor remat) — a hit is one
+    dict probe, a miss falls back to the defaults below, and no
+    measurement ever runs; ``1`` additionally runs the measured search
+    on a miss at shape-local call sites (outside any jax trace);
+    ``-1`` bypasses cache lookups entirely (the A/B baseline the
+    ``bench_all.py --autotune`` overhead gate uses).
+
+``MXNET_TUNE_TRIALS`` (default 12)
+    Measurement budget per search: total candidates timed (median-of-k
+    each) after analytic-cost pruning.
+
+``MXNET_TUNE_CACHE`` (default ``~/.cache/mxnet_tpu/tuning.json``)
+    Tuning-cache file path. String-valued, env-only (like
+    MXNET_PROFILER_MODE). ``MXNET_TUNE_FINGERPRINT`` (env-only)
+    overrides the device fingerprint half of every cache key — tests,
+    or shipping one tuned cache to a known fleet.
 
 ``MXNET_PROFILER_MODE`` (default ``symbolic``)
     Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
@@ -167,6 +188,8 @@ _DEFAULTS = {
     "MXNET_SERVING_MAX_WAIT_MS": 5,
     "MXNET_SERVING_QUEUE": 1024,
     "MXNET_SERVING_PIPELINE": 2,
+    "MXNET_TUNE": 0,
+    "MXNET_TUNE_TRIALS": 12,
 }
 
 

@@ -18,8 +18,27 @@ just applies the stashed gradients according to grad_req. An explicit
 """
 from __future__ import annotations
 
+from .autotune.registry import declare as _declare_tunable
 from .base import MXNetError
 from .context import Context
+
+
+def _remat_default(ctx):
+    from .config import get_flag
+
+    return {"mirror": int(bool(get_flag("MXNET_BACKWARD_DO_MIRROR")))}
+
+
+# the executor's program-build knob (ISSUE 6): store activations vs
+# jax.checkpoint recompute for the fused train program — a measured
+# HBM-footprint/backward-FLOPs tradeoff, keyed per graph fingerprint
+# (autotune.tune_remat drives the measurement)
+_declare_tunable(
+    "exec.remat",
+    space={"mirror": (0, 1)},
+    default=_remat_default,
+    doc="Fused train program remat policy: 0 = store activations, "
+        "1 = rematerialize in backward (jax.checkpoint).")
 
 
 def _maybe_jit(f):
@@ -36,16 +55,20 @@ def _maybe_jit(f):
     return jax.jit(f)
 
 
-def _maybe_mirror(loss_fn):
-    """Wrap the forward in jax.checkpoint when MXNET_BACKWARD_DO_MIRROR is
-    set: activations are rematerialized during backward instead of stored —
-    the reference's memory-mirroring pass (graph_executor.cc:282-296,
-    docs/faq/env_var.md MXNET_BACKWARD_DO_MIRROR) expressed as remat."""
+def _maybe_mirror(loss_fn, mirror=None):
+    """Wrap the forward in jax.checkpoint when remat is on: activations
+    are rematerialized during backward instead of stored — the
+    reference's memory-mirroring pass (graph_executor.cc:282-296,
+    docs/faq/env_var.md MXNET_BACKWARD_DO_MIRROR) expressed as remat.
+    ``mirror=None`` reads the flag; callers with a tuned per-graph
+    decision (``_GraphProgram.remat_mirror``) pass it explicitly."""
     import jax
 
     from .config import get_flag
 
-    if get_flag("MXNET_BACKWARD_DO_MIRROR"):
+    if mirror is None:
+        mirror = get_flag("MXNET_BACKWARD_DO_MIRROR")
+    if mirror:
         return jax.checkpoint(loss_fn)
     return loss_fn
 
@@ -73,10 +96,44 @@ class _GraphProgram:
             if n.op in self._INIT_OPS
             and 0 in tuple(n.parsed_attrs().get("shape", ()))]
         self._init_shape_cache = {}
+        self._tuning_key = None
         import threading
 
         self._jit_cache = {}  # guarded-by: self._jit_lock
         self._jit_lock = threading.Lock()
+
+    def tuning_key(self):
+        """Stable graph fingerprint for tuning-cache keys: node count +
+        a hash of the op sequence INCLUDING each node's op params
+        (num_hidden, kernel, ... — so same-topology models of different
+        widths never collide on a tuned decision). Bound input shapes
+        are deliberately not part of it; where they matter they ride in
+        the shape-bucket part of the cache key."""
+        if self._tuning_key is None:
+            import hashlib
+
+            sig = ";".join(
+                "%s{%s}" % (n.op, ",".join(
+                    "%s=%s" % (k, n.attrs[k]) for k in sorted(n.attrs)))
+                for n in self.topo)
+            self._tuning_key = "g%d-%s" % (
+                len(self.topo),
+                hashlib.sha1(sig.encode()).hexdigest()[:12])
+        return self._tuning_key
+
+    def remat_mirror(self):
+        """Remat decision for this graph's fused train program: a tuned
+        ``exec.remat`` cache entry (autotune.tune_remat) wins over the
+        MXNET_BACKWARD_DO_MIRROR flag. Consulted once per train_fn build
+        — one dict probe, cached with the compiled program."""
+        from .autotune import lookup
+
+        tuned = lookup("exec.remat", key=self.tuning_key())
+        if tuned is not None:
+            return bool(tuned.get("mirror", 0))
+        from .config import get_flag
+
+        return bool(get_flag("MXNET_BACKWARD_DO_MIRROR"))
 
     def _resolve_init_shapes(self, arg_shapes):
         """Infer concrete shapes for deferred init-op nodes given the bound
@@ -211,6 +268,8 @@ class _GraphProgram:
         key = ("train", tuple(grad_names))
         with self._jit_lock:
             if key not in self._jit_cache:
+                mirror = self.remat_mirror()
+
                 def f(nograd_d, grad_d, aux_d, rngs, seeds):
                     def inner(gd):
                         merged = dict(nograd_d)
@@ -218,7 +277,7 @@ class _GraphProgram:
                         outs, aux_upd = self._eval(merged, aux_d, rngs, True)
                         return tuple(outs), aux_upd
 
-                    inner = _maybe_mirror(inner)
+                    inner = _maybe_mirror(inner, mirror)
                     outs, vjp, aux_upd = jax.vjp(inner, grad_d, has_aux=True)
                     grads = vjp(tuple(seeds))[0]
                     return outs, aux_upd, grads

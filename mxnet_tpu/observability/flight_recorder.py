@@ -26,7 +26,8 @@ interpreter exit when an anomaly was recorded after the last dump
 
 Knobs: ``MXNET_HEALTH_RING`` (ring capacity, default 256, via
 config.get_flag) and ``MXNET_HEALTH_DUMP_DIR`` (dump directory, default
-the working directory; env-only string, like MXNET_PROFILER_MODE).
+``health_dumps/`` under the working directory so triage files never
+litter a repo root; env-only string, like MXNET_PROFILER_MODE).
 """
 from __future__ import annotations
 
@@ -217,7 +218,8 @@ def dump(reason="on-demand", path=None):
         _dump_count += 1
         n = _dump_count
         seq_now = _seq
-        out_dir = _dump_dir or os.environ.get("MXNET_HEALTH_DUMP_DIR") or "."
+        out_dir = (_dump_dir or os.environ.get("MXNET_HEALTH_DUMP_DIR")
+                   or "health_dumps")
     payload = {
         "version": 1,
         "reason": str(reason),

@@ -31,9 +31,42 @@ import functools
 
 import numpy as np
 
+from ..autotune import cost_model as _tune_cost
+from ..autotune.registry import declare as _declare_tunable
 from ..config import get_flag
 
 __all__ = ["flash_attention"]
+
+
+def _block_space(ctx):
+    """Candidate block bounds at this shape: powers of two up to
+    min(T, 2048) — bounds, not exact sizes (the largest divisor of T at
+    or below the bound is what actually runs)."""
+    T = int(ctx.get("T", 2048))
+    vals = [b for b in (128, 256, 512, 1024, 2048) if b <= T]
+    return tuple(vals) if vals else (T,)
+
+
+# the knob + search-space declaration lives AT the call site (ISSUE 6):
+# the tuner sweeps per-call overrides below, no env mutation involved
+_declare_tunable(
+    "flash_attention.fwd",
+    space=lambda ctx: {"block_q": _block_space(ctx),
+                       "block_k": _block_space(ctx)},
+    default=lambda ctx: {"block_q": get_flag("MXNET_FLASH_BLOCK_Q"),
+                         "block_k": get_flag("MXNET_FLASH_BLOCK_K")},
+    cost=_tune_cost.flash_fwd_cost,
+    doc="Forward kernel q/k block upper bounds (config defaults from "
+        "the round-5 on-chip sweep at T=4096).")
+_declare_tunable(
+    "flash_attention.bwd",
+    space=lambda ctx: {"block_q": _block_space(ctx),
+                       "block_k": _block_space(ctx)},
+    default=lambda ctx: {"block_q": get_flag("MXNET_FLASH_BWD_BLOCK_Q"),
+                         "block_k": get_flag("MXNET_FLASH_BWD_BLOCK_K")},
+    cost=_tune_cost.flash_bwd_cost,
+    doc="Backward (dq + dk/dv recompute passes) block upper bounds — "
+        "more live tiles per grid step than the forward.")
 
 
 def _compiler_params(pltpu, **kw):
@@ -41,6 +74,16 @@ def _compiler_params(pltpu, **kw):
     cls = getattr(pltpu, "CompilerParams", None) \
         or getattr(pltpu, "TPUCompilerParams")
     return cls(**kw)
+
+
+def _tuned_block(value):
+    """Positive-int coercion of a tuning-cache value; a corrupt or
+    hand-edited entry degrades to the config default, never a crash."""
+    try:
+        value = int(value)
+    except (TypeError, ValueError):
+        return None
+    return value if value > 0 else None
 
 
 def _pick_block(T, bound):
@@ -236,11 +279,15 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     """Blocked attention; q/k/v: (batch, heads, T, d).
 
     Block arguments are upper bounds; the largest divisors of T at or
-    below them are used. Unset bounds come from config.py
-    (MXNET_FLASH_BLOCK_Q/K for the forward, MXNET_FLASH_BWD_BLOCK_Q/K for
-    the backward; forward defaults from an on-chip sweep at T=4096, v5e,
-    round 5: 1024/1024 measures 2.49 ms vs 2.67 ms for 512/512 and
-    35.5 ms for the dense XLA formula). Differentiable: the vjp runs the
+    below them are used. Unset bounds resolve through the autotuner
+    first — a persistent per-device tuning-cache entry for this
+    (shape-bucket, dtype) wins (docs/autotune.md; a miss with
+    MXNET_TUNE=1 outside a trace runs the measured sweep on the spot) —
+    then fall back to config.py (MXNET_FLASH_BLOCK_Q/K for the forward,
+    MXNET_FLASH_BWD_BLOCK_Q/K for the backward; forward defaults from an
+    on-chip sweep at T=4096, v5e, round 5: 1024/1024 measures 2.49 ms vs
+    2.67 ms for 512/512 and 35.5 ms for the dense XLA formula).
+    Differentiable: the vjp runs the
     tiled recompute backward kernels above (dense XLA autodiff of the
     reference formula when MXNET_FLASH_ATTENTION_BWD=0).
 
@@ -256,10 +303,36 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
 
     B, H, T, D = q.shape
     scale = float(scale) if scale is not None else float(1.0 / np.sqrt(D))
-    block_q = int(block_q or get_flag("MXNET_FLASH_BLOCK_Q"))
-    block_k = int(block_k or get_flag("MXNET_FLASH_BLOCK_K"))
-    block_q_bwd = int(block_q_bwd or get_flag("MXNET_FLASH_BWD_BLOCK_Q"))
-    block_k_bwd = int(block_k_bwd or get_flag("MXNET_FLASH_BWD_BLOCK_K"))
+    # block resolution: explicit per-call override > tuning-cache entry
+    # for this (device, shape-bucket, dtype) > config.py flag. The cache
+    # consult is one dict probe at trace time; a miss under MXNET_TUNE=1
+    # (outside any jax trace) runs the measured sweep right here.
+    tuned_fwd = tuned_bwd = None
+    if None in (block_q, block_k, block_q_bwd, block_k_bwd):
+        from .. import autotune
+
+        key = autotune.flash_shape_key(T, D, causal)
+        ctx = {"T": T, "D": D, "B": B, "H": H, "causal": causal,
+               "dtype": str(q.dtype), "dtype_bytes": q.dtype.itemsize,
+               "interpret": interpret or None}
+        if block_q is None or block_k is None:
+            tuned_fwd = autotune.lookup_or_tune(
+                "flash_attention.fwd", key, dtype=str(q.dtype), ctx=ctx)
+        if block_q_bwd is None or block_k_bwd is None:
+            tuned_bwd = autotune.lookup_or_tune(
+                "flash_attention.bwd", key, dtype=str(q.dtype), ctx=ctx)
+    # corrupt/hand-edited entries (including non-dict values) degrade to
+    # the config defaults — tuning is an optimization, never a crash
+    tuned_fwd = tuned_fwd if isinstance(tuned_fwd, dict) else {}
+    tuned_bwd = tuned_bwd if isinstance(tuned_bwd, dict) else {}
+    block_q = int(block_q or _tuned_block(tuned_fwd.get("block_q"))
+                  or get_flag("MXNET_FLASH_BLOCK_Q"))
+    block_k = int(block_k or _tuned_block(tuned_fwd.get("block_k"))
+                  or get_flag("MXNET_FLASH_BLOCK_K"))
+    block_q_bwd = int(block_q_bwd or _tuned_block(tuned_bwd.get("block_q"))
+                      or get_flag("MXNET_FLASH_BWD_BLOCK_Q"))
+    block_k_bwd = int(block_k_bwd or _tuned_block(tuned_bwd.get("block_k"))
+                      or get_flag("MXNET_FLASH_BWD_BLOCK_K"))
     # block sizes are upper bounds: the largest divisor of T at or below
     # the bound is used. When T has no reasonable divisor (prime-ish), a
     # "block" would balloon toward T and defeat the kernel — fall back to

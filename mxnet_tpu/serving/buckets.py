@@ -17,9 +17,66 @@ from __future__ import annotations
 import bisect
 import os
 
-__all__ = ["parse_buckets", "pick_bucket", "DEFAULT_BUCKETS"]
+from ..autotune import cost_model as _tune_cost
+from ..autotune.cost_model import pow2_at_least as _pow2_at_least
+from ..autotune.registry import declare as _declare_tunable
+
+__all__ = ["parse_buckets", "pick_bucket", "DEFAULT_BUCKETS",
+           "ladder_candidates", "traffic_signature"]
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def ladder_candidates(max_size=None, sizes=None):
+    """Candidate bucket ladders for the autotuner, all topped by the
+    smallest power of two covering ``max_size`` (default: the traffic
+    sample's largest request, else the default ladder's top): the full
+    power-of-two ladder, a sparse (x4-step) one, the two extremes
+    (single bucket / {1, top}), and — given a traffic sample — a
+    quantile ladder built from its p50/p95."""
+    if max_size is None:
+        max_size = max(sizes) if sizes else DEFAULT_BUCKETS[-1]
+    top = _pow2_at_least(int(max_size))
+    full = []
+    b = 1
+    while b <= top:
+        full.append(b)
+        b <<= 1
+    sparse = sorted(set(full[::2]) | {1, top})
+    cands = {tuple(full), tuple(sparse), (1, top), (top,)}
+    if sizes:
+        ordered = sorted(int(n) for n in sizes)
+        q = {1, top}
+        for pct in (0.5, 0.95):
+            q.add(min(top, _pow2_at_least(
+                ordered[int(pct * (len(ordered) - 1))])))
+        cands.add(tuple(sorted(q)))
+    return sorted(cands)
+
+
+def traffic_signature(sizes):
+    """Quantized fingerprint of a request-size sample — the traffic-shape
+    half of a ``serving.buckets`` tuning-cache key."""
+    ordered = sorted(int(n) for n in sizes)
+    if not ordered:
+        return "empty"
+    pick = lambda pct: ordered[int(pct * (len(ordered) - 1))]  # noqa: E731
+    return "p50x%d-p95x%d-maxx%d" % (
+        _pow2_at_least(pick(0.5)), _pow2_at_least(pick(0.95)),
+        _pow2_at_least(ordered[-1]))
+
+
+# the ladder's knob declaration (ISSUE 6): candidates are whole ladders,
+# ranked analytically by expected pad-waste + a per-bucket compile
+# penalty, then measured on a live server (autotune.tune_serving_buckets)
+_declare_tunable(
+    "serving.buckets",
+    space=lambda ctx: {"buckets": tuple(ladder_candidates(
+        ctx.get("max_size"), ctx.get("sizes")))},
+    default=lambda ctx: {"buckets": parse_buckets(None)},
+    cost=_tune_cost.ladder_cost,
+    doc="Serving batch-bucket ladder, keyed by (model fingerprint, "
+        "traffic shape).")
 
 
 def parse_buckets(spec=None):

@@ -196,10 +196,17 @@ class InferenceServer:
     ignored (buckets replace it). All non-data arguments missing from
     ``arg_params`` (e.g. a SoftmaxOutput label) are zero-filled at their
     inferred per-bucket shapes, matching ``simple_bind``.
+
+    Without an explicit ``config``, the bucket ladder resolves through
+    the autotuner first — a ``serving.buckets`` tuning-cache entry for
+    (this device, this model, ``traffic_key``), recorded by
+    ``autotune.tune_serving_buckets`` — then the MXNET_SERVING_BUCKETS
+    env, then the power-of-two default (docs/autotune.md).
     """
 
     def __init__(self, symbol, arg_params, aux_params=None, data_shapes=None,
-                 devices=None, mesh=None, config=None, start=True):
+                 devices=None, mesh=None, config=None, start=True,
+                 traffic_key="default"):
         import jax
 
         if data_shapes is None:
@@ -207,7 +214,27 @@ class InferenceServer:
                              "with the batch axis leading")
         self._symbol = symbol
         self._prog = _GraphProgram(symbol)
-        self._cfg = config or ServingConfig()
+        if config is None:
+            # trace-time tuning-cache consult (ISSUE 6): a ladder tuned
+            # for this (device, model, traffic shape) beats the env/
+            # default ladder; a miss costs one dict probe and falls
+            # through to ServingConfig's usual resolution. Tuning is
+            # explicit (autotune.tune_serving_buckets — it needs a
+            # traffic sample), so no search can trigger here.
+            from .. import autotune
+
+            tuned = autotune.lookup(
+                "serving.buckets",
+                key=(self._prog.tuning_key(), traffic_key))
+            if not isinstance(tuned, dict):
+                tuned = {}
+            try:
+                config = ServingConfig(buckets=tuned.get("buckets"))
+            except (ValueError, TypeError):
+                # a corrupt/hand-edited cache entry must never take the
+                # server down — tuning is an optimization
+                config = ServingConfig()
+        self._cfg = config
         self._data_names = [d[0] for d in data_shapes]
         self._row_shapes = [tuple(d[1][1:]) for d in data_shapes]
         unknown = [n for n in self._data_names

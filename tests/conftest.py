@@ -29,6 +29,16 @@ assert len(jax.devices()) >= 8, (
 # a workflow artifact (.github/workflows/ci.yml, if: always()).
 os.environ.setdefault("MXNET_HEALTH_DUMP_DIR", "health_dumps")
 
+# ---- autotuner: hermetic tuning cache -------------------------------------
+# The persistent tuning cache defaults to ~/.cache/mxnet_tpu/tuning.json;
+# a developer's tuned entries must never steer (or be clobbered by) unit
+# tests, so the whole run gets a throwaway cache file. Tests that exercise
+# the cache override this again per-test (tests/test_autotune.py).
+import tempfile
+
+os.environ["MXNET_TUNE_CACHE"] = os.path.join(
+    tempfile.mkdtemp(prefix="mxnet_tune_test_"), "tuning.json")
+
 import pytest  # noqa: E402
 
 _FAILURE_DUMPS = {"n": 0, "max": 5}  # bound artifact size on mass failures
