@@ -34,7 +34,7 @@ __all__ = ["cache", "registry", "cost_model", "search",
            "tunable_names", "SearchConfig", "SearchResult", "median_time",
            "tune_and_record", "mode", "enabled",
            "tune_flash_attention", "tune_serving_buckets", "tune_layout",
-           "tune_remat", "flash_shape_key"]
+           "tune_remat", "tune_generation", "flash_shape_key"]
 
 
 # the layout knob has no single in-package call site (models take
@@ -49,6 +49,38 @@ declare(
     doc="Per-graph data layout: NHWC feeds the MXU lanes on TPU "
         "(LAYOUT_AUDIT*.json); NCHW can win on other backends. Measured "
         "through a caller-supplied train/infer step (tune_layout).")
+
+
+def _flag_default(field, flag):
+    # flags resolve at consult time, not at import, so env/config
+    # ordering doesn't matter
+    def default(ctx):
+        from ..config import get_flag
+
+        return {field: get_flag(flag)}
+    return default
+
+
+# generation-subsystem knobs (ISSUE 7): consulted by
+# serving/generation/engine.py (explicit GenerationConfig arg > tuning
+# cache > MXNET_GEN_* flag), measured by tuners.tune_generation. The
+# consuming engine loads lazily, so — like graph.layout — the
+# declarations live here where a fresh process registers them at import.
+declare(
+    "generation.page_size",
+    space={"page_size": (8, 16, 32, 64)},
+    default=_flag_default("page_size", "MXNET_GEN_PAGE_SIZE"),
+    doc="KV-cache page size in tokens: allocation granularity of the "
+        "paged generation cache (small pages waste less on short "
+        "sequences; large pages gather in fewer, longer DMA runs).")
+declare(
+    "generation.decode_blocks",
+    space=lambda ctx: {"decode_blocks": tuple(
+        b for b in (32, 64, 128, 256, 512)
+        if b <= int(ctx.get("max_seq", 512))) or (32,)},
+    default=_flag_default("decode_blocks", "MXNET_GEN_DECODE_BLOCKS"),
+    doc="Decode-attention key-block bound in tokens "
+        "(paged_decode_attention's online-softmax streaming window).")
 
 
 def mode():
@@ -109,7 +141,8 @@ def __getattr__(name):
     # (importlib, not `from . import`: the latter probes this very
     # __getattr__ through hasattr and recurses)
     if name in ("tune_flash_attention", "tune_serving_buckets",
-                "tune_layout", "tune_remat", "flash_shape_key", "tuners"):
+                "tune_layout", "tune_remat", "tune_generation",
+                "generation_replay_measurer", "flash_shape_key", "tuners"):
         import importlib
 
         tuners = importlib.import_module(__name__ + ".tuners")

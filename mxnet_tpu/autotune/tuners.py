@@ -28,7 +28,8 @@ from .search import SearchConfig, median_time, search
 
 __all__ = ["flash_shape_key", "tune_flash_attention",
            "serving_replay_measurer", "tune_serving_buckets",
-           "tune_layout", "tune_remat", "auto_tune"]
+           "tune_layout", "tune_remat", "tune_generation",
+           "generation_replay_measurer", "auto_tune"]
 
 
 from .cost_model import pow2_at_least as _pow2_at_least
@@ -199,6 +200,94 @@ def tune_serving_buckets(symbol, arg_params, data_shapes, sizes,
     cache.record("serving.buckets", (mkey, traffic_key), value,
                  ms=res.best_s * 1e3, trials=res.measured)
     return ladder
+
+
+def generation_replay_measurer(model, params, prompts, max_new=8,
+                               max_batch=4, max_seq=128, fixed=None,
+                               repeats=2, warmup=1):
+    """``measure(candidate)`` for generation knobs: build a live
+    continuous-batching :class:`~mxnet_tpu.serving.generation.Generator`
+    with the candidate knob (merged over ``fixed``), warm every program,
+    replay the prompt sample end to end, return median wall seconds.
+    Shared by :func:`tune_generation` and ``bench_all.py`` so the search
+    and any benchmark comparison measure the same protocol."""
+    from ..serving.generation import (GenerationConfig, Generator,
+                                      SamplingParams)
+
+    def measure(c):
+        kw = dict(fixed or {})
+        kw.update(c)
+        gen = Generator(model, params,
+                        GenerationConfig(max_batch=max_batch,
+                                         max_seq=max_seq, **kw))
+        try:
+            gen.warmup()
+            sp = SamplingParams(max_new_tokens=max_new)
+
+            def run():
+                handles = [gen.submit(p, sp) for p in prompts]
+                for h in handles:
+                    h.result(timeout=300)
+
+            return median_time(run, repeats=repeats, warmup=warmup)
+        finally:
+            gen.stop(drain=True)
+
+    return measure
+
+
+def tune_generation(model, params, prompts=None, max_new=8, max_batch=4,
+                    max_seq=128, trials=None, measure=None):
+    """Measured search over ``generation.page_size`` and
+    ``generation.decode_blocks`` for one checkpoint + slot geometry:
+    each candidate serves a mixed-length prompt sample on a live
+    continuous-batching generator; wall time decides. The two knobs are
+    searched sequentially (page size first, then decode blocks at the
+    winning page size — the blocks knob is downstream of the page
+    layout). Records both under the generator's tuning key
+    (``generation_tune_key``) so a plain ``Generator(model, params)``
+    construction picks the winners up. Returns ``{op: value dict}``.
+
+    ``measure`` (tests/smoke) replaces the live-generator measurer:
+    ``measure(candidate) -> seconds``.
+    """
+    from ..serving.generation.engine import generation_tune_key
+
+    if prompts is None:
+        vocab = int(model.cfg["vocab"])
+        rng = np.random.RandomState(0)
+        # every sample length must satisfy the generator's admission
+        # bound (prompt + max_new <= max_seq), not just the largest
+        top = max(1, max_seq - max_new)
+        lengths = sorted({min(n, top) for n in (3, 9, 17, 29)})
+        prompts = [list(rng.randint(1, vocab, size=n) % vocab)
+                   for n in lengths]
+    prompts = [[int(t) for t in p] for p in prompts]
+    key = generation_tune_key(model, max_batch, max_seq)
+    ctx = {"max_seq": max_seq}
+    cfg = SearchConfig(trials=trials, repeats=2, warmup=1)
+    out = {}
+
+    mk = measure if measure is not None else None
+    page_measure = mk or generation_replay_measurer(
+        model, params, prompts, max_new=max_new, max_batch=max_batch,
+        max_seq=max_seq, repeats=cfg.repeats, warmup=cfg.warmup)
+    res_p = search(registry.get("generation.page_size"), page_measure,
+                   ctx=ctx, cfg=cfg)
+    cache.record("generation.page_size", key, res_p.best,
+                 ms=res_p.best_s * 1e3, trials=res_p.measured)
+    out["generation.page_size"] = res_p.best
+
+    blk_measure = mk or generation_replay_measurer(
+        model, params, prompts, max_new=max_new, max_batch=max_batch,
+        max_seq=max_seq, fixed=dict(res_p.best),
+        repeats=cfg.repeats, warmup=cfg.warmup)
+    res_b = search(registry.get("generation.decode_blocks"), blk_measure,
+                   ctx=ctx, cfg=cfg)
+    cache.record("generation.decode_blocks", key, res_b.best,
+                 ms=res_b.best_s * 1e3, trials=res_b.measured)
+    out["generation.decode_blocks"] = res_b.best
+    return out
 
 
 def tune_layout(measure, key, default="NHWC", trials=None):

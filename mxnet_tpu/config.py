@@ -132,6 +132,51 @@ Flags currently honored:
     traffic. String-valued, env-only (pass ``buckets=`` to
     ServingConfig to override at runtime).
 
+``MXNET_GEN_PAGE_SIZE`` (default 16)
+    KV-cache page size, in tokens, of the generation subsystem
+    (serving/generation/): sequences allocate cache storage page-wise —
+    on prefill for the prompt, one page at a time as decode crosses
+    page boundaries. A ``generation.page_size`` tuning-cache entry
+    (autotune.tune_generation) wins over this flag; an explicit
+    ``GenerationConfig(page_size=...)`` wins over both.
+
+``MXNET_GEN_DECODE_BLOCKS`` (default 128)
+    Key-block bound, in tokens, of the paged decode attention step
+    (``paged_decode_attention``): keys stream through the online-softmax
+    recurrence in blocks of this many positions, bounding the gathered
+    K/V working set. Same resolution order as MXNET_GEN_PAGE_SIZE via
+    the ``generation.decode_blocks`` tunable.
+
+``MXNET_GEN_MAX_BATCH`` (default 8)
+    Decode slot count of the continuous-batching scheduler. The decode
+    step is ONE compiled program over this fixed slot layout (inactive
+    slots are masked), so this also bounds per-step compute.
+
+``MXNET_GEN_MAX_SEQ`` (default 256)
+    Per-sequence cache capacity in tokens: every request must satisfy
+    ``prompt + max_new_tokens <= MXNET_GEN_MAX_SEQ``. Sizes the page
+    table (and, with MXNET_GEN_POOL_PAGES=0, the page pool).
+
+``MXNET_GEN_POOL_PAGES`` (default 0 = auto)
+    Total device page-pool size (including the reserved trash page 0).
+    0 sizes it for the worst case: ``max_batch`` sequences at
+    ``max_seq`` tokens. Smaller pools oversubscribe slots — admission
+    control then holds requests until evictions free pages.
+
+``MXNET_GEN_QUEUE`` (default 64)
+    Admission-queue bound of the generation scheduler, in REQUESTS.
+    Beyond it ``MXNET_GEN_BACKPRESSURE`` applies: ``block`` (default)
+    stalls submitters, ``reject`` raises QueueFullError. The policy
+    is a string env var (not integer get_flag machinery), like
+    MXNET_SERVING_BACKPRESSURE.
+
+``MXNET_GEN_PREFILL_BUCKETS`` (default: powers of two up to
+    MXNET_GEN_MAX_SEQ)
+    Comma-separated prompt-length bucket ladder: prompts pad up to the
+    smallest fitting bucket so prefill compiles are bounded by ladder
+    size, never by traffic. String-valued, env-only (pass
+    ``prefill_buckets=`` to GenerationConfig to override at runtime).
+
 ``MXNET_TUNE`` (default 0)
     Autotuner mode (autotune/, docs/autotune.md): ``0`` consults the
     persistent tuning cache at the wired call sites (flash-attention
@@ -190,6 +235,12 @@ _DEFAULTS = {
     "MXNET_SERVING_PIPELINE": 2,
     "MXNET_TUNE": 0,
     "MXNET_TUNE_TRIALS": 12,
+    "MXNET_GEN_PAGE_SIZE": 16,
+    "MXNET_GEN_DECODE_BLOCKS": 128,
+    "MXNET_GEN_MAX_BATCH": 8,
+    "MXNET_GEN_MAX_SEQ": 256,
+    "MXNET_GEN_POOL_PAGES": 0,
+    "MXNET_GEN_QUEUE": 64,
 }
 
 

@@ -35,7 +35,7 @@ from ..autotune import cost_model as _tune_cost
 from ..autotune.registry import declare as _declare_tunable
 from ..config import get_flag
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "paged_decode_attention"]
 
 
 def _block_space(ctx):
@@ -466,6 +466,81 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
 
     out, lse = _flash(q, k, v)
     return (out, lse) if return_lse else out
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None, block_tokens=None):
+    """Single-query attention against a paged KV cache — the decode step
+    of the generation subsystem (serving/generation/, docs/generation.md).
+
+    ``q``: (S, H, d) — ONE query per sequence slot (the token being
+    decoded); ``k_pages``/``v_pages``: (P, page, H, d) — one layer's
+    device-resident page pool; ``page_table``: (S, n_pages) int32 page
+    ids mapping each slot's logical positions onto pool pages;
+    ``lengths``: (S,) int32 — valid key count per slot (positions at or
+    beyond a slot's length are masked, so stale/trash page contents
+    never contribute; a slot with length 0 yields a zero output).
+
+    Deliberately XLA, not Pallas: at query length 1 there is no MXU
+    tiling to win — the step is HBM-bandwidth-bound on the K/V gather,
+    which XLA lowers to the same dynamic-gather DMA a hand kernel would
+    issue, and a (S, H, block) score tile never approaches VMEM limits.
+    What *is* kernel-shaped about it is the blocking: keys stream in
+    blocks of ``block_tokens`` positions (the ``generation.decode_blocks``
+    tunable; upper bound, rounded to a page multiple dividing the table)
+    through the same online-softmax recurrence as the Pallas forward
+    kernel above, so the gathered K/V working set is O(S * block), not
+    O(S * max_seq). Everything is fixed-shape: one compiled program
+    serves every batch composition (the active-slot mask lives in
+    ``lengths``), which is the whole compile-count discipline of the
+    decode path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S, H, d = q.shape
+    page = k_pages.shape[1]
+    n_pages = page_table.shape[1]
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+    # block bound -> whole pages per block, a divisor of the table width
+    want = max(1, int(block_tokens or n_pages * page) // page)
+    bp = 1
+    for cand in range(min(want, n_pages), 0, -1):
+        if n_pages % cand == 0:
+            bp = cand
+            break
+    n_blocks = n_pages // bp
+    blk = bp * page
+
+    qf = q.astype(jnp.float32) * scale
+    lengths = lengths.astype(jnp.int32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        tab = jax.lax.dynamic_slice_in_dim(page_table, i * bp, bp, axis=1)
+        kb = k_pages[tab].reshape(S, blk, H, d).astype(jnp.float32)
+        vb = v_pages[tab].reshape(S, blk, H, d).astype(jnp.float32)
+        s = jnp.einsum("shd,sthd->sht", qf, kb)          # (S, H, blk)
+        pos = i * blk + jax.lax.iota(jnp.int32, blk)
+        live = pos[None, :] < lengths[:, None]            # (S, blk)
+        s = jnp.where(live[:, None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("sht,sthd->shd", p, vb)
+        return m_new, l, acc
+
+    m0 = jnp.full((S, H), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((S, H), jnp.float32)
+    a0 = jnp.zeros((S, H, d), jnp.float32)
+    if n_blocks == 1:
+        _, l, acc = body(0, (m0, l0, a0))
+    else:
+        _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def _dense_with_lse(q, k, v, causal=False, scale=None):

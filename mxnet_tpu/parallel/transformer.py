@@ -92,22 +92,44 @@ class TransformerParallel:
                 for k, v in params.items()}
 
     # --- the model --------------------------------------------------------
-    def _forward(self, params, tokens):
+    def _qkv(self, params, p, ln):
+        """Q/K/V projections of a normed activation block, returned in
+        the (B, T, H, hd) storage layout the paged KV cache uses."""
+        c = self.cfg
+        B, T = ln.shape[0], ln.shape[1]
+        H = c["n_heads"]
+        hd = c["d_model"] // H
+        q = (ln @ params[p + "wq"]).reshape(B, T, H, hd)
+        k = (ln @ params[p + "wk"]).reshape(B, T, H, hd)
+        v = (ln @ params[p + "wv"]).reshape(B, T, H, hd)
+        return q, k, v
+
+    def _moe_ffn(self, params, p, x):
+        """MoE FFN residual delta: soft gate over ep-sharded experts.
+        Shared verbatim by the training forward, the prefill forward and
+        the single-token decode step, so the three paths cannot drift."""
         import jax
         import jax.numpy as jnp
 
+        ln = _rms_norm(x)
+        gate = jax.nn.softmax(ln @ params[p + "gate"], axis=-1)
+        # (B,T,d) x (E,d,f) -> (B,T,E,f): expert compute stays on the
+        # ep shards; the gate-weighted combine is the all-to-all mix
+        hidden = jnp.einsum("btd,edf->btef", ln, params[p + "w1"])
+        hidden = jax.nn.gelu(hidden)
+        expert_out = jnp.einsum("btef,efd->bted", hidden,
+                                params[p + "w2"])
+        return jnp.einsum("bted,bte->btd", expert_out, gate)
+
+    def _forward(self, params, tokens):
         c = self.cfg
         B, T = tokens.shape
-        d, H = c["d_model"], c["n_heads"]
-        hd = d // H
+        d = c["d_model"]
         x = params["embed"][tokens]  # (B, T, d)
         for li in range(c["n_layers"]):
             p = "l%d_" % li
             # --- attention, heads split on tp, sequence ring on sp ------
-            ln = _rms_norm(x)
-            q = (ln @ params[p + "wq"]).reshape(B, T, H, hd)
-            k = (ln @ params[p + "wk"]).reshape(B, T, H, hd)
-            v = (ln @ params[p + "wv"]).reshape(B, T, H, hd)
+            q, k, v = self._qkv(params, p, _rms_norm(x))
             q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
             if "sp" in self.axes and self.mesh.shape.get("sp", 1) > 1:
                 att = ring_attention(
@@ -119,17 +141,72 @@ class TransformerParallel:
             att = att.transpose(0, 2, 1, 3).reshape(B, T, d)
             x = x + att @ params[p + "wo"]
             # --- MoE FFN: soft top-2-ish gate over ep-sharded experts ---
-            ln = _rms_norm(x)
-            gate = jax.nn.softmax(ln @ params[p + "gate"], axis=-1)
-            # (B,T,d) x (E,d,f) -> (B,T,E,f): expert compute stays on the
-            # ep shards; the gate-weighted combine is the all-to-all mix
-            hidden = jnp.einsum("btd,edf->btef", ln, params[p + "w1"])
-            hidden = jax.nn.gelu(hidden)
-            expert_out = jnp.einsum("btef,efd->bted", hidden,
-                                    params[p + "w2"])
-            x = x + jnp.einsum("bted,bte->btd", expert_out, gate)
+            x = x + self._moe_ffn(params, p, x)
         logits = _rms_norm(x) @ params["out_w"]
         return logits
+
+    # --- incremental decode (generation subsystem) ------------------------
+    def prefill_forward(self, params, tokens):
+        """Full causal forward over a (B, T) prompt that ALSO returns the
+        per-layer K/V it computed — the prefill half of the generation
+        subsystem's prefill/decode split (serving/generation/).
+
+        Returns ``(logits, ks, vs)``: fp32 logits (B, T, V) and stacked
+        projections (L, B, T, H, hd) in cache storage layout. T is a
+        prefill *bucket* length — rows at or beyond the true prompt
+        length are causal-masked garbage the caller never reads (and the
+        pages they land in are overwritten/masked by the decode step).
+        Attention runs the Pallas flash kernel on TPU (same bucketed
+        compile-key discipline as serving) and an fp32 dense reference
+        elsewhere — the same fp32 softmax discipline as
+        :func:`~.flash_attention.paged_decode_attention`, so incremental
+        decode reproduces this forward token-exactly.
+        """
+        import jax.numpy as jnp
+
+        c = self.cfg
+        B, T = tokens.shape
+        d = c["d_model"]
+        x = params["embed"][tokens]
+        ks, vs = [], []
+        for li in range(c["n_layers"]):
+            p = "l%d_" % li
+            q, k, v = self._qkv(params, p, _rms_norm(x))
+            ks.append(k)
+            vs.append(v)
+            q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+            att = _prefill_attention(q, k, v)
+            att = att.transpose(0, 2, 1, 3).reshape(B, T, d)
+            x = x + att @ params[p + "wo"]
+            x = x + self._moe_ffn(params, p, x)
+        logits = (_rms_norm(x) @ params["out_w"]).astype(jnp.float32)
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def decode_forward(self, params, tokens, attend):
+        """One incremental-decode layer stack over a slot batch.
+
+        ``tokens``: (S,) int32 — each active slot's previous token;
+        ``attend(li, q, k_new, v_new) -> (S, H, hd)`` — the caller-owned
+        attention hook: the generation engine scatters ``k_new/v_new``
+        into its paged KV cache and runs
+        :func:`~.flash_attention.paged_decode_attention` against it.
+        The weight math (projections, MoE FFN, norms) is shared with
+        ``_forward``/``prefill_forward``, so any checkpoint that trains
+        here decodes here. Returns fp32 logits (S, V).
+        """
+        import jax.numpy as jnp
+
+        c = self.cfg
+        S = tokens.shape[0]
+        d = c["d_model"]
+        x = params["embed"][tokens]  # (S, d)
+        for li in range(c["n_layers"]):
+            p = "l%d_" % li
+            q, k, v = self._qkv(params, p, _rms_norm(x)[:, None, :])
+            att = attend(li, q[:, 0], k[:, 0], v[:, 0])   # (S, H, hd)
+            x = x + att.reshape(S, d) @ params[p + "wo"]
+            x = x + self._moe_ffn(params, p, x[:, None, :])[:, 0]
+        return (_rms_norm(x) @ params["out_w"]).astype(jnp.float32)
 
     def loss_fn(self, params, tokens, targets):
         import jax
@@ -223,6 +300,31 @@ class TransformerParallel:
             return {k: jax.device_put(
                         np.asarray(z[k], dtype=self.dtype), shardings[k])
                     for k in shardings}
+
+
+def _prefill_attention(q, k, v):
+    """Causal attention for the generation prefill: the Pallas flash
+    kernel on TPU (T permitting), else a dense reference with the fp32
+    softmax discipline of ``paged_decode_attention`` — scores, softmax
+    and the PV contraction all accumulate in fp32 regardless of the
+    storage dtype, so prefill rows and decode steps agree token-exactly
+    (bf16 included: the cached K/V are bit-identical to a recompute, and
+    the fp32 attention arithmetic matches on both sides)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, d = q.shape[2], q.shape[3]
+    if jax.default_backend() == "tpu" and T >= 128:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    scale = float(1.0 / np.sqrt(d))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)
 
 
 def _local_attention(q, k, v, mesh=None):

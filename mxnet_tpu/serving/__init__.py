@@ -1,9 +1,21 @@
 """TPU-native inference serving: shape-bucketed compiled-program cache,
-dynamic micro-batching, pipelined dispatch (docs/serving.md)."""
+dynamic micro-batching, pipelined dispatch (docs/serving.md); the
+:mod:`.generation` subpackage adds autoregressive decode — paged KV
+cache + continuous batching (docs/generation.md)."""
 from .buckets import DEFAULT_BUCKETS, parse_buckets, pick_bucket
 from .engine import (InferenceServer, QueueFullError, ServerClosedError,
                      ServingConfig)
 
 __all__ = ["InferenceServer", "ServingConfig", "QueueFullError",
            "ServerClosedError", "parse_buckets", "pick_bucket",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "generation"]
+
+
+def __getattr__(name):
+    # the generation subsystem pulls in the transformer stack; load it
+    # on first use so plain inference serving stays light
+    if name == "generation":
+        import importlib
+
+        return importlib.import_module(__name__ + ".generation")
+    raise AttributeError(name)

@@ -1,0 +1,764 @@
+"""Continuous-batching autoregressive generation engine.
+
+The serving engine (engine.py one level up) batches *stateless* forward
+passes; this module is the stateful analog for autoregressive decode —
+the "millions of users" LLM workload (ROADMAP item 1). Three moving
+parts, all riding the same compile-count discipline as serving:
+
+* **Prefill/decode split.** A new request's prompt is padded up a
+  token-length bucket ladder (the :mod:`..buckets` machinery, applied to
+  sequence length instead of batch rows) and runs ONE full causal
+  forward — the Pallas flash kernel on TPU — that returns the prompt's
+  K/V, scattered straight into the paged cache, plus the first sampled
+  token. Compile count: ``len(prefill_buckets)``.
+* **Single-program decode.** The decode step is ONE compiled program
+  regardless of batch composition: a fixed ``max_batch`` slot layout,
+  an active-slot mask, per-slot traced sampling knobs, and
+  gather/scatter against the page pool
+  (:func:`~...parallel.flash_attention.paged_decode_attention`). Mixed
+  prompt lengths, mid-flight joins, evictions — none of it retraces.
+  Compile count: 1.
+* **Iteration-level scheduling.** Between decode steps the scheduler
+  evicts finished sequences (EOS / max-tokens), frees their pages, and
+  admits queued requests into the vacated slots — continuous batching,
+  so a long sequence never convoys short ones. Admission is bounded
+  (``MXNET_GEN_QUEUE`` requests) with block/reject backpressure, and
+  page-pool admission control reserves worst-case pages up front so a
+  mid-flight cache extension can never deadlock. Results stream through
+  per-request handles (a future for the full output + a token iterator).
+
+Weights come straight from training: any
+:class:`~...parallel.transformer.TransformerParallel` checkpoint decodes
+here through the shared layer math (``decode_forward`` /
+``prefill_forward``).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ...config import get_flag
+from ..buckets import pick_bucket
+from ..engine import QueueFullError, ServerClosedError
+from .kv_cache import PagePool
+from .sampling import SamplingParams, sample_tokens
+
+__all__ = ["GenerationConfig", "Generator", "GenerationHandle",
+           "SamplingParams", "QueueFullError", "ServerClosedError"]
+
+# the generation.page_size / generation.decode_blocks knobs this engine
+# consults (explicit config arg > tuning cache > MXNET_GEN_* flag) are
+# declared in autotune/__init__ — like graph.layout, this module loads
+# lazily, and registry.get must work in a process that never imported it
+
+
+def default_prefill_ladder(max_seq):
+    """Power-of-two prompt-length buckets up to ``max_seq`` (always
+    topped by ``max_seq`` itself so any admissible prompt fits)."""
+    ladder, b = [], 16
+    while b < max_seq:
+        ladder.append(b)
+        b <<= 1
+    ladder.append(int(max_seq))
+    return tuple(sorted(set(ladder)))
+
+
+def generation_tune_key(model, max_batch, max_seq):
+    """The ``generation.*`` tuning-cache key for one (checkpoint shape,
+    slot geometry) — shared by :class:`Generator`'s consult and
+    ``autotune.tune_generation``'s record so they can never drift."""
+    c = model.cfg
+    sig = "lm-L%d-d%d-H%d-ff%d-e%d-V%d-%s" % (
+        c["n_layers"], c["d_model"], c["n_heads"], c["d_ff"],
+        c["n_experts"], c["vocab"], np.dtype(model.dtype).name)
+    return (sig, "B%d-T%d" % (int(max_batch), int(max_seq)))
+
+
+class GenerationConfig:
+    """Knobs for :class:`Generator`. Defaults come from the
+    ``MXNET_GEN_*`` environment (docs/generation.md has the tuning
+    table); ``page_size``/``decode_blocks`` left unset resolve through
+    the autotuner cache first (docs/autotune.md)."""
+
+    def __init__(self, page_size=None, decode_blocks=None, max_batch=None,
+                 max_seq=None, pool_pages=None, prefill_buckets=None,
+                 max_queue=None, backpressure=None):
+        import os
+
+        # None = resolve in Generator: explicit > tuning cache > flag
+        self.page_size = None if page_size is None else int(page_size)
+        self.decode_blocks = (None if decode_blocks is None
+                              else int(decode_blocks))
+        self.max_batch = (get_flag("MXNET_GEN_MAX_BATCH")
+                          if max_batch is None else int(max_batch))
+        self.max_seq = (get_flag("MXNET_GEN_MAX_SEQ")
+                        if max_seq is None else int(max_seq))
+        self.pool_pages = (get_flag("MXNET_GEN_POOL_PAGES")
+                           if pool_pages is None else int(pool_pages))
+        if prefill_buckets is None:
+            spec = os.environ.get("MXNET_GEN_PREFILL_BUCKETS", "").strip()
+            prefill_buckets = ([int(t) for t in
+                                spec.replace(",", " ").split()]
+                               if spec else default_prefill_ladder(
+                                   self.max_seq))
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in prefill_buckets)))
+        self.max_queue = (get_flag("MXNET_GEN_QUEUE")
+                          if max_queue is None else int(max_queue))
+        self.backpressure = (backpressure if backpressure is not None
+                             else os.environ.get("MXNET_GEN_BACKPRESSURE",
+                                                 "block"))
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_seq < 2:
+            raise ValueError("max_seq must be >= 2")
+        if self.backpressure not in ("block", "reject"):
+            raise ValueError("backpressure must be 'block' or 'reject', "
+                             "got %r" % (self.backpressure,))
+        if not self.prefill_buckets or self.prefill_buckets[0] < 1:
+            raise ValueError("prefill_buckets must be positive ints")
+        if self.prefill_buckets[-1] > self.max_seq:
+            raise ValueError(
+                "largest prefill bucket %d exceeds max_seq %d"
+                % (self.prefill_buckets[-1], self.max_seq))
+
+
+class GenerationHandle:
+    """One request's result surface: ``result()`` blocks for the full
+    generated-token list; ``stream()`` yields tokens as the scheduler
+    produces them (iteration-level granularity)."""
+
+    def __init__(self):
+        import concurrent.futures
+
+        self.future = concurrent.futures.Future()
+        self._tokens = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False          # guarded-by: self._cond
+
+    # scheduler-side -----------------------------------------------------
+    def _push(self, token):
+        with self._cond:
+            self._tokens.append(token)
+            self._cond.notify_all()
+
+    def _finish(self, tokens):
+        try:
+            self.future.set_result(list(tokens))
+        except Exception:
+            pass  # future cancelled by the caller: same terminal state
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _fail(self, err):
+        try:
+            if not self.future.done():
+                self.future.set_exception(err)
+        except Exception:
+            pass
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # caller-side --------------------------------------------------------
+    def result(self, timeout=None):
+        """The full generated token list (excludes the prompt)."""
+        return self.future.result(timeout)
+
+    def done(self):
+        return self.future.done()
+
+    def stream(self, timeout=None):
+        """Yield generated tokens as they arrive; raises the request's
+        error (if any) once the stream drains."""
+        while True:
+            with self._cond:
+                while not self._tokens and not self._closed:
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError("no token within %ss" % timeout)
+                if self._tokens:
+                    tok = self._tokens.popleft()
+                else:
+                    break
+            yield tok
+        err = self.future.exception() if self.future.done() else None
+        if err is not None:
+            raise err
+
+
+class _Seq:
+    """Scheduler-side state of one admitted sequence (slot-resident)."""
+
+    __slots__ = ("handle", "prompt_len", "params", "tokens", "worst",
+                 "t_submit", "t_first")
+
+    def __init__(self, handle, prompt_len, params, worst, t_submit):
+        self.handle = handle
+        self.prompt_len = prompt_len
+        self.params = params          # SamplingParams
+        self.worst = worst            # worst-case cached tokens (pages)
+        self.tokens = []              # generated so far
+        self.t_submit = t_submit
+        self.t_first = None
+
+
+_Pending = collections.namedtuple(
+    "_Pending", ["prompt", "params", "handle", "t_submit"])
+
+# every live generator, GC-pruned — ONE "generation" flight-recorder
+# provider walks them (same discipline as serving._live_servers)
+_live_generators = weakref.WeakSet()
+
+
+def _generators_state():
+    views = []
+    for gen in list(_live_generators):
+        try:
+            views.append(gen.get_stats())
+        except Exception as err:
+            views.append({"error": repr(err)})
+    if not views:
+        return None
+    return views[0] if len(views) == 1 else {"generators": views}
+
+
+class Generator:
+    """Continuous-batching autoregressive generator for one checkpoint.
+
+    ::
+
+        model = TransformerParallel(mesh, vocab=..., ...)
+        params = model.load_checkpoint("ckpt")     # or model.init(seed)
+        gen = generation.Generator(model, params)
+        h = gen.submit([1, 2, 3], SamplingParams(max_new_tokens=16))
+        for tok in h.stream():
+            ...                                    # or h.result()
+        gen.stop()                                 # drains by default
+
+    ``model`` is a :class:`~...parallel.transformer.TransformerParallel`
+    (its layer math is shared between training, prefill and decode, so
+    any training checkpoint serves unchanged); ``params`` its parameter
+    dict. Unset ``page_size``/``decode_blocks`` resolve through the
+    autotuner (``generation.*`` tuning-cache entries recorded by
+    ``autotune.tune_generation``), then the ``MXNET_GEN_*`` flags.
+    """
+
+    def __init__(self, model, params, config=None, start=True):
+        import jax
+
+        self._model = model
+        self._params = params
+        cfg = config if config is not None else GenerationConfig()
+        self._cfg = cfg
+        c = model.cfg
+        self._tune_key = generation_tune_key(model, cfg.max_batch,
+                                             cfg.max_seq)
+        self.page_size = self._resolve("generation.page_size", "page_size",
+                                       cfg.page_size, "MXNET_GEN_PAGE_SIZE")
+        self.decode_blocks = self._resolve(
+            "generation.decode_blocks", "decode_blocks", cfg.decode_blocks,
+            "MXNET_GEN_DECODE_BLOCKS")
+
+        S = cfg.max_batch
+        self._max_pages = -(-cfg.max_seq // self.page_size)
+        pool_pages = cfg.pool_pages or (S * self._max_pages + 1)
+        self.pool = PagePool(pool_pages, self.page_size)
+
+        L, H = c["n_layers"], c["n_heads"]
+        hd = c["d_model"] // H
+        dt = np.dtype(model.dtype)
+        # committed to the model's device: an UNcommitted fresh pool
+        # would carry a different sharding signature than the compiled
+        # programs' outputs and cost one spurious recompile per bucket
+        self._pool_shape = (L, pool_pages, self.page_size, H, hd)
+        self._pool_dtype = dt
+        self._device = list(model.mesh.devices.flat)[0]
+        self._pages_k = self._fresh_pool()  # guarded-by: self._pages_lock
+        self._pages_v = self._fresh_pool()  # guarded-by: self._pages_lock
+
+        # slot state: scheduler-thread-only numpy mirrors of the decode
+        # program's inputs (no lock — only _loop touches them)
+        self._page_table = np.zeros((S, self._max_pages), np.int32)
+        self._seq_len = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+        self._last_token = np.zeros(S, np.int32)
+        self._temp = np.zeros(S, np.float32)
+        self._top_k = np.zeros(S, np.int32)
+        self._keys = np.zeros((S, 2), np.uint32)
+        self._slots = [None] * S      # _Seq per occupied slot
+
+        self._cond = threading.Condition()
+        self._queue = collections.deque()   # guarded-by: self._cond
+        self._stop = False                  # guarded-by: self._cond
+        self._abort = False                 # guarded-by: self._cond
+        self._n_active = 0                  # guarded-by: self._cond
+
+        self._lock = threading.Lock()
+        self._stats = collections.Counter()  # guarded-by: self._lock
+        # serializes page-pool rebinds: the scheduler thread owns them in
+        # steady state, but warmup() runs on the caller's thread
+        self._pages_lock = threading.Lock()
+
+        # donation lets XLA update the page pools in place; CPU has no
+        # donation support, so skip it there (avoids a per-compile warn)
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        self._donating = bool(donate)
+        self._decode_jit = jax.jit(self._decode_step, donate_argnums=donate)
+        self._prefill_jit = jax.jit(self._prefill_step,
+                                    donate_argnums=donate)
+
+        self._thread = None
+        self._life = threading.Lock()  # serializes start()/stop()
+        _live_generators.add(self)
+        from ...observability import flight_recorder
+
+        flight_recorder.register_provider("generation", _generators_state)
+        if start:
+            self.start()
+
+    def _fresh_pool(self):
+        import jax
+
+        return jax.device_put(
+            np.zeros(self._pool_shape, self._pool_dtype), self._device)
+
+    def _recover_pools(self, err):
+        """After a FAILED donated prefill/decode call the old pool
+        buffers may already be consumed — every later call would then
+        die on a donated-buffer error, failing 100% of traffic while
+        the generator looks alive. Re-materialize empty pools and evict
+        every active sequence (their cached K/V went down with the old
+        buffers). No-op when donation is off (CPU): the old pools are
+        still valid there and unaffected sequences keep their cache."""
+        if not self._donating:
+            return
+        for slot, seq in enumerate(self._slots):
+            if seq is not None:
+                self._evict(slot, failed=err)
+        with self._pages_lock:
+            self._pages_k = self._fresh_pool()
+            self._pages_v = self._fresh_pool()
+
+    def _resolve(self, op, field, explicit, flag):
+        """Knob resolution: explicit config arg > tuning cache > flag."""
+        if explicit is not None:
+            return int(explicit)
+        from ... import autotune
+
+        tuned = autotune.lookup(op, key=self._tune_key)
+        if isinstance(tuned, dict):
+            try:
+                val = int(tuned.get(field))
+                if val > 0:
+                    return val
+            except (TypeError, ValueError):
+                pass  # corrupt cache entry: tuning is an optimization
+        return int(get_flag(flag))
+
+    @classmethod
+    def from_checkpoint(cls, path, model, **kwargs):
+        """Generator over a :meth:`TransformerParallel.save_checkpoint`
+        file — the training-to-serving handoff."""
+        return cls(model, model.load_checkpoint(path), **kwargs)
+
+    # -------------------------------------------------- compiled programs
+    def _prefill_step(self, params, pages_k, pages_v, tokens, length,
+                      page_row, key, temp, top_k):
+        """ONE compiled program per prompt bucket: full causal forward,
+        prompt K/V scattered into the paged cache, first token sampled.
+        ``tokens``: (1, bucket) int32; ``page_row``: (max_pages,) int32
+        (0-padded — unallocated positions scatter to the trash page)."""
+        import jax.numpy as jnp
+
+        bucket = tokens.shape[1]
+        logits, ks, vs = self._model.prefill_forward(params, tokens)
+        pos = jnp.arange(bucket, dtype=jnp.int32)
+        dest = page_row[pos // self.page_size]
+        off = pos % self.page_size
+        pages_k = pages_k.at[:, dest, off].set(ks[:, 0])
+        pages_v = pages_v.at[:, dest, off].set(vs[:, 0])
+        last = logits[0, length - 1]
+        tok, new_key = sample_tokens(last[None], key[None], temp[None],
+                                     top_k[None])
+        return pages_k, pages_v, tok[0], new_key[0]
+
+    def _decode_step(self, params, pages_k, pages_v, page_table, seq_len,
+                     active, last_token, temp, top_k, keys):
+        """THE decode program: one step for every slot, active or not.
+        Fixed shapes throughout — batch composition, sequence lengths
+        and sampling mixes are all data, never compile keys."""
+        import jax.numpy as jnp
+
+        from ...parallel.flash_attention import paged_decode_attention
+
+        S = self._cfg.max_batch
+        page = self.page_size
+        rows = jnp.arange(S)
+        pidx = seq_len // page
+        off = seq_len % page
+        # inactive slots scatter to the trash page 0; active slots own
+        # disjoint pages, so the writes never collide
+        dest = jnp.where(active, page_table[rows, pidx], 0)
+        state = {"k": pages_k, "v": pages_v}
+
+        def attend(li, q, k_new, v_new):
+            state["k"] = state["k"].at[li, dest, off].set(k_new)
+            state["v"] = state["v"].at[li, dest, off].set(v_new)
+            return paged_decode_attention(
+                q, state["k"][li], state["v"][li], page_table, seq_len + 1,
+                block_tokens=self.decode_blocks)
+
+        logits = self._model.decode_forward(params, last_token, attend)
+        toks, new_keys = sample_tokens(logits, keys, temp, top_k)
+        toks = jnp.where(active, toks, -1)
+        new_keys = jnp.where(active[:, None], new_keys, keys)
+        return state["k"], state["v"], toks, new_keys
+
+    def warmup(self):
+        """Compile every prefill bucket plus the decode program against
+        the trash page, so the first request never pays a compile.
+        Returns the number of programs warmed.
+
+        Safe to call even while traffic flows: warmup drives the
+        programs with SYNTHETIC all-inactive state (zeros — identical
+        shapes and dtypes to the live mirrors, writes land only on the
+        trash page) rather than reading the scheduler thread's slot
+        mirrors, and the page-pool rebinds serialize on the same lock
+        the scheduler holds during its calls."""
+        import jax
+
+        # PRNGKey construction is itself a (tiny) jitted program; build
+        # one now so admission never pays its compile
+        np.asarray(jax.random.PRNGKey(0))
+        S = self._cfg.max_batch
+        n = 0
+        with self._pages_lock:
+            for bucket in self._cfg.prefill_buckets:
+                pk, pv, tok, _ = self._prefill_jit(
+                    self._params, self._pages_k, self._pages_v,
+                    np.zeros((1, bucket), np.int32), np.int32(1),
+                    np.zeros(self._max_pages, np.int32),
+                    np.zeros(2, np.uint32), np.float32(0), np.int32(0))
+                jax.block_until_ready(tok)
+                self._pages_k, self._pages_v = pk, pv
+                n += 1
+            pk, pv, toks, _ = self._decode_jit(
+                self._params, self._pages_k, self._pages_v,
+                np.zeros((S, self._max_pages), np.int32),
+                np.zeros(S, np.int32), np.zeros(S, bool),
+                np.zeros(S, np.int32), np.zeros(S, np.float32),
+                np.zeros(S, np.int32), np.zeros((S, 2), np.uint32))
+            jax.block_until_ready(toks)
+            self._pages_k, self._pages_v = pk, pv
+        return n + 1
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        """Launch the scheduler thread (idempotent)."""
+        with self._life:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            with self._cond:
+                self._stop = False
+                self._abort = False
+            self._thread = threading.Thread(
+                target=self._loop, name="mxnet-generation-scheduler",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Shut down. ``drain=True`` (default) finishes every admitted
+        and queued request first; ``drain=False`` fails queued AND
+        in-flight requests with :class:`ServerClosedError`."""
+        with self._cond:
+            self._stop = True
+            self._abort = not drain
+            self._cond.notify_all()
+        with self._life:
+            thread, self._thread = self._thread, None
+            if thread is not None:
+                thread.join()
+            elif self._queue or self._n_active:
+                self._loop()  # never started: honor the drain contract
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    # -------------------------------------------------------------- submit
+    def submit(self, prompt, params=None):
+        """Enqueue one generation request; returns a
+        :class:`GenerationHandle`. ``prompt``: iterable of int token
+        ids; ``params``: :class:`SamplingParams` (default: greedy, 32
+        new tokens)."""
+        from ...observability import metrics
+
+        params = params if params is not None else SamplingParams()
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        top = self._cfg.prefill_buckets[-1]
+        if len(prompt) > top:
+            raise ValueError(
+                "prompt of %d tokens exceeds the largest prefill bucket "
+                "%d (raise MXNET_GEN_PREFILL_BUCKETS / max_seq)"
+                % (len(prompt), top))
+        if len(prompt) + params.max_new_tokens > self._cfg.max_seq:
+            raise ValueError(
+                "prompt %d + max_new_tokens %d exceeds max_seq %d"
+                % (len(prompt), params.max_new_tokens, self._cfg.max_seq))
+        worst = len(prompt) + params.max_new_tokens - 1
+        if self.pool.pages_for(worst) > self.pool.capacity:
+            raise ValueError(
+                "request needs %d KV pages but the pool only holds %d "
+                "(raise MXNET_GEN_POOL_PAGES)"
+                % (self.pool.pages_for(worst), self.pool.capacity))
+        handle = GenerationHandle()
+        ent = _Pending(prompt, params, handle, time.monotonic())
+        with self._cond:
+            if self._stop:
+                raise ServerClosedError("submit() after stop()")
+            if self._cfg.backpressure == "reject":
+                if len(self._queue) >= self._cfg.max_queue:
+                    with self._lock:
+                        self._stats["rejected"] += 1
+                    metrics.counter("generation.rejected").inc()
+                    raise QueueFullError(
+                        "admission queue full (%d requests); raise "
+                        "MXNET_GEN_QUEUE or use backpressure='block'"
+                        % len(self._queue))
+            else:
+                while len(self._queue) >= self._cfg.max_queue:
+                    self._cond.wait()
+                    if self._stop:
+                        raise ServerClosedError(
+                            "server stopped while submit() was blocked")
+            self._queue.append(ent)
+            self._cond.notify_all()
+        with self._lock:
+            self._stats["requests"] += 1
+        metrics.counter("generation.requests").inc()
+        return handle
+
+    def generate(self, prompt, params=None, timeout=None):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(prompt, params).result(timeout)
+
+    # ----------------------------------------------------------- scheduler
+    def _loop(self):
+        while True:
+            aborted = None
+            with self._cond:
+                while (not self._queue and not self._n_active
+                       and not self._stop):
+                    self._cond.wait()
+                if self._stop:
+                    if self._abort:
+                        aborted = list(self._queue)
+                        self._queue.clear()
+                        self._cond.notify_all()
+                    elif not self._queue and not self._n_active:
+                        return
+            if aborted is not None:
+                self._fail_all(aborted)
+                return
+            self._admit_pending()
+            if self._n_active:
+                try:
+                    self._decode_once()
+                except Exception as err:  # fail the batch, not the thread
+                    for slot, seq in enumerate(self._slots):
+                        if seq is not None:
+                            self._evict(slot, failed=err)
+                    self._recover_pools(err)
+
+    def _fail_all(self, pending):
+        err = ServerClosedError("generator stopped without draining")
+        for ent in pending:
+            ent.handle._fail(err)
+        for slot, seq in enumerate(self._slots):
+            if seq is not None:
+                self._evict(slot, failed=err)
+
+    def _free_slot(self):
+        for s, seq in enumerate(self._slots):
+            if seq is None:
+                return s
+        return None
+
+    def _admit_pending(self):
+        """Admit queued requests into free slots — between decode steps,
+        which is what makes the batching *continuous*."""
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            with self._cond:
+                if not self._queue:
+                    return
+                ent = self._queue[0]
+                worst = len(ent.prompt) + ent.params.max_new_tokens - 1
+                if not self.pool.can_admit(worst):
+                    return  # pages tight: decode on, eviction frees some
+                self._queue.popleft()
+                self._n_active += 1
+                self._cond.notify_all()  # wake blocked submitters
+            try:
+                self._prefill(slot, ent, worst)
+            except Exception as err:  # fail THIS request, not the thread
+                self._reset_slot(slot, worst)
+                with self._cond:
+                    self._n_active -= 1
+                    self._cond.notify_all()
+                ent.handle._fail(err)
+                # under donation the failed call may have consumed the
+                # pool buffers other sequences' caches live in
+                self._recover_pools(err)
+
+    def _prefill(self, slot, ent, worst):
+        import jax
+
+        from ...observability import metrics
+
+        plen = len(ent.prompt)
+        sp = ent.params
+        bucket = pick_bucket(plen, self._cfg.prefill_buckets)
+        pages = self.pool.admit(slot, plen, worst)
+        row = np.zeros(self._max_pages, np.int32)
+        row[:len(pages)] = pages
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = ent.prompt
+        key = np.asarray(jax.random.PRNGKey(sp.seed), np.uint32)
+        with self._pages_lock:
+            pk, pv, tok, nkey = self._prefill_jit(
+                self._params, self._pages_k, self._pages_v, tokens,
+                np.int32(plen), row, key, np.float32(sp.temperature),
+                np.int32(sp.top_k))
+            self._pages_k, self._pages_v = pk, pv
+        # the ONE host sync of admission: the prompt's first token (this
+        # is also the time-to-first-token mark)
+        first = int(np.asarray(tok))  # graftlint: disable=G001 — admission-boundary fetch, not a hot-loop sync
+        seq = _Seq(ent.handle, plen, sp, worst, ent.t_submit)
+        seq.t_first = time.monotonic()
+        self._slots[slot] = seq
+        self._page_table[slot, :] = row
+        self._seq_len[slot] = plen
+        self._active[slot] = True
+        self._last_token[slot] = first
+        self._temp[slot] = sp.temperature
+        self._top_k[slot] = sp.top_k
+        self._keys[slot] = np.array(nkey, np.uint32)  # copy: jax views are read-only
+        with self._lock:
+            self._stats["prefills"] += 1
+            self._stats["tokens"] += 1
+        metrics.counter("generation.prefill_batches").inc()
+        metrics.counter("generation.tokens_generated").inc()
+        self._emit(slot, first)
+
+    def _emit(self, slot, token):
+        """Stream one token; evict on EOS / max-tokens."""
+        seq = self._slots[slot]
+        seq.tokens.append(token)
+        seq.handle._push(token)
+        if (token == seq.params.eos_id
+                or len(seq.tokens) >= seq.params.max_new_tokens):
+            self._evict(slot)
+
+    def _reset_slot(self, slot, worst):
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._seq_len[slot] = 0
+        self._last_token[slot] = 0
+        self._temp[slot] = 0.0
+        self._top_k[slot] = 0
+        self._page_table[slot, :] = 0
+        self.pool.release(slot, worst)
+
+    def _evict(self, slot, failed=None):
+        from ...observability import metrics
+
+        seq = self._slots[slot]
+        self._reset_slot(slot, seq.worst)
+        with self._cond:
+            self._n_active -= 1
+            self._cond.notify_all()
+        if failed is not None:
+            seq.handle._fail(failed)
+        else:
+            seq.handle._finish(seq.tokens)
+        with self._lock:
+            self._stats["evicted"] += 1
+        metrics.counter("generation.sequences_evicted").inc()
+
+    def _decode_once(self):
+        """One iteration of the continuous-batching loop: extend pages
+        where a sequence crosses a page boundary, run THE decode
+        program, stream the sampled tokens, evict the finished."""
+        from ...observability import metrics
+
+        t0 = time.monotonic()
+        for slot, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            need = int(self._seq_len[slot]) // self.page_size
+            owned = self.pool.pages_of(slot)
+            if need >= len(owned):  # extend-on-decode
+                self._page_table[slot, need] = self.pool.extend(slot)
+        with self._pages_lock:
+            pk, pv, toks, nkeys = self._decode_jit(
+                self._params, self._pages_k, self._pages_v,
+                self._page_table, self._seq_len, self._active,
+                self._last_token, self._temp, self._top_k, self._keys)
+            self._pages_k, self._pages_v = pk, pv
+        n_active = int(self._active.sum())
+        # the decode loop's one bounded host fetch per step (everything
+        # else above is dispatch): S int32 tokens + S keys
+        sampled = np.asarray(toks)  # graftlint: disable=G001 — per-step token fetch IS the product of the decode loop
+        self._keys = np.array(nkeys, np.uint32)  # copy: jax views are read-only
+        for slot, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            self._seq_len[slot] += 1
+            tok = int(sampled[slot])
+            self._last_token[slot] = tok
+            self._emit(slot, tok)
+        with self._lock:
+            self._stats["decode_steps"] += 1
+            self._stats["tokens"] += n_active
+        metrics.counter("generation.tokens_generated").inc(n_active)
+        metrics.gauge("generation.decode_batch_occupancy").set(
+            100.0 * n_active / self._cfg.max_batch)
+        metrics.histogram("generation.decode_step_ms").observe(
+            (time.monotonic() - t0) * 1e3)
+
+    # --------------------------------------------------------------- stats
+    def get_stats(self):
+        """JSON-safe operational snapshot (also the flight-recorder
+        provider section for crash dumps)."""
+        with self._cond:
+            queued = len(self._queue)
+            n_active = self._n_active
+            stopped = self._stop
+        with self._lock:
+            stats = dict(self._stats)
+        stats.update(
+            queued=queued, active=n_active,
+            max_batch=self._cfg.max_batch, max_seq=self._cfg.max_seq,
+            page_size=self.page_size, decode_blocks=self.decode_blocks,
+            prefill_buckets=list(self._cfg.prefill_buckets),
+            pool=self.pool.get_stats(),
+            running=self.running, stopped=stopped)
+        return stats

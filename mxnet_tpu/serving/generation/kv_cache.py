@@ -1,0 +1,155 @@
+"""Paged KV cache: the generation subsystem's storage manager.
+
+The KV cache is the first-class, storage-managed object end-to-end LLM
+serving hinges on (nncase, PAPERS.md) — NOT an activation that lives and
+dies with one forward pass. This module owns the two halves of that
+treatment:
+
+* **Device half** — one fixed-shape page pool per K and V:
+  ``(n_layers, pool_pages, page_size, n_heads, head_dim)`` arrays that
+  every prefill/decode program threads through functionally (donated, so
+  XLA updates them in place). Page 0 is the *trash page*: inactive slots
+  and padded prefill rows scatter there, which keeps every program free
+  of data-dependent shapes — the compile-count discipline of the whole
+  subsystem.
+* **Host half** — the allocator: a free list of page ids plus per-slot
+  page tables. Pages are **allocated on prefill** (just enough for the
+  prompt), **extended on decode** (one page whenever a sequence crosses
+  a page boundary), and **freed on eviction** (EOS / max-tokens /
+  abort). Admission control reserves worst-case pages up front so a
+  mid-flight extension can never fail (no deadlock between growing
+  sequences fighting for the last page).
+
+Occupancy is exposed as the ``generation.kv_pages_used`` metrics gauge
+(refreshed on every alloc/free) and through the generation
+flight-recorder provider (engine.py), so a crash dump shows exactly who
+held which pages.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["PagePool"]
+
+
+class PagePool:
+    """Host-side page allocator over a device page pool.
+
+    ``pool_pages`` counts the whole device pool including the reserved
+    trash page 0, so ``capacity = pool_pages - 1`` pages are allocatable.
+    All methods are thread-safe; the scheduler thread allocates/frees
+    while ``get_stats`` (metrics, flight recorder, tests) reads.
+    """
+
+    def __init__(self, pool_pages, page_size):
+        if pool_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the trash page), "
+                             "got %d" % pool_pages)
+        self.page_size = int(page_size)
+        self.pool_pages = int(pool_pages)
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed pages are re-used first (their
+        # device tiles are warm in whatever cache hierarchy applies)
+        self._free = list(range(self.pool_pages - 1, 0, -1))  # guarded-by: self._lock
+        self._owned = {}   # slot -> [page ids] in position order  # guarded-by: self._lock
+        self._reserved = 0  # worst-case pages promised to live slots  # guarded-by: self._lock
+        self._peak = 0      # high-water of pages in use  # guarded-by: self._lock
+
+    # ------------------------------------------------------------- queries
+    @property
+    def capacity(self):
+        return self.pool_pages - 1
+
+    def pages_used(self):
+        with self._lock:
+            return self.capacity - len(self._free)
+
+    def pages_for(self, n_tokens):
+        """Pages needed to hold ``n_tokens`` cache positions."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def can_admit(self, worst_case_tokens):
+        """Would a sequence that may grow to ``worst_case_tokens`` ever
+        be starved? Admission gate: free pages minus what live slots may
+        still claim must cover this sequence's worst case."""
+        need = self.pages_for(worst_case_tokens)
+        with self._lock:
+            return len(self._free) - self._reserved >= need
+
+    # ---------------------------------------------------------- allocation
+    def admit(self, slot, prompt_tokens, worst_case_tokens):
+        """Allocate-on-prefill: pages for the prompt now, a reservation
+        for the rest. Returns the slot's page-id list (position order).
+        Raises MemoryError when the admission gate would be violated —
+        callers check :meth:`can_admit` first, so this is a bug trap."""
+        n_now = self.pages_for(prompt_tokens)
+        worst = self.pages_for(worst_case_tokens)
+        with self._lock:
+            if slot in self._owned:
+                raise ValueError("slot %d already owns pages" % slot)
+            if len(self._free) - self._reserved < worst:
+                raise MemoryError(
+                    "page pool overcommitted: %d free, %d reserved, "
+                    "%d needed" % (len(self._free), self._reserved, worst))
+            pages = [self._free.pop() for _ in range(n_now)]
+            self._owned[slot] = pages
+            self._reserved += worst - n_now
+            self._peak = max(self._peak, self.capacity - len(self._free))
+        self._gauge()
+        return list(pages)
+
+    def extend(self, slot):
+        """Extend-on-decode: one more page for ``slot`` (its sequence
+        crossed a page boundary). The admission reservation guarantees a
+        free page exists. Returns the new page id."""
+        with self._lock:
+            if slot not in self._owned:
+                raise ValueError("slot %d owns no pages" % slot)
+            if not self._free:
+                raise MemoryError("page pool exhausted despite admission "
+                                  "reservations (accounting bug)")
+            page = self._free.pop()
+            self._owned[slot].append(page)
+            self._reserved = max(0, self._reserved - 1)
+            self._peak = max(self._peak, self.capacity - len(self._free))
+        self._gauge()
+        return page
+
+    def release(self, slot, worst_case_tokens=0):
+        """Free-on-eviction: return all of ``slot``'s pages to the free
+        list and drop whatever admission reservation it never claimed
+        (``worst_case_tokens``: the same bound passed to :meth:`admit`).
+        Returns the number of pages freed."""
+        with self._lock:
+            pages = self._owned.pop(slot, None)
+            if pages is None:
+                # a slot that never completed admit() holds neither
+                # pages nor a reservation — dropping one here would
+                # steal another slot's
+                return 0
+            self._free.extend(reversed(pages))
+            # the slot's live reservation is worst-case pages minus the
+            # pages it actually claimed (admit + extend both decrement)
+            unused = max(0, self.pages_for(worst_case_tokens) - len(pages))
+            self._reserved = max(0, self._reserved - unused)
+        self._gauge()
+        return len(pages)
+
+    def pages_of(self, slot):
+        with self._lock:
+            return list(self._owned.get(slot, ()))
+
+    def _gauge(self):
+        from ...observability import metrics
+
+        metrics.gauge("generation.kv_pages_used").set(self.pages_used())
+
+    def get_stats(self):
+        with self._lock:
+            return {"page_size": self.page_size,
+                    "capacity": self.capacity,
+                    "free": len(self._free),
+                    "used": self.capacity - len(self._free),
+                    "peak_used": self._peak,
+                    "reserved": self._reserved,
+                    "slots": {s: len(p) for s, p in self._owned.items()}}

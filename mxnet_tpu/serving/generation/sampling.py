@@ -1,0 +1,74 @@
+"""Seeded sampling for the decode step: greedy / temperature / top-k.
+
+Sampling runs INSIDE the compiled decode program, vectorized over slots,
+with every knob a traced per-slot value — temperature 0.3 next to greedy
+next to top-k 5 in one batch, no recompiles. Each request carries its
+own PRNG key (derived from its seed), advanced only on its own decode
+steps, so a request's token sequence is a pure function of (checkpoint,
+prompt, SamplingParams) — independent of batch composition, which is
+what makes continuous batching transparent (the mid-flight-join parity
+test in tests/test_generation.py pins this down).
+"""
+from __future__ import annotations
+
+__all__ = ["SamplingParams", "sample_tokens"]
+
+
+class SamplingParams:
+    """Per-request sampling recipe.
+
+    ``temperature`` 0 = greedy (argmax; ``seed``/``top_k`` ignored);
+    ``top_k`` 0 = no truncation; ``eos_id`` -1 = never stop on a token;
+    ``max_new_tokens`` always bounds generation.
+    """
+
+    __slots__ = ("temperature", "top_k", "seed", "eos_id",
+                 "max_new_tokens")
+
+    def __init__(self, max_new_tokens=32, temperature=0.0, top_k=0,
+                 seed=0, eos_id=-1):
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.eos_id = int(eos_id)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0 (0 = off)")
+
+
+def sample_tokens(logits, keys, temperature, top_k):
+    """Vectorized one-token sampling. ``logits``: (S, V) fp32; ``keys``:
+    (S, 2) uint32 PRNG keys; ``temperature``: (S,) fp32; ``top_k``:
+    (S,) int32. Returns ``(tokens (S,) int32, new_keys (S, 2))``.
+
+    Greedy slots (temperature == 0) take the argmax and do NOT consume
+    randomness; sampled slots split their key every step. All branches
+    are computed and selected with ``where`` — one program for any mix.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+
+    def one(logit, key, temp, k):
+        greedy = temp <= 0.0
+        safe_t = jnp.where(greedy, 1.0, temp)
+        scaled = logit / safe_t
+        # top-k truncation: keep scores >= the kth largest (k = 0 or
+        # k >= V keeps everything)
+        k_eff = jnp.clip(jnp.where(k <= 0, V, k), 1, V)
+        sorted_desc = -jnp.sort(-scaled)
+        kth = sorted_desc[k_eff - 1]
+        truncated = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        sub, nxt = jax.random.split(key)
+        drawn = jax.random.categorical(sub, truncated)
+        tok = jnp.where(greedy, jnp.argmax(logit), drawn)
+        new_key = jnp.where(greedy, key, nxt)
+        return tok.astype(jnp.int32), new_key
+
+    return jax.vmap(one)(logits, keys, temperature,
+                         top_k.astype(jnp.int32))

@@ -1,0 +1,487 @@
+"""Autoregressive generation subsystem (ISSUE 7): token-exact
+incremental-decode parity vs full recompute, paged KV cache accounting,
+continuous-batching invariants (mid-flight joins, flat compile count),
+seeded sampling determinism, backpressure, and shutdown semantics."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import autotune, observability as obs
+from mxnet_tpu.config import set_flag
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.parallel.flash_attention import paged_decode_attention
+from mxnet_tpu.parallel.transformer import TransformerParallel
+from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                          PagePool, QueueFullError,
+                                          SamplingParams,
+                                          ServerClosedError,
+                                          default_prefill_ladder)
+
+
+@pytest.fixture
+def telemetry():
+    obs.set_enabled(True)
+    obs.reset_metrics()
+    yield
+    obs.reset_metrics()
+    obs.set_enabled(False)
+
+
+def _model(dtype=np.float32, **cfg):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    kw = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+              n_experts=2, dtype=dtype)
+    kw.update(cfg)
+    model = TransformerParallel(mesh, **kw)
+    return model, model.init(seed=0)
+
+
+def _generator(model, params, start=True, **cfg_kwargs):
+    kw = dict(page_size=8, max_batch=4, max_seq=64,
+              prefill_buckets=(16, 32, 64))
+    kw.update(cfg_kwargs)
+    return Generator(model, params, GenerationConfig(**kw), start=start)
+
+
+def _recompute_tokens(model, params, prompt, n):
+    """Greedy full-recompute reference: re-run the whole causal forward
+    for every generated token (the oracle incremental decode must
+    reproduce token-exactly)."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits, _, _ = model.prefill_forward(
+            params, np.asarray([toks], np.int32))
+        nxt = int(np.argmax(np.asarray(logits)[0, len(toks) - 1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+# ------------------------------------------------- paged decode attention
+def test_paged_decode_attention_matches_dense():
+    rng = np.random.RandomState(0)
+    S, H, d, page, n_pages, pool = 3, 2, 8, 4, 4, 16
+    k_pages = jnp.asarray(rng.randn(pool, page, H, d), jnp.float32)
+    v_pages = jnp.asarray(rng.randn(pool, page, H, d), jnp.float32)
+    table = jnp.asarray(rng.choice(np.arange(1, pool), (S, n_pages),
+                                   replace=False).reshape(S, n_pages))
+    q = jnp.asarray(rng.randn(S, H, d), jnp.float32)
+    lengths = jnp.asarray([1, 7, 16], jnp.int32)
+
+    for blocks in (None, 4, 8, 16):
+        out = np.asarray(paged_decode_attention(
+            q, k_pages, v_pages, table, lengths, block_tokens=blocks))
+        for s in range(S):
+            L = int(lengths[s])
+            k = np.asarray(k_pages)[np.asarray(table)[s]].reshape(
+                n_pages * page, H, d)[:L]
+            v = np.asarray(v_pages)[np.asarray(table)[s]].reshape(
+                n_pages * page, H, d)[:L]
+            scores = np.einsum("hd,thd->ht", np.asarray(q)[s] / np.sqrt(d),
+                               k)
+            w = np.exp(scores - scores.max(-1, keepdims=True))
+            w /= w.sum(-1, keepdims=True)
+            ref = np.einsum("ht,thd->hd", w, v)
+            np.testing.assert_allclose(out[s], ref, atol=1e-5,
+                                       err_msg="blocks=%r slot %d"
+                                               % (blocks, s))
+
+
+def test_paged_decode_attention_zero_length_slot_is_finite():
+    k = jnp.zeros((4, 4, 2, 8), jnp.float32)
+    table = jnp.zeros((2, 2), jnp.int32)
+    out = np.asarray(paged_decode_attention(
+        jnp.ones((2, 2, 8), jnp.float32), k, k, table,
+        jnp.asarray([0, 3], jnp.int32)))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[0], 0.0)
+
+
+# --------------------------------------------------------------- parity
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+def test_incremental_decode_token_exact_parity(dtype):
+    model, params = _model(dtype=dtype)
+    gen = _generator(model, params)
+    try:
+        rng = np.random.RandomState(3)
+        for plen, n_new in ((1, 6), (5, 10), (17, 8)):
+            prompt = [int(t) for t in rng.randint(1, 64, size=plen)]
+            got = gen.generate(prompt,
+                               SamplingParams(max_new_tokens=n_new),
+                               timeout=300)
+            ref = _recompute_tokens(model, params, prompt, n_new)
+            assert got == ref, (prompt, got, ref)
+    finally:
+        gen.stop()
+
+
+# ------------------------------------------------------- page accounting
+def test_page_alloc_extend_free_accounting():
+    model, params = _model()
+    gen = _generator(model, params, page_size=8)
+    try:
+        # prompt of 10 -> 2 pages at prefill; 9 new tokens cache
+        # positions 10..18 -> extension to 3 pages mid-decode
+        h = gen.submit(list(range(1, 11)),
+                       SamplingParams(max_new_tokens=9))
+        h.result(timeout=300)
+        stats = gen.pool.get_stats()
+        assert stats["used"] == 0, stats          # freed on eviction
+        assert stats["peak_used"] == 3, stats     # 2 prefill + 1 extend
+        assert stats["reserved"] == 0, stats      # reservation drained
+    finally:
+        gen.stop()
+
+
+def test_pool_admission_reservation_and_errors():
+    pool = PagePool(8, 4)  # 7 allocatable
+    assert pool.pages_for(9) == 3
+    pool.admit(0, 8, 16)          # 2 now, 4 worst -> 2 reserved
+    assert pool.pages_used() == 2
+    assert pool.can_admit(12)     # 3 <= 5 free - 2 reserved
+    assert not pool.can_admit(16)
+    with pytest.raises(ValueError):
+        pool.admit(0, 4, 4)       # slot already owns pages
+    with pytest.raises(MemoryError):
+        pool.admit(1, 16, 16)     # 4 > 5 free - 2 reserved
+    pool.extend(0)                # claims one reserved page
+    assert pool.pages_used() == 3
+    assert pool.release(0, 16) == 3
+    assert pool.pages_used() == 0
+    assert pool.can_admit(28)     # everything free again
+    # releasing a slot that never completed admit() must not touch
+    # another slot's reservation
+    pool.admit(2, 4, 16)          # 1 now, 3 reserved
+    assert pool.release(3, 16) == 0
+    stats = pool.get_stats()
+    assert stats["reserved"] == 3, stats
+    pool.release(2, 16)
+    assert pool.get_stats()["reserved"] == 0
+
+
+def test_kv_pages_gauge_and_flight_recorder_provider(telemetry, tmp_path):
+    model, params = _model()
+    gen = _generator(model, params)
+    try:
+        gen.generate([1, 2, 3], SamplingParams(max_new_tokens=4),
+                     timeout=300)
+        assert M.get_value("generation.tokens_generated", 0) == 4
+        assert M.get_value("generation.sequences_evicted", 0) == 1
+        assert M.get_value("generation.prefill_batches", 0) == 1
+        assert M.get_value("generation.decode_step_ms", 0) == 3
+        assert M.get_value("generation.kv_pages_used", 0) == 0
+        dump = obs.flight_recorder.dump(
+            "test", path=str(tmp_path / "dump.json"))
+        with open(dump) as f:
+            payload = json.load(f)
+        section = payload["providers"]["generation"]
+        views = section.get("generators", [section])
+        assert any(v.get("evicted") == 1 and v.get("pool", {}).get(
+            "used") == 0 for v in views), views
+    finally:
+        gen.stop()
+
+
+# -------------------------------------------------- continuous batching
+def test_mid_flight_join_keeps_earlier_tokens_unchanged():
+    model, params = _model()
+    prompt_a = [7, 3, 11, 30]
+    prompt_b = [5] * 9
+    solo = _generator(model, params)
+    try:
+        ref_a = solo.generate(prompt_a, SamplingParams(max_new_tokens=16),
+                              timeout=300)
+        ref_b = solo.generate(prompt_b, SamplingParams(max_new_tokens=6),
+                              timeout=300)
+    finally:
+        solo.stop()
+
+    gen = _generator(model, params)
+    try:
+        ha = gen.submit(prompt_a, SamplingParams(max_new_tokens=16))
+        stream = ha.stream(timeout=120)
+        early = [next(stream) for _ in range(3)]  # A is mid-flight...
+        hb = gen.submit(prompt_b, SamplingParams(max_new_tokens=6))
+        got_a = early + list(stream)
+        assert got_a == ref_a                     # ...and B joining
+        assert hb.result(timeout=300) == ref_b    # didn't perturb A
+    finally:
+        gen.stop()
+
+
+def test_compile_count_flat_under_mixed_length_traffic(telemetry):
+    model, params = _model()
+    gen = _generator(model, params)
+    try:
+        warmed = gen.warmup()
+        assert warmed == len(gen._cfg.prefill_buckets) + 1
+        after_warmup = M.get_value("jit.compile_count", 0)
+        rng = np.random.RandomState(0)
+        handles = [
+            gen.submit([int(t) for t in rng.randint(1, 64, size=plen)],
+                       SamplingParams(max_new_tokens=n_new))
+            for plen, n_new in ((2, 9), (30, 3), (11, 7), (17, 12),
+                                (1, 1), (50, 5), (9, 2))]
+        for h in handles:
+            h.result(timeout=300)
+        assert M.get_value("jit.compile_count", 0) == after_warmup, \
+            "decode/prefill recompiled under mixed-length traffic"
+    finally:
+        gen.stop()
+
+
+# ------------------------------------------------------------- sampling
+def test_seeded_sampling_deterministic_and_seed_sensitive():
+    model, params = _model()
+    gen = _generator(model, params)
+    try:
+        prompt = [9, 4, 27]
+        sp = dict(max_new_tokens=12, temperature=0.9, top_k=8)
+        a = gen.generate(prompt, SamplingParams(seed=7, **sp), timeout=300)
+        b = gen.generate(prompt, SamplingParams(seed=7, **sp), timeout=300)
+        c = gen.generate(prompt, SamplingParams(seed=8, **sp), timeout=300)
+        assert a == b                 # same seed, same traffic-free tokens
+        assert a != c                 # different stream
+        # greedy ignores the seed entirely
+        g1 = gen.generate(prompt, SamplingParams(max_new_tokens=6, seed=1),
+                          timeout=300)
+        g2 = gen.generate(prompt, SamplingParams(max_new_tokens=6, seed=2),
+                          timeout=300)
+        assert g1 == g2
+    finally:
+        gen.stop()
+
+
+def test_sampling_determinism_independent_of_batch_composition():
+    model, params = _model()
+    prompt = [13, 2, 40]
+    sp = SamplingParams(max_new_tokens=8, temperature=0.7, top_k=5, seed=3)
+    solo = _generator(model, params)
+    try:
+        ref = solo.generate(prompt, sp, timeout=300)
+    finally:
+        solo.stop()
+    gen = _generator(model, params)
+    try:
+        noise = [gen.submit([1 + (i % 60)] * (1 + i * 3),
+                            SamplingParams(max_new_tokens=10))
+                 for i in range(3)]
+        got = gen.generate(prompt, sp, timeout=300)
+        for h in noise:
+            h.result(timeout=300)
+        assert got == ref
+    finally:
+        gen.stop()
+
+
+def test_eos_evicts_early():
+    model, params = _model()
+    gen = _generator(model, params)
+    try:
+        prompt = [3, 17, 5]
+        full = gen.generate(prompt, SamplingParams(max_new_tokens=8),
+                            timeout=300)
+        eos = full[3]
+        got = gen.generate(prompt, SamplingParams(max_new_tokens=8,
+                                                  eos_id=eos),
+                           timeout=300)
+        # stops AT the first occurrence of the eos token
+        assert got == full[:full.index(eos) + 1]
+        assert len(got) < len(full)
+    finally:
+        gen.stop()
+
+
+# ------------------------------------------------ validation/backpressure
+def test_submit_validation():
+    model, params = _model()
+    gen = _generator(model, params, max_seq=64,
+                     prefill_buckets=(16, 32))
+    try:
+        with pytest.raises(ValueError):
+            gen.submit([])
+        with pytest.raises(ValueError):
+            gen.submit([1] * 33)                   # > largest bucket
+        with pytest.raises(ValueError):
+            gen.submit([1] * 16, SamplingParams(max_new_tokens=49))
+        with pytest.raises(ValueError):
+            SamplingParams(max_new_tokens=0)
+        with pytest.raises(ValueError):
+            GenerationConfig(backpressure="dropit")
+        with pytest.raises(ValueError):
+            GenerationConfig(max_seq=64, prefill_buckets=(16, 128))
+    finally:
+        gen.stop()
+
+
+def test_pool_too_small_for_request_rejected_at_submit():
+    model, params = _model()
+    gen = _generator(model, params, pool_pages=4)  # 3 pages = 24 tokens
+    try:
+        with pytest.raises(ValueError):
+            gen.submit([1] * 16, SamplingParams(max_new_tokens=16))
+        # a fitting request still flows
+        assert len(gen.generate([1] * 4, SamplingParams(max_new_tokens=2),
+                                timeout=300)) == 2
+    finally:
+        gen.stop()
+
+
+def test_backpressure_reject_and_block():
+    model, params = _model()
+    gen = _generator(model, params, max_queue=1, backpressure="reject",
+                     start=False)
+    h = gen.submit([1, 2], SamplingParams(max_new_tokens=2))
+    with pytest.raises(QueueFullError):
+        gen.submit([3, 4], SamplingParams(max_new_tokens=2))
+    gen.stop(drain=True)              # never-started: drains inline
+    assert len(h.result(timeout=60)) == 2
+
+    gen2 = _generator(model, params, max_queue=1, backpressure="block",
+                      start=False)
+    gen2.submit([1, 2], SamplingParams(max_new_tokens=2))
+    unblocked = []
+
+    def blocked_submit():
+        try:
+            unblocked.append(
+                gen2.submit([5, 6], SamplingParams(max_new_tokens=2)))
+        except ServerClosedError as err:
+            unblocked.append(err)
+
+    t = threading.Thread(target=blocked_submit)
+    t.start()
+    time.sleep(0.2)
+    assert not unblocked              # still blocked on the full queue
+    gen2.start()                      # scheduler drains the queue
+    t.join(120)
+    assert unblocked and isinstance(unblocked[0].result(timeout=120), list)
+    gen2.stop()
+
+
+# -------------------------------------------------------------- shutdown
+def test_clean_drain_serves_everything():
+    model, params = _model()
+    gen = _generator(model, params)
+    handles = [gen.submit([1 + i] * (2 + i),
+                          SamplingParams(max_new_tokens=5))
+               for i in range(6)]
+    gen.stop(drain=True)
+    for h in handles:
+        assert len(h.result(timeout=60)) == 5
+    assert gen.pool.pages_used() == 0
+    with pytest.raises(ServerClosedError):
+        gen.submit([1], SamplingParams(max_new_tokens=1))
+
+
+def test_abort_fails_queued_and_in_flight():
+    model, params = _model()
+    gen = _generator(model, params, max_batch=1)
+    handles = [gen.submit([2 + i] * 3, SamplingParams(max_new_tokens=40))
+               for i in range(4)]
+    time.sleep(0.3)                   # let one admit and start decoding
+    gen.stop(drain=False)
+    failed = 0
+    for h in handles:
+        try:
+            h.result(timeout=60)
+        except ServerClosedError:
+            failed += 1
+    assert failed >= 3                # at most one finished before abort
+    assert gen.pool.pages_used() == 0
+
+
+# ------------------------------------------------------------- autotune
+def test_knob_resolution_explicit_beats_cache_beats_flag():
+    from mxnet_tpu.serving.generation.engine import generation_tune_key
+
+    model, params = _model()
+    key = generation_tune_key(model, 4, 64)
+    autotune.record("generation.page_size", key, {"page_size": 4})
+    autotune.record("generation.decode_blocks", key, {"decode_blocks": 32})
+    try:
+        gen = Generator(model, params, GenerationConfig(
+            max_batch=4, max_seq=64, prefill_buckets=(16, 32, 64)),
+            start=False)
+        assert gen.page_size == 4 and gen.decode_blocks == 32
+        gen2 = Generator(model, params, GenerationConfig(
+            page_size=8, decode_blocks=64, max_batch=4, max_seq=64,
+            prefill_buckets=(16, 32, 64)), start=False)
+        assert gen2.page_size == 8 and gen2.decode_blocks == 64
+        # corrupt entry degrades to the flag default, never a crash
+        autotune.record("generation.page_size", key,
+                        {"page_size": "gibberish"})
+        set_flag("MXNET_GEN_PAGE_SIZE", 32)
+        gen3 = Generator(model, params, GenerationConfig(
+            max_batch=4, max_seq=64, prefill_buckets=(16, 32, 64)),
+            start=False)
+        assert gen3.page_size == 32
+    finally:
+        set_flag("MXNET_GEN_PAGE_SIZE", None)
+        autotune.reset()
+
+
+def test_tune_generation_records_and_is_consulted():
+    model, params = _model()
+    calls = []
+
+    def stub_measure(c):
+        calls.append(dict(c))
+        # prefer page 8 / blocks 32 deterministically
+        return (0.001 if c.get("page_size") == 8 else 0.002) \
+            if "page_size" in c \
+            else (0.001 if c.get("decode_blocks") == 32 else 0.002)
+
+    out = autotune.tune_generation(model, params, max_batch=4, max_seq=64,
+                                   measure=stub_measure, trials=8)
+    try:
+        assert out["generation.page_size"]["page_size"] == 8
+        assert out["generation.decode_blocks"]["decode_blocks"] == 32
+        assert calls, "stub measurer never consulted"
+        gen = Generator(model, params, GenerationConfig(
+            max_batch=4, max_seq=64, prefill_buckets=(16, 32, 64)),
+            start=False)
+        assert gen.page_size == 8
+        assert gen.decode_blocks == 32
+    finally:
+        autotune.reset()
+
+
+def test_tune_generation_live_measurer_smoke():
+    model, params = _model()
+    out = autotune.tune_generation(
+        model, params, prompts=[[1, 2, 3], [4] * 7], max_new=2,
+        max_batch=2, max_seq=32, trials=2)
+    try:
+        assert out["generation.page_size"]["page_size"] > 0
+    finally:
+        autotune.reset()
+
+
+def test_tune_generation_default_prompts_fit_small_geometry():
+    # every DEFAULT sample length must satisfy prompt + max_new <=
+    # max_seq, not just the largest (a 17-token default prompt used to
+    # crash the live-measurer search at max_seq=24)
+    model, params = _model()
+    try:
+        out = autotune.tune_generation(model, params, max_new=8,
+                                       max_batch=2, max_seq=24, trials=2)
+        assert out["generation.page_size"]["page_size"] > 0
+    finally:
+        autotune.reset()
+
+
+# ---------------------------------------------------------------- config
+def test_default_prefill_ladder():
+    assert default_prefill_ladder(256) == (16, 32, 64, 128, 256)
+    assert default_prefill_ladder(100) == (16, 32, 64, 100)
+    assert default_prefill_ladder(16) == (16,)
+    assert default_prefill_ladder(8) == (8,)

@@ -48,16 +48,18 @@ _DEF_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 _BUILTIN_NAMES = frozenset(dir(__import__("builtins")))
 
-# method names of ubiquitous stdlib concurrency objects: an
-# ``x.submit(...)`` or ``fut.add_done_callback(...)`` is almost always a
-# ThreadPoolExecutor / Future / lock, not a package-unique def that
-# happens to share the name — binding those by attr produces sync-closure
-# false positives package-wide the moment anyone defines e.g. a
-# ``submit`` method (the attr analog of the _BUILTIN_NAMES guard)
+# method names of ubiquitous stdlib concurrency/container objects: an
+# ``x.submit(...)``, ``fut.add_done_callback(...)`` or ``lst.extend(...)``
+# is almost always a ThreadPoolExecutor / Future / lock / list, not a
+# package-unique def that happens to share the name — binding those by
+# attr produces sync-closure false positives package-wide the moment
+# anyone defines e.g. a ``submit`` (or ``extend``: PagePool.extend vs
+# every list.extend in the package) method (the attr analog of the
+# _BUILTIN_NAMES guard)
 _STDLIB_METHOD_NAMES = frozenset({
     "submit", "shutdown", "add_done_callback", "set_result",
     "set_exception", "put_nowait", "get_nowait", "acquire", "release",
-    "notify", "notify_all",
+    "notify", "notify_all", "extend",
 })
 
 
