@@ -54,6 +54,7 @@ from .monitor import Monitor
 from . import profiler
 from . import observability
 from . import autotune
+from . import resilience
 from . import rtc
 from . import storage
 from . import attribute

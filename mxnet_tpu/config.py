@@ -197,6 +197,44 @@ Flags currently honored:
     overrides the device fingerprint half of every cache key — tests,
     or shipping one tuned cache to a known fleet.
 
+``MXNET_FAULTS`` (default unset) / ``MXNET_FAULTS_SEED`` (default 0)
+    Deterministic fault-injection spec for the resilience layer
+    (resilience/faults.py; grammar in docs/resilience.md), e.g.
+    ``kvstore.push:drop@p=0.01;serving.replica_execute:raise@call=7``.
+    Unset, every declared injection point is a few-nanosecond no-op
+    (gated by ``bench_all.py --resilience-overhead``). String-valued,
+    env-only (``resilience.faults.configure`` overrides at runtime).
+
+``MXNET_RETRY_MAX`` (default 3)
+    Attempt budget of the shared retry primitive (resilience/retry.py)
+    — total tries including the first. Used by kvstore push/pull and
+    the PS RPC layer (reconnect-between-attempts).
+
+``MXNET_RETRY_BASE_MS`` / ``MXNET_RETRY_MAX_MS`` (defaults 10 / 2000)
+    First backoff delay and its doubling cap, milliseconds. Each delay
+    is down-jittered by up to 25% so synchronized clients desynchronize.
+
+``MXNET_RETRY_DEADLINE_MS`` (default 30000)
+    Wall-clock cap across all attempts of one retried operation; 0
+    disables. Bounds scheduling only — an attempt already blocked in a
+    recv is the transport timeout's job.
+
+``MXNET_SERVING_DEADLINE_MS`` (default 0 = off)
+    Per-request deadline of the serving engine: a request still queued
+    this many ms after submit is failed with ``DeadlineExceeded``
+    *before* dispatch — a backlogged server sheds stale work instead of
+    serving answers nobody is waiting for.
+
+``MXNET_SERVING_COOLDOWN_MS`` (default 1000)
+    Circuit-breaker cooldown: a replica whose dispatch faulted is
+    quarantined out of round-robin for this long, then re-admitted via
+    a zero-batch probe (success re-admits, failure re-quarantines).
+
+``MXNET_GEN_SUBMIT_TIMEOUT`` (default 0 = wait forever)
+    Block-mode ``Generator.submit`` wait bound, milliseconds: a full
+    admission queue that stays full this long raises QueueFullError
+    instead of blocking the caller indefinitely.
+
 ``MXNET_PROFILER_MODE`` (default ``symbolic``)
     Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
     trace can be captured from an unmodified script via env alone;
@@ -241,6 +279,13 @@ _DEFAULTS = {
     "MXNET_GEN_MAX_SEQ": 256,
     "MXNET_GEN_POOL_PAGES": 0,
     "MXNET_GEN_QUEUE": 64,
+    "MXNET_GEN_SUBMIT_TIMEOUT": 0,
+    "MXNET_RETRY_MAX": 3,
+    "MXNET_RETRY_BASE_MS": 10,
+    "MXNET_RETRY_MAX_MS": 2000,
+    "MXNET_RETRY_DEADLINE_MS": 30000,
+    "MXNET_SERVING_DEADLINE_MS": 0,
+    "MXNET_SERVING_COOLDOWN_MS": 1000,
 }
 
 

@@ -22,8 +22,19 @@ from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import optimizer as opt
+from .resilience import faults as _faults
+from .resilience import retry as _retry
 
 __all__ = ["KVStore", "create"]
+
+# chaos-testable injection points (resilience/faults.py): zero-cost
+# no-ops unless an MXNET_FAULTS spec matches; a drop here looks exactly
+# like a lost socket, which the retry wrapper around push/pull heals
+_faults.declare("kvstore.push",
+                doc="before one push's reduce+update/RPC — drop faults "
+                    "are retried (backoff + shard reconnect)")
+_faults.declare("kvstore.pull",
+                doc="before one pull's fetch — drop faults are retried")
 
 
 def _ctype_key_value(keys, vals):
@@ -105,6 +116,7 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._barrier_count = 0
+        self._retry_policy = _retry.RetryPolicy()
         self._dist = kv_type.startswith("dist")
         if self._dist:
             _ensure_distributed()
@@ -255,8 +267,22 @@ class KVStore:
     def push(self, key, value, priority=0):
         from .observability import counter, trace_span
 
-        with trace_span("kvstore.push", "kvstore"):
+        def _attempt():
+            # this retry layer heals drops injected at the OPERATION
+            # level (and, for local stores, any connection-shaped error
+            # — local pushes have no inner transport). Dist stores'
+            # real socket losses are healed one level down, by
+            # PSClient._call's retry-through-reconnect; inject at
+            # `kvstore.rpc` to chaos-test that path. Only
+            # connection-shaped errors are retried — a semantic error
+            # (uninitialized key) stays fatal, and an exhausted inner
+            # retry (RetryExhaustedError) is not re-retried here.
+            _faults.inject("kvstore.push")
             self._push_impl(key, value, priority)
+
+        with trace_span("kvstore.push", "kvstore"):
+            _retry.call(_attempt, policy=self._retry_policy,
+                        name="kvstore.push")
         counter("kvstore.push").inc()
         for k in (key if isinstance(key, (list, tuple)) else (key,)):
             self._note_push(k)
@@ -314,8 +340,14 @@ class KVStore:
         from .observability import counter, trace_span
 
         assert out is not None
-        with trace_span("kvstore.pull", "kvstore"):
+
+        def _attempt():
+            _faults.inject("kvstore.pull")
             self._pull_impl(key, out, priority)
+
+        with trace_span("kvstore.pull", "kvstore"):
+            _retry.call(_attempt, policy=self._retry_policy,
+                        name="kvstore.pull")
         counter("kvstore.pull").inc()
 
     def _pull_impl(self, key, out, priority=0):
@@ -525,6 +557,7 @@ class KVStoreDistAsync(KVStore):
         self._optimizer = None
         self._compression_params = None
         self._barrier_count = 0
+        self._retry_policy = _retry.RetryPolicy()
         self._dist = True
         addrs = os.environ.get("MXTPU_PS_ADDR")
         self._rank = int(os.environ.get("MXTPU_WORKER_ID", "0"))
@@ -593,42 +626,13 @@ class KVStoreDistAsync(KVStore):
                     # silently corrupt a pull. Drop the socket and try
                     # one quick reconnect; if that fails the next data
                     # call errors loudly instead of desyncing.
-                    self._reconnect_shard(i)
+                    client.reconnect_shard(i, locked=True)
             except Exception as err:  # dead shard must not sink the dump
                 servers.append({"error": repr(err)})
             finally:
                 lock.release()
         out["servers"] = servers
         return out
-
-    def _reconnect_shard(self, i):
-        """Replace shard i's data socket after a mid-exchange failure
-        (caller holds the shard lock). Short one-shot connect — this runs
-        in the crash-dump path and must stay bounded."""
-        import socket as _socket
-
-        client = self._client
-        try:
-            client._socks[i].close()
-        except OSError:
-            pass
-        try:
-            host, _, port = client._addresses[i].rpartition(":")
-            fresh = _socket.create_connection((host, int(port)), timeout=2)
-            fresh.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-            from .kvstore_server import _recv_msg, _send_msg
-
-            # hello still under the 2s crash-path budget (a shard that
-            # accepts but whose handler is wedged must not block the
-            # dying process); only then widen to the normal 30s data
-            # window (matching PSClient._connect) so a slow-but-healthy
-            # pull on the recovered socket doesn't spuriously time out
-            _send_msg(fresh, ("hello", client.rank))
-            _recv_msg(fresh)
-            fresh.settimeout(30)
-            client._socks[i] = fresh
-        except Exception:
-            pass  # closed socket: the next data call fails loudly
 
     def _slice_plan(self, key, shape):
         """Contiguous flat-slice layout of a big value across all shards

@@ -35,8 +35,17 @@ import time
 import numpy as np
 
 from .base import MXNetError
+from .resilience import faults as _faults
 
 __all__ = ["KVStoreServer", "PSClient", "run_server", "start_server_thread"]
+
+# injection point INSIDE the RPC retry region (PSClient._call): a drop
+# here exercises the real transport-loss recovery — reconnect_shard +
+# re-attempt — which a drop at the kvstore.push level (healed before
+# any socket is touched) cannot reach
+_faults.declare("kvstore.rpc",
+                doc="before one PS RPC exchange, inside the retried "
+                    "region — drops heal through shard reconnect")
 
 _LEN = struct.Struct(">Q")
 
@@ -292,12 +301,26 @@ class KVStoreServer:
             # withdraw so this stale event cannot count toward (and
             # prematurely release) a later barrier round; re-check under
             # the lock — the release may have raced our timeout
+            hb = float(os.environ.get("MXTPU_PS_HEARTBEAT", "5"))
+            now = time.time()
             with self._lock:
                 if ev.is_set():
                     return ("ok",)
+                arrived = len(self._barrier_waiters)
                 if ev in self._barrier_waiters:
                     self._barrier_waiters.remove(ev)
-            return ("err", "barrier timeout (gen %d)" % gen)
+                ages = {str(rank): round(now - ts, 3)
+                        for rank, ts in self._last_seen.items()}
+            # dead-node diagnostics ride the reply: the client surfaces
+            # them in a typed BarrierTimeoutError instead of a bare
+            # ("err", ...) string — the ps-lite heartbeat story made
+            # actionable (which rank stopped heartbeating, how long ago)
+            dead = sorted(rank for rank, age in ages.items()
+                          if age > max(3.0 * hb, 15.0))
+            return ("barrier_timeout",
+                    {"gen": gen, "timeout_s": timeout, "arrived": arrived,
+                     "num_workers": int(num_workers),
+                     "worker_age_s": ages, "dead_nodes": dead})
         return ("ok",)
 
     # --- server loop ------------------------------------------------------
@@ -399,8 +422,11 @@ class PSClient:
     """
 
     def __init__(self, addresses, rank):
+        from .resilience import retry as _retry
+
         self.rank = rank
         self._addresses = list(addresses)
+        self._retry_policy = _retry.RetryPolicy()
         self._socks = []
         self._locks = []
         for addr in addresses:
@@ -461,12 +487,78 @@ class PSClient:
         return zlib.crc32(str(key).encode()) % len(self._socks)
 
     def _call(self, shard, msg):
-        with self._locks[shard]:
-            _send_msg(self._socks[shard], msg)
-            resp = _recv_msg(self._socks[shard])
+        """One RPC exchange, retried through shard reconnect on
+        connection-shaped failures (resilience/retry.py — the shared
+        backoff/deadline primitive, replacing the old one-shot ad-hoc
+        reconnect). Note the at-least-once caveat: a failure between the
+        server applying a push and the reply landing means the retry
+        re-applies it — inherent to retried non-idempotent RPC, and the
+        reference PS protocol's behavior too."""
+        from .resilience import BarrierTimeoutError
+        from .resilience import retry as _retry
+
+        def _exchange():
+            _faults.inject("kvstore.rpc")
+            with self._locks[shard]:
+                _send_msg(self._socks[shard], msg)
+                return _recv_msg(self._socks[shard])
+
+        def _on_retry(err, attempt):
+            self.reconnect_shard(shard)
+
+        if msg[0] == "barrier":
+            # a barrier must NOT be retried: the first request may still
+            # be counted in the server's waiter list, and a re-sent
+            # entry from the same worker could release a round early —
+            # transport errors surface raw, exactly as before
+            resp = _exchange()
+        else:
+            resp = _retry.call(_exchange, policy=self._retry_policy,
+                               name="kvstore.rpc", on_retry=_on_retry)
+        if resp[0] == "barrier_timeout":
+            diag = resp[1]
+            raise BarrierTimeoutError(
+                "kvstore barrier timed out after %.0fs (gen %s): %d/%d "
+                "workers arrived; dead nodes: %s"
+                % (diag.get("timeout_s", 0), diag.get("gen"),
+                   diag.get("arrived", 0), diag.get("num_workers", 0),
+                   ", ".join(diag.get("dead_nodes") or []) or "none"),
+                diagnostics=diag)
         if resp[0] == "err":
             raise MXNetError("PS server: %s" % resp[1])
         return resp[1] if len(resp) > 1 else None
+
+    def reconnect_shard(self, i, timeout=2.0, locked=False):
+        """Replace shard ``i``'s data socket after a mid-exchange
+        failure. ``locked=True`` when the caller already holds the shard
+        lock (the crash-dump path in ``KVStoreDistAsync.push_staleness``
+        — short timeouts, must stay bounded); otherwise the lock is
+        taken here so a concurrent exchange cannot race the swap.
+        Failures are swallowed: the next attempt fails fast on the
+        closed socket and the retry budget decides when to give up."""
+        if not locked:
+            with self._locks[i]:
+                return self.reconnect_shard(i, timeout=timeout, locked=True)
+        try:
+            self._socks[i].close()
+        except OSError:
+            pass
+        try:
+            host, _, port = self._addresses[i].rpartition(":")
+            fresh = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+            fresh.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # hello under the short budget (a shard that accepts but
+            # whose handler is wedged must not block the caller); only
+            # then widen to the normal 30s data window (matching
+            # _connect) so a slow-but-healthy pull on the recovered
+            # socket doesn't spuriously time out
+            _send_msg(fresh, ("hello", self.rank))
+            _recv_msg(fresh)
+            fresh.settimeout(30)
+            self._socks[i] = fresh
+        except Exception:
+            pass  # closed socket: the next data call fails loudly
 
     def key_call(self, key, msg):
         return self._call(self._shard(key), msg)

@@ -219,8 +219,11 @@ class FeedForward:
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_end_callback=None, eval_batch_end_callback=None):
-        """Train for ``num_epoch`` epochs over X/y (arrays or a DataIter)."""
+            eval_end_callback=None, eval_batch_end_callback=None,
+            resume=None):
+        """Train for ``num_epoch`` epochs over X/y (arrays or a DataIter).
+        ``resume`` names a resumable-checkpoint directory (preemption-
+        safe training — see Module.fit / docs/resilience.md)."""
         if self.num_epoch is None:
             raise ValueError("num_epoch must be set to call fit")
         from .observability import flight_recorder, health
@@ -250,7 +253,7 @@ class FeedForward:
                 begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
                 eval_end_callback=eval_end_callback,
                 eval_batch_end_callback=eval_batch_end_callback,
-                monitor=monitor)
+                monitor=monitor, resume=resume)
         self.arg_params, self.aux_params = mod.get_params()
         return self
 

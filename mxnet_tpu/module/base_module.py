@@ -102,14 +102,43 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """Train over ``train_data`` for ``num_epoch`` epochs."""
+            monitor=None, resume=None):
+        """Train over ``train_data`` for ``num_epoch`` epochs.
+
+        ``resume`` names a checkpoint directory and makes the run
+        preemption-safe (resilience/, docs/resilience.md): the newest
+        *valid* resumable checkpoint there (if any) restores parameters,
+        optimizer state, the RNG stream and the (epoch, batch) position
+        — bit-exact at the checkpointed step for deterministic input
+        pipelines — and a SIGTERM during training finishes the in-flight
+        step, writes a fresh checkpoint into the same directory, and
+        unwinds with :class:`~mxnet_tpu.resilience.PreemptedError`.
+        """
         if num_epoch is None:
             raise ValueError("please specify number of epochs")
         if health.active():
             # arm the crash hooks so an OOM/preemption/raise mid-fit
             # still leaves the last-K step records on disk
             flight_recorder.install()
+
+        guard = None
+        resume_state = None
+        if resume is not None:
+            from ..resilience import checkpoint as _ckpt
+            from ..resilience.preemption import PreemptionGuard
+
+            resume_state = _ckpt.load_latest(resume)
+            guard = PreemptionGuard(resume)
+            if resume_state is not None:
+                self.logger.info(
+                    "Resuming from %s (epoch %d, batch %d, step %d)",
+                    resume_state.path, resume_state.epoch,
+                    resume_state.batch, resume_state.step)
+                arg_params = resume_state.arg_params
+                aux_params = resume_state.aux_params
+                allow_missing = False
+                force_init = True
+                begin_epoch = resume_state.epoch
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -121,47 +150,88 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resume_state is not None:
+            if resume_state.optimizer_states is not None:
+                self.load_optimizer_states(resume_state.optimizer_states)
+            if resume_state.rng_state is not None:
+                from .. import random as _random
+
+                _random.set_state(resume_state.rng_state)
 
         train_metric = _resolve_metric(eval_metric)
         validation_metric = (train_metric if validation_metric is None
                              else validation_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
-            started = time.time()
-            train_metric.reset()
-            nbatch = self._fit_epoch(train_data, train_metric, monitor,
-                                     batch_end_callback, epoch)
+        completed_steps = resume_state.step if resume_state else 0
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                started = time.time()
+                train_metric.reset()
+                skip = (resume_state.batch
+                        if resume_state is not None
+                        and epoch == resume_state.epoch else 0)
+                # epoch-loop transfer is the end-of-epoch metric/monitor
+                # report plus the (cold) preemption-checkpoint path
+                nbatch, completed_steps = self._fit_epoch(  # graftlint: disable=G001
+                    train_data, train_metric, monitor, batch_end_callback,
+                    epoch, skip_batches=skip, guard=guard,
+                    completed_steps=completed_steps)
 
-            for name, val in train_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - started)
+                for name, val in train_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - started)
 
-            # sync params from devices so callbacks / eval see fresh values
-            arg_now, aux_now = self.get_params()
-            self.set_params(arg_now, aux_now)
-            _fire(epoch_end_callback, epoch, self.symbol, arg_now, aux_now)
+                # sync params from devices so callbacks / eval see fresh
+                # values
+                arg_now, aux_now = self.get_params()
+                self.set_params(arg_now, aux_now)
+                _fire(epoch_end_callback, epoch, self.symbol, arg_now,
+                      aux_now)
 
-            if eval_data:
-                scores = self.score(eval_data, validation_metric,
-                                    score_end_callback=eval_end_callback,
-                                    batch_end_callback=eval_batch_end_callback,
-                                    epoch=epoch)
-                for name, val in scores:
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
-            train_data.reset()
+                if eval_data:
+                    scores = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in scores:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+                if guard is not None and guard.triggered:
+                    # preempted during eval/epoch turnover: position is
+                    # the top of the next epoch
+                    guard.checkpoint_and_raise(self, epoch=epoch + 1,
+                                               batch=0,
+                                               step=completed_steps)
+        finally:
+            if guard is not None:
+                guard.disarm()
         if health.active():
             # settle the warn-mode lag-1 stash so the final step's
             # verdict is recorded before fit returns
             health.flush()
 
     def _fit_epoch(self, train_data, train_metric, monitor,
-                   batch_end_callback, epoch):
-        """One pass over train_data; returns the number of batches run."""
+                   batch_end_callback, epoch, skip_batches=0, guard=None,
+                   completed_steps=0):
+        """One pass over train_data; returns (batches consumed this
+        epoch, completed training steps overall).
+
+        ``skip_batches`` fast-forwards a resumed epoch to its
+        checkpointed position (the batches are consumed, not trained —
+        deterministic iterators replay identically after reset).
+        ``guard`` is the :class:`PreemptionGuard` polled between steps:
+        when SIGTERM flagged it, the in-flight step has just finished,
+        so the checkpoint written here is step-consistent."""
         nbatch = 0
         eval_metric = train_metric  # keep legacy name visible in locals()
         for data_batch, _is_last, upcoming in _lookahead(train_data):
+            if nbatch < skip_batches:
+                nbatch += 1
+                continue
             step_started = time.perf_counter()
             if monitor is not None:
                 monitor.tic()
@@ -193,7 +263,14 @@ class BaseModule:
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=train_metric, locals=locals()))
             nbatch += 1
-        return nbatch
+            completed_steps += 1
+            if guard is not None and guard.triggered:
+                # the in-flight step just completed; checkpoint at this
+                # exact position and unwind (PreemptedError)
+                guard.checkpoint_and_raise(self, epoch=epoch,
+                                           batch=nbatch,
+                                           step=completed_steps)
+        return nbatch, completed_steps
 
     def _health_check(self, wall_s):
         """Hook: run observability.health's fused per-step check over this

@@ -55,6 +55,7 @@ _last_dump = None       # (path, monotonic ts) of the last dump  # guarded-by: _
 _providers = {}         # name -> zero-arg callable  # guarded-by: _lock
 _installed = False      # excepthook/atexit armed  # guarded-by: _lock
 _prev_excepthook = None
+_prev_signals = {}      # signum -> previous handler (chained)
 
 # at most one anomaly dump per this many seconds: a run stuck at NaN must
 # not grind itself to death re-serializing the same story every step
@@ -282,6 +283,56 @@ def _excepthook(exc_type, exc, tb):
         _prev_excepthook(exc_type, exc, tb)
 
 
+def _signal_handler(signum, frame):
+    """SIGTERM/SIGINT chain link: dump (same 60s throttle as anomaly
+    dumps — a signal storm must not grind the dying process), then hand
+    the signal on. Preemptions used to bypass the excepthook/atexit
+    paths entirely, losing exactly the dumps that matter most."""
+    import signal as _signal
+
+    try:
+        name = _signal.Signals(signum).name
+    except (ValueError, AttributeError):
+        name = str(signum)
+    # reentrancy probe: the handler interrupts the MAIN thread, which
+    # may be inside a `with _lock:` section — a blocking dump() would
+    # then deadlock the dying process inside its own crash handler.
+    # The suspended main thread can never release while we run, so a
+    # short timed acquire either proves the lock is safe (another
+    # thread holding it will release) or tells us to skip the dump.
+    if _lock.acquire(timeout=0.25):
+        _lock.release()
+        try:
+            dump_on_anomaly("signal:%s" % name)
+        except Exception:
+            pass
+    prev = _prev_signals.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == _signal.SIG_DFL:
+        # restore the default and re-deliver so the process dies with
+        # the conventional signal status (the dump already landed)
+        try:
+            _signal.signal(signum, _signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        os.kill(os.getpid(), signum)
+    # SIG_IGN: swallowed, matching the pre-install behavior
+
+
+def _install_signal_hooks():
+    """Chain SIGTERM/SIGINT (main thread only — signal.signal raises
+    elsewhere, and a library must not steal handlers from a host that
+    runs us in a worker thread)."""
+    import signal as _signal
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            _prev_signals[sig] = _signal.signal(sig, _signal_handler)
+        except (ValueError, OSError):
+            return
+
+
 def _atexit_flush():
     # safety net: an anomaly was recorded after the last dump and the
     # process is exiting without an uncaught exception (swallowed error,
@@ -303,9 +354,11 @@ def _atexit_flush():
 
 def install(dump_dir=None):
     """Arm the crash hooks (idempotent): chain ``sys.excepthook`` so an
-    uncaught exception dumps before the traceback prints, and register
-    the atexit flush. Called by the wired training front-ends when the
-    health policy is active, and by the test harness (conftest)."""
+    uncaught exception dumps before the traceback prints, chain
+    SIGTERM/SIGINT so preemptions dump before dying (throttled; skipped
+    off the main thread), and register the atexit flush. Called by the
+    wired training front-ends when the health policy is active, and by
+    the test harness (conftest)."""
     global _installed, _prev_excepthook
     if dump_dir is not None:
         configure(dump_dir=dump_dir)
@@ -315,4 +368,5 @@ def install(dump_dir=None):
         _installed = True
     _prev_excepthook = sys.excepthook
     sys.excepthook = _excepthook
+    _install_signal_hooks()
     atexit.register(_atexit_flush)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["seed", "next_key"]
+__all__ = ["seed", "next_key", "get_state", "set_state"]
 
 _state = threading.local()
 
@@ -41,3 +41,20 @@ def next_key():
     key, sub = jax.random.split(_get_key())
     _state.key = key
     return sub
+
+
+def get_state():
+    """Host copy of the global PRNG key (uint32 vector) — what a
+    resumable checkpoint stores so a resumed run draws the same random
+    sequence the uninterrupted run would have (resilience/checkpoint)."""
+    import numpy as np
+
+    return np.asarray(_get_key(), dtype=np.uint32)
+
+
+def set_state(data):
+    """Restore a key captured by :func:`get_state`."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    _state.key = jnp.asarray(np.asarray(data, dtype=np.uint32))

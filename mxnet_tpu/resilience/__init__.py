@@ -1,0 +1,68 @@
+"""Fault-tolerance layer (ISSUE 8): the training/serving stack assumes
+workers die, sockets drop, devices fault and jobs get preempted — and
+every recovery path is provable because faults can be *injected*.
+
+Four pieces, wired through kvstore, module.fit, serving and generation:
+
+* :mod:`.faults` — deterministic fault injection: call sites declare
+  named points (``kvstore.push``, ``serving.replica_execute``,
+  ``generation.decode_step``, ``checkpoint.write``) that are
+  a few-nanosecond no-ops by default and, under a seeded
+  ``MXNET_FAULTS`` spec, deterministically drop/delay/raise.
+* :mod:`.retry` — THE retry primitive (exponential backoff + jitter,
+  attempt- and deadline-capped, per-policy telemetry), used by the
+  kvstore RPC layer through shard reconnect.
+* :mod:`.checkpoint` / :mod:`.preemption` — SIGTERM-safe training:
+  finish the in-flight step, write an atomic checksummed resumable
+  checkpoint (params + optimizer state + RNG + position + recorder
+  ring), and ``fit(resume=dir)`` restarts from the newest *valid* one.
+* Serving/generation failover lives in :mod:`..serving`: per-request
+  deadlines (:class:`DeadlineExceeded`), a replica circuit breaker with
+  cooldown re-admission, and decode-fault containment in the
+  generation scheduler.
+
+See docs/resilience.md for the fault-spec grammar, the retry/deadline
+tuning table, and the preempt-resume quick start.
+"""
+from ..base import MXNetError
+
+
+class DeadlineExceeded(MXNetError):
+    """A request's per-request deadline (``MXNET_SERVING_DEADLINE_MS``)
+    expired while it was still queued — rejected before dispatch so a
+    backlogged server sheds load instead of serving answers nobody is
+    waiting for anymore."""
+
+
+class BarrierTimeoutError(MXNetError):
+    """A kvstore barrier timed out server-side. ``diagnostics`` carries
+    the server's view: how many workers arrived, per-worker last-contact
+    ages, and which ranks look dead — the ps-lite dead-node story as a
+    typed error instead of a ``("err", ...)`` tuple."""
+
+    def __init__(self, message, diagnostics=None):
+        self.diagnostics = dict(diagnostics or {})
+        super().__init__(message)
+
+
+from . import faults
+from . import retry
+from . import checkpoint
+from . import preemption
+from .faults import InjectedFault, InjectedDrop
+from .retry import RetryPolicy, RetryExhaustedError
+from .checkpoint import save_resumable, load_latest
+from .preemption import PreemptedError, PreemptionGuard
+
+__all__ = ["faults", "retry", "checkpoint", "preemption",
+           "DeadlineExceeded", "BarrierTimeoutError",
+           "InjectedFault", "InjectedDrop",
+           "RetryPolicy", "RetryExhaustedError",
+           "save_resumable", "load_latest",
+           "PreemptedError", "PreemptionGuard"]
+
+# the injected-faults section rides every crash dump (providers run
+# best-effort; None when no spec is active keeps clean dumps clean)
+from ..observability import flight_recorder as _flight_recorder
+
+_flight_recorder.register_provider("faults", faults._recorder_section)

@@ -1,0 +1,270 @@
+"""Preemption-safe resumable checkpoints (ISSUE 8).
+
+``model.save_checkpoint`` writes the portable symbol+params artifact
+pair; this module writes the *operational* checkpoint a preempted
+training job resumes from: parameters + optimizer state (update counts
+included — the mxtpu_v2 blob) + the global RNG stream + (epoch, batch,
+step) position + the flight-recorder ring, under one checksummed
+``MANIFEST.json`` written atomically LAST. A reader trusts a checkpoint
+only if the manifest parses and every listed file matches its sha256 —
+a process killed mid-write leaves a manifest-less (or stale-manifest)
+directory that :func:`load_latest` skips, falling back to the previous
+checkpoint instead of resuming from garbage.
+
+Layout::
+
+    <dir>/ckpt-00000042/          # 42 = global step
+        params.ndarray            # save_params format (arg:/aux: keys)
+        optimizer.states          # Updater/kvstore blob (optional)
+        rng.npy                   # mx.random key (optional)
+        ring.json                 # flight-recorder snapshot at write
+        MANIFEST.json             # checksums + position, written last
+
+The two newest checkpoints are kept (:func:`prune` runs after every
+successful write) so one corrupt latest always has a fallback.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+import time
+
+from ..base import MXNetError
+from . import faults
+
+__all__ = ["CheckpointState", "save_resumable", "write_resumable",
+           "load_latest", "validate", "list_checkpoints", "prune"]
+
+MANIFEST = "MANIFEST.json"
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+faults.declare("checkpoint.write",
+               doc="before the manifest write: a fault here leaves a "
+                   "partial checkpoint that load_latest must skip")
+
+
+class CheckpointState:
+    """One validated checkpoint, loaded back to host values."""
+
+    __slots__ = ("path", "epoch", "batch", "step", "arg_params",
+                 "aux_params", "optimizer_states", "rng_state", "meta")
+
+    def __init__(self, path, epoch, batch, step, arg_params, aux_params,
+                 optimizer_states, rng_state, meta):
+        self.path = path
+        self.epoch = epoch
+        self.batch = batch          # completed batches within `epoch`
+        self.step = step            # completed training steps overall
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.optimizer_states = optimizer_states  # file path or None
+        self.rng_state = rng_state  # uint32 key array or None
+        self.meta = meta
+
+
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_resumable(directory, arg_params, aux_params, epoch, batch, step,
+                    optimizer_saver=None, rng_state=None, extra=None):
+    """Write one resumable checkpoint; returns its directory path.
+
+    ``arg_params``/``aux_params``: host NDArray dicts (as returned by
+    ``module.get_params()``). ``optimizer_saver``: callable taking a
+    file path and writing the optimizer-state blob there (e.g.
+    ``module.save_optimizer_states``) — a callback because the kvstore
+    path gathers shard blobs itself. ``rng_state``: the
+    ``mx.random.get_state()`` array. The manifest lands atomically last;
+    everything before it is invisible to :func:`load_latest`.
+    """
+    from .. import ndarray as nd
+    from ..context import cpu
+    from ..observability import flight_recorder
+
+    ckpt_dir = os.path.join(directory, "ckpt-%08d" % int(step))
+    if os.path.isdir(ckpt_dir):
+        # a re-write of the same step starts clean — a half-written
+        # older attempt must not leave stray files the manifest blesses
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    faults.inject("checkpoint.write")
+
+    files = {}
+
+    def _add(name):
+        files[name] = _sha256(os.path.join(ckpt_dir, name))
+
+    blobs = {}
+    for kind, params in (("arg", arg_params or {}), ("aux", aux_params or {})):
+        for pname, value in params.items():
+            # checkpoint serialization IS a host materialization point —
+            # cold path, runs once per preemption/save
+            blobs["%s:%s" % (kind, pname)] = (
+                value.as_in_context(cpu())  # graftlint: disable=G001
+                if hasattr(value, "as_in_context") else nd.array(value))
+    params_path = os.path.join(ckpt_dir, "params.ndarray")
+    nd.save(params_path, blobs)
+    _add("params.ndarray")
+
+    if optimizer_saver is not None:
+        opt_path = os.path.join(ckpt_dir, "optimizer.states")
+        optimizer_saver(opt_path)
+        _add("optimizer.states")
+
+    if rng_state is not None:
+        import numpy as np
+
+        np.save(os.path.join(ckpt_dir, "rng.npy"),
+                np.asarray(rng_state, dtype=np.uint32))
+        _add("rng.npy")
+
+    ring_path = os.path.join(ckpt_dir, "ring.json")
+    with open(ring_path, "w") as sink:
+        json.dump(flight_recorder.snapshot(), sink, default=repr)
+    _add("ring.json")
+
+    manifest = {
+        "version": 1,
+        "epoch": int(epoch),
+        "batch": int(batch),
+        "step": int(step),
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "files": files,
+    }
+    if extra:
+        manifest["extra"] = extra
+    tmp = os.path.join(ckpt_dir, MANIFEST + ".tmp.%d" % os.getpid())
+    with open(tmp, "w") as sink:
+        json.dump(manifest, sink, indent=1)
+        sink.flush()
+        os.fsync(sink.fileno())
+    os.replace(tmp, os.path.join(ckpt_dir, MANIFEST))
+    prune(directory)
+    return ckpt_dir
+
+
+def save_resumable(module, directory, epoch, batch, step):
+    """Checkpoint a bound, initialized module (params + optimizer state
+    + RNG stream + position) — the one-call form the preemption guard
+    and user code share."""
+    from .. import random as _random
+
+    arg_params, aux_params = module.get_params()
+    saver = (module.save_optimizer_states
+             if getattr(module, "optimizer_initialized", False) else None)
+    return write_resumable(directory, arg_params, aux_params,
+                           epoch=epoch, batch=batch, step=step,
+                           optimizer_saver=saver,
+                           rng_state=_random.get_state())
+
+
+def list_checkpoints(directory):
+    """(step, path) pairs under ``directory``, newest first — validity
+    NOT checked (that is :func:`validate`/:func:`load_latest`'s job)."""
+    out = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    for name in entries:
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def validate(ckpt_dir):
+    """Return the parsed manifest, or raise :class:`MXNetError` naming
+    what is wrong (missing/corrupt manifest, missing file, checksum
+    mismatch) — the reason :func:`load_latest` logs when it falls back."""
+    mpath = os.path.join(ckpt_dir, MANIFEST)
+    try:
+        with open(mpath) as src:
+            manifest = json.load(src)
+    except (OSError, ValueError) as err:
+        raise MXNetError("checkpoint %s: unreadable manifest (%s)"
+                         % (ckpt_dir, err))
+    files = manifest.get("files")
+    if not isinstance(files, dict) or "params.ndarray" not in files:
+        raise MXNetError("checkpoint %s: manifest lists no params"
+                         % ckpt_dir)
+    for name, want in files.items():
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.isfile(path):
+            raise MXNetError("checkpoint %s: missing file %r"
+                             % (ckpt_dir, name))
+        got = _sha256(path)
+        if got != want:
+            raise MXNetError("checkpoint %s: checksum mismatch on %r "
+                             "(%s != %s)" % (ckpt_dir, name, got[:12],
+                                             want[:12]))
+    return manifest
+
+
+def load_latest(directory):
+    """Newest *valid* checkpoint under ``directory`` as a
+    :class:`CheckpointState`, or None. Corrupt/partial checkpoints are
+    logged and skipped — the fallback contract preemption relies on."""
+    from .. import ndarray as nd
+
+    for _step, ckpt_dir in list_checkpoints(directory):
+        try:
+            manifest = validate(ckpt_dir)
+        except MXNetError as err:
+            logging.warning("resilience: skipping invalid checkpoint: %s",
+                            err)
+            continue
+        arg_params, aux_params = {}, {}
+        for key, value in nd.load(
+                os.path.join(ckpt_dir, "params.ndarray")).items():
+            kind, _, pname = key.partition(":")
+            (arg_params if kind == "arg" else aux_params)[pname] = value
+        opt_path = os.path.join(ckpt_dir, "optimizer.states")
+        rng_state = None
+        rng_path = os.path.join(ckpt_dir, "rng.npy")
+        if "rng.npy" in manifest["files"]:
+            import numpy as np
+
+            rng_state = np.load(rng_path)
+        return CheckpointState(
+            ckpt_dir, epoch=int(manifest.get("epoch", 0)),
+            batch=int(manifest.get("batch", 0)),
+            step=int(manifest.get("step", 0)),
+            arg_params=arg_params, aux_params=aux_params,
+            optimizer_states=(opt_path if "optimizer.states"
+                              in manifest["files"] else None),
+            rng_state=rng_state, meta=manifest)
+    return None
+
+
+def prune(directory, keep=2):
+    """Keep the ``keep`` newest *valid* checkpoints; delete everything
+    else — including invalid (crashed-write) directories, which must
+    never count toward the quota: two crashed higher-step writes would
+    otherwise evict every valid checkpoint, the just-written one
+    included. Single-writer contract (fit's preemption guard / explicit
+    save_resumable calls), so an invalid directory is always a dead
+    leftover, never a concurrent write in progress."""
+    kept = 0
+    for _step, ckpt_dir in list_checkpoints(directory):
+        ok = False
+        if kept < keep:
+            try:
+                validate(ckpt_dir)
+                ok = True
+            except MXNetError:
+                ok = False
+        if ok:
+            kept += 1
+        else:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)

@@ -3,12 +3,12 @@ dynamic micro-batching, pipelined dispatch (docs/serving.md); the
 :mod:`.generation` subpackage adds autoregressive decode — paged KV
 cache + continuous batching (docs/generation.md)."""
 from .buckets import DEFAULT_BUCKETS, parse_buckets, pick_bucket
-from .engine import (InferenceServer, QueueFullError, ServerClosedError,
-                     ServingConfig)
+from .engine import (DeadlineExceeded, InferenceServer, QueueFullError,
+                     ServerClosedError, ServingConfig)
 
 __all__ = ["InferenceServer", "ServingConfig", "QueueFullError",
-           "ServerClosedError", "parse_buckets", "pick_bucket",
-           "DEFAULT_BUCKETS", "generation"]
+           "ServerClosedError", "DeadlineExceeded", "parse_buckets",
+           "pick_bucket", "DEFAULT_BUCKETS", "generation"]
 
 
 def __getattr__(name):

@@ -40,10 +40,20 @@ import numpy as np
 
 from ..base import MXNetError
 from ..executor import _GraphProgram
+from ..resilience import DeadlineExceeded
+from ..resilience import faults as _faults
 from .buckets import parse_buckets, pick_bucket
 
 __all__ = ["ServingConfig", "InferenceServer", "QueueFullError",
-           "ServerClosedError"]
+           "ServerClosedError", "DeadlineExceeded"]
+
+# chaos-testable injection point (resilience/faults.py): fires inside
+# one replica's padded-bucket dispatch, tagged with the replica index so
+# a spec can fault exactly one replica (serving.replica_execute[1]:...)
+_faults.declare("serving.replica_execute",
+                doc="inside one replica's bucket dispatch — a raise here "
+                    "quarantines the replica and retries the batch once "
+                    "on a surviving one")
 
 
 class QueueFullError(MXNetError):
@@ -65,7 +75,8 @@ class ServingConfig:
     """
 
     def __init__(self, buckets=None, max_wait_ms=None, max_queue_rows=None,
-                 backpressure=None, pipeline_depth=None):
+                 backpressure=None, pipeline_depth=None, deadline_ms=None,
+                 cooldown_ms=None):
         import os
 
         from ..config import get_flag
@@ -82,11 +93,23 @@ class ServingConfig:
         self.pipeline_depth = (get_flag("MXNET_SERVING_PIPELINE")
                                if pipeline_depth is None
                                else int(pipeline_depth))
+        # 0 = no per-request deadline; >0 = a request still queued this
+        # many ms after submit fails with DeadlineExceeded before
+        # dispatch (load shedding under backlog)
+        self.deadline_ms = (get_flag("MXNET_SERVING_DEADLINE_MS")
+                            if deadline_ms is None else float(deadline_ms))
+        # circuit-breaker cooldown before a faulted replica is probed
+        self.cooldown_ms = (get_flag("MXNET_SERVING_COOLDOWN_MS")
+                            if cooldown_ms is None else float(cooldown_ms))
         if self.backpressure not in ("block", "reject"):
             raise ValueError("backpressure must be 'block' or 'reject', "
                              "got %r" % (self.backpressure,))
         if self.max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
+        if self.deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0 (0 disables)")
+        if self.cooldown_ms <= 0:
+            raise ValueError("cooldown_ms must be > 0")
         if self.max_queue_rows < self.buckets[-1]:
             raise ValueError(
                 "max_queue_rows (%d) must fit at least one largest bucket "
@@ -146,18 +169,23 @@ class _Request:
     """One admission-queue entry (a whole request, or one chunk of an
     oversize one)."""
 
-    __slots__ = ("arrays", "n", "assembly", "part", "t_submit")
+    __slots__ = ("arrays", "n", "assembly", "part", "t_submit", "deadline")
 
-    def __init__(self, arrays, n, assembly, part, t_submit):
+    def __init__(self, arrays, n, assembly, part, t_submit, deadline=None):
         self.arrays = arrays
         self.n = n
         self.assembly = assembly
         self.part = part
         self.t_submit = t_submit
+        self.deadline = deadline   # monotonic expiry, None = no deadline
 
 
+# ``batch`` keeps the padded host arrays so a fetch-side device fault
+# can re-execute the batch on a surviving replica; ``retried`` caps the
+# failover at ONE re-execution per batch
 _InFlight = collections.namedtuple(
-    "_InFlight", ["outs", "reqs", "bucket", "rows", "replica"])
+    "_InFlight", ["outs", "reqs", "bucket", "rows", "replica", "batch",
+                  "retried"])
 
 # every live server, GC-pruned — walked by ONE "serving" flight-recorder
 # provider so crash dumps carry queue/in-flight state without a per-
@@ -276,6 +304,9 @@ class InferenceServer:
         # window and the round-robin replica cursor
         self._inflight = collections.deque()
         self._rr = 0
+        # circuit breaker: replica -> monotonic probe-due time; mutated
+        # by the dispatcher, read by get_stats
+        self._quarantined = {}  # guarded-by: self._lock
 
         self._thread = None
         self._life = threading.Lock()  # serializes start()/stop()
@@ -367,11 +398,17 @@ class InferenceServer:
             self._thread.start()
         return self
 
-    def stop(self, drain=True):
+    def stop(self, drain=True, timeout=None):
         """Shut down. ``drain=True`` (default) serves every admitted
         request before returning; ``drain=False`` fails queued requests
         with :class:`ServerClosedError` (in-flight batches still
-        complete — their results are already paid for)."""
+        complete — their results are already paid for).
+
+        ``timeout`` (seconds) bounds the drain: a request stuck on a
+        wedged device used to hang ``stop`` forever — past the timeout
+        every still-pending request fails with
+        :class:`ServerClosedError` and ``stop`` returns (the dispatcher
+        thread is daemonic and exits if/when the device unwedges)."""
         with self._cond:
             self._stop = True
             self._abort = not drain
@@ -379,13 +416,42 @@ class InferenceServer:
         with self._life:  # concurrent stop()s must not race the join
             thread, self._thread = self._thread, None
             if thread is not None:
-                thread.join()
+                thread.join(timeout)
+                if thread.is_alive():
+                    self._abandon_drain(timeout)
             elif self._queue or self._inflight:
                 # never started (start=False): honor the drain contract
                 # by running the dispatch loop inline — with _stop set
                 # it flushes (or abort-fails) the queue and returns
                 self._dispatch_loop()
         return self
+
+    def _abandon_drain(self, timeout):
+        """Drain timed out: fail everything still pending so callers
+        unblock, and leave the (daemon) dispatcher to die on its own."""
+        err = ServerClosedError(
+            "stop(drain=True) timed out after %ss; remaining requests "
+            "failed" % timeout)
+        with self._cond:
+            self._abort = True  # if the thread unwedges, it aborts out
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+            self._cond.notify_all()
+        for r in stranded:
+            r.assembly.fail(err)
+        # best-effort snapshot: the wedged thread owns _inflight, but
+        # Assembly.fail is idempotent and future-safe, so failing a
+        # batch the thread later completes is a no-op race, not a bug
+        try:
+            inflight = list(self._inflight)
+        except RuntimeError:  # deque mutated mid-iteration
+            inflight = []
+        for ent in inflight:
+            for r in ent.reqs:
+                r.assembly.fail(err)
+        with self._lock:
+            self._stats["drain_timeouts"] += 1
 
     def __enter__(self):
         return self.start()
@@ -437,11 +503,13 @@ class InferenceServer:
         n_parts = -(-n_rows // max_bucket)
         assembly = _Assembly(future, n_parts, squeeze)
         t0 = time.monotonic()
+        deadline = (t0 + self._cfg.deadline_ms / 1e3
+                    if self._cfg.deadline_ms > 0 else None)
         parts = []
         for p in range(n_parts):
             lo, hi = p * max_bucket, min((p + 1) * max_bucket, n_rows)
             parts.append(_Request([a[lo:hi] for a in arrays], hi - lo,
-                                  assembly, p, t0))
+                                  assembly, p, t0, deadline))
         bound = self._cfg.max_queue_rows
         with self._cond:
             if self._stop:
@@ -545,9 +613,12 @@ class InferenceServer:
             reqs = self._collect(block=not self._inflight)
             if reqs is None:
                 break
+            self._probe_quarantined()
             if not reqs:
-                # nothing ready yet: spend the wait draining the window
-                self._complete_oldest()
+                # nothing ready yet (or everything queued had expired):
+                # spend the wait draining the window
+                if self._inflight:
+                    self._complete_oldest()
                 continue
             try:
                 self._launch(reqs)
@@ -589,12 +660,27 @@ class InferenceServer:
             self._cond.notify_all()
             return None
         max_bucket = self._cfg.buckets[-1]
+        now = time.monotonic()
         reqs, rows = [], 0
         while self._queue and rows + self._queue[0].n <= max_bucket:
             r = self._queue.popleft()
+            self._queued_rows -= r.n  # graftlint: disable=G004 — under self._cond via _collect
+            if r.deadline is not None and now >= r.deadline:
+                # expired while queued: rejected BEFORE dispatch — a
+                # backlogged server sheds stale work instead of burning
+                # device time on answers nobody is waiting for
+                r.assembly.fail(DeadlineExceeded(
+                    "request expired in queue after %.0f ms (deadline "
+                    "%.0f ms)" % ((now - r.t_submit) * 1e3,
+                                  self._cfg.deadline_ms)))
+                with self._lock:
+                    self._stats["expired"] += 1
+                from ..observability import metrics
+
+                metrics.counter("serving.deadline_expired").inc()
+                continue
             reqs.append(r)
             rows += r.n
-        self._queued_rows -= rows  # graftlint: disable=G004 — under self._cond via _collect
         self._cond.notify_all()  # wake submitters blocked on backpressure
         from ..observability import metrics
 
@@ -603,14 +689,13 @@ class InferenceServer:
 
     def _launch(self, reqs):
         """Pad to the bucket, stage with ONE pytree device_put, dispatch
-        the compiled program (async), and append to the in-flight window."""
+        the compiled program (async), and append to the in-flight window.
+        A dispatch fault quarantines the replica and the batch retries
+        ONCE on a surviving one (inference is idempotent)."""
         from ..observability import metrics
 
         rows = sum(r.n for r in reqs)
         bucket = pick_bucket(rows, self._cfg.buckets)
-        rep = self._rr
-        self._rr = (self._rr + 1) % len(self._devices)
-
         batch = []
         for i, (name, shape) in enumerate(zip(self._data_names,
                                               self._row_shapes)):
@@ -621,17 +706,120 @@ class InferenceServer:
                     dtype=self._arg_dtypes.get(name, np.float32)))
             batch.append(pieces[0] if len(pieces) == 1
                          else np.concatenate(pieces))
-        outs = self._run_bucket(rep, bucket, batch)
-        self._inflight.append(_InFlight(outs, reqs, bucket, rows, rep))
+        err = None
+        for attempt in range(2):
+            rep = self._pick_replica()
+            if rep is None:
+                # circuit OPEN: every replica is quarantined, so this
+                # batch fails fast (it is NOT requeued — FIFO would
+                # invert). Requests arriving after a cooldown expires
+                # are served again: the dispatcher probes due replicas
+                # before every launch.
+                raise err or MXNetError(
+                    "all %d serving replicas quarantined — failing fast; "
+                    "a probe re-admits replicas after the %.0f ms "
+                    "cooldown (MXNET_SERVING_COOLDOWN_MS)"
+                    % (len(self._devices), self._cfg.cooldown_ms))
+            try:
+                outs = self._run_bucket(rep, bucket, batch)
+            except Exception as e:
+                self._quarantine(rep, e)
+                err = e
+                continue
+            self._inflight.append(
+                _InFlight(outs, reqs, bucket, rows, rep, batch,
+                          attempt > 0))
+            with self._lock:
+                if attempt > 0:
+                    self._stats["batch_retries"] += 1
+                self._stats["batches"] += 1
+                self._stats["rows_real"] += rows
+                self._stats["rows_padded"] += bucket - rows
+            metrics.counter("serving.batches").inc()
+            metrics.counter("serving.rows_real").inc(rows)
+            metrics.counter("serving.rows_padded").inc(bucket - rows)
+            metrics.histogram("serving.occupancy_pct").observe(
+                100.0 * rows / bucket)
+            return
+        raise err
+
+    # ------------------------------------------------- replica failover
+    def _pick_replica(self):
+        """Next replica in round-robin order, skipping quarantined ones;
+        None when every replica is quarantined."""
+        n = len(self._devices)
         with self._lock:
-            self._stats["batches"] += 1
-            self._stats["rows_real"] += rows
-            self._stats["rows_padded"] += bucket - rows
-        metrics.counter("serving.batches").inc()
-        metrics.counter("serving.rows_real").inc(rows)
-        metrics.counter("serving.rows_padded").inc(bucket - rows)
-        metrics.histogram("serving.occupancy_pct").observe(
-            100.0 * rows / bucket)
+            quarantined = set(self._quarantined)
+        for _ in range(n):
+            rep = self._rr
+            self._rr = (self._rr + 1) % n
+            if rep not in quarantined:
+                return rep
+        return None
+
+    def _quarantine(self, rep, err):
+        """Pull a faulted replica out of round-robin until its probe."""
+        from ..observability import metrics
+
+        with self._lock:
+            self._quarantined[rep] = (time.monotonic()
+                                      + self._cfg.cooldown_ms / 1e3)
+            self._stats["quarantines"] += 1
+        metrics.counter("serving.replica_quarantined").inc()
+        import logging
+
+        logging.warning("serving: replica %d quarantined for %.0f ms "
+                        "after %s: %s", rep, self._cfg.cooldown_ms,
+                        type(err).__name__, err)
+
+    def _probe_quarantined(self):
+        """Cooldown-expired quarantined replicas get one zero-batch
+        probe through the normal dispatch path; success re-admits them
+        into round-robin, failure restarts the cooldown. Runs on the
+        dispatcher thread between batches — background from the
+        caller's perspective, and never on the request path."""
+        import jax
+
+        now = time.monotonic()
+        with self._lock:
+            due = [rep for rep, until in self._quarantined.items()
+                   if now >= until]
+        for rep in due:
+            probe_bucket = self._cfg.buckets[0]
+            try:
+                outs = self._run_bucket(rep, probe_bucket,
+                                        self._zero_batch(probe_bucket))
+                jax.block_until_ready(outs)
+            except Exception as err:
+                self._quarantine(rep, err)
+                continue
+            from ..observability import metrics
+
+            with self._lock:
+                self._quarantined.pop(rep, None)
+                self._stats["readmitted"] += 1
+            metrics.counter("serving.replica_readmitted").inc()
+
+    def _retry_batch(self, ent):
+        """Re-execute a fetch-faulted batch on a surviving replica and
+        fetch synchronously; returns host outputs or None when no
+        replica survives (or the retry faults too)."""
+        from ..observability import metrics
+
+        rep = self._pick_replica()
+        if rep is None:
+            return None
+        with self._lock:
+            self._stats["batch_retries"] += 1
+        metrics.counter("serving.batch_retries").inc()
+        try:
+            outs = self._run_bucket(rep, ent.bucket, ent.batch)
+            # synchronous drain of the one retried batch — the failover
+            # path, not the pipelined hot path
+            return [np.asarray(o) for o in outs]  # graftlint: disable=G001
+        except Exception as err:
+            self._quarantine(rep, err)
+            return None
 
     def _run_bucket(self, replica, bucket, batch_arrays):
         """One compiled-program dispatch of a padded bucket batch."""
@@ -640,6 +828,7 @@ class InferenceServer:
         from .. import random as _random
         from ..observability import metrics
 
+        _faults.inject("serving.replica_execute", tag=replica)
         extras, aux = self._bindings(replica, bucket)
         dev = self._devices[replica]
         staged = jax.device_put(batch_arrays, dev)  # one pytree transfer
@@ -668,10 +857,16 @@ class InferenceServer:
         # already dispatched
         try:
             host = [np.asarray(o) for o in ent.outs]  # graftlint: disable=G001
-        except Exception as err:  # device failure: fail THIS batch only
-            for r in ent.reqs:
-                r.assembly.fail(err)
-            return
+        except Exception as err:
+            # device failure at fetch: quarantine the replica and retry
+            # the batch ONCE on a surviving one — inference is
+            # idempotent, so a re-execution is answer-preserving
+            self._quarantine(ent.replica, err)
+            host = None if ent.retried else self._retry_batch(ent)
+            if host is None:  # no survivor (or second fault): fail batch
+                for r in ent.reqs:
+                    r.assembly.fail(err)
+                return
         now = time.monotonic()
         offset = 0
         finished = 0
@@ -695,11 +890,14 @@ class InferenceServer:
             stopped = self._stop
         with self._lock:
             stats = dict(self._stats)
+            quarantined = sorted(self._quarantined)
         stats.update(
             queue_rows=depth,
             inflight=len(self._inflight),
             buckets=list(self._cfg.buckets),
             replicas=len(self._devices),
+            quarantined_replicas=quarantined,
+            deadline_ms=self._cfg.deadline_ms,
             max_wait_ms=self._cfg.max_wait_ms,
             running=self.running,
             stopped=stopped)

@@ -42,10 +42,18 @@ import weakref
 import numpy as np
 
 from ...config import get_flag
+from ...resilience import faults as _faults
 from ..buckets import pick_bucket
 from ..engine import QueueFullError, ServerClosedError
 from .kv_cache import PagePool
 from .sampling import SamplingParams, sample_tokens
+
+# chaos-testable injection point (resilience/faults.py): a raise here
+# is contained by the scheduler — the slots in the faulted step fail,
+# their pages free, and the loop keeps serving queued requests
+_faults.declare("generation.decode_step",
+                doc="inside one continuous-batching decode iteration, "
+                    "before the compiled step dispatches")
 
 __all__ = ["GenerationConfig", "Generator", "GenerationHandle",
            "SamplingParams", "QueueFullError", "ServerClosedError"]
@@ -86,7 +94,7 @@ class GenerationConfig:
 
     def __init__(self, page_size=None, decode_blocks=None, max_batch=None,
                  max_seq=None, pool_pages=None, prefill_buckets=None,
-                 max_queue=None, backpressure=None):
+                 max_queue=None, backpressure=None, submit_timeout_ms=None):
         import os
 
         # None = resolve in Generator: explicit > tuning cache > flag
@@ -112,6 +120,15 @@ class GenerationConfig:
         self.backpressure = (backpressure if backpressure is not None
                              else os.environ.get("MXNET_GEN_BACKPRESSURE",
                                                  "block"))
+        # 0 = block forever (legacy); >0 = a full queue that stays full
+        # this many ms raises QueueFullError instead of wedging the
+        # caller with no escape hatch
+        self.submit_timeout_ms = (get_flag("MXNET_GEN_SUBMIT_TIMEOUT")
+                                  if submit_timeout_ms is None
+                                  else float(submit_timeout_ms))
+        if self.submit_timeout_ms < 0:
+            raise ValueError("submit_timeout_ms must be >= 0 (0 = no "
+                             "timeout)")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.max_seq < 2:
@@ -472,10 +489,15 @@ class Generator:
             self._thread.start()
         return self
 
-    def stop(self, drain=True):
+    def stop(self, drain=True, timeout=None):
         """Shut down. ``drain=True`` (default) finishes every admitted
         and queued request first; ``drain=False`` fails queued AND
-        in-flight requests with :class:`ServerClosedError`."""
+        in-flight requests with :class:`ServerClosedError`.
+
+        ``timeout`` (seconds) bounds the drain: a wedged decode step
+        used to hang ``stop`` forever — past the timeout every still-
+        pending request fails with :class:`ServerClosedError` and
+        ``stop`` returns (the daemon scheduler exits if it unwedges)."""
         with self._cond:
             self._stop = True
             self._abort = not drain
@@ -483,10 +505,33 @@ class Generator:
         with self._life:
             thread, self._thread = self._thread, None
             if thread is not None:
-                thread.join()
+                thread.join(timeout)
+                if thread.is_alive():
+                    self._abandon_drain(timeout)
             elif self._queue or self._n_active:
                 self._loop()  # never started: honor the drain contract
         return self
+
+    def _abandon_drain(self, timeout):
+        """Drain timed out: unblock every caller. Slot state and pages
+        stay with the wedged scheduler thread (it aborts if it ever
+        unwedges); handles are failed best-effort — _fail is idempotent
+        so a slot the thread later finishes is a no-op race."""
+        err = ServerClosedError(
+            "stop(drain=True) timed out after %ss; remaining requests "
+            "failed" % timeout)
+        with self._cond:
+            self._abort = True
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for ent in stranded:
+            ent.handle._fail(err)
+        for seq in list(self._slots):
+            if seq is not None:
+                seq.handle._fail(err)
+        with self._lock:
+            self._stats["drain_timeouts"] += 1
 
     def __enter__(self):
         return self.start()
@@ -541,8 +586,21 @@ class Generator:
                         "MXNET_GEN_QUEUE or use backpressure='block'"
                         % len(self._queue))
             else:
+                wait_s = self._cfg.submit_timeout_ms / 1e3
+                give_up = (time.monotonic() + wait_s) if wait_s > 0 else None
                 while len(self._queue) >= self._cfg.max_queue:
-                    self._cond.wait()
+                    remaining = (None if give_up is None
+                                 else give_up - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        with self._lock:
+                            self._stats["submit_timeouts"] += 1
+                        metrics.counter("generation.submit_timeouts").inc()
+                        raise QueueFullError(
+                            "admission queue still full after %.0f ms "
+                            "(MXNET_GEN_SUBMIT_TIMEOUT); %d requests "
+                            "queued" % (self._cfg.submit_timeout_ms,
+                                        len(self._queue)))
+                    self._cond.wait(remaining)
                     if self._stop:
                         raise ServerClosedError(
                             "server stopped while submit() was blocked")
@@ -579,7 +637,15 @@ class Generator:
             if self._n_active:
                 try:
                     self._decode_once()
-                except Exception as err:  # fail the batch, not the thread
+                except Exception as err:
+                    # contain the fault to the slots in the faulted
+                    # step: fail those requests, free their pages, keep
+                    # the loop alive for queued/later traffic
+                    from ...observability import metrics
+
+                    with self._lock:
+                        self._stats["decode_faults"] += 1
+                    metrics.counter("generation.decode_faults").inc()
                     for slot, seq in enumerate(self._slots):
                         if seq is not None:
                             self._evict(slot, failed=err)
@@ -710,6 +776,7 @@ class Generator:
         from ...observability import metrics
 
         t0 = time.monotonic()
+        _faults.inject("generation.decode_step")
         for slot, seq in enumerate(self._slots):
             if seq is None:
                 continue

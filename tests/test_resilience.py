@@ -1,0 +1,604 @@
+"""Chaos suite for the fault-tolerance layer (ISSUE 8, resilience/).
+
+Every recovery path is exercised against *injected* faults
+(resilience/faults.py) with a fixed seed, so each assertion is
+deterministic: kvstore push drops converge to the fault-free weights,
+a faulted serving replica quarantines without breaking FIFO order or
+numeric parity, SIGTERM mid-fit resumes bit-exact-at-step, and corrupt
+checkpoints fall back to the previous valid one.
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import (BarrierTimeoutError, DeadlineExceeded,
+                                  InjectedDrop, InjectedFault,
+                                  PreemptedError, RetryExhaustedError,
+                                  RetryPolicy, checkpoint as ckpt,
+                                  faults, retry)
+
+pytestmark = pytest.mark.usefixtures("_clean_faults")
+
+
+@pytest.fixture
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------------- faults
+def test_fault_spec_grammar_and_registry():
+    # declared points include the wired call sites (the generation
+    # module is a lazy import — like its autotune knobs, its point
+    # appears once the subsystem loads)
+    import mxnet_tpu.serving.generation  # noqa: F401
+
+    pts = faults.points()
+    for p in ("kvstore.push", "serving.replica_execute",
+              "generation.decode_step", "checkpoint.write"):
+        assert p in pts, (p, pts)
+    # strict configure rejects undeclared points, naming the known set
+    with pytest.raises(KeyError):
+        faults.configure("no.such.point:raise")
+    with pytest.raises(ValueError):
+        faults.configure("kvstore.push:explode")
+    with pytest.raises(ValueError):
+        faults.configure("kvstore.push:drop@p=1.5")
+    # a full entry parses: tag, action param, ANDed triggers
+    faults.configure("kvstore.push[sub]:delay=5@calls=2-3,every=1")
+    assert faults.enabled()
+    faults.configure(None)
+    assert not faults.enabled()
+
+
+def test_fault_call_triggers_and_tags():
+    faults.configure("kvstore.push:raise@call=2")
+    faults.inject("kvstore.push")                      # call 1: clean
+    with pytest.raises(InjectedFault):
+        faults.inject("kvstore.push")                  # call 2: fires
+    faults.inject("kvstore.push")                      # call 3: clean
+
+    faults.configure("kvstore.push[a]:drop@calls=1-2")
+    faults.inject("kvstore.push", tag="b")             # other tag: clean
+    with pytest.raises(InjectedDrop):
+        faults.inject("kvstore.push", tag="a")
+    with pytest.raises(InjectedDrop):
+        faults.inject("kvstore.push", tag="a")
+    faults.inject("kvstore.push", tag="a")             # window passed
+    fired = faults.fired()
+    assert fired["kvstore.push[a]:drop"]["fired"] == 2
+
+
+def test_fault_probability_deterministic_under_seed():
+    def run():
+        faults.configure("kvstore.pull:raise@p=0.5", seed=42)
+        hits = []
+        for i in range(64):
+            try:
+                faults.inject("kvstore.pull")
+                hits.append(0)
+            except InjectedFault:
+                hits.append(1)
+        return hits
+
+    a, b = run(), run()
+    assert a == b                      # pure function of (spec, seed)
+    assert 10 < sum(a) < 54            # actually probabilistic
+
+
+def test_env_spec_loaded_lazily(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULTS", "kvstore.push:raise@call=1")
+    faults.reset()                     # forget prior env consult
+    with pytest.raises(InjectedFault):
+        faults.inject("kvstore.push")
+    faults.inject("kvstore.push")      # only call=1 fires
+
+
+# ---------------------------------------------------------------- retry
+def test_retry_heals_transient_then_exhausts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=4, base_delay_ms=1, jitter=0.0)
+    assert retry.call(flaky, policy=pol, name="t") == "ok"
+    assert calls["n"] == 3
+
+    def always():
+        raise ConnectionError("down")
+
+    reconnects = []
+    with pytest.raises(RetryExhaustedError) as ei:
+        retry.call(always, policy=RetryPolicy(max_attempts=3,
+                                              base_delay_ms=1, jitter=0.0),
+                   name="t2", on_retry=lambda e, a: reconnects.append(a))
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_error, ConnectionError)
+    assert reconnects == [1, 2]        # on_retry between attempts only
+
+    # non-retryable errors pass through untouched
+    with pytest.raises(ValueError):
+        retry.call(lambda: (_ for _ in ()).throw(ValueError("semantic")),
+                   policy=pol, name="t3")
+
+
+def test_retry_deadline_caps_attempts():
+    t0 = time.monotonic()
+    with pytest.raises(RetryExhaustedError) as ei:
+        retry.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                   policy=RetryPolicy(max_attempts=100, base_delay_ms=30,
+                                      deadline_ms=80, jitter=0.0),
+                   name="deadline")
+    assert ei.value.attempts < 100
+    assert time.monotonic() - t0 < 5.0
+
+
+# ----------------------------------------------- kvstore under injection
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _iter(X, y):
+    return mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False,
+                             label_name="softmax_label")
+
+
+def _fit_params(resume=None, batch_end_callback=None, num_epoch=2,
+                kvstore="local"):
+    np.random.seed(11)
+    mx.random.seed(11)
+    rng = np.random.RandomState(3)
+    X = rng.rand(32, 6).astype(np.float32)
+    y = (rng.rand(32) * 4).astype(np.float32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(X, y), num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            initializer=mx.init.Uniform(0.3), kvstore=kvstore,
+            batch_end_callback=batch_end_callback, resume=resume)
+    args, _ = mod.get_params()
+    return {k: v.asnumpy().copy() for k, v in args.items()}
+
+
+def test_kvstore_push_drops_converge_to_fault_free_weights():
+    # the chaos-proof core: an explicit KVStore routes updates through
+    # push/pull; injected drops are retried transparently, so the final
+    # weights are IDENTICAL to the fault-free run
+    clean = _fit_params(kvstore=mx.kv.create("local"))
+    faults.configure("kvstore.push:drop@every=3;kvstore.pull:drop@call=5",
+                     seed=9)
+    chaotic = _fit_params(kvstore=mx.kv.create("local"))
+    fired = faults.fired()
+    faults.reset()
+    assert fired["kvstore.push:drop"]["fired"] >= 2, fired
+    for k in clean:
+        assert np.array_equal(clean[k], chaotic[k]), k
+
+
+def test_dist_async_push_retry_through_reconnect():
+    # dist_async runs a real in-process PS server over TCP; injected
+    # drops at the push point are retried by the shared primitive
+    faults.configure("kvstore.push:drop@every=2", seed=1)
+    kv = mx.kvstore.KVStoreDistAsync()
+    try:
+        kv.init("w", mx.nd.array(np.zeros((4, 4), np.float32)))
+        for i in range(6):
+            kv.push("w", mx.nd.array(np.full((4, 4), float(i + 1),
+                                             np.float32)))
+        out = mx.nd.zeros((4, 4))
+        kv.pull("w", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), np.full((4, 4), 6.0))
+        # retried attempts re-enter the injection point, so every other
+        # ATTEMPT (not push) drops: 6 pushes -> 5 injected drops, all
+        # healed by the retry primitive
+        assert faults.fired()["kvstore.push:drop"]["fired"] >= 3
+    finally:
+        faults.reset()
+        kv.close()
+
+
+def test_rpc_drops_heal_through_real_reconnect():
+    # kvstore.rpc injects INSIDE PSClient._call's retried region, so a
+    # drop exercises the genuine transport-loss path: reconnect_shard
+    # re-establishes the socket (hello handshake) and the re-attempt
+    # lands — unlike kvstore.push drops, which heal before any socket
+    faults.configure("kvstore.rpc:drop@every=3", seed=2)
+    kv = mx.kvstore.KVStoreDistAsync()
+    try:
+        kv.init("w", mx.nd.array(np.zeros((2, 2), np.float32)))
+        for i in range(5):
+            kv.push("w", mx.nd.array(np.full((2, 2), float(i + 1),
+                                             np.float32)))
+        out = mx.nd.zeros((2, 2))
+        kv.pull("w", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), np.full((2, 2), 5.0))
+        assert faults.fired()["kvstore.rpc:drop"]["fired"] >= 2
+    finally:
+        faults.reset()
+        kv.close()
+
+
+def test_barrier_timeout_is_typed_with_dead_node_diagnostics(monkeypatch):
+    monkeypatch.setenv("MXTPU_PS_BARRIER_TIMEOUT", "0.3")
+    from mxnet_tpu.kvstore_server import PSClient, start_server_thread
+
+    server = start_server_thread()
+    client = PSClient([server.address], rank=0)
+    try:
+        with pytest.raises(BarrierTimeoutError) as ei:
+            client.call0(("barrier", 2))   # 2 workers, only 1 arrives
+        diag = ei.value.diagnostics
+        assert diag["num_workers"] == 2 and diag["arrived"] == 1
+        assert "worker_age_s" in diag and "dead_nodes" in diag
+    finally:
+        client.close()
+        server.stop()
+
+
+# ----------------------------------------------------- serving failover
+def _serving_setup():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 6).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    args = {"fc_weight": mx.nd.array(w), "fc_bias": mx.nd.array(b)}
+
+    def ref(x):
+        logits = x @ w.T + b
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc"),
+        name="softmax")
+    return net, args, ref
+
+
+def test_quarantined_replica_preserves_fifo_and_parity():
+    import jax
+
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    net, args, ref = _serving_setup()
+    faults.configure("serving.replica_execute[1]:raise@calls=1-2", seed=0)
+    srv = InferenceServer(
+        net, args, data_shapes=[("data", (1, 6))],
+        devices=jax.devices()[:2],
+        config=ServingConfig(buckets=(1, 2, 4), max_wait_ms=1,
+                             cooldown_ms=150))
+    rng = np.random.RandomState(5)
+    xs = [rng.rand(1 + i % 3, 6).astype(np.float32) for i in range(12)]
+    order = []
+    futs = []
+    for i, x in enumerate(xs):
+        f = srv.submit(x)
+        f.add_done_callback(lambda _f, _i=i: order.append(_i))
+        futs.append(f)
+    for x, f in zip(xs, futs):
+        np.testing.assert_allclose(f.result(timeout=60), ref(x), atol=1e-4)
+    assert order == sorted(order)      # FIFO survived the failover
+    stats = srv.get_stats()
+    assert stats["quarantines"] >= 1
+    assert stats.get("batch_retries", 0) >= 1
+    # cooldown passes -> traffic-driven probe re-admits the replica
+    time.sleep(0.25)
+    for _ in range(4):
+        srv.submit(xs[0]).result(timeout=60)
+    time.sleep(0.25)
+    for _ in range(4):
+        srv.submit(xs[0]).result(timeout=60)
+    stats = srv.get_stats()
+    srv.stop()
+    assert stats.get("readmitted", 0) >= 1
+    assert stats["quarantined_replicas"] == []
+
+
+def test_serving_deadline_rejects_expired_before_dispatch():
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    net, args, ref = _serving_setup()
+    srv = InferenceServer(
+        net, args, data_shapes=[("data", (1, 6))], start=False,
+        config=ServingConfig(buckets=(1, 2, 4), max_wait_ms=1,
+                             deadline_ms=40))
+    stale = srv.submit(np.ones((1, 6), np.float32))
+    time.sleep(0.12)                   # expires while the queue sits
+    fresh_x = np.full((2, 6), 0.5, np.float32)
+    fresh = srv.submit(fresh_x)
+    srv.start()
+    with pytest.raises(DeadlineExceeded):
+        stale.result(timeout=30)
+    # the fresh request (same batch window) still serves correctly
+    np.testing.assert_allclose(fresh.result(timeout=30), ref(fresh_x),
+                               atol=1e-4)
+    stats = srv.get_stats()
+    srv.stop()
+    assert stats["expired"] == 1
+
+
+def test_serving_stop_drain_timeout_unsticks():
+    from mxnet_tpu.serving import InferenceServer, ServerClosedError, \
+        ServingConfig
+
+    net, args, _ref = _serving_setup()
+    faults.configure("serving.replica_execute:delay=3000", seed=0)
+    srv = InferenceServer(
+        net, args, data_shapes=[("data", (1, 6))],
+        config=ServingConfig(buckets=(1, 2), max_wait_ms=1))
+    futs = [srv.submit(np.ones((1, 6), np.float32)) for _ in range(3)]
+    t0 = time.monotonic()
+    srv.stop(drain=True, timeout=0.4)
+    assert time.monotonic() - t0 < 2.5  # did not wait out the 3s wedge
+    for f in futs:
+        with pytest.raises((ServerClosedError, Exception)):
+            f.result(timeout=1)
+    assert srv.get_stats()["drain_timeouts"] == 1
+
+
+# -------------------------------------------------- generation failover
+def _generator(**cfg_kw):
+    import jax
+
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+    from mxnet_tpu.serving.generation import GenerationConfig, Generator
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tp = TransformerParallel(mesh, vocab=64, d_model=32, n_heads=4,
+                             n_layers=1, d_ff=64, n_experts=1,
+                             dtype=np.dtype("float32"))
+    cfg_kw.setdefault("max_batch", 2)
+    cfg_kw.setdefault("max_seq", 64)
+    start = cfg_kw.pop("_start", True)
+    return Generator(tp, tp.init(0), config=GenerationConfig(**cfg_kw),
+                     start=start)
+
+
+def test_generation_decode_fault_contained_to_step():
+    from mxnet_tpu.serving.generation import SamplingParams
+
+    faults.configure("generation.decode_step:raise@call=2", seed=0)
+    gen = _generator()
+    h1 = gen.submit([1, 2, 3], SamplingParams(max_new_tokens=8, seed=1))
+    with pytest.raises(InjectedFault):
+        h1.result(timeout=60)
+    # the loop survived: later requests decode normally, pages freed
+    h2 = gen.submit([4, 5], SamplingParams(max_new_tokens=4, seed=2))
+    toks = h2.result(timeout=60)
+    assert len(toks) >= 1 and all(np.isfinite(t) for t in toks)
+    stats = gen.get_stats()
+    gen.stop()
+    assert stats["decode_faults"] == 1
+    assert gen.pool.get_stats()["used"] == 0   # zero leaked KV pages
+
+
+def test_generation_submit_timeout_escapes_full_queue():
+    from mxnet_tpu.serving.generation import QueueFullError, SamplingParams
+
+    gen = _generator(max_queue=1, submit_timeout_ms=120, _start=False)
+    gen.submit([1, 2], SamplingParams(max_new_tokens=2))  # fills queue
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        gen.submit([3, 4], SamplingParams(max_new_tokens=2))
+    assert 0.05 < time.monotonic() - t0 < 5.0
+    gen.stop(drain=False)
+
+
+def test_generation_stop_drain_timeout_unsticks():
+    from mxnet_tpu.serving.generation import SamplingParams, \
+        ServerClosedError
+
+    faults.configure("generation.decode_step:delay=3000", seed=0)
+    gen = _generator()
+    h = gen.submit([1, 2, 3], SamplingParams(max_new_tokens=8, seed=1))
+    time.sleep(0.2)                    # let the scheduler wedge
+    t0 = time.monotonic()
+    gen.stop(drain=True, timeout=0.4)
+    assert time.monotonic() - t0 < 2.5
+    with pytest.raises(Exception):
+        h.result(timeout=1)
+    assert gen.get_stats()["drain_timeouts"] == 1
+
+
+# --------------------------------------------- preemption-safe training
+def test_sigterm_mid_fit_resumes_bit_exact(tmp_path):
+    full = _fit_params(num_epoch=3)
+
+    count = [0]
+
+    def preempt(param):
+        count[0] += 1
+        if count[0] == 5:              # epoch 1, batch 1
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(PreemptedError) as ei:
+        _fit_params(num_epoch=3, resume=str(tmp_path),
+                    batch_end_callback=preempt)
+    assert "ckpt-" in ei.value.checkpoint_path
+    state = ckpt.load_latest(str(tmp_path))
+    assert (state.epoch, state.batch, state.step) == (1, 1, 5)
+
+    # the resumed run ignores ambient seeds (RNG rides the checkpoint)
+    np.random.seed(12345)
+    resumed = _fit_params(num_epoch=3, resume=str(tmp_path))
+    for k in full:
+        assert np.array_equal(full[k], resumed[k]), k
+        assert np.isfinite(resumed[k]).all()
+
+
+def test_corrupt_manifest_falls_back_to_previous(tmp_path):
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(3)
+    X = rng.rand(16, 6).astype(np.float32)
+    y = (rng.rand(16) * 4).astype(np.float32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(X, y), num_epoch=1, optimizer="sgd",
+            initializer=mx.init.Uniform(0.3))
+
+    # corrupt latest manifest -> previous wins (prune keeps exactly one
+    # fallback, so each scenario gets its own directory)
+    d1 = str(tmp_path / "manifest")
+    good = mod.save_resumable(d1, epoch=0, batch=2, step=2)
+    bad = mod.save_resumable(d1, epoch=1, batch=0, step=4)
+    with open(os.path.join(bad, "MANIFEST.json"), "w") as f:
+        f.write("{not json")
+    state = ckpt.load_latest(d1)
+    assert state.step == 2 and state.path == good
+
+    # checksum mismatch (tampered params) is also rejected
+    d2 = str(tmp_path / "checksum")
+    mod.save_resumable(d2, epoch=0, batch=2, step=2)
+    bad2 = mod.save_resumable(d2, epoch=1, batch=0, step=6)
+    with open(os.path.join(bad2, "params.ndarray"), "ab") as f:
+        f.write(b"garbage")
+    assert ckpt.load_latest(d2).step == 2
+
+    # a fault during write (before the manifest) leaves an invisible dir
+    d3 = str(tmp_path / "faulted")
+    mod.save_resumable(d3, epoch=0, batch=2, step=2)
+    faults.configure("checkpoint.write:raise@call=1")
+    with pytest.raises(InjectedFault):
+        mod.save_resumable(d3, epoch=2, batch=0, step=8)
+    faults.reset()
+    assert ckpt.load_latest(d3).step == 2
+
+    # nothing valid at all -> None (fresh start, not a crash)
+    assert ckpt.load_latest(str(tmp_path / "empty")) is None
+
+    # prune must never count invalid (crashed-write) dirs toward its
+    # quota: two manifest-less higher-step leftovers + a fresh write
+    # keep the valid pair and reclaim the garbage
+    d4 = str(tmp_path / "prune")
+    mod.save_resumable(d4, epoch=0, batch=1, step=1)
+    os.makedirs(os.path.join(d4, "ckpt-00000025"))
+    os.makedirs(os.path.join(d4, "ckpt-00000030"))
+    mod.save_resumable(d4, epoch=0, batch=2, step=2)  # prune runs here
+    assert ckpt.load_latest(d4).step == 2
+    assert sorted(os.listdir(d4)) == ["ckpt-00000001", "ckpt-00000002"]
+
+
+def test_kill_term_subprocess_then_resume_reaches_step_count(tmp_path):
+    """kill -TERM a real training process mid-fit; resume in a second
+    process and verify it finishes the full step count with finite
+    params — the satellite's end-to-end preemption drill."""
+    script = textwrap.dedent("""
+        import json, os, sys, time
+        import numpy as np
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import mxnet_tpu as mx
+        from mxnet_tpu.resilience import PreemptedError
+
+        ckpt_dir, out_path, slow = sys.argv[1], sys.argv[2], sys.argv[3]
+        np.random.seed(5); mx.random.seed(5)
+        rng = np.random.RandomState(3)
+        X = rng.rand(64, 6).astype(np.float32)
+        y = (rng.rand(64) * 4).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False,
+                               label_name="softmax_label")
+        data = mx.sym.Variable("data")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(data, num_hidden=4, name="fc"),
+            name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        steps = [0]
+        def cb(param):
+            steps[0] += 1
+            print("STEP %d" % steps[0], flush=True)
+            if slow == "1":
+                time.sleep(0.15)
+        try:
+            mod.fit(it, num_epoch=4, optimizer="sgd",
+                    optimizer_params=(("learning_rate", 0.1),),
+                    initializer=mx.init.Uniform(0.3),
+                    batch_end_callback=cb, resume=ckpt_dir)
+        except PreemptedError:
+            sys.exit(43)
+        args, _ = mod.get_params()
+        finite = all(bool(np.isfinite(v.asnumpy()).all())
+                     for v in args.values())
+        with open(out_path, "w") as f:
+            json.dump({"steps": steps[0], "finite": finite}, f)
+    """)
+    script_path = tmp_path / "train.py"
+    script_path.write_text(script)
+    ckpt_dir = str(tmp_path / "ckpts")
+    out_path = str(tmp_path / "out.json")
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+
+    proc = subprocess.Popen(
+        [sys.executable, str(script_path), ckpt_dir, out_path, "1"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    # wait for a few steps, then preempt
+    seen = 0
+    for line in proc.stdout:
+        if line.startswith("STEP"):
+            seen += 1
+            if seen == 3:
+                proc.send_signal(signal.SIGTERM)
+                break
+    proc.stdout.read()
+    assert proc.wait(timeout=120) == 43   # PreemptedError exit
+    state = ckpt.load_latest(ckpt_dir)
+    assert state is not None and state.step >= 3
+
+    # resume (fast mode) runs to completion
+    rc = subprocess.run(
+        [sys.executable, str(script_path), ckpt_dir, out_path, "0"],
+        timeout=300, env=env)
+    assert rc.returncode == 0
+    import json
+
+    result = json.load(open(out_path))
+    # 4 epochs x 8 batches, minus the steps the first process completed
+    assert result["steps"] == 32 - state.step
+    assert result["finite"]
+
+
+def test_flight_recorder_dumps_on_sigterm(tmp_path):
+    """Preemption of a plain (unguarded) process still leaves a dump:
+    the recorder's chained SIGTERM handler fires before the default
+    handler kills the process."""
+    script = textwrap.dedent("""
+        import os, signal, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from mxnet_tpu.observability import flight_recorder
+        flight_recorder.configure(dump_dir=sys.argv[1])
+        flight_recorder.install()
+        flight_recorder.record({"step": 1, "loss": 0.5})
+        os.kill(os.getpid(), signal.SIGTERM)
+        print("UNREACHABLE")
+    """)
+    script_path = tmp_path / "victim.py"
+    script_path.write_text(script)
+    dump_dir = tmp_path / "dumps"
+    proc = subprocess.run(
+        [sys.executable, str(script_path), str(dump_dir)],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ,
+                 PYTHONPATH=os.path.dirname(os.path.dirname(
+                     os.path.abspath(__file__)))))
+    assert proc.returncode == -signal.SIGTERM   # died BY the signal
+    assert "UNREACHABLE" not in proc.stdout
+    dumps = list(dump_dir.glob("health_dump_*.json"))
+    assert dumps, "no dump written on SIGTERM"
+    import json
+
+    payload = json.load(open(dumps[0]))
+    assert payload["reason"].startswith("signal:SIGTERM")
+    assert any(r.get("step") == 1 for r in payload["records"])
