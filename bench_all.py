@@ -1300,6 +1300,91 @@ def bench_autotune(gate_pct=None):
     return results
 
 
+def bench_graph_passes():
+    """--graph-passes: optimized-vs-unoptimized inference on the bench
+    resnet-style model (ISSUE 9 acceptance): the default pass pipeline
+    must reduce compiled-program node count, and measured inference
+    latency/throughput for both arms is recorded into BENCH_ALL.json
+    (CPU QUICK now, on-chip numbers next bench pass)."""
+    import time as _time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import graph_pass
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.models import get_resnet
+
+    rng = np.random.RandomState(0)
+    layers, size, bs = (18, 32, 4) if QUICK else (50, 224, 16)
+    steps = 10 if QUICK else 50
+    x = rng.rand(bs, 3, size, size).astype(np.float32)
+
+    def build(spec):
+        graph_pass.set_passes(spec)
+        try:
+            sym = get_resnet(num_classes=1000, num_layers=layers,
+                             image_shape=(3, size, size))
+            mod = mx.mod.Module(sym, context=mx.gpu()
+                                if mx.context.num_gpus() else mx.cpu())
+            mod.bind(data_shapes=[("data", x.shape)], for_training=False)
+            mod.init_params(mx.init.Xavier())
+            return mod
+        finally:
+            graph_pass.set_passes(None)
+
+    def run(mod):
+        it = lambda: NDArrayIter(x, None, batch_size=bs)  # noqa: E731
+        mod.predict(it())  # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            mod.predict(it())
+        return (_time.perf_counter() - t0) / steps
+
+    base = build("off")
+    base_s = run(base)
+    opt = build("default")
+    opt_s = run(opt)
+    ex = opt._exec_group.execs[0]
+    info = ex._opt.summary() if ex._opt is not None else {}
+    results = {
+        "protocol": "resnet%d %dx%d bs%d predict, %d timed iters" % (
+            layers, size, size, bs, steps),
+        "unoptimized_ms": round(base_s * 1e3, 2),
+        "optimized_ms": round(opt_s * 1e3, 2),
+        "speedup": round(base_s / opt_s, 3),
+        "images_per_s": {"unoptimized": round(bs / base_s, 1),
+                         "optimized": round(bs / opt_s, 1)},
+        "nodes_before": info.get("nodes_before"),
+        "nodes_after": info.get("nodes_after"),
+        "folded_constants": info.get("folded_constants"),
+        "passes": info.get("passes"),
+        "quick": QUICK,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_ALL.json")
+    try:
+        with open(out_path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["graph_passes"] = results
+    tmp = out_path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps({"graph_passes": results}))
+    if not info or info["nodes_after"] >= info["nodes_before"]:
+        raise SystemExit(
+            "bench_all --graph-passes: no node-count reduction (%s -> %s)"
+            % (info.get("nodes_before"), info.get("nodes_after")))
+    print("[bench_all] graph passes: %d -> %d nodes, %.2f ms -> %.2f ms "
+          "(%.3fx)" % (results["nodes_before"], results["nodes_after"],
+                       results["unoptimized_ms"], results["optimized_ms"],
+                       results["speedup"]), file=sys.stderr)
+    return results
+
+
 def assert_lint_clean():
     """--lint-clean: graftlint must exit 0 against the committed baseline.
 
@@ -1374,5 +1459,10 @@ if __name__ == "__main__":
         # the warm-cache (<1%/step) overhead gate (docs/autotune.md);
         # merges an "autotune" section into BENCH_ALL.json
         bench_autotune()
+    elif "--graph-passes" in sys.argv[1:]:
+        # optimized-vs-unoptimized inference under the default pass
+        # pipeline (node-count reduction is a hard gate; latency is
+        # recorded); merges a "graph_passes" section into BENCH_ALL.json
+        bench_graph_passes()
     else:
         main(telemetry="--telemetry" in sys.argv[1:])

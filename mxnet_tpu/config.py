@@ -177,6 +177,20 @@ Flags currently honored:
     size, never by traffic. String-valued, env-only (pass
     ``prefill_buckets=`` to GenerationConfig to override at runtime).
 
+``MXNET_GRAPH_PASSES`` (default ``default``)
+    Bind-time graph-optimization pipeline (graph_pass/,
+    docs/graph_passes.md): ``default`` runs the numerically exact
+    passes — inference loss-head simplification + dead-node pruning,
+    BatchNorm→conv/FC folding, the autotuner-consulting layout rewrite,
+    and constant folding of frozen-parameter subgraphs; ``all``
+    additionally enables the opt-in bf16 ``amp`` rewrite (fp32 islands
+    for softmax/norm/loss); ``off`` disables the layer; ``-<pass>``
+    drops one pass; ``layout=NHWC`` forces the layout target. Grammar
+    in docs/graph_passes.md. String-valued and read by graph_pass
+    straight from the environment (runtime override:
+    ``graph_pass.set_passes``) — like MXNET_HEALTH, NOT routed through
+    the integer get_flag machinery.
+
 ``MXNET_TUNE`` (default 0)
     Autotuner mode (autotune/, docs/autotune.md): ``0`` consults the
     persistent tuning cache at the wired call sites (flash-attention

@@ -72,7 +72,30 @@ def _maybe_mirror(loss_fn, mirror=None):
         return jax.checkpoint(loss_fn)
     return loss_fn
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "resolve_output_indices"]
+
+
+def resolve_output_indices(names, outputs):
+    """Map requested output heads — indices, exact output names, or bare
+    node names (``_output`` suffix optional) — onto positions in
+    ``names``. Shared by Executor.select_outputs and the Module-level
+    ``predict(outputs=...)`` plumbing so the resolution rules can never
+    drift."""
+    sel = []
+    for o in outputs:
+        if isinstance(o, int):
+            if not 0 <= o < len(names):
+                raise ValueError("outputs: index %d out of range (%d "
+                                 "outputs)" % (o, len(names)))
+            sel.append(o)
+        elif o in names:
+            sel.append(names.index(o))
+        elif o + "_output" in names:
+            sel.append(names.index(o + "_output"))
+        else:
+            raise ValueError("outputs: %r is not an output (outputs: %s)"
+                             % (o, list(names)))
+    return sel
 
 
 class _GraphProgram:
@@ -80,7 +103,11 @@ class _GraphProgram:
 
     _INIT_OPS = ("_zeros", "_ones", "_full")
 
-    def __init__(self, symbol):
+    def __init__(self, symbol, tuning_key=None):
+        # ``tuning_key`` pins the fingerprint when ``symbol`` is a
+        # pass-rewritten graph: autotune entries (exec.remat,
+        # serving.buckets) are keyed by the ORIGINAL graph so tuned
+        # decisions keep resolving under any pass config
         self.symbol = symbol
         self.topo = [n for n in symbol.topo_nodes() if not n.is_variable]
         self.rng_nodes = [n for n in self.topo
@@ -96,7 +123,8 @@ class _GraphProgram:
             if n.op in self._INIT_OPS
             and 0 in tuple(n.parsed_attrs().get("shape", ()))]
         self._init_shape_cache = {}
-        self._tuning_key = None
+        self._sel_topo = {}
+        self._tuning_key = tuning_key
         import threading
 
         self._jit_cache = {}  # guarded-by: self._jit_lock
@@ -108,18 +136,37 @@ class _GraphProgram:
         (num_hidden, kernel, ... — so same-topology models of different
         widths never collide on a tuned decision). Bound input shapes
         are deliberately not part of it; where they matter they ride in
-        the shape-bucket part of the cache key."""
+        the shape-bucket part of the cache key. (Shared construction
+        with graph_pass.graph_fingerprint — one fingerprint language
+        across the tuner and the pass layer.)"""
         if self._tuning_key is None:
-            import hashlib
+            from .graph_pass import graph_fingerprint
 
-            sig = ";".join(
-                "%s{%s}" % (n.op, ",".join(
-                    "%s=%s" % (k, n.attrs[k]) for k in sorted(n.attrs)))
-                for n in self.topo)
-            self._tuning_key = "g%d-%s" % (
-                len(self.topo),
-                hashlib.sha1(sig.encode()).hexdigest()[:12])
+            self._tuning_key = graph_fingerprint(self.symbol)
         return self._tuning_key
+
+    def topo_for(self, sel):
+        """(topo subset, output entries) for a selection of output
+        indices — the dead-output-pruned walk behind ``predict(
+        outputs=...)``. Memoized per selection."""
+        if sel is None:
+            return self.topo, self.symbol._outputs
+        key = tuple(sel)
+        cached = self._sel_topo.get(key)
+        if cached is not None:
+            return cached
+        entries = [self.symbol._outputs[i] for i in key]
+        reachable = set()
+        stack = [n for n, _ in entries]
+        while stack:
+            node = stack.pop()
+            if id(node) in reachable:
+                continue
+            reachable.add(id(node))
+            stack.extend(src for src, _ in node.inputs)
+        topo = [n for n in self.topo if id(n) in reachable]
+        self._sel_topo[key] = (topo, entries)  # graftlint: disable=G003 — host-side memo of a graph walk
+        return topo, entries
 
     def remat_mirror(self):
         """Remat decision for this graph's fused train program: a tuned
@@ -185,7 +232,7 @@ class _GraphProgram:
 
     # --- raw graph evaluation (traced under jit) --------------------------
     def _eval(self, arg_d, aux_d, rngs, is_train, callback=None,
-              ctx_map=None):
+              ctx_map=None, sel=None):
         """Walk the graph once. With ``callback`` (only ever passed from
         the eager monitor path), fire ``callback(entry_name, value)`` per
         node output — the reference's per-node monitor hook
@@ -201,6 +248,7 @@ class _GraphProgram:
         if self._deferred_init_nodes:
             overrides = self._resolve_init_shapes(
                 {k: tuple(v.shape) for k, v in arg_d.items()})
+        topo, out_entries = self.topo_for(sel)
 
         def get_entry(e):
             n, i = e
@@ -210,7 +258,7 @@ class _GraphProgram:
                 return aux_d[n.name]
             return env[(id(n), i)]
 
-        for node in self.topo:
+        for node in topo:
             opdef = node.opdef()
             attrs = node.parsed_attrs()
             if id(node) in overrides:
@@ -244,22 +292,27 @@ class _GraphProgram:
                 src, _ = e
                 if src.is_variable:
                     aux_updates[src.name] = nv
-        outputs = tuple(get_entry(e) for e in self.symbol._outputs)
+        outputs = tuple(get_entry(e) for e in out_entries)
         return outputs, aux_updates
 
     # --- compiled entry points --------------------------------------------
-    def infer_fn(self):
+    def infer_fn(self, sel=None):
         # locked check-then-set: concurrent callers (serving warmup vs
         # its dispatcher thread) must share ONE jit wrapper, or the same
-        # bucket shape compiles twice
+        # bucket shape compiles twice. ``sel`` (a tuple of output
+        # indices) builds a dead-output-pruned program — the compiled
+        # form of ``predict(outputs=...)``; each selection caches its
+        # own program.
+        key = "infer" if sel is None else ("infer", tuple(sel))
         with self._jit_lock:
-            if "infer" not in self._jit_cache:
-                def f(arg_d, aux_d, rngs):
-                    outs, _ = self._eval(arg_d, aux_d, rngs, False)
+            if key not in self._jit_cache:
+                def f(arg_d, aux_d, rngs, _sel=sel):
+                    outs, _ = self._eval(arg_d, aux_d, rngs, False,
+                                         sel=_sel)
                     return outs
 
-                self._jit_cache["infer"] = _maybe_jit(f)
-            return self._jit_cache["infer"]
+                self._jit_cache[key] = _maybe_jit(f)
+            return self._jit_cache[key]
 
     def train_fn(self, grad_names):
         """One fused program: outputs + aux updates + grads w.r.t. grad_names."""
@@ -290,23 +343,61 @@ class Executor:
     """Bound executor (reference: include/mxnet/executor.h:53, executor.py)."""
 
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 shared_exec=None, group2ctx=None):
+                 shared_exec=None, group2ctx=None, frozen_params=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
-        self._prog = (shared_exec._prog if shared_exec is not None
-                      and shared_exec._symbol is symbol else _GraphProgram(symbol))
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self.grad_req = dict(grad_req)
+        self.aux_dict = dict(aux_states or {})
+        self._output_names = symbol.list_outputs()
+        self._orig_arg_names = symbol.list_arguments()
+        self._orig_aux_names = symbol.list_auxiliary_states()
+        self._out_sel = None
+        self._param_version = 0
+        self._fold_vals = {}
+        self._fold_version = -1
+        if shared_exec is not None and shared_exec._symbol is symbol:
+            # re-bind (reshape / bucket switch): the compiled-program
+            # cache AND the bind-time pass results ride across — a
+            # shape seen before never re-runs the pipeline or re-folds
+            self._prog = shared_exec._prog
+            self._opt = shared_exec._opt
+            self._train_prog = shared_exec._train_prog
+            self._fold_vals = dict(shared_exec._fold_vals)
+            self._fold_version = shared_exec._fold_version
+            self._param_version = shared_exec._param_version
+        else:
+            # model-parallel graphs run eagerly node-by-node; keep them
+            # off the pass layer (ctx_group placement must see the
+            # user's own nodes)
+            self._opt = (self._run_graph_passes(symbol, frozen_params)
+                         if group2ctx is None else None)
+            self._prog = (_GraphProgram(self._opt.symbol,
+                                        tuning_key=self._opt.graph_key)
+                          if self._opt is not None
+                          else _GraphProgram(symbol))
+            # inference-only rewrites (pruned loss heads, folded BN,
+            # dropped Dropout) must not leak into an explicit
+            # forward(is_train=True) on this executor — that path gets
+            # a lazily-built program over the ORIGINAL graph
+            self._train_prog = (self._prog if self._opt is None
+                                or self._opt.for_training else None)
+        self._fold_names = (self._opt.fold_names if self._opt is not None
+                            else frozenset())
         # model parallelism: ctx_group attrs -> devices (reference:
         # group2ctx through AssignContext, graph_executor.cc:317-421)
         self._group2ctx = group2ctx
         self._ctx_map = (self._prog.assign_contexts(group2ctx, self._ctx)
                          if group2ctx else None)
-        self.arg_dict = dict(args)
-        self.grad_dict = dict(args_grad or {})
-        self.grad_req = dict(grad_req)
-        self.aux_dict = dict(aux_states or {})
-        self._arg_names = self._prog.arg_names
+        self._arg_names = [n for n in self._prog.arg_names
+                           if n not in self._fold_names]
         self._aux_names = self._prog.aux_names
-        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        # an argument may live in aux_dict: bn_fold retires a BatchNorm,
+        # so its moving stats feed plain arithmetic (arg slots) while
+        # the bound arrays still sit in the aux dict
+        missing = [n for n in self._arg_names
+                   if n not in self.arg_dict and n not in self.aux_dict]
         if missing:
             raise MXNetError("bind: missing arguments %s" % missing)
         self.outputs = []
@@ -316,28 +407,117 @@ class Executor:
         self._monitor_jit_cache = {}
         self._health_steps = 0
 
+    def _run_graph_passes(self, symbol, frozen_params):
+        """Bind-time pass pipeline (graph_pass package): returns the
+        OptimizedGraph, or None when the layer is off / nothing changed
+        (the program then lowers the original symbol object, keeping
+        graph fingerprints — and tuning-cache keys — stable)."""
+        from . import graph_pass
+
+        cfg = graph_pass.PassConfig()
+        if not cfg.enabled:
+            return None
+        inference = not any(req != "null"
+                            for req in self.grad_req.values())
+        frozen = set(frozen_params or ())
+        if inference:
+            # aux states cannot be fed through forward() and are not
+            # mutated by an inference program — always freezable there
+            frozen.update(self.aux_dict)
+        shapes = {n: tuple(v.shape) for n, v in self.arg_dict.items()}
+        shapes.update((n, tuple(v.shape)) for n, v in self.aux_dict.items())
+        dtypes = {n: v.dtype for n, v in self.arg_dict.items()}
+        dtypes.update((n, v.dtype) for n, v in self.aux_dict.items())
+        return graph_pass.optimize_for_bind(
+            symbol, for_training=not inference, frozen=frozen,
+            arg_shapes=shapes, arg_dtypes=dtypes, config=cfg)
+
     # --- properties mirroring the reference -------------------------------
+    # the public array views follow the ORIGINAL symbol's argument/aux
+    # lists (reference API), independent of what the pass layer pruned,
+    # folded, or re-classified in the compiled program
     @property
     def arg_arrays(self):
-        return [self.arg_dict[n] for n in self._arg_names]
+        return [self.arg_dict[n] for n in self._orig_arg_names]
 
     @property
     def grad_arrays(self):
-        return [self.grad_dict.get(n) for n in self._arg_names]
+        return [self.grad_dict.get(n) for n in self._orig_arg_names]
 
     @property
     def aux_arrays(self):
-        return [self.aux_dict[n] for n in self._aux_names]
+        return [self.aux_dict[n] for n in self._orig_aux_names]
 
     @property
     def output_dict(self):
-        return dict(zip(self._symbol.list_outputs(), self.outputs))
+        return dict(zip(self.current_output_names, self.outputs))
+
+    @property
+    def current_output_names(self):
+        """Output names as currently produced (honors select_outputs)."""
+        if self._out_sel is None:
+            return self._output_names
+        return [self._output_names[i] for i in self._out_sel]
 
     # --- execution ----------------------------------------------------------
-    def _rng_keys(self):
+    def select_outputs(self, outputs):
+        """Restrict inference forwards to a subset of the graph's heads
+        (by name or index; None restores all). The compiled program is
+        dead-output-pruned to the selection's ancestors — the executor
+        half of ``predict(outputs=...)``; training forwards ignore it."""
+        if outputs is None:
+            self._out_sel = None
+            return
+        self._out_sel = tuple(
+            resolve_output_indices(self._output_names, outputs))
+
+    def _train_program(self):
+        """The program train-mode forwards run: the bound program when no
+        inference-only rewrite happened, else a lazily-built program over
+        the ORIGINAL graph (a grad_req='null' executor may still be asked
+        to forward(is_train=True) — reference semantics — and must see
+        dropout/loss heads/BN train behavior unrewritten)."""
+        if self._train_prog is None:
+            self._train_prog = _GraphProgram(self._symbol)
+        return self._train_prog
+
+    def _arg_datas(self, prog=None):
+        """Program argument feed: bound arrays (args may live in the aux
+        dict after bn_fold) plus the fold-pass constants, re-evaluated
+        only when the parameter version has bumped."""
+        if prog is None:
+            prog = self._prog
+        folded = self._folded() if prog is self._prog else {}
+        d = {}
+        for n in prog.arg_names:
+            if n in folded:
+                continue
+            arr = self.arg_dict.get(n)
+            if arr is None:
+                arr = self.aux_dict[n]
+            d[n] = arr._data
+        d.update(folded)
+        return d
+
+    def _folded(self):
+        if self._opt is None or not self._opt.fold_exprs:
+            return {}
+        if self._fold_version != self._param_version:
+            values = {}
+            for n in self._opt.fold_inputs:
+                arr = self.arg_dict.get(n)
+                if arr is None:
+                    arr = self.aux_dict[n]
+                values[n] = arr._data
+            self._fold_vals = self._opt.fold(values)
+            self._fold_version = self._param_version
+        return self._fold_vals
+
+    def _rng_keys(self, prog=None):
         from . import random as _random
 
-        return tuple(_random.next_key() for _ in self._prog.rng_nodes)
+        prog = prog if prog is not None else self._prog
+        return tuple(_random.next_key() for _ in prog.rng_nodes)
 
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference: GraphExecutor::Forward, graph_executor.cc:81).
@@ -352,10 +532,18 @@ class Executor:
                 raise MXNetError("unknown argument %r in forward" % k)
             self.arg_dict[k]._set_data(
                 v._data.astype(self.arg_dict[k]._data.dtype))
+            if self._opt is not None and k in self._opt.fold_input_set:
+                # a "frozen" argument just changed through the reference
+                # forward-kwargs path: invalidate the folded constants so
+                # the new value takes effect (reference semantics)
+                self._param_version += 1
 
-        arg_d = {n: self.arg_dict[n]._data for n in self._arg_names}
-        aux_d = {n: self.aux_dict[n]._data for n in self._aux_names}
-        rngs = self._rng_keys()
+        # train-mode forwards on an inference-optimized executor use the
+        # unrewritten program (see _train_program)
+        prog = self._train_program() if is_train else self._prog
+        arg_d = self._arg_datas(prog)
+        aux_d = {n: self.aux_dict[n]._data for n in prog.aux_names}
+        rngs = self._rng_keys(prog)
 
         if self._monitor_callback is not None:
             # per-node spy pass: fire the callback for every node output
@@ -376,13 +564,17 @@ class Executor:
                 # forward() returns, so drain the effects queue here
                 jax.effects_barrier()
             else:
-                outs, aux_upd = self._prog._eval(
+                outs, aux_upd = prog._eval(
                     arg_d, aux_d, rngs, is_train, ctx_map=self._ctx_map,
                     callback=lambda name, v: self._monitor_callback(
                         name, _from_data(v)))
             if not is_train:
                 for n, nv in aux_upd.items():
                     self.aux_dict[n]._set_data(nv)
+                if self._out_sel is not None:
+                    # the monitored spy pass runs the full graph; honor
+                    # the output selection on the way out
+                    outs = [outs[i] for i in self._out_sel]
                 self.outputs = [_from_data(o) for o in outs]
                 self._stashed_grads = None
                 return self.outputs
@@ -402,19 +594,17 @@ class Executor:
         t0 = _profiler._now_us() if (profiled or telemetry) else 0
 
         if not is_train:
-            outs = self._prog.infer_fn()(arg_d, aux_d, rngs)
+            outs = self._prog.infer_fn(self._out_sel)(arg_d, aux_d, rngs)
             self._stashed_grads = None
         else:
-            import jax.numpy as jnp
-
-            grad_names = tuple(n for n in self._arg_names
+            grad_names = tuple(n for n in prog.arg_names
                                if self.grad_req.get(n, "null") != "null")
             nograd_d = {n: v for n, v in arg_d.items() if n not in grad_names}
             grad_d = {n: arg_d[n] for n in grad_names}
             # seed ones: loss heads ignore it (custom_vjp); matches MXNet's
             # backward()-without-head-grads convention
-            seeds = self._ones_seeds(arg_d, aux_d, rngs)
-            outs, aux_upd, grads = self._prog.train_fn(grad_names)(
+            seeds = self._ones_seeds(arg_d, aux_d, rngs, prog)
+            outs, aux_upd, grads = prog.train_fn(grad_names)(
                 nograd_d, grad_d, aux_d, rngs, seeds)
             for n, nv in aux_upd.items():
                 self.aux_dict[n]._set_data(nv)
@@ -453,6 +643,8 @@ class Executor:
         if not is_train:
             outs, _ = prog._eval(arg_d, aux_d, rngs, False,
                                  ctx_map=self._ctx_map)
+            if self._out_sel is not None:  # eager path: slice post-hoc
+                outs = [outs[i] for i in self._out_sel]
             self._stashed_grads = None
             self.outputs = [_from_data(o) for o in outs]
             return self.outputs
@@ -480,16 +672,17 @@ class Executor:
         self.outputs = [_from_data(o) for o in outs]
         return self.outputs
 
-    def _ones_seeds(self, arg_d, aux_d, rngs):
+    def _ones_seeds(self, arg_d, aux_d, rngs, prog=None):
         """Ones cotangents matching the outputs' abstract shapes/dtypes."""
         import jax
         import jax.numpy as jnp
 
+        prog = prog if prog is not None else self._prog
         key = tuple((n, tuple(v.shape), str(v.dtype))
                     for n, v in sorted(arg_d.items()))
-        cache = self._prog._jit_cache.setdefault("seed_specs", {})
+        cache = prog._jit_cache.setdefault("seed_specs", {})
         if key not in cache:
-            specs = jax.eval_shape(self._prog.infer_fn(), arg_d, aux_d, rngs)
+            specs = jax.eval_shape(prog.infer_fn(), arg_d, aux_d, rngs)
             cache[key] = [(s.shape, s.dtype) for s in specs]
         return tuple(jnp.ones(s, dtype=d) for s, d in cache[key])
 
@@ -501,21 +694,22 @@ class Executor:
 
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            arg_d = {n: self.arg_dict[n]._data for n in self._arg_names}
-            aux_d = {n: self.aux_dict[n]._data for n in self._aux_names}
+            prog = self._train_program()
+            arg_d = self._arg_datas(prog)
+            aux_d = {n: self.aux_dict[n]._data for n in prog.aux_names}
             seeds = tuple(g._data for g in out_grads)
             if self._ctx_map:
                 grads = self._forward_model_parallel(
                     True, arg_d, aux_d, self._rng_keys(), seeds=seeds,
                     grads_only=True)
             else:
-                grad_names = tuple(n for n in self._arg_names
+                grad_names = tuple(n for n in prog.arg_names
                                    if self.grad_req.get(n, "null") != "null")
                 nograd_d = {n: v for n, v in arg_d.items()
                             if n not in grad_names}
                 grad_d = {n: arg_d[n] for n in grad_names}
-                _, _, grads = self._prog.train_fn(grad_names)(
-                    nograd_d, grad_d, aux_d, self._rng_keys(), seeds)
+                _, _, grads = prog.train_fn(grad_names)(
+                    nograd_d, grad_d, aux_d, self._rng_keys(prog), seeds)
         else:
             if self._stashed_grads is None:
                 raise MXNetError("backward() called without a prior "
@@ -549,6 +743,9 @@ class Executor:
                 elif not allow_extra_params:
                     raise ValueError("Find name \"%s\" that is not in the "
                                      "auxiliary states" % name)
+        # the fold-pass constants are functions of the parameters just
+        # replaced: bump the version so the next forward re-folds
+        self._param_version += 1
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return a new executor bound to new input shapes (reference:
@@ -558,7 +755,10 @@ class Executor:
 
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         new_args, new_grads = {}, {}
-        for name, shape in zip(self._arg_names, arg_shapes):
+        # iterate the ORIGINAL symbol's argument list: the bound arrays
+        # cover it even when the optimized program dropped some (pruned
+        # labels) or added fold constants (those ride via shared_exec)
+        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
             old = self.arg_dict[name]
             if tuple(old.shape) == tuple(shape):
                 new_args[name] = old
@@ -570,7 +770,8 @@ class Executor:
                     new_grads[name] = nd.zeros(shape, ctx=self._ctx,
                                                dtype=old.dtype)
         new_aux = {}
-        for name, shape in zip(self._aux_names, aux_shapes):
+        for name, shape in zip(self._symbol.list_auxiliary_states(),
+                               aux_shapes):
             old = self.aux_dict[name]
             new_aux[name] = old if tuple(old.shape) == tuple(shape) else \
                 nd.zeros(shape, ctx=self._ctx, dtype=old.dtype)
@@ -602,9 +803,11 @@ class Executor:
             def traced_cb(name, value):
                 jax.debug.callback(functools.partial(fire, name), value)
 
+            prog = self._train_program() if is_train else self._prog
+
             def f(arg_d, aux_d, rngs):
-                return self._prog._eval(arg_d, aux_d, rngs, is_train,
-                                        callback=traced_cb)
+                return prog._eval(arg_d, aux_d, rngs, is_train,
+                                  callback=traced_cb)
 
             fn = _maybe_jit(f)
             self._monitor_jit_cache[key] = fn
@@ -624,7 +827,7 @@ class Executor:
         """``(kind, name, NDArray)`` triples for the health layer: every
         output and every gradient buffer this executor exposes."""
         out = [("loss", name, o)
-               for name, o in zip(self._symbol.list_outputs(), self.outputs)]
+               for name, o in zip(self.current_output_names, self.outputs)]
         out.extend(("grad", name, g)
                    for name, g in sorted(self.grad_dict.items())
                    if g is not None)

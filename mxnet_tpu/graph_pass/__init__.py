@@ -1,0 +1,283 @@
+"""Graph-level optimization pass layer (ISSUE 9; ROADMAP open item 5).
+
+The executor used to lower the symbol graph essentially 1:1 to XLA. This
+package is the small Relay/TVM-style IR-pass layer that owns the
+fold/fuse/prune/precision decisions instead, running ONCE at bind time:
+
+* ``prune``   — inference loss-head simplification + dead-node
+  elimination (``SoftmaxOutput`` label plumbing leaves the compiled
+  program entirely),
+* ``bn_fold`` — inference BatchNorm folded into the preceding conv/FC
+  weights (running stats + affine),
+* ``layout``  — graph-wide layout rewrite consulting the autotuner's
+  ``graph.layout`` cache entry (PR 6), with transpose sink/cancel,
+* ``amp``     — automatic bf16 mixed precision with fp32 islands
+  (opt-in: a deliberate precision change),
+* ``fold``    — constant folding: frozen-parameter subgraphs evaluated
+  once at bind, re-evaluated only when the parameter version bumps.
+
+Pipeline selection is ``MXNET_GRAPH_PASSES`` (grammar in
+docs/graph_passes.md; runtime override via :func:`set_passes`). Every
+run emits per-pass provenance through the metrics registry and a
+``graph_pass`` flight-recorder provider, so health dumps show whether a
+numeric anomaly ran under (say) the bf16 rewrite.
+
+Consumers: ``Executor`` (bind-time pipeline + cached re-binds),
+``serving.InferenceServer`` (freeze → fold → specialize),
+``serving.generation.Generator`` (amp policy for prefill/decode
+program builds).
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+
+from ..symbol.symbol import Symbol
+from . import core, passes
+from .core import (DEFAULT_PASSES, INFERENCE_ONLY, PIPELINE_ORDER,
+                   PassConfig, PassContext, clone_entries, topo_from)
+from .passes import eval_fold_exprs
+
+__all__ = ["PassConfig", "OptimizedGraph", "optimize", "optimize_for_bind",
+           "graph_fingerprint", "set_passes", "stats", "reset_stats",
+           "recent_reports", "note_program", "PIPELINE_ORDER",
+           "DEFAULT_PASSES"]
+
+_PASS_FNS = {
+    "prune": passes.run_prune,
+    "bn_fold": passes.run_bn_fold,
+    "layout": passes.run_layout,
+    "amp": passes.run_amp,
+    "fold": passes.run_fold,
+}
+
+_lock = threading.Lock()
+_stats = collections.Counter()          # guarded-by: _lock
+_recent = collections.deque(maxlen=16)  # per-program summaries  # guarded-by: _lock
+_provider_armed = False                 # guarded-by: _lock
+
+# bind-level structure cache: a re-bind of the same symbol under the
+# same pass config never re-runs the pipeline (ISSUE 9 satellite); the
+# entry holds a strong symbol ref so id() can never alias a dead object
+_cache = collections.OrderedDict()      # guarded-by: _lock
+_CACHE_CAP = 64
+
+
+def set_passes(spec):
+    """Process-wide override of MXNET_GRAPH_PASSES (None clears). The
+    bind-level structure cache is dropped so the next bind re-resolves."""
+    core._SPEC_OVERRIDE = spec
+    with _lock:
+        _cache.clear()
+
+
+def stats():
+    """Always-on pipeline counters (pipeline_runs, cache_hits, folds,
+    refolds, ...) — the ``jit.compile_count`` analog for regression
+    tests, independent of MXNET_TELEMETRY."""
+    with _lock:
+        return dict(_stats)
+
+
+def reset_stats():
+    with _lock:
+        _stats.clear()
+
+
+def recent_reports():
+    """Chronological copy of the last per-program pass summaries (the
+    flight-recorder provider payload)."""
+    with _lock:
+        return list(_recent)
+
+
+def _graph_pass_state():
+    with _lock:
+        if not _recent and not _stats:
+            return None
+        return {"stats": dict(_stats), "recent": list(_recent)}
+
+
+def _arm_provider():
+    global _provider_armed
+    with _lock:
+        if _provider_armed:
+            return
+        _provider_armed = True
+    from ..observability import flight_recorder
+
+    flight_recorder.register_provider("graph_pass", _graph_pass_state)
+
+
+def note_program(kind, **summary):
+    """Record an externally-built program's pass facts (e.g. the
+    generation engine's amp policy) into the provider ring."""
+    _arm_provider()
+    entry = {"program": str(kind)}
+    entry.update(summary)
+    with _lock:
+        _recent.append(entry)
+
+
+def graph_fingerprint(symbol_or_entries):
+    """Stable graph fingerprint: node count + a hash of the op sequence
+    including per-node op params. Identical construction (and output)
+    to ``_GraphProgram.tuning_key`` so autotuner cache entries keyed by
+    one resolve through the other."""
+    entries = (symbol_or_entries._outputs
+               if isinstance(symbol_or_entries, Symbol)
+               else list(symbol_or_entries))
+    topo = [n for n in topo_from(entries) if not n.is_variable]
+    sig = ";".join(
+        "%s{%s}" % (n.op, ",".join(
+            "%s=%s" % (k, n.attrs[k]) for k in sorted(n.attrs)))
+        for n in topo)
+    return "g%d-%s" % (len(topo),
+                       hashlib.sha1(sig.encode()).hexdigest()[:12])
+
+
+class OptimizedGraph:
+    """Result of one pipeline run: the rewritten symbol plus everything
+    the bind layer needs to use it (fold expressions, provenance)."""
+
+    __slots__ = ("symbol", "fold_exprs", "fold_names", "fold_inputs",
+                 "fold_input_set", "reports", "config", "graph_key",
+                 "for_training", "nodes_before", "nodes_after")
+
+    def __init__(self, symbol, fold_exprs, reports, config, graph_key,
+                 for_training, nodes_before, nodes_after):
+        self.symbol = symbol
+        self.fold_exprs = list(fold_exprs)
+        self.fold_names = frozenset(n for n, _e, _d in self.fold_exprs)
+        self.fold_inputs = sorted({d for _n, _e, deps in self.fold_exprs
+                                   for d in deps})
+        self.fold_input_set = frozenset(self.fold_inputs)
+        self.reports = list(reports)
+        self.config = config
+        self.graph_key = graph_key
+        self.for_training = bool(for_training)
+        self.nodes_before = nodes_before
+        self.nodes_after = nodes_after
+
+    def fold(self, values):
+        """Evaluate the fold expressions once against ``values``
+        ({frozen var name: array}); returns {fold name: jax array}.
+        Called at bind, and again only when the caller's parameter
+        version bumps (docs/graph_passes.md)."""
+        if not self.fold_exprs:
+            return {}
+        from ..observability import metrics
+
+        t0 = time.perf_counter()
+        out = eval_fold_exprs(self.fold_exprs, values,
+                              for_training=self.for_training)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        nbytes = sum(int(getattr(v, "nbytes", 0)) for v in out.values())
+        with _lock:
+            _stats["folds"] += 1
+            _stats["folded_bytes"] += nbytes
+        if metrics.enabled():
+            metrics.counter("graph_pass.folds").inc()
+            metrics.counter("graph_pass.folded_bytes").inc(nbytes)
+            metrics.histogram("graph_pass.fold_ms").observe(wall_ms)
+        return out
+
+    def summary(self):
+        """JSON-safe per-program pass summary (provider/report shape)."""
+        return {
+            "graph": self.graph_key,
+            "for_training": self.for_training,
+            "nodes_before": self.nodes_before,
+            "nodes_after": self.nodes_after,
+            "folded_constants": len(self.fold_exprs),
+            "amp": "amp" in self.config.passes,
+            "passes": list(self.reports),
+        }
+
+
+def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
+             arg_dtypes=None, config=None):
+    """Run the configured pipeline over ``symbol``; returns an
+    :class:`OptimizedGraph`, or None when the layer is off or nothing
+    changed (callers then lower the original symbol object — keeping
+    graph fingerprints, and thus tuning-cache keys, stable)."""
+    cfg = config if config is not None else PassConfig()
+    if not cfg.enabled:
+        return None
+    _arm_provider()
+    outputs, _memo = clone_entries(symbol._outputs)
+    graph_key = graph_fingerprint(outputs)
+    ctx = PassContext(outputs, for_training, frozen, arg_shapes,
+                      arg_dtypes, cfg, graph_key)
+    nodes_before = ctx.node_count()
+    for name in PIPELINE_ORDER:
+        if name not in cfg.passes:
+            continue
+        if for_training and name in INFERENCE_ONLY:
+            continue
+        before = ctx.node_count()
+        t0 = time.perf_counter()
+        rewrites = _PASS_FNS[name](ctx)
+        ctx.reports.append({
+            "pass": name, "rewrites": int(rewrites),
+            "nodes_before": before, "nodes_after": ctx.node_count(),
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+    nodes_after = ctx.node_count()
+    changed = any(r["rewrites"] for r in ctx.reports)
+    opt = OptimizedGraph(Symbol(list(ctx.outputs)), ctx.fold_exprs,
+                         ctx.reports, cfg, graph_key, for_training,
+                         nodes_before, nodes_after) if changed else None
+    from ..observability import metrics
+
+    with _lock:
+        _stats["pipeline_runs"] += 1
+        if changed:
+            _stats["graphs_rewritten"] += 1
+            _stats["nodes_removed"] += max(0, nodes_before - nodes_after)
+            _recent.append(opt.summary())
+    if metrics.enabled():
+        metrics.counter("graph_pass.pipeline_runs").inc()
+        if changed:
+            metrics.counter("graph_pass.nodes_removed").inc(
+                max(0, nodes_before - nodes_after))
+            amp_rw = sum(r["rewrites"] for r in ctx.reports
+                         if r["pass"] == "amp")
+            if amp_rw:
+                metrics.counter("graph_pass.precision_rewrites").inc(amp_rw)
+    return opt
+
+
+def optimize_for_bind(symbol, for_training=False, frozen=(),
+                      arg_shapes=None, arg_dtypes=None, config=None):
+    """Cached :func:`optimize` for bind sites: keyed by (symbol id, pass
+    config, mode, frozen set, input rank/dtype signature) so re-binds —
+    ``DataParallelExecutorGroup.reshape``, serving bucket builds — never
+    re-run the pipeline. Only ranks (not dims) key the cache: a batch
+    reshape reuses the structure verbatim; fold VALUES are versioned
+    separately by the caller (Executor._param_version)."""
+    cfg = config if config is not None else PassConfig()
+    if not cfg.enabled:
+        return None
+    rank_sig = tuple(sorted(
+        (k, len(v)) for k, v in (arg_shapes or {}).items()
+        if v is not None))
+    dtype_sig = tuple(sorted(
+        (k, str(v)) for k, v in (arg_dtypes or {}).items()))
+    key = (id(symbol), cfg.signature(), bool(for_training),
+           frozenset(frozen or ()), rank_sig, dtype_sig)
+    with _lock:
+        hit = _cache.get(key)
+        if hit is not None:
+            _cache.move_to_end(key)
+            _stats["cache_hits"] += 1
+            return hit[1]
+    opt = optimize(symbol, for_training=for_training, frozen=frozen,
+                   arg_shapes=arg_shapes, arg_dtypes=arg_dtypes,
+                   config=cfg)
+    with _lock:
+        _cache[key] = (symbol, opt)
+        while len(_cache) > _CACHE_CAP:
+            _cache.popitem(last=False)
+    return opt

@@ -1,0 +1,281 @@
+"""Graph-pass substrate: pipeline config, pass context, graph surgery helpers.
+
+The pass layer (docs/graph_passes.md; ROADMAP open item 5) operates on the
+NNVM-style ``_Node`` DAG behind :class:`~mxnet_tpu.symbol.Symbol`. Every
+pipeline run works on a PRIVATE clone of the user's graph — passes mutate
+nodes freely (rewire inputs, patch attrs) and the caller's symbol is never
+touched. A pass is a function ``(ctx) -> rewrite_count`` reading and
+updating ``ctx.outputs`` (the graph's output entry list).
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+from ..ops.registry import get_op
+from ..symbol.symbol import Symbol, _Node
+
+# canonical execution order — the env grammar toggles membership, never
+# order (fold runs LAST so it materializes the small parameter
+# expressions bn_fold/layout/amp leave behind: scale vectors, transposed
+# weights, pre-cast bf16 params)
+PIPELINE_ORDER = ("prune", "bn_fold", "layout", "amp", "fold")
+
+# passes that change inference-only semantics (loss-head simplification,
+# folding running stats into weights) never run on a training bind
+INFERENCE_ONLY = frozenset({"prune", "bn_fold"})
+
+# the numerically exact default; amp (a deliberate precision change) is
+# opt-in per the parity discipline, layout only acts on a tuned
+# graph.layout cache entry so it defaults on
+DEFAULT_PASSES = ("prune", "bn_fold", "layout", "fold")
+
+_OFF_TOKENS = frozenset({"off", "none", "0", ""})
+
+# process-wide spec override (graph_pass.set_passes); None = env/default
+_SPEC_OVERRIDE = None
+
+
+class PassConfig:
+    """Parsed ``MXNET_GRAPH_PASSES`` pipeline selection.
+
+    Grammar (comma-separated, order-insensitive — execution order is
+    canonical): ``default`` expands to the exact default pipeline
+    (prune, bn_fold, layout, fold); ``all`` additionally enables
+    ``amp``; a bare pass name enables it, ``-name`` disables it;
+    ``amp`` / ``amp=bf16`` enables the mixed-precision rewrite;
+    ``layout=NHWC`` (or NCHW) forces the layout target instead of
+    consulting the autotuner; ``off`` disables the whole layer.
+    """
+
+    __slots__ = ("passes", "amp_dtype", "layout_force")
+
+    def __init__(self, spec=None, passes=None, amp_dtype="bfloat16",
+                 layout_force=None):
+        self.amp_dtype = amp_dtype
+        self.layout_force = layout_force
+        if passes is not None:
+            self.passes = frozenset(passes)
+            return
+        if spec is None:
+            spec = (_SPEC_OVERRIDE if _SPEC_OVERRIDE is not None
+                    else os.environ.get("MXNET_GRAPH_PASSES", "default"))
+        spec = spec.strip().lower()
+        if spec in _OFF_TOKENS:
+            self.passes = frozenset()
+            return
+        # two-phase, ORDER-INSENSITIVE parse: positives build the base
+        # set, negatives subtract at the end — so '-bn_fold,default' ==
+        # 'default,-bn_fold', and a purely-negative spec ('-bn_fold')
+        # means default-minus-that, never "everything off"
+        pos, neg = set(), set()
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            negated = token.startswith("-")
+            if negated:
+                token = token[1:]
+            name, _, value = token.partition("=")
+            if name == "default":
+                (neg if negated else pos).update(DEFAULT_PASSES)
+                continue
+            if name == "all":
+                (neg if negated else pos).update(PIPELINE_ORDER)
+                continue
+            if name not in PIPELINE_ORDER:
+                raise MXNetError(
+                    "MXNET_GRAPH_PASSES: unknown pass %r (known: %s, plus "
+                    "'default', 'all', 'off')"
+                    % (name, ", ".join(PIPELINE_ORDER)))
+            (neg if negated else pos).add(name)
+            if not negated and name == "amp" and value:
+                self.amp_dtype = value
+            if not negated and name == "layout" and value:
+                self.layout_force = value.upper()
+        base = pos if pos else set(DEFAULT_PASSES)
+        self.passes = frozenset(base - neg)
+
+    @property
+    def enabled(self):
+        return bool(self.passes)
+
+    def signature(self):
+        """Stable cache-key component for this configuration."""
+        return (tuple(sorted(self.passes)), self.amp_dtype,
+                self.layout_force)
+
+    def __repr__(self):
+        return "PassConfig(%s)" % ",".join(
+            p for p in PIPELINE_ORDER if p in self.passes)
+
+
+# --------------------------------------------------------------- graph ops
+
+def clone_entries(entries):
+    """Deep-copy the DAG feeding ``entries``; returns (new_entries, memo)
+    where memo maps id(old node) -> new node. Variables are cloned too so
+    passes can retire them without touching the source graph."""
+    memo = {}
+
+    def visit(node):
+        new = memo.get(id(node))
+        if new is not None:
+            return new
+        new = _Node(node.op, node.name, dict(node.attrs),
+                    dict(node.user_attrs),
+                    [(visit(src), idx) for src, idx in node.inputs])
+        memo[id(node)] = new
+        return new
+
+    return [(visit(n), i) for n, i in entries], memo
+
+
+def topo_from(entries):
+    """DFS post-order over the nodes reachable from ``entries``."""
+    order, visited = [], set()
+
+    def visit(node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        for src, _ in node.inputs:
+            visit(src)
+        order.append(node)
+
+    for node, _ in entries:
+        visit(node)
+    return order
+
+
+def consumers_of(entries):
+    """{id(producer node): [(consumer node, input slot)]} plus the set of
+    entries that are graph outputs."""
+    cons = {}
+    for node in topo_from(entries):
+        for slot, (src, _idx) in enumerate(node.inputs):
+            cons.setdefault(id(src), []).append((node, slot))
+    return cons
+
+
+def make_node(op, name, inputs, **attrs):
+    """Build an op node with parsed-then-stringified attrs (the same
+    canonical attr form ``mx.sym.*`` codegen produces)."""
+    opdef = get_op(op)
+    parsed = opdef.parse_attrs(attrs)
+    return _Node(op, name, attrs=opdef.attrs_to_str_dict(parsed),
+                 inputs=list(inputs))
+
+
+def set_attrs(node, **attrs):
+    """Patch a node's op params in place (string form) and drop its parse
+    cache. The full param set is re-parsed so defaults/validation hold."""
+    opdef = node.opdef()
+    merged = dict(node.parsed_attrs()._d)
+    merged.update(attrs)
+    parsed = opdef.parse_attrs(merged)
+    node.attrs = opdef.attrs_to_str_dict(parsed)
+    node._attrs_cache = None
+
+
+def apply_entry_map(entries, entry_map, skip=()):
+    """Rewire every node input (and the output list) through ``entry_map``
+    ({(id(node), idx): replacement entry}), following chains. Nodes whose
+    id is in ``skip`` keep their inputs verbatim (inserted wrapper nodes —
+    e.g. a back-transpose referencing the very entry being remapped).
+    Mutates the graph in place; returns the new output list."""
+    skip = set(skip)
+
+    def resolve(entry):
+        seen = 0
+        while (id(entry[0]), entry[1]) in entry_map:
+            entry = entry_map[(id(entry[0]), entry[1])]
+            seen += 1
+            if seen > 10000:
+                raise MXNetError("graph_pass: entry replacement cycle")
+        return entry
+
+    # rewire along RESOLVED edges only: each node's inputs are mapped
+    # before its producers are visited, so nodes that just became
+    # unreachable (a replaced subgraph — e.g. a fold expression's
+    # captured subtree) are never mutated. Walking the pre-rewrite
+    # topology instead would corrupt those subtrees (a fold var leaking
+    # into a sibling expression crashed eval_fold_exprs).
+    resolved = [resolve(e) for e in entries]
+    visited = set()
+    stack = [n for n, _ in resolved]
+    while stack:
+        node = stack.pop()
+        if id(node) in visited or node.is_variable:
+            continue
+        visited.add(id(node))
+        if id(node) not in skip:
+            node.inputs = [resolve(e) for e in node.inputs]
+        stack.extend(src for src, _ in node.inputs)
+    return resolved
+
+
+def num_outputs_of(node):
+    return node.opdef().get_num_outputs(node.parsed_attrs())
+
+
+class PassContext:
+    """Shared state for one pipeline run over one (cloned) graph."""
+
+    def __init__(self, outputs, for_training, frozen, arg_shapes=None,
+                 arg_dtypes=None, config=None, graph_key=None):
+        self.outputs = outputs          # list of (node, idx), mutated by passes
+        self.for_training = bool(for_training)
+        self.frozen = frozenset(frozen or ())
+        self.arg_shapes = dict(arg_shapes or {})
+        self.arg_dtypes = dict(arg_dtypes or {})
+        self.config = config or PassConfig()
+        self.graph_key = graph_key
+        self.fold_exprs = []            # [(name, [entry], [frozen input names])]
+        self.reports = []
+        self._shape_map = None
+        self._uid = 0
+
+    def uid(self):
+        self._uid += 1
+        return self._uid
+
+    def node_count(self):
+        return sum(1 for n in topo_from(self.outputs) if not n.is_variable)
+
+    def symbol(self):
+        return Symbol(list(self.outputs))
+
+    # ---- inferred shapes ------------------------------------------------
+    def shape_of(self, entry):
+        """Inferred shape of one entry (None when inference can't tell) —
+        computed once per pipeline run from the bind-time arg shapes, the
+        same partial-inference machinery executors use."""
+        if self._shape_map is None:
+            self._shape_map = self._infer_shapes()
+        node, idx = entry
+        if node.is_variable:
+            return self._shape_map.get(node.name)
+        return self._shape_map.get((id(node), idx))
+
+    def invalidate_shapes(self):
+        self._shape_map = None
+
+    def _infer_shapes(self):
+        sym = self.symbol()
+        internals = sym.get_internals()
+        feed = {k: tuple(v) for k, v in self.arg_shapes.items()
+                if v is not None and k in set(sym.list_inputs())}
+        try:
+            _, out_shapes, _ = internals.infer_shape_partial(**feed)
+        except Exception:
+            return {}
+        table = {}
+        for (node, idx), shape in zip(internals._outputs, out_shapes):
+            if shape is None:
+                continue
+            if node.is_variable:
+                table[node.name] = tuple(shape)
+            else:
+                table[(id(node), idx)] = tuple(shape)
+        return table

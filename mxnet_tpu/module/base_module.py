@@ -280,7 +280,27 @@ class BaseModule:
         return None
 
     # ---------------------------------------------------------- inference
-    def _inference_batches(self, eval_data, num_batch, reset):
+    def _set_output_selection(self, sel):
+        """Hook: restrict forwards to the output indices in ``sel``
+        (None restores all). Subclasses with bound executors thread it
+        into the compiled program (dead-output pruning); the base
+        implementation supports nothing and returns False — callers
+        then slice fetched outputs host-side instead."""
+        return False
+
+    def _resolve_output_indices(self, outputs):
+        """Map requested output names (bare or ``_output``-suffixed) or
+        indices onto positions in this module's output list (one shared
+        resolver: executor.resolve_output_indices)."""
+        from ..executor import resolve_output_indices
+
+        try:
+            names = list(self.output_names)
+        except (AttributeError, AssertionError):
+            names = list(self.symbol.list_outputs())
+        return resolve_output_indices(names, outputs)
+
+    def _inference_batches(self, eval_data, num_batch, reset, outputs=None):
         """Forward (is_train=False) over eval_data, yielding
         (index, original batch, depadded outputs, extra pad rows).
 
@@ -288,32 +308,50 @@ class BaseModule:
         the outputs are sliced back, instead of re-binding (and
         re-compiling) the executor for the leftover shape — the bound
         program serves every batch (regression-tested via the jit
-        compile counter in tests/test_serving.py)."""
+        compile counter in tests/test_serving.py).
+
+        ``outputs`` selects a subset of heads by name/index: where the
+        module supports it, the compiled program is dead-output-pruned
+        to the selection (graph_pass + Executor.select_outputs) so
+        unrequested heads are neither computed nor fetched; otherwise
+        the fetched list is sliced host-side."""
         from ..io import pad_batch_to_bound
 
         if not (self.binded and self.params_initialized):
             raise AssertionError("call bind and init_params first")
         if reset:
             eval_data.reset()
-        for i, batch in enumerate(eval_data):
-            if num_batch is not None and i == num_batch:
-                return
-            fwd, extra = pad_batch_to_bound(batch, self.data_shapes,
-                                            self.label_shapes)
-            self.forward(fwd, is_train=False)
-            pad = (batch.pad or 0) + extra
-            keep = lambda o, _pad=pad: o[0:o.shape[0] - _pad]  # noqa: E731
-            yield i, batch, [keep(o) for o in self.get_outputs()], extra
+        sel = (self._resolve_output_indices(outputs)
+               if outputs is not None else None)
+        applied = sel is not None and self._set_output_selection(sel)
+        try:
+            for i, batch in enumerate(eval_data):
+                if num_batch is not None and i == num_batch:
+                    return
+                fwd, extra = pad_batch_to_bound(batch, self.data_shapes,
+                                                self.label_shapes)
+                self.forward(fwd, is_train=False)
+                pad = (batch.pad or 0) + extra
+                keep = lambda o, _pad=pad: o[0:o.shape[0] - _pad]  # noqa: E731
+                outs = self.get_outputs()
+                if sel is not None and not applied:
+                    outs = [outs[j] for j in sel]
+                yield i, batch, [keep(o) for o in outs], extra
+        finally:
+            if applied:
+                self._set_output_selection(None)
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
-              epoch=0):
-        """Run a full evaluation pass and return metric name/value pairs."""
+              epoch=0, outputs=None):
+        """Run a full evaluation pass and return metric name/value pairs.
+        ``outputs`` restricts the evaluated heads (see :meth:`predict`) —
+        the metric then sees only the selected outputs."""
         eval_metric = _resolve_metric(eval_metric)
         eval_metric.reset()
         seen = 0
         for nbatch, batch, outs, extra in self._inference_batches(
-                eval_data, num_batch, reset):
+                eval_data, num_batch, reset, outputs=outputs):
             if extra:
                 # the executors ran on a padded batch; score the true
                 # rows exactly (synthetic zero rows never reach the
@@ -334,19 +372,26 @@ class BaseModule:
                             eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
-    def iter_predict(self, eval_data, num_batch=None, reset=True):
+    def iter_predict(self, eval_data, num_batch=None, reset=True,
+                     outputs=None):
         """Generator over (outputs, batch index, batch)."""
         for i, batch, outs, _extra in self._inference_batches(
-                eval_data, num_batch, reset):
+                eval_data, num_batch, reset, outputs=outputs):
             yield outs, i, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
-                reset=True, always_output_list=False):
-        """Collect predictions; optionally concatenate across batches."""
+                reset=True, always_output_list=False, outputs=None):
+        """Collect predictions; optionally concatenate across batches.
+
+        ``outputs`` selects a subset of the graph's heads by name (bare
+        or ``_output``-suffixed) or index; with a bound Module the
+        compiled inference program is pruned to the selection's
+        ancestors, so dead heads cost neither compute nor fetch
+        (exactness regression-tested in tests/test_graph_passes.py)."""
         collected = [
             [o.copy() for o in outs]
             for _i, _batch, outs, _extra in self._inference_batches(
-                eval_data, num_batch, reset)]
+                eval_data, num_batch, reset, outputs=outputs)]
         if not collected:
             return collected
         if not merge_batches:

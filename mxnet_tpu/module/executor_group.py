@@ -121,6 +121,7 @@ class DataParallelExecutorGroup:
 
         self._shared_group = shared_group
         self.execs = []
+        self._out_sel = None
         self.data_shapes = self.label_shapes = None
         self.data_layouts = self.label_layouts = None
         self.output_layouts = [
@@ -159,6 +160,9 @@ class DataParallelExecutorGroup:
         self.execs = [self._bind_replica(i, data_shapes, label_shapes,
                                          shared_group)
                       for i in range(len(self.contexts))]
+        if self._out_sel is not None:  # selection survives a re-bind
+            for e in self.execs:
+                e.select_outputs(self._out_sel)
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
         self.data_names = [d.name for d in data_shapes]
@@ -200,10 +204,17 @@ class DataParallelExecutorGroup:
                 {d.name: d.shape
                  for d in self._replica_descs(label_shapes, i,
                                               self.label_layouts)})
+        # bind-time pass pipeline inputs (graph_pass): an inference bind
+        # freezes every parameter (predict/score serve fixed weights
+        # between set_params calls — the executor re-folds on update);
+        # a training bind freezes only the explicitly fixed ones
+        frozen = [n for n in (self.fixed_param_names if self.for_training
+                              else self.param_names)
+                  if n in self.arg_names]
         return self.symbol.simple_bind(
             ctx=self.contexts[i], grad_req=self.grad_req,
             shared_exec=None if shared_group is None else shared_group.execs[i],
-            **shapes)
+            frozen_params=frozen, **shapes)
 
     def _index_arrays(self):
         """Build the name-major views over per-replica executor arrays."""
@@ -291,13 +302,24 @@ class DataParallelExecutorGroup:
             merged.append((name, tuple(dims)))
         return merged
 
+    def set_output_selection(self, sel):
+        """Restrict inference forwards to the output indices in ``sel``
+        (None restores all) — threaded down to every executor so the
+        compiled program only computes (and the host only fetches) the
+        requested heads."""
+        self._out_sel = list(sel) if sel is not None else None
+        for e in self.execs:
+            e.select_outputs(self._out_sel)
+
     def get_outputs(self, merge_multi_context=True):
         columns = [[e.outputs[i] for e in self.execs]
                    for i in range(len(self.execs[0].outputs))]
         if not merge_multi_context:
             return columns
+        layouts = (self.output_layouts if self._out_sel is None
+                   else [self.output_layouts[i] for i in self._out_sel])
         axes = [axis if axis is not None and axis >= 0 else 0
-                for axis in self.output_layouts]
+                for axis in layouts]
         return _gather(columns, axes)
 
     def get_input_grads(self, merge_multi_context=True):

@@ -101,8 +101,29 @@ def _register_reshape():
         axes = attrs.axes if attrs.axes else None
         return jnp.transpose(x, axes)
 
+    def transpose_infer(attrs, in_shapes, aux_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return None
+        axes = attrs.axes or tuple(reversed(range(len(d))))
+        return ([d], [tuple(d[a] for a in axes)], aux_shapes)
+
+    def transpose_infer_backward(attrs, out_shapes, in_shapes):
+        # inverse-permute the output shape back onto the input: lets the
+        # graph-pass layout rewrite (transpose around a Convolution whose
+        # conv_infer backfills the TRANSPOSED weight shape) resolve the
+        # underlying weight variable's shape
+        o = out_shapes[0] if out_shapes else None
+        if o is None or not attrs.axes or len(attrs.axes) != len(o):
+            return None
+        inv = [0] * len(o)
+        for i, a in enumerate(attrs.axes):
+            inv[a] = o[i]
+        return [tuple(inv)]
+
     register_op("transpose", transpose, params={"axes": Shape(default=())},
-                num_inputs=1)
+                num_inputs=1, infer_shape=transpose_infer,
+                infer_backward=transpose_infer_backward)
 
     def swapaxis(attrs, x):
         return jnp.swapaxes(x, attrs.dim1, attrs.dim2)
@@ -117,6 +138,8 @@ def _register_reshape():
         return x.astype(np_dtype(attrs.dtype))
 
     register_op("Cast", cast, params={"dtype": DType()}, num_inputs=1,
+                infer_shape=lambda attrs, i, a: (
+                    None if i[0] is None else ([i[0]], [i[0]], a)),
                 infer_dtype=lambda attrs, i, a: (i, [attrs.dtype], a))
     alias_op("Cast", "cast")
 

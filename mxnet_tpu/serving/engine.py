@@ -241,7 +241,12 @@ class InferenceServer:
             raise ValueError("data_shapes is required: [(name, shape), ...] "
                              "with the batch axis leading")
         self._symbol = symbol
-        self._prog = _GraphProgram(symbol)
+        from .. import graph_pass
+
+        # serving.buckets tuning keys stay pinned to the ORIGINAL
+        # graph's fingerprint, so ladders tuned under any pass config
+        # keep resolving
+        base_key = graph_pass.graph_fingerprint(symbol)
         if config is None:
             # trace-time tuning-cache consult (ISSUE 6): a ladder tuned
             # for this (device, model, traffic shape) beats the env/
@@ -252,8 +257,7 @@ class InferenceServer:
             from .. import autotune
 
             tuned = autotune.lookup(
-                "serving.buckets",
-                key=(self._prog.tuning_key(), traffic_key))
+                "serving.buckets", key=(base_key, traffic_key))
             if not isinstance(tuned, dict):
                 tuned = {}
             try:
@@ -283,11 +287,44 @@ class InferenceServer:
         host_args = {k: self._as_np(v) for k, v in (arg_params or {}).items()
                      if k not in self._data_names}
         host_aux = {k: self._as_np(v) for k, v in (aux_params or {}).items()}
+        self._arg_dtypes = self._infer_dtypes()
+
+        # freeze -> fold -> specialize (graph_pass): serving params are
+        # fixed for the server's lifetime, so EVERYTHING but the data
+        # enters the pipeline frozen — BN folds into conv weights, loss
+        # heads and their label plumbing prune away (no zero-filled
+        # label extras), and the folded constants ship with the params
+        self._opt = None
+        opt_symbol = symbol
+        feed = {n: (1,) + s for n, s in zip(self._data_names,
+                                            self._row_shapes)}
+        opt = graph_pass.optimize_for_bind(
+            symbol, for_training=False,
+            frozen=set(host_args) | set(host_aux),
+            arg_shapes=feed,
+            arg_dtypes={**{k: v.dtype for k, v in host_aux.items()},
+                        **{k: v.dtype for k, v in host_args.items()},
+                        **self._arg_dtypes})
+        if opt is not None:
+            consts = opt.fold({**host_aux, **host_args})
+            host_args = dict(host_args)
+            host_args.update(
+                (k, np.asarray(v)) for k, v in consts.items())
+            # bn_fold may retire a BatchNorm while the fold pass is off:
+            # its moving stats then feed plain arithmetic as ARGUMENTS
+            opt_args = set(opt.symbol.list_arguments())
+            host_args.update((k, v) for k, v in host_aux.items()
+                             if k in opt_args)
+            opt_symbol = opt.symbol
+            self._opt = opt
+        self._opt_symbol = opt_symbol
+        # tuning key pinned to the ORIGINAL fingerprint so exec.remat/
+        # serving entries tuned under any pass config keep resolving
+        self._prog = _GraphProgram(opt_symbol, tuning_key=base_key)
         self._replica_args = [jax.device_put(host_args, dev)
                               for dev in self._devices]
         self._replica_aux = [jax.device_put(host_aux, dev)
                              for dev in self._devices]
-        self._arg_dtypes = self._infer_dtypes()
 
         self._lock = threading.Lock()
         self._stats = collections.Counter()   # guarded-by: self._lock
@@ -364,17 +401,20 @@ class InferenceServer:
 
         feed = {n: (bucket,) + s
                 for n, s in zip(self._data_names, self._row_shapes)}
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**feed)
+        # shapes/args come from the OPTIMIZED symbol: pruned labels are
+        # no longer arguments, so no zero-filled extras exist for them
+        arg_shapes, _, aux_shapes = self._opt_symbol.infer_shape(**feed)
         dev = self._devices[replica]
         args = self._replica_args[replica]
         extras = {}
-        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
+        for name, shape in zip(self._opt_symbol.list_arguments(),
+                               arg_shapes):
             if name in self._data_names or name in args:
                 continue
             dt = self._arg_dtypes.get(name, np.float32)
             extras[name] = jax.device_put(jnp.zeros(shape, dtype=dt), dev)
         aux = dict(self._replica_aux[replica])
-        for name, shape in zip(self._symbol.list_auxiliary_states(),
+        for name, shape in zip(self._opt_symbol.list_auxiliary_states(),
                                aux_shapes):
             if name not in aux:
                 aux[name] = jax.device_put(
@@ -901,4 +941,8 @@ class InferenceServer:
             max_wait_ms=self._cfg.max_wait_ms,
             running=self.running,
             stopped=stopped)
+        if self._opt is not None:
+            # which rewrites this server's programs compiled under —
+            # rides into flight-recorder dumps via the serving provider
+            stats["graph_pass"] = self._opt.summary()
         return stats

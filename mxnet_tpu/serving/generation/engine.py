@@ -94,9 +94,13 @@ class GenerationConfig:
 
     def __init__(self, page_size=None, decode_blocks=None, max_batch=None,
                  max_seq=None, pool_pages=None, prefill_buckets=None,
-                 max_queue=None, backpressure=None, submit_timeout_ms=None):
+                 max_queue=None, backpressure=None, submit_timeout_ms=None,
+                 amp=None):
         import os
 
+        # None = follow the graph-pass layer (amp in MXNET_GRAPH_PASSES);
+        # True/False force the bf16 prefill/decode rewrite per bind
+        self.amp = amp
         # None = resolve in Generator: explicit > tuning cache > flag
         self.page_size = None if page_size is None else int(page_size)
         self.decode_blocks = (None if decode_blocks is None
@@ -280,6 +284,28 @@ class Generator:
         self.decode_blocks = self._resolve(
             "generation.decode_blocks", "decode_blocks", cfg.decode_blocks,
             "MXNET_GEN_DECODE_BLOCKS")
+        # mixed-precision policy for the prefill/decode program builds:
+        # the graph-pass layer's amp rewrite, applied functionally (the
+        # model is jax functions, not a symbol graph) — params cast to
+        # bf16 at program entry, logits returned to fp32 before sampling
+        # (the fp32 island), all inside the compiled programs. Opt-in:
+        # GenerationConfig(amp=True) or amp in MXNET_GRAPH_PASSES.
+        from ... import graph_pass
+
+        if cfg.amp is None:
+            self._amp = "amp" in graph_pass.PassConfig().passes
+        else:
+            self._amp = bool(cfg.amp)
+        if self._amp:
+            # cast ONCE at construction so the device holds (and every
+            # decode step reads) half-width weights — an in-program cast
+            # would stream fp32 from HBM each step and deliver none of
+            # the bandwidth win on the HBM-bound decode path
+            self._params = self._amp_params(params)
+            graph_pass.note_program(
+                "generation", amp=True,
+                dtype=str(np.dtype(model.dtype).name),
+                tune_key=list(self._tune_key))
 
         S = cfg.max_batch
         self._max_pages = -(-cfg.max_seq // self.page_size)
@@ -338,6 +364,22 @@ class Generator:
         if start:
             self.start()
 
+    def _amp_params(self, params):
+        """The amp pass applied to this engine's functional programs:
+        fp32 parameter leaves cast to bf16 ONCE at construction, so the
+        device-resident copy every prefill/decode program reads is
+        half-width (the bn_fold/fold analog of baking the rewrite into
+        the weights). No-op when amp is off — token-exactness is the
+        default contract."""
+        if not self._amp:
+            return params
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if getattr(a, "dtype", None) == jnp.float32 else a, params)
+
     def _fresh_pool(self):
         import jax
 
@@ -394,6 +436,7 @@ class Generator:
 
         bucket = tokens.shape[1]
         logits, ks, vs = self._model.prefill_forward(params, tokens)
+        logits = logits.astype(jnp.float32)  # fp32 sampling island
         pos = jnp.arange(bucket, dtype=jnp.int32)
         dest = page_row[pos // self.page_size]
         off = pos % self.page_size
@@ -431,6 +474,7 @@ class Generator:
                 block_tokens=self.decode_blocks)
 
         logits = self._model.decode_forward(params, last_token, attend)
+        logits = logits.astype(jnp.float32)  # fp32 sampling island
         toks, new_keys = sample_tokens(logits, keys, temp, top_k)
         toks = jnp.where(active, toks, -1)
         new_keys = jnp.where(active[:, None], new_keys, keys)
@@ -827,5 +871,6 @@ class Generator:
             page_size=self.page_size, decode_blocks=self.decode_blocks,
             prefill_buckets=list(self._cfg.prefill_buckets),
             pool=self.pool.get_stats(),
+            graph_pass={"amp": bool(self._amp)},
             running=self.running, stopped=stopped)
         return stats

@@ -560,9 +560,12 @@ class Symbol:
     # --- binding ------------------------------------------------------------
     def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
                     shared_arg_names=None, shared_exec=None, shared_buffer=None,
-                    **kwargs):
+                    frozen_params=None, **kwargs):
         """Allocate arrays by shape inference and bind (reference:
-        symbol.py:1254 → GraphExecutor::Init, graph_executor.cc:956)."""
+        symbol.py:1254 → GraphExecutor::Init, graph_executor.cc:956).
+        ``frozen_params`` names arguments whose values are fixed for the
+        executor's lifetime — the graph-pass layer may then fold
+        subgraphs over them at bind time (docs/graph_passes.md)."""
         from ..executor import Executor
         from .. import ndarray as nd
 
@@ -584,10 +587,11 @@ class Symbol:
             for name, shape, t in zip(aux_names, aux_shapes, aux_types)
         }
         return Executor(self, ctx, args, args_grad, reqs, aux_states,
-                        shared_exec=shared_exec, group2ctx=group2ctx)
+                        shared_exec=shared_exec, group2ctx=group2ctx,
+                        frozen_params=frozen_params)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None):
+             group2ctx=None, shared_exec=None, frozen_params=None):
         """Bind existing arrays (reference: symbol.py:1518 → Executor::Bind)."""
         from ..executor import Executor
 
@@ -606,7 +610,8 @@ class Symbol:
                 reqs = dict(reqs)
                 reqs[name] = "null"
         return Executor(self, ctx, args, args_grad, reqs, aux_states,
-                        shared_exec=shared_exec, group2ctx=group2ctx)
+                        shared_exec=shared_exec, group2ctx=group2ctx,
+                        frozen_params=frozen_params)
 
     # --- eval ---------------------------------------------------------------
     def eval(self, ctx=None, **kwargs):

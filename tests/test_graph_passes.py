@@ -1,0 +1,560 @@
+"""Graph-pass layer tests (ISSUE 9): parity harness over the tier-1
+model zoo, per-pass and full-pipeline, plus pipeline idempotence,
+re-bind caching, outputs= selection, refold-on-update, serving
+specialization, and the MXNET_GRAPH_PASSES grammar."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import graph_pass
+from mxnet_tpu.graph_pass import PassConfig
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.observability import metrics as M
+
+
+@pytest.fixture(autouse=True)
+def _passes_reset():
+    graph_pass.set_passes(None)
+    graph_pass.reset_stats()
+    yield
+    graph_pass.set_passes(None)
+
+
+@pytest.fixture
+def telemetry():
+    from mxnet_tpu import observability as obs
+
+    obs.set_enabled(True)
+    obs.reset_metrics()
+    yield
+    obs.reset_metrics()
+    obs.set_enabled(False)
+
+
+@pytest.fixture
+def own_tune_cache(tmp_path, monkeypatch):
+    """Per-test tuning-cache file: entries recorded here can't leak into
+    later tests (the conftest cache is per-RUN, not per-test)."""
+    from mxnet_tpu import autotune
+
+    monkeypatch.setenv("MXNET_TUNE_CACHE", str(tmp_path / "tuning.json"))
+    autotune.reset()
+    yield
+    autotune.reset()
+
+
+# ------------------------------------------------------------- model zoo
+
+def _mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=16,
+                                                name="fc1"),
+                          act_type="relu")
+    h = mx.sym.Dropout(h, p=0.3, name="drop")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=6,
+                                                      name="fc2"),
+                                name="softmax"), (5, 8)
+
+
+def _bn_heavy():
+    data = mx.sym.var("data")
+    x = data
+    for i, (nf, nb) in enumerate([(8, False), (12, True), (8, False)]):
+        x = mx.sym.Convolution(x, kernel=(3, 3), num_filter=nf, pad=(1, 1),
+                               no_bias=nb, name="c%d" % i)
+        x = mx.sym.BatchNorm(x, name="bn%d" % i, fix_gamma=(i % 2 == 0))
+        x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", name="gp")
+    x = mx.sym.Flatten(x)
+    x = mx.sym.FullyConnected(x, num_hidden=5, name="fc")
+    x = mx.sym.BatchNorm(x, name="bnf", fix_gamma=False, axis=1)
+    return mx.sym.SoftmaxOutput(x, name="softmax"), (4, 3, 8, 8)
+
+
+def _resnet_toy():
+    from mxnet_tpu.models import get_resnet
+
+    sym = get_resnet(num_classes=10, num_layers=8, image_shape=(3, 16, 16))
+    return sym, (2, 3, 16, 16)
+
+
+def _transformer_block():
+    """A symbol-level attention-ish block: QKV FCs + batch_dot scores +
+    softmax + projection (the zoo's stand-in for the transformer)."""
+    T, D = 6, 8
+    data = mx.sym.var("data")  # (N, T, D)
+    q = mx.sym.FullyConnected(data, num_hidden=D, flatten=False, name="q")
+    k = mx.sym.FullyConnected(data, num_hidden=D, flatten=False, name="k")
+    v = mx.sym.FullyConnected(data, num_hidden=D, flatten=False, name="v")
+    scores = mx.sym.batch_dot(q, mx.sym.transpose(k, axes=(0, 2, 1)))
+    attn = mx.sym.softmax(scores / float(np.sqrt(D)), axis=-1)
+    ctx = mx.sym.batch_dot(attn, v)
+    out = mx.sym.FullyConnected(ctx + data, num_hidden=D, flatten=False,
+                                name="proj")
+    flat = mx.sym.Flatten(out)
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(flat, num_hidden=4, name="head"),
+        name="softmax"), (3, T, D)
+
+
+ZOO = {"mlp": _mlp, "bn_heavy": _bn_heavy, "resnet_toy": _resnet_toy,
+       "transformer_block": _transformer_block}
+
+
+def _materialize(builder, seed=7):
+    sym, dshape = builder()
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=dshape)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data",) and not n.endswith("label")}
+    auxs = {n: mx.nd.array(rng.uniform(0.5, 1.5, s).astype(np.float32))
+            for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    x = rng.uniform(0, 1, dshape).astype(np.float32)
+    return sym, args, auxs, x
+
+
+def _predict(builder, spec, args, auxs, x, seed=7):
+    graph_pass.set_passes(spec)
+    try:
+        sym, dshape = builder()
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)], for_training=False)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.set_params(args, auxs)
+        out = mod.predict(NDArrayIter(x, None, batch_size=x.shape[0]))
+        return mod, out.asnumpy()
+    finally:
+        graph_pass.set_passes(None)
+
+
+# ------------------------------------------------------- parity harness
+
+@pytest.mark.parametrize("name", sorted(ZOO))
+def test_full_pipeline_parity_fp32(name):
+    builder = ZOO[name]
+    _sym, args, auxs, x = _materialize(builder)
+    _m0, ref = _predict(builder, "off", args, auxs, x)
+    _m1, opt = _predict(builder, "default", args, auxs, x)
+    np.testing.assert_allclose(opt, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("pass_name", ["prune", "bn_fold", "fold",
+                                       "layout"])
+def test_single_pass_parity_fp32(pass_name):
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    _m0, ref = _predict(builder, "off", args, auxs, x)
+    _m1, opt = _predict(builder, pass_name, args, auxs, x)
+    np.testing.assert_allclose(opt, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_amp_parity_documented_tolerance():
+    # bf16 rewrite is a deliberate precision change (docs/graph_passes.md
+    # documents the tolerance): outputs still land within bf16 epsilon
+    # of fp32, and the interface dtype stays float32
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    _m0, ref = _predict(builder, "off", args, auxs, x)
+    m1, opt = _predict(builder, "default,amp", args, auxs, x)
+    assert opt.dtype == np.float32
+    np.testing.assert_allclose(opt, ref, rtol=5e-2, atol=2e-2)
+    ex = m1._exec_group.execs[0]
+    amp_rewrites = sum(r["rewrites"] for r in ex._opt.reports
+                      if r["pass"] == "amp")
+    assert amp_rewrites > 0
+
+
+def test_pipeline_idempotent():
+    # running the pipeline over an already-optimized graph (its fold
+    # constants now frozen inputs) changes nothing
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    m1, _ = _predict(builder, "default", args, auxs, x)
+    opt = m1._exec_group.execs[0]._opt
+    assert opt is not None
+    vals = {n: (args[n] if n in args else auxs[n]).asnumpy()
+            for n in opt.fold_inputs}
+    consts = opt.fold(vals)
+    frozen2 = set(args) | set(auxs) | set(consts)
+    opt2 = graph_pass.optimize(
+        opt.symbol, for_training=False, frozen=frozen2,
+        arg_shapes={"data": x.shape},
+        arg_dtypes={k: "float32" for k in frozen2},
+        config=PassConfig("default"))
+    assert opt2 is None  # no rewrites -> caller keeps the same graph
+
+
+# -------------------------------------------------- structural effects
+
+def test_node_count_reduction_and_label_pruned():
+    builder = ZOO["bn_heavy"]
+    sym, args, auxs, x = _materialize(builder)
+    m1, _ = _predict(builder, "default", args, auxs, x)
+    ex = m1._exec_group.execs[0]
+    opt = ex._opt
+    assert opt is not None
+    assert opt.nodes_after < opt.nodes_before
+    prog_args = ex._prog.symbol.list_arguments()
+    assert "softmax_label" not in prog_args  # label plumbing pruned
+    assert not any(n.op == "BatchNorm" for n in ex._prog.topo)
+    assert len(opt.fold_exprs) > 0
+
+
+def test_layout_rewrite_forced_nhwc():
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    _m0, ref = _predict(builder, "off", args, auxs, x)
+    m1, opt_out = _predict(builder, "default,layout=NHWC", args, auxs, x)
+    np.testing.assert_allclose(opt_out, ref, rtol=1e-5, atol=1e-6)
+    convs = [n for n in m1._exec_group.execs[0]._prog.topo
+             if n.op == "Convolution"]
+    assert convs and all(n.parsed_attrs().layout == "NHWC" for n in convs)
+
+
+def test_layout_consults_autotuner_cache(own_tune_cache):
+    from mxnet_tpu import autotune
+
+    builder = ZOO["bn_heavy"]
+    sym, _ = builder()
+    key = graph_pass.graph_fingerprint(sym)
+    autotune.record("graph.layout", key, {"layout": "NHWC"})
+    _sym, args, auxs, x = _materialize(builder)
+    _m0, ref = _predict(builder, "off", args, auxs, x)
+    m1, out = _predict(builder, "default", args, auxs, x)
+    convs = [n for n in m1._exec_group.execs[0]._prog.topo
+             if n.op == "Convolution"]
+    assert convs and all(n.parsed_attrs().layout == "NHWC"
+                         for n in convs)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------ caching / recompiles
+
+def test_rebind_never_reruns_pipeline_or_recompiles(telemetry):
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    graph_pass.set_passes("default")
+    try:
+        sym, dshape = builder()
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)], for_training=False)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.set_params(args, auxs)
+        mod.predict(NDArrayIter(x, None, batch_size=x.shape[0]))
+        runs0 = graph_pass.stats()["pipeline_runs"]
+        # alternate batch shapes: second visit of each shape must be free
+        small = x[:2]
+        for _ in range(2):
+            mod.reshape([("data", small.shape)])
+            mod.predict(NDArrayIter(small, None, batch_size=2))
+            mod.reshape([("data", x.shape)])
+            mod.predict(NDArrayIter(x, None, batch_size=x.shape[0]))
+        assert graph_pass.stats()["pipeline_runs"] == runs0, \
+            "re-binds re-ran the pass pipeline"
+        c1 = M.get_value("jit.compile_count", 0)
+        mod.reshape([("data", small.shape)])
+        mod.predict(NDArrayIter(small, None, batch_size=2))
+        assert M.get_value("jit.compile_count", 0) == c1, \
+            "a shape seen before recompiled"
+    finally:
+        graph_pass.set_passes(None)
+
+
+def test_refold_after_set_params():
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    m1, _ = _predict(builder, "default", args, auxs, x)
+    args2 = {k: v * 1.5 for k, v in args.items()}
+    m1.set_params(args2, auxs)
+    upd = m1.predict(NDArrayIter(x, None, batch_size=x.shape[0])).asnumpy()
+    _m0, ref = _predict(builder, "off", args2, auxs, x)
+    np.testing.assert_allclose(upd, ref, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- outputs= selection
+
+def _multi_head():
+    d = mx.sym.var("data")
+    shared = mx.sym.FullyConnected(d, num_hidden=6, name="h1")
+    sm = mx.sym.SoftmaxOutput(shared, name="sm")
+    reg = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(shared, num_hidden=2, name="h2"), name="reg")
+    return mx.sym.Group([sm, reg])
+
+
+def test_predict_outputs_selection_exact():
+    rng = np.random.RandomState(5)
+    mod = mx.mod.Module(_multi_head(), context=mx.cpu(),
+                        label_names=("sm_label", "reg_label"))
+    mod.bind(data_shapes=[("data", (4, 5))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+    x = rng.rand(4, 5).astype(np.float32)
+    it = lambda: NDArrayIter(x, None, batch_size=4)  # noqa: E731
+    full = mod.predict(it(), always_output_list=True)
+    one = mod.predict(it(), outputs=["reg_output"])
+    np.testing.assert_array_equal(one.asnumpy(), full[1].asnumpy())
+    # bare head name and index forms resolve too
+    np.testing.assert_array_equal(
+        mod.predict(it(), outputs=["sm"]).asnumpy(), full[0].asnumpy())
+    np.testing.assert_array_equal(
+        mod.predict(it(), outputs=[1]).asnumpy(), full[1].asnumpy())
+    # selection is scoped: the module serves every head again afterwards
+    again = mod.predict(it(), always_output_list=True)
+    assert len(again) == 2
+
+
+def test_selection_prunes_compiled_program(telemetry):
+    rng = np.random.RandomState(5)
+    mod = mx.mod.Module(_multi_head(), context=mx.cpu(),
+                        label_names=("sm_label", "reg_label"))
+    mod.bind(data_shapes=[("data", (4, 5))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+    x = rng.rand(4, 5).astype(np.float32)
+    it = lambda: NDArrayIter(x, None, batch_size=4)  # noqa: E731
+    mod.predict(it(), outputs=["reg_output"])
+    c0 = M.get_value("jit.compile_count", 0)
+    mod.predict(it(), outputs=["reg_output"])  # same selection: cached
+    assert M.get_value("jit.compile_count", 0) == c0
+    ex = mod._exec_group.execs[0]
+    topo, _ = ex._prog.topo_for(
+        (mod._resolve_output_indices(["reg_output"])[0],))
+    names = {n.name for n in topo}
+    assert "h2" in names and "sm" not in names  # dead head not computed
+
+
+def test_unknown_output_name_raises():
+    mod = mx.mod.Module(_multi_head(), context=mx.cpu(),
+                        label_names=("sm_label", "reg_label"))
+    mod.bind(data_shapes=[("data", (4, 5))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+    x = np.zeros((4, 5), np.float32)
+    with pytest.raises(ValueError):
+        list(mod.iter_predict(NDArrayIter(x, None, batch_size=4),
+                              outputs=["nope"]))
+
+
+# ------------------------------------------------- serving integration
+
+def test_serving_freeze_fold_specialization():
+    from mxnet_tpu import serving
+
+    builder = ZOO["bn_heavy"]
+    sym, args, auxs, x = _materialize(builder)
+    row = x.shape[1:]
+    outs = {}
+    for spec in ("off", "default"):
+        graph_pass.set_passes(spec)
+        try:
+            srv = serving.InferenceServer(
+                builder()[0], args, auxs,
+                data_shapes=[("data", (1,) + row)],
+                config=serving.ServingConfig(buckets=(4,)))
+            outs[spec] = srv.predict(x)
+            stats = srv.get_stats()
+            srv.stop()
+        finally:
+            graph_pass.set_passes(None)
+    np.testing.assert_allclose(outs["default"], outs["off"],
+                               rtol=1e-5, atol=1e-6)
+    assert stats["graph_pass"]["nodes_after"] < \
+        stats["graph_pass"]["nodes_before"]
+    assert stats["graph_pass"]["folded_constants"] > 0
+
+
+# -------------------------------------------- provenance / provider
+
+def test_flight_recorder_graph_pass_provider(tmp_path):
+    import json
+
+    from mxnet_tpu.observability import flight_recorder
+
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    _m1, _ = _predict(builder, "default", args, auxs, x)
+    path = flight_recorder.dump(reason="test",
+                                path=str(tmp_path / "dump.json"))
+    payload = json.loads(open(path).read())
+    section = payload["providers"]["graph_pass"]
+    assert section["stats"]["pipeline_runs"] >= 1
+    recent = section["recent"]
+    assert any(r.get("nodes_after", 99) < r.get("nodes_before", 0)
+               for r in recent if "nodes_after" in r)
+
+
+def test_trace_report_prints_graph_pass_section(tmp_path, capsys):
+    import json
+    import sys
+
+    from mxnet_tpu.observability import flight_recorder
+
+    builder = ZOO["bn_heavy"]
+    _sym, args, auxs, x = _materialize(builder)
+    _predict(builder, "default", args, auxs, x)
+    path = flight_recorder.dump(reason="test",
+                                path=str(tmp_path / "dump.json"))
+    sys.path.insert(0, "tools")
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    rows = trace_report.graph_pass_rows(json.loads(open(path).read()))
+    assert rows and any(r["pass"] == "bn_fold" for r in rows)
+
+
+def test_partially_frozen_simple_bind_parity():
+    # raw-Symbol inference bind: only aux states are frozen, so the
+    # bn_fold scale chain is PARTIALLY foldable and fold frontiers
+    # overlap (rstd feeds both foldable and non-foldable consumers).
+    # Regression: apply_entry_map used to rewire the captured fold
+    # subtrees, crashing the first forward with a KeyError; and the
+    # reference arg_arrays/aux_arrays views used to KeyError on ex-aux
+    # program arguments.
+    rng = np.random.RandomState(2)
+    for fix_gamma, no_bias in [(True, False), (False, False),
+                               (True, True)]:
+        data = mx.sym.var("data")
+        c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                               pad=(1, 1), no_bias=no_bias, name="c0")
+        b = mx.sym.BatchNorm(c, name="bn0", fix_gamma=fix_gamma)
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Flatten(b), num_hidden=3,
+                                  name="fc"), name="softmax")
+        arg_shapes, _, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+        args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s)
+                               .astype(np.float32))
+                for n, s in zip(net.list_arguments(), arg_shapes)}
+        auxs = {n: mx.nd.array(rng.uniform(0.5, 1.5, s)
+                               .astype(np.float32))
+                for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+        outs = {}
+        for spec in ("default", "off"):
+            graph_pass.set_passes(spec)
+            try:
+                ex = net.simple_bind(mx.cpu(), grad_req="null",
+                                     data=(2, 3, 8, 8))
+            finally:
+                graph_pass.set_passes(None)
+            ex.copy_params_from(args, auxs)
+            outs[spec] = ex.forward(is_train=False)[0].asnumpy()
+            # reference array views stay on the ORIGINAL symbol's lists
+            assert len(ex.arg_arrays) == len(net.list_arguments())
+            assert len(ex.aux_arrays) == len(net.list_auxiliary_states())
+        np.testing.assert_allclose(outs["default"], outs["off"],
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ----------------------------------------------- generation amp policy
+
+def test_generation_amp_policy():
+    import jax
+
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+    from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                              SamplingParams)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    model = TransformerParallel(mesh, vocab=32, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, n_experts=2,
+                                dtype=np.float32)
+    params = model.init(seed=0)
+    gen = Generator(model, params,
+                    GenerationConfig(page_size=8, max_batch=2, max_seq=32,
+                                     prefill_buckets=(16,), amp=True))
+    try:
+        assert gen.get_stats()["graph_pass"]["amp"] is True
+        toks = gen.submit([1, 2, 3],
+                          SamplingParams(max_new_tokens=4)).result(60)
+        assert len(toks) == 4 and all(0 <= t < 32 for t in toks)
+    finally:
+        gen.stop()
+    # the bf16 policy rides the provider ring for health dumps
+    assert any(r.get("program") == "generation" and r.get("amp")
+               for r in graph_pass.recent_reports())
+    # default stays token-exact fp32: amp must be OFF unless opted in
+    gen2 = Generator(model, params,
+                     GenerationConfig(page_size=8, max_batch=2,
+                                      max_seq=32, prefill_buckets=(16,)))
+    try:
+        assert gen2.get_stats()["graph_pass"]["amp"] is False
+    finally:
+        gen2.stop()
+
+
+# --------------------------------------------------- grammar / config
+
+def test_pass_config_grammar():
+    assert PassConfig("off").passes == frozenset()
+    assert PassConfig("default").passes == frozenset(
+        graph_pass.DEFAULT_PASSES)
+    assert "amp" in PassConfig("all").passes
+    assert "bn_fold" not in PassConfig("default,-bn_fold").passes
+    assert PassConfig("amp=float16").amp_dtype == "float16"
+    assert PassConfig("layout=nhwc").layout_force == "NHWC"
+    cfg = PassConfig("fold,prune")
+    assert cfg.passes == frozenset({"fold", "prune"})
+    with pytest.raises(mx.MXNetError):
+        PassConfig("default,bogus")
+    # order-insensitive: negatives subtract AFTER positives, wherever
+    # they appear; a purely-negative spec means default-minus-those
+    assert PassConfig("-bn_fold,default").passes == \
+        PassConfig("default,-bn_fold").passes
+    assert PassConfig("-bn_fold").passes == \
+        frozenset(graph_pass.DEFAULT_PASSES) - {"bn_fold"}
+    assert PassConfig("amp,-amp").passes == \
+        frozenset()  # pure positive+negative of same pass
+
+
+def test_forward_kwargs_on_frozen_arg_refolds():
+    # reference semantics: forward(**kwargs) updates ANY argument for
+    # the next run — including one declared frozen, whose folded
+    # constants must be invalidated (regression: stale fold served the
+    # old value)
+    w = mx.sym.var("w")
+    y = mx.sym.broadcast_mul(mx.sym.var("data"), w + 1.0)
+    graph_pass.set_passes("fold")
+    try:
+        ex = y.simple_bind(mx.cpu(), grad_req="null", data=(2, 3),
+                           w=(1, 3), frozen_params=["w"])
+    finally:
+        graph_pass.set_passes(None)
+    ones = mx.nd.ones((2, 3))
+    ex.copy_params_from({"w": mx.nd.ones((1, 3))}, {})
+    out = ex.forward(is_train=False, data=ones)[0].asnumpy()
+    np.testing.assert_allclose(out, 2.0)
+    out = ex.forward(is_train=False, data=ones,
+                     w=mx.nd.full((1, 3), 9.0))[0].asnumpy()
+    np.testing.assert_allclose(out, 10.0)
+
+
+def test_tuning_key_pinned_to_original_graph():
+    # exec.remat / serving entries are keyed on the ORIGINAL graph's
+    # fingerprint; a pass-rewritten program must keep resolving them
+    builder = ZOO["bn_heavy"]
+    sym, dshape = builder()
+    base = graph_pass.graph_fingerprint(sym)
+    _sym, args, auxs, x = _materialize(builder)
+    m1, _ = _predict(builder, "default", args, auxs, x)
+    ex = m1._exec_group.execs[0]
+    assert ex._opt is not None  # the graph really was rewritten
+    assert ex._prog.tuning_key() == base
+
+
+def test_training_bind_unchanged_by_default():
+    # a training bind under the default pipeline must lower the ORIGINAL
+    # symbol object (stable fingerprints, zero behavior change)
+    builder = ZOO["bn_heavy"]
+    graph_pass.set_passes("default")
+    try:
+        sym, dshape = builder()
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)],
+                 label_shapes=[("softmax_label", (dshape[0],))],
+                 for_training=True)
+        mod.init_params(mx.init.Uniform(0.1))
+        ex = mod._exec_group.execs[0]
+        assert ex._opt is None
+        assert ex._prog.symbol is sym
+    finally:
+        graph_pass.set_passes(None)

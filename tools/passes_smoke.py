@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""CPU-fast graph-pass smoke (tier-1 CI guard, docs/graph_passes.md).
+
+End-to-end in seconds on CPU: a BN+conv net is bound for inference under
+the default pass pipeline and verified the way production uses it:
+
+1. **node-count reduction** — BatchNorm nodes and the SoftmaxOutput
+   label plumbing must leave the compiled program (the pass layer's
+   reason to exist),
+2. **numeric parity** — optimized predictions match the unoptimized
+   program at fp32 tolerances,
+3. **flat re-bind cost** — reshaping to an already-seen batch shape
+   re-runs neither the pass pipeline (``graph_pass.stats``) nor XLA
+   compilation (``jit.compile_count``).
+
+Prints a one-line JSON summary (optionally written to argv[1]); any
+violation raises, failing the CI step.
+"""
+import json
+import os
+import sys
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "MXNET_TUNE_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="passes_smoke_"), "tuning.json"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import graph_pass  # noqa: E402
+from mxnet_tpu.io import NDArrayIter  # noqa: E402
+from mxnet_tpu.observability import metrics as M  # noqa: E402
+from mxnet_tpu.observability import set_enabled  # noqa: E402
+
+
+def _net():
+    data = mx.sym.var("data")
+    x = data
+    for i in range(2):
+        x = mx.sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                               no_bias=(i == 1), name="c%d" % i)
+        x = mx.sym.BatchNorm(x, name="bn%d" % i, fix_gamma=(i == 0))
+        x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Flatten(x)
+    x = mx.sym.FullyConnected(x, num_hidden=7, name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _bind(spec, dshape, args, auxs):
+    graph_pass.set_passes(spec)
+    try:
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)], for_training=False)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.set_params(args, auxs)
+        return mod
+    finally:
+        graph_pass.set_passes(None)
+
+
+def main(out_path=None):
+    rng = np.random.RandomState(11)
+    dshape = (4, 3, 10, 10)
+    sym = _net()
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=dshape)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    auxs = {n: mx.nd.array(rng.uniform(0.5, 1.5, s).astype(np.float32))
+            for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    x = rng.uniform(0, 1, dshape).astype(np.float32)
+
+    ref = _bind("off", dshape, args, auxs).predict(
+        NDArrayIter(x, None, batch_size=4)).asnumpy()
+
+    set_enabled(True)
+    graph_pass.reset_stats()
+    mod = _bind("default", dshape, args, auxs)
+    out = mod.predict(NDArrayIter(x, None, batch_size=4)).asnumpy()
+
+    # 1) numeric parity at fp32
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # 2) node-count reduction + structural facts
+    ex = mod._exec_group.execs[0]
+    opt = ex._opt
+    assert opt is not None, "default pipeline did not rewrite the graph"
+    assert opt.nodes_after < opt.nodes_before, \
+        "no node-count reduction: %d -> %d" % (opt.nodes_before,
+                                               opt.nodes_after)
+    prog_args = ex._prog.symbol.list_arguments()
+    assert "softmax_label" not in prog_args, "label plumbing survived"
+    assert not any(n.op == "BatchNorm" for n in ex._prog.topo), \
+        "BatchNorm survived bn_fold"
+
+    # 3) flat compile count + pipeline runs under re-binds
+    runs0 = graph_pass.stats()["pipeline_runs"]
+    small = x[:2]
+    mod.reshape([("data", small.shape)])
+    mod.predict(NDArrayIter(small, None, batch_size=2))
+    mod.reshape([("data", dshape)])
+    c0 = M.get_value("jit.compile_count", 0)
+    mod.predict(NDArrayIter(x, None, batch_size=4))
+    mod.reshape([("data", small.shape)])
+    mod.predict(NDArrayIter(small, None, batch_size=2))
+    assert M.get_value("jit.compile_count", 0) == c0, \
+        "a previously-seen shape recompiled after re-bind"
+    assert graph_pass.stats()["pipeline_runs"] == runs0, \
+        "re-binds re-ran the pass pipeline"
+
+    summary = {
+        "nodes_before": opt.nodes_before,
+        "nodes_after": opt.nodes_after,
+        "folded_constants": len(opt.fold_exprs),
+        "max_abs_diff": float(np.abs(out - ref).max()),
+        "pipeline_runs": graph_pass.stats()["pipeline_runs"],
+        "passes": [r["pass"] for r in opt.reports if r["rewrites"]],
+    }
+    set_enabled(False)
+    print(json.dumps(summary))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

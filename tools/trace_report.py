@@ -207,6 +207,50 @@ def format_compare(rows, path_a, path_b):
     return "\n".join(lines)
 
 
+def graph_pass_rows(payload):
+    """Per-pass provenance rows from a flight-recorder dump's
+    ``graph_pass`` provider section (observability/flight_recorder.py):
+    one row per pass per recently-built program, so a health dump
+    answers "did this program run under the bf16 rewrite, and what did
+    the pass layer fold/prune?"."""
+    section = (payload.get("providers", {}) or {}).get("graph_pass")
+    if not section:
+        return []
+    rows = []
+    for prog in section.get("recent", []):
+        tag = prog.get("graph", prog.get("program", "?"))
+        if "passes" not in prog:  # external program note (generation amp)
+            rows.append({"program": tag, "pass": "amp",
+                         "rewrites": 1 if prog.get("amp") else 0,
+                         "nodes_before": None, "nodes_after": None})
+            continue
+        for rep in prog["passes"]:
+            rows.append({
+                "program": tag, "pass": rep["pass"],
+                "rewrites": rep["rewrites"],
+                "nodes_before": rep["nodes_before"],
+                "nodes_after": rep["nodes_after"],
+                "amp": prog.get("amp", False),
+                "folded_constants": prog.get("folded_constants", 0)})
+    return rows
+
+
+def format_graph_pass(rows, path):
+    if not rows:
+        return "(no graph_pass provider section in %s)" % path
+    lines = ["# graph_pass provenance — %s" % path,
+             "%-18s %-10s %9s %13s %12s %6s" % (
+                 "program", "pass", "rewrites", "nodes_before",
+                 "nodes_after", "amp")]
+    for r in rows:
+        lines.append("%-18s %-10s %9s %13s %12s %6s" % (
+            str(r["program"])[:18], r["pass"], r["rewrites"],
+            "-" if r["nodes_before"] is None else r["nodes_before"],
+            "-" if r["nodes_after"] is None else r["nodes_after"],
+            "Y" if r.get("amp") else "-"))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="top-K op/phase time report from a chrome/XPlane trace")
@@ -218,10 +262,21 @@ def main(argv=None):
                          "executor, module, kvstore)")
     ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
                     help="diff two traces instead of reporting one")
+    ap.add_argument("--graph-passes", metavar="DUMP",
+                    help="print the graph_pass provider section of a "
+                         "flight-recorder dump (per-program pass summary: "
+                         "nodes folded/pruned, precision rewrites)")
     ap.add_argument("--json", action="store_true",
                     help="emit rows as JSON instead of a table")
     args = ap.parse_args(argv)
 
+    if args.graph_passes:
+        with open(args.graph_passes) as f:
+            payload = json.load(f)
+        rows = graph_pass_rows(payload)
+        print(json.dumps(rows, indent=1) if args.json
+              else format_graph_pass(rows, args.graph_passes))
+        return 0
     if args.compare:
         rows = compare(args.compare[0], args.compare[1], k=args.top_k,
                        cat=args.cat)
