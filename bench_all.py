@@ -26,9 +26,12 @@ Standalone gates/modes: --lint-clean (graftlint vs baseline),
 docs/resilience.md), --autotune (tuned-vs-default on the autotuner's
 knob families + the warm-cache <1%/step gate; docs/autotune.md).
 """
+import atexit
 import functools
+import itertools
 import json
 import os
+import shutil
 import sys
 import time
 import traceback
@@ -1385,6 +1388,208 @@ def bench_graph_passes():
     return results
 
 
+def bench_input_pipeline(gate_ratio=None):
+    """--input-pipeline: streaming pipeline vs the synchronous iterators
+    (ISSUE 10 acceptance). Three measurements plus two hard guards:
+
+    * iterator-only throughput — the MXNet-1.0 synchronous shape
+      (serial decode under a depth-2 PrefetchingIter) vs the async
+      streaming pipeline; the GATE is streaming >= 1.5x (the pooled
+      synchronous variant is recorded for context);
+    * fit-loop feed — a small conv net trained from each backend:
+      img/s and host-stall % (time the training thread spends waiting
+      on the iterator);
+    * exactness + compile flatness — both backends must produce
+      identical batch sequences, and the steady-state per-fit compile
+      delta must not grow under streaming.
+
+    Merges an "input_pipeline" section into BENCH_ALL.json.
+    """
+    import time as _time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability import metrics as M
+    from tools.io_smoke import build_rec
+
+    obs.set_enabled(True)
+    if gate_ratio is None:
+        gate_ratio = float(os.environ.get("MXNET_IO_GATE_RATIO", "1.5"))
+    # decode-bound geometry even under QUICK: the pipeline exists for
+    # JPEG-decode-dominated feeds (224px ImageNet-style), not toy tiles
+    n, size, bs = (160, 224, 16) if QUICK else (512, 224, 32)
+    epochs = 2 if QUICK else 3
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="bench_io_")
+    # reclaimed on process exit (covers the SystemExit gate paths too):
+    # repeated bench runs must not accumulate jpeg datasets in /tmp
+    atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+    rec, idx = build_rec(os.path.join(tmp, "data"), n=n, size=size)
+    shape = (3, size, size)
+
+    def make(kind):
+        if kind == "sync_serial":  # the MXNet-1.0 synchronous shape
+            return mx.io.ImageRecordIter(rec, shape, bs, path_imgidx=idx,
+                                         streaming=False,
+                                         preprocess_threads=1,
+                                         prefetch_buffer=2)
+        if kind == "sync_pooled":  # pre-ISSUE-10 default (decode pool)
+            return mx.io.ImageRecordIter(rec, shape, bs, path_imgidx=idx,
+                                         streaming=False)
+        return mx.io.ImageRecordIter(rec, shape, bs, path_imgidx=idx,
+                                     streaming=True)
+
+    def iter_throughput(kind):
+        it = make(kind)
+        try:
+            for _ in it:  # warm epoch (page cache, pools, staging)
+                pass
+            rows = 0
+            t0 = _time.perf_counter()
+            for _ in range(epochs):
+                it.reset()
+                for b in it:
+                    rows += bs - (b.pad or 0)
+            return rows / (_time.perf_counter() - t0)
+        finally:
+            it.close()
+
+    ips = {kind: iter_throughput(kind)
+           for kind in ("sync_serial", "sync_pooled", "streaming")}
+
+    # ---- exactness guard: identical batch sequences, sync vs streaming
+    # (lockstep compare-and-discard: a full 224px epoch materialized
+    # per arm would hold ~300 MB x2 of host RAM for the equality check)
+    ref_it, got_it = make("sync_pooled"), make("streaming")
+    try:
+        sentinel = object()
+        for i, (rb, gb) in enumerate(
+                itertools.zip_longest(ref_it, got_it, fillvalue=sentinel)):
+            if rb is sentinel or gb is sentinel:
+                raise SystemExit("bench_all --input-pipeline: exactness "
+                                 "guard failed: batch count diverged")
+            if int(rb.pad or 0) != int(gb.pad or 0) or \
+                    not np.array_equal(rb.data[0].asnumpy(),
+                                       gb.data[0].asnumpy()) or \
+                    not np.array_equal(rb.label[0].asnumpy(),
+                                       gb.label[0].asnumpy()):
+                raise SystemExit("bench_all --input-pipeline: exactness "
+                                 "guard failed at batch %d" % i)
+    finally:
+        ref_it.close()
+        got_it.close()
+
+    # ---- fit-loop feed: img/s + host-stall %
+    class _TimedIter:
+        """Times next()/StopIteration on the consumer thread — the
+        synchronous path's host-stall measurement."""
+
+        def __init__(self, inner):
+            self._it = inner
+            self.wait_s = 0.0
+            self.provide_data = inner.provide_data
+            self.provide_label = inner.provide_label
+            self.batch_size = inner.batch_size
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            t0 = _time.perf_counter()
+            try:
+                return next(self._it)
+            finally:
+                self.wait_s += _time.perf_counter() - t0
+
+        next = __next__
+
+        def reset(self):
+            self._it.reset()
+
+        def close(self):
+            self._it.close()
+
+    def build_net():
+        x = mx.sym.Variable("data")
+        x = mx.sym.Convolution(x, num_filter=16, kernel=(3, 3),
+                               stride=(2, 2), name="c1")
+        x = mx.sym.Activation(x, act_type="relu")
+        x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+        x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=10,
+                                  name="fc")
+        return mx.sym.SoftmaxOutput(x, name="softmax")
+
+    def fit_arm(kind):
+        np.random.seed(5)
+        mx.random.seed(5)
+        it = _TimedIter(make(kind))
+        mod = mx.mod.Module(build_net(), context=mx.gpu()
+                            if mx.context.num_gpus() else mx.cpu())
+        c0 = M.get_value("jit.compile_count", 0)
+        t0 = _time.perf_counter()
+        try:
+            mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                    optimizer_params=(("learning_rate", 0.01),),
+                    initializer=mx.init.Uniform(0.1))
+        finally:
+            it.close()
+        wall = _time.perf_counter() - t0
+        compiles = M.get_value("jit.compile_count", 0) - c0
+        return {"img_per_s": round(epochs * n / wall, 1),
+                "host_stall_pct": round(100.0 * it.wait_s / wall, 1),
+                "compiles": compiles}
+
+    fit_arm("sync_pooled")           # warm: model compiles once
+    fit_sync = fit_arm("sync_pooled")
+    fit_stream = fit_arm("streaming")
+    if fit_stream["compiles"] > fit_sync["compiles"]:
+        raise SystemExit(
+            "bench_all --input-pipeline: streaming added XLA compiles "
+            "(%d vs %d)" % (fit_stream["compiles"], fit_sync["compiles"]))
+
+    ratio = ips["streaming"] / ips["sync_serial"]
+    results = {
+        "protocol": "%d %dx%d jpgs, bs%d, %d epochs (iterator-only "
+                    "throughput; fit = conv net on %s)" % (
+                        n, size, size, bs, epochs,
+                        __import__("jax").devices()[0].platform),
+        "iterator_img_per_s": {k: round(v, 1) for k, v in ips.items()},
+        "streaming_vs_sync_serial": round(ratio, 3),
+        "streaming_vs_sync_pooled": round(
+            ips["streaming"] / ips["sync_pooled"], 3),
+        "fit": {"sync": fit_sync, "streaming": fit_stream},
+        "exactness": "identical batch sequences (sync == streaming)",
+        "gate_ratio": gate_ratio,
+        "quick": QUICK,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_ALL.json")
+    try:
+        with open(out_path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["input_pipeline"] = results
+    tmp_path = out_path + ".tmp.%d" % os.getpid()
+    with open(tmp_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp_path, out_path)
+    print(json.dumps({"input_pipeline": results}))
+    if ratio < gate_ratio:
+        raise SystemExit(
+            "bench_all --input-pipeline: streaming %.0f img/s is only "
+            "%.2fx the synchronous iterator's %.0f img/s (gate %.1fx)"
+            % (ips["streaming"], ratio, ips["sync_serial"], gate_ratio))
+    print("[bench_all] input pipeline: %.0f -> %.0f img/s (%.2fx), fit "
+          "host-stall %.1f%% -> %.1f%%, compiles flat"
+          % (ips["sync_serial"], ips["streaming"], ratio,
+             fit_sync["host_stall_pct"], fit_stream["host_stall_pct"]),
+          file=sys.stderr)
+    return results
+
+
 def assert_lint_clean():
     """--lint-clean: graftlint must exit 0 against the committed baseline.
 
@@ -1464,5 +1669,11 @@ if __name__ == "__main__":
         # pipeline (node-count reduction is a hard gate; latency is
         # recorded); merges a "graph_passes" section into BENCH_ALL.json
         bench_graph_passes()
+    elif "--input-pipeline" in sys.argv[1:]:
+        # streaming vs synchronous input pipeline: >=1.5x iterator
+        # throughput gate, fit-loop img/s + host-stall %, exactness +
+        # compile-flatness guards (docs/data_pipeline.md); merges an
+        # "input_pipeline" section into BENCH_ALL.json
+        bench_input_pipeline()
     else:
         main(telemetry="--telemetry" in sys.argv[1:])

@@ -68,6 +68,7 @@ from . import contrib
 from . import visualization
 from . import visualization as viz
 from . import parallel
+from . import runtime
 from . import serving
 from . import models
 from . import gluon

@@ -34,7 +34,8 @@ __all__ = ["cache", "registry", "cost_model", "search",
            "tunable_names", "SearchConfig", "SearchResult", "median_time",
            "tune_and_record", "mode", "enabled",
            "tune_flash_attention", "tune_serving_buckets", "tune_layout",
-           "tune_remat", "tune_generation", "flash_shape_key"]
+           "tune_remat", "tune_generation", "tune_input_pipeline",
+           "flash_shape_key"]
 
 
 # the layout knob has no single in-package call site (models take
@@ -81,6 +82,34 @@ declare(
     default=_flag_default("decode_blocks", "MXNET_GEN_DECODE_BLOCKS"),
     doc="Decode-attention key-block bound in tokens "
         "(paged_decode_attention's online-softmax streaming window).")
+
+
+# input-pipeline knobs (ISSUE 10): consulted by runtime/pipeline.py at
+# StreamingIter construction (explicit arg > tuning cache under
+# io_pipeline_key (host cores x batch geometry) > MXNET_IO_* flag >
+# auto), measured by tuners.tune_input_pipeline. The consuming pipeline
+# loads lazily, so — the graph.layout precedent — the declarations live
+# here where a fresh process registers them at import.
+declare(
+    "io.decode_workers",
+    space=lambda ctx: {"workers": tuple(sorted(set(
+        w for w in (1, 2, 4, 8, 16,
+                    int(ctx.get("cpus", 4)),
+                    max(1, int(ctx.get("cpus", 4)) // 2))
+        if w <= int(ctx.get("cpus", 4)))))},
+    default=_flag_default("workers", "MXNET_IO_DECODE_WORKERS"),
+    doc="Decode/augment worker-pool size of the streaming input "
+        "pipeline: JPEG decode + numpy augmenters release the GIL, so "
+        "throughput scales with workers until the host's cores (or its "
+        "memory bandwidth) saturate.")
+declare(
+    "io.prefetch_depth",
+    space={"depth": (2, 3, 4, 6, 8)},
+    default=_flag_default("depth", "MXNET_IO_PREFETCH_DEPTH"),
+    doc="Finished-batch queue bound of the streaming input pipeline, "
+        "in batches: how far decode may run ahead of the consumer "
+        "(absorbs decode-time jitter at the price of host batch "
+        "memory).")
 
 
 def mode():
@@ -142,6 +171,7 @@ def __getattr__(name):
     # __getattr__ through hasattr and recurses)
     if name in ("tune_flash_attention", "tune_serving_buckets",
                 "tune_layout", "tune_remat", "tune_generation",
+                "tune_input_pipeline", "pipeline_replay_measurer",
                 "generation_replay_measurer", "flash_shape_key", "tuners"):
         import importlib
 

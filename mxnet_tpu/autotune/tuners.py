@@ -23,13 +23,15 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..base import MXNetError
 from . import cache, registry
 from .search import SearchConfig, median_time, search
 
 __all__ = ["flash_shape_key", "tune_flash_attention",
            "serving_replay_measurer", "tune_serving_buckets",
            "tune_layout", "tune_remat", "tune_generation",
-           "generation_replay_measurer", "auto_tune"]
+           "generation_replay_measurer", "pipeline_replay_measurer",
+           "tune_input_pipeline", "auto_tune"]
 
 
 from .cost_model import pow2_at_least as _pow2_at_least
@@ -313,6 +315,80 @@ def tune_remat(measure, graph_key, trials=None):
     cache.record("exec.remat", graph_key, res.best, ms=res.best_s * 1e3,
                  trials=res.measured)
     return int(res.best["mirror"])
+
+
+def pipeline_replay_measurer(make_iter, batches=8):
+    """``measure(candidate) -> seconds`` over a live streaming input
+    pipeline: builds the iterator with the candidate's
+    ``workers``/``depth`` via the caller's ``make_iter(decode_workers=,
+    prefetch_depth=)`` factory and times the delivery of ``batches``
+    batches (the consumer-side rate is exactly what training sees)."""
+    import time
+
+    def measure(c):
+        it = make_iter(decode_workers=c.get("workers"),
+                       prefetch_depth=c.get("depth"))
+        try:
+            t0 = time.perf_counter()
+            n = 0
+            starved = 0
+            while n < batches:
+                try:
+                    next(it)
+                except StopIteration:
+                    # two consecutive epoch ends with no batch in
+                    # between = the stream yields nothing (empty record
+                    # file / empty shard): fail with a diagnostic
+                    # instead of spinning the search forever
+                    starved += 1
+                    if starved > 1:
+                        raise MXNetError(
+                            "pipeline_replay_measurer: iterator yields "
+                            "no batches (empty dataset or shard)")
+                    it.reset()
+                    continue
+                starved = 0
+                n += 1
+            return time.perf_counter() - t0
+        finally:
+            closer = getattr(it, "close", None)
+            if closer is not None:
+                closer()
+
+    return measure
+
+
+def tune_input_pipeline(make_iter, key, batches=8, trials=None,
+                        measure=None):
+    """Measured search over the streaming input pipeline's
+    ``io.decode_workers`` and ``io.prefetch_depth`` (worker count first,
+    then queue depth at the winning worker count); records both under
+    ``key`` (see ``runtime.pipeline.io_pipeline_key`` — the pipeline
+    self-sizes per HOST) and returns ``{op: winning value dict}``.
+
+    ``make_iter(decode_workers=, prefetch_depth=)`` must build a fresh
+    iterator (None = that knob's default); ``measure`` overrides the
+    live replay measurer (tests use a stub)."""
+    import os
+
+    ctx = {"cpus": os.cpu_count() or 4}
+    cfg = SearchConfig(trials=trials or 4, repeats=2, warmup=0)
+    base = measure or pipeline_replay_measurer(make_iter, batches)
+
+    res_w = search(registry.get("io.decode_workers"),
+                   lambda c: base({"workers": int(c["workers"])}),
+                   ctx=ctx, cfg=cfg)
+    cache.record("io.decode_workers", key, res_w.best,
+                 ms=res_w.best_s * 1e3, trials=res_w.measured)
+    workers = int(res_w.best["workers"])
+    res_d = search(registry.get("io.prefetch_depth"),
+                   lambda c: base({"workers": workers,
+                                   "depth": int(c["depth"])}),
+                   ctx=ctx, cfg=cfg)
+    cache.record("io.prefetch_depth", key, res_d.best,
+                 ms=res_d.best_s * 1e3, trials=res_d.measured)
+    return {"io.decode_workers": res_w.best,
+            "io.prefetch_depth": res_d.best}
 
 
 def auto_tune(op, key, ctx):

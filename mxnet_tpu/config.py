@@ -249,6 +249,38 @@ Flags currently honored:
     admission queue that stays full this long raises QueueFullError
     instead of blocking the caller indefinitely.
 
+``MXNET_IO_STREAMING`` (default 0)
+    Backend switch of the ``ImageRecordIter`` factory (runtime/,
+    docs/data_pipeline.md): 1 returns the async streaming pipeline
+    (:class:`~mxnet_tpu.runtime.pipeline.StreamingIter` — parallel
+    decode workers, batch assembly off the training thread,
+    double-buffered device staging); 0 keeps the MXNet-1.0 synchronous
+    shape (PrefetchingIter over ImageIter). Batch-for-batch identical
+    output either way for same-``seed`` (or unshuffled) streams without
+    random augmenters (tools/io_smoke.py guards it; random augmenters
+    draw per-worker randomness on both backends and are not
+    bit-reproducible across them); an explicit ``streaming=`` argument
+    wins over the flag.
+
+``MXNET_IO_DECODE_WORKERS`` (default 0 = auto)
+    Decode/augment worker-pool size of the streaming input pipeline.
+    0 sizes automatically (host cores, capped at 8). Resolution order
+    at iterator construction: explicit ``decode_workers=`` argument >
+    ``io.decode_workers`` tuning-cache entry
+    (``autotune.tune_input_pipeline``) > this flag > auto.
+
+``MXNET_IO_PREFETCH_DEPTH`` (default 2)
+    Bound of the streaming pipeline's finished-batch queue, in batches
+    — how far the decode stages may run ahead of the consumer (host
+    memory is the price of depth). Same resolution order as
+    MXNET_IO_DECODE_WORKERS via the ``io.prefetch_depth`` tunable.
+
+``MXNET_IO_STAGE_DEPTH`` (default 2)
+    Device-staging window of the streaming pipeline: how many batches
+    are kept transferred (one pytree ``device_put`` each) ahead of the
+    consumer. 2 = classic double buffering — batch N+1's transfer
+    overlaps batch N's compute; 1 disables the overlap (debug).
+
 ``MXNET_PROFILER_MODE`` (default ``symbolic``)
     Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
     trace can be captured from an unmodified script via env alone;
@@ -300,6 +332,10 @@ _DEFAULTS = {
     "MXNET_RETRY_DEADLINE_MS": 30000,
     "MXNET_SERVING_DEADLINE_MS": 0,
     "MXNET_SERVING_COOLDOWN_MS": 1000,
+    "MXNET_IO_STREAMING": 0,
+    "MXNET_IO_DECODE_WORKERS": 0,
+    "MXNET_IO_PREFETCH_DEPTH": 2,
+    "MXNET_IO_STAGE_DEPTH": 2,
 }
 
 

@@ -504,7 +504,8 @@ class ImageIter(_io.DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, dtype="float32",
-                 last_batch_handle="pad", preprocess_threads=0, **kwargs):
+                 last_batch_handle="pad", preprocess_threads=0, seed=None,
+                 **kwargs):
         super().__init__()
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
         assert len(data_shape) == 3 and data_shape[0] in (1, 3)
@@ -556,10 +557,24 @@ class ImageIter(_io.DataIter):
             assert 0 <= part_index < num_parts
             if self.seq is None:
                 raise MXNetError("sharding requires an index (.idx) or list")
-            n_per = len(self.seq) // num_parts
-            self.seq = self.seq[part_index * n_per:(part_index + 1) * n_per]
+            # dmlc InputSplit semantics (runtime/source.py): contiguous,
+            # disjoint AND complete — uneven remainders spread across
+            # parts, never dropped (the old //-based split lost up to
+            # num_parts-1 trailing records per epoch)
+            from ..runtime.source import shard_partition
+
+            lo, hi = shard_partition(len(self.seq), num_parts, part_index)
+            self.seq = self.seq[lo:hi]
 
         self.shuffle = shuffle
+        # a seeded private RNG makes the per-epoch shuffle reproducible
+        # (and the iterator position checkpointable via get_state);
+        # unseeded keeps the reference's module-level random behavior.
+        # Seeded epochs shuffle a CANONICAL base order — the same
+        # permutation semantics as runtime.source.RecordFileSource, so
+        # the two backends produce identical seeded epoch orders
+        self._rng = np.random.RandomState(seed) if seed is not None else None
+        self._base_seq = list(self.seq) if self.seq is not None else None
         if shuffle and self.seq is None:
             raise MXNetError(
                 "shuffle=True needs random access: provide path_imgidx (an "
@@ -575,6 +590,7 @@ class ImageIter(_io.DataIter):
                          if aug_list is None else aug_list)
         self.cur = 0
         self._allow_read = True
+        self._closed = False
         # parallel decode+augment pool (the ImageRecordIter
         # preprocess_threads analog, iter_image_recordio_2.cc:139-145's
         # OMP decode loop): PIL decode and the numpy augmenters release
@@ -583,7 +599,13 @@ class ImageIter(_io.DataIter):
         if preprocess_threads and preprocess_threads > 1:
             from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+            # bounded at the host's core count: decode threads beyond it
+            # only add contention (and idle threads to leak)
+            workers = min(int(preprocess_threads), os.cpu_count() or 1)
+            if workers > 1:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="mxnet-image-decode")
         self.last_batch_handle = last_batch_handle
         self.num_image = len(self.seq) if self.seq is not None else None
         self._cache_data = None
@@ -597,24 +619,75 @@ class ImageIter(_io.DataIter):
         self.reset()
 
     def reset(self):
+        if self._closed:
+            raise MXNetError("reset() on a closed ImageIter")
         if self.shuffle:
-            pyrandom.shuffle(self.seq)
+            if self._rng is not None:
+                self.seq = list(self._base_seq)
+                self._rng.shuffle(self.seq)
+            else:
+                pyrandom.shuffle(self.seq)
         if self.imgrec is not None and self.seq is None:
             self.imgrec.reset()
         self.cur = 0
 
     def close(self):
-        """Release the decode pool's worker threads (iterators rebuilt
-        per epoch would otherwise accumulate idle threads)."""
+        """Release the decode pool's worker threads AND the record
+        reader (iterators rebuilt per epoch would otherwise accumulate
+        idle threads and open file handles). Idempotent."""
+        self._closed = True
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            self._pool.shutdown(wait=True)
             self._pool = None
+        if self.imgrec is not None:
+            try:
+                self.imgrec.close()
+            except Exception:
+                pass  # gc/exit path: never raise out of close
+            self.imgrec = None
 
     def __del__(self):
         try:
             self.close()
         except Exception:
             pass
+
+    def skip_batches(self, n):
+        """Fast-forward ``n`` batches by cursor math (no decode)."""
+        if self.seq is None:
+            super().skip_batches(n)
+            return
+        self.cur = min(self.cur + int(n) * self.batch_size, len(self.seq))
+
+    def get_state(self):
+        """Cursor + this epoch's sample order + the RNG stream (when
+        seeded) — None for index-less sequential scans, which have no
+        checkpointable random-access position."""
+        if self.seq is None:
+            return None
+        from ..runtime.source import encode_rng_state
+
+        return {"cur": int(self.cur),
+                "seq": [int(k) for k in self.seq],
+                "rng": (encode_rng_state(self._rng)
+                        if self._rng is not None else None)}
+
+    def set_state(self, state):
+        if state is None:
+            return
+        if self.seq is None:
+            raise MXNetError("set_state on an index-less ImageIter")
+        from ..runtime.source import decode_rng_state
+
+        seq = [int(k) for k in state["seq"]]
+        if set(seq) != set(int(k) for k in self.seq):
+            raise MXNetError(
+                "iterator state does not match this dataset/shard "
+                "(different key sets)")
+        self.seq = seq
+        self.cur = int(state["cur"])
+        if state.get("rng") is not None:
+            self._rng = decode_rng_state(state["rng"])
 
     def _next_raw(self):
         """(label, payload, kind) with decode deferred — the IO half."""
@@ -658,6 +731,11 @@ class ImageIter(_io.DataIter):
             lab[:self.label_width]
 
     def next(self):
+        # close() released the record reader — a bare read would die on
+        # AttributeError; raise the lifecycle error like the other
+        # guarded iterators
+        if self._closed:
+            raise MXNetError("next() on a closed ImageIter")
         c, h, w = self.data_shape
         batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
         batch_label = np.zeros((self.batch_size, self.label_width),

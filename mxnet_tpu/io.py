@@ -25,7 +25,19 @@ from .ndarray import NDArray
 __all__ = ["DataDesc", "DataBatch", "DataIter", "MXDataIter",
            "ResizeIter", "PrefetchingIter", "NDArrayIter", "MNISTIter",
            "CSVIter", "ImageRecordIter", "ImageDetRecordIter",
-           "LibSVMIter", "pad_batch_to_bound"]
+           "LibSVMIter", "pad_batch_to_bound", "StreamingIter"]
+
+
+def __getattr__(attr):
+    # the streaming pipeline lives in runtime/ (it depends on image and
+    # recordio, which import this module) — expose it here lazily so
+    # ``mx.io.StreamingIter`` reads like the other iterators
+    if attr == "StreamingIter":
+        from .runtime.pipeline import StreamingIter
+
+        return StreamingIter
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, attr))
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -129,7 +141,16 @@ def pad_batch_to_bound(batch, data_descs, label_descs=None):
 
 
 class DataIter:
-    """Base iterator (reference: io.py:177)."""
+    """Base iterator (reference: io.py:177).
+
+    Beyond the reference surface, iterators here expose a small
+    position-checkpointing protocol (docs/data_pipeline.md):
+    ``get_state()`` returns a JSON-safe snapshot of the stream position
+    (or None when unsupported), ``set_state()`` restores it exactly —
+    shuffle order included — and ``skip_batches(n)`` fast-forwards.
+    ``fit(resume=)`` rides this to make resumed runs bit-exact in DATA
+    ORDER, not just model/RNG state.
+    """
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -139,6 +160,30 @@ class DataIter:
 
     def reset(self):
         pass
+
+    def close(self):
+        """Release background resources (threads, pools, readers);
+        idempotent. The base iterator holds none."""
+
+    def get_state(self):
+        """JSON-safe position snapshot, or None (not checkpointable)."""
+        return None
+
+    def set_state(self, state):
+        """Restore a :meth:`get_state` snapshot; raises when this
+        iterator cannot (a None state is always accepted as a no-op)."""
+        if state is not None:
+            raise MXNetError("%s does not support set_state"
+                             % type(self).__name__)
+
+    def skip_batches(self, n):
+        """Fast-forward ``n`` batches. The base implementation consumes
+        them; subclasses with random access override with cursor math."""
+        for _ in range(int(n)):
+            try:
+                self.next()
+            except StopIteration:
+                return
 
     def next(self):
         if self.iter_next():
@@ -234,9 +279,18 @@ class PrefetchingIter(DataIter):
                         for _ in range(self.n_iter)]
         self._stop = threading.Event()
         self._threads = []
+        self._life = threading.RLock()  # serializes close/reset/set_state
+        self._closed = False            # guarded-by: self._life
+        self._delivered = 0
+        self._child_states = None       # children's epoch-start states
         self._start_threads()
 
     def _start_threads(self):
+        # epoch-start child positions, captured BEFORE the producers
+        # start reading ahead — the half of get_state() that stays
+        # meaningful while the queues run ahead of the consumer
+        self._child_states = [getattr(i, "get_state", lambda: None)()
+                              for i in self.iters]
         stop, queues = self._stop, self._queues
 
         def put(q, item):
@@ -266,8 +320,9 @@ class PrefetchingIter(DataIter):
         for t in self._threads:
             t.start()
 
-    def close(self):
-        """Stop producer threads (also runs at gc/exit)."""
+    def _halt(self):
+        """Stop and join the producer threads, draining the queues so a
+        producer blocked on a full queue unwedges."""
         self._stop.set()
         for q in self._queues:
             while True:
@@ -279,6 +334,33 @@ class PrefetchingIter(DataIter):
             if t.is_alive():
                 t.join(timeout=5)
         self._threads = []
+
+    def close(self):
+        """Stop producer threads and close the wrapped iterators (their
+        decode pools / record readers). Idempotent, and safe against a
+        concurrent ``reset()`` — both take the lifecycle lock (also
+        runs at gc/exit)."""
+        with self._life:
+            if self._closed:
+                return
+            self._closed = True
+            self._halt()
+            # unwedge a next() that passed its _closed check before this
+            # close landed: with the producers joined its q.get() would
+            # block forever — the epoch-end sentinel turns the race into
+            # StopIteration
+            for q in self._queues:
+                try:
+                    q.put_nowait(None)
+                except queue.Full:
+                    pass
+            for i in self.iters:
+                closer = getattr(i, "close", None)
+                if closer is not None:
+                    try:
+                        closer()
+                    except Exception:
+                        pass  # gc/exit path: never raise out of close
 
     def __del__(self):
         try:
@@ -304,28 +386,123 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def reset(self):
-        # drain, stop producers, reset children, restart
+    def _restart(self):
         depth = self._queues[0].maxsize if self._queues else 2
-        self.close()
-        for i in self.iters:
-            i.reset()
         self._stop = threading.Event()
         self._queues = [queue.Queue(maxsize=depth)
                         for _ in range(self.n_iter)]
         self._start_threads()
 
+    def reset(self):
+        # drain, stop producers, reset children, restart
+        with self._life:
+            if self._closed:
+                raise MXNetError("reset() on a closed PrefetchingIter")
+            self._halt()
+            for i in self.iters:
+                i.reset()
+            self._delivered = 0
+            self._restart()
+
     def next(self):
+        # unlocked flag read: after close() the producers are joined and
+        # the queues drained, so q.get() would block forever — raise like
+        # the other lifecycle-guarded methods instead
+        if self._closed:
+            raise MXNetError("next() on a closed PrefetchingIter")
         batches = [q.get() for q in self._queues]
         if any(b is None for b in batches):
             assert all(b is None for b in batches), \
                 "Number of entry mismatches between iterators"
             raise StopIteration
+        self._delivered += 1
         return DataBatch(
             data=sum([b.data for b in batches], []),
             label=sum([(b.label or []) for b in batches], []),
             pad=batches[0].pad, index=batches[0].index,
             provide_data=self.provide_data, provide_label=self.provide_label)
+
+    def get_state(self):
+        """Epoch-start child states + batches delivered — exactly
+        reconstructible no matter how far the producers read ahead;
+        None when any wrapped iterator is not checkpointable."""
+        if self._child_states is None or \
+                any(s is None for s in self._child_states):
+            return None
+        return {"children": list(self._child_states),
+                "delivered": int(self._delivered)}
+
+    def set_state(self, state):
+        if state is None:
+            return
+        with self._life:
+            if self._closed:
+                raise MXNetError("set_state() on a closed PrefetchingIter")
+            if len(state["children"]) != len(self.iters):
+                # validate BEFORE halting: a zip would silently truncate
+                # and leave the unmatched children at misaligned positions
+                raise MXNetError(
+                    "iterator state holds %d child streams, this "
+                    "PrefetchingIter wraps %d"
+                    % (len(state["children"]), len(self.iters)))
+            self._halt()
+            try:
+                delivered = int(state.get("delivered", 0))
+                for child, s in zip(self.iters, state["children"]):
+                    child.set_state(s)
+                    child.skip_batches(delivered)
+            except BaseException:
+                # a child rejected its snapshot AFTER earlier children
+                # restored: re-align everyone to a fresh epoch start so
+                # the restart below can never serve batches that pair
+                # rows from different stream positions
+                for child in self.iters:
+                    child.reset()
+                raise
+            finally:
+                # restart EVEN on failure (mismatched dataset/shard):
+                # fit's consume-and-skip fallback needs live producers,
+                # not a pipeline wedged between _halt() and _restart().
+                # _restart snapshots the (fast-forwarded) child
+                # positions as the new base, so the delivered counter
+                # restarts at 0 — get_state stays exactly
+                # reconstructible after a restore
+                self._restart()
+                self._delivered = 0
+
+    def skip_batches(self, n):
+        """Fast-forward by the children's cursor math — no decode, no
+        queue consumption (the base implementation would make the
+        producers decode every skipped batch).
+
+        Positions ABSOLUTELY from the epoch-start base at
+        ``delivered + n`` (the StreamingIter discipline): the producers
+        may already have read ahead of the consumer, so a relative skip
+        from the children's current cursors would overshoot by whatever
+        they prefetched."""
+        if n <= 0:
+            return
+        with self._life:
+            if self._closed:
+                raise MXNetError("skip_batches() on a closed "
+                                 "PrefetchingIter")
+            states = self._child_states
+            if states is None or any(s is None for s in states):
+                # no checkpointable base: consume-and-discard (exact,
+                # but decodes the skipped batches)
+                return super().skip_batches(n)
+            self._halt()
+            try:
+                target = self._delivered + int(n)
+                for child, s in zip(self.iters, states):
+                    child.set_state(s)
+                    child.skip_batches(target)
+            finally:
+                # _restart re-bases the child snapshots, so the
+                # delivered counter restarts at 0 (the set_state
+                # discipline) — get_state stays exactly reconstructible
+                self._restart()
+                self._delivered = 0
 
     def iter_next(self):
         try:
@@ -450,6 +627,47 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - self.num_data
         return 0
 
+    def skip_batches(self, n):
+        self.cursor += int(n) * self.batch_size
+
+    def get_state(self):
+        """Cursor + the construction-time shuffle permutation (the data
+        order is fixed for the iterator's lifetime, so the permutation
+        plus the cursor pin the stream position exactly)."""
+        return {"cursor": int(self.cursor),
+                "idx": np.asarray(self.idx).tolist()}
+
+    def set_state(self, state):
+        """Restore a snapshot — possibly from another process whose
+        construction-time shuffle differed: the data is re-gathered into
+        the saved permutation's order first."""
+        if state is None:
+            return
+        saved = np.asarray(state["idx"], dtype=np.int64)
+        current = np.asarray(self.idx, dtype=np.int64)
+        if saved.shape != current.shape:
+            raise MXNetError(
+                "iterator state does not match this dataset "
+                "(%d vs %d indexed rows)" % (saved.size, current.size))
+        if not np.array_equal(saved, current):
+            if len(current) != self.data_list[0].shape[0]:
+                raise MXNetError(
+                    "cannot restore a shuffled-state snapshot onto a "
+                    "truncated (last_batch_handle='discard') iterator "
+                    "with a different permutation")
+            inverse = np.empty_like(current)
+            inverse[current] = np.arange(len(current))
+            take = inverse[saved]
+            # one-time host gather at restore — not a training-path sync
+            self.data = [(k, nd.array(v.asnumpy()[take]))  # graftlint: disable=G001
+                         for k, v in self.data]
+            self.label = [(k, nd.array(v.asnumpy()[take]))  # graftlint: disable=G001
+                          for k, v in self.label]
+            self.data_list = [x[1] for x in self.data] + \
+                [x[1] for x in self.label]
+            self.idx = saved
+        self.cursor = int(state["cursor"])
+
 
 def _read_idx_ubyte(path):
     """Read an MNIST idx-format file, gzipped or raw."""
@@ -511,18 +729,35 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
                     shuffle=False, rand_crop=False, rand_mirror=False,
                     resize=0, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                     std_r=1.0, std_g=1.0, std_b=1.0, label_width=1,
-                    num_parts=1, part_index=0, preprocess_threads=4,
-                    prefetch_buffer=4, dtype="float32", **kwargs):
+                    num_parts=1, part_index=0, preprocess_threads=None,
+                    prefetch_buffer=None, dtype="float32", seed=None,
+                    streaming=None, **kwargs):
     """Factory mirroring the C++ ImageRecordIter registration
     (reference: src/io/iter_image_recordio_2.cc:50 ImageRecordIOParser2 +
     MXNET_REGISTER_IO_ITER(ImageRecordIter); python surface io.py:762
     MXDataIter): a record-file image source with the default augmenter
-    stack, distributed num_parts/part_index sharding, and a
-    double-buffered prefetch thread (iter_prefetcher.h:47).
+    stack, distributed num_parts/part_index sharding, and prefetching.
 
-    Returns a PrefetchingIter wrapping an image.ImageIter.
+    Two backends behind one surface (docs/data_pipeline.md):
+
+    * ``streaming=False`` (the MXNET-1.0 shape) — a PrefetchingIter
+      wrapping an image.ImageIter: one prefetch thread double-buffering
+      synchronous batch assembly (iter_prefetcher.h:47);
+    * ``streaming=True`` (or ``MXNET_IO_STREAMING=1``) — the async
+      runtime pipeline (:class:`~mxnet_tpu.runtime.pipeline.StreamingIter`):
+      parallel decode workers, batch assembly + padding off the
+      training thread, double-buffered device staging. Batch-for-batch
+      identical output for unshuffled or same-``seed`` streams with
+      deterministic augmenters (tools/io_smoke.py guards it); unseeded
+      shuffles draw a fresh order per construction, and random
+      augmenters per-worker randomness, on both backends.
+
+    ``preprocess_threads``/``prefetch_buffer`` left at None defer to
+    the ``io.decode_workers``/``io.prefetch_depth`` autotuner entries,
+    then the ``MXNET_IO_*`` flags (streaming path), or the reference
+    defaults of 4 (synchronous path).
     """
-    from .image import ImageIter
+    from .config import get_flag
 
     mean = None
     if mean_r or mean_g or mean_b:
@@ -530,14 +765,51 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
     std = None
     if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
         std = np.array([std_r, std_g, std_b], np.float32)
+    if streaming is None:
+        streaming = bool(get_flag("MXNET_IO_STREAMING"))
+        if streaming:
+            # the GLOBAL flag must not hard-fail workloads only the
+            # synchronous backend supports (an index-less .rec falls
+            # back to sequential imgrec.read() there; the streaming
+            # source needs random access) — degrade with a warning.
+            # An explicit streaming=True argument keeps the clear error.
+            if path_imgidx is None:
+                guess = os.path.splitext(path_imgrec)[0] + ".idx"
+                if not os.path.exists(guess):
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "MXNET_IO_STREAMING=1 ignored for %r: the "
+                        "streaming source needs a .idx companion "
+                        "(falling back to the synchronous backend)",
+                        path_imgrec)
+                    streaming = False
+    if streaming:
+        from .runtime.pipeline import StreamingIter
+
+        return StreamingIter(
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+            data_shape=tuple(data_shape), batch_size=batch_size,
+            label_width=label_width, shuffle=shuffle,
+            seed=seed, num_parts=num_parts,
+            part_index=part_index, dtype=dtype,
+            decode_workers=preprocess_threads,
+            prefetch_depth=prefetch_buffer, resize=resize,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean,
+            std=std, **kwargs)
+    from .image import ImageIter
+
     inner = ImageIter(
         batch_size=batch_size, data_shape=tuple(data_shape),
         label_width=label_width, path_imgrec=path_imgrec,
         path_imgidx=path_imgidx, shuffle=shuffle, part_index=part_index,
         num_parts=num_parts, dtype=dtype, resize=resize,
         rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
-        preprocess_threads=preprocess_threads, **kwargs)
-    return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
+        seed=seed,
+        preprocess_threads=(4 if preprocess_threads is None
+                            else preprocess_threads), **kwargs)
+    return PrefetchingIter(inner, prefetch_depth=(
+        4 if prefetch_buffer is None else prefetch_buffer))
 
 
 def ImageDetRecordIter(path_imgrec, data_shape, batch_size,

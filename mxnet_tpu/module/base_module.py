@@ -13,6 +13,7 @@ import logging
 import time
 
 from .. import metric as metric_mod
+from ..base import MXNetError
 from ..model import BatchEndParam
 from .. import ndarray as nd
 from ..context import cpu
@@ -150,6 +151,7 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        restored_iter = False
         if resume_state is not None:
             if resume_state.optimizer_states is not None:
                 self.load_optimizer_states(resume_state.optimizer_states)
@@ -157,6 +159,59 @@ class BaseModule:
                 from .. import random as _random
 
                 _random.set_state(resume_state.rng_state)
+            if resume_state.iterator_state is not None:
+                # restore the checkpointed EPOCH-START stream state
+                # (shuffle order + RNG stream) and fast-forward to the
+                # checkpointed batch by cursor math — bit-exact in DATA
+                # ORDER even for per-epoch-shuffling iterators, where
+                # the consume-and-discard fallback below could not
+                # reproduce the interrupted epoch's permutation.
+                # save_resumable(data_iter=)'s convenience instead
+                # tags the iterator's CURRENT position ({"kind":
+                # "exact", "at_batch": b}): set_state alone lands on
+                # batch b, so only batches trained after the capture
+                # fast-forward
+                state = resume_state.iterator_state
+                at_batch = 0
+                if (isinstance(state, dict)
+                        and state.get("kind") == "exact"):
+                    at_batch = int(state.get("at_batch", 0))
+                    state = state["state"]
+                try:
+                    train_data.set_state(state)
+                except (MXNetError, KeyError, TypeError,
+                        AttributeError) as err:
+                    # AttributeError included: a duck-typed iterator
+                    # without set_state must fall back, not crash the
+                    # resume
+                    self.logger.warning(
+                        "resume: could not restore iterator state (%s); "
+                        "fast-forwarding %d batches instead", err,
+                        resume_state.batch)
+                else:
+                    # the stream is REPOSITIONED now — the fallback
+                    # below would double-skip, so a missing
+                    # skip_batches degrades to consuming just the delta
+                    delta = max(0, resume_state.batch - at_batch)
+                    try:
+                        train_data.skip_batches(delta)
+                    except AttributeError:
+                        for _ in range(delta):
+                            try:
+                                train_data.next()
+                            except StopIteration:
+                                break
+                    restored_iter = True
+        # the current epoch's start-of-stream snapshot rides every
+        # checkpoint written this epoch (see save_resumable's contract).
+        # Captured only when a guard is armed: the snapshot is O(dataset)
+        # for shuffling iterators (full epoch permutation), dead weight
+        # for non-resumable runs
+        iter_state = None
+        if guard is not None:
+            iter_state = (resume_state.iterator_state if restored_iter
+                          else getattr(train_data, "get_state",
+                                       lambda: None)())
 
         train_metric = _resolve_metric(eval_metric)
         validation_metric = (train_metric if validation_metric is None
@@ -167,15 +222,22 @@ class BaseModule:
             for epoch in range(begin_epoch, num_epoch):
                 started = time.time()
                 train_metric.reset()
+                resumed_here = (resume_state is not None
+                                and epoch == resume_state.epoch)
+                # a restored iterator is already positioned mid-epoch —
+                # only the batch NUMBERING fast-forwards; otherwise the
+                # deterministic replay consumes the leading batches
                 skip = (resume_state.batch
-                        if resume_state is not None
-                        and epoch == resume_state.epoch else 0)
+                        if resumed_here and not restored_iter else 0)
+                start = (resume_state.batch
+                         if resumed_here and restored_iter else 0)
                 # epoch-loop transfer is the end-of-epoch metric/monitor
                 # report plus the (cold) preemption-checkpoint path
                 nbatch, completed_steps = self._fit_epoch(  # graftlint: disable=G001
                     train_data, train_metric, monitor, batch_end_callback,
-                    epoch, skip_batches=skip, guard=guard,
-                    completed_steps=completed_steps)
+                    epoch, skip_batches=skip, start_batch=start,
+                    guard=guard, completed_steps=completed_steps,
+                    iter_state=iter_state)
 
                 for name, val in train_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
@@ -200,12 +262,20 @@ class BaseModule:
                         self.logger.info("Epoch[%d] Validation-%s=%f",
                                          epoch, name, val)
                 train_data.reset()
+                # the NEXT epoch's start state: reset() just drew its
+                # shuffle order, so this snapshot pins it for both the
+                # turnover checkpoint below and any mid-epoch one later
+                # (guard-armed runs only — O(dataset) for shufflers)
+                if guard is not None:
+                    iter_state = getattr(train_data, "get_state",
+                                         lambda: None)()
                 if guard is not None and guard.triggered:
                     # preempted during eval/epoch turnover: position is
                     # the top of the next epoch
                     guard.checkpoint_and_raise(self, epoch=epoch + 1,
                                                batch=0,
-                                               step=completed_steps)
+                                               step=completed_steps,
+                                               iterator_state=iter_state)
         finally:
             if guard is not None:
                 guard.disarm()
@@ -215,18 +285,20 @@ class BaseModule:
             health.flush()
 
     def _fit_epoch(self, train_data, train_metric, monitor,
-                   batch_end_callback, epoch, skip_batches=0, guard=None,
-                   completed_steps=0):
+                   batch_end_callback, epoch, skip_batches=0, start_batch=0,
+                   guard=None, completed_steps=0, iter_state=None):
         """One pass over train_data; returns (batches consumed this
         epoch, completed training steps overall).
 
         ``skip_batches`` fast-forwards a resumed epoch to its
         checkpointed position (the batches are consumed, not trained —
-        deterministic iterators replay identically after reset).
+        deterministic iterators replay identically after reset);
+        ``start_batch`` instead just offsets the batch NUMBERING when
+        the iterator itself was repositioned via ``set_state``.
         ``guard`` is the :class:`PreemptionGuard` polled between steps:
         when SIGTERM flagged it, the in-flight step has just finished,
         so the checkpoint written here is step-consistent."""
-        nbatch = 0
+        nbatch = start_batch
         eval_metric = train_metric  # keep legacy name visible in locals()
         for data_batch, _is_last, upcoming in _lookahead(train_data):
             if nbatch < skip_batches:
@@ -266,10 +338,14 @@ class BaseModule:
             completed_steps += 1
             if guard is not None and guard.triggered:
                 # the in-flight step just completed; checkpoint at this
-                # exact position and unwind (PreemptedError)
+                # exact position and unwind (PreemptedError). The
+                # iterator state is the EPOCH-START snapshot — resume
+                # restores it and skips `nbatch` batches, exact no
+                # matter how far the pipeline has read ahead
                 guard.checkpoint_and_raise(self, epoch=epoch,
                                            batch=nbatch,
-                                           step=completed_steps)
+                                           step=completed_steps,
+                                           iterator_state=iter_state)
         return nbatch, completed_steps
 
     def _health_check(self, wall_s):

@@ -51,10 +51,11 @@ class CheckpointState:
     """One validated checkpoint, loaded back to host values."""
 
     __slots__ = ("path", "epoch", "batch", "step", "arg_params",
-                 "aux_params", "optimizer_states", "rng_state", "meta")
+                 "aux_params", "optimizer_states", "rng_state",
+                 "iterator_state", "meta")
 
     def __init__(self, path, epoch, batch, step, arg_params, aux_params,
-                 optimizer_states, rng_state, meta):
+                 optimizer_states, rng_state, meta, iterator_state=None):
         self.path = path
         self.epoch = epoch
         self.batch = batch          # completed batches within `epoch`
@@ -63,6 +64,10 @@ class CheckpointState:
         self.aux_params = aux_params
         self.optimizer_states = optimizer_states  # file path or None
         self.rng_state = rng_state  # uint32 key array or None
+        # DataIter.get_state() snapshot (shuffle order + cursor) or
+        # None — fit(resume=) restores it so the resumed run is
+        # bit-exact in data order too
+        self.iterator_state = iterator_state
         self.meta = meta
 
 
@@ -75,7 +80,8 @@ def _sha256(path):
 
 
 def write_resumable(directory, arg_params, aux_params, epoch, batch, step,
-                    optimizer_saver=None, rng_state=None, extra=None):
+                    optimizer_saver=None, rng_state=None, extra=None,
+                    iterator_state=None):
     """Write one resumable checkpoint; returns its directory path.
 
     ``arg_params``/``aux_params``: host NDArray dicts (as returned by
@@ -83,8 +89,11 @@ def write_resumable(directory, arg_params, aux_params, epoch, batch, step,
     file path and writing the optimizer-state blob there (e.g.
     ``module.save_optimizer_states``) — a callback because the kvstore
     path gathers shard blobs itself. ``rng_state``: the
-    ``mx.random.get_state()`` array. The manifest lands atomically last;
-    everything before it is invisible to :func:`load_latest`.
+    ``mx.random.get_state()`` array. ``iterator_state``: a JSON-safe
+    ``DataIter.get_state()`` snapshot (shuffle order + cursor) so the
+    resumed run replays the identical data order. The manifest lands
+    atomically last; everything before it is invisible to
+    :func:`load_latest`.
     """
     from .. import ndarray as nd
     from ..context import cpu
@@ -127,6 +136,11 @@ def write_resumable(directory, arg_params, aux_params, epoch, batch, step,
                 np.asarray(rng_state, dtype=np.uint32))
         _add("rng.npy")
 
+    if iterator_state is not None:
+        with open(os.path.join(ckpt_dir, "iterator.json"), "w") as sink:
+            json.dump(iterator_state, sink)
+        _add("iterator.json")
+
     ring_path = os.path.join(ckpt_dir, "ring.json")
     with open(ring_path, "w") as sink:
         json.dump(flight_recorder.snapshot(), sink, default=repr)
@@ -152,19 +166,47 @@ def write_resumable(directory, arg_params, aux_params, epoch, batch, step,
     return ckpt_dir
 
 
-def save_resumable(module, directory, epoch, batch, step):
+def save_resumable(module, directory, epoch, batch, step, data_iter=None,
+                   iterator_state=None):
     """Checkpoint a bound, initialized module (params + optimizer state
-    + RNG stream + position) — the one-call form the preemption guard
-    and user code share."""
+    + RNG stream + position, plus the data stream position when
+    checkpointable) — the one-call form the preemption guard and user
+    code share.
+
+    ``iterator_state`` should be the iterator's EPOCH-START
+    ``get_state()`` snapshot; resume restores it and fast-forwards
+    ``batch`` batches by cursor math. (A mid-epoch snapshot would be
+    skewed by however far a prefetching pipeline has read ahead of the
+    trained position — the epoch-start + skip contract is exact for any
+    read-ahead depth.) ``data_iter`` is a convenience for direct calls
+    where the caller owns the iterator's read position: its current
+    ``get_state()`` is captured and tagged with ``batch`` so resume
+    fast-forwards only batches trained AFTER the capture —
+    ``set_state`` alone already lands on the captured position, and a
+    further ``skip_batches(batch)`` would double-skip the data. Do NOT
+    pass the iterator a running ``fit`` is consuming (e.g. from a
+    ``batch_end_callback``): fit reads one batch ahead, so a mid-fit
+    ``get_state()`` sits one batch past the trained position and the
+    resumed run would silently skip that batch — ``fit(resume=)``'s
+    built-in preemption checkpoint captures mid-fit positions exactly
+    and is the right tool there."""
     from .. import random as _random
 
     arg_params, aux_params = module.get_params()
     saver = (module.save_optimizer_states
              if getattr(module, "optimizer_initialized", False) else None)
+    if iterator_state is None and data_iter is not None:
+        getter = getattr(data_iter, "get_state", None)
+        if getter is not None:
+            snap = getter()  # None when not checkpointable
+            if snap is not None:
+                iterator_state = {"kind": "exact", "at_batch": int(batch),
+                                  "state": snap}
     return write_resumable(directory, arg_params, aux_params,
                            epoch=epoch, batch=batch, step=step,
                            optimizer_saver=saver,
-                           rng_state=_random.get_state())
+                           rng_state=_random.get_state(),
+                           iterator_state=iterator_state)
 
 
 def list_checkpoints(directory):
@@ -236,6 +278,10 @@ def load_latest(directory):
             import numpy as np
 
             rng_state = np.load(rng_path)
+        iterator_state = None
+        if "iterator.json" in manifest["files"]:
+            with open(os.path.join(ckpt_dir, "iterator.json")) as src:
+                iterator_state = json.load(src)
         return CheckpointState(
             ckpt_dir, epoch=int(manifest.get("epoch", 0)),
             batch=int(manifest.get("batch", 0)),
@@ -243,7 +289,8 @@ def load_latest(directory):
             arg_params=arg_params, aux_params=aux_params,
             optimizer_states=(opt_path if "optimizer.states"
                               in manifest["files"] else None),
-            rng_state=rng_state, meta=manifest)
+            rng_state=rng_state, iterator_state=iterator_state,
+            meta=manifest)
     return None
 
 

@@ -86,16 +86,20 @@ class PreemptionGuard:
             except (ValueError, OSError):
                 pass
 
-    def checkpoint_and_raise(self, module, epoch, batch, step):
-        """Write the resumable checkpoint and unwind with
-        :class:`PreemptedError`; the guard disarms first so a second
-        SIGTERM during the write falls through to the default/previous
-        handler (the grace window is not infinite)."""
+    def checkpoint_and_raise(self, module, epoch, batch, step,
+                             iterator_state=None):
+        """Write the resumable checkpoint (the data stream's EPOCH-START
+        state included when the caller captured one — see
+        ``save_resumable``) and unwind with :class:`PreemptedError`; the
+        guard disarms first so a second SIGTERM during the write falls
+        through to the default/previous handler (the grace window is
+        not infinite)."""
         self.disarm()
         logging.warning("resilience: SIGTERM received — checkpointing at "
                         "epoch %d batch %d (step %d) into %s",
                         epoch, batch, step, self.directory)
         path = _checkpoint.save_resumable(module, self.directory,
                                           epoch=epoch, batch=batch,
-                                          step=step)
+                                          step=step,
+                                          iterator_state=iterator_state)
         raise PreemptedError(path)

@@ -1,0 +1,30 @@
+"""Runtime data-movement layer (ISSUE 10; ROADMAP item 4).
+
+The machinery that keeps the accelerator fed, shared by training and
+serving:
+
+* :mod:`.staging` — one-pytree device transfers and the bounded
+  in-flight :class:`~mxnet_tpu.runtime.staging.PipelineWindow` (the
+  double-buffer core the serving dispatcher and the streaming input
+  pipeline both consume);
+* :mod:`.source` — shard-aware record sources
+  (``num_parts``/``part_index`` partitions verified disjoint and
+  complete) with seedable, checkpointable epoch order;
+* :mod:`.pipeline` — :class:`~mxnet_tpu.runtime.pipeline.StreamingIter`,
+  the async streaming input pipeline: parallel host decode workers,
+  batch assembly (padding included) off the training thread, and
+  double-buffered ``device_put`` staging, with per-stage telemetry
+  (``io.*`` metrics + the "io" flight-recorder provider).
+
+Quick start: docs/data_pipeline.md.
+"""
+from . import pipeline, source, staging
+from .pipeline import (StreamingIter, io_pipeline_key,
+                       resolve_decode_workers, resolve_prefetch_depth)
+from .source import RecordFileSource, shard_partition
+from .staging import PipelineWindow, stage_pytree
+
+__all__ = ["staging", "source", "pipeline", "stage_pytree",
+           "PipelineWindow", "RecordFileSource", "shard_partition",
+           "StreamingIter", "io_pipeline_key", "resolve_decode_workers",
+           "resolve_prefetch_depth"]

@@ -1,0 +1,118 @@
+"""Shared device-staging machinery: one-pytree transfers and the bounded
+in-flight window behind every double-buffered dispatch path (ISSUE 10).
+
+Two consumers, ONE implementation:
+
+* ``serving/engine.py``'s pipelined dispatcher — batch N+1 is staged
+  (one pytree ``device_put``) and dispatched while batch N executes,
+  host fetches drain at the window boundary;
+* the training input pipeline (:mod:`.pipeline`) — the next host batch
+  transfers to the device while the current one is being consumed by
+  the compiled train step.
+
+``jax.device_put`` is *asynchronous*: staging returns as soon as the
+transfer is enqueued, so double buffering needs no extra thread — only
+the discipline of (a) transferring the WHOLE batch as one pytree (one
+transfer program, not one per array) and (b) keeping a bounded window
+of in-flight work so host-side fetches/consumption happen while the
+next transfer (or execution) is already running. Both live here.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+__all__ = ["stage_pytree", "PipelineWindow"]
+
+
+def stage_pytree(tree, device=None):
+    """Transfer an arbitrary pytree of host arrays to ``device`` as ONE
+    ``jax.device_put`` — the single-transfer discipline shared by the
+    serving dispatcher and the training input pipeline. Asynchronous:
+    returns device arrays immediately, the copy overlaps whatever the
+    device (and the host) do next."""
+    import jax
+
+    if device is None:
+        return jax.device_put(tree)
+    return jax.device_put(tree, device)
+
+
+class PipelineWindow:
+    """A bounded window of in-flight entries (FIFO).
+
+    The caller pushes staged/dispatched work and pops the oldest entry
+    when the window is full (or when there is nothing better to do) —
+    batch N's results are fetched while batch N+1 executes. The window
+    itself is policy-free: what an "entry" is and what popping means
+    (host fetch, consumption) belong to the caller.
+
+    Single-owner by design — the serving dispatcher thread, or the
+    iterator's consumer — so no lock; ``snapshot()`` is the one
+    concurrent reader (crash-dump providers) and tolerates a racing
+    mutation.
+    """
+
+    __slots__ = ("depth", "_entries", "_pushed", "_wait_s")
+
+    def __init__(self, depth):
+        if depth < 1:
+            raise ValueError("window depth must be >= 1, got %r" % (depth,))
+        self.depth = int(depth)
+        self._entries = collections.deque()
+        self._pushed = 0
+        self._wait_s = 0.0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __bool__(self):
+        return bool(self._entries)
+
+    @property
+    def full(self):
+        return len(self._entries) >= self.depth
+
+    @property
+    def pushed(self):
+        """Total entries ever pushed (occupancy accounting)."""
+        return self._pushed
+
+    @property
+    def wait_s(self):
+        """Cumulative seconds spent inside timed ``pop`` finalizers —
+        the window's measured drain cost (input- vs compute-bound
+        attribution)."""
+        return self._wait_s
+
+    def push(self, entry):
+        self._entries.append(entry)
+        self._pushed += 1
+        return entry
+
+    def pop(self):
+        """Oldest in-flight entry (the caller fetches/consumes it);
+        raises IndexError when empty — callers gate on ``bool(self)``."""
+        return self._entries.popleft()
+
+    def pop_timed(self, finalize):
+        """Pop the oldest entry and run ``finalize(entry)`` on it,
+        accounting the wall time into :attr:`wait_s`. Returns
+        ``finalize``'s result."""
+        entry = self._entries.popleft()
+        t0 = time.perf_counter()
+        try:
+            return finalize(entry)
+        finally:
+            self._wait_s += time.perf_counter() - t0
+
+    def snapshot(self):
+        """Best-effort copy for crash-dump providers: the owning thread
+        may mutate concurrently; a torn read degrades to []."""
+        try:
+            return list(self._entries)
+        except RuntimeError:  # deque mutated mid-iteration
+            return []
+
+    def clear(self):
+        self._entries.clear()

@@ -22,8 +22,13 @@ This module gives the *request path* the same treatment (ISSUE 5):
   (one pytree ``device_put``) and dispatched while batch N executes,
   and host fetches drain in that bounded window — the serving-path
   extension of the bounded-window fetch fix in ``FeedForward.predict``.
-  Replicas (one per device, round-robin) come from an explicit device
-  list or the mesh utilities (:func:`parallel.mesh.replica_devices`).
+  The window and the one-pytree transfer are the shared
+  :mod:`~mxnet_tpu.runtime.staging` machinery (ISSUE 10) — the training
+  input pipeline double-buffers through the SAME
+  :class:`~mxnet_tpu.runtime.staging.PipelineWindow`/``stage_pytree``
+  pair. Replicas (one per device, round-robin) come from an explicit
+  device list or the mesh utilities
+  (:func:`parallel.mesh.replica_devices`).
 
 The compute itself reuses the executor's :class:`_GraphProgram`: ONE
 jitted whole-graph program per (bucket shape, device), shared across
@@ -42,6 +47,7 @@ from ..base import MXNetError
 from ..executor import _GraphProgram
 from ..resilience import DeadlineExceeded
 from ..resilience import faults as _faults
+from ..runtime.staging import PipelineWindow, stage_pytree
 from .buckets import parse_buckets, pick_bucket
 
 __all__ = ["ServingConfig", "InferenceServer", "QueueFullError",
@@ -338,8 +344,9 @@ class InferenceServer:
         self._abort = False                   # guarded-by: self._cond
 
         # dispatcher-thread-only state (no lock): the bounded in-flight
-        # window and the round-robin replica cursor
-        self._inflight = collections.deque()
+        # window (runtime/staging.py — shared with the training input
+        # pipeline) and the round-robin replica cursor
+        self._inflight = PipelineWindow(self._cfg.pipeline_depth)
         self._rr = 0
         # circuit breaker: replica -> monotonic probe-due time; mutated
         # by the dispatcher, read by get_stats
@@ -483,11 +490,7 @@ class InferenceServer:
         # best-effort snapshot: the wedged thread owns _inflight, but
         # Assembly.fail is idempotent and future-safe, so failing a
         # batch the thread later completes is a no-op race, not a bug
-        try:
-            inflight = list(self._inflight)
-        except RuntimeError:  # deque mutated mid-iteration
-            inflight = []
-        for ent in inflight:
+        for ent in self._inflight.snapshot():
             for r in ent.reqs:
                 r.assembly.fail(err)
         with self._lock:
@@ -648,7 +651,7 @@ class InferenceServer:
         in-flight batch whenever the window is full or no work is ready
         — host fetch of batch N overlaps device execution of N+1."""
         while True:
-            while len(self._inflight) >= self._cfg.pipeline_depth:
+            while self._inflight.full:
                 self._complete_oldest()
             reqs = self._collect(block=not self._inflight)
             if reqs is None:
@@ -766,7 +769,7 @@ class InferenceServer:
                 self._quarantine(rep, e)
                 err = e
                 continue
-            self._inflight.append(
+            self._inflight.push(
                 _InFlight(outs, reqs, bucket, rows, rep, batch,
                           attempt > 0))
             with self._lock:
@@ -871,7 +874,7 @@ class InferenceServer:
         _faults.inject("serving.replica_execute", tag=replica)
         extras, aux = self._bindings(replica, bucket)
         dev = self._devices[replica]
-        staged = jax.device_put(batch_arrays, dev)  # one pytree transfer
+        staged = stage_pytree(batch_arrays, dev)  # one pytree transfer
         args = dict(self._replica_args[replica])
         args.update(extras)
         args.update(zip(self._data_names, staged))
@@ -891,13 +894,23 @@ class InferenceServer:
         rows to its future (FIFO — completion order == admission order)."""
         from ..observability import metrics
 
-        ent = self._inflight.popleft()
-        # bounded-window host fetch (the G001 drain pattern): this is the
-        # ONE place serving blocks on the device, and by now batch N+1 is
-        # already dispatched
+        ent = None
+
+        def _fetch(entry):
+            # bounded-window host fetch (the G001 drain pattern): this
+            # is the ONE place serving blocks on the device, and by now
+            # batch N+1 is already dispatched; pop_timed accounts the
+            # block into the window's drain cost (get_stats
+            # staging_wait_s — input- vs compute-bound attribution)
+            nonlocal ent
+            ent = entry
+            return [np.asarray(o) for o in entry.outs]  # graftlint: disable=G001
+
         try:
-            host = [np.asarray(o) for o in ent.outs]  # graftlint: disable=G001
+            host = self._inflight.pop_timed(_fetch)
         except Exception as err:
+            if ent is None:  # the pop itself failed (empty window)
+                raise
             # device failure at fetch: quarantine the replica and retry
             # the batch ONCE on a surviving one — inference is
             # idempotent, so a re-execution is answer-preserving
@@ -934,6 +947,8 @@ class InferenceServer:
         stats.update(
             queue_rows=depth,
             inflight=len(self._inflight),
+            staged_batches=self._inflight.pushed,
+            staging_wait_s=round(self._inflight.wait_s, 6),
             buckets=list(self._cfg.buckets),
             replicas=len(self._devices),
             quarantined_replicas=quarantined,

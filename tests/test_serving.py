@@ -157,6 +157,9 @@ def test_deadline_flush_pads_partial_bucket():
         assert stats["rows_real"] == 1
         assert stats["rows_padded"] == 3
         assert wall < 8.0  # flushed by deadline, not stuck
+        # the shared PipelineWindow accounts the drain (runtime/staging)
+        assert stats["staged_batches"] == 1
+        assert stats["staging_wait_s"] > 0.0
     finally:
         srv.stop()
 

@@ -16,26 +16,11 @@ import sys
 import tempfile
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
 import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu import recordio  # noqa: E402
-
-
-def build_rec(path, n, size, fmt=".jpg"):
-    rng = np.random.RandomState(0)
-    rec, idx = path + ".rec", path + ".idx"
-    w = recordio.MXIndexedRecordIO(idx, rec, "w")
-    for i in range(n):
-        img = rng.randint(0, 255, (size, size, 3)).astype(np.uint8)
-        w.write_idx(i, recordio.pack_img(
-            recordio.IRHeader(0, float(i % 10), i, 0), img, img_fmt=fmt,
-            quality=90))
-    w.close()
-    return rec, idx
+from tools.io_smoke import build_rec  # noqa: E402 — the one tools/ builder
 
 
 def measure(it, epochs=2):

@@ -251,6 +251,57 @@ def format_graph_pass(rows, path):
     return "\n".join(lines)
 
 
+def input_pipeline_rows(payload):
+    """Per-stage wait/occupancy rows from a flight-recorder dump's
+    ``io`` provider section (runtime/pipeline.py): one pipeline view
+    per live StreamingIter, so a dump answers "was this run input-bound
+    or compute-bound?" directly."""
+    section = (payload.get("providers", {}) or {}).get("io")
+    if not section:
+        return []
+    views = (section.get("pipelines") if isinstance(section, dict)
+             and "pipelines" in section else [section])
+    rows = []
+    for i, view in enumerate(views):
+        if not isinstance(view, dict) or "stages" not in view:
+            rows.append({"pipeline": i, "error": repr(view)})
+            continue
+        for stage, vals in view["stages"].items():
+            row = {"pipeline": i, "stage": stage}
+            row.update(vals)
+            rows.append(row)
+        rows.append({"pipeline": i, "stage": "(verdict)",
+                     "verdict": view.get("verdict"),
+                     "host_stall_pct": view.get("host_stall_pct"),
+                     "batches": view.get("batches"),
+                     "queue_depth": view.get("queue_depth"),
+                     "decode_workers": view.get("decode_workers"),
+                     "prefetch_depth": view.get("prefetch_depth")})
+    return rows
+
+
+def format_input_pipeline(rows, path):
+    if not rows:
+        return "(no io provider section in %s)" % path
+    lines = ["# input pipeline — %s" % path,
+             "%-9s %-12s %s" % ("pipeline", "stage", "detail")]
+    for r in rows:
+        if r.get("stage") == "(verdict)":
+            lines.append(
+                "%-9s %-12s %s (host stall %.1f%%, %s batches, queue "
+                "depth %s, %s workers, prefetch %s)" % (
+                    r["pipeline"], "verdict", r.get("verdict"),
+                    r.get("host_stall_pct") or 0.0, r.get("batches"),
+                    r.get("queue_depth"), r.get("decode_workers"),
+                    r.get("prefetch_depth")))
+            continue
+        detail = ", ".join("%s=%s" % (k, v) for k, v in sorted(r.items())
+                           if k not in ("pipeline", "stage"))
+        lines.append("%-9s %-12s %s" % (r.get("pipeline"),
+                                        r.get("stage"), detail))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="top-K op/phase time report from a chrome/XPlane trace")
@@ -266,10 +317,22 @@ def main(argv=None):
                     help="print the graph_pass provider section of a "
                          "flight-recorder dump (per-program pass summary: "
                          "nodes folded/pruned, precision rewrites)")
+    ap.add_argument("--input-pipeline", metavar="DUMP",
+                    help="print the io provider section of a "
+                         "flight-recorder dump (per-stage wait/occupancy "
+                         "of the streaming input pipeline + the "
+                         "input-bound vs compute-bound verdict)")
     ap.add_argument("--json", action="store_true",
                     help="emit rows as JSON instead of a table")
     args = ap.parse_args(argv)
 
+    if args.input_pipeline:
+        with open(args.input_pipeline) as f:
+            payload = json.load(f)
+        rows = input_pipeline_rows(payload)
+        print(json.dumps(rows, indent=1) if args.json
+              else format_input_pipeline(rows, args.input_pipeline))
+        return 0
     if args.graph_passes:
         with open(args.graph_passes) as f:
             payload = json.load(f)
