@@ -1388,6 +1388,205 @@ def bench_graph_passes():
     return results
 
 
+def bench_quantize():
+    """--quantize: int8 end-to-end numbers (ISSUE 11), two halves.
+
+    **Predict** — calibrate → quantize → serve on the bench resnet-style
+    model: fp32 vs int8 predict throughput plus the top-1 agreement the
+    accuracy budget is stated in.
+
+    **Decode** — paged-KV generation at kv_dtype model/bf16/int8: decode
+    tokens/s (informational on CPU QUICK; on-chip numbers next bench
+    pass), token agreement vs the model-dtype decode, and the stable
+    witnessed quantity, HBM-bytes-per-generated-token from the pool's
+    byte model — the GATE asserts int8 at most 0.55x of bf16 (halved).
+
+    Merges a "quantize" section into BENCH_ALL.json.
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import graph_pass
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.models import get_resnet
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+    from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                              SamplingParams)
+
+    rng = np.random.RandomState(0)
+
+    # ---------------------------------------------------------- predict
+    layers, size, bs = (8, 16, 4) if QUICK else (50, 224, 16)
+    steps = 10 if QUICK else 50
+    sym = get_resnet(num_classes=10 if QUICK else 1000, num_layers=layers,
+                     image_shape=(3, size, size))
+    x = rng.rand(bs, 3, size, size).astype(np.float32)
+
+    def build(spec):
+        graph_pass.set_passes(spec)
+        try:
+            mod = mx.mod.Module(sym, context=mx.gpu()
+                                if mx.context.num_gpus() else mx.cpu())
+            mod.bind(data_shapes=[("data", x.shape)], for_training=False)
+            mod.init_params(mx.init.Xavier())
+            # an untrained net's logits are near-tied (argmax = noise);
+            # scaling the classifier head emulates the class margins of
+            # a trained checkpoint so top-1 agreement measures the
+            # quantization error, not init degeneracy
+            args, auxs = mod.get_params()
+            args = dict(args)
+            args["fc1_weight"] = args["fc1_weight"] * 8.0
+            mod.set_params(args, auxs)
+            return mod
+        finally:
+            graph_pass.set_passes(None)
+
+    # agreement is judged on a few hundred rows — with one bs-row batch
+    # the attainable values under 1.0 (e.g. 3/4) sit below any 99%
+    # budget, so a single near-tie argmax flip would hard-fail the gate
+    eval_rows = 64 if QUICK else 256
+    eval_x = rng.rand(eval_rows, 3, size, size).astype(np.float32)
+
+    def run(mod):
+        it = lambda: NDArrayIter(x, None, batch_size=bs)  # noqa: E731
+        mod.predict(it())  # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            mod.predict(it())
+        dt = (_time.perf_counter() - t0) / steps
+        out = mod.predict(
+            NDArrayIter(eval_x, None, batch_size=bs)).asnumpy()
+        return dt, out
+
+    fp32 = build("default")
+    table = graph_pass.calibrate(
+        fp32, [rng.rand(bs, 3, size, size).astype(np.float32)
+               for _ in range(3)])
+    fp32_s, fp32_out = run(fp32)
+    graph_pass.set_calibration_table(table)
+    try:
+        q = build("default,quantize")
+        q.set_params(*fp32.get_params())  # identical weights, both arms
+        q_s, q_out = run(q)
+    finally:
+        graph_pass.set_calibration_table(None)
+    ex = q._exec_group.execs[0]
+    qinfo = (ex._opt.summary().get("quantize", {})
+             if ex._opt is not None else {})
+    top1 = float((fp32_out.argmax(1) == q_out.argmax(1)).mean())
+    predict = {
+        "protocol": "resnet%d %dx%d bs%d predict, %d timed iters" % (
+            layers, size, size, bs, steps),
+        "fp32_ms": round(fp32_s * 1e3, 2),
+        "int8_ms": round(q_s * 1e3, 2),
+        "speedup": round(fp32_s / q_s, 3),
+        "images_per_s": {"fp32": round(bs / fp32_s, 1),
+                         "int8": round(bs / q_s, 1)},
+        "top1_agreement": round(top1, 4),
+        "coverage": qinfo,
+    }
+
+    # ----------------------------------------------------------- decode
+    # head_dim 64 (the realistic transformer regime): the int8 pools'
+    # per-(position, head) fp32 scales amortize over head_dim, so toy
+    # head dims would overstate the scale overhead the gate measures
+    if QUICK:
+        model_kw = dict(vocab=64, d_model=128, n_heads=2, n_layers=2,
+                        d_ff=128, n_experts=2)
+        max_batch, max_seq, max_new, n_req = 4, 64, 12, 8
+    else:
+        model_kw = dict(vocab=256, d_model=256, n_heads=4, n_layers=4,
+                        d_ff=256, n_experts=2)
+        max_batch, max_seq, max_new, n_req = 8, 256, 24, 24
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    model = TransformerParallel(mesh, **model_kw)
+    params = model.init(seed=0)
+    prompts = [[int(t) for t in rng.randint(1, model_kw["vocab"],
+                                            size=int(p))]
+               for p in rng.randint(2, max_seq - max_new, size=n_req)]
+    mean_ctx = float(np.mean([len(p) + max_new / 2 for p in prompts]))
+
+    def decode_arm(kv_dtype):
+        gen = Generator(model, params,
+                        GenerationConfig(max_batch=max_batch,
+                                         max_seq=max_seq,
+                                         kv_dtype=kv_dtype))
+        try:
+            gen.warmup()
+            sp = SamplingParams(max_new_tokens=max_new)  # greedy
+            t0 = _time.perf_counter()
+            toks = [h.result(timeout=600)
+                    for h in [gen.submit(p, sp) for p in prompts]]
+            wall = _time.perf_counter() - t0
+            n_tok = sum(len(t) for t in toks)
+            return {"tokens_per_s": round(n_tok / wall, 1),
+                    "hbm_bytes_per_token": gen.kv_read_bytes_per_token(
+                        mean_ctx),
+                    "bytes_per_cached_token": gen.pool.bytes_per_token,
+                    "tokens": toks}
+        finally:
+            gen.stop()
+
+    arms = {kv: decode_arm(kv) for kv in ("model", "bfloat16", "int8")}
+    ref_tokens = arms["model"].pop("tokens")
+    for kv in ("bfloat16", "int8"):
+        toks = arms[kv].pop("tokens")
+        pairs = [(a, b) for r, s in zip(ref_tokens, toks)
+                 for a, b in zip(r, s)]
+        arms[kv]["token_agreement"] = round(
+            float(np.mean([a == b for a, b in pairs])), 4)
+    bytes_ratio = (arms["int8"]["hbm_bytes_per_token"]
+                   / max(1, arms["bfloat16"]["hbm_bytes_per_token"]))
+    decode = {
+        "protocol": ("causal LM %s, %d greedy requests, max_new=%d, "
+                     "mean ctx %.0f tokens" % (model_kw, n_req, max_new,
+                                               mean_ctx)),
+        "arms": arms,
+        "int8_vs_bf16_bytes_ratio": round(bytes_ratio, 3),
+        "int8_vs_bf16_tokens_ratio": round(
+            arms["int8"]["tokens_per_s"]
+            / max(1e-9, arms["bfloat16"]["tokens_per_s"]), 3),
+    }
+
+    results = {"predict": predict, "decode": decode, "quick": QUICK}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_ALL.json")
+    try:
+        with open(out_path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["quantize"] = results
+    tmp = out_path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps({"quantize": results}))
+    # hard gates: the stable witnessed quantities (wall-clock is
+    # informational on CPU QUICK — the HBM story needs the chip)
+    if top1 < 0.99:
+        raise SystemExit("bench_all --quantize: predict top-1 agreement "
+                         "%.4f < 0.99" % top1)
+    if bytes_ratio > 0.55:
+        raise SystemExit("bench_all --quantize: int8 bytes/token %.3fx "
+                         "of bf16 (gate: <= 0.55)" % bytes_ratio)
+    if arms["int8"]["token_agreement"] < 0.9:
+        raise SystemExit("bench_all --quantize: int8 decode token "
+                         "agreement %.4f < 0.9 documented tolerance"
+                         % arms["int8"]["token_agreement"])
+    print("[bench_all] quantize: predict %.3fx @ top1 %.3f; decode "
+          "bytes/token %d (int8) vs %d (bf16), tokens/s ratio %.2fx"
+          % (predict["speedup"], top1,
+             arms["int8"]["hbm_bytes_per_token"],
+             arms["bfloat16"]["hbm_bytes_per_token"],
+             decode["int8_vs_bf16_tokens_ratio"]), file=sys.stderr)
+    return results
+
+
 def bench_input_pipeline(gate_ratio=None):
     """--input-pipeline: streaming pipeline vs the synchronous iterators
     (ISSUE 10 acceptance). Three measurements plus two hard guards:
@@ -1669,6 +1868,12 @@ if __name__ == "__main__":
         # pipeline (node-count reduction is a hard gate; latency is
         # recorded); merges a "graph_passes" section into BENCH_ALL.json
         bench_graph_passes()
+    elif "--quantize" in sys.argv[1:]:
+        # int8 PTQ predict (throughput + top-1 agreement gate) and
+        # int8 paged-KV decode (HBM-bytes-per-token halved vs bf16 is
+        # the gate; tokens/s recorded) — merges a "quantize" section
+        # into BENCH_ALL.json (docs/quantization.md)
+        bench_quantize()
     elif "--input-pipeline" in sys.argv[1:]:
         # streaming vs synchronous input pipeline: >=1.5x iterator
         # throughput gate, fit-loop img/s + host-stall %, exactness +

@@ -34,7 +34,8 @@ __all__ = ["cache", "registry", "cost_model", "search",
            "tunable_names", "SearchConfig", "SearchResult", "median_time",
            "tune_and_record", "mode", "enabled",
            "tune_flash_attention", "tune_serving_buckets", "tune_layout",
-           "tune_remat", "tune_generation", "tune_input_pipeline",
+           "tune_remat", "tune_generation", "tune_generation_kv",
+           "tune_quantize_layers", "tune_input_pipeline",
            "flash_shape_key"]
 
 
@@ -82,6 +83,46 @@ declare(
     default=_flag_default("decode_blocks", "MXNET_GEN_DECODE_BLOCKS"),
     doc="Decode-attention key-block bound in tokens "
         "(paged_decode_attention's online-softmax streaming window).")
+
+
+def _kv_dtypes():
+    # the engine owns the valid dtype set (KV_DTYPES); resolving it
+    # lazily keeps the three consumers (space, default validation,
+    # Generator._resolve_kv_dtype) in lockstep when a dtype is added
+    from ..serving.generation.engine import KV_DTYPES
+
+    return KV_DTYPES
+
+
+def _kv_dtype_default(ctx):
+    # MXNET_GEN_KV_DTYPE is a string env (like MXNET_HEALTH), not an
+    # integer get_flag — read it directly at consult time
+    import os
+
+    val = os.environ.get("MXNET_GEN_KV_DTYPE", "").strip().lower()
+    return {"kv_dtype": val if val in _kv_dtypes() else "model"}
+
+
+declare(
+    "generation.kv_dtype",
+    space=lambda ctx: {"kv_dtype": tuple(sorted(_kv_dtypes()))},
+    default=_kv_dtype_default,
+    doc="KV-page storage dtype of the paged decode cache (ISSUE 11): "
+        "decode is an HBM-gather workload, so narrower pages are "
+        "near-linearly faster — int8 pages carry per-(position, head) "
+        "fp32 scales and dequantize inside the online-softmax "
+        "recurrence. tune_generation_kv arbitrates the candidates "
+        "against a measured token-agreement budget vs the model-dtype "
+        "decode.")
+declare(
+    "quantize.layers",
+    space={},
+    default=None,
+    doc="Per-layer precision of the int8 PTQ graph pass: the cached "
+        "value's {'skip': [op names]} pins layers to fp32. Driven by "
+        "tune_quantize_layers (greedy drop of the most damaging layer "
+        "until the measured top-1 agreement budget holds), keyed by "
+        "graph fingerprint; run_quantize consults it at every bind.")
 
 
 # input-pipeline knobs (ISSUE 10): consulted by runtime/pipeline.py at
@@ -171,6 +212,7 @@ def __getattr__(name):
     # __getattr__ through hasattr and recurses)
     if name in ("tune_flash_attention", "tune_serving_buckets",
                 "tune_layout", "tune_remat", "tune_generation",
+                "tune_generation_kv", "tune_quantize_layers",
                 "tune_input_pipeline", "pipeline_replay_measurer",
                 "generation_replay_measurer", "flash_shape_key", "tuners"):
         import importlib

@@ -30,6 +30,7 @@ from .search import SearchConfig, median_time, search
 __all__ = ["flash_shape_key", "tune_flash_attention",
            "serving_replay_measurer", "tune_serving_buckets",
            "tune_layout", "tune_remat", "tune_generation",
+           "tune_generation_kv", "tune_quantize_layers",
            "generation_replay_measurer", "pipeline_replay_measurer",
            "tune_input_pipeline", "auto_tune"]
 
@@ -290,6 +291,232 @@ def tune_generation(model, params, prompts=None, max_new=8, max_batch=4,
                  ms=res_b.best_s * 1e3, trials=res_b.measured)
     out["generation.decode_blocks"] = res_b.best
     return out
+
+
+def tune_generation_kv(model, params, prompts=None, max_new=8, max_batch=4,
+                       max_seq=128, budget=0.9, measure=None):
+    """Arbitrate the KV-page storage dtype against a measured accuracy
+    budget (ISSUE 11): every ``generation.kv_dtype`` candidate decodes
+    the same greedy prompt sample on a live generator; a candidate is
+    admissible when its token agreement vs the model-dtype decode is at
+    least ``budget``, and the fastest admissible candidate wins (decode
+    is gather-bound, so narrower pages usually do — this tuner is the
+    guard-rail that proves it on THIS checkpoint before serving flips).
+    Records the winner under the generator's tuning key and returns
+    ``{"kv_dtype": ..., "candidates": {dtype: {s, agreement}}}``.
+
+    ``measure`` (tests) replaces the live run:
+    ``measure(kv_dtype) -> (seconds, agreement)``.
+    """
+    from ..serving.generation import (GenerationConfig, Generator,
+                                      SamplingParams)
+    from ..serving.generation.engine import KV_DTYPES, generation_tune_key
+
+    if prompts is None:
+        vocab = int(model.cfg["vocab"])
+        rng = np.random.RandomState(0)
+        top = max(1, max_seq - max_new)
+        lengths = sorted({min(n, top) for n in (3, 9, 17, 29)})
+        prompts = [list(rng.randint(1, vocab, size=n)) for n in lengths]
+    prompts = [[int(t) for t in p] for p in prompts]
+    key = generation_tune_key(model, max_batch, max_seq)
+
+    def live_run(kv_dtype):
+        import time
+
+        gen = Generator(model, params,
+                        GenerationConfig(max_batch=max_batch,
+                                         max_seq=max_seq,
+                                         kv_dtype=kv_dtype))
+        try:
+            gen.warmup()
+            sp = SamplingParams(max_new_tokens=max_new)  # greedy
+            t0 = time.perf_counter()
+            toks = [gen.submit(p, sp) for p in prompts]
+            toks = [h.result(timeout=300) for h in toks]
+            return time.perf_counter() - t0, toks
+        finally:
+            gen.stop(drain=True)
+
+    ref_tokens = None
+    ref_secs = None
+    if measure is None:
+        # the reference run doubles as the "model" candidate: greedy
+        # decode of the same arm is deterministic, a second full
+        # build+warmup+decode would buy zero information
+        ref_secs, ref_tokens = live_run("model")
+
+    def agreement(toks):
+        pairs = [(a, b) for r, s in zip(ref_tokens, toks)
+                 for a, b in zip(r, s)]
+        return float(np.mean([a == b for a, b in pairs])) if pairs else 1.0
+
+    report = {}
+    for kv in sorted(KV_DTYPES):
+        if measure is not None:
+            secs, agree = measure(kv)
+        elif kv == "model":
+            secs, agree = ref_secs, 1.0
+        else:
+            secs, toks = live_run(kv)
+            agree = agreement(toks)
+        report[kv] = {"s": float(secs), "agreement": float(agree)}
+        cache.note_measurements()
+    admissible = {kv: r for kv, r in report.items()
+                  if r["agreement"] >= budget}
+    if not admissible:  # budget impossible: the exact baseline stands
+        admissible = {"model": report["model"]}
+    winner = min(admissible, key=lambda kv: admissible[kv]["s"])
+    cache.record("generation.kv_dtype", key, {"kv_dtype": winner},
+                 ms=admissible[winner]["s"] * 1e3, trials=len(report),
+                 extra={"budget": budget, "candidates": report})
+    return {"kv_dtype": winner, "candidates": report}
+
+
+def tune_quantize_layers(module, batches, table, budget=0.99, key=None,
+                         max_drops=None):
+    """Per-layer int8-vs-fp32 arbitration for the ``quantize`` graph
+    pass (ISSUE 11): starting from everything-quantized, greedily pin
+    the most damaging layer back to fp32 until the measured top-1
+    agreement vs the fp32 module meets ``budget``. Records
+    ``quantize.layers`` ``{"skip": [...]}`` under the graph fingerprint
+    (``key``) so every later quantized bind of this graph consults it.
+
+    ``module``: a bound fp32 inference Module (the baseline);
+    ``batches``: numpy arrays / DataBatches to score on; ``table``: the
+    CalibrationTable. Returns ``{"skip": [...], "agreement": float}``.
+
+    The consulted/recorded entry always lives under the graph
+    FINGERPRINT (what ``run_quantize`` looks up); a custom ``key`` gets
+    a bookkeeping copy of the winner but never steers the consult.
+    """
+    from .. import graph_pass
+    from ..graph_pass import quantize as _quant
+
+    symbol = module.symbol
+    fp_key = graph_pass.graph_fingerprint(symbol)
+    arg_params, aux_params = module.get_params()
+    data_shapes = [(d.name, d.shape) for d in module.data_shapes]
+
+    def top1(mod, arrays):
+        import mxnet_tpu as mx
+
+        outs = []
+        for arr in arrays:
+            mod.forward(mx.io.DataBatch(data=[mx.nd.array(a)
+                                              for a in arr]),
+                        is_train=False)
+            outs.append(mod.get_outputs()[0].asnumpy().argmax(axis=-1))  # graftlint: disable=G001 — accuracy measurement over a handful of calibration batches, not a hot path
+        return np.concatenate(outs)
+
+    def as_arrays(b):
+        if isinstance(b, np.ndarray):  # BEFORE the .data duck-check:
+            return [b]                 # ndarray.data is a memoryview
+        if isinstance(b, (list, tuple)):
+            return list(b)
+        if hasattr(b, "data"):  # a DataBatch (docstring contract)
+            return [np.asarray(a.asnumpy() if hasattr(a, "asnumpy")
+                               else a) for a in b.data]  # graftlint: disable=G001 — one-time measurement-input staging
+        return [b]
+
+    arrays = [as_arrays(b) for b in batches]
+    ref = top1(module, arrays)
+    # trial binds must be pure functions of THIS tuner's skip list: a
+    # stale quantize.layers entry from a previous run would otherwise
+    # union into every trial (run_quantize consults the cache), and the
+    # recorded winner's agreement would never have been measured. The
+    # prior entry is restored if the tune dies mid-run (an unmeasured
+    # empty-skip stub must not clobber a previously tuned pin list).
+    prior_entry = cache.lookup("quantize.layers", fp_key)
+    cache.record("quantize.layers", fp_key, {"skip": []},
+                 extra={"status": "tuning"})
+    # save/restore the caller's process-wide overrides: clearing them to
+    # None would silently disable a set_calibration_table/set_passes the
+    # user had armed for later binds
+    from ..graph_pass import core as _gp_core
+
+    prior_spec = _gp_core._SPEC_OVERRIDE
+    prior_table = _quant._TABLE_OVERRIDE
+    prior_skip = _quant._SKIP_OVERRIDE
+
+    def agreement(skip):
+        import mxnet_tpu as mx
+
+        _quant.set_quantize_skip(skip)
+        graph_pass.set_calibration_table(table)
+        graph_pass.set_passes(_ambient_passes_plus_quantize())
+        try:
+            mod = mx.mod.Module(symbol, context=mx.cpu(),
+                                data_names=[n for n, _ in data_shapes])
+            mod.bind(data_shapes=data_shapes, for_training=False)
+            mod.set_params(arg_params, aux_params, allow_missing=False)
+            got = top1(mod, arrays)
+        finally:
+            graph_pass.set_passes(prior_spec)
+            graph_pass.set_calibration_table(prior_table)
+            _quant.set_quantize_skip(prior_skip)
+        return float((got == ref).mean())
+
+    try:
+        # candidate set: the ops a fully-quantized rewrite touches
+        opt = graph_pass.optimize(
+            symbol, for_training=False,
+            frozen=set(arg_params) | set(aux_params),
+            arg_shapes=dict(data_shapes),
+            config=graph_pass.PassConfig(
+                passes=set(graph_pass.DEFAULT_PASSES) | {"quantize"},
+                quant_table=table))
+        quantized = []
+        if opt is not None:
+            for rep in opt.reports:
+                if rep["pass"] == "quantize" and "detail" in rep:
+                    quantized = list(rep["detail"].get("quantized", ()))
+        skip = []
+        agree = agreement(skip)
+        drops = 0
+        bound = max_drops if max_drops is not None else len(quantized)
+        while agree < budget and quantized and drops < bound:
+            trials = [(agreement(skip + [name]), name) for name in quantized]  # graftlint: disable=G001 — the greedy arbitration loop IS the measurement (tune-once, ship the cache)
+            cache.note_measurements(len(trials))
+            best_agree, best_name = max(trials)
+            if best_agree <= agree:
+                break  # no single drop helps: stop instead of thrashing
+            skip.append(best_name)
+            quantized.remove(best_name)
+            agree = best_agree
+            drops += 1
+    except BaseException:
+        if isinstance(prior_entry, dict):
+            cache.record("quantize.layers", fp_key, prior_entry,
+                         extra={"status": "restored_after_failed_tune"})
+        raise
+    cache.record("quantize.layers", fp_key, {"skip": sorted(skip)},
+                 trials=drops + 1,
+                 extra={"budget": budget, "agreement": agree})
+    if key is not None and key != fp_key:
+        # caller bookkeeping copy only — run_quantize consults fp_key
+        cache.record("quantize.layers", key, {"skip": sorted(skip)},
+                     trials=drops + 1,
+                     extra={"budget": budget, "agreement": agree,
+                            "consulted_key": str(fp_key)})
+    return {"skip": sorted(skip), "agreement": agree}
+
+
+def _ambient_passes_plus_quantize():
+    """The ambient pass spec — an active ``graph_pass.set_passes``
+    override first, else MXNET_GRAPH_PASSES — with ``quantize`` appended
+    (the tuner must trial-quantize under the user's own pipeline)."""
+    import os
+
+    from ..graph_pass import core as _gp_core
+
+    spec = _gp_core._SPEC_OVERRIDE
+    if spec is None:
+        spec = os.environ.get("MXNET_GRAPH_PASSES", "default")
+    spec = str(spec).strip()
+    if spec.lower() in ("off", "none", "0", ""):
+        spec = "default"
+    return spec + ",quantize"
 
 
 def tune_layout(measure, key, default="NHWC", trials=None):

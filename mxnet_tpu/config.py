@@ -177,6 +177,25 @@ Flags currently honored:
     size, never by traffic. String-valued, env-only (pass
     ``prefill_buckets=`` to GenerationConfig to override at runtime).
 
+``MXNET_GEN_KV_DTYPE`` (default ``model``)
+    KV-page storage dtype of the paged generation cache
+    (docs/quantization.md): ``model`` keeps the checkpoint dtype,
+    ``bfloat16`` halves fp32 pools, ``int8`` stores symmetric-int8
+    pages with per-(position, head) fp32 scales dequantized inside the
+    decode attention's streaming recurrence — roughly half the decode
+    HBM traffic of bf16 pages. Resolution: explicit
+    ``GenerationConfig(kv_dtype=...)`` > ``generation.kv_dtype``
+    tuning-cache entry (``autotune.tune_generation_kv``) > this env.
+    String-valued, env-only — like MXNET_HEALTH, NOT routed through the
+    integer get_flag machinery.
+
+``MXNET_QUANT_TABLE`` (default unset)
+    Calibration-table JSON path the ``quantize`` graph pass resolves
+    when no table is attached explicitly (``quantize=<path>`` in
+    MXNET_GRAPH_PASSES or ``InferenceServer(quantize=...)`` win;
+    runtime override: ``graph_pass.set_calibration_table``).
+    String-valued, env-only.
+
 ``MXNET_GRAPH_PASSES`` (default ``default``)
     Bind-time graph-optimization pipeline (graph_pass/,
     docs/graph_passes.md): ``default`` runs the numerically exact

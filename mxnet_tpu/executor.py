@@ -402,8 +402,14 @@ class Executor:
             raise MXNetError("bind: missing arguments %s" % missing)
         self.outputs = []
         self._stashed_grads = None
-        self._monitor_callback = None
-        self._monitor_use_jit = False
+        # a re-bind (reshape / bucket switch) keeps the monitor armed:
+        # calibration (graph_pass.quantize.calibrate) feeds batches of
+        # arbitrary size through Module.forward, and the spy must
+        # survive the executor swap a shape change triggers
+        self._monitor_callback = (shared_exec._monitor_callback
+                                  if shared_exec is not None else None)
+        self._monitor_use_jit = (shared_exec._monitor_use_jit
+                                 if shared_exec is not None else False)
         self._monitor_jit_cache = {}
         self._health_steps = 0
 

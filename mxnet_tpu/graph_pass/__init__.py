@@ -35,19 +35,23 @@ import threading
 import time
 
 from ..symbol.symbol import Symbol
-from . import core, passes
+from . import core, passes, quantize
 from .core import (DEFAULT_PASSES, INFERENCE_ONLY, PIPELINE_ORDER,
                    PassConfig, PassContext, clone_entries, topo_from)
 from .passes import eval_fold_exprs
+from .quantize import (CalibrationTable, calibrate, set_calibration_table,
+                       set_quantize_skip)
 
 __all__ = ["PassConfig", "OptimizedGraph", "optimize", "optimize_for_bind",
            "graph_fingerprint", "set_passes", "stats", "reset_stats",
            "recent_reports", "note_program", "PIPELINE_ORDER",
-           "DEFAULT_PASSES"]
+           "DEFAULT_PASSES", "CalibrationTable", "calibrate",
+           "set_calibration_table", "set_quantize_skip"]
 
 _PASS_FNS = {
     "prune": passes.run_prune,
     "bn_fold": passes.run_bn_fold,
+    "quantize": quantize.run_quantize,
     "layout": passes.run_layout,
     "amp": passes.run_amp,
     "fold": passes.run_fold,
@@ -63,6 +67,9 @@ _provider_armed = False                 # guarded-by: _lock
 # entry holds a strong symbol ref so id() can never alias a dead object
 _cache = collections.OrderedDict()      # guarded-by: _lock
 _CACHE_CAP = 64
+# per-symbol fingerprint memo for the quantize bind-key lookup (strong
+# symbol ref for the same id-aliasing reason; bounded like _cache)
+_fp_memo = collections.OrderedDict()    # id(symbol) -> (symbol, fp)  # guarded-by: _lock
 
 
 def set_passes(spec):
@@ -186,7 +193,7 @@ class OptimizedGraph:
 
     def summary(self):
         """JSON-safe per-program pass summary (provider/report shape)."""
-        return {
+        out = {
             "graph": self.graph_key,
             "for_training": self.for_training,
             "nodes_before": self.nodes_before,
@@ -195,6 +202,19 @@ class OptimizedGraph:
             "amp": "amp" in self.config.passes,
             "passes": list(self.reports),
         }
+        for rep in self.reports:
+            # quantize coverage rides at the top level too, so a dump
+            # (trace_report.py --graph-passes) answers "what fraction of
+            # this program is int8, and under which calibration table?"
+            # without digging through the per-pass detail
+            if rep["pass"] == "quantize" and "detail" in rep:
+                d = rep["detail"]
+                out["quantize"] = {
+                    "ops_quantized": d.get("ops_quantized", 0),
+                    "ops_eligible": d.get("ops_eligible", 0),
+                    "skipped": dict(d.get("skipped", {})),
+                    "table": d.get("table")}
+        return out
 
 
 def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
@@ -220,10 +240,14 @@ def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
         before = ctx.node_count()
         t0 = time.perf_counter()
         rewrites = _PASS_FNS[name](ctx)
-        ctx.reports.append({
+        report = {
             "pass": name, "rewrites": int(rewrites),
             "nodes_before": before, "nodes_after": ctx.node_count(),
-            "wall_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+        extra = ctx.pass_extras.get(name)
+        if extra is not None:
+            report["detail"] = extra
+        ctx.reports.append(report)
     nodes_after = ctx.node_count()
     changed = any(r["rewrites"] for r in ctx.reports)
     opt = OptimizedGraph(Symbol(list(ctx.outputs)), ctx.fold_exprs,
@@ -231,12 +255,19 @@ def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
                          nodes_before, nodes_after) if changed else None
     from ..observability import metrics
 
+    quant = ctx.pass_extras.get("quantize") or {}
     with _lock:
         _stats["pipeline_runs"] += 1
         if changed:
             _stats["graphs_rewritten"] += 1
             _stats["nodes_removed"] += max(0, nodes_before - nodes_after)
             _recent.append(opt.summary())
+        if quant:
+            _stats["quantized_ops"] += quant.get("ops_quantized", 0)
+            # "*" is the no-table placeholder, not a skipped OP — the
+            # counter must track genuine per-op skips only
+            _stats["quantize_skipped"] += len(
+                [n for n in quant.get("skipped", {}) if n != "*"])
     if metrics.enabled():
         metrics.counter("graph_pass.pipeline_runs").inc()
         if changed:
@@ -246,6 +277,9 @@ def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
                          if r["pass"] == "amp")
             if amp_rw:
                 metrics.counter("graph_pass.precision_rewrites").inc(amp_rw)
+        if quant.get("ops_quantized"):
+            metrics.counter("graph_pass.quantized_ops").inc(
+                quant["ops_quantized"])
     return opt
 
 
@@ -267,6 +301,28 @@ def optimize_for_bind(symbol, for_training=False, frozen=(),
         (k, str(v)) for k, v in (arg_dtypes or {}).items()))
     key = (id(symbol), cfg.signature(), bool(for_training),
            frozenset(frozen or ()), rank_sig, dtype_sig)
+    if "quantize" in cfg.passes:
+        # the per-GRAPH tuned skip list run_quantize consults is part of
+        # the rewrite's identity: an autotune.reload() that changes
+        # quantize.layers must miss this cache, not serve a graph built
+        # under the stale pin set (set_quantize_skip already drops the
+        # cache for in-process mutations; this covers cross-process).
+        # The fingerprint memoizes per symbol so cache HITS stay O(1).
+        from .. import autotune
+
+        with _lock:
+            hit = _fp_memo.get(id(symbol))
+            fp = hit[1] if hit is not None else None
+        if fp is None:
+            fp = graph_fingerprint(symbol)
+            with _lock:
+                _fp_memo[id(symbol)] = (symbol, fp)
+                while len(_fp_memo) > _CACHE_CAP:
+                    _fp_memo.popitem(last=False)
+        tuned = autotune.lookup("quantize.layers", key=fp)
+        skip = (tuple(sorted(tuned.get("skip") or ()))
+                if isinstance(tuned, dict) else ())
+        key = key + (skip,)
     with _lock:
         hit = _cache.get(key)
         if hit is not None:

@@ -16,14 +16,17 @@ from ..ops.registry import get_op
 from ..symbol.symbol import Symbol, _Node
 
 # canonical execution order — the env grammar toggles membership, never
-# order (fold runs LAST so it materializes the small parameter
-# expressions bn_fold/layout/amp leave behind: scale vectors, transposed
-# weights, pre-cast bf16 params)
-PIPELINE_ORDER = ("prune", "bn_fold", "layout", "amp", "fold")
+# order (quantize runs after bn_fold so folded convs quantize as one
+# unit and before layout so calibration entry names still resolve; fold
+# runs LAST so it materializes the small parameter expressions
+# bn_fold/layout/amp/quantize leave behind: scale vectors, transposed
+# weights, pre-cast bf16 params, int8 weight tensors)
+PIPELINE_ORDER = ("prune", "bn_fold", "quantize", "layout", "amp", "fold")
 
 # passes that change inference-only semantics (loss-head simplification,
-# folding running stats into weights) never run on a training bind
-INFERENCE_ONLY = frozenset({"prune", "bn_fold"})
+# folding running stats into weights, int8 rewrite) never run on a
+# training bind
+INFERENCE_ONLY = frozenset({"prune", "bn_fold", "quantize"})
 
 # the numerically exact default; amp (a deliberate precision change) is
 # opt-in per the parity discipline, layout only acts on a tuned
@@ -42,32 +45,40 @@ class PassConfig:
     Grammar (comma-separated, order-insensitive — execution order is
     canonical): ``default`` expands to the exact default pipeline
     (prune, bn_fold, layout, fold); ``all`` additionally enables
-    ``amp``; a bare pass name enables it, ``-name`` disables it;
-    ``amp`` / ``amp=bf16`` enables the mixed-precision rewrite;
-    ``layout=NHWC`` (or NCHW) forces the layout target instead of
-    consulting the autotuner; ``off`` disables the whole layer.
+    ``amp`` and ``quantize``; a bare pass name enables it, ``-name``
+    disables it; ``amp`` / ``amp=bf16`` enables the mixed-precision
+    rewrite; ``quantize`` / ``quantize=<table.json>`` enables the int8
+    post-training rewrite (table resolution:
+    :func:`~.quantize.resolve_table`); ``layout=NHWC`` (or NCHW) forces
+    the layout target instead of consulting the autotuner; ``off``
+    disables the whole layer.
     """
 
-    __slots__ = ("passes", "amp_dtype", "layout_force")
+    __slots__ = ("passes", "amp_dtype", "layout_force", "quant_table",
+                 "quant_skip")
 
     def __init__(self, spec=None, passes=None, amp_dtype="bfloat16",
-                 layout_force=None):
+                 layout_force=None, quant_table=None, quant_skip=None):
         self.amp_dtype = amp_dtype
         self.layout_force = layout_force
+        self.quant_table = quant_table
+        self.quant_skip = frozenset(quant_skip or ())
         if passes is not None:
             self.passes = frozenset(passes)
             return
         if spec is None:
             spec = (_SPEC_OVERRIDE if _SPEC_OVERRIDE is not None
                     else os.environ.get("MXNET_GRAPH_PASSES", "default"))
-        spec = spec.strip().lower()
-        if spec in _OFF_TOKENS:
+        spec = spec.strip()
+        if spec.lower() in _OFF_TOKENS:
             self.passes = frozenset()
             return
         # two-phase, ORDER-INSENSITIVE parse: positives build the base
         # set, negatives subtract at the end — so '-bn_fold,default' ==
         # 'default,-bn_fold', and a purely-negative spec ('-bn_fold')
-        # means default-minus-that, never "everything off"
+        # means default-minus-that, never "everything off". Only the
+        # NAME half of a token lowercases: values may be case-sensitive
+        # paths (quantize=<table.json>)
         pos, neg = set(), set()
         for token in spec.split(","):
             token = token.strip()
@@ -77,6 +88,7 @@ class PassConfig:
             if negated:
                 token = token[1:]
             name, _, value = token.partition("=")
+            name = name.lower()
             if name == "default":
                 (neg if negated else pos).update(DEFAULT_PASSES)
                 continue
@@ -90,9 +102,13 @@ class PassConfig:
                     % (name, ", ".join(PIPELINE_ORDER)))
             (neg if negated else pos).add(name)
             if not negated and name == "amp" and value:
-                self.amp_dtype = value
+                self.amp_dtype = value.lower()
             if not negated and name == "layout" and value:
                 self.layout_force = value.upper()
+            if not negated and name == "quantize" and value:
+                # a path token: the table loads lazily at pass run (and
+                # its fingerprint keys the bind cache via signature())
+                self.quant_table = value
         base = pos if pos else set(DEFAULT_PASSES)
         self.passes = frozenset(base - neg)
 
@@ -102,8 +118,13 @@ class PassConfig:
 
     def signature(self):
         """Stable cache-key component for this configuration."""
+        quant_sig = None
+        if "quantize" in self.passes:
+            from .quantize import table_signature
+
+            quant_sig = table_signature(self)
         return (tuple(sorted(self.passes)), self.amp_dtype,
-                self.layout_force)
+                self.layout_force, quant_sig)
 
     def __repr__(self):
         return "PassConfig(%s)" % ",".join(
@@ -233,6 +254,7 @@ class PassContext:
         self.graph_key = graph_key
         self.fold_exprs = []            # [(name, [entry], [frozen input names])]
         self.reports = []
+        self.pass_extras = {}           # pass name -> JSON-safe detail dict
         self._shape_map = None
         self._uid = 0
 

@@ -450,7 +450,11 @@ def run_fold(ctx):
         if node.is_variable:
             continue
         opdef = node.opdef()
+        # __nofold__ marks a deliberate fold BARRIER: the quantize pass
+        # sets it on the int8→int32 widening cast so fold materializes
+        # the quarter-width int8 weight, never the widened constant
         foldable[id(node)] = (opdef.name not in _NOFOLD
+                              and "__nofold__" not in node.user_attrs
                               and not opdef.needs_rng
                               and bool(node.inputs)
                               and all(entry_ok(e) for e in node.inputs))

@@ -140,6 +140,12 @@ def _register_reshape():
     register_op("Cast", cast, params={"dtype": DType()}, num_inputs=1,
                 infer_shape=lambda attrs, i, a: (
                     None if i[0] is None else ([i[0]], [i[0]], a)),
+                # identity backward flow: lets a consumer-inferred shape
+                # reach a variable behind the cast — e.g. the quantize
+                # pass's folded int8 weight behind its widening cast
+                infer_backward=lambda attrs, out_shapes, in_shapes: (
+                    [out_shapes[0]] if out_shapes
+                    and out_shapes[0] is not None else None),
                 infer_dtype=lambda attrs, i, a: (i, [attrs.dtype], a))
     alias_op("Cast", "cast")
 

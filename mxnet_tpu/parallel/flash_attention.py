@@ -469,7 +469,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                           scale=None, block_tokens=None):
+                           scale=None, block_tokens=None,
+                           k_scale=None, v_scale=None):
     """Single-query attention against a paged KV cache — the decode step
     of the generation subsystem (serving/generation/, docs/generation.md).
 
@@ -480,6 +481,16 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     ``lengths``: (S,) int32 — valid key count per slot (positions at or
     beyond a slot's length are masked, so stale/trash page contents
     never contribute; a slot with length 0 yields a zero output).
+
+    ``k_scale``/``v_scale``: (P, page, H) fp32 — the int8 pool mode
+    (ISSUE 11): pages hold symmetric-int8 quantized K/V with one scale
+    per (position, head) stored alongside, and each gathered block
+    dequantizes INSIDE the streaming online-softmax recurrence — the
+    attention arithmetic below is fp32 either way, so int8 pages change
+    HBM traffic (roughly halved vs bf16, quartered vs fp32), never the
+    softmax discipline. The pool dtype is part of the program's jit
+    signature, not a traced value: one compiled decode program per pool
+    mode, the subsystem's compile-count contract intact.
 
     Deliberately XLA, not Pallas: at query length 1 there is no MXU
     tiling to win — the step is HBM-bandwidth-bound on the K/V gather,
@@ -520,6 +531,10 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
         tab = jax.lax.dynamic_slice_in_dim(page_table, i * bp, bp, axis=1)
         kb = k_pages[tab].reshape(S, blk, H, d).astype(jnp.float32)
         vb = v_pages[tab].reshape(S, blk, H, d).astype(jnp.float32)
+        if k_scale is not None:
+            kb = kb * k_scale[tab].reshape(S, blk, H)[..., None]
+        if v_scale is not None:
+            vb = vb * v_scale[tab].reshape(S, blk, H)[..., None]
         s = jnp.einsum("shd,sthd->sht", qf, kb)          # (S, H, blk)
         pos = i * blk + jax.lax.iota(jnp.int32, blk)
         live = pos[None, :] < lengths[:, None]            # (S, blk)

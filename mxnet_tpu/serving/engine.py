@@ -240,7 +240,7 @@ class InferenceServer:
 
     def __init__(self, symbol, arg_params, aux_params=None, data_shapes=None,
                  devices=None, mesh=None, config=None, start=True,
-                 traffic_key="default"):
+                 traffic_key="default", quantize=None):
         import jax
 
         if data_shapes is None:
@@ -299,9 +299,32 @@ class InferenceServer:
         # fixed for the server's lifetime, so EVERYTHING but the data
         # enters the pipeline frozen — BN folds into conv weights, loss
         # heads and their label plumbing prune away (no zero-filled
-        # label extras), and the folded constants ship with the params
+        # label extras), and the folded constants ship with the params.
+        # ``quantize=`` (a CalibrationTable or a table path) is the
+        # serving bind option of ISSUE 11: it appends the int8 PTQ
+        # rewrite to the ambient pipeline, so this server's programs
+        # compute the conv/FC/matmul islands on the int8 lattice and
+        # the fold below materializes QUARTER-WIDTH weights per replica
         self._opt = None
         opt_symbol = symbol
+        pass_cfg = None
+        if quantize is not None:
+            from ..graph_pass import quantize as _quant
+
+            pass_cfg = graph_pass.PassConfig()
+            pass_cfg.passes = frozenset(pass_cfg.passes | {"quantize"})
+            if quantize is not True:
+                pass_cfg.quant_table = _quant.as_table(quantize)
+            if _quant.resolve_table(pass_cfg) is None:
+                # int8 serving was EXPLICITLY requested: a silent fp32
+                # fallback (every op skipped "no_calibration_table")
+                # would ship full-width weights while the caller
+                # believes quantization is on
+                raise MXNetError(
+                    "InferenceServer(quantize=...): no calibration table "
+                    "resolvable — pass a CalibrationTable or its JSON "
+                    "path, call graph_pass.set_calibration_table(), or "
+                    "set MXNET_QUANT_TABLE (docs/quantization.md)")
         feed = {n: (1,) + s for n, s in zip(self._data_names,
                                             self._row_shapes)}
         opt = graph_pass.optimize_for_bind(
@@ -310,7 +333,8 @@ class InferenceServer:
             arg_shapes=feed,
             arg_dtypes={**{k: v.dtype for k, v in host_aux.items()},
                         **{k: v.dtype for k, v in host_args.items()},
-                        **self._arg_dtypes})
+                        **self._arg_dtypes},
+            config=pass_cfg)
         if opt is not None:
             consts = opt.fold({**host_aux, **host_args})
             host_args = dict(host_args)
@@ -409,8 +433,13 @@ class InferenceServer:
         feed = {n: (bucket,) + s
                 for n, s in zip(self._data_names, self._row_shapes)}
         # shapes/args come from the OPTIMIZED symbol: pruned labels are
-        # no longer arguments, so no zero-filled extras exist for them
-        arg_shapes, _, aux_shapes = self._opt_symbol.infer_shape(**feed)
+        # no longer arguments, so no zero-filled extras exist for them.
+        # PARTIAL inference: fold constants (e.g. the quantize pass's
+        # int8 weights behind their widening casts) already live in
+        # ``args`` with concrete arrays — only a zero-filled extra we
+        # must materialize OURSELVES needs an inferable shape
+        arg_shapes, _, aux_shapes = self._opt_symbol.infer_shape_partial(
+            **feed)
         dev = self._devices[replica]
         args = self._replica_args[replica]
         extras = {}
@@ -418,12 +447,20 @@ class InferenceServer:
                                arg_shapes):
             if name in self._data_names or name in args:
                 continue
+            if shape is None or 0 in shape:
+                raise MXNetError(
+                    "serving: cannot infer shape for argument %r (not in "
+                    "arg_params and not a data input)" % name)
             dt = self._arg_dtypes.get(name, np.float32)
             extras[name] = jax.device_put(jnp.zeros(shape, dtype=dt), dev)
         aux = dict(self._replica_aux[replica])
         for name, shape in zip(self._opt_symbol.list_auxiliary_states(),
                                aux_shapes):
             if name not in aux:
+                if shape is None or 0 in shape:
+                    raise MXNetError(
+                        "serving: cannot infer shape for auxiliary state "
+                        "%r" % name)
                 aux[name] = jax.device_put(
                     jnp.zeros(shape, dtype=np.float32), dev)
         with self._lock:

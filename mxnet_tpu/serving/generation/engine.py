@@ -58,10 +58,28 @@ _faults.declare("generation.decode_step",
 __all__ = ["GenerationConfig", "Generator", "GenerationHandle",
            "SamplingParams", "QueueFullError", "ServerClosedError"]
 
-# the generation.page_size / generation.decode_blocks knobs this engine
-# consults (explicit config arg > tuning cache > MXNET_GEN_* flag) are
-# declared in autotune/__init__ — like graph.layout, this module loads
-# lazily, and registry.get must work in a process that never imported it
+# the generation.page_size / generation.decode_blocks / generation.
+# kv_dtype knobs this engine consults (explicit config arg > tuning
+# cache > MXNET_GEN_* flag) are declared in autotune/__init__ — like
+# graph.layout, this module loads lazily, and registry.get must work in
+# a process that never imported it
+
+# valid KV-page storage dtypes ("model" = the checkpoint's dtype)
+KV_DTYPES = frozenset({"model", "bfloat16", "int8"})
+
+
+def _quantize_kv(arr):
+    """Symmetric-int8 quantization of K/V vectors along head_dim: one
+    fp32 scale per (…, head). Traced inside the prefill/decode programs
+    — the cast to int8 happens before the HBM scatter, so pages (and
+    the decode gather they feed) move quarter-width bytes."""
+    import jax.numpy as jnp
+
+    a32 = arr.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(a32), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(a32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
 
 
 def default_prefill_ladder(max_seq):
@@ -95,12 +113,23 @@ class GenerationConfig:
     def __init__(self, page_size=None, decode_blocks=None, max_batch=None,
                  max_seq=None, pool_pages=None, prefill_buckets=None,
                  max_queue=None, backpressure=None, submit_timeout_ms=None,
-                 amp=None):
+                 amp=None, kv_dtype=None):
         import os
 
         # None = follow the graph-pass layer (amp in MXNET_GRAPH_PASSES);
         # True/False force the bf16 prefill/decode rewrite per bind
         self.amp = amp
+        # KV-page storage dtype: None resolves in Generator (explicit >
+        # generation.kv_dtype tuning-cache entry > MXNET_GEN_KV_DTYPE >
+        # "model"). "int8" stores symmetric-int8 pages with per-
+        # (position, head) fp32 scales alongside — the decode-bandwidth
+        # lever (ISSUE 11); "bfloat16" halves fp32 pools without scales
+        if kv_dtype is not None:
+            kv_dtype = str(kv_dtype).lower()
+            if kv_dtype not in KV_DTYPES:
+                raise ValueError("kv_dtype must be one of %s, got %r"
+                                 % (sorted(KV_DTYPES), kv_dtype))
+        self.kv_dtype = kv_dtype
         # None = resolve in Generator: explicit > tuning cache > flag
         self.page_size = None if page_size is None else int(page_size)
         self.decode_blocks = (None if decode_blocks is None
@@ -310,19 +339,48 @@ class Generator:
         S = cfg.max_batch
         self._max_pages = -(-cfg.max_seq // self.page_size)
         pool_pages = cfg.pool_pages or (S * self._max_pages + 1)
-        self.pool = PagePool(pool_pages, self.page_size)
 
         L, H = c["n_layers"], c["n_heads"]
         hd = c["d_model"] // H
         dt = np.dtype(model.dtype)
+        # KV-page storage dtype (ISSUE 11): "model" keeps the checkpoint
+        # dtype; "bfloat16"/"int8" store narrower pages — the decode
+        # step is an HBM-gather workload, so page width IS its bandwidth
+        self.kv_dtype = self._resolve_kv_dtype(cfg.kv_dtype)
+        self._quant_kv = self.kv_dtype == "int8"
+        if self.kv_dtype == "model":
+            pool_dt = dt
+        elif self.kv_dtype == "int8":
+            pool_dt = np.dtype(np.int8)
+        else:
+            import jax.numpy as jnp
+
+            pool_dt = np.dtype(jnp.bfloat16)
+        # device bytes per cached token: K + V across layers/heads at
+        # the pool dtype, plus the per-(position, head) fp32 scales an
+        # int8 pool stores alongside — the PagePool byte model behind
+        # the kv_bytes_used gauge
+        bytes_per_token = 2 * L * H * hd * pool_dt.itemsize
+        if self._quant_kv:
+            bytes_per_token += 2 * L * H * 4
+        self.pool = PagePool(pool_pages, self.page_size,
+                             bytes_per_token=bytes_per_token,
+                             kv_dtype=self.kv_dtype)
+
         # committed to the model's device: an UNcommitted fresh pool
         # would carry a different sharding signature than the compiled
         # programs' outputs and cost one spurious recompile per bucket
         self._pool_shape = (L, pool_pages, self.page_size, H, hd)
-        self._pool_dtype = dt
+        self._scale_shape = (L, pool_pages, self.page_size, H)
+        self._pool_dtype = pool_dt
         self._device = list(model.mesh.devices.flat)[0]
-        self._pages_k = self._fresh_pool()  # guarded-by: self._pages_lock
-        self._pages_v = self._fresh_pool()  # guarded-by: self._pages_lock
+        self._pools = self._fresh_pools()  # guarded-by: self._pages_lock
+        if self._quant_kv:
+            # provenance: crash dumps must say this engine's programs
+            # decode against quantized pages (the amp-note discipline)
+            graph_pass.note_program(
+                "generation", kv_dtype=self.kv_dtype,
+                tune_key=list(self._tune_key))
 
         # slot state: scheduler-thread-only numpy mirrors of the decode
         # program's inputs (no lock — only _loop touches them)
@@ -348,8 +406,9 @@ class Generator:
         self._pages_lock = threading.Lock()
 
         # donation lets XLA update the page pools in place; CPU has no
-        # donation support, so skip it there (avoids a per-compile warn)
-        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        # donation support, so skip it there (avoids a per-compile warn).
+        # The whole pool pytree (pages + int8 scales) is ONE argument.
+        donate = () if jax.default_backend() == "cpu" else (1,)
         self._donating = bool(donate)
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=donate)
         self._prefill_jit = jax.jit(self._prefill_step,
@@ -380,11 +439,19 @@ class Generator:
             lambda a: a.astype(jnp.bfloat16)
             if getattr(a, "dtype", None) == jnp.float32 else a, params)
 
-    def _fresh_pool(self):
+    def _fresh_pools(self):
+        """The device KV state as ONE donated pytree: K and V page
+        pools, plus their fp32 scale pools in int8 mode. A dict (not
+        two attributes) so the quantized layout threads through the
+        compiled programs without forking their signatures."""
         import jax
 
-        return jax.device_put(
-            np.zeros(self._pool_shape, self._pool_dtype), self._device)
+        pools = {"k": np.zeros(self._pool_shape, self._pool_dtype),
+                 "v": np.zeros(self._pool_shape, self._pool_dtype)}
+        if self._quant_kv:
+            pools["ks"] = np.zeros(self._scale_shape, np.float32)
+            pools["vs"] = np.zeros(self._scale_shape, np.float32)
+        return jax.device_put(pools, self._device)
 
     def _recover_pools(self, err):
         """After a FAILED donated prefill/decode call the old pool
@@ -400,8 +467,7 @@ class Generator:
             if seq is not None:
                 self._evict(slot, failed=err)
         with self._pages_lock:
-            self._pages_k = self._fresh_pool()
-            self._pages_v = self._fresh_pool()
+            self._pools = self._fresh_pools()
 
     def _resolve(self, op, field, explicit, flag):
         """Knob resolution: explicit config arg > tuning cache > flag."""
@@ -419,6 +485,25 @@ class Generator:
                 pass  # corrupt cache entry: tuning is an optimization
         return int(get_flag(flag))
 
+    def _resolve_kv_dtype(self, explicit):
+        """KV-page dtype resolution: explicit config arg >
+        ``generation.kv_dtype`` tuning-cache entry
+        (autotune.tune_generation_kv arbitrates int8 vs bf16 against a
+        token-agreement budget) > MXNET_GEN_KV_DTYPE env > "model"."""
+        import os
+
+        if explicit is not None:
+            return explicit  # validated by GenerationConfig
+        from ... import autotune
+
+        tuned = autotune.lookup("generation.kv_dtype", key=self._tune_key)
+        if isinstance(tuned, dict):
+            val = str(tuned.get("kv_dtype", "")).lower()
+            if val in KV_DTYPES:
+                return val
+        env = os.environ.get("MXNET_GEN_KV_DTYPE", "").strip().lower()
+        return env if env in KV_DTYPES else "model"
+
     @classmethod
     def from_checkpoint(cls, path, model, **kwargs):
         """Generator over a :meth:`TransformerParallel.save_checkpoint`
@@ -426,7 +511,26 @@ class Generator:
         return cls(model, model.load_checkpoint(path), **kwargs)
 
     # -------------------------------------------------- compiled programs
-    def _prefill_step(self, params, pages_k, pages_v, tokens, length,
+    def _scatter_kv(self, pools, dest, off, k_new, v_new):
+        """Write new K/V vectors into the page pools at (dest, off) —
+        quantizing on the way in int8 mode (scales land in the scale
+        pools at the same coordinates). ``k_new``/``v_new``:
+        (L, n, H, hd) [prefill rows] or (L, S, H, hd) [decode]."""
+        pools = dict(pools)
+        if self._quant_kv:
+            kq, ksc = _quantize_kv(k_new)
+            vq, vsc = _quantize_kv(v_new)
+            pools["k"] = pools["k"].at[:, dest, off].set(kq)
+            pools["v"] = pools["v"].at[:, dest, off].set(vq)
+            pools["ks"] = pools["ks"].at[:, dest, off].set(ksc)
+            pools["vs"] = pools["vs"].at[:, dest, off].set(vsc)
+        else:
+            dt = pools["k"].dtype
+            pools["k"] = pools["k"].at[:, dest, off].set(k_new.astype(dt))
+            pools["v"] = pools["v"].at[:, dest, off].set(v_new.astype(dt))
+        return pools
+
+    def _prefill_step(self, params, pools, tokens, length,
                       page_row, key, temp, top_k):
         """ONE compiled program per prompt bucket: full causal forward,
         prompt K/V scattered into the paged cache, first token sampled.
@@ -440,18 +544,19 @@ class Generator:
         pos = jnp.arange(bucket, dtype=jnp.int32)
         dest = page_row[pos // self.page_size]
         off = pos % self.page_size
-        pages_k = pages_k.at[:, dest, off].set(ks[:, 0])
-        pages_v = pages_v.at[:, dest, off].set(vs[:, 0])
+        pools = self._scatter_kv(pools, dest, off, ks[:, 0], vs[:, 0])
         last = logits[0, length - 1]
         tok, new_key = sample_tokens(last[None], key[None], temp[None],
                                      top_k[None])
-        return pages_k, pages_v, tok[0], new_key[0]
+        return pools, tok[0], new_key[0]
 
-    def _decode_step(self, params, pages_k, pages_v, page_table, seq_len,
+    def _decode_step(self, params, pools, page_table, seq_len,
                      active, last_token, temp, top_k, keys):
         """THE decode program: one step for every slot, active or not.
         Fixed shapes throughout — batch composition, sequence lengths
-        and sampling mixes are all data, never compile keys."""
+        and sampling mixes are all data, never compile keys. The pool
+        dtype (int8 vs model/bf16) is part of the program's SIGNATURE —
+        one compiled decode program per pool mode, never per batch."""
         import jax.numpy as jnp
 
         from ...parallel.flash_attention import paged_decode_attention
@@ -464,21 +569,35 @@ class Generator:
         # inactive slots scatter to the trash page 0; active slots own
         # disjoint pages, so the writes never collide
         dest = jnp.where(active, page_table[rows, pidx], 0)
-        state = {"k": pages_k, "v": pages_v}
+        state = dict(pools)
+        quant = self._quant_kv
 
         def attend(li, q, k_new, v_new):
-            state["k"] = state["k"].at[li, dest, off].set(k_new)
-            state["v"] = state["v"].at[li, dest, off].set(v_new)
+            if quant:
+                kq, ksc = _quantize_kv(k_new)
+                vq, vsc = _quantize_kv(v_new)
+                state["k"] = state["k"].at[li, dest, off].set(kq)
+                state["v"] = state["v"].at[li, dest, off].set(vq)
+                state["ks"] = state["ks"].at[li, dest, off].set(ksc)
+                state["vs"] = state["vs"].at[li, dest, off].set(vsc)
+            else:
+                dt = state["k"].dtype
+                state["k"] = state["k"].at[li, dest, off].set(
+                    k_new.astype(dt))
+                state["v"] = state["v"].at[li, dest, off].set(
+                    v_new.astype(dt))
             return paged_decode_attention(
                 q, state["k"][li], state["v"][li], page_table, seq_len + 1,
-                block_tokens=self.decode_blocks)
+                block_tokens=self.decode_blocks,
+                k_scale=state["ks"][li] if quant else None,
+                v_scale=state["vs"][li] if quant else None)
 
         logits = self._model.decode_forward(params, last_token, attend)
         logits = logits.astype(jnp.float32)  # fp32 sampling island
         toks, new_keys = sample_tokens(logits, keys, temp, top_k)
         toks = jnp.where(active, toks, -1)
         new_keys = jnp.where(active[:, None], new_keys, keys)
-        return state["k"], state["v"], toks, new_keys
+        return state, toks, new_keys
 
     def warmup(self):
         """Compile every prefill bucket plus the decode program against
@@ -500,22 +619,22 @@ class Generator:
         n = 0
         with self._pages_lock:
             for bucket in self._cfg.prefill_buckets:
-                pk, pv, tok, _ = self._prefill_jit(
-                    self._params, self._pages_k, self._pages_v,
+                pools, tok, _ = self._prefill_jit(
+                    self._params, self._pools,
                     np.zeros((1, bucket), np.int32), np.int32(1),
                     np.zeros(self._max_pages, np.int32),
                     np.zeros(2, np.uint32), np.float32(0), np.int32(0))
                 jax.block_until_ready(tok)
-                self._pages_k, self._pages_v = pk, pv
+                self._pools = pools
                 n += 1
-            pk, pv, toks, _ = self._decode_jit(
-                self._params, self._pages_k, self._pages_v,
+            pools, toks, _ = self._decode_jit(
+                self._params, self._pools,
                 np.zeros((S, self._max_pages), np.int32),
                 np.zeros(S, np.int32), np.zeros(S, bool),
                 np.zeros(S, np.int32), np.zeros(S, np.float32),
                 np.zeros(S, np.int32), np.zeros((S, 2), np.uint32))
             jax.block_until_ready(toks)
-            self._pages_k, self._pages_v = pk, pv
+            self._pools = pools
         return n + 1
 
     # ----------------------------------------------------------- lifecycle
@@ -753,11 +872,11 @@ class Generator:
         tokens[0, :plen] = ent.prompt
         key = np.asarray(jax.random.PRNGKey(sp.seed), np.uint32)
         with self._pages_lock:
-            pk, pv, tok, nkey = self._prefill_jit(
-                self._params, self._pages_k, self._pages_v, tokens,
+            pools, tok, nkey = self._prefill_jit(
+                self._params, self._pools, tokens,
                 np.int32(plen), row, key, np.float32(sp.temperature),
                 np.int32(sp.top_k))
-            self._pages_k, self._pages_v = pk, pv
+            self._pools = pools
         # the ONE host sync of admission: the prompt's first token (this
         # is also the time-to-first-token mark)
         first = int(np.asarray(tok))  # graftlint: disable=G001 — admission-boundary fetch, not a hot-loop sync
@@ -829,11 +948,11 @@ class Generator:
             if need >= len(owned):  # extend-on-decode
                 self._page_table[slot, need] = self.pool.extend(slot)
         with self._pages_lock:
-            pk, pv, toks, nkeys = self._decode_jit(
-                self._params, self._pages_k, self._pages_v,
+            pools, toks, nkeys = self._decode_jit(
+                self._params, self._pools,
                 self._page_table, self._seq_len, self._active,
                 self._last_token, self._temp, self._top_k, self._keys)
-            self._pages_k, self._pages_v = pk, pv
+            self._pools = pools
         n_active = int(self._active.sum())
         # the decode loop's one bounded host fetch per step (everything
         # else above is dispatch): S int32 tokens + S keys
@@ -869,8 +988,18 @@ class Generator:
             queued=queued, active=n_active,
             max_batch=self._cfg.max_batch, max_seq=self._cfg.max_seq,
             page_size=self.page_size, decode_blocks=self.decode_blocks,
+            kv_dtype=self.kv_dtype,
             prefill_buckets=list(self._cfg.prefill_buckets),
             pool=self.pool.get_stats(),
-            graph_pass={"amp": bool(self._amp)},
+            graph_pass={"amp": bool(self._amp),
+                        "kv_dtype": self.kv_dtype},
             running=self.running, stopped=stopped)
         return stats
+
+    def kv_read_bytes_per_token(self, ctx_len):
+        """HBM bytes ONE decode step reads from the KV pool for one slot
+        at context length ``ctx_len`` — the analytic
+        bytes-per-generated-token witness the ``generation_lm`` bench
+        reports (decode is gather-bound, so this IS the step's traffic
+        model; int8 pools roughly halve it vs bf16, quarter vs fp32)."""
+        return int(ctx_len) * self.pool.bytes_per_token

@@ -39,14 +39,25 @@ class PagePool:
     trash page 0, so ``capacity = pool_pages - 1`` pages are allocatable.
     All methods are thread-safe; the scheduler thread allocates/frees
     while ``get_stats`` (metrics, flight recorder, tests) reads.
+
+    ``bytes_per_token``/``kv_dtype`` (optional) describe the DEVICE cost
+    of one cached position — K + V across every layer and head at the
+    pool's storage dtype, plus any quantization scales stored alongside
+    (ISSUE 11). With them the pool reports bytes, not just page counts:
+    the ``generation.kv_bytes_used`` gauge and the ``kv_bytes_*`` stats
+    make an int8 pool directly comparable to a bf16 one in dashboards
+    and in the ``generation_lm`` bench output.
     """
 
-    def __init__(self, pool_pages, page_size):
+    def __init__(self, pool_pages, page_size, bytes_per_token=0,
+                 kv_dtype=None):
         if pool_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is the trash page), "
                              "got %d" % pool_pages)
         self.page_size = int(page_size)
         self.pool_pages = int(pool_pages)
+        self.bytes_per_token = int(bytes_per_token)
+        self.kv_dtype = str(kv_dtype) if kv_dtype is not None else None
         self._lock = threading.Lock()
         # LIFO free list: recently-freed pages are re-used first (their
         # device tiles are warm in whatever cache hierarchy applies)
@@ -67,6 +78,16 @@ class PagePool:
     def pages_for(self, n_tokens):
         """Pages needed to hold ``n_tokens`` cache positions."""
         return -(-int(n_tokens) // self.page_size)
+
+    @property
+    def page_bytes(self):
+        """Device bytes one page occupies (0 when the pool was built
+        without a byte model)."""
+        return self.page_size * self.bytes_per_token
+
+    def kv_bytes_used(self):
+        """Device bytes of the pages currently allocated."""
+        return self.pages_used() * self.page_bytes
 
     def can_admit(self, worst_case_tokens):
         """Would a sequence that may grow to ``worst_case_tokens`` ever
@@ -142,14 +163,26 @@ class PagePool:
     def _gauge(self):
         from ...observability import metrics
 
-        metrics.gauge("generation.kv_pages_used").set(self.pages_used())
+        used = self.pages_used()
+        metrics.gauge("generation.kv_pages_used").set(used)
+        if self.bytes_per_token:
+            # bytes, not pages: the gauge that makes int8 vs bf16 pools
+            # comparable on one dashboard axis (ISSUE 11 satellite)
+            metrics.gauge("generation.kv_bytes_used").set(
+                used * self.page_bytes)
 
     def get_stats(self):
         with self._lock:
+            used = self.capacity - len(self._free)
             return {"page_size": self.page_size,
                     "capacity": self.capacity,
                     "free": len(self._free),
-                    "used": self.capacity - len(self._free),
+                    "used": used,
                     "peak_used": self._peak,
                     "reserved": self._reserved,
+                    "kv_dtype": self.kv_dtype,
+                    "bytes_per_token": self.bytes_per_token,
+                    "kv_bytes_used": used * self.page_bytes,
+                    "kv_bytes_peak": self._peak * self.page_bytes,
+                    "kv_bytes_capacity": self.capacity * self.page_bytes,
                     "slots": {s: len(p) for s, p in self._owned.items()}}

@@ -1,0 +1,602 @@
+"""Quantized inference end-to-end (ISSUE 11): calibration determinism,
+per-channel scale math vs a numpy reference, fp32-island boundaries,
+quantized-vs-fp32 top-1 agreement on the zoo, int8 paged-KV decode
+token agreement + compile-count flatness + zero leaked pages, pipeline
+composition (prune→bn_fold→quantize→fold), grammar, serving bind
+option, PagePool byte telemetry, and the two arbitration tuners."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune, graph_pass
+from mxnet_tpu import observability as obs
+from mxnet_tpu.graph_pass import CalibrationTable, PassConfig
+from mxnet_tpu.graph_pass import quantize as qz
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.parallel.transformer import TransformerParallel
+from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                          PagePool, SamplingParams)
+
+# the documented int8 decode tolerance (docs/quantization.md)
+TOKEN_AGREEMENT_BAR = 0.9
+
+
+@pytest.fixture(autouse=True)
+def _quantize_reset():
+    graph_pass.set_passes(None)
+    graph_pass.set_calibration_table(None)
+    graph_pass.set_quantize_skip(None)
+    graph_pass.reset_stats()
+    yield
+    graph_pass.set_passes(None)
+    graph_pass.set_calibration_table(None)
+    graph_pass.set_quantize_skip(None)
+
+
+@pytest.fixture
+def telemetry():
+    obs.set_enabled(True)
+    obs.reset_metrics()
+    yield
+    obs.reset_metrics()
+    obs.set_enabled(False)
+
+
+@pytest.fixture
+def own_tune_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TUNE_CACHE", str(tmp_path / "tuning.json"))
+    autotune.reset()
+    yield
+    autotune.reset()
+
+
+# --------------------------------------------------------------- helpers
+
+def _conv_net():
+    data = mx.sym.var("data")
+    x = data
+    for i in range(2):
+        x = mx.sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                               no_bias=(i == 1), name="c%d" % i)
+        x = mx.sym.BatchNorm(x, name="bn%d" % i, fix_gamma=(i == 0))
+        x = mx.sym.Activation(x, act_type="relu", name="act%d" % i)
+    x = mx.sym.Flatten(x, name="flat")
+    x = mx.sym.FullyConnected(x, num_hidden=7, name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax"), (6, 3, 10, 10)
+
+
+def _fc_net():
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="act")
+    x = mx.sym.FullyConnected(x, num_hidden=5, name="fc2")
+    return mx.sym.SoftmaxOutput(x, name="softmax"), (8, 12)
+
+
+def _materialize(sym, dshape, seed=7, head=None, head_gain=8.0):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=dshape)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data",) and not n.endswith("label")}
+    if head is not None:
+        # decisive class margins (an untrained net's logits are near-
+        # tied; argmax agreement must measure int8 error, not noise)
+        args[head] = args[head] * head_gain
+    auxs = {n: mx.nd.array(rng.uniform(0.5, 1.5, s).astype(np.float32))
+            for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    x = rng.uniform(0, 1, dshape).astype(np.float32)
+    return args, auxs, x
+
+
+def _bind(sym, spec, dshape, args, auxs):
+    graph_pass.set_passes(spec)
+    try:
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)], for_training=False)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.set_params(args, auxs)
+        return mod
+    finally:
+        graph_pass.set_passes(None)
+
+
+def _predict(mod, x):
+    return mod.predict(NDArrayIter(x, None, batch_size=x.shape[0])).asnumpy()
+
+
+def _quant_summary(mod):
+    exe = mod._exec_group.execs[0]
+    assert exe._opt is not None
+    return exe._opt.summary().get("quantize", {})
+
+
+# ----------------------------------------------------------- calibration
+
+def test_calibration_determinism_and_roundtrip(tmp_path):
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    mod = _bind(sym, "default", dshape, args, auxs)
+    batches = [x[i:i + 2] for i in range(0, 6, 2)]
+    t1 = graph_pass.calibrate(mod, batches)
+    t2 = graph_pass.calibrate(mod, batches)
+    assert len(t1) > 3 and t1.batches == 3
+    assert t1.fingerprint() == t2.fingerprint()
+    # node outputs AND the data input are both observed
+    assert "data" in t1.ranges() and "c0_output" in t1.ranges()
+    path = str(tmp_path / "table.json")
+    t1.save(path)
+    t3 = CalibrationTable.load(path)
+    assert t3.fingerprint() == t1.fingerprint()
+    assert t3.ranges() == t1.ranges()
+
+
+def test_calibration_percentile_mode_clips_outliers():
+    t = CalibrationTable(mode="percentile", percentile=90.0)
+    arr = np.ones(1000, np.float32)
+    arr[0] = 1000.0  # one outlier must not own the whole range
+    t.observe("x", arr)
+    assert t.get("x") < 2.0
+    t_abs = CalibrationTable(mode="absmax")
+    t_abs.observe("x", arr)
+    assert t_abs.get("x") == 1000.0
+
+
+# ------------------------------------------------------- scale math (ref)
+
+def test_per_channel_scale_math_vs_numpy_reference():
+    """One quantized FC vs a from-scratch numpy implementation of the
+    island: per-channel weight scales, per-tensor activation scale,
+    int32 accumulation, per-channel rescale + fp32 bias."""
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    dshape = (4, 9)
+    rng = np.random.RandomState(3)
+    W = rng.uniform(-0.7, 0.7, (5, 9)).astype(np.float32)
+    b = rng.uniform(-0.2, 0.2, (5,)).astype(np.float32)
+    x = rng.uniform(-1.2, 1.2, dshape).astype(np.float32)
+    args = {"fc_weight": mx.nd.array(W), "fc_bias": mx.nd.array(b)}
+    mod = _bind(out, "default", dshape, args, {})
+    table = graph_pass.calibrate(mod, [x])
+
+    graph_pass.set_calibration_table(table)
+    qmod = _bind(out, "default,quantize", dshape, args, {})
+    got = _predict(qmod, x)
+    assert _quant_summary(qmod)["ops_quantized"] == 1
+
+    # numpy reference of the exact same math
+    s_x = max(float(np.abs(x).max()), 1e-12) / 127.0
+    xq = np.clip(np.round(x / s_x), -127, 127).astype(np.int32)
+    s_w = np.maximum(np.abs(W).max(axis=1, keepdims=True) / 127.0, 1e-12)
+    wq = np.clip(np.round(W / s_w), -127, 127).astype(np.int32)
+    ref = (xq @ wq.T).astype(np.float32) * (s_x * s_w[:, 0])[None, :] + b
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------- fp32 islands
+
+def test_fp32_island_boundaries():
+    """Softmax stays an untouched fp32 island; the int8 lattice exists
+    exactly inside the conv/FC islands (visible as int8 Casts)."""
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    graph_pass.set_calibration_table(table)
+    opt = graph_pass.optimize(
+        sym, for_training=False,
+        frozen=set(args) | set(auxs),
+        arg_shapes={"data": dshape},
+        config=PassConfig(spec="default,quantize"))
+    ops = [(n.opdef().name, n.attrs) for n in opt.symbol.topo_nodes()
+           if not n.is_variable]
+    names = [o for o, _ in ops]
+    assert "softmax" in names  # pruned loss head, NOT quantized away
+    int8_casts = [a for o, a in ops
+                  if o == "Cast" and a.get("dtype") == "int8"]
+    assert int8_casts, "no int8 lattice in the rewritten graph"
+    # the output head is fp32: the final op is not an integer compute
+    out_node = opt.symbol._outputs[0][0]
+    assert out_node.opdef().name == "softmax"
+
+
+def test_quantize_never_runs_on_training_bind():
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    graph_pass.set_calibration_table(table)
+    opt = graph_pass.optimize(
+        sym, for_training=True,
+        frozen=set(args) | set(auxs),
+        arg_shapes={"data": dshape},
+        config=PassConfig(spec="default,quantize"))
+    passes_run = [r["pass"] for r in (opt.reports if opt else [])]
+    assert "quantize" not in passes_run
+
+
+# ------------------------------------------------------ zoo-level parity
+
+@pytest.mark.parametrize("builder,head", [(_conv_net, "fc_weight"),
+                                          (_fc_net, "fc2_weight")])
+def test_top1_agreement_on_zoo(builder, head):
+    sym, dshape = builder()
+    args, auxs, x = _materialize(sym, dshape, head=head)
+    fp32 = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(fp32, [x])
+    ref = _predict(fp32, x)
+    graph_pass.set_calibration_table(table)
+    qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+    out = _predict(qmod, x)
+    info = _quant_summary(qmod)
+    assert info["ops_quantized"] == info["ops_eligible"] > 0
+    agreement = (ref.argmax(1) == out.argmax(1)).mean()
+    assert agreement >= 0.99, agreement
+
+
+def test_resnet_toy_top1_agreement_and_pipeline_composition():
+    """The acceptance model: prune→bn_fold→quantize→fold composes on a
+    resnet-style graph — BN gone, every conv/FC quantized, int8 weights
+    folded, top-1 agreement >= 99%."""
+    from mxnet_tpu.models import get_resnet
+
+    sym = get_resnet(num_classes=10, num_layers=8, image_shape=(3, 16, 16))
+    dshape = (8, 3, 16, 16)
+    args, auxs, x = _materialize(sym, dshape, head="fc1_weight")
+    fp32 = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(
+        fp32, [np.random.RandomState(1).uniform(0, 1, dshape)
+               .astype(np.float32), x])
+    ref = _predict(fp32, x)
+    graph_pass.set_calibration_table(table)
+    qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+    out = _predict(qmod, x)
+    agreement = (ref.argmax(1) == out.argmax(1)).mean()
+    assert agreement >= 0.99, agreement
+    info = _quant_summary(qmod)
+    assert info["ops_quantized"] == info["ops_eligible"] > 5, info
+    exe = qmod._exec_group.execs[0]
+    # fold materialized the int8 weights (quarter-width serving payload)
+    feed = exe._arg_datas()
+    int8_feed = [n for n, v in feed.items() if str(v.dtype) == "int8"]
+    assert len(int8_feed) == info["ops_quantized"]
+
+
+def test_bn_fold_then_quantize_composition():
+    """Ordering: bn_fold retires the post-conv BatchNorms FIRST, so
+    quantize sees (and quantizes) the folded convs as one unit."""
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    graph_pass.set_calibration_table(table)
+    qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+    info = _quant_summary(qmod)
+    assert info["ops_quantized"] == 3  # c0, c1, fc — all of them
+    exe = qmod._exec_group.execs[0]
+    opt_ops = {n.opdef().name for n in exe._opt.symbol.topo_nodes()
+               if not n.is_variable}
+    assert "BatchNorm" not in opt_ops
+
+
+def test_compile_count_flat_across_rebinds(telemetry):
+    """Quantized re-binds are free: a reshape cycle back to a seen
+    shape re-runs neither the pass pipeline nor XLA compilation."""
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    graph_pass.set_calibration_table(table)
+    graph_pass.set_passes("default,quantize")
+    try:
+        qmod = mx.mod.Module(sym, context=mx.cpu())
+        qmod.bind(data_shapes=[("data", dshape)], for_training=False)
+        qmod.init_params(mx.init.Uniform(0.1))
+        qmod.set_params(args, auxs)
+        _predict(qmod, x)
+        runs = graph_pass.stats()["pipeline_runs"]
+        small = x[:2]
+        for _ in range(2):
+            qmod.reshape([("data", small.shape)])
+            _predict(qmod, small)
+            qmod.reshape([("data", dshape)])
+            _predict(qmod, x)
+        assert graph_pass.stats()["pipeline_runs"] == runs, \
+            "quantized re-binds re-ran the pass pipeline"
+        compiles = M.get_value("jit.compile_count", 0)
+        qmod.reshape([("data", small.shape)])
+        _predict(qmod, small)
+        assert M.get_value("jit.compile_count", 0) == compiles, \
+            "a shape seen before recompiled under quantize"
+    finally:
+        graph_pass.set_passes(None)
+
+
+# ---------------------------------------------------- provenance/grammar
+
+def test_coverage_report_and_skip_reasons():
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    graph_pass.set_calibration_table(table)
+    graph_pass.set_quantize_skip(["fc"])
+    qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+    info = _quant_summary(qmod)
+    assert info["skipped"] == {"fc": "tuned_fp32"}
+    assert info["ops_quantized"] == info["ops_eligible"] - 1
+    assert info["table"] == table.fingerprint()
+    stats = graph_pass.stats()
+    assert stats["quantized_ops"] >= 2
+    assert stats["quantize_skipped"] >= 1
+    recent = [r for r in graph_pass.recent_reports() if "quantize" in r]
+    assert recent and recent[-1]["quantize"]["table"] == table.fingerprint()
+
+
+def test_no_table_means_no_rewrite():
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape)
+    qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+    ref = _predict(_bind(sym, "default", dshape, args, auxs), x)
+    out = _predict(qmod, x)
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+    info = _quant_summary(qmod) if qmod._exec_group.execs[0]._opt else {}
+    assert info.get("ops_quantized", 0) == 0
+
+
+def test_pass_config_grammar_quantize(tmp_path):
+    assert "quantize" not in PassConfig("default").passes
+    assert "quantize" in PassConfig("default,quantize").passes
+    assert "quantize" in PassConfig("all").passes
+    assert "quantize" not in PassConfig("all,-quantize").passes
+    table = CalibrationTable()
+    table.observe("x", np.ones(4))
+    path = str(tmp_path / "CaseSensitive" / "t.json")
+    import os
+
+    os.makedirs(os.path.dirname(path))
+    table.save(path)
+    cfg = PassConfig("default,quantize=%s" % path)
+    assert cfg.quant_table == path  # case preserved
+    resolved = qz.resolve_table(cfg)
+    assert resolved.fingerprint() == table.fingerprint()
+    # the table fingerprint keys the bind cache
+    assert cfg.signature() != PassConfig("default,quantize").signature()
+
+
+def test_signature_tracks_table_and_skip():
+    t1 = CalibrationTable()
+    t1.observe("a", np.ones(3))
+    t2 = CalibrationTable()
+    t2.observe("a", 2 * np.ones(3))
+    s1 = PassConfig(spec="default,quantize", quant_table=t1).signature()
+    s2 = PassConfig(spec="default,quantize", quant_table=t2).signature()
+    assert s1 != s2
+    s3 = PassConfig(spec="default,quantize", quant_table=t1,
+                    quant_skip=("fc",)).signature()
+    assert s3 != s1
+
+
+# ------------------------------------------------------------- serving
+
+def test_serving_quantize_bind_option(tmp_path):
+    from mxnet_tpu import serving
+
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape, head="fc_weight")
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    ref = _predict(mod, x)
+    path = str(tmp_path / "table.json")
+    table.save(path)
+
+    server = serving.InferenceServer(
+        sym, {k: v for k, v in args.items()}, auxs,
+        data_shapes=[("data", dshape)], quantize=path, start=True)
+    try:
+        out = np.asarray(server.predict(x, timeout=120))
+        assert (ref.argmax(1) == out.argmax(1)).all()
+        stats = server.get_stats()
+        q = stats["graph_pass"].get("quantize", {})
+        assert q.get("ops_quantized", 0) > 0
+        # quarter-width weights resident per replica
+        int8_args = [n for n, v in server._replica_args[0].items()
+                     if str(v.dtype) == "int8"]
+        assert len(int8_args) == q["ops_quantized"]
+    finally:
+        server.stop()
+
+
+def test_serving_quantize_without_table_raises():
+    """Explicitly requested int8 serving must never silently fall back
+    to fp32: no resolvable table is an error, not a skipped rewrite."""
+    from mxnet_tpu import serving
+
+    sym, dshape = _fc_net()
+    args, auxs, _x = _materialize(sym, dshape)
+    with pytest.raises(mx.MXNetError, match="calibration table"):
+        serving.InferenceServer(sym, args, auxs,
+                                data_shapes=[("data", dshape)],
+                                quantize=True, start=False)
+
+
+# ------------------------------------------------------- int8 paged KV
+
+def _lm(**kw):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    cfg = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+               n_experts=2)
+    cfg.update(kw)
+    model = TransformerParallel(mesh, **cfg)
+    return model, model.init(seed=0)
+
+
+def _gen(model, params, **kw):
+    cfg = dict(page_size=8, max_batch=4, max_seq=64,
+               prefill_buckets=(16, 32, 64))
+    cfg.update(kw)
+    return Generator(model, params, GenerationConfig(**cfg))
+
+
+def test_int8_decode_token_agreement_within_tolerance():
+    model, params = _lm()
+    rng = np.random.RandomState(3)
+    prompts = [[int(t) for t in rng.randint(1, 64, size=n)]
+               for n in (2, 9, 17, 28)]
+    sp = SamplingParams(max_new_tokens=10)
+
+    def run(kv):
+        gen = _gen(model, params, kv_dtype=kv)
+        try:
+            return [gen.generate(p, sp, timeout=300) for p in prompts]
+        finally:
+            gen.stop()
+
+    ref = run(None)
+    toks = run("int8")
+    pairs = [(a, b) for r, s in zip(ref, toks) for a, b in zip(r, s)]
+    agreement = np.mean([a == b for a, b in pairs])
+    assert agreement >= TOKEN_AGREEMENT_BAR, agreement
+    # the FIRST token of every request comes from the exact prefill
+    # logits (never from quantized cache reads)
+    assert all(r[0] == s[0] for r, s in zip(ref, toks))
+
+
+def test_int8_decode_compile_count_flat(telemetry):
+    model, params = _lm()
+    gen = _gen(model, params, kv_dtype="int8")
+    try:
+        warmed = gen.warmup()
+        assert warmed == len(gen._cfg.prefill_buckets) + 1
+        after = M.get_value("jit.compile_count", 0)
+        rng = np.random.RandomState(0)
+        handles = [
+            gen.submit([int(t) for t in rng.randint(1, 64, size=plen)],
+                       SamplingParams(max_new_tokens=n_new))
+            for plen, n_new in ((2, 9), (30, 3), (11, 7), (17, 12))]
+        for h in handles:
+            h.result(timeout=300)
+        assert M.get_value("jit.compile_count", 0) == after, \
+            "int8 decode recompiled under mixed-length traffic"
+        assert gen.get_stats()["pool"]["used"] == 0, "leaked pages"
+    finally:
+        gen.stop()
+
+
+def test_int8_pool_bytes_telemetry(telemetry):
+    model, params = _lm()
+    gen = _gen(model, params, kv_dtype="int8")
+    try:
+        pool = gen.pool
+        # 2 (K+V) * L2 * H4 * hd8 * 1B + 2 * L2 * H4 * 4B scales
+        assert pool.bytes_per_token == 2 * 2 * 4 * 8 + 2 * 2 * 4 * 4
+        assert pool.get_stats()["kv_dtype"] == "int8"
+        h = gen.submit(list(range(1, 11)), SamplingParams(max_new_tokens=4))
+        h.result(timeout=300)
+        # bytes gauge went up while pages were held, back to 0 on evict
+        assert pool.get_stats()["kv_bytes_used"] == 0
+        assert M.get_value("generation.kv_bytes_used", -1) == 0
+        assert gen.kv_read_bytes_per_token(10) == 10 * pool.bytes_per_token
+    finally:
+        gen.stop()
+
+
+def test_model_dtype_pool_reports_wider_bytes():
+    model, params = _lm()
+    gen = _gen(model, params)
+    try:
+        assert gen.kv_dtype == "model"
+        assert gen.pool.bytes_per_token == 2 * 2 * 4 * 8 * 4  # fp32
+    finally:
+        gen.stop()
+
+
+def test_kv_dtype_resolution_explicit_beats_cache_beats_env(
+        own_tune_cache, monkeypatch):
+    from mxnet_tpu.serving.generation.engine import generation_tune_key
+
+    model, params = _lm()
+    key = generation_tune_key(model, 4, 64)
+    monkeypatch.setenv("MXNET_GEN_KV_DTYPE", "bfloat16")
+    gen = _gen(model, params)
+    assert gen.kv_dtype == "bfloat16"
+    gen.stop()
+    autotune.record("generation.kv_dtype", key, {"kv_dtype": "int8"})
+    gen = _gen(model, params)
+    assert gen.kv_dtype == "int8"
+    gen.stop()
+    gen = _gen(model, params, kv_dtype="model")
+    assert gen.kv_dtype == "model"
+    gen.stop()
+    with pytest.raises(ValueError):
+        GenerationConfig(kv_dtype="float8")
+
+
+def test_pagepool_bytes_model_direct():
+    pool = PagePool(5, 8, bytes_per_token=100, kv_dtype="int8")
+    assert pool.page_bytes == 800
+    pool.admit(0, 10, 12)  # 2 pages
+    assert pool.kv_bytes_used() == 1600
+    stats = pool.get_stats()
+    assert stats["kv_bytes_used"] == 1600
+    assert stats["kv_bytes_capacity"] == 4 * 800
+    pool.release(0, 12)
+    assert pool.kv_bytes_used() == 0
+
+
+# --------------------------------------------------------------- tuners
+
+def test_tune_generation_kv_records_and_is_consulted(own_tune_cache):
+    model, params = _lm()
+
+    def measure(kv):  # stub: int8 fastest and inside budget
+        return ({"model": 2.0, "bfloat16": 1.5, "int8": 1.0}[kv],
+                {"model": 1.0, "bfloat16": 0.99, "int8": 0.95}[kv])
+
+    out = autotune.tune_generation_kv(model, params, max_batch=4,
+                                      max_seq=64, budget=0.9,
+                                      measure=measure)
+    assert out["kv_dtype"] == "int8"
+    gen = _gen(model, params)
+    try:
+        assert gen.kv_dtype == "int8"  # consulted from the cache
+    finally:
+        gen.stop()
+
+
+def test_tune_generation_kv_budget_vetoes_lossy(own_tune_cache):
+    model, params = _lm()
+
+    def measure(kv):  # int8 fastest but OUTSIDE the budget
+        return ({"model": 2.0, "bfloat16": 1.5, "int8": 1.0}[kv],
+                {"model": 1.0, "bfloat16": 0.99, "int8": 0.5}[kv])
+
+    out = autotune.tune_generation_kv(model, params, max_batch=4,
+                                      max_seq=64, budget=0.9,
+                                      measure=measure)
+    assert out["kv_dtype"] == "bfloat16"
+
+
+def test_tune_quantize_layers_greedy_drop(own_tune_cache):
+    """With a table poisoned for one layer, the greedy arbiter pins
+    exactly that layer to fp32 and the next quantized bind honors it."""
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape, head="fc_weight")
+    mod = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    # poison the FC activation range: its scale is now absurd, so the
+    # quantized FC wrecks top-1 until the tuner pins it fp32
+    table.observe("flat_output", np.array([1e6], np.float32))
+    batches = [x]
+    out = autotune.tune_quantize_layers(mod, batches, table, budget=0.99)
+    assert "fc" in out["skip"]
+    assert out["agreement"] >= 0.99
+    # a later quantized bind consults the cached skip list
+    graph_pass.set_calibration_table(table)
+    qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+    info = _quant_summary(qmod)
+    assert info["skipped"].get("fc") == "tuned_fp32"

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""CPU-fast quantized-inference smoke (tier-1 CI guard, ISSUE 11;
+docs/quantization.md).
+
+End-to-end in seconds on CPU, the way production uses the int8 path:
+
+1. **calibrate → rewrite → predict** — a conv+BN net is calibrated on
+   synthetic batches, bound under ``default,quantize``, and must ship
+   int8 folded weights (dtype-checked in the executor feed), report full
+   coverage through the graph-pass provenance, and agree with the fp32
+   program's top-1 on every row (the margins are made decisive, so
+   agreement measures quantization error, not init degeneracy),
+2. **int8 paged-KV decode** — a toy causal LM serves mixed-length
+   greedy requests with ``kv_dtype="int8"``: tokens must agree with the
+   model-dtype decode within the documented tolerance, the compile
+   count must stay FLAT after warmup (pool dtype is a program
+   signature, never a traced value), and zero KV pages (and bytes) may
+   leak after the drain.
+
+Prints a one-line JSON summary (optionally written to argv[1]); any
+violation raises, failing the CI step.
+"""
+import json
+import os
+import sys
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "MXNET_TUNE_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="quantize_smoke_"), "tuning.json"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import graph_pass  # noqa: E402
+from mxnet_tpu.io import NDArrayIter  # noqa: E402
+from mxnet_tpu.observability import metrics as M  # noqa: E402
+from mxnet_tpu.observability import set_enabled  # noqa: E402
+
+TOKEN_AGREEMENT_BAR = 0.9   # documented tolerance (docs/quantization.md)
+
+
+def _net():
+    data = mx.sym.var("data")
+    x = data
+    for i in range(2):
+        x = mx.sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                               no_bias=(i == 1), name="c%d" % i)
+        x = mx.sym.BatchNorm(x, name="bn%d" % i, fix_gamma=(i == 0))
+        x = mx.sym.Activation(x, act_type="relu", name="act%d" % i)
+    x = mx.sym.Flatten(x, name="flat")
+    x = mx.sym.FullyConnected(x, num_hidden=7, name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _bind(sym, spec, dshape, args, auxs):
+    graph_pass.set_passes(spec)
+    try:
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)], for_training=False)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.set_params(args, auxs)
+        return mod
+    finally:
+        graph_pass.set_passes(None)
+
+
+def predict_leg(summary):
+    rng = np.random.RandomState(11)
+    dshape = (8, 3, 10, 10)
+    sym = _net()
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=dshape)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    # decisive class margins: top-1 agreement then measures the int8
+    # error, not argmax noise between near-tied logits
+    args["fc_weight"] = args["fc_weight"] * 8.0
+    auxs = {n: mx.nd.array(rng.uniform(0.5, 1.5, s).astype(np.float32))
+            for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    x = rng.uniform(0, 1, dshape).astype(np.float32)
+
+    fp32 = _bind(sym, "default", dshape, args, auxs)
+    table = graph_pass.calibrate(
+        fp32, [rng.uniform(0, 1, dshape).astype(np.float32)
+               for _ in range(4)])
+    ref = fp32.predict(NDArrayIter(x, None, batch_size=8)).asnumpy()
+
+    graph_pass.set_calibration_table(table)
+    try:
+        qmod = _bind(sym, "default,quantize", dshape, args, auxs)
+        out = qmod.predict(NDArrayIter(x, None, batch_size=8)).asnumpy()
+    finally:
+        graph_pass.set_calibration_table(None)
+
+    top1 = float((ref.argmax(1) == out.argmax(1)).mean())
+    exe = qmod._exec_group.execs[0]
+    feed = exe._arg_datas()
+    int8_args = [n for n, v in feed.items() if str(v.dtype) == "int8"]
+    info = exe._opt.summary().get("quantize", {})
+    summary["predict"] = {
+        "top1_agreement": top1,
+        "ops_quantized": info.get("ops_quantized"),
+        "ops_eligible": info.get("ops_eligible"),
+        "table": info.get("table"),
+        "int8_folded_args": len(int8_args),
+        "max_abs_err": float(np.abs(ref - out).max()),
+    }
+    assert top1 == 1.0, "quantized top-1 disagrees with fp32: %s" % top1
+    assert info.get("ops_quantized") == info.get("ops_eligible") == 3, info
+    assert int8_args, "no int8 folded weights in the executor feed"
+    assert info.get("table"), "no calibration-table fingerprint reported"
+
+
+def decode_leg(summary):
+    import jax
+
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+    from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                              SamplingParams)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    model = TransformerParallel(mesh, vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, n_experts=2)
+    params = model.init(seed=0)
+    rng = np.random.RandomState(5)
+    prompts = [[int(t) for t in rng.randint(1, 64, size=n)]
+               for n in (2, 7, 13, 21, 30)]
+    sp = SamplingParams(max_new_tokens=10)  # greedy
+
+    def run(kv_dtype):
+        gen = Generator(model, params,
+                        GenerationConfig(page_size=8, max_batch=4,
+                                         max_seq=64,
+                                         prefill_buckets=(16, 32, 64),
+                                         kv_dtype=kv_dtype))
+        try:
+            warmed = gen.warmup()
+            after_warmup = M.get_value("jit.compile_count", 0)
+            toks = [h.result(timeout=300)
+                    for h in [gen.submit(p, sp) for p in prompts]]
+            flat = M.get_value("jit.compile_count", 0) == after_warmup
+            stats = gen.get_stats()
+            return toks, warmed, flat, stats
+        finally:
+            gen.stop()
+
+    ref, _, _, _ = run("model")
+    toks, warmed, flat, stats = run("int8")
+    pairs = [(a, b) for r, s in zip(ref, toks) for a, b in zip(r, s)]
+    agreement = float(np.mean([a == b for a, b in pairs]))
+    pool = stats["pool"]
+    summary["decode"] = {
+        "kv_dtype": stats["kv_dtype"],
+        "token_agreement": agreement,
+        "programs_warmed": warmed,
+        "compile_count_flat": flat,
+        "bytes_per_token": pool["bytes_per_token"],
+        "leaked_pages": pool["used"],
+        "leaked_bytes": pool["kv_bytes_used"],
+    }
+    assert stats["kv_dtype"] == "int8"
+    assert agreement >= TOKEN_AGREEMENT_BAR, \
+        "int8 decode agreement %.3f < %s" % (agreement, TOKEN_AGREEMENT_BAR)
+    assert flat, "int8 decode recompiled after warmup"
+    assert pool["used"] == 0 and pool["kv_bytes_used"] == 0, \
+        "leaked KV pages: %s" % pool
+    assert pool["bytes_per_token"] < 512, \
+        "int8 pool not narrower than fp32: %s" % pool["bytes_per_token"]
+
+
+def main(out_path=None):
+    set_enabled(True)
+    summary = {}
+    predict_leg(summary)
+    decode_leg(summary)
+    summary["ok"] = True
+    line = json.dumps(summary)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -219,19 +219,26 @@ def graph_pass_rows(payload):
     rows = []
     for prog in section.get("recent", []):
         tag = prog.get("graph", prog.get("program", "?"))
-        if "passes" not in prog:  # external program note (generation amp)
+        if "passes" not in prog:  # external program note (generation)
             rows.append({"program": tag, "pass": "amp",
                          "rewrites": 1 if prog.get("amp") else 0,
-                         "nodes_before": None, "nodes_after": None})
+                         "nodes_before": None, "nodes_after": None,
+                         "kv_dtype": prog.get("kv_dtype")})
             continue
         for rep in prog["passes"]:
-            rows.append({
+            row = {
                 "program": tag, "pass": rep["pass"],
                 "rewrites": rep["rewrites"],
                 "nodes_before": rep["nodes_before"],
                 "nodes_after": rep["nodes_after"],
                 "amp": prog.get("amp", False),
-                "folded_constants": prog.get("folded_constants", 0)})
+                "folded_constants": prog.get("folded_constants", 0)}
+            if rep["pass"] == "quantize":
+                # int8 coverage + calibration-table fingerprint: the
+                # triage row a numerics regression needs (ISSUE 11)
+                row["quantize"] = rep.get("detail",
+                                          prog.get("quantize")) or {}
+            rows.append(row)
     return rows
 
 
@@ -248,6 +255,16 @@ def format_graph_pass(rows, path):
             "-" if r["nodes_before"] is None else r["nodes_before"],
             "-" if r["nodes_after"] is None else r["nodes_after"],
             "Y" if r.get("amp") else "-"))
+        if r.get("kv_dtype"):
+            lines.append("  kv pages: %s" % r["kv_dtype"])
+        q = r.get("quantize")
+        if q:
+            lines.append(
+                "  int8 coverage: %s/%s ops quantized, table %s" % (
+                    q.get("ops_quantized", 0), q.get("ops_eligible", 0),
+                    q.get("table", "-")))
+            for name, why in sorted(q.get("skipped", {}).items()):
+                lines.append("    fp32 %-24s %s" % (name, why))
     return "\n".join(lines)
 
 
