@@ -300,6 +300,37 @@ Flags currently honored:
     consumer. 2 = classic double buffering — batch N+1's transfer
     overlaps batch N's compute; 1 disables the overlap (debug).
 
+``MXNET_OBS_TRACE_SAMPLE`` (default 1)
+    Request-trace sampling of the serving stack
+    (observability/request_trace.py): every sampled request carries a
+    ``RequestTrace`` from submit to completion with exact
+    queue/batch/compute/fetch (serving) or queue/prefill/decode
+    (generation) latency attribution. 0 = tracing off (shared no-op
+    trace, gated < 1%/request by ``bench_all.py --obs-overhead``),
+    1 = every request, N = 1-in-N.
+
+``MXNET_OBS_RESERVOIR`` (default 32)
+    Capacity of the request-trace tail reservoir: the slowest-K
+    requests ever seen (p99 exemplars) plus the most-recent-K full span
+    timelines, served by the exposition plane's ``/tracez``.
+
+``MXNET_OBS_HTTP_PORT`` (default unset = off)
+    Opt-in live exposition plane (observability/exposition.py): a
+    stdlib HTTP daemon thread serving ``/metrics`` (Prometheus text),
+    ``/statusz`` (live engine/provider JSON), ``/healthz`` and
+    ``/tracez``. Set to a port (0 = ephemeral) before import, or call
+    ``observability.exposition.start_http(port)`` at runtime. Binds
+    127.0.0.1 unless ``MXNET_OBS_HTTP_HOST`` widens it. String-valued,
+    env-only — like MXNET_PROFILER_MODE, NOT routed through the integer
+    get_flag machinery (unset must mean "off", not port 0).
+
+``MXNET_PROFILER_RING`` (default 200000)
+    Bound of the profiler's in-memory event ring (profiler.py): beyond
+    it the OLDEST events are evicted and counted
+    (``profiler.dropped_events()``, the ``profiler.events_dropped``
+    metric, ``droppedEventsCount`` in the dump) so a week-long serving
+    process with spans on cannot grow host memory without bound.
+
 ``MXNET_PROFILER_MODE`` (default ``symbolic``)
     Initial profiler mode (``symbolic`` / ``imperative`` / ``all``) so a
     trace can be captured from an unmodified script via env alone;
@@ -351,6 +382,9 @@ _DEFAULTS = {
     "MXNET_RETRY_DEADLINE_MS": 30000,
     "MXNET_SERVING_DEADLINE_MS": 0,
     "MXNET_SERVING_COOLDOWN_MS": 1000,
+    "MXNET_OBS_TRACE_SAMPLE": 1,
+    "MXNET_OBS_RESERVOIR": 32,
+    "MXNET_PROFILER_RING": 200000,
     "MXNET_IO_STREAMING": 0,
     "MXNET_IO_DECODE_WORKERS": 0,
     "MXNET_IO_PREFETCH_DEPTH": 2,
@@ -376,8 +410,16 @@ def _apply_telemetry(value):
         _instruments.install_jax_hooks()
 
 
+def _apply_obs_sample(value):
+    # keep request_trace's cached sampling rate coherent with the flag
+    from .observability import request_trace as _rtrace
+
+    _rtrace._apply_sample_flag(value)
+
+
 _APPLIERS = {"MXNET_DEBUG_NANS": _apply_debug_nans,
-             "MXNET_TELEMETRY": _apply_telemetry}
+             "MXNET_TELEMETRY": _apply_telemetry,
+             "MXNET_OBS_TRACE_SAMPLE": _apply_obs_sample}
 
 
 def get_flag(name, default=None):

@@ -280,10 +280,22 @@ class KVStore:
             _faults.inject("kvstore.push")
             self._push_impl(key, value, priority)
 
+        from .observability import request_trace as _rtrace
+
+        ambient = _rtrace.current()
+        if ambient is not None:
+            # close the caller's interval as the push STARTS — the
+            # "kvstore.push" phase below then covers exactly the RPC,
+            # not all the compute since the trace's previous mark
+            ambient.event("step")
         with trace_span("kvstore.push", "kvstore"):
             _retry.call(_attempt, policy=self._retry_policy,
                         name="kvstore.push")
         counter("kvstore.push").inc()
+        if ambient is not None:
+            # this push is one of the ambient trace's phases (the dist
+            # RPC under it already carried the trace id — PSClient._call)
+            ambient.event("kvstore.push")
         for k in (key if isinstance(key, (list, tuple)) else (key,)):
             self._note_push(k)
 
@@ -345,10 +357,18 @@ class KVStore:
             _faults.inject("kvstore.pull")
             self._pull_impl(key, out, priority)
 
+        from .observability import request_trace as _rtrace
+
+        ambient = _rtrace.current()
+        if ambient is not None:
+            ambient.event("step")  # pull phase starts here, not at the
+            #                        trace's previous mark
         with trace_span("kvstore.pull", "kvstore"):
             _retry.call(_attempt, policy=self._retry_policy,
                         name="kvstore.pull")
         counter("kvstore.pull").inc()
+        if ambient is not None:
+            ambient.event("kvstore.pull")
 
     def _pull_impl(self, key, out, priority=0):
         keys, outs = _ctype_key_value(key, out)

@@ -99,6 +99,24 @@ class KVStoreServer:
     # --- command handlers -------------------------------------------------
     def _handle(self, msg, conn_state):
         op = msg[0]
+        if op == "traced":
+            # a trace id rode the RPC (PSClient._call): handle the inner
+            # message and record its server-side span under the SAME
+            # trace_id, so a dumped server profile correlates with the
+            # worker's request/step timeline BY ID (trace_report
+            # --requests lists these as `stitched` spans — timestamps
+            # are per-process perf_counter epochs, never compared
+            # across dumps)
+            _, trace_id, inner = msg
+            from . import profiler
+
+            t0 = profiler._now_us()
+            resp = self._handle(inner, conn_state)
+            if profiler.spans_active():
+                profiler.record("kvstore.server.%s" % inner[0], "request",
+                                t0, profiler._now_us() - t0,
+                                args={"trace_id": trace_id})
+            return resp
         now = time.time()
         if op == "hello":
             rank = int(msg[1])
@@ -494,13 +512,24 @@ class PSClient:
         server applying a push and the reply landing means the retry
         re-applies it — inherent to retried non-idempotent RPC, and the
         reference PS protocol's behavior too."""
+        from .observability import request_trace as _rtrace
         from .resilience import BarrierTimeoutError
         from .resilience import retry as _retry
+
+        # an ambient request/step trace rides the wire as a ("traced",
+        # id, inner) envelope so the server's handling records under the
+        # same trace_id (distributed stitching, ISSUE 12). Barriers stay
+        # bare: their no-retry special case keys off msg identity.
+        ambient = _rtrace.current()
+        wire = msg
+        if (ambient is not None and ambient.trace_id is not None
+                and msg[0] != "barrier"):
+            wire = ("traced", ambient.trace_id, msg)
 
         def _exchange():
             _faults.inject("kvstore.rpc")
             with self._locks[shard]:
-                _send_msg(self._socks[shard], msg)
+                _send_msg(self._socks[shard], wire)
                 return _recv_msg(self._socks[shard])
 
         def _on_retry(err, attempt):

@@ -18,29 +18,52 @@ Three pillars, one import:
 * :mod:`.flight_recorder` — lock-guarded last-K ring of per-step health
   records; dumps one atomic triage file on anomaly, uncaught exception,
   or demand (render with tools/health_report.py).
+* :mod:`.request_trace` — request-scoped tracing: one
+  :class:`~.request_trace.RequestTrace` per served request threaded
+  submit→completion through the serving/generation engines, with exact
+  queue/batch/compute/fetch latency attribution, a bounded tail-exemplar
+  reservoir, and chrome-trace export (``tools/trace_report.py
+  --requests``).
+* :mod:`.stats_schema` — the ONE stats vocabulary both serving engines'
+  ``get_stats()`` snapshots conform to.
+* :mod:`.exposition` — opt-in stdlib HTTP plane
+  (``MXNET_OBS_HTTP_PORT``): ``/metrics`` (Prometheus text),
+  ``/statusz`` (live engine/provider JSON), ``/healthz``, ``/tracez``
+  (tail request-trace exemplars).
 
-See docs/observability.md for the metrics catalog and the "where did my
-step time go" workflow (profiler dump → tools/trace_report.py), and
-docs/health.md for the "why did my run go bad" workflow.
+See docs/observability.md for the metrics catalog, the "where did my
+step time go" workflow (profiler dump → tools/trace_report.py), the
+"where did my REQUEST's latency go" workflow (request tracing →
+``/tracez`` / ``trace_report --requests``), and docs/health.md for the
+"why did my run go bad" workflow.
 """
 from . import metrics
 from . import instruments
 from . import tracing
 from . import health
 from . import flight_recorder
+from . import request_trace
+from . import stats_schema
+from . import exposition
 from .metrics import (counter, gauge, histogram, dump_metrics,
                       reset_metrics, set_enabled, enabled)
 from .tracing import trace_span, device_scope
 from .instruments import sample_memory, record_step, retrace_causes
 from .health import TrainingHealthError
+from .request_trace import RequestTrace
 
 __all__ = ["metrics", "instruments", "tracing", "health", "flight_recorder",
+           "request_trace", "stats_schema", "exposition",
            "counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "set_enabled", "enabled", "trace_span", "device_scope",
            "sample_memory", "record_step", "retrace_causes",
-           "TrainingHealthError"]
+           "TrainingHealthError", "RequestTrace"]
 
 # honor an env-set MXNET_TELEMETRY at import: installs the jax.monitoring
 # hooks so compiles are counted from the first jit call
 if metrics.enabled():
     instruments.install_jax_hooks()
+
+# honor an env-set MXNET_OBS_HTTP_PORT at import: the exposition plane
+# comes up with the process, no code change in the serving script
+exposition.maybe_start_from_env()

@@ -40,7 +40,8 @@ import threading
 import time
 
 __all__ = ["record", "snapshot", "dump", "dump_on_anomaly", "install",
-           "configure", "register_provider", "last_dump_path", "reset"]
+           "configure", "register_provider", "provider_sections",
+           "last_dump_path", "reset"]
 
 _lock = threading.Lock()
 _ring = None            # deque of step records  # guarded-by: _lock
@@ -180,6 +181,11 @@ def _provider_sections():
         if val is not None:
             out[name] = val
     return out
+
+
+# public alias: the exposition plane's /statusz serves the same live
+# provider sections a crash dump embeds (exposition.py)
+provider_sections = _provider_sections
 
 
 def _json_safe(obj):

@@ -20,9 +20,18 @@ Design rules:
 * **Instruments are process-wide and named.** ``counter("dispatch.eager")``
   returns the same object from anywhere; names are dotted lowercase.
 * **Exposition is Prometheus text format.** :func:`dump_metrics` renders
-  every instrument in the standard ``# TYPE`` / sample-line format
-  (dots become underscores) so the output can be scraped, diffed, or
-  pasted into a bug report verbatim.
+  every instrument in the standard ``# HELP`` / ``# TYPE`` / sample-line
+  format (dots become underscores), with label values escaped per the
+  exposition spec, so the output can be scraped by a real Prometheus
+  server (the ``/metrics`` endpoint in exposition.py serves it under
+  :data:`PROM_CONTENT_TYPE`), diffed, or pasted into a bug report
+  verbatim. Round-tripped by a text-format parser in the tests.
+* **Labels are constant per instrument.** ``counter(name,
+  labels={"engine": "serving"})`` registers one child per label set —
+  the label values are part of the instrument's identity, rendered as
+  ``name{engine="serving"}``. Dynamic (per-observation) labels are
+  deliberately unsupported: a label-per-request would make cardinality a
+  traffic function, the classic exposition footgun.
 """
 from __future__ import annotations
 
@@ -30,7 +39,12 @@ import math
 import threading
 
 __all__ = ["counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
-           "enabled", "set_enabled", "get_value", "all_instruments"]
+           "enabled", "set_enabled", "get_value", "all_instruments",
+           "PROM_CONTENT_TYPE"]
+
+# the content type a compliant scrape endpoint must declare for this
+# text format (exposition.py's /metrics sends it)
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _lock = threading.Lock()
 _registry = {}  # name -> instrument  # guarded-by: _lock
@@ -72,10 +86,12 @@ class Counter:
     """Monotonically increasing count (dispatches, compiles, pushes)."""
 
     kind = "counter"
-    __slots__ = ("name", "_value")
+    __slots__ = ("name", "labels", "help", "_value")
 
-    def __init__(self, name):
+    def __init__(self, name, labels=(), help=None):
         self.name = name
+        self.labels = labels
+        self.help = help
         self._value = 0
 
     def inc(self, n=1):
@@ -92,8 +108,9 @@ class Counter:
     def _reset(self):
         self._value = 0
 
-    def _render(self, out, pname):
-        out.append("%s %s" % (pname, _fmt(self._value)))
+    def _render(self, out, pname, lbl):
+        out.append("%s%s %s" % (pname, _label_block(lbl),
+                                _fmt(self._value)))
 
 
 class Gauge:
@@ -101,10 +118,12 @@ class Gauge:
     high-watermark."""
 
     kind = "gauge"
-    __slots__ = ("name", "_value")
+    __slots__ = ("name", "labels", "help", "_value")
 
-    def __init__(self, name):
+    def __init__(self, name, labels=(), help=None):
         self.name = name
+        self.labels = labels
+        self.help = help
         self._value = 0
 
     def set(self, v):
@@ -123,8 +142,9 @@ class Gauge:
     def _reset(self):
         self._value = 0
 
-    def _render(self, out, pname):
-        out.append("%s %s" % (pname, _fmt(self._value)))
+    def _render(self, out, pname, lbl):
+        out.append("%s%s %s" % (pname, _label_block(lbl),
+                                _fmt(self._value)))
 
 
 # 1-2-5 decade ladder: wide enough for µs dispatch latencies and
@@ -137,11 +157,14 @@ class Histogram:
     """Distribution with Prometheus cumulative buckets + sum/count/min/max."""
 
     kind = "histogram"
-    __slots__ = ("name", "buckets", "_counts", "_sum", "_count",
-                 "_min", "_max", "_nonfinite")
+    __slots__ = ("name", "labels", "help", "buckets", "_counts", "_sum",
+                 "_count", "_min", "_max", "_nonfinite")
 
-    def __init__(self, name, buckets=_DEFAULT_BUCKETS):
+    def __init__(self, name, buckets=_DEFAULT_BUCKETS, labels=(),
+                 help=None):
         self.name = name
+        self.labels = labels
+        self.help = help
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
         self._sum = 0.0
@@ -217,17 +240,22 @@ class Histogram:
         self._max = -math.inf
         self._nonfinite = 0
 
-    def _render(self, out, pname):
+    def _render(self, out, pname, lbl):
+        pre = lbl + "," if lbl else ""
         cum = 0
         for b, c in zip(self.buckets, self._counts):
             cum += c
-            out.append('%s_bucket{le="%s"} %d' % (pname, _fmt(b), cum))
+            out.append('%s_bucket{%sle="%s"} %d' % (pname, pre, _fmt(b),
+                                                    cum))
         cum += self._counts[-1]
-        out.append('%s_bucket{le="+Inf"} %d' % (pname, cum))
-        out.append("%s_sum %s" % (pname, _fmt(self._sum)))
-        out.append("%s_count %d" % (pname, self._count))
+        out.append('%s_bucket{%sle="+Inf"} %d' % (pname, pre, cum))
+        out.append("%s_sum%s %s" % (pname, _label_block(lbl),
+                                    _fmt(self._sum)))
+        out.append("%s_count%s %d" % (pname, _label_block(lbl),
+                                      self._count))
         if self._nonfinite:
-            out.append("%s_nonfinite %d" % (pname, self._nonfinite))
+            out.append("%s_nonfinite%s %d" % (pname, _label_block(lbl),
+                                              self._nonfinite))
 
 
 class _Noop:
@@ -259,35 +287,91 @@ class _Noop:
 NOOP = _Noop()
 
 
-def _get(name, cls, **kwargs):
-    inst = _registry.get(name)
+def _canon_labels(labels):
+    """Canonical constant-label tuple: sorted ((key, str(value)), ...)."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _key(name, labels):
+    """Registry key: one instrument per (name, label set). Built from
+    the repr of the canonical tuple, NOT a joined string — a joined
+    'k=v,k2=v2' would let crafted values collide distinct label sets
+    onto one instrument (x='1,y=2' vs {x: '1', y: '2'})."""
+    canon = _canon_labels(labels)
+    if not canon:
+        return name
+    return "%s|%r" % (name, canon)
+
+
+def _valid_label_name(k):
+    # Prometheus label-name charset [a-zA-Z_][a-zA-Z0-9_]*: one illegal
+    # key (a dotted 'kv.dtype', a non-ASCII letter — str.isalpha alone
+    # would accept those) aborts the ENTIRE scrape at parse time
+    return bool(k) and k.isascii() and (k[0].isalpha() or k[0] == "_") \
+        and all(c.isalnum() or c == "_" for c in k)
+
+
+def _get(name, cls, labels=None, help=None, **kwargs):
+    key = _key(name, labels)
+    inst = _registry.get(key)
     if inst is None:
         with _lock:
-            inst = _registry.get(name)
+            inst = _registry.get(key)
             if inst is None:
-                inst = cls(name, **kwargs)
-                _registry[name] = inst
+                canon = _canon_labels(labels)
+                # creation-time validation (never on the hot accessor
+                # path): label names must be legal...
+                for k, _v in canon:
+                    if not _valid_label_name(k):
+                        raise ValueError(
+                            "metric %r: illegal label name %r (must "
+                            "match [a-zA-Z_][a-zA-Z0-9_]*)" % (name, k))
+                inst = cls(name, labels=canon, help=help, **kwargs)
+                # ...one kind per metric FAMILY (mixed kinds would emit
+                # contradictory # TYPE lines), and histogram children
+                # of one family must share a bucket ladder (mismatched
+                # le sets silently break sum-by-le aggregation)
+                for other in _registry.values():
+                    if other.name != name:
+                        continue
+                    if other.kind != cls.kind:
+                        raise TypeError(
+                            "metric %r is a %s, not a %s"
+                            % (name, other.kind, cls.kind))
+                    if (isinstance(other, Histogram)
+                            and other.buckets != inst.buckets):
+                        raise ValueError(
+                            "histogram %r already exists with different "
+                            "buckets (label children of one family must "
+                            "share a ladder)" % (name,))
+                _registry[key] = inst
     elif not isinstance(inst, cls):
         raise TypeError("metric %r is a %s, not a %s"
                         % (name, inst.kind, cls.kind))
+    if help and not inst.help:
+        inst.help = help
     return inst
 
 
-def counter(name):
-    """Fetch-or-create the named counter (NOOP while telemetry is off)."""
+def counter(name, labels=None, help=None):
+    """Fetch-or-create the named counter (NOOP while telemetry is off).
+    ``labels``: constant labels identifying this child (one instrument
+    per label set); ``help``: one-line # HELP text for the family."""
     if not enabled():
         return NOOP
-    return _get(name, Counter)
+    return _get(name, Counter, labels=labels, help=help)
 
 
-def gauge(name):
+def gauge(name, labels=None, help=None):
     """Fetch-or-create the named gauge (NOOP while telemetry is off)."""
     if not enabled():
         return NOOP
-    return _get(name, Gauge)
+    return _get(name, Gauge, labels=labels, help=help)
 
 
-def histogram(name, buckets=None):
+def histogram(name, buckets=None, labels=None, help=None):
     """Fetch-or-create the named histogram (NOOP while telemetry is off).
 
     Explicitly requested buckets must match an existing instrument's —
@@ -296,18 +380,18 @@ def histogram(name, buckets=None):
     if not enabled():
         return NOOP
     if buckets is None:
-        return _get(name, Histogram)
-    inst = _get(name, Histogram, buckets=buckets)
+        return _get(name, Histogram, labels=labels, help=help)
+    inst = _get(name, Histogram, buckets=buckets, labels=labels, help=help)
     if inst.buckets != tuple(sorted(buckets)):
         raise ValueError(
             "histogram %r already exists with different buckets" % (name,))
     return inst
 
 
-def get_value(name, default=None):
+def get_value(name, default=None, labels=None):
     """Read a metric's scalar (counter/gauge value, histogram count)
     without creating it."""
-    inst = _registry.get(name)
+    inst = _registry.get(_key(name, labels))
     if inst is None:
         return default
     return inst.count if isinstance(inst, Histogram) else inst.value
@@ -346,8 +430,34 @@ def _prom_name(name):
     return "mxnet_" + safe
 
 
+def _escape_label_value(v):
+    """Label-value escaping per the text exposition format: backslash,
+    double quote, and newline must be escaped inside the quotes."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v):
+    """# HELP text escaping: backslash and newline (quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_body(labels):
+    """Rendered (escaped) label pairs without braces: 'k="v",k2="v2"'."""
+    return ",".join('%s="%s"' % (k, _escape_label_value(v))
+                    for k, v in labels)
+
+
+def _label_block(lbl):
+    """A pre-rendered label body wrapped in braces ('' when empty)."""
+    return "{%s}" % lbl if lbl else ""
+
+
 def dump_metrics(extras=True):
-    """Prometheus text exposition of every registered instrument.
+    """Prometheus text exposition of every registered instrument:
+    ``# HELP`` (when provided) and ``# TYPE`` once per metric family,
+    then one sample line per child, label values escaped. Serve it with
+    content type :data:`PROM_CONTENT_TYPE`.
 
     ``extras``: append the retrace-cause tail (instruments.py) as
     comments — human context that has no sample-line encoding.
@@ -355,12 +465,23 @@ def dump_metrics(extras=True):
     out = []
     with _lock:
         # under the same lock as the mutators so a histogram never
-        # renders a sum that includes an observation its count misses
-        for name in sorted(_registry):
-            inst = _registry[name]
-            pname = _prom_name(name)
-            out.append("# TYPE %s %s" % (pname, inst.kind))
-            inst._render(out, pname)
+        # renders a sum that includes an observation its count misses;
+        # sorted by (family, labels) so every family's children are
+        # contiguous under ONE # HELP/# TYPE header
+        insts = sorted(_registry.values(),
+                       key=lambda i: (i.name, i.labels))
+        prev_family = None
+        for inst in insts:
+            pname = _prom_name(inst.name)
+            if inst.name != prev_family:
+                prev_family = inst.name
+                help_text = next((i.help for i in insts
+                                  if i.name == inst.name and i.help), None)
+                if help_text:
+                    out.append("# HELP %s %s" % (pname,
+                                                 _escape_help(help_text)))
+                out.append("# TYPE %s %s" % (pname, inst.kind))
+            inst._render(out, pname, _label_body(inst.labels))
     if extras:
         from . import instruments
 

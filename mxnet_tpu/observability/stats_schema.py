@@ -1,0 +1,104 @@
+"""ONE stats schema for the serving engines' operational snapshots.
+
+``InferenceServer.get_stats()`` and ``Generator.get_stats()`` grew
+key-by-key across PRs 5–11 and drifted: the server said ``queue_rows``
+where the generator said ``queued``, "how many requests finished" was
+``completed`` on one and derivable-from-``evicted`` on the other, and
+nothing named the circuit-breaker state at all. Every consumer — the
+flight-recorder providers, the ``/statusz`` endpoint (exposition.py),
+dashboards — had to special-case both shapes.
+
+This module is the fix: :func:`engine_stats` builds the snapshot both
+engines return, guaranteeing one shared core vocabulary
+(:data:`CORE_KEYS`) on top of which each engine layers its
+engine-specific (and legacy, test-relied-upon) keys:
+
+* ``engine`` — ``"serving"`` | ``"generation"``; ``schema`` — version.
+* ``queue_depth`` — admitted-but-undispatched work (rows / requests).
+* ``requests`` / ``completed`` / ``rejected`` — request accounting.
+* ``capacity`` — occupancy dict (buckets/replicas/inflight for serving;
+  slots/KV pages/bytes for generation).
+* ``config`` — the knobs this engine resolved (deadlines, buckets,
+  dtypes) so a scraped snapshot is self-describing.
+* ``resilience`` — breaker/fault state (quarantined replicas with
+  probe countdowns, decode faults, retries, drain timeouts).
+* ``running`` / ``stopped`` — lifecycle.
+
+:func:`validate` asserts the contract (tests + /statusz);
+:func:`summarize` compacts one snapshot into the /statusz engine row.
+"""
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+# every engine snapshot must carry these, with these types
+CORE_KEYS = {
+    "engine": str,
+    "schema": int,
+    "queue_depth": int,
+    "requests": int,
+    "completed": int,
+    "rejected": int,
+    "capacity": dict,
+    "config": dict,
+    "resilience": dict,
+    "running": bool,
+    "stopped": bool,
+}
+
+
+def engine_stats(engine, counters, *, queue_depth, completed, running,
+                 stopped, capacity, config, resilience, provenance=None,
+                 extra=None):
+    """Assemble one schema-conforming snapshot.
+
+    ``counters`` (the engine's raw counter dict) and ``extra`` (legacy
+    flat keys) merge in first, so the shared vocabulary always wins a
+    key collision — the drift this helper exists to prevent.
+    """
+    stats = dict(counters)
+    if extra:
+        stats.update(extra)
+    stats.update(
+        engine=str(engine),
+        schema=SCHEMA_VERSION,
+        queue_depth=int(queue_depth),
+        requests=int(counters.get("requests", 0)),
+        completed=int(completed),
+        rejected=int(counters.get("rejected", 0)),
+        capacity=dict(capacity),
+        config=dict(config),
+        resilience=dict(resilience),
+        running=bool(running),
+        stopped=bool(stopped))
+    if provenance is not None:
+        stats["graph_pass"] = provenance
+    return stats
+
+
+def validate(stats):
+    """Assert ``stats`` honors the shared schema; returns it (tests,
+    /statusz ingestion)."""
+    if not isinstance(stats, dict):
+        raise TypeError("engine stats must be a dict, got %r"
+                        % type(stats).__name__)
+    for key, typ in CORE_KEYS.items():
+        if key not in stats:
+            raise ValueError("engine stats missing core key %r (have %s)"
+                             % (key, sorted(stats)))
+        if not isinstance(stats[key], typ):
+            raise TypeError("engine stats key %r must be %s, got %r"
+                            % (key, typ.__name__, type(stats[key]).__name__))
+    if stats["schema"] != SCHEMA_VERSION:
+        raise ValueError("engine stats schema %r != %d"
+                         % (stats["schema"], SCHEMA_VERSION))
+    return stats
+
+
+def summarize(stats):
+    """The compact /statusz engine row: shared core + the capacity and
+    resilience dicts (already small), none of the legacy flat keys."""
+    validate(stats)
+    return {k: stats[k] for k in ("engine", "queue_depth", "requests",
+                                  "completed", "rejected", "running",
+                                  "stopped", "capacity", "resilience")}

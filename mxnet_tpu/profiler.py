@@ -26,16 +26,27 @@ so unmodified scripts can be traced. All state transitions take the
 module lock, and ``dump_profile()`` writes via temp-file + atomic rename
 so a concurrent reader (a dashboard tailing the file, the CI artifact
 scraper) never observes truncated JSON.
+
+The event buffer is a bounded ring (``MXNET_PROFILER_RING`` events,
+default 200k): a week-long serving process with a session left running
+(or the always-on span tail the flight recorder embeds) can never grow
+host memory without bound. When the ring is full the OLDEST event is
+dropped and counted — :func:`dropped_events`, the
+``profiler.events_dropped`` metric, and a ``droppedEventsCount`` field
+in the dump all expose the loss, so a truncated trace is visible, never
+silent.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "pause", "resume", "events_tail"]
+           "pause", "resume", "events_tail", "record_raw",
+           "dropped_events", "configure_ring"]
 
 _VALID_MODES = ("symbolic", "imperative", "all")
 
@@ -47,7 +58,9 @@ def _env_mode():
 
 _state = {"mode": _env_mode(), "filename": "profile.json", "running": False,
           "paused": False}  # guarded-by: _lock
-_events = []  # guarded-by: _lock
+_events = collections.deque()  # bounded ring, manual cap  # guarded-by: _lock
+_ring_cap = None  # resolved lazily from MXNET_PROFILER_RING  # guarded-by: _lock
+_dropped = 0  # events evicted from the full ring  # guarded-by: _lock
 _lock = threading.Lock()
 _trace_lock = threading.Lock()  # serializes jax device-trace start/stop
 _t0 = time.perf_counter()
@@ -73,21 +86,102 @@ def spans_active():
     return _state["running"] and not _state["paused"]
 
 
-def record(name, cat, ts_us, dur_us):
-    """Append one complete ('ph':'X') event."""
+def _cap_locked():
+    # caller holds _lock — the _locked suffix contract
+    global _ring_cap
+    if _ring_cap is None:
+        from .config import get_flag
+
+        _ring_cap = max(1024, get_flag("MXNET_PROFILER_RING"))  # graftlint: disable=G004 — under _lock via every caller (_append/configure_ring)
+    return _ring_cap
+
+
+def configure_ring(capacity=None):
+    """Runtime override of the event-ring capacity (tests; None restores
+    the MXNET_PROFILER_RING flag resolution). Excess oldest events are
+    evicted (and counted) immediately."""
+    global _ring_cap
+    evicted = 0
+    with _lock:
+        _ring_cap = None if capacity is None else max(1, int(capacity))
+        cap = _cap_locked()
+        while len(_events) > cap:
+            _events.popleft()
+            evicted += 1
+        _count_dropped_locked(evicted)
+    _note_dropped_metric(evicted)
+
+
+def _count_dropped_locked(n):
+    # caller holds _lock — the _locked suffix contract
+    global _dropped
+    _dropped += n  # graftlint: disable=G004 — under _lock via every caller (_append/configure_ring)
+
+
+def _note_dropped_metric(n):
+    if not n:
+        return
+    try:
+        from .observability import metrics as _metrics
+
+        _metrics.counter(
+            "profiler.events_dropped",
+            help="profiler ring evictions (trace tail truncated)").inc(n)
+    except Exception:  # the ring must keep working during teardown
+        pass
+
+
+def dropped_events():
+    """How many events the bounded ring has evicted since the last
+    ``dump_profile`` (0 = the current buffer/trace is complete; the
+    ``profiler.events_dropped`` metric keeps the cumulative count)."""
+    with _lock:
+        return _dropped
+
+
+def _append(ev):
+    with _lock:
+        dropped = len(_events) >= _cap_locked()
+        if dropped:
+            _events.popleft()
+            _count_dropped_locked(1)
+        _events.append(ev)
+    if dropped:
+        _note_dropped_metric(1)
+
+
+def record(name, cat, ts_us, dur_us, args=None, tid=None):
+    """Append one complete ('ph':'X') event. ``args`` rides into the
+    chrome JSON verbatim (request tracing stores trace ids there);
+    ``tid`` overrides the recording thread's id (a trace emitted at
+    completion replays spans onto the threads where they happened)."""
     ev = {"name": name, "cat": cat, "ph": "X",
           "ts": ts_us, "dur": dur_us,
           "pid": os.getpid(),
-          "tid": threading.get_ident() % (1 << 20)}
-    with _lock:
-        _events.append(ev)
+          "tid": (threading.get_ident() % (1 << 20)
+                  if tid is None else int(tid))}
+    if args:
+        ev["args"] = dict(args)
+    _append(ev)
+
+
+def record_raw(ev):
+    """Append one pre-built chrome-trace event dict (flow events,
+    instant events — phases the 'X' shape cannot express)."""
+    _append(dict(ev))
 
 
 def events_tail(n=256):
     """Copy of the most recent ``n`` recorded events (the flight
-    recorder embeds this tail in its crash dump)."""
+    recorder embeds this tail in its crash dump). Collected from the
+    ring's right end — O(n), never an O(ring-capacity) copy under the
+    lock recording threads contend on."""
+    import itertools
+
     with _lock:
-        return list(_events[-int(n):])
+        tail = list(itertools.islice(reversed(_events), max(0, int(n))))
+    tail.reverse()
+    return tail
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -172,11 +266,21 @@ def dump_profile():
     src/engine/profiler.h:107). The write is atomic (temp file +
     rename): a concurrent reader sees either the previous dump or the
     complete new one, never a truncated file."""
+    global _dropped
     profiler_set_state("stop")
     with _lock:
-        events, _events[:] = list(_events), []
+        events = list(_events)
+        _events.clear()
         filename = _state["filename"]
+        # the dump consumes the loss: dropped counts what THIS artifact
+        # is missing, and a later session's complete dump must not
+        # inherit it (the events_dropped metric stays cumulative)
+        dropped, _dropped = _dropped, 0
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if dropped:
+        # non-standard but chrome-ignored: makes ring truncation visible
+        # in the artifact itself, not just the live process
+        payload["droppedEventsCount"] = dropped
     tmp = "%s.tmp.%d.%d" % (filename, os.getpid(), threading.get_ident())
     with open(tmp, "w") as f:
         # json.dumps hits the C encoder; json.dump streams through the

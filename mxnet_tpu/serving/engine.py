@@ -45,6 +45,8 @@ import numpy as np
 
 from ..base import MXNetError
 from ..executor import _GraphProgram
+from ..observability import request_trace as _rtrace
+from ..observability import stats_schema as _schema
 from ..resilience import DeadlineExceeded
 from ..resilience import faults as _faults
 from ..runtime.staging import PipelineWindow, stage_pytree
@@ -132,14 +134,19 @@ class _Assembly:
     reassemble here. ``n_parts == 1`` is the common, unchunked case.
     """
 
-    __slots__ = ("future", "parts", "remaining", "squeeze", "lock")
+    __slots__ = ("future", "parts", "remaining", "squeeze", "lock",
+                 "trace")
 
-    def __init__(self, future, n_parts, squeeze):
+    def __init__(self, future, n_parts, squeeze, trace=_rtrace.NOOP_TRACE):
         self.future = future
         self.parts = [None] * n_parts
         self.remaining = n_parts
         self.squeeze = squeeze
         self.lock = threading.Lock()
+        # ONE RequestTrace per submitted request: chunked requests'
+        # parts append events to the shared trace, so the timeline
+        # still partitions [submit, complete] exactly
+        self.trace = trace
 
     def deliver(self, idx, pieces):
         """``pieces``: one host array of this part's rows per output.
@@ -160,15 +167,18 @@ class _Assembly:
         except Exception:
             # the caller cancelled (or a racing fail() landed first) —
             # the dispatcher must never die over one dead future
+            self.trace.finish("cancelled")
             return False
+        self.trace.finish("ok")
         return True
 
-    def fail(self, err):
+    def fail(self, err, status="error"):
         try:
             if not self.future.done():
                 self.future.set_exception(err)
         except Exception:
             pass  # cancelled between the check and the set: same outcome
+        self.trace.finish(status)
 
 
 class _Request:
@@ -581,7 +591,12 @@ class InferenceServer:
         future = concurrent.futures.Future()
         max_bucket = self._cfg.buckets[-1]
         n_parts = -(-n_rows // max_bucket)
-        assembly = _Assembly(future, n_parts, squeeze)
+        # request-scoped trace (ISSUE 12): submit is the birth event;
+        # the dispatcher marks queue/batch/compute/fetch ends as the
+        # request crosses each boundary
+        trace = _rtrace.begin("serving")
+        trace.annotate(rows=n_rows, parts=n_parts)
+        assembly = _Assembly(future, n_parts, squeeze, trace)
         t0 = time.monotonic()
         deadline = (t0 + self._cfg.deadline_ms / 1e3
                     if self._cfg.deadline_ms > 0 else None)
@@ -593,12 +608,14 @@ class InferenceServer:
         bound = self._cfg.max_queue_rows
         with self._cond:
             if self._stop:
+                trace.finish("rejected")
                 raise ServerClosedError("submit() after stop()")
             if self._cfg.backpressure == "reject":
                 if self._queued_rows + n_rows > bound:
                     with self._lock:
                         self._stats["rejected"] += 1
                     metrics.counter("serving.rejected").inc()
+                    trace.finish("rejected")
                     if n_rows > bound:
                         raise QueueFullError(
                             "%d-row request can never fit the %d-row "
@@ -745,6 +762,8 @@ class InferenceServer:
         while self._queue and rows + self._queue[0].n <= max_bucket:
             r = self._queue.popleft()
             self._queued_rows -= r.n  # graftlint: disable=G004 — under self._cond via _collect
+            # queue phase ends here for this part, expired or not
+            r.assembly.trace.event("queue")
             if r.deadline is not None and now >= r.deadline:
                 # expired while queued: rejected BEFORE dispatch — a
                 # backlogged server sheds stale work instead of burning
@@ -752,7 +771,8 @@ class InferenceServer:
                 r.assembly.fail(DeadlineExceeded(
                     "request expired in queue after %.0f ms (deadline "
                     "%.0f ms)" % ((now - r.t_submit) * 1e3,
-                                  self._cfg.deadline_ms)))
+                                  self._cfg.deadline_ms)),
+                    status="deadline_expired")
                 with self._lock:
                     self._stats["expired"] += 1
                 from ..observability import metrics
@@ -809,6 +829,12 @@ class InferenceServer:
             self._inflight.push(
                 _InFlight(outs, reqs, bucket, rows, rep, batch,
                           attempt > 0))
+            for r in reqs:
+                # batch-formation phase ends at dispatch: padding,
+                # concatenation, staging and the async program launch
+                # all land between "queue" and here
+                r.assembly.trace.event("batch")
+                r.assembly.trace.annotate(bucket=bucket, replica=rep)
             with self._lock:
                 if attempt > 0:
                     self._stats["batch_retries"] += 1
@@ -941,6 +967,10 @@ class InferenceServer:
             # staging_wait_s — input- vs compute-bound attribution)
             nonlocal ent
             ent = entry
+            for r in entry.reqs:
+                # compute phase (dispatch -> first host-fetch touch)
+                # ends as the blocking fetch begins
+                r.assembly.trace.event("compute")
             return [np.asarray(o) for o in entry.outs]  # graftlint: disable=G001
 
         try:
@@ -961,6 +991,9 @@ class InferenceServer:
         offset = 0
         finished = 0
         for r in ent.reqs:
+            # fetch phase ends at delivery; deliver() finishes the
+            # trace ("ok") when this was the request's last part
+            r.assembly.trace.event("fetch")
             done = r.assembly.deliver(
                 r.part, [o[offset:offset + r.n] for o in host])
             offset += r.n
@@ -972,29 +1005,80 @@ class InferenceServer:
             self._stats["completed"] += finished
 
     # -------------------------------------------------------------- stats
+    def breaker_states(self):
+        """Circuit-breaker view: overall state (``closed`` — all
+        replicas serving; ``degraded`` — some quarantined; ``open`` —
+        every replica quarantined, requests fail fast) plus per-
+        quarantined-replica probe countdowns. Surfaced by get_stats's
+        ``resilience`` section and the exposition plane's /statusz."""
+        now = time.monotonic()
+        with self._lock:
+            quarantined = dict(self._quarantined)
+        n = len(self._devices)
+        if not quarantined:
+            state = "closed"
+        elif len(quarantined) >= n:
+            state = "open"
+        else:
+            state = "degraded"
+        return {
+            "state": state,
+            "replicas": n,
+            "quarantined": {
+                str(rep): {"probe_in_ms":
+                           round(max(0.0, (until - now) * 1e3), 1)}
+                for rep, until in sorted(quarantined.items())},
+            "cooldown_ms": self._cfg.cooldown_ms,
+        }
+
     def get_stats(self):
-        """JSON-safe operational snapshot (also the flight-recorder
-        provider section for crash dumps)."""
+        """Operational snapshot conforming to the shared engine-stats
+        schema (observability/stats_schema.py) — consumed by the
+        flight-recorder "serving" provider and /statusz. Legacy flat
+        keys (queue_rows, inflight, buckets, ...) are preserved on top
+        of the shared core."""
         with self._cond:
             depth = self._queued_rows
             stopped = self._stop
         with self._lock:
-            stats = dict(self._stats)
+            counters = dict(self._stats)
             quarantined = sorted(self._quarantined)
-        stats.update(
-            queue_rows=depth,
-            inflight=len(self._inflight),
-            staged_batches=self._inflight.pushed,
-            staging_wait_s=round(self._inflight.wait_s, 6),
-            buckets=list(self._cfg.buckets),
-            replicas=len(self._devices),
-            quarantined_replicas=quarantined,
-            deadline_ms=self._cfg.deadline_ms,
-            max_wait_ms=self._cfg.max_wait_ms,
-            running=self.running,
-            stopped=stopped)
-        if self._opt is not None:
+        return _schema.engine_stats(
+            "serving", counters,
+            queue_depth=depth,
+            completed=counters.get("completed", 0),
+            running=self.running, stopped=stopped,
+            capacity={
+                "buckets": list(self._cfg.buckets),
+                "replicas": len(self._devices),
+                "inflight": len(self._inflight),
+                "pipeline_depth": self._cfg.pipeline_depth,
+                "queue_limit_rows": self._cfg.max_queue_rows,
+            },
+            config={
+                "max_wait_ms": self._cfg.max_wait_ms,
+                "deadline_ms": self._cfg.deadline_ms,
+                "backpressure": self._cfg.backpressure,
+                "cooldown_ms": self._cfg.cooldown_ms,
+            },
+            resilience={
+                "breaker": self.breaker_states(),
+                "quarantines": counters.get("quarantines", 0),
+                "batch_retries": counters.get("batch_retries", 0),
+                "drain_timeouts": counters.get("drain_timeouts", 0),
+            },
             # which rewrites this server's programs compiled under —
             # rides into flight-recorder dumps via the serving provider
-            stats["graph_pass"] = self._opt.summary()
-        return stats
+            provenance=(self._opt.summary() if self._opt is not None
+                        else None),
+            extra={
+                "queue_rows": depth,
+                "inflight": len(self._inflight),
+                "staged_batches": self._inflight.pushed,
+                "staging_wait_s": round(self._inflight.wait_s, 6),
+                "buckets": list(self._cfg.buckets),
+                "replicas": len(self._devices),
+                "quarantined_replicas": quarantined,
+                "deadline_ms": self._cfg.deadline_ms,
+                "max_wait_ms": self._cfg.max_wait_ms,
+            })

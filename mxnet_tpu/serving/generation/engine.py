@@ -42,6 +42,8 @@ import weakref
 import numpy as np
 
 from ...config import get_flag
+from ...observability import request_trace as _rtrace
+from ...observability import stats_schema as _schema
 from ...resilience import faults as _faults
 from ..buckets import pick_bucket
 from ..engine import QueueFullError, ServerClosedError
@@ -245,9 +247,10 @@ class _Seq:
     """Scheduler-side state of one admitted sequence (slot-resident)."""
 
     __slots__ = ("handle", "prompt_len", "params", "tokens", "worst",
-                 "t_submit", "t_first")
+                 "t_submit", "t_first", "t_last", "trace")
 
-    def __init__(self, handle, prompt_len, params, worst, t_submit):
+    def __init__(self, handle, prompt_len, params, worst, t_submit,
+                 trace=_rtrace.NOOP_TRACE):
         self.handle = handle
         self.prompt_len = prompt_len
         self.params = params          # SamplingParams
@@ -255,10 +258,12 @@ class _Seq:
         self.tokens = []              # generated so far
         self.t_submit = t_submit
         self.t_first = None
+        self.t_last = None            # last token instant (ITL)
+        self.trace = trace            # RequestTrace (submit -> evict)
 
 
 _Pending = collections.namedtuple(
-    "_Pending", ["prompt", "params", "handle", "t_submit"])
+    "_Pending", ["prompt", "params", "handle", "t_submit", "trace"])
 
 # every live generator, GC-pruned — ONE "generation" flight-recorder
 # provider walks them (same discipline as serving._live_servers)
@@ -690,9 +695,11 @@ class Generator:
             self._cond.notify_all()
         for ent in stranded:
             ent.handle._fail(err)
+            ent.trace.finish("error")
         for seq in list(self._slots):
             if seq is not None:
                 seq.handle._fail(err)
+                seq.trace.finish("error")
         with self._lock:
             self._stats["drain_timeouts"] += 1
 
@@ -735,15 +742,23 @@ class Generator:
                 "(raise MXNET_GEN_POOL_PAGES)"
                 % (self.pool.pages_for(worst), self.pool.capacity))
         handle = GenerationHandle()
-        ent = _Pending(prompt, params, handle, time.monotonic())
+        # request-scoped trace (ISSUE 12): queue ends at admission,
+        # prefill ends at the first token (TTFT), one decode phase per
+        # generated token, finish at eviction/stream end
+        trace = _rtrace.begin("generation")
+        trace.annotate(prompt_len=len(prompt),
+                       max_new_tokens=params.max_new_tokens)
+        ent = _Pending(prompt, params, handle, time.monotonic(), trace)
         with self._cond:
             if self._stop:
+                trace.finish("rejected")
                 raise ServerClosedError("submit() after stop()")
             if self._cfg.backpressure == "reject":
                 if len(self._queue) >= self._cfg.max_queue:
                     with self._lock:
                         self._stats["rejected"] += 1
                     metrics.counter("generation.rejected").inc()
+                    trace.finish("rejected")
                     raise QueueFullError(
                         "admission queue full (%d requests); raise "
                         "MXNET_GEN_QUEUE or use backpressure='block'"
@@ -758,6 +773,7 @@ class Generator:
                         with self._lock:
                             self._stats["submit_timeouts"] += 1
                         metrics.counter("generation.submit_timeouts").inc()
+                        trace.finish("rejected")
                         raise QueueFullError(
                             "admission queue still full after %.0f ms "
                             "(MXNET_GEN_SUBMIT_TIMEOUT); %d requests "
@@ -765,6 +781,7 @@ class Generator:
                                         len(self._queue)))
                     self._cond.wait(remaining)
                     if self._stop:
+                        trace.finish("rejected")
                         raise ServerClosedError(
                             "server stopped while submit() was blocked")
             self._queue.append(ent)
@@ -818,6 +835,7 @@ class Generator:
         err = ServerClosedError("generator stopped without draining")
         for ent in pending:
             ent.handle._fail(err)
+            ent.trace.finish("error")
         for slot, seq in enumerate(self._slots):
             if seq is not None:
                 self._evict(slot, failed=err)
@@ -853,6 +871,7 @@ class Generator:
                     self._n_active -= 1
                     self._cond.notify_all()
                 ent.handle._fail(err)
+                ent.trace.finish("error")
                 # under donation the failed call may have consumed the
                 # pool buffers other sequences' caches live in
                 self._recover_pools(err)
@@ -864,6 +883,7 @@ class Generator:
 
         plen = len(ent.prompt)
         sp = ent.params
+        ent.trace.event("queue")  # admission = end of queue wait
         bucket = pick_bucket(plen, self._cfg.prefill_buckets)
         pages = self.pool.admit(slot, plen, worst)
         row = np.zeros(self._max_pages, np.int32)
@@ -880,8 +900,17 @@ class Generator:
         # the ONE host sync of admission: the prompt's first token (this
         # is also the time-to-first-token mark)
         first = int(np.asarray(tok))  # graftlint: disable=G001 — admission-boundary fetch, not a hot-loop sync
-        seq = _Seq(ent.handle, plen, sp, worst, ent.t_submit)
+        seq = _Seq(ent.handle, plen, sp, worst, ent.t_submit, ent.trace)
         seq.t_first = time.monotonic()
+        seq.t_last = seq.t_first
+        # prefill ends at the first sampled token — this instant IS the
+        # time-to-first-token mark
+        ent.trace.event("prefill")
+        ent.trace.annotate(prefill_bucket=bucket, slot=slot)
+        metrics.histogram(
+            "generation.ttft_ms",
+            help="time to first token (submit -> first sampled token)"
+        ).observe((seq.t_first - ent.t_submit) * 1e3)
         self._slots[slot] = seq
         self._page_table[slot, :] = row
         self._seq_len[slot] = plen
@@ -926,10 +955,14 @@ class Generator:
             self._cond.notify_all()
         if failed is not None:
             seq.handle._fail(failed)
+            seq.trace.finish("error")
         else:
             seq.handle._finish(seq.tokens)
+            seq.trace.finish("ok")
         with self._lock:
             self._stats["evicted"] += 1
+            if failed is None:
+                self._stats["completed"] += 1
         metrics.counter("generation.sequences_evicted").inc()
 
     def _decode_once(self):
@@ -958,12 +991,23 @@ class Generator:
         # else above is dispatch): S int32 tokens + S keys
         sampled = np.asarray(toks)  # graftlint: disable=G001 — per-step token fetch IS the product of the decode loop
         self._keys = np.array(nkeys, np.uint32)  # copy: jax views are read-only
+        t_tok = time.monotonic()
+        itl_hist = metrics.histogram(
+            "generation.itl_ms",
+            help="inter-token latency (consecutive sampled tokens of "
+                 "one request)")
         for slot, seq in enumerate(self._slots):
             if seq is None:
                 continue
             self._seq_len[slot] += 1
             tok = int(sampled[slot])
             self._last_token[slot] = tok
+            # one decode phase per generated token: the trace's decode
+            # spans ARE the request's inter-token latencies
+            seq.trace.event("decode")
+            if seq.t_last is not None:
+                itl_hist.observe((t_tok - seq.t_last) * 1e3)
+            seq.t_last = t_tok
             self._emit(slot, tok)
         with self._lock:
             self._stats["decode_steps"] += 1
@@ -976,25 +1020,56 @@ class Generator:
 
     # --------------------------------------------------------------- stats
     def get_stats(self):
-        """JSON-safe operational snapshot (also the flight-recorder
-        provider section for crash dumps)."""
+        """Operational snapshot conforming to the shared engine-stats
+        schema (observability/stats_schema.py) — consumed by the
+        flight-recorder "generation" provider and /statusz. Legacy flat
+        keys (queued, active, pool, ...) are preserved on top of the
+        shared core."""
         with self._cond:
             queued = len(self._queue)
             n_active = self._n_active
             stopped = self._stop
         with self._lock:
-            stats = dict(self._stats)
-        stats.update(
-            queued=queued, active=n_active,
-            max_batch=self._cfg.max_batch, max_seq=self._cfg.max_seq,
-            page_size=self.page_size, decode_blocks=self.decode_blocks,
-            kv_dtype=self.kv_dtype,
-            prefill_buckets=list(self._cfg.prefill_buckets),
-            pool=self.pool.get_stats(),
-            graph_pass={"amp": bool(self._amp),
+            counters = dict(self._stats)
+        pool = self.pool.get_stats()
+        return _schema.engine_stats(
+            "generation", counters,
+            queue_depth=queued,
+            completed=counters.get("completed", 0),
+            running=self.running, stopped=stopped,
+            capacity={
+                "max_batch": self._cfg.max_batch,
+                "active_slots": n_active,
+                "kv_pages_used": pool["used"],
+                "kv_pages_capacity": pool["capacity"],
+                "kv_bytes_used": pool["kv_bytes_used"],
+                "kv_bytes_capacity": pool["kv_bytes_capacity"],
+                "queue_limit_requests": self._cfg.max_queue,
+            },
+            config={
+                "max_seq": self._cfg.max_seq,
+                "page_size": self.page_size,
+                "decode_blocks": self.decode_blocks,
+                "kv_dtype": self.kv_dtype,
+                "prefill_buckets": list(self._cfg.prefill_buckets),
+                "backpressure": self._cfg.backpressure,
+            },
+            resilience={
+                "decode_faults": counters.get("decode_faults", 0),
+                "drain_timeouts": counters.get("drain_timeouts", 0),
+            },
+            provenance={"amp": bool(self._amp),
                         "kv_dtype": self.kv_dtype},
-            running=self.running, stopped=stopped)
-        return stats
+            extra={
+                "queued": queued, "active": n_active,
+                "max_batch": self._cfg.max_batch,
+                "max_seq": self._cfg.max_seq,
+                "page_size": self.page_size,
+                "decode_blocks": self.decode_blocks,
+                "kv_dtype": self.kv_dtype,
+                "prefill_buckets": list(self._cfg.prefill_buckets),
+                "pool": pool,
+            })
 
     def kv_read_bytes_per_token(self, ctx_len):
         """HBM bytes ONE decode step reads from the KV pool for one slot

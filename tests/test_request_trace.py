@@ -1,0 +1,648 @@
+"""Request-scoped tracing + live exposition plane (ISSUE 12 acceptance
+tests): exact per-phase latency attribution through the serving and
+generation engines, the tail-exemplar reservoir, Prometheus exposition
+compliance, the bounded profiler ring, the shared stats schema, the
+HTTP plane, trace_report --requests, and kvstore RPC trace stitching."""
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.config import set_flag
+from mxnet_tpu.observability import exposition
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.observability import request_trace as RT
+from mxnet_tpu.observability import stats_schema
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import trace_report  # noqa: E402
+
+
+@pytest.fixture
+def telemetry():
+    mx.observability.set_enabled(True)
+    mx.observability.reset_metrics()
+    yield
+    mx.observability.reset_metrics()
+    mx.observability.set_enabled(False)
+
+
+@pytest.fixture
+def fresh_reservoir():
+    RT.reset()
+    yield RT.reservoir()
+    RT.reset()
+
+
+@pytest.fixture
+def profiler_session(tmp_path):
+    path = str(tmp_path / "profile.json")
+    profiler.set_config(mode="symbolic", filename=path)
+    yield path
+    profiler.set_state("stop")
+    profiler.set_config(mode="symbolic", filename="profile.json")
+
+
+def _mlp_server(start=True, **cfg_kwargs):
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc"),
+        name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": mx.nd.array(rng.randn(8, 4).astype(np.float32)),
+            "fc_bias": mx.nd.array(rng.randn(8).astype(np.float32))}
+    cfg_kwargs.setdefault("buckets", (1, 2, 4))
+    cfg_kwargs.setdefault("max_wait_ms", 0)
+    return InferenceServer(net, args, data_shapes=[("data", (1, 4))],
+                           config=ServingConfig(**cfg_kwargs), start=start)
+
+
+# ------------------------------------------------------------ RequestTrace
+def test_phase_partition_is_exact(fresh_reservoir):
+    tr = RT.RequestTrace("t")
+    for phase in ("queue", "batch", "work", "work", "fetch"):
+        tr.event(phase)
+    spans = tr.spans()
+    assert [s["phase"] for s in spans] == ["queue", "batch", "work",
+                                          "work", "fetch"]
+    # consecutive spans partition [submit, last] exactly
+    assert abs(sum(s["dur_us"] for s in spans) - tr.total_us) < 1e-9
+    totals = tr.phase_totals()
+    assert list(totals) == ["queue", "batch", "work", "fetch"]
+    assert abs(sum(totals.values()) - tr.total_us) < 1e-9
+    d = tr.to_dict()
+    assert abs(sum(d["phases_ms"].values()) - d["total_ms"]) < 1e-2
+
+
+def test_finish_idempotent_and_status(fresh_reservoir):
+    tr = RT.RequestTrace("t")
+    tr.event("queue")
+    tr.finish("ok")
+    tr.finish("error")  # second finish must not overwrite or re-offer
+    assert tr.status == "ok"
+    assert fresh_reservoir.offered == 1
+    # a finished trace is frozen: a straggler part's events must not
+    # grow the exemplar already exported to histograms/reservoir/chrome
+    n = len(tr.events)
+    tr.event("late")
+    assert len(tr.events) == n
+
+
+def test_sampling_modes(fresh_reservoir):
+    import itertools as _it
+
+    uniq = "t%d" % next(_it.count(id(object())))  # fresh per-kind cursor
+    try:
+        set_flag("MXNET_OBS_TRACE_SAMPLE", 0)
+        assert RT.begin(uniq) is RT.NOOP_TRACE
+        set_flag("MXNET_OBS_TRACE_SAMPLE", 1)
+        assert RT.begin(uniq).sampled
+        set_flag("MXNET_OBS_TRACE_SAMPLE", 3)
+        got = sum(1 for _ in range(30) if RT.begin(uniq).sampled)
+        assert got == 10, got  # exactly 1-in-3
+        # per-KIND cursors: alternating submissions across two kinds
+        # must not phase-lock one kind out of sampling entirely
+        set_flag("MXNET_OBS_TRACE_SAMPLE", 2)
+        ka, kb = uniq + "-a", uniq + "-b"
+        counts = {ka: 0, kb: 0}
+        for _ in range(20):
+            for k in (ka, kb):
+                if RT.begin(k).sampled:
+                    counts[k] += 1
+        assert counts == {ka: 10, kb: 10}, counts
+    finally:
+        set_flag("MXNET_OBS_TRACE_SAMPLE", None)
+    # the no-op trace is inert everywhere
+    noop = RT.NOOP_TRACE
+    noop.event("x")
+    noop.annotate(a=1)
+    noop.finish()
+    assert noop.spans() == [] and noop.trace_id is None
+    assert fresh_reservoir.offered == 0
+
+
+def test_reservoir_keeps_slowest_and_recent_bounded(fresh_reservoir):
+    import time
+
+    set_flag("MXNET_OBS_RESERVOIR", 4)
+    try:
+        RT.reset()
+        res = RT.reservoir()
+        traces = []
+        for i in range(12):
+            tr = RT.RequestTrace("t")
+            # fabricate a controlled duration by editing the raw events
+            t0 = tr.events[0][1]
+            tr.events.append(("work", t0 + (i % 6) * 1e-3,
+                              threading.get_ident()))
+            tr.finish()
+            traces.append(tr)
+            time.sleep(0.001)
+        assert len(res.recent()) == 4
+        assert res.recent()[0] is traces[-1]  # newest first
+        slowest = res.slowest()
+        assert len(slowest) == 4
+        # the 4 slowest offered had (i % 6) in {5, 5, 4, 4}
+        durs = sorted(round(t.total_us / 1e3) for t in slowest)
+        assert durs == [4, 4, 5, 5], durs
+    finally:
+        set_flag("MXNET_OBS_RESERVOIR", None)
+        RT.reset()
+
+
+def test_trace_histograms_labeled_by_engine(telemetry, fresh_reservoir):
+    tr = RT.RequestTrace("myengine")
+    tr.event("queue")
+    tr.finish()
+    assert M.get_value("request.total_ms",
+                       labels={"engine": "myengine"}) == 1
+    assert M.get_value("request.queue_ms",
+                       labels={"engine": "myengine"}) == 1
+    # non-ok traces count as failures but must NOT enter the latency
+    # histograms (load shedding would drag the percentiles toward 0)
+    bad = RT.RequestTrace("myengine")
+    bad.finish("rejected")
+    assert M.get_value("request.total_ms",
+                       labels={"engine": "myengine"}) == 1
+    assert M.get_value("request.failed",
+                       labels={"engine": "myengine"}) == 1
+
+
+# ------------------------------------------------- serving end to end
+def test_serving_trace_end_to_end(telemetry, fresh_reservoir):
+    srv = _mlp_server()
+    srv.warmup()
+    rng = np.random.RandomState(1)
+    futs = [srv.submit(rng.rand(1 + i % 3, 4).astype(np.float32))
+            for i in range(6)]
+    for f in futs:
+        f.result(timeout=60)
+    stats = stats_schema.validate(srv.get_stats())
+    assert stats["engine"] == "serving"
+    assert stats["completed"] == 6
+    assert stats["resilience"]["breaker"]["state"] == "closed"
+    srv.stop()
+    exemplars = [t for t in fresh_reservoir.recent() if t.kind == "serving"]
+    assert len(exemplars) == 6
+    for tr in exemplars:
+        assert tr.status == "ok"
+        totals = tr.phase_totals()
+        assert set(totals) == {"queue", "batch", "compute", "fetch"}
+        assert abs(sum(totals.values()) - tr.total_us) < 1e-6
+        assert tr.meta["bucket"] in (1, 2, 4)
+        assert tr.meta["replica"] == 0
+
+
+def test_serving_trace_chunked_oversize_request(telemetry, fresh_reservoir):
+    srv = _mlp_server()
+    out = srv.predict(np.random.RandomState(2)
+                      .rand(10, 4).astype(np.float32), timeout=60)
+    assert np.asarray(out).shape[0] == 10
+    srv.stop()
+    (tr,) = [t for t in fresh_reservoir.recent() if t.kind == "serving"]
+    assert tr.meta["parts"] == 3  # 10 rows over max bucket 4
+    # interleaved per-part phases still partition the lifetime exactly
+    assert abs(sum(tr.phase_totals().values()) - tr.total_us) < 1e-6
+    assert tr.status == "ok"
+
+
+def test_serving_rejected_trace_status(telemetry, fresh_reservoir):
+    from mxnet_tpu.serving import QueueFullError
+
+    srv = _mlp_server(backpressure="reject", max_queue_rows=4,
+                      max_wait_ms=50, start=False)
+    # no dispatcher: fill the queue, then overflow it
+    srv.submit(np.zeros((4, 4), np.float32))
+    with pytest.raises(QueueFullError):
+        srv.submit(np.zeros((2, 4), np.float32))
+    rejected = [t for t in fresh_reservoir.recent()
+                if t.status == "rejected"]
+    assert len(rejected) == 1
+    srv.stop(drain=False)
+
+
+def test_breaker_states_shape():
+    srv = _mlp_server(start=False)
+    b = srv.breaker_states()
+    assert b["state"] == "closed" and b["quarantined"] == {}
+    import time as _time
+
+    with srv._lock:
+        srv._quarantined[0] = _time.monotonic() + 1.0
+    b = srv.breaker_states()
+    assert b["state"] == "open"  # single replica, quarantined
+    assert "0" in b["quarantined"]
+    assert b["quarantined"]["0"]["probe_in_ms"] > 0
+    with srv._lock:
+        srv._quarantined.clear()
+    srv.stop(drain=False)
+
+
+# ---------------------------------------------- generation end to end
+def test_generation_trace_end_to_end(telemetry, fresh_reservoir):
+    import jax
+
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+    from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                              SamplingParams)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    model = TransformerParallel(mesh, vocab=32, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, n_experts=2)
+    gen = Generator(model, model.init(seed=0),
+                    GenerationConfig(page_size=8, max_batch=2, max_seq=32,
+                                     prefill_buckets=(16, 32)))
+    n_new = 4
+    toks = gen.generate([1, 2, 3],
+                        SamplingParams(max_new_tokens=n_new), timeout=120)
+    assert len(toks) == n_new
+    stats = stats_schema.validate(gen.get_stats())
+    assert stats["engine"] == "generation"
+    assert stats["completed"] == 1
+    assert stats["capacity"]["kv_pages_used"] == 0  # evicted -> freed
+    gen.stop()
+    (tr,) = [t for t in fresh_reservoir.recent()
+             if t.kind == "generation"]
+    assert tr.status == "ok"
+    totals = tr.phase_totals()
+    assert set(totals) == {"queue", "prefill", "decode"}
+    assert abs(sum(totals.values()) - tr.total_us) < 1e-6
+    # one decode span per token after the first
+    decode_spans = [s for s in tr.spans() if s["phase"] == "decode"]
+    assert len(decode_spans) == n_new - 1
+    # TTFT histogram observed once, ITL once per decode token
+    assert M.get_value("generation.ttft_ms") == 1
+    assert M.get_value("generation.itl_ms") == n_new - 1
+
+
+# --------------------------------------------- exposition compliance
+def _parse_prom(text):
+    """Minimal text-format parser: families {name: kind}, samples
+    {name: {label_body: float}}, help {name: text} — with label-value
+    unescaping, so the round-trip test can verify escaping."""
+    types, helps, samples = {}, {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind.strip()
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, txt = line.split(None, 3)
+            helps[name] = (txt.replace("\\n", "\n")
+                           .replace("\\\\", "\\"))
+            continue
+        if line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            body, value = rest.rsplit("}", 1)
+            labels = {}
+            i = 0
+            while i < len(body):
+                eq = body.index("=", i)
+                key = body[i:eq]
+                assert body[eq + 1] == '"'
+                j = eq + 2
+                val = []
+                while body[j] != '"':
+                    if body[j] == "\\":
+                        nxt = body[j + 1]
+                        val.append({"\\": "\\", '"': '"',
+                                    "n": "\n"}[nxt])
+                        j += 2
+                    else:
+                        val.append(body[j])
+                        j += 1
+                labels[key] = "".join(val)
+                i = j + 1
+                if i < len(body) and body[i] == ",":
+                    i += 1
+            key = tuple(sorted(labels.items()))
+        else:
+            name, value = line.rsplit(None, 1)
+            key = ()
+        samples.setdefault(name.strip(), {})[key] = float(value)
+    return types, helps, samples
+
+
+def test_prometheus_exposition_round_trip(telemetry):
+    nasty = 'a"b\\c\nd'
+    M.counter("rt.count", labels={"engine": "serving", "weird": nasty},
+              help="line one\nline two").inc(7)
+    M.counter("rt.count", labels={"engine": "generation"}).inc(2)
+    M.gauge("rt.gauge", help="a gauge").set(3.5)
+    h = M.histogram("rt.hist", buckets=(1, 10), labels={"kind": "x"})
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    text = M.dump_metrics()
+    types, helps, samples = _parse_prom(text)
+    assert types["mxnet_rt_count"] == "counter"
+    assert types["mxnet_rt_hist"] == "histogram"
+    assert helps["mxnet_rt_count"] == "line one\nline two"
+    # ONE TYPE line per family even with two children
+    assert text.count("# TYPE mxnet_rt_count counter") == 1
+    # escaped label value round-trips exactly
+    vals = samples["mxnet_rt_count"]
+    key = tuple(sorted({"engine": "serving", "weird": nasty}.items()))
+    assert vals[key] == 7.0
+    assert vals[(("engine", "generation"),)] == 2.0
+    # histogram buckets cumulative and consistent with count
+    b = samples["mxnet_rt_hist_bucket"]
+    assert b[(("kind", "x"), ("le", "1"))] == 1
+    assert b[(("kind", "x"), ("le", "10"))] == 2
+    assert b[(("kind", "x"), ("le", "+Inf"))] == 3
+    assert samples["mxnet_rt_hist_count"][(("kind", "x"),)] == 3
+    assert samples["mxnet_rt_hist_sum"][(("kind", "x"),)] == 55.5
+
+
+def test_metric_family_kind_conflict_rejected(telemetry):
+    M.counter("rt.conflict", labels={"a": "1"})
+    with pytest.raises(TypeError):
+        M.gauge("rt.conflict", labels={"a": "2"})
+
+
+def test_concurrent_finish_exports_exactly_once(fresh_reservoir):
+    tr = RT.RequestTrace("t")
+    tr.event("queue")
+    barrier = threading.Barrier(8)
+
+    def racer():
+        barrier.wait()
+        tr.finish("ok")
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert fresh_reservoir.offered == 1
+
+
+def test_submit_after_stop_finishes_trace(fresh_reservoir):
+    from mxnet_tpu.serving import ServerClosedError
+
+    srv = _mlp_server()
+    srv.stop()
+    with pytest.raises(ServerClosedError):
+        srv.submit(np.zeros((1, 4), np.float32))
+    assert any(t.status == "rejected" for t in fresh_reservoir.recent())
+
+
+def test_histogram_family_buckets_must_match_across_children(telemetry):
+    M.histogram("rt.fam", buckets=(1, 2), labels={"engine": "a"})
+    with pytest.raises(ValueError):
+        M.histogram("rt.fam", buckets=(1, 2, 4), labels={"engine": "b"})
+    # same ladder is fine
+    M.histogram("rt.fam", buckets=(1, 2), labels={"engine": "b"})
+
+
+def test_illegal_label_name_rejected(telemetry):
+    with pytest.raises(ValueError):
+        M.counter("rt.lbl", labels={"kv.dtype": "int8"})
+    with pytest.raises(ValueError):
+        M.counter("rt.lbl", labels={"0x": "1"})
+    M.counter("rt.lbl", labels={"kv_dtype": "int8"}).inc()  # legal
+
+
+def test_crafted_label_values_do_not_collide(telemetry):
+    a = M.counter("rt.collide", labels={"x": "1,y=2"})
+    b = M.counter("rt.collide", labels={"x": "1", "y": "2"})
+    assert a is not b
+    a.inc(1)
+    b.inc(5)
+    assert M.get_value("rt.collide", labels={"x": "1,y=2"}) == 1
+    assert M.get_value("rt.collide", labels={"x": "1", "y": "2"}) == 5
+
+
+# ------------------------------------------------------ profiler ring
+def test_profiler_ring_bounded_with_drop_counter(tmp_path):
+    profiler.set_config(mode="symbolic", filename=str(tmp_path / "p.json"))
+    profiler.dump_profile()  # drain events earlier tests left behind
+    try:
+        profiler.configure_ring(64)
+        base = profiler.dropped_events()  # after the trim, before records
+        profiler.set_state("run")
+        for i in range(200):
+            profiler.record("ev%d" % i, "t", float(i), 1.0)
+        assert len(profiler.events_tail(1000)) == 64
+        assert profiler.dropped_events() - base == 136
+        # the oldest were evicted, the newest survive
+        names = [e["name"] for e in profiler.events_tail(1000)]
+        assert names[0] == "ev136" and names[-1] == "ev199"
+        path = profiler.dump_profile()
+        payload = json.load(open(path))
+        assert payload["droppedEventsCount"] >= 136
+        assert len(payload["traceEvents"]) == 64
+        # the dump consumed the loss: a NEW session's complete trace
+        # must not inherit the previous session's drop count
+        assert profiler.dropped_events() == 0
+    finally:
+        profiler.configure_ring(None)
+        profiler.set_config(mode="symbolic", filename="profile.json")
+
+
+# ------------------------------------------------------- stats schema
+def test_stats_schema_validate_rejects_drift():
+    good = stats_schema.engine_stats(
+        "serving", {"requests": 3}, queue_depth=0, completed=2,
+        running=True, stopped=False, capacity={}, config={},
+        resilience={})
+    stats_schema.validate(good)
+    row = stats_schema.summarize(good)
+    assert row["engine"] == "serving" and row["requests"] == 3
+    assert "config" not in row  # summary stays compact
+    bad = dict(good)
+    del bad["queue_depth"]
+    with pytest.raises(ValueError):
+        stats_schema.validate(bad)
+    bad = dict(good, queue_depth="3")
+    with pytest.raises(TypeError):
+        stats_schema.validate(bad)
+
+
+def test_engine_stats_shared_vocabulary(telemetry):
+    """The drift regression: both engines' snapshots expose the SAME
+    core keys with the same types."""
+    srv = _mlp_server(start=False)
+    s = stats_schema.validate(srv.get_stats())
+    srv.stop(drain=False)
+    for key in stats_schema.CORE_KEYS:
+        assert key in s
+    # legacy keys still present for serving
+    for legacy in ("queue_rows", "inflight", "buckets", "replicas"):
+        assert legacy in s
+
+
+# ------------------------------------------------------ exposition plane
+def test_http_endpoints(telemetry, fresh_reservoir):
+    tr = RT.RequestTrace("serving")
+    tr.event("queue")
+    tr.finish()
+    port = exposition.start_http(0)
+    try:
+        def get(path):
+            r = urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path), timeout=10)
+            return r.status, r.headers.get("Content-Type"), r.read()
+
+        st, ct, body = get("/healthz")
+        assert st == 200 and json.loads(body)["status"] == "ok"
+        st, ct, body = get("/metrics")
+        assert st == 200 and ct == M.PROM_CONTENT_TYPE
+        assert b"# TYPE" in body
+        st, ct, body = get("/statusz")
+        payload = json.loads(body)
+        assert payload["pid"] == os.getpid()
+        assert payload["telemetry_enabled"] is True
+        st, ct, body = get("/tracez")
+        payload = json.loads(body)
+        assert payload["recent"][0]["trace_id"] == tr.trace_id
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get("/nope")
+        assert err.value.code == 404
+        # idempotent start returns the same port
+        assert exposition.start_http(0) == port
+        assert exposition.http_port() == port
+    finally:
+        exposition.stop_http()
+    assert exposition.http_port() is None
+
+
+def test_statusz_engine_rows_from_live_server(telemetry, fresh_reservoir):
+    srv = _mlp_server()
+    srv.predict(np.ones((2, 4), np.float32), timeout=60)
+    payload = exposition.statusz()
+    rows = [r for r in payload["engines"] if r.get("engine") == "serving"]
+    assert rows, payload["engines"]
+    assert rows[0]["completed"] >= 1
+    assert rows[0]["resilience"]["breaker"]["state"] == "closed"
+    srv.stop()
+
+
+# --------------------------------------------- trace_report --requests
+def test_trace_report_requests_sections(telemetry, fresh_reservoir,
+                                        profiler_session):
+    profiler.dump_profile()  # drain events earlier tests left behind
+    profiler.set_state("run")
+    srv = _mlp_server()
+    srv.warmup()
+    futs = [srv.submit(np.random.rand(1 + i % 3, 4).astype(np.float32))
+            for i in range(5)]
+    for f in futs:
+        f.result(timeout=60)
+    srv.stop()
+    ours = {t.trace_id for t in fresh_reservoir.recent()}
+    path = profiler.dump_profile()
+    events = trace_report.load_events(path)
+    timelines = [t for t in trace_report.request_timelines(events)
+                 if t["trace_id"] in ours]
+    assert len(timelines) == 5
+    for tl in timelines:
+        assert tl["kind"] == "serving"
+        assert set(tl["phases"]) == {"queue", "batch", "compute", "fetch"}
+        assert abs(sum(tl["phases"].values()) - tl["total_ms"]) < 1e-2
+    rows = trace_report.request_summary(timelines)
+    assert rows[0]["kind"] == "serving" and rows[0]["count"] == 5
+    assert rows[0]["total_p99_ms"] >= rows[0]["total_p50_ms"]
+    table = trace_report.format_requests(timelines, path)
+    assert "slowest request" in table and "queue" in table
+    # --compare over request sections (self-diff = zero deltas)
+    cmp_rows = trace_report.compare_requests(path, path)
+    assert cmp_rows[0]["delta_total_p99_ms"] == 0.0
+    # CLI end to end
+    assert trace_report.main([path, "--requests"]) == 0
+    assert trace_report.main(["--compare", path, path, "--requests"]) == 0
+    # flow events stitched into the dump
+    raw = json.load(open(path))["traceEvents"]
+    assert any(e.get("ph") == "s" and e.get("cat") == "request"
+               for e in raw)
+
+
+def test_request_timelines_stitched_spans_keep_partition_exact(
+        profiler_session):
+    """Stitched (kvstore.server.*) spans overlap the engine phases and
+    may come from another process's clock epoch: they must be listed
+    separately, never summed into phases or stretched into bounds."""
+    profiler.dump_profile()
+    profiler.set_state("run")
+    tr = RT.RequestTrace("step")
+    tr.event("queue")
+    tr.event("kvstore.push")
+    # a correlated server-side span with a FOREIGN (e.g. other-process)
+    # timestamp epoch, far outside this request's real bounds
+    profiler.record("kvstore.server.push", "request", 1e12, 5000.0,
+                    args={"trace_id": tr.trace_id})
+    tr.finish()
+    path = profiler.dump_profile()
+    tls = [t for t in trace_report.request_timelines(
+        trace_report.load_events(path)) if t["trace_id"] == tr.trace_id]
+    (tl,) = tls
+    assert abs(sum(tl["phases"].values()) - tl["total_ms"]) < 1e-2
+    assert "kvstore.server.push" not in tl["phases"]
+    assert any(s["span"] == "kvstore.server.push" for s in tl["stitched"])
+    assert tl["total_ms"] < 60_000  # foreign epoch didn't stretch bounds
+
+
+# --------------------------------------------- kvstore RPC stitching
+def test_kvstore_rpc_carries_trace_id(profiler_session):
+    from mxnet_tpu.kvstore_server import PSClient, start_server_thread
+
+    server = start_server_thread()
+    client = PSClient([server.address], rank=0)
+    profiler.set_state("run")
+    tr = RT.RequestTrace("step")
+    with RT.activate(tr):
+        assert RT.current() is tr
+        client.key_call("w", ("init", "w", np.zeros(3, np.float32)))
+        client.key_call("w", ("pull", "w"))
+    assert RT.current() is None
+    profiler.set_state("stop")
+    req = [e for e in profiler.events_tail(200)
+           if e.get("cat") == "request"]
+    names = {e["name"] for e in req}
+    assert "kvstore.server.init" in names and "kvstore.server.pull" in names
+    for e in req:
+        assert e["args"]["trace_id"] == tr.trace_id
+    # without an ambient trace the wire stays bare (no NEW server
+    # request events recorded)
+    before = len([e for e in profiler.events_tail(500)
+                  if e.get("cat") == "request"])
+    profiler.set_state("run")
+    client.key_call("w", ("pull", "w"))
+    profiler.set_state("stop")
+    after = len([e for e in profiler.events_tail(500)
+                 if e.get("cat") == "request"])
+    assert after == before
+    server._stop.set()
+
+
+def test_kvstore_local_push_annotates_ambient_trace(tmp_path):
+    import time
+
+    kv = mx.kv.create("local")
+    kv.init("a", mx.nd.zeros((3,)))
+    kv.push("a", mx.nd.ones((3,)))  # warm the push path outside the trace
+    tr = RT.RequestTrace("step")
+    with RT.activate(tr):
+        time.sleep(0.05)  # caller compute — must NOT land in push
+        kv.push("a", mx.nd.ones((3,)))
+        out = mx.nd.zeros((3,))
+        kv.pull("a", out=out)
+    phases = tr.phase_totals()
+    assert "kvstore.push" in phases and "kvstore.pull" in phases
+    # the RPC phase covers only the RPC: the 50 ms of caller work
+    # before it lands in the preceding "step" interval
+    assert phases["step"] >= 45e3, phases
+    assert phases["kvstore.push"] < 45e3, phases

@@ -268,6 +268,217 @@ def format_graph_pass(rows, path):
     return "\n".join(lines)
 
 
+# ------------------------------------------------------ request tracing
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ASCENDING-sorted list (q in 0-100)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def request_timelines(events):
+    """Reconstruct per-request timelines from a chrome trace's request
+    events (cat ``request``, emitted by observability/request_trace.py:
+    phase spans named ``req.<kind>.<phase>`` carrying ``args.trace_id``;
+    kvstore server-side spans stitch in by the same id).
+
+    Returns [{trace_id, kind, start_ts, total_ms, phases (merged ms by
+    phase), spans (ordered), ttft_ms, itl_ms (list), queue_ms}] sorted
+    slowest-first."""
+    groups = {}
+    for ev in events:
+        if ev.get("cat") != "request" or ev.get("ph", "X") != "X":
+            continue
+        tid = (ev.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        groups.setdefault(tid, []).append(ev)
+    out = []
+    for trace_id, evs in groups.items():
+        evs.sort(key=lambda e: float(e["ts"]))
+        # totals/phases come from the ENGINE's partitioning req.* spans
+        # ONLY: stitched spans (kvstore.server.*) (a) fully overlap the
+        # worker phase that contains them — adding them in would break
+        # the sum(phases) == total partition invariant — and (b) may
+        # come from ANOTHER PROCESS whose perf_counter epoch is
+        # unrelated, so their timestamps must never stretch this
+        # request's bounds. They correlate by trace_id, not by clock,
+        # and are reported in a separate `stitched` list.
+        req_evs = [e for e in evs
+                   if e.get("name", "").startswith("req.")]
+        stitched = [
+            {"span": e.get("name", "?"),
+             "dur_ms": round(float(e["dur"]) / 1e3, 4),
+             "pid": e.get("pid")}
+            for e in evs if not e.get("name", "").startswith("req.")]
+        if not req_evs:
+            # a server-side-only dump: phases from the stitched spans
+            # themselves (one process, one epoch — bounds are sound)
+            t0 = min(float(e["ts"]) for e in evs)
+            t1 = max(float(e["ts"]) + float(e["dur"]) for e in evs)
+            out.append({
+                "trace_id": trace_id, "kind": "(stitched)",
+                "start_ts": t0,
+                "total_ms": round((t1 - t0) / 1e3, 4),
+                "phases": {}, "spans": [], "stitched": stitched,
+                "queue_ms": 0.0, "ttft_ms": None, "itl_ms": [],
+            })
+            continue
+        kind = None
+        phases, spans, itl = {}, [], []
+        t0 = min(float(e["ts"]) for e in req_evs)
+        t1 = max(float(e["ts"]) + float(e["dur"]) for e in req_evs)
+        ttft = None
+        for ev in req_evs:
+            _, k, phase = ev["name"].split(".", 2)
+            kind = kind or k
+            dur_ms = float(ev["dur"]) / 1e3
+            phases[phase] = phases.get(phase, 0.0) + dur_ms
+            spans.append({"phase": phase,
+                          "offset_ms": round((float(ev["ts"]) - t0) / 1e3,
+                                             4),
+                          "dur_ms": round(dur_ms, 4),
+                          "tid": ev.get("tid")})
+            if phase == "prefill":
+                # TTFT = submit -> end of the prefill span
+                ttft = (float(ev["ts"]) + float(ev["dur"]) - t0) / 1e3
+            elif phase == "decode":
+                itl.append(dur_ms)
+        out.append({
+            "trace_id": trace_id,
+            "kind": kind,
+            "start_ts": t0,
+            "total_ms": round((t1 - t0) / 1e3, 4),
+            "phases": {p: round(v, 4) for p, v in phases.items()},
+            "spans": spans,
+            "stitched": stitched,
+            "queue_ms": round(phases.get("queue", 0.0), 4),
+            "ttft_ms": None if ttft is None else round(ttft, 4),
+            "itl_ms": [round(v, 4) for v in itl],
+        })
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def request_summary(timelines):
+    """Per-kind percentile rows: request count plus p50/p90/p99/max of
+    end-to-end latency, queue wait, TTFT and inter-token latency."""
+    by_kind = {}
+    for r in timelines:
+        by_kind.setdefault(r["kind"], []).append(r)
+    rows = []
+    for kind in sorted(by_kind):
+        reqs = by_kind[kind]
+        row = {"kind": kind, "count": len(reqs),
+               "slowest": reqs[0]["trace_id"]}
+        for label, vals in (
+                ("total", [r["total_ms"] for r in reqs]),
+                ("queue", [r["queue_ms"] for r in reqs]),
+                ("ttft", [r["ttft_ms"] for r in reqs
+                          if r["ttft_ms"] is not None]),
+                ("itl", [v for r in reqs for v in r["itl_ms"]])):
+            vals = sorted(vals)
+            for q in (50, 90, 99):
+                row["%s_p%d_ms" % (label, q)] = (
+                    None if not vals
+                    else round(_percentile(vals, q), 4))
+            row["%s_max_ms" % label] = (None if not vals
+                                        else round(vals[-1], 4))
+        rows.append(row)
+    return rows
+
+
+def format_requests(timelines, path, k_spans=40):
+    """The --requests rendering: percentile table + the slowest
+    request's full span timeline."""
+    if not timelines:
+        return "(no request events in %s — was tracing sampled and a " \
+               "profiler session running?)" % path
+    rows = request_summary(timelines)
+    lines = ["# request latency attribution — %s (%d requests)"
+             % (path, len(timelines)),
+             "%-11s %6s %10s %10s %10s %10s %10s %10s %10s"
+             % ("kind", "count", "total_p50", "total_p99", "queue_p99",
+                "ttft_p50", "ttft_p99", "itl_p50", "itl_p99")]
+    fmt = lambda v: "-" if v is None else "%.2f" % v  # noqa: E731
+    for r in rows:
+        lines.append("%-11s %6d %10s %10s %10s %10s %10s %10s %10s"
+                     % (r["kind"], r["count"], fmt(r["total_p50_ms"]),
+                        fmt(r["total_p99_ms"]), fmt(r["queue_p99_ms"]),
+                        fmt(r["ttft_p50_ms"]), fmt(r["ttft_p99_ms"]),
+                        fmt(r["itl_p50_ms"]), fmt(r["itl_p99_ms"])))
+    slow = timelines[0]
+    lines.append("")
+    lines.append("# slowest request: %s (%s, %.3f ms total)"
+                 % (slow["trace_id"], slow["kind"], slow["total_ms"]))
+    lines.append("%-12s %12s %12s %10s" % ("phase", "offset_ms",
+                                           "dur_ms", "tid"))
+    for s in slow["spans"][:k_spans]:
+        lines.append("%-12s %12.4f %12.4f %10s"
+                     % (s["phase"], s["offset_ms"], s["dur_ms"],
+                        s.get("tid", "-")))
+    if len(slow["spans"]) > k_spans:
+        lines.append("... (%d more spans)" % (len(slow["spans"]) - k_spans))
+    lines.append("")
+    lines.append("# phase totals of the slowest request (sum = total):")
+    for p, v in slow["phases"].items():
+        lines.append("  %-12s %10.4f ms" % (p, v))
+    if slow.get("stitched"):
+        lines.append("# stitched spans (correlated by trace_id; overlap "
+                     "the phases above, possibly other processes):")
+        for s in slow["stitched"]:
+            lines.append("  %-24s %10.4f ms  pid %s"
+                         % (s["span"], s["dur_ms"], s.get("pid", "-")))
+    return "\n".join(lines)
+
+
+def compare_requests(path_a, path_b):
+    """--compare for the request sections: per-kind percentile deltas
+    (b minus a; positive = b is slower)."""
+    rows_a = {r["kind"]: r for r in request_summary(
+        request_timelines(load_events(path_a)))}
+    rows_b = {r["kind"]: r for r in request_summary(
+        request_timelines(load_events(path_b)))}
+    out = []
+    for kind in sorted(set(rows_a) | set(rows_b)):
+        a, b = rows_a.get(kind), rows_b.get(kind)
+        row = {"kind": kind,
+               "a_count": a["count"] if a else 0,
+               "b_count": b["count"] if b else 0}
+        for metric in ("total_p50_ms", "total_p99_ms", "queue_p99_ms",
+                       "ttft_p99_ms", "itl_p99_ms"):
+            va = a.get(metric) if a else None
+            vb = b.get(metric) if b else None
+            row["a_" + metric] = va
+            row["b_" + metric] = vb
+            row["delta_" + metric] = (None if va is None or vb is None
+                                      else round(vb - va, 4))
+        out.append(row)
+    return out
+
+
+def format_compare_requests(rows, path_a, path_b):
+    if not rows:
+        return "(no request events in either trace)"
+    lines = ["# request regression diff: %s -> %s (positive = slower)"
+             % (path_a, path_b),
+             "%-11s %9s %12s %12s %12s %12s %12s"
+             % ("kind", "counts", "d_total_p50", "d_total_p99",
+                "d_queue_p99", "d_ttft_p99", "d_itl_p99")]
+    fmt = lambda v: "-" if v is None else "%+.2f" % v  # noqa: E731
+    for r in rows:
+        lines.append("%-11s %4d/%-4d %12s %12s %12s %12s %12s"
+                     % (r["kind"], r["a_count"], r["b_count"],
+                        fmt(r["delta_total_p50_ms"]),
+                        fmt(r["delta_total_p99_ms"]),
+                        fmt(r["delta_queue_p99_ms"]),
+                        fmt(r["delta_ttft_p99_ms"]),
+                        fmt(r["delta_itl_p99_ms"])))
+    return "\n".join(lines)
+
+
 def input_pipeline_rows(payload):
     """Per-stage wait/occupancy rows from a flight-recorder dump's
     ``io`` provider section (runtime/pipeline.py): one pipeline view
@@ -330,6 +541,12 @@ def main(argv=None):
                          "executor, module, kvstore)")
     ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
                     help="diff two traces instead of reporting one")
+    ap.add_argument("--requests", action="store_true",
+                    help="per-request latency attribution from the "
+                         "trace's request events (request_trace.py): "
+                         "TTFT/ITL/queue-wait percentile table + the "
+                         "slowest request's full span timeline; with "
+                         "--compare, per-kind percentile deltas")
     ap.add_argument("--graph-passes", metavar="DUMP",
                     help="print the graph_pass provider section of a "
                          "flight-recorder dump (per-program pass summary: "
@@ -358,6 +575,11 @@ def main(argv=None):
               else format_graph_pass(rows, args.graph_passes))
         return 0
     if args.compare:
+        if args.requests:
+            rows = compare_requests(*args.compare)
+            print(json.dumps(rows, indent=1) if args.json
+                  else format_compare_requests(rows, *args.compare))
+            return 0
         rows = compare(args.compare[0], args.compare[1], k=args.top_k,
                        cat=args.cat)
         print(json.dumps(rows, indent=1) if args.json
@@ -365,6 +587,11 @@ def main(argv=None):
         return 0
     if not args.trace:
         ap.error("trace path required (or use --compare A B)")
+    if args.requests:
+        timelines = request_timelines(load_events(args.trace))
+        print(json.dumps(timelines, indent=1) if args.json
+              else format_requests(timelines, args.trace))
+        return 0
     rows = report(args.trace, k=args.top_k, cat=args.cat)
     title = "top %d by total time — %s" % (args.top_k, args.trace)
     if args.cat:
