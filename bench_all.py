@@ -24,9 +24,15 @@ Standalone gates/modes: --lint-clean (graftlint vs baseline),
 --health-overhead (warn-mode <=2%/step), --resilience-overhead
 (faults-disabled injection points + deadline checks <1%/request;
 docs/resilience.md), --obs-overhead (request tracing <1%/request,
-on and sampled-out; docs/observability.md), --autotune
-(tuned-vs-default on the autotuner's knob families + the warm-cache
-<1%/step gate; docs/autotune.md).
+on and sampled-out; docs/observability.md), --perf-overhead (roofline
+attribution + step waterfall <1%/step on stable quantities;
+docs/perf_observability.md), --autotune (tuned-vs-default on the
+autotuner's knob families + the warm-cache <1%/step gate;
+docs/autotune.md).
+
+Every full run also appends one row to BENCH_LEDGER.jsonl (fingerprint,
+per-bench throughput + MFU, per-program predicted-vs-measured
+residuals) — the perf trajectory tools/perf_report.py --ledger diffs.
 """
 import atexit
 import functools
@@ -470,12 +476,19 @@ def bench_transformer_lm(B=None, T=None):
     float(loss)
     dt = (time.perf_counter() - t0) / steps
     n_par = sum(v.size for v in jax.tree_util.tree_leaves(params))
+    # 6ND FLOP basis over the spec-sheet ceiling — the ONE table
+    # (autotune.cost_model.CEILINGS) every MFU field cites, so this
+    # number and the perf ledger's transformer MFU can never drift
+    from mxnet_tpu.autotune.cost_model import SPEC_MATMUL_TF
+
     return {"value": round(B * T / dt), "unit": "tokens/sec",
             "protocol": ("%dM-param causal LM, T=%d bs%d bf16, flash "
                          "attention, fwd+bwd+sgd one program"
                          % (round(n_par / 1e6), T, B)),
             "ms_per_step": round(dt * 1e3, 2),
-            "mfu_spec": round(6 * n_par * B * T / dt / 197e12, 4)}
+            "params": int(n_par),
+            "mfu_spec": round(6 * n_par * B * T / dt
+                              / (SPEC_MATMUL_TF * 1e12), 4)}
 
 
 def bench_serving_resnet50():
@@ -1923,6 +1936,228 @@ def bench_input_pipeline(gate_ratio=None):
     return results
 
 
+def _perf_probe(steps=6, bs=64):
+    """A short instrumented fit whose per-program predicted-vs-measured
+    residuals ride the ledger row (observability.perf): the attribution
+    registry fills from the fit loop's fenced step scopes, so the probe
+    runs OUTSIDE the timed benches and cannot perturb their numbers.
+    Returns (programs, last waterfall)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import perf
+
+    perf.reset()
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="pc1"),
+        act_type="relu")
+    p1 = mx.sym.Pooling(c1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f1 = mx.sym.FullyConnected(mx.sym.Flatten(p1), num_hidden=64,
+                               name="pf1")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Activation(f1, act_type="relu"), num_hidden=10, name="pf2"),
+        name="softmax")
+    x = rng.rand(bs * steps, 1, 16, 16).astype(np.float32)
+    y = rng.randint(0, 10, bs * steps).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=bs, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.gpu() if mx.context.num_gpus()
+                        else mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),))
+    programs = []
+    for p in perf.program_table():
+        programs.append({k: p[k] for k in (
+            "graph", "mode", "flops", "hbm_bytes", "roofline_ms", "runs",
+            "device_ms_ema", "device_ms_best", "mfu_pct", "hbm_util_pct",
+            "residual")})
+    return programs, perf.last_waterfall()
+
+
+def _ledger_fingerprint():
+    import platform
+    import subprocess
+
+    import jax
+
+    fp = {"device": jax.devices()[0].device_kind,
+          "platform": jax.default_backend(),
+          "jax": jax.__version__,
+          "python": sys.version.split()[0],
+          "host": platform.node()}
+    try:
+        fp["git"] = subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stderr=subprocess.DEVNULL, timeout=10).decode().strip()
+    except Exception:
+        pass
+    return fp
+
+
+def append_perf_ledger(results, path=None):
+    """One append-only BENCH_LEDGER.jsonl row per bench run (ISSUE 13):
+    env/device fingerprint, per-bench throughput + MFU (the transformer
+    rows' MFU uses the SAME 6ND/spec-ceiling basis as BENCH_ALL.json's
+    ``mfu_spec``), and predicted-vs-measured residual per program from
+    a short instrumented probe fit — the dataset a learned cost model
+    trains on.  Prints the regression verdict vs the previous
+    comparable row."""
+    import time as _time
+
+    from mxnet_tpu.observability import perf
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = path or os.path.join(here, "BENCH_LEDGER.jsonl")
+    benches = {}
+    for name, entry in results.get("configs", {}).items():
+        if "error" in entry:
+            benches[name] = {"error": entry["error"]}
+            continue
+        row = {"value": entry.get("value"), "unit": entry.get("unit")}
+        if entry.get("mfu_spec") is not None:
+            # same FLOP basis as BENCH_ALL.json mfu_spec, as a percent
+            row["mfu_pct"] = round(100.0 * entry["mfu_spec"], 2)
+            row["mfu_basis"] = "6ND / spec ceiling (cost_model.CEILINGS)"
+        benches[name] = row
+    try:
+        programs, waterfall = _perf_probe()
+    except Exception as err:
+        traceback.print_exc()
+        programs, waterfall = [], None
+        benches["_perf_probe"] = {"error": repr(err)}
+    row = {
+        "ts": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "quick": QUICK,
+        "fingerprint": _ledger_fingerprint(),
+        "benches": benches,
+        "programs": programs,
+        "waterfall": waterfall,
+    }
+    perf.append_ledger(row, path)
+    rows = perf.read_ledger(path)
+    verdict = perf.ledger_verdict(rows)
+    print("[bench_all] ledger row appended to %s (%d rows); verdict: %s"
+          % (path, len(rows), json.dumps(verdict)), file=sys.stderr)
+    return path, verdict
+
+
+def bench_perf_overhead(threshold_pct=None):
+    """--perf-overhead: gate the per-step cost of the roofline
+    attribution layer (observability/perf.py).  Wall-clock A/B measures
+    ambient noise larger than the effect (the PR 8/12 lesson), so the
+    hard gate is on the stable quantities:
+
+    * the steady-state step path performs ZERO cost walks — the
+      analytic accounting is memoized per (program, shape signature)
+      (witnessed: walk count flat across timed steps);
+    * the full per-step perf work — scope begin, one fenced
+      ``block_until_ready`` on already-ready outputs, the memo probe +
+      attribution update, a data-wait and a kvstore note, scope end —
+      measured per-call and taken as a percentage of the measured
+      per-step wall of a small fit.
+
+    Fails above ``threshold_pct`` (default 1%, env MXNET_PERF_GATE_PCT).
+    """
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import perf
+
+    if threshold_pct is None:
+        threshold_pct = float(os.environ.get("MXNET_PERF_GATE_PCT", "1.0"))
+    rng = np.random.RandomState(0)
+
+    # ---- the measured per-step wall of a small fused-train-step loop
+    bs, steps = 128, (20 if QUICK else 60)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=512, name="o1"), act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        fc1, num_hidden=16, name="o2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), data_names=("data",))
+    mod.bind(data_shapes=[("data", (bs, 64))],
+             label_shapes=[("softmax_label", (bs,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.rand(bs, 64).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 16, bs).astype(np.float32))])
+    for _ in range(3):  # compile + warm
+        mod.forward_backward(batch)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()
+    step_s = (time.perf_counter() - t0) / steps
+
+    # ---- witness: steady-state steps pay ZERO cost walks
+    ex = mod._exec_group.execs[0]
+    prog = ex._prog if ex._train_prog is None else ex._train_prog
+    perf.reset()
+    perf.step_begin()
+    mod.forward_backward(batch)
+    mod.update()
+    perf.step_end(step=0)
+    walks_before = len(prog._perf_costs)
+    n_check = 10
+    for i in range(n_check):
+        perf.step_begin()
+        mod.forward_backward(batch)
+        mod.update()
+        perf.step_end(step=i + 1)
+    walks = len(prog._perf_costs) - walks_before
+
+    # ---- per-call cost of the full per-step perf work
+    arg_d = ex._arg_datas(prog)
+    aux_d = {n: ex.aux_dict[n]._data for n in prog.aux_names}
+    outs = [o._data for o in ex.outputs]
+    n = 5_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            perf.step_begin()
+            jax.block_until_ready(outs)  # the fence, on ready outputs
+            perf.note_program_run(prog.perf_cost(arg_d, aux_d, train=True),
+                                  device_s=1e-6, host_s=1e-6)
+            perf.note_data_wait(1e-9)
+            perf.note_kv(1e-9)
+            perf.step_end(step=i)
+        best = min(best, (time.perf_counter() - t0) / n)
+    perf.reset()
+
+    pct = 100.0 * best / step_s
+    result = {
+        "per_step_cost_us": round(best * 1e6, 2),
+        "step_ms": round(step_s * 1e3, 3),
+        "steady_state_cost_walks": walks,
+        "overhead_pct": round(pct, 4),
+        "threshold_pct": threshold_pct,
+        "protocol": ("full per-step perf work (scope + fence + memoized "
+                     "attribution + waterfall record) per-call vs the "
+                     "measured per-step wall of an MLP 64-512-16 bs%d "
+                     "fused train step" % bs),
+    }
+    print("[bench_all] perf overhead: %s" % json.dumps(result),
+          file=sys.stderr)
+    if walks:
+        raise SystemExit(
+            "bench_all --perf-overhead: %d cost walks on the steady-state "
+            "step path — accounting must stay memoized per shape" % walks)
+    if pct > threshold_pct:
+        raise SystemExit(
+            "bench_all --perf-overhead: perf layer costs %.3f%% per step "
+            "(> %.2f%% gate) — attribution must stay cheap enough to "
+            "leave on by default" % (pct, threshold_pct))
+    print("[bench_all] perf-overhead gate passed (%.4f%% <= %.2f%%, 0 "
+          "steady-state walks)" % (pct, threshold_pct), file=sys.stderr)
+    return result
+
+
 def assert_lint_clean():
     """--lint-clean: graftlint must exit 0 against the committed baseline.
 
@@ -1975,6 +2210,12 @@ def main(out_path=None, skip=(), quiet=False, telemetry=False):
         os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json")
     with open(out_path, "w") as sink:
         json.dump(results, sink, indent=1)
+    try:
+        # one append-only ledger row per run (ISSUE 13) — the bench
+        # trajectory tools/perf_report.py --ledger diffs and CI gates on
+        append_perf_ledger(results)
+    except Exception:
+        traceback.print_exc()
     print(json.dumps(results), file=sys.stderr if quiet else sys.stdout)
     return results
 
@@ -1996,6 +2237,11 @@ if __name__ == "__main__":
         # standalone gate: request tracing (on AND sampled-out) must
         # cost < 1% of a serving request (docs/observability.md)
         bench_obs_overhead()
+    elif "--perf-overhead" in sys.argv[1:]:
+        # standalone gate: the roofline-attribution layer (fenced split,
+        # memoized cost accounting, waterfall records) must cost < 1% of
+        # a fit step on the stable quantities (docs/perf_observability.md)
+        bench_perf_overhead()
     elif "--autotune" in sys.argv[1:]:
         # tuned-vs-default on the autotuner's three knob families +
         # the warm-cache (<1%/step) overhead gate (docs/autotune.md);

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import math
 
-__all__ = ["MEASURED_MATMUL_TF", "MEASURED_HBM_GBPS", "VMEM_BYTES",
+__all__ = ["MEASURED_MATMUL_TF", "MEASURED_HBM_GBPS", "SPEC_MATMUL_TF",
+           "VMEM_BYTES", "CEILINGS", "ridge_intensity",
            "roofline_seconds", "flash_fwd_cost", "flash_bwd_cost",
            "flash_vmem_bytes", "ladder_cost", "expected_padding",
            "pow2_at_least"]
@@ -27,11 +28,36 @@ def pow2_at_least(n):
     return p
 
 # measured ceilings (PERF_NOTES.md: 8192^3 matmul scan; bf16 stream,
-# round-5 recalibration) — one consistent basis with flops_anchor.py
+# round-5 recalibration) — THE one calibrated table every FLOP/ceiling
+# consumer cites (ISSUE 13): tools/flops_anchor.py, tools/
+# chip_calibration.py, observability/perf.py and bench_all.py's MFU
+# fields all import from here, so an MFU% printed anywhere in the tree
+# is always relative to the same basis.
 MEASURED_MATMUL_TF = 128.6
 MEASURED_HBM_GBPS = 634.0
+# spec-sheet bf16 matmul peak of the chip (v5-lite datasheet) — the
+# denominator of the *_spec MFU numbers (BENCH_ALL.json mfu_spec);
+# measured vs spec: achieved-of-attainable vs achieved-of-advertised
+SPEC_MATMUL_TF = 197.0
 # per-core VMEM; Pallas tiles + double-buffered input windows must fit
 VMEM_BYTES = 16 * 2 ** 20
+
+#: the exported calibration table (single source of truth; see
+#: tools/chip_calibration.py for the microbench that re-measures it)
+CEILINGS = {
+    "matmul_tf_s": MEASURED_MATMUL_TF,
+    "hbm_gb_s": MEASURED_HBM_GBPS,
+    "spec_matmul_tf_s": SPEC_MATMUL_TF,
+    "vmem_bytes": VMEM_BYTES,
+    "source": "PERF_NOTES.md round-5 calibration "
+              "(tools/chip_calibration.py)",
+}
+
+
+def ridge_intensity():
+    """The roofline ridge point in FLOPs/byte at the measured ceilings:
+    ops whose arithmetic intensity sits below it are bandwidth-bound."""
+    return (MEASURED_MATMUL_TF * 1e12) / (MEASURED_HBM_GBPS * 1e9)
 _VMEM_BUDGET = int(VMEM_BYTES * 0.75)  # headroom for Mosaic's own buffers
 # fixed cost per grid step (loop + DMA issue) — dominates tiny blocks
 _GRID_STEP_S = 2e-7

@@ -324,6 +324,21 @@ Flags currently honored:
     env-only — like MXNET_PROFILER_MODE, NOT routed through the integer
     get_flag machinery (unset must mean "off", not port 0).
 
+``MXNET_PERF`` (default 1)
+    Roofline attribution layer (observability/perf.py): analytic
+    FLOPs/HBM-bytes accounting per compiled program, achieved-vs-
+    roofline ``perf.mfu_pct`` / ``perf.hbm_util_pct`` gauges, and the
+    fit-loop step-time waterfall (data-wait / host dispatch / device
+    compute / kvstore segments that sum to the step wall exactly).
+    Cost walks run once per (program, shape signature); steady-state
+    steps pay dict probes only (gated < 1%/step by ``bench_all.py
+    --perf-overhead``). 0 = the whole layer off.
+
+``MXNET_PERF_RING`` (default 64)
+    Capacity of the per-step waterfall ring surfaced by the flight
+    recorder's ``perf`` provider, ``/statusz`` and
+    ``tools/perf_report.py``.
+
 ``MXNET_PROFILER_RING`` (default 200000)
     Bound of the profiler's in-memory event ring (profiler.py): beyond
     it the OLDEST events are evicted and counted
@@ -384,6 +399,8 @@ _DEFAULTS = {
     "MXNET_SERVING_COOLDOWN_MS": 1000,
     "MXNET_OBS_TRACE_SAMPLE": 1,
     "MXNET_OBS_RESERVOIR": 32,
+    "MXNET_PERF": 1,
+    "MXNET_PERF_RING": 64,
     "MXNET_PROFILER_RING": 200000,
     "MXNET_IO_STREAMING": 0,
     "MXNET_IO_DECODE_WORKERS": 0,
@@ -417,9 +434,17 @@ def _apply_obs_sample(value):
     _rtrace._apply_sample_flag(value)
 
 
+def _apply_perf(value):
+    # keep perf's cached activity switch coherent with the flag
+    from .observability import perf as _perf
+
+    _perf._apply_perf_flag(value)
+
+
 _APPLIERS = {"MXNET_DEBUG_NANS": _apply_debug_nans,
              "MXNET_TELEMETRY": _apply_telemetry,
-             "MXNET_OBS_TRACE_SAMPLE": _apply_obs_sample}
+             "MXNET_OBS_TRACE_SAMPLE": _apply_obs_sample,
+             "MXNET_PERF": _apply_perf}
 
 
 def get_flag(name, default=None):

@@ -124,6 +124,7 @@ class _GraphProgram:
             and 0 in tuple(n.parsed_attrs().get("shape", ()))]
         self._init_shape_cache = {}
         self._sel_topo = {}
+        self._perf_costs = {}  # (mode, shape sig) -> analytic cost dict
         self._tuning_key = tuning_key
         import threading
 
@@ -167,6 +168,35 @@ class _GraphProgram:
         topo = [n for n in self.topo if id(n) in reachable]
         self._sel_topo[key] = (topo, entries)  # graftlint: disable=G003 — host-side memo of a graph walk
         return topo, entries
+
+    def perf_cost(self, arg_d, aux_d, train=False):
+        """Analytic FLOPs + HBM-bytes accounting for this program at the
+        given bound arrays (observability.perf, ISSUE 13), memoized per
+        (mode, shape signature) alongside the compiled program — the
+        walk runs once per shape, steady-state runs pay one dict probe.
+        Returns None when shape inference cannot cover the graph."""
+        key = (bool(train),
+               tuple(sorted((n, tuple(v.shape)) for n, v in arg_d.items())),
+               tuple(sorted((n, tuple(v.shape)) for n, v in aux_d.items())))
+        if key not in self._perf_costs:
+            from .observability import perf as _perf
+
+            var_shapes = {n: tuple(v.shape) for n, v in arg_d.items()}
+            var_shapes.update((n, tuple(v.shape))
+                              for n, v in aux_d.items())
+            # compute dtype = the widest bound tensor's (bf16 params ->
+            # 2-byte traffic model; fp32 -> 4)
+            db = 4
+            if arg_d:
+                biggest = max(arg_d.values(),
+                              key=lambda v: getattr(v, "size", 0))
+                db = getattr(getattr(biggest, "dtype", None), "itemsize", 4)
+            names = self.symbol.list_outputs()
+            graph = names[0] if names else "program"
+            self._perf_costs[key] = _perf.program_cost(  # graftlint: disable=G003 — host-side memo, computed post-run
+                self.symbol, self.topo, var_shapes, dtype_bytes=db,
+                train=train, graph="%s/%dn" % (graph, len(self.topo)))
+        return self._perf_costs[key]
 
     def remat_mirror(self):
         """Remat decision for this graph's fused train program: a tuned
@@ -594,10 +624,17 @@ class Executor:
 
         from . import profiler as _profiler
         from .observability import metrics as _metrics
+        from .observability import perf as _perf
 
         profiled = _profiler.symbolic_active()
         telemetry = _metrics.enabled()
-        t0 = _profiler._now_us() if (profiled or telemetry) else 0
+        # fenced measurement also when a fit-step waterfall scope is open
+        # (observability.perf): the host/device split feeds per-program
+        # MFU attribution + the step waterfall's device segment. Scope-
+        # gated on purpose — async predict loops outside fit keep their
+        # pipelining.
+        perf_on = _perf.step_active()
+        t0 = _profiler._now_us() if (profiled or telemetry or perf_on) else 0
 
         if not is_train:
             outs = self._prog.infer_fn(self._out_sel)(arg_d, aux_d, rngs)
@@ -615,19 +652,28 @@ class Executor:
             for n, nv in aux_upd.items():
                 self.aux_dict[n]._set_data(nv)
             self._stashed_grads = grads
-        if profiled or telemetry:
+        if profiled or telemetry or perf_on:
             # one event per compiled-program run — the engine-op analog
-            # (a whole graph is ONE engine push here, SURVEY.md §7.1)
+            # (a whole graph is ONE engine push here, SURVEY.md §7.1).
+            # t1 - t0 = host dispatch (trace/lower/enqueue), t2 - t1 =
+            # the device-compute wait: the PR 2 fenced split, applied to
+            # the graph path
             import jax
 
+            t1 = _profiler._now_us()
             jax.block_until_ready(outs)
-            dur_us = _profiler._now_us() - t0
+            t2 = _profiler._now_us()
+            dur_us = t2 - t0
             name = "forward_backward" if is_train else "forward"
             if profiled:
                 _profiler.record(name, "executor", t0, dur_us)
             if telemetry:
                 _metrics.counter("dispatch.graph").inc()
                 _metrics.histogram("executor.run_ms").observe(dur_us / 1e3)
+            if perf_on:
+                _perf.note_program_run(
+                    prog.perf_cost(arg_d, aux_d, train=is_train),
+                    device_s=(t2 - t1) / 1e6, host_s=(t1 - t0) / 1e6)
         self.outputs = [_from_data(o) for o in outs]
         return self.outputs
 
@@ -828,6 +874,16 @@ class Executor:
         for model-parallel group2ctx graphs, which always run eagerly)."""
         self._monitor_callback = callback
         self._monitor_use_jit = bool(use_jit)
+
+    def perf_program_cost(self, is_train=False):
+        """Analytic cost of the program a forward(is_train=...) on this
+        executor runs, at its currently-bound shapes (memoized on the
+        program) — the group-level perf note's input
+        (executor_group.DataParallelExecutorGroup.forward)."""
+        prog = self._train_program() if is_train else self._prog
+        arg_d = self._arg_datas(prog)
+        aux_d = {n: self.aux_dict[n]._data for n in prog.aux_names}
+        return prog.perf_cost(arg_d, aux_d, train=is_train)
 
     def named_health_arrays(self):
         """``(kind, name, NDArray)`` triples for the health layer: every

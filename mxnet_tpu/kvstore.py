@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
 
 from .base import MXNetError
 from . import ndarray as nd
@@ -288,9 +289,15 @@ class KVStore:
             # "kvstore.push" phase below then covers exactly the RPC,
             # not all the compute since the trace's previous mark
             ambient.event("step")
+        from .observability import perf as _perf
+
+        _t_kv = time.perf_counter()
         with trace_span("kvstore.push", "kvstore"):
             _retry.call(_attempt, policy=self._retry_policy,
                         name="kvstore.push")
+        # kvstore/collective segment of the fit-step waterfall (no-op
+        # outside a perf step scope)
+        _perf.note_kv(time.perf_counter() - _t_kv)
         counter("kvstore.push").inc()
         if ambient is not None:
             # this push is one of the ambient trace's phases (the dist
@@ -363,9 +370,13 @@ class KVStore:
         if ambient is not None:
             ambient.event("step")  # pull phase starts here, not at the
             #                        trace's previous mark
+        from .observability import perf as _perf
+
+        _t_kv = time.perf_counter()
         with trace_span("kvstore.pull", "kvstore"):
             _retry.call(_attempt, policy=self._retry_policy,
                         name="kvstore.pull")
+        _perf.note_kv(time.perf_counter() - _t_kv)
         counter("kvstore.pull").inc()
         if ambient is not None:
             ambient.event("kvstore.pull")

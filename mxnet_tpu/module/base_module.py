@@ -18,7 +18,8 @@ from ..model import BatchEndParam
 from .. import ndarray as nd
 from ..context import cpu
 from ..initializer import Uniform
-from ..observability import flight_recorder, health, record_step, trace_span
+from ..observability import (flight_recorder, health, perf, record_step,
+                             trace_span)
 
 _PARAM_KINDS = ("arg", "aux")
 _WEIGHT_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
@@ -59,18 +60,30 @@ def _check_input_names(symbol, names, typename, throw):
 
 
 def _lookahead(data_iter):
-    """Yield (batch, is_last) pairs, reading one batch ahead."""
+    """Yield (batch, is_last) pairs, reading one batch ahead.
+
+    Each ``next()`` is timed into the current perf step scope's
+    data-wait segment (observability.perf): the fit loop opens the
+    scope BEFORE resuming this generator, so the wait for batch N+1
+    lands in the step that stalls on it — the waterfall's input-bound
+    signal."""
     it = iter(data_iter)
+    t0 = time.perf_counter()
     try:
         pending = next(it)
     except StopIteration:
         return
+    finally:
+        perf.note_data_wait(time.perf_counter() - t0)
     while True:
+        t0 = time.perf_counter()
         try:
             upcoming = next(it)
         except StopIteration:
+            perf.note_data_wait(time.perf_counter() - t0)
             yield pending, True, None
             return
+        perf.note_data_wait(time.perf_counter() - t0)
         yield pending, False, upcoming
         pending = upcoming
 
@@ -299,53 +312,75 @@ class BaseModule:
         when SIGTERM flagged it, the in-flight step has just finished,
         so the checkpoint written here is step-consistent."""
         nbatch = start_batch
-        eval_metric = train_metric  # keep legacy name visible in locals()
-        for data_batch, _is_last, upcoming in _lookahead(train_data):
-            if nbatch < skip_batches:
-                nbatch += 1
-                continue
-            step_started = time.perf_counter()
-            if monitor is not None:
-                monitor.tic()
-            with trace_span("step", "module"):
-                self.forward_backward(data_batch)
-                skip_update = False
-                if health.active():
-                    # fused non-finite check over this step's loss/grads/
-                    # params BEFORE the update, so skip_step can withhold
-                    # it and keep the parameters finite
-                    verdict = self._health_check(
-                        time.perf_counter() - step_started)
-                    skip_update = verdict is not None and verdict.skip
+        # step-time waterfall (observability.perf): the scope opens
+        # BEFORE the lookahead fetches each batch, so data-wait, the
+        # executors' fenced device time and kvstore time all land in the
+        # step that paid them; the scope closes right after record_step
+        # and the segments sum to the step wall exactly by construction
+        perf.step_begin()
+        try:
+            eval_metric = train_metric  # keep legacy name in locals()
+            for data_batch, _is_last, upcoming in _lookahead(train_data):
+                if nbatch < skip_batches:
+                    nbatch += 1
+                    # resume fast-forward consumes batches without
+                    # training: restart the scope so its data wait is
+                    # not charged to the first real step
+                    perf.step_abandon()
+                    perf.step_begin()
+                    continue
+                step_started = time.perf_counter()
+                if monitor is not None:
+                    monitor.tic()
+                with trace_span("step", "module"):
+                    self.forward_backward(data_batch)
+                    skip_update = False
+                    if health.active():
+                        # fused non-finite check over this step's loss/
+                        # grads/params BEFORE the update, so skip_step
+                        # can withhold it and keep the parameters finite
+                        verdict = self._health_check(
+                            time.perf_counter() - step_started)
+                        skip_update = verdict is not None and verdict.skip
+                    if not skip_update:
+                        with trace_span("update", "module"):
+                            self.update()
+                if upcoming is not None:
+                    self.prepare(upcoming)
                 if not skip_update:
-                    with trace_span("update", "module"):
-                        self.update()
-            if upcoming is not None:
-                self.prepare(upcoming)
-            if not skip_update:
-                # a skipped step's outputs are the non-finite values the
-                # skip protects against — feeding them to a sum-based
-                # metric would print Train-<m>=nan for the whole epoch
-                with trace_span("update_metric", "module"):
-                    self.update_metric(train_metric, data_batch.label)
-            if monitor is not None:
-                monitor.toc_print()
-            record_step(time.perf_counter() - step_started)
-            _fire(batch_end_callback,
-                  BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                eval_metric=train_metric, locals=locals()))
-            nbatch += 1
-            completed_steps += 1
-            if guard is not None and guard.triggered:
-                # the in-flight step just completed; checkpoint at this
-                # exact position and unwind (PreemptedError). The
-                # iterator state is the EPOCH-START snapshot — resume
-                # restores it and skips `nbatch` batches, exact no
-                # matter how far the pipeline has read ahead
-                guard.checkpoint_and_raise(self, epoch=epoch,
-                                           batch=nbatch,
-                                           step=completed_steps,
-                                           iterator_state=iter_state)
+                    # a skipped step's outputs are the non-finite values
+                    # the skip protects against — feeding them to a
+                    # sum-based metric would print Train-<m>=nan for the
+                    # whole epoch
+                    with trace_span("update_metric", "module"):
+                        self.update_metric(train_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                record_step(time.perf_counter() - step_started)
+                perf.step_end(step=completed_steps + 1)
+                perf.step_begin()
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=train_metric,
+                                    locals=locals()))
+                nbatch += 1
+                completed_steps += 1
+                if guard is not None and guard.triggered:
+                    # the in-flight step just completed; checkpoint at
+                    # this exact position and unwind (PreemptedError).
+                    # The iterator state is the EPOCH-START snapshot —
+                    # resume restores it and skips `nbatch` batches,
+                    # exact no matter how far the pipeline has read
+                    # ahead
+                    guard.checkpoint_and_raise(self, epoch=epoch,
+                                               batch=nbatch,
+                                               step=completed_steps,
+                                               iterator_state=iter_state)
+        finally:
+            # an exception (health raise, preemption checkpoint) or the
+            # epoch end must not leave a dangling scope: step_active()
+            # would keep fencing every later forward on this thread
+            perf.step_abandon()
         return nbatch, completed_steps
 
     def _health_check(self, wall_s):

@@ -260,6 +260,33 @@ class DataParallelExecutorGroup:
         if self.label_arrays is not None and data_batch.label:
             _scatter(data_batch.label, self.label_arrays)
         train_flag = self.for_training if is_train is None else is_train
+        from ..observability import perf as _perf
+
+        if len(self.execs) > 1 and _perf.step_active():
+            # data-parallel replicas must overlap: the per-executor
+            # fenced perf measurement would block_until_ready between
+            # dispatches and serialize them. Hide the step scope while
+            # dispatching ALL replicas, then fence the whole group once
+            # — the device segment is the wait for the slowest replica,
+            # and the note stays per-replica cost so MFU reads relative
+            # to one chip's ceiling.
+            import time as _time
+
+            import jax
+
+            t0 = _time.perf_counter()
+            with _perf.scope_suspended():
+                for e in self.execs:
+                    e.forward(is_train=train_flag)
+            t1 = _time.perf_counter()
+            jax.block_until_ready([o._data for e in self.execs
+                                   for o in e.outputs])
+            t2 = _time.perf_counter()
+            _perf.note_program_run(
+                self.execs[0].perf_program_cost(bool(train_flag)),
+                device_s=t2 - t1, host_s=t1 - t0,
+                replicas=len(self.execs))
+            return
         for e in self.execs:
             e.forward(is_train=train_flag)
 

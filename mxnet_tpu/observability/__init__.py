@@ -24,6 +24,12 @@ Three pillars, one import:
   queue/batch/compute/fetch latency attribution, a bounded tail-exemplar
   reservoir, and chrome-trace export (``tools/trace_report.py
   --requests``).
+* :mod:`.perf` — roofline attribution (ISSUE 13): analytic FLOPs/HBM
+  bytes per compiled program on the autotuner's measured-ceiling basis,
+  achieved-vs-roofline MFU / HBM-utilization gauges, the fit-loop
+  step-time waterfall (data-wait / host / device / kvstore, summing to
+  the step wall exactly), and the ``BENCH_LEDGER.jsonl`` perf-ledger
+  helpers (render with ``tools/perf_report.py``).
 * :mod:`.stats_schema` — the ONE stats vocabulary both serving engines'
   ``get_stats()`` snapshots conform to.
 * :mod:`.exposition` — opt-in stdlib HTTP plane
@@ -45,6 +51,7 @@ from . import flight_recorder
 from . import request_trace
 from . import stats_schema
 from . import exposition
+from . import perf
 from .metrics import (counter, gauge, histogram, dump_metrics,
                       reset_metrics, set_enabled, enabled)
 from .tracing import trace_span, device_scope
@@ -53,7 +60,7 @@ from .health import TrainingHealthError
 from .request_trace import RequestTrace
 
 __all__ = ["metrics", "instruments", "tracing", "health", "flight_recorder",
-           "request_trace", "stats_schema", "exposition",
+           "request_trace", "stats_schema", "exposition", "perf",
            "counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "set_enabled", "enabled", "trace_span", "device_scope",
            "sample_memory", "record_step", "retrace_causes",

@@ -74,6 +74,7 @@ def _engine_rows():
 def statusz():
     """The /statusz payload (also importable for tests/tools)."""
     from .. import profiler
+    from . import perf
 
     rows, sections = _engine_rows()
     return {
@@ -83,6 +84,7 @@ def statusz():
         "telemetry_enabled": metrics.enabled(),
         "trace_sample_every": request_trace.sample_every(),
         "profiler_dropped_events": profiler.dropped_events(),
+        "perf": perf.summary_brief(),
         "engines": rows,
         "providers": sections,
     }

@@ -22,6 +22,10 @@ engine-specific (and legacy, test-relied-upon) keys:
   dtypes) so a scraped snapshot is self-describing.
 * ``resilience`` — breaker/fault state (quarantined replicas with
   probe countdowns, decode faults, retries, drain timeouts).
+* ``perf`` — roofline attribution (observability.perf, ISSUE 13):
+  current MFU% / HBM-utilization%, the last step waterfall, and how
+  many compiled programs carry measured attribution.  Injected by
+  :func:`engine_stats` so BOTH engines carry it schema-validated.
 * ``running`` / ``stopped`` — lifecycle.
 
 :func:`validate` asserts the contract (tests + /statusz);
@@ -42,6 +46,7 @@ CORE_KEYS = {
     "capacity": dict,
     "config": dict,
     "resilience": dict,
+    "perf": dict,
     "running": bool,
     "stopped": bool,
 }
@@ -56,10 +61,13 @@ def engine_stats(engine, counters, *, queue_depth, completed, running,
     flat keys) merge in first, so the shared vocabulary always wins a
     key collision — the drift this helper exists to prevent.
     """
+    from . import perf as _perf
+
     stats = dict(counters)
     if extra:
         stats.update(extra)
     stats.update(
+        perf=_perf.summary_brief(),
         engine=str(engine),
         schema=SCHEMA_VERSION,
         queue_depth=int(queue_depth),

@@ -13,7 +13,11 @@ version the round-3 verdict asked for:
   the caches, counting exact touched bytes.
 
 Prints one JSON line with the best sustained numbers; these are THE
-capability ceilings later rooflines must cite.
+capability ceilings later rooflines must cite.  The tree's recorded
+copy of the last calibration lives in ``autotune.cost_model.CEILINGS``
+(the single table every MFU/roofline consumer imports — ISSUE 13); the
+output includes the measured-vs-recorded deltas so a recalibration run
+says immediately whether the table needs updating.
 """
 import json
 import os
@@ -185,6 +189,19 @@ def main():
         "best_tflops": max((r["tflops"] for r in matmul), default=None),
         "best_gb_s": max((r["gb_s"] for r in hbm), default=None),
     }
+    # measured vs the tree's recorded table (the basis every MFU number
+    # cites): large deltas mean cost_model.CEILINGS needs updating
+    from mxnet_tpu.autotune.cost_model import CEILINGS
+
+    recorded = {"matmul_tf_s": CEILINGS["matmul_tf_s"],
+                "hbm_gb_s": CEILINGS["hbm_gb_s"]}
+    out["recorded_ceilings"] = recorded
+    if out["best_tflops"]:
+        out["vs_recorded_matmul_pct"] = round(
+            100.0 * out["best_tflops"] / recorded["matmul_tf_s"], 1)
+    if out["best_gb_s"]:
+        out["vs_recorded_hbm_pct"] = round(
+            100.0 * out["best_gb_s"] / recorded["hbm_gb_s"], 1)
     print(json.dumps(out))
     return out
 

@@ -5,8 +5,10 @@ unanchored").
 Compiles the SAME graphs bench_all times and reads XLA's
 ``cost_analysis()['flops']``, then converts the recorded BENCH_ALL
 rates into achieved TF/s and percent of the chip's measured matmul
-ceiling (128.6 TF/s, PERF_NOTES.md) — so every headline number is
-relatable to the hardware, not free-floating.
+ceiling — imported from ``autotune.cost_model.CEILINGS``, the ONE
+calibrated table (ISSUE 13: three independently-stated ceilings made
+MFU numbers lie) — so every headline number is relatable to the
+hardware, not free-floating.
 
 Run anywhere (CPU fine: FLOP counts are graph properties; fusion noise
 is a few percent):  python tools/flops_anchor.py
@@ -20,7 +22,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
-MEASURED_MATMUL_TF = 128.6
+from mxnet_tpu.autotune.cost_model import MEASURED_MATMUL_TF  # noqa: E402
 
 
 def _graph_forward_flops(symbol, shapes):

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Perf-attribution smoke: 3-step fit -> waterfall + roofline + ledger.
+
+The end-to-end guard CI runs for the roofline-attribution layer
+(ISSUE 13, docs/perf_observability.md): train a tiny conv net for one
+epoch of 3 batches with MXNET_PERF on and assert
+
+* the step-time waterfall recorded one row per step and every row's
+  segments (data-wait + host + device + kvstore) sum EXACTLY to the
+  measured step wall;
+* the per-program roofline table is non-empty (analytic FLOPs/bytes,
+  per-op rows, measured device time, MFU%) and renders through
+  ``tools/perf_report.py`` / ``trace_report --roofline``;
+* a perf-ledger row appends, re-reads, and yields an ``ok`` verdict
+  against itself re-appended;
+* ``/statusz`` carries the perf section and ``/metrics`` exposes the
+  ``perf.mfu_pct`` / ``perf.hbm_util_pct`` gauges with HELP/TYPE lines.
+
+Usage: python tools/perf_smoke.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("MXNET_TELEMETRY", "1")
+    os.environ.setdefault("MXNET_PERF", "1")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    out = sys.argv[1] if len(sys.argv) > 1 else "perf_smoke.json"
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import exposition, metrics, perf
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import perf_report
+
+    perf.reset()
+    failures = []
+
+    # ------------------------------------------------- 3-step toy fit
+    rng = np.random.RandomState(0)
+    bs, steps = 16, 3
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1"),
+        act_type="relu")
+    f1 = mx.sym.FullyConnected(mx.sym.Flatten(c1), num_hidden=32,
+                               name="f1")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        f1, num_hidden=10, name="f2"), name="softmax")
+    x = rng.rand(bs * steps, 1, 12, 12).astype(np.float32)
+    y = rng.randint(0, 10, bs * steps).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=bs, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.05),))
+
+    # ------------------------------- waterfall: 3 rows, exact partition
+    falls = perf.waterfalls()
+    if len(falls) != steps:
+        failures.append("expected %d waterfall rows, got %d"
+                        % (steps, len(falls)))
+    for rec in falls:
+        parts = (rec["data_wait_s"] + rec["device_s"] + rec["kvstore_s"]
+                 + rec["host_s"])
+        if abs(parts - rec["wall_s"]) > 1e-9:
+            failures.append("waterfall step %s: segments sum %.12f != "
+                            "wall %.12f" % (rec["step"], parts,
+                                            rec["wall_s"]))
+        if rec["host_s"] != rec["wall_s"] - (rec["data_wait_s"]
+                                             + rec["device_s"]
+                                             + rec["kvstore_s"]):
+            failures.append("waterfall step %s: host residual not exact"
+                            % rec["step"])
+
+    # --------------------------------- roofline table: non-empty, sane
+    programs = perf.program_table()
+    if not programs:
+        failures.append("program attribution table is empty")
+    for p in programs:
+        if p["flops"] <= 0 or p["hbm_bytes"] <= 0:
+            failures.append("program %s has no analytic cost" % p["graph"])
+        if not p.get("ops_top"):
+            failures.append("program %s has no per-op roofline rows"
+                            % p["graph"])
+        if p["runs"] and p.get("mfu_pct") is None:
+            failures.append("program %s measured runs but no MFU"
+                            % p["graph"])
+    section = perf.summary()
+    rendered = perf_report.format_roofline(section, "live")
+    if "roofline attribution" not in rendered:
+        failures.append("perf_report roofline rendering failed")
+    print(rendered)
+    print()
+    print(perf_report.format_waterfall(section, "live"))
+
+    # ------------------------------------- ledger append/read/verdict
+    tmp = tempfile.mkdtemp(prefix="perf_smoke_")
+    ledger = os.path.join(tmp, "BENCH_LEDGER.jsonl")
+    row = {"ts": "smoke", "quick": True,
+           "fingerprint": {"device": "cpu"},
+           "benches": {"toy_fit": {"value": 1.0, "unit": "x"}},
+           "programs": [{k: p[k] for k in ("graph", "mode", "flops",
+                                           "hbm_bytes", "roofline_ms",
+                                           "residual")}
+                        for p in programs],
+           "waterfall": perf.last_waterfall()}
+    perf.append_ledger(row, ledger)
+    perf.append_ledger(row, ledger)
+    rows = perf.read_ledger(ledger)
+    if len(rows) != 2:
+        failures.append("ledger round-trip: wrote 2 rows, read %d"
+                        % len(rows))
+    verdict = perf.ledger_verdict(rows)
+    if verdict["verdict"] != "ok":
+        failures.append("self-identical ledger rows verdicted %r"
+                        % verdict)
+    bad = dict(rows[-1])
+    bad["programs"] = [dict(p, flops=p["flops"] + 1)
+                       for p in bad["programs"]]
+    drift = perf.ledger_verdict(rows + [bad])
+    if drift["verdict"] != "regression":
+        failures.append("analytic-flops drift not flagged: %r" % drift)
+
+    # ----------------------------------- exposition: /statusz, /metrics
+    port = exposition.start_http(0)
+    try:
+        def get(path):
+            r = urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path), timeout=10)
+            return r.read().decode()
+
+        statusz = json.loads(get("/statusz"))
+        pz = statusz.get("perf") or {}
+        if pz.get("mfu_pct") is None or not pz.get("waterfall"):
+            failures.append("/statusz perf section incomplete: %r" % pz)
+        if not (statusz.get("providers") or {}).get("perf"):
+            failures.append("/statusz providers.perf missing")
+        prom = get("/metrics")
+        for family in ("mxnet_perf_mfu_pct", "mxnet_perf_hbm_util_pct"):
+            if "# TYPE %s gauge" % family not in prom:
+                failures.append("%s TYPE line missing from /metrics"
+                                % family)
+            if "# HELP %s" % family not in prom:
+                failures.append("%s HELP line missing from /metrics"
+                                % family)
+            if '%s{scope="step"}' % family not in prom:
+                failures.append("%s step child missing from /metrics"
+                                % family)
+    finally:
+        exposition.stop_http()
+
+    payload = {
+        "steps": steps,
+        "waterfalls": falls,
+        "programs": [{k: v for k, v in p.items() if k != "ops_top"}
+                     for p in programs],
+        "ledger_rows": len(rows),
+        "verdict": verdict,
+        "failures": failures,
+    }
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1, default=repr)
+    if failures:
+        print("PERF SMOKE FAILED:\n  - " + "\n  - ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("perf smoke OK: %d steps, %d programs, ledger verdict ok (%s)"
+          % (steps, len(programs), out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,12 @@ command:
 
 ``--compare`` prints a per-name regression diff (total-ms delta, sorted
 by |delta|) between two traces — the artifact a perf PR should paste to
-prove its claim.
+prove its claim.  With ``--perf`` it instead diffs the two sources'
+roofline-attribution sections (MFU + waterfall-segment delta columns;
+accepts flight-recorder dumps or ``BENCH_LEDGER.jsonl[:N]`` rows).
+``--roofline DUMP`` / ``--waterfall DUMP`` print a dump's per-op
+roofline table (ranked fusion candidates) and per-step wall-time
+waterfall (tools/perf_report.py renders; docs/perf_observability.md).
 
 Accepted inputs: a ``.json`` trace, a ``.json.gz`` / ``.gz`` trace, or a
 directory that contains one (searched recursively, newest wins — the
@@ -547,6 +552,22 @@ def main(argv=None):
                          "TTFT/ITL/queue-wait percentile table + the "
                          "slowest request's full span timeline; with "
                          "--compare, per-kind percentile deltas")
+    ap.add_argument("--roofline", metavar="DUMP",
+                    help="print the perf provider section of a "
+                         "flight-recorder dump as a roofline table: "
+                         "per-program achieved-vs-roofline MFU, per-op "
+                         "intensity rows and ranked fusion candidates "
+                         "(tools/perf_report.py renders)")
+    ap.add_argument("--waterfall", metavar="DUMP",
+                    help="print the per-step wall-time waterfall "
+                         "(data-wait/host/device/kvstore, summing to the "
+                         "step wall) from a flight-recorder dump's perf "
+                         "section")
+    ap.add_argument("--perf", action="store_true",
+                    help="with --compare: diff the two sources' perf "
+                         "sections instead (MFU + waterfall-segment "
+                         "delta columns; accepts dumps or "
+                         "BENCH_LEDGER.jsonl[:N] rows)")
     ap.add_argument("--graph-passes", metavar="DUMP",
                     help="print the graph_pass provider section of a "
                          "flight-recorder dump (per-program pass summary: "
@@ -560,6 +581,32 @@ def main(argv=None):
                     help="emit rows as JSON instead of a table")
     args = ap.parse_args(argv)
 
+    if args.roofline or args.waterfall:
+        try:
+            import perf_report
+        except ImportError:
+            from tools import perf_report
+
+        spec = args.roofline or args.waterfall
+        section = perf_report.load_perf_section(spec)
+        if args.json:
+            print(json.dumps(section, indent=1))
+            return 0
+        if args.roofline:
+            print(perf_report.format_roofline(section, spec))
+        if args.waterfall:
+            print(perf_report.format_waterfall(section, spec))
+        return 0
+    if args.compare and args.perf:
+        try:
+            import perf_report
+        except ImportError:
+            from tools import perf_report
+
+        cmp = perf_report.compare_perf(*args.compare)
+        print(json.dumps(cmp, indent=1) if args.json
+              else perf_report.format_compare_perf(cmp))
+        return 0
     if args.input_pipeline:
         with open(args.input_pipeline) as f:
             payload = json.load(f)
