@@ -35,7 +35,7 @@ __all__ = ["cache", "registry", "cost_model", "search",
            "tune_and_record", "mode", "enabled",
            "tune_flash_attention", "tune_serving_buckets", "tune_layout",
            "tune_remat", "tune_generation", "tune_generation_kv",
-           "tune_quantize_layers", "tune_input_pipeline",
+           "tune_quantize_layers", "tune_input_pipeline", "tune_control",
            "flash_shape_key"]
 
 
@@ -114,6 +114,29 @@ declare(
         "recurrence. tune_generation_kv arbitrates the candidates "
         "against a measured token-agreement budget vs the model-dtype "
         "decode.")
+# serving-control-plane knobs (ISSUE 14): consulted by the generation
+# engine at construction (explicit GenerationConfig arg > tuning cache
+# > MXNET_GEN_* flag), measured by tuners.tune_control. Declared here
+# at package import — the graph.layout precedent — because the engine
+# loads lazily.
+declare(
+    "control.prefix_pages",
+    space=lambda ctx: {"prefix_pages": tuple(sorted(set(
+        max(1, int(ctx.get("pool_pages", 64)) * f // 8)
+        for f in (1, 2, 4, 8)))) or (8,)},
+    default=_flag_default("prefix_pages", "MXNET_GEN_PREFIX_PAGES"),
+    doc="Prefix-cache capacity in KV pages (serving/control/): a larger "
+        "cache keeps more cold prefixes resident (higher hit rate) but "
+        "competes with live sequences for pool pages — admission "
+        "pressure reclaims cached pages LRU-first either way.")
+declare(
+    "control.slo_aging",
+    space={"aging_ms": (0, 100, 250, 500, 1000, 2000)},
+    default=_flag_default("aging_ms", "MXNET_GEN_SLO_AGING_MS"),
+    doc="SLO-admission aging interval in ms: queue wait per one-tier "
+        "effective-priority boost (starvation bound of weighted "
+        "admission). 0 = strict priority, small values converge toward "
+        "FIFO, large values toward strict tiers.")
 declare(
     "quantize.layers",
     space={},
@@ -213,7 +236,8 @@ def __getattr__(name):
     if name in ("tune_flash_attention", "tune_serving_buckets",
                 "tune_layout", "tune_remat", "tune_generation",
                 "tune_generation_kv", "tune_quantize_layers",
-                "tune_input_pipeline", "pipeline_replay_measurer",
+                "tune_input_pipeline", "tune_control",
+                "control_replay_measurer", "pipeline_replay_measurer",
                 "generation_replay_measurer", "flash_shape_key", "tuners"):
         import importlib
 

@@ -30,9 +30,9 @@ from .search import SearchConfig, median_time, search
 __all__ = ["flash_shape_key", "tune_flash_attention",
            "serving_replay_measurer", "tune_serving_buckets",
            "tune_layout", "tune_remat", "tune_generation",
-           "tune_generation_kv", "tune_quantize_layers",
-           "generation_replay_measurer", "pipeline_replay_measurer",
-           "tune_input_pipeline", "auto_tune"]
+           "tune_generation_kv", "tune_quantize_layers", "tune_control",
+           "generation_replay_measurer", "control_replay_measurer",
+           "pipeline_replay_measurer", "tune_input_pipeline", "auto_tune"]
 
 
 from .cost_model import pow2_at_least as _pow2_at_least
@@ -290,6 +290,108 @@ def tune_generation(model, params, prompts=None, max_new=8, max_batch=4,
     cache.record("generation.decode_blocks", key, res_b.best,
                  ms=res_b.best_s * 1e3, trials=res_b.measured)
     out["generation.decode_blocks"] = res_b.best
+    return out
+
+
+def control_replay_measurer(model, params, prompts=None, shared_prefix=32,
+                            max_new=8, max_batch=4, max_seq=128,
+                            fixed=None, repeats=2, warmup=1):
+    """``measure(candidate)`` for the serving-control-plane knobs
+    (ISSUE 14): build a live Generator with the prefix cache ON and the
+    candidate knob (merged over ``fixed``), replay a shared-prefix
+    prompt sample TWICE — the first pass seeds the radix tree on
+    eviction, the second serves from it — and return median wall
+    seconds. Shared by :func:`tune_control` and ``bench_all.py
+    --control`` so search and benchmark measure the same protocol."""
+    from ..serving.generation import (GenerationConfig, Generator,
+                                      SamplingParams)
+
+    if prompts is None:
+        vocab = int(model.cfg["vocab"])
+        rng = np.random.RandomState(0)
+        head = [int(t) for t in rng.randint(1, vocab, size=shared_prefix)]
+        top = max(1, max_seq - max_new - shared_prefix)
+        prompts = [head + [int(t) for t in rng.randint(
+            1, vocab, size=1 + (n % top))] for n in (3, 9, 17, 29)]
+
+    # knob fields -> GenerationConfig keyword names
+    _ARGS = {"prefix_pages": "prefix_pages", "aging_ms": "slo_aging_ms"}
+
+    # the replay is mixed-class so the aging knob is semantically LIVE
+    # during its own search (on a single-class workload every aging
+    # candidate would produce an identical schedule and noise would
+    # pick the recorded winner)
+    _TIERS = ("interactive", "standard", "batch")
+
+    def measure(c):
+        merged = dict(fixed or {})
+        merged.update(c)
+        kw = {_ARGS.get(k, k): v for k, v in merged.items()}
+        gen = Generator(model, params,
+                        GenerationConfig(max_batch=max_batch,
+                                         max_seq=max_seq,
+                                         prefix_cache=True, **kw))
+        try:
+            gen.warmup()
+            sp = SamplingParams(max_new_tokens=max_new)
+
+            def run():
+                for _ in range(2):  # pass 1 seeds, pass 2 hits
+                    handles = [gen.submit(p, sp, slo=_TIERS[i % 3])
+                               for i, p in enumerate(prompts)]
+                    for h in handles:
+                        h.result(timeout=300)
+
+            return median_time(run, repeats=repeats, warmup=warmup)
+        finally:
+            gen.stop(drain=True)
+
+    return measure
+
+
+def tune_control(model, params, prompts=None, shared_prefix=32, max_new=8,
+                 max_batch=4, max_seq=128, trials=None, measure=None):
+    """Measured search over the serving control plane's two knobs —
+    ``control.prefix_pages`` (prefix-cache capacity) then
+    ``control.slo_aging`` (admission aging interval) at the winning
+    capacity — on a shared-prefix replay (the workload the cache
+    exists for). Records both under the generator's tuning key
+    (``generation_tune_key``) so a plain Generator construction picks
+    the winners up. Returns ``{op: value dict}``.
+
+    ``measure`` (tests/smoke) replaces the live-generator measurer:
+    ``measure(candidate) -> seconds``.
+    """
+    from ..serving.generation.engine import generation_tune_key
+
+    key = generation_tune_key(model, max_batch, max_seq)
+    # capacity candidates scale off the default pool geometry (the
+    # auto-sized pool at the flag-default 16-token page)
+    pool_pages = max_batch * (-(-max_seq // 16)) + 1
+    ctx = {"pool_pages": pool_pages}
+    cfg = SearchConfig(trials=trials, repeats=2, warmup=1)
+    out = {}
+
+    mk = measure if measure is not None else None
+    cap_measure = mk or control_replay_measurer(
+        model, params, prompts, shared_prefix=shared_prefix,
+        max_new=max_new, max_batch=max_batch, max_seq=max_seq,
+        repeats=cfg.repeats, warmup=cfg.warmup)
+    res_c = search(registry.get("control.prefix_pages"), cap_measure,
+                   ctx=ctx, cfg=cfg)
+    cache.record("control.prefix_pages", key, res_c.best,
+                 ms=res_c.best_s * 1e3, trials=res_c.measured)
+    out["control.prefix_pages"] = res_c.best
+
+    age_measure = mk or control_replay_measurer(
+        model, params, prompts, shared_prefix=shared_prefix,
+        max_new=max_new, max_batch=max_batch, max_seq=max_seq,
+        fixed=dict(res_c.best), repeats=cfg.repeats, warmup=cfg.warmup)
+    res_a = search(registry.get("control.slo_aging"), age_measure,
+                   ctx=ctx, cfg=cfg)
+    cache.record("control.slo_aging", key, res_a.best,
+                 ms=res_a.best_s * 1e3, trials=res_a.measured)
+    out["control.slo_aging"] = res_a.best
     return out
 
 

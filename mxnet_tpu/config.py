@@ -268,6 +268,36 @@ Flags currently honored:
     admission queue that stays full this long raises QueueFullError
     instead of blocking the caller indefinitely.
 
+``MXNET_GEN_DEADLINE_MS`` (default 0 = off)
+    Per-request queue deadline of the generation engine — the
+    ``MXNET_SERVING_DEADLINE_MS`` analog: a request still queued this
+    many ms after submit is failed with ``DeadlineExceeded`` *before*
+    prefill dispatch. An :class:`~mxnet_tpu.serving.control.SLOClass`
+    with its own ``deadline_ms`` overrides this default per class.
+
+``MXNET_GEN_PREFIX_CACHE`` (default 0 = off)
+    Serving control plane's radix-tree prefix cache
+    (serving/control/, docs/serving_control.md): 1 shares the KV pages
+    of page-aligned common prompt prefixes across requests
+    (copy-on-write, refcounted), so a repeated system prompt prefills
+    once and later requests prefill only their suffix. Opt-in: a cold
+    engine keeps the original prefill numeric path bit-for-bit.
+
+``MXNET_GEN_PREFIX_PAGES`` (default 0 = pool-bounded)
+    Prefix-cache capacity in KV pages; beyond it insertion evicts
+    least-recently-matched leaves. 0 bounds the cache only by the pool
+    itself (admission pressure reclaims cached pages LRU-first either
+    way). Resolution: ``GenerationConfig(prefix_pages=...)`` >
+    ``control.prefix_pages`` tuning-cache entry > this flag.
+
+``MXNET_GEN_SLO_AGING_MS`` (default 500)
+    Starvation bound of SLO-class admission: every this-many ms of
+    queue wait boosts a request's effective priority by one tier, so a
+    low-priority class eventually outranks fresh high-priority
+    arrivals. 0 disables aging (strict priority). Resolution:
+    ``GenerationConfig(slo_aging_ms=...)`` > ``control.slo_aging``
+    tuning-cache entry > this flag.
+
 ``MXNET_IO_STREAMING`` (default 0)
     Backend switch of the ``ImageRecordIter`` factory (runtime/,
     docs/data_pipeline.md): 1 returns the async streaming pipeline
@@ -391,6 +421,10 @@ _DEFAULTS = {
     "MXNET_GEN_POOL_PAGES": 0,
     "MXNET_GEN_QUEUE": 64,
     "MXNET_GEN_SUBMIT_TIMEOUT": 0,
+    "MXNET_GEN_DEADLINE_MS": 0,
+    "MXNET_GEN_PREFIX_CACHE": 0,
+    "MXNET_GEN_PREFIX_PAGES": 0,
+    "MXNET_GEN_SLO_AGING_MS": 500,
     "MXNET_RETRY_MAX": 3,
     "MXNET_RETRY_BASE_MS": 10,
     "MXNET_RETRY_MAX_MS": 2000,

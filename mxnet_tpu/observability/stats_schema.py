@@ -53,13 +53,18 @@ CORE_KEYS = {
 
 
 def engine_stats(engine, counters, *, queue_depth, completed, running,
-                 stopped, capacity, config, resilience, provenance=None,
-                 extra=None):
+                 stopped, capacity, config, resilience, control=None,
+                 provenance=None, extra=None):
     """Assemble one schema-conforming snapshot.
 
     ``counters`` (the engine's raw counter dict) and ``extra`` (legacy
     flat keys) merge in first, so the shared vocabulary always wins a
     key collision — the drift this helper exists to prevent.
+
+    ``control`` (optional) is the serving control plane's section
+    (ISSUE 14): prefix-cache hit accounting, per-SLO-class queue
+    depths, COW/sharing page counts — surfaced on /statusz when
+    present.
     """
     from . import perf as _perf
 
@@ -79,6 +84,8 @@ def engine_stats(engine, counters, *, queue_depth, completed, running,
         resilience=dict(resilience),
         running=bool(running),
         stopped=bool(stopped))
+    if control is not None:
+        stats["control"] = dict(control)
     if provenance is not None:
         stats["graph_pass"] = provenance
     return stats
@@ -105,8 +112,12 @@ def validate(stats):
 
 def summarize(stats):
     """The compact /statusz engine row: shared core + the capacity and
-    resilience dicts (already small), none of the legacy flat keys."""
+    resilience dicts (already small), plus the control-plane section
+    when the engine carries one — none of the legacy flat keys."""
     validate(stats)
-    return {k: stats[k] for k in ("engine", "queue_depth", "requests",
-                                  "completed", "rejected", "running",
-                                  "stopped", "capacity", "resilience")}
+    out = {k: stats[k] for k in ("engine", "queue_depth", "requests",
+                                 "completed", "rejected", "running",
+                                 "stopped", "capacity", "resilience")}
+    if "control" in stats:
+        out["control"] = stats["control"]
+    return out

@@ -146,7 +146,7 @@ class TransformerParallel:
         return logits
 
     # --- incremental decode (generation subsystem) ------------------------
-    def prefill_forward(self, params, tokens):
+    def prefill_forward(self, params, tokens, attend=None):
         """Full causal forward over a (B, T) prompt that ALSO returns the
         per-layer K/V it computed — the prefill half of the generation
         subsystem's prefill/decode split (serving/generation/).
@@ -161,6 +161,16 @@ class TransformerParallel:
         elsewhere — the same fp32 softmax discipline as
         :func:`~.flash_attention.paged_decode_attention`, so incremental
         decode reproduces this forward token-exactly.
+
+        ``attend(li, q, k, v) -> (B, H, T, hd)`` (optional) replaces the
+        per-layer attention — the serving control plane's suffix prefill
+        passes a hook that additionally attends to a cached prompt
+        prefix in the paged KV pool (docs/serving_control.md); this
+        model has no positional encoding, so suffix tokens need no
+        position offset, only the hook's extended key set. The layer
+        math around the hook (projections, MoE FFN, norms) stays THE
+        shared implementation, so training checkpoints serve unchanged
+        on every path.
         """
         import jax.numpy as jnp
 
@@ -175,7 +185,8 @@ class TransformerParallel:
             ks.append(k)
             vs.append(v)
             q, k, v = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
-            att = _prefill_attention(q, k, v)
+            att = (_prefill_attention(q, k, v) if attend is None
+                   else attend(li, q, k, v))
             att = att.transpose(0, 2, 1, 3).reshape(B, T, d)
             x = x + att @ params[p + "wo"]
             x = x + self._moe_ffn(params, p, x)

@@ -1,0 +1,11 @@
+"""Serving control plane (ISSUE 14; docs/serving_control.md): a
+radix-tree prefix cache sharing KV pages copy-on-write across requests
+with a common prompt prefix, plus SLO-class (deadline + priority tier)
+weighted admission with aging — layered over the generation engine's
+PagePool and continuous-batching scheduler. The path to disaggregated
+prefill/decode serving (ROADMAP item 5) runs through this machinery."""
+from .prefix_cache import PrefixCache
+from .slo import BUILTIN_CLASSES, ClassQueue, SLOClass, resolve_class
+
+__all__ = ["PrefixCache", "SLOClass", "ClassQueue", "resolve_class",
+           "BUILTIN_CLASSES"]

@@ -1,14 +1,18 @@
 """Autoregressive generation subsystem (ISSUE 7; docs/generation.md):
 paged KV cache, prefill/decode split, continuous-batching scheduler,
 seeded sampling — checkpoint in, token streams out, with compile count
-bounded by the prefill bucket ladder plus ONE decode program."""
-from .engine import (GenerationConfig, GenerationHandle, Generator,
-                     QueueFullError, ServerClosedError,
+bounded by the prefill bucket ladder plus ONE decode program. The
+serving control plane (ISSUE 14; docs/serving_control.md) layers a
+radix-tree prefix cache (COW-shared KV pages) and SLO-class weighted
+admission on top."""
+from ..control import PrefixCache, SLOClass
+from .engine import (DeadlineExceeded, GenerationConfig, GenerationHandle,
+                     Generator, QueueFullError, ServerClosedError,
                      default_prefill_ladder)
 from .kv_cache import PagePool
 from .sampling import SamplingParams, sample_tokens
 
 __all__ = ["Generator", "GenerationConfig", "GenerationHandle",
-           "SamplingParams", "PagePool", "sample_tokens",
-           "default_prefill_ladder", "QueueFullError",
-           "ServerClosedError"]
+           "SamplingParams", "PagePool", "PrefixCache", "SLOClass",
+           "sample_tokens", "default_prefill_ladder", "QueueFullError",
+           "ServerClosedError", "DeadlineExceeded"]

@@ -44,8 +44,10 @@ import numpy as np
 from ...config import get_flag
 from ...observability import request_trace as _rtrace
 from ...observability import stats_schema as _schema
-from ...resilience import faults as _faults
+from ...resilience import DeadlineExceeded, faults as _faults
 from ..buckets import pick_bucket
+from ..control import PrefixCache, SLOClass, resolve_class
+from ..control.slo import ClassQueue
 from ..engine import QueueFullError, ServerClosedError
 from .kv_cache import PagePool
 from .sampling import SamplingParams, sample_tokens
@@ -58,7 +60,8 @@ _faults.declare("generation.decode_step",
                     "before the compiled step dispatches")
 
 __all__ = ["GenerationConfig", "Generator", "GenerationHandle",
-           "SamplingParams", "QueueFullError", "ServerClosedError"]
+           "SamplingParams", "SLOClass", "QueueFullError",
+           "ServerClosedError", "DeadlineExceeded"]
 
 # the generation.page_size / generation.decode_blocks / generation.
 # kv_dtype knobs this engine consults (explicit config arg > tuning
@@ -115,7 +118,8 @@ class GenerationConfig:
     def __init__(self, page_size=None, decode_blocks=None, max_batch=None,
                  max_seq=None, pool_pages=None, prefill_buckets=None,
                  max_queue=None, backpressure=None, submit_timeout_ms=None,
-                 amp=None, kv_dtype=None):
+                 amp=None, kv_dtype=None, prefix_cache=None,
+                 prefix_pages=None, slo_aging_ms=None, deadline_ms=None):
         import os
 
         # None = follow the graph-pass layer (amp in MXNET_GRAPH_PASSES);
@@ -164,6 +168,27 @@ class GenerationConfig:
         if self.submit_timeout_ms < 0:
             raise ValueError("submit_timeout_ms must be >= 0 (0 = no "
                              "timeout)")
+        # ---- serving control plane (ISSUE 14) ----
+        # radix-tree prefix cache: opt-in (MXNET_GEN_PREFIX_CACHE) — a
+        # cold engine keeps the PR 7 prefill numeric path bit-for-bit
+        self.prefix_cache = (bool(get_flag("MXNET_GEN_PREFIX_CACHE"))
+                             if prefix_cache is None else bool(prefix_cache))
+        # None = resolve in Generator: explicit > tuning cache > flag
+        self.prefix_pages = (None if prefix_pages is None
+                             else int(prefix_pages))
+        self.slo_aging_ms = (None if slo_aging_ms is None
+                             else float(slo_aging_ms))
+        # default queue deadline for every SLO class that doesn't carry
+        # its own — the MXNET_SERVING_DEADLINE_MS analog (0 = off):
+        # expired-in-queue requests fail DeadlineExceeded BEFORE prefill
+        self.deadline_ms = (float(get_flag("MXNET_GEN_DEADLINE_MS"))
+                            if deadline_ms is None else float(deadline_ms))
+        if self.deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0 (0 = no deadline)")
+        if self.prefix_pages is not None and self.prefix_pages < 0:
+            raise ValueError("prefix_pages must be >= 0 (0 = pool-bounded)")
+        if self.slo_aging_ms is not None and self.slo_aging_ms < 0:
+            raise ValueError("slo_aging_ms must be >= 0 (0 = no aging)")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.max_seq < 2:
@@ -246,13 +271,14 @@ class GenerationHandle:
 class _Seq:
     """Scheduler-side state of one admitted sequence (slot-resident)."""
 
-    __slots__ = ("handle", "prompt_len", "params", "tokens", "worst",
-                 "t_submit", "t_first", "t_last", "trace")
+    __slots__ = ("handle", "prompt", "prompt_len", "params", "tokens",
+                 "worst", "t_submit", "t_first", "t_last", "trace", "slo")
 
-    def __init__(self, handle, prompt_len, params, worst, t_submit,
-                 trace=_rtrace.NOOP_TRACE):
+    def __init__(self, handle, prompt, params, worst, t_submit,
+                 trace=_rtrace.NOOP_TRACE, slo=None):
         self.handle = handle
-        self.prompt_len = prompt_len
+        self.prompt = prompt          # token list (prefix-cache insert)
+        self.prompt_len = len(prompt)
         self.params = params          # SamplingParams
         self.worst = worst            # worst-case cached tokens (pages)
         self.tokens = []              # generated so far
@@ -260,10 +286,12 @@ class _Seq:
         self.t_first = None
         self.t_last = None            # last token instant (ITL)
         self.trace = trace            # RequestTrace (submit -> evict)
+        self.slo = slo if slo is not None else resolve_class(None)
 
 
 _Pending = collections.namedtuple(
-    "_Pending", ["prompt", "params", "handle", "t_submit", "trace"])
+    "_Pending", ["prompt", "params", "handle", "t_submit", "trace",
+                 "slo", "deadline"])
 
 # every live generator, GC-pruned — ONE "generation" flight-recorder
 # provider walks them (same discipline as serving._live_servers)
@@ -372,6 +400,23 @@ class Generator:
                              bytes_per_token=bytes_per_token,
                              kv_dtype=self.kv_dtype)
 
+        # ---- serving control plane (ISSUE 14) -------------------------
+        # prefix cache: radix tree over page-aligned token blocks sharing
+        # KV pages COW across requests (serving/control/prefix_cache.py)
+        self._use_prefix = bool(cfg.prefix_cache)
+        if self._use_prefix:
+            cap = self._resolve("control.prefix_pages", "prefix_pages",
+                                cfg.prefix_pages, "MXNET_GEN_PREFIX_PAGES",
+                                minimum=0)
+            self.prefix_cache = PrefixCache(self.pool, capacity_pages=cap)
+        else:
+            self.prefix_cache = None
+        # SLO admission: priority tiers with aging between decode steps
+        # (serving/control/slo.py); aging_ms = 0 disables the boost
+        self._aging_ms = self._resolve("control.slo_aging", "aging_ms",
+                                       cfg.slo_aging_ms,
+                                       "MXNET_GEN_SLO_AGING_MS", minimum=0)
+
         # committed to the model's device: an UNcommitted fresh pool
         # would carry a different sharding signature than the compiled
         # programs' outputs and cost one spurious recompile per bucket
@@ -399,7 +444,9 @@ class Generator:
         self._slots = [None] * S      # _Seq per occupied slot
 
         self._cond = threading.Condition()
-        self._queue = collections.deque()   # guarded-by: self._cond
+        # per-SLO-class FIFO queues with priority + aging selection —
+        # FIFO within a class, weighted admission between classes
+        self._queue = ClassQueue(aging_ms=self._aging_ms)  # guarded-by: self._cond
         self._stop = False                  # guarded-by: self._cond
         self._abort = False                 # guarded-by: self._cond
         self._n_active = 0                  # guarded-by: self._cond
@@ -474,8 +521,10 @@ class Generator:
         with self._pages_lock:
             self._pools = self._fresh_pools()
 
-    def _resolve(self, op, field, explicit, flag):
-        """Knob resolution: explicit config arg > tuning cache > flag."""
+    def _resolve(self, op, field, explicit, flag, minimum=1):
+        """Knob resolution: explicit config arg > tuning cache > flag.
+        ``minimum`` bounds what a cache entry may supply (the control
+        knobs accept 0 = off/unbounded; the geometry knobs don't)."""
         if explicit is not None:
             return int(explicit)
         from ... import autotune
@@ -484,7 +533,7 @@ class Generator:
         if isinstance(tuned, dict):
             try:
                 val = int(tuned.get(field))
-                if val > 0:
+                if val >= minimum:
                     return val
             except (TypeError, ValueError):
                 pass  # corrupt cache entry: tuning is an optimization
@@ -535,19 +584,114 @@ class Generator:
             pools["v"] = pools["v"].at[:, dest, off].set(v_new.astype(dt))
         return pools
 
-    def _prefill_step(self, params, pools, tokens, length,
-                      page_row, key, temp, top_k):
-        """ONE compiled program per prompt bucket: full causal forward,
-        prompt K/V scattered into the paged cache, first token sampled.
-        ``tokens``: (1, bucket) int32; ``page_row``: (max_pages,) int32
-        (0-padded — unallocated positions scatter to the trash page)."""
+    def _suffix_attend(self, pools, page_row, prefix_len):
+        """Attention hook for the control plane's suffix prefill: each
+        suffix query attends the cached prefix — gathered from the paged
+        pool through this slot's page row, masked to ``prefix_len`` —
+        plus the causal suffix itself. Scores, softmax and the PV
+        contraction accumulate in fp32 (the subsystem-wide discipline),
+        and int8 pools dequantize on gather exactly like
+        ``paged_decode_attention``. ``prefix_len == 0`` (a cache miss,
+        or warmup) masks the whole gathered region, so ONE compiled
+        program per bucket serves hit and miss traffic alike — the
+        compile-count contract stays ``len(prefill_buckets) + 1``.
+        The flip side: a cache-enabled engine's MISSES also pay the
+        masked prefix-region gather/scores (~bucket x max_seq extra per
+        layer), which is why the cache is opt-in — no-sharing
+        workloads keep the lean cold program (docs/serving_control.md
+        "Miss-path cost")."""
+        import jax.numpy as jnp
+
+        max_ctx = self._max_pages * self.page_size
+        quant = self._quant_kv
+
+        def attend(li, q, k, v):
+            T, hd = q.shape[2], q.shape[3]
+            kp = pools["k"][li][page_row].reshape(max_ctx, -1, hd)
+            vp = pools["v"][li][page_row].reshape(max_ctx, -1, hd)
+            kp = kp.astype(jnp.float32)
+            vp = vp.astype(jnp.float32)
+            if quant:
+                kp = kp * pools["ks"][li][page_row].reshape(
+                    max_ctx, -1)[..., None]
+                vp = vp * pools["vs"][li][page_row].reshape(
+                    max_ctx, -1)[..., None]
+                # the fresh suffix K/V attend through the SAME
+                # quantize->dequantize round trip their pages will hold:
+                # a later request that reads these positions from the
+                # cache then sees bit-identical values, so warm-cache
+                # and cold-cache generations agree token-for-token even
+                # at int8 (the sharing-exactness contract)
+                kq, ksc = _quantize_kv(k)
+                vq, vsc = _quantize_kv(v)
+                k = kq.astype(jnp.float32) * ksc[..., None]
+                v = vq.astype(jnp.float32) * vsc[..., None]
+            else:
+                # same discipline for narrow non-quantized pools
+                # (kv_dtype="bfloat16" under an fp32 model): round-trip
+                # the fresh suffix K/V through the pages' storage dtype
+                # so warm- and cold-cache runs see identical values.
+                # A no-op when pool dtype == model dtype.
+                k = k.astype(pools["k"].dtype)
+                v = v.astype(pools["v"].dtype)
+            scale = float(1.0 / np.sqrt(hd))
+            qf = q.astype(jnp.float32) * scale
+            sp = jnp.einsum("bhqd,khd->bhqk", qf, kp)
+            live = jnp.arange(max_ctx, dtype=jnp.int32) < prefix_len
+            sp = jnp.where(live[None, None, None, :], sp, -jnp.inf)
+            ss = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32))
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            ss = jnp.where(causal[None, None], ss, -jnp.inf)
+            s = jnp.concatenate([sp, ss], axis=-1)
+            # every row's own (causal-diagonal) score is live -> the max
+            # is finite and the softmax denominator positive
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+            w = p / jnp.sum(p, axis=-1, keepdims=True)
+            out = (jnp.einsum("bhqk,khd->bhqd", w[..., :max_ctx], vp)
+                   + jnp.einsum("bhqk,bhkd->bhqd", w[..., max_ctx:],
+                                v.astype(jnp.float32)))
+            return out.astype(q.dtype)
+
+        return attend
+
+    def _prefill_step(self, params, pools, tokens, length, prefix_len,
+                      page_row, cow_src, cow_dst, key, temp, top_k):
+        """ONE compiled program per prompt bucket: causal forward over
+        the (suffix) tokens, K/V scattered into the paged cache, first
+        token sampled. ``tokens``: (1, bucket) int32; ``page_row``:
+        (max_pages,) int32 (0-padded — unallocated positions scatter to
+        the trash page).
+
+        With the prefix cache active, ``tokens`` holds only the SUFFIX
+        past the longest cached prefix: ``prefix_len`` global positions
+        are served read-only from shared pages through the attention
+        hook, and the ``cow_src -> cow_dst`` page copy privatizes the
+        last shared page before the one write that may land in it (the
+        page-aligned full-prefix-hit case; 0 -> 0 is a trash-page
+        no-op). Prefix length, like batch composition, is DATA — the
+        compile count stays ``len(prefill_buckets) + 1``."""
         import jax.numpy as jnp
 
         bucket = tokens.shape[1]
-        logits, ks, vs = self._model.prefill_forward(params, tokens)
+        if self._use_prefix:
+            pools = {n: a.at[:, cow_dst].set(a[:, cow_src])
+                     for n, a in pools.items()}
+            attend = self._suffix_attend(pools, page_row, prefix_len)
+        else:
+            attend = None  # cold engines keep the PR 7 path bit-for-bit
+        logits, ks, vs = self._model.prefill_forward(params, tokens,
+                                                     attend=attend)
         logits = logits.astype(jnp.float32)  # fp32 sampling island
-        pos = jnp.arange(bucket, dtype=jnp.int32)
-        dest = page_row[pos // self.page_size]
+        pos = prefix_len + jnp.arange(bucket, dtype=jnp.int32)
+        pidx = pos // self.page_size
+        # padded suffix rows past the page table scatter to the trash
+        # page (a suffix bucket may overhang max_seq when the prefix is
+        # long; page_row is 0 beyond the owned pages either way)
+        dest = jnp.where(pidx < self._max_pages,
+                         page_row[jnp.minimum(pidx, self._max_pages - 1)],
+                         0)
         off = pos % self.page_size
         pools = self._scatter_kv(pools, dest, off, ks[:, 0], vs[:, 0])
         last = logits[0, length - 1]
@@ -627,7 +771,8 @@ class Generator:
                 pools, tok, _ = self._prefill_jit(
                     self._params, self._pools,
                     np.zeros((1, bucket), np.int32), np.int32(1),
-                    np.zeros(self._max_pages, np.int32),
+                    np.int32(0), np.zeros(self._max_pages, np.int32),
+                    np.int32(0), np.int32(0),
                     np.zeros(2, np.uint32), np.float32(0), np.int32(0))
                 jax.block_until_ready(tok)
                 self._pools = pools
@@ -678,6 +823,12 @@ class Generator:
                     self._abandon_drain(timeout)
             elif self._queue or self._n_active:
                 self._loop()  # never started: honor the drain contract
+            if (self.prefix_cache is not None
+                    and (thread is None or not thread.is_alive())):
+                # scheduler down -> nothing can match again: release the
+                # cache's page references so a drained pool reports
+                # zero pages (assert_no_leaks holds after stop)
+                self.prefix_cache.clear()
         return self
 
     def _abandon_drain(self, timeout):
@@ -690,8 +841,8 @@ class Generator:
             "failed" % timeout)
         with self._cond:
             self._abort = True
-            stranded = list(self._queue)
-            self._queue.clear()
+            stranded = self._queue.drain()
+            self._class_gauges(self._queue.depths())
             self._cond.notify_all()
         for ent in stranded:
             ent.handle._fail(err)
@@ -714,14 +865,20 @@ class Generator:
         return self._thread is not None and self._thread.is_alive()
 
     # -------------------------------------------------------------- submit
-    def submit(self, prompt, params=None):
+    def submit(self, prompt, params=None, slo=None):
         """Enqueue one generation request; returns a
         :class:`GenerationHandle`. ``prompt``: iterable of int token
         ids; ``params``: :class:`SamplingParams` (default: greedy, 32
-        new tokens)."""
+        new tokens); ``slo``: an :class:`~..control.SLOClass`, a builtin
+        tier name (``"interactive"``/``"standard"``/``"batch"``), or
+        None for the standard tier — higher tiers preempt queue order
+        (never in-flight slots), the class deadline (or
+        ``MXNET_GEN_DEADLINE_MS``) sheds queue-expired requests with
+        :class:`DeadlineExceeded` before prefill."""
         from ...observability import metrics
 
         params = params if params is not None else SamplingParams()
+        slo_cls = resolve_class(slo)
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -742,13 +899,20 @@ class Generator:
                 "(raise MXNET_GEN_POOL_PAGES)"
                 % (self.pool.pages_for(worst), self.pool.capacity))
         handle = GenerationHandle()
-        # request-scoped trace (ISSUE 12): queue ends at admission,
-        # prefill ends at the first token (TTFT), one decode phase per
-        # generated token, finish at eviction/stream end
+        # request-scoped trace (ISSUE 12): queue ends at admission, a
+        # prefix_match phase covers the cache lookup, prefill ends at
+        # the first token (TTFT), one decode phase per generated token,
+        # finish at eviction/stream end
         trace = _rtrace.begin("generation")
         trace.annotate(prompt_len=len(prompt),
-                       max_new_tokens=params.max_new_tokens)
-        ent = _Pending(prompt, params, handle, time.monotonic(), trace)
+                       max_new_tokens=params.max_new_tokens,
+                       slo=slo_cls.name)
+        t_submit = time.monotonic()
+        dl_ms = (slo_cls.deadline_ms if slo_cls.deadline_ms is not None
+                 else self._cfg.deadline_ms)
+        deadline = (t_submit + dl_ms / 1e3) if dl_ms > 0 else None
+        ent = _Pending(prompt, params, handle, t_submit, trace,
+                       slo_cls, deadline)
         with self._cond:
             if self._stop:
                 trace.finish("rejected")
@@ -784,12 +948,29 @@ class Generator:
                         trace.finish("rejected")
                         raise ServerClosedError(
                             "server stopped while submit() was blocked")
-            self._queue.append(ent)
+            self._queue.push(ent)
+            depths = self._queue.depths()
             self._cond.notify_all()
         with self._lock:
             self._stats["requests"] += 1
         metrics.counter("generation.requests").inc()
+        metrics.counter("generation.slo_requests",
+                        labels={"slo": slo_cls.name},
+                        help="requests submitted per SLO class").inc()
+        self._class_gauges(depths)
         return handle
+
+    @staticmethod
+    def _class_gauges(depths):
+        """Refresh every per-class queue-depth gauge — called on each
+        queue transition (submit/admit/shed/drain) so an emptied class
+        reads 0 instead of its last nonzero depth forever."""
+        from ...observability import metrics
+
+        for name, depth in depths.items():
+            metrics.gauge("generation.slo_queue_depth",
+                          labels={"slo": name},
+                          help="queued requests per SLO class").set(depth)
 
     def generate(self, prompt, params=None, timeout=None):
         """Synchronous convenience: ``submit(...).result(timeout)``."""
@@ -805,8 +986,8 @@ class Generator:
                     self._cond.wait()
                 if self._stop:
                     if self._abort:
-                        aborted = list(self._queue)
-                        self._queue.clear()
+                        aborted = self._queue.drain()
+                        self._class_gauges(self._queue.depths())
                         self._cond.notify_all()
                     elif not self._queue and not self._n_active:
                         return
@@ -846,23 +1027,90 @@ class Generator:
                 return s
         return None
 
+    def _shed(self, expired):
+        """Fail queue-expired requests with DeadlineExceeded BEFORE any
+        prefill dispatch (the serving-engine shedding semantics): a
+        backlogged generator stops burning prefill compute on answers
+        nobody is waiting for."""
+        from ...observability import metrics
+
+        now = time.monotonic()
+        for ent in expired:
+            ent.handle._fail(DeadlineExceeded(
+                "generation request expired in queue after %.0f ms "
+                "(class %r deadline)" % ((now - ent.t_submit) * 1e3,
+                                         ent.slo.name)))
+            ent.trace.finish("deadline_expired")
+            metrics.counter("generation.deadline_expired").inc()
+            metrics.counter("generation.slo_expired",
+                            labels={"slo": ent.slo.name},
+                            help="queue-expired requests per SLO class"
+                            ).inc()
+        with self._lock:
+            self._stats["expired"] += len(expired)
+
+    def _pressure_admit(self, ent, worst):
+        """The conservative ``can_admit(worst)`` gate failed — account
+        the sharing the request would actually get before reclaiming
+        anything. A PROBE match (counters untouched, refs dropped right
+        back — the scheduler thread is the only evictor, so the real
+        match in ``_prefill`` sees the same tree) supplies the
+        shared-page discount; only the remaining shortfall of COLD
+        cached prefixes is reclaimed LRU-first, so pressure never
+        shreds the very prefix a pending request is about to share.
+        Returns True when admission can proceed."""
+        if self.prefix_cache is None:
+            return False
+        for attempt in range(2):
+            shared, matched = self.prefix_cache.match(ent.prompt,
+                                                      record=False)
+            cow = matched > 0 and matched == len(ent.prompt)
+            n_shared = len(shared)
+            for p in shared:
+                self.pool.decref(p)
+            if self.pool.can_admit(worst, shared_pages=n_shared, cow=cow):
+                return True
+            if attempt or not self.prefix_cache.reclaim(
+                    self.pool.admission_shortfall(
+                        worst, shared_pages=n_shared, cow=cow)):
+                return False
+            # reclaim released something: re-probe (the probe's LRU
+            # bump shields this request's own chain, but a tiny cache
+            # may still have shrunk the match)
+        return False
+
     def _admit_pending(self):
         """Admit queued requests into free slots — between decode steps,
-        which is what makes the batching *continuous*."""
+        which is what makes the batching *continuous*. Admission order
+        is the SLO scheduler's (serving/control/slo.py): highest
+        effective priority (tier + aging boost) first, FIFO within a
+        class, queue-expired requests shed first; a pool full of cached
+        prefixes reclaims them under pressure instead of stalling."""
         while True:
+            with self._cond:
+                expired = self._queue.shed_expired(time.monotonic())
+                depths = self._queue.depths() if expired else None
+                if expired:
+                    self._cond.notify_all()  # queue space freed
+            if expired:
+                self._shed(expired)
+                self._class_gauges(depths)
             slot = self._free_slot()
             if slot is None:
                 return
             with self._cond:
-                if not self._queue:
+                ent = self._queue.select(time.monotonic())
+                if ent is None:
                     return
-                ent = self._queue[0]
                 worst = len(ent.prompt) + ent.params.max_new_tokens - 1
                 if not self.pool.can_admit(worst):
-                    return  # pages tight: decode on, eviction frees some
-                self._queue.popleft()
+                    if not self._pressure_admit(ent, worst):
+                        return  # decode on, eviction frees some pages
+                self._queue.pop(ent)
+                depths = self._queue.depths()
                 self._n_active += 1
                 self._cond.notify_all()  # wake blocked submitters
+            self._class_gauges(depths)
             try:
                 self._prefill(slot, ent, worst)
             except Exception as err:  # fail THIS request, not the thread
@@ -884,23 +1132,60 @@ class Generator:
         plen = len(ent.prompt)
         sp = ent.params
         ent.trace.event("queue")  # admission = end of queue wait
-        bucket = pick_bucket(plen, self._cfg.prefill_buckets)
-        pages = self.pool.admit(slot, plen, worst)
+        # --- prefix-cache match (control plane): longest cached page-
+        # aligned prefix attaches read-only; only the suffix prefills
+        shared, matched, cow = [], 0, False
+        if self.prefix_cache is not None:
+            shared, matched = self.prefix_cache.match(ent.prompt)
+            # a prompt that IS a cached page-aligned prefix still needs
+            # its last token recomputed (the suffix forward produces the
+            # first-token logits); that one write lands inside the last
+            # shared page -> copy-on-write privatizes it
+            cow = matched > 0 and matched == plen
+            ent.trace.annotate(prefix_hit=bool(matched),
+                               prefix_tokens=int(matched))
+            metrics.counter("generation.prefix_hits" if matched
+                            else "generation.prefix_misses").inc()
+            # the phase exists only on control-plane engines: cold
+            # engines keep the PR 12 queue/prefill/decode partition
+            ent.trace.event("prefix_match")
+        suffix_start = plen - 1 if cow else matched
+        suffix = ent.prompt[suffix_start:]
+        if suffix_start:
+            metrics.counter("generation.prefill_tokens_skipped").inc(
+                suffix_start)
+            with self._lock:
+                self._stats["prefix_hits"] += 1
+                self._stats["prefill_tokens_skipped"] += suffix_start
+        bucket = pick_bucket(len(suffix), self._cfg.prefill_buckets)
+        try:
+            pages = self.pool.admit(slot, plen, worst,
+                                    shared_pages=shared, cow_last=cow)
+        except BaseException:
+            for p in shared:
+                self.pool.decref(p)  # match's refs never reached a slot
+            raise
+        cow_src = cow_dst = 0
+        if cow:
+            cow_src, cow_dst = self.pool.cow(slot, len(shared) - 1)
+            pages = self.pool.pages_of(slot)
         row = np.zeros(self._max_pages, np.int32)
         row[:len(pages)] = pages
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :plen] = ent.prompt
+        tokens[0, :len(suffix)] = suffix
         key = np.asarray(jax.random.PRNGKey(sp.seed), np.uint32)
         with self._pages_lock:
             pools, tok, nkey = self._prefill_jit(
                 self._params, self._pools, tokens,
-                np.int32(plen), row, key, np.float32(sp.temperature),
-                np.int32(sp.top_k))
+                np.int32(len(suffix)), np.int32(suffix_start), row,
+                np.int32(cow_src), np.int32(cow_dst), key,
+                np.float32(sp.temperature), np.int32(sp.top_k))
             self._pools = pools
         # the ONE host sync of admission: the prompt's first token (this
         # is also the time-to-first-token mark)
         first = int(np.asarray(tok))  # graftlint: disable=G001 — admission-boundary fetch, not a hot-loop sync
-        seq = _Seq(ent.handle, plen, sp, worst, ent.t_submit, ent.trace)
+        seq = _Seq(ent.handle, ent.prompt, sp, worst, ent.t_submit,
+                   ent.trace, slo=ent.slo)
         seq.t_first = time.monotonic()
         seq.t_last = seq.t_first
         # prefill ends at the first sampled token — this instant IS the
@@ -949,6 +1234,17 @@ class Generator:
         from ...observability import metrics
 
         seq = self._slots[slot]
+        if failed is None and self.prefix_cache is not None:
+            # cold prefixes enter the tree on eviction: the prompt's
+            # full pages just served real traffic and hold position-
+            # exact K/V (decode writes never land below the prompt's
+            # last full page, so they stay pure-prompt content)
+            try:
+                self.prefix_cache.insert(seq.prompt,
+                                         self.pool.pages_of(slot))
+            except Exception:
+                with self._lock:
+                    self._stats["prefix_insert_errors"] += 1
         self._reset_slot(slot, seq.worst)
         with self._cond:
             self._n_active -= 1
@@ -959,6 +1255,9 @@ class Generator:
         else:
             seq.handle._finish(seq.tokens)
             seq.trace.finish("ok")
+            metrics.counter("generation.slo_completed",
+                            labels={"slo": seq.slo.name},
+                            help="completed requests per SLO class").inc()
         with self._lock:
             self._stats["evicted"] += 1
             if failed is None:
@@ -1027,11 +1326,24 @@ class Generator:
         shared core."""
         with self._cond:
             queued = len(self._queue)
+            class_depths = self._queue.depths()
             n_active = self._n_active
             stopped = self._stop
         with self._lock:
             counters = dict(self._stats)
         pool = self.pool.get_stats()
+        control = {
+            "slo": {"aging_ms": self._aging_ms,
+                    "deadline_ms": float(self._cfg.deadline_ms),
+                    "queues": class_depths,
+                    "expired": counters.get("expired", 0)},
+            "prefix_cache": (self.prefix_cache.get_stats()
+                             if self.prefix_cache is not None else None),
+            "prefill_tokens_skipped": counters.get(
+                "prefill_tokens_skipped", 0),
+            "pages_shared": pool["pages_shared"],
+            "cow_copies": pool["cow_copies"],
+        }
         return _schema.engine_stats(
             "generation", counters,
             queue_depth=queued,
@@ -1053,11 +1365,15 @@ class Generator:
                 "kv_dtype": self.kv_dtype,
                 "prefill_buckets": list(self._cfg.prefill_buckets),
                 "backpressure": self._cfg.backpressure,
+                "prefix_cache": self._use_prefix,
+                "slo_aging_ms": self._aging_ms,
+                "deadline_ms": float(self._cfg.deadline_ms),
             },
             resilience={
                 "decode_faults": counters.get("decode_faults", 0),
                 "drain_timeouts": counters.get("drain_timeouts", 0),
             },
+            control=control,
             provenance={"amp": bool(self._amp),
                         "kv_dtype": self.kv_dtype},
             extra={

@@ -20,6 +20,19 @@ treatment:
   mid-flight extension can never fail (no deadlock between growing
   sequences fighting for the last page).
 
+Since the serving control plane (ISSUE 14, serving/control/), pages are
+**reference-counted**: the prefix cache shares the full pages of a
+common prompt prefix between every request that matches it (and keeps
+its own reference so they survive eviction), so ``admit``/``release``/
+``extend`` are refcount-aware — a page returns to the free list only
+when its last reference drops. Shared pages are read-only by
+construction (a request's writes always land at positions past its
+shared prefix); the one place a write WOULD land in a shared page — a
+prompt that is exactly a page-aligned cached prefix, whose last token
+must be recomputed for logits — goes through :meth:`cow`: the slot gets
+a private copy of the page (copy-on-write), the shared original keeps
+serving other readers.
+
 Occupancy is exposed as the ``generation.kv_pages_used`` metrics gauge
 (refreshed on every alloc/free) and through the generation
 flight-recorder provider (engine.py), so a crash dump shows exactly who
@@ -33,7 +46,7 @@ __all__ = ["PagePool"]
 
 
 class PagePool:
-    """Host-side page allocator over a device page pool.
+    """Host-side refcounted page allocator over a device page pool.
 
     ``pool_pages`` counts the whole device pool including the reserved
     trash page 0, so ``capacity = pool_pages - 1`` pages are allocatable.
@@ -63,8 +76,11 @@ class PagePool:
         # device tiles are warm in whatever cache hierarchy applies)
         self._free = list(range(self.pool_pages - 1, 0, -1))  # guarded-by: self._lock
         self._owned = {}   # slot -> [page ids] in position order  # guarded-by: self._lock
+        self._refs = {}    # page id -> reference count (>= 1 iff allocated)  # guarded-by: self._lock
         self._reserved = 0  # worst-case pages promised to live slots  # guarded-by: self._lock
         self._peak = 0      # high-water of pages in use  # guarded-by: self._lock
+        self._cow_copies = 0    # cumulative copy-on-write privatizations  # guarded-by: self._lock
+        self._shared_admits = 0  # cumulative pages attached via sharing  # guarded-by: self._lock
 
     # ------------------------------------------------------------- queries
     @property
@@ -89,35 +105,78 @@ class PagePool:
         """Device bytes of the pages currently allocated."""
         return self.pages_used() * self.page_bytes
 
-    def can_admit(self, worst_case_tokens):
+    def refcount(self, page):
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def can_admit(self, worst_case_tokens, shared_pages=0, cow=False):
         """Would a sequence that may grow to ``worst_case_tokens`` ever
         be starved? Admission gate: free pages minus what live slots may
-        still claim must cover this sequence's worst case."""
-        need = self.pages_for(worst_case_tokens)
+        still claim must cover this sequence's worst case.
+        ``shared_pages`` pages it would attach from the prefix cache
+        never touch the free list; a ``cow`` privatization claims one
+        extra free page beyond the worst-case model."""
+        need = (self.pages_for(worst_case_tokens) - int(shared_pages)
+                + (1 if cow else 0))
         with self._lock:
             return len(self._free) - self._reserved >= need
 
+    def admission_shortfall(self, worst_case_tokens, shared_pages=0,
+                            cow=False):
+        """How many MORE free pages admission of this sequence needs —
+        the precise amount pressure-driven prefix-cache reclamation
+        should release (evicting a request's full worst case would
+        needlessly destroy cached prefixes under mild pressure).
+        ``shared_pages``/``cow`` mirror :meth:`can_admit`."""
+        need = (self.pages_for(worst_case_tokens) - int(shared_pages)
+                + (1 if cow else 0))
+        with self._lock:
+            return max(0, need - (len(self._free) - self._reserved))
+
     # ---------------------------------------------------------- allocation
-    def admit(self, slot, prompt_tokens, worst_case_tokens):
+    def admit(self, slot, prompt_tokens, worst_case_tokens,
+              shared_pages=(), cow_last=False):
         """Allocate-on-prefill: pages for the prompt now, a reservation
         for the rest. Returns the slot's page-id list (position order).
+
+        ``shared_pages``: prefix-cache pages covering the prompt's head,
+        ONE live reference each already held by the caller (the cache's
+        ``match`` increfs) — admit transfers those references to the
+        slot and allocates only the remaining fresh pages.
+        ``cow_last=True`` reserves one extra free page for the
+        :meth:`cow` privatization the caller will perform next (the
+        page-aligned full-prefix-hit case).
+
         Raises MemoryError when the admission gate would be violated —
-        callers check :meth:`can_admit` first, so this is a bug trap."""
+        callers check :meth:`can_admit` first, so this is a bug trap;
+        the caller still owns the shared references on failure."""
+        shared = list(shared_pages)
         n_now = self.pages_for(prompt_tokens)
         worst = self.pages_for(worst_case_tokens)
+        if len(shared) > n_now:
+            raise ValueError("%d shared pages exceed the %d the prompt "
+                             "occupies" % (len(shared), n_now))
+        need = worst - len(shared) + (1 if cow_last else 0)
         with self._lock:
             if slot in self._owned:
                 raise ValueError("slot %d already owns pages" % slot)
-            if len(self._free) - self._reserved < worst:
+            for p in shared:
+                if self._refs.get(p, 0) < 1:
+                    raise ValueError(
+                        "shared page %d has no live reference" % p)
+            if len(self._free) - self._reserved < need:
                 raise MemoryError(
                     "page pool overcommitted: %d free, %d reserved, "
-                    "%d needed" % (len(self._free), self._reserved, worst))
-            pages = [self._free.pop() for _ in range(n_now)]
-            self._owned[slot] = pages
+                    "%d needed" % (len(self._free), self._reserved, need))
+            fresh = [self._free.pop() for _ in range(n_now - len(shared))]
+            for p in fresh:
+                self._refs[p] = 1
+            self._owned[slot] = shared + fresh
+            self._shared_admits += len(shared)
             self._reserved += worst - n_now
             self._peak = max(self._peak, self.capacity - len(self._free))
         self._gauge()
-        return list(pages)
+        return list(self._owned[slot])
 
     def extend(self, slot):
         """Extend-on-decode: one more page for ``slot`` (its sequence
@@ -130,17 +189,74 @@ class PagePool:
                 raise MemoryError("page pool exhausted despite admission "
                                   "reservations (accounting bug)")
             page = self._free.pop()
+            self._refs[page] = 1
             self._owned[slot].append(page)
             self._reserved = max(0, self._reserved - 1)
             self._peak = max(self._peak, self.capacity - len(self._free))
         self._gauge()
         return page
 
+    def cow(self, slot, index):
+        """Copy-on-write: privatize the shared page at ``index`` of
+        ``slot``'s page list before a write lands in it. Returns
+        ``(src_page, dst_page)`` — the caller copies the device page
+        contents ``src -> dst`` (inside its compiled program) when they
+        differ. A page this slot is already the sole owner of needs no
+        copy (``src == dst``); a genuinely shared page is swapped for a
+        fresh one (the ``admit(cow_last=True)`` gate guaranteed it) and
+        the original keeps serving its other readers."""
+        with self._lock:
+            if slot not in self._owned:
+                raise ValueError("slot %d owns no pages" % slot)
+            pages = self._owned[slot]
+            old = pages[index]
+            if self._refs.get(old, 0) <= 1:
+                return old, old  # sole owner: write in place
+            if not self._free:
+                raise MemoryError("no free page for copy-on-write "
+                                  "(admit(cow_last=True) gate bypassed)")
+            new = self._free.pop()
+            self._refs[new] = 1
+            self._refs[old] -= 1
+            pages[index] = new
+            self._cow_copies += 1
+            self._peak = max(self._peak, self.capacity - len(self._free))
+        self._gauge()
+        return old, new
+
+    def incref(self, page):
+        """Add a reference to an allocated page (the prefix cache's
+        retain; readers via ``match``/``admit`` transfer these)."""
+        with self._lock:
+            if self._refs.get(page, 0) < 1:
+                raise ValueError("page %d is not allocated" % page)
+            self._refs[page] += 1
+
+    def decref(self, page):
+        """Drop one reference; the page returns to the free list when
+        the last reference drops. Returns True if the page was freed."""
+        freed = False
+        with self._lock:
+            refs = self._refs.get(page, 0)
+            if refs < 1:
+                raise ValueError("decref of unallocated page %d" % page)
+            if refs == 1:
+                del self._refs[page]
+                self._free.append(page)
+                freed = True
+            else:
+                self._refs[page] = refs - 1
+        if freed:
+            self._gauge()
+        return freed
+
     def release(self, slot, worst_case_tokens=0):
-        """Free-on-eviction: return all of ``slot``'s pages to the free
-        list and drop whatever admission reservation it never claimed
-        (``worst_case_tokens``: the same bound passed to :meth:`admit`).
-        Returns the number of pages freed."""
+        """Free-on-eviction: drop one reference on each of ``slot``'s
+        pages (pages the prefix cache or another reader still holds stay
+        allocated) and drop whatever admission reservation the slot
+        never claimed (``worst_case_tokens``: the same bound passed to
+        :meth:`admit`). Returns the number of pages actually freed."""
+        n_freed = 0
         with self._lock:
             pages = self._owned.pop(slot, None)
             if pages is None:
@@ -148,13 +264,20 @@ class PagePool:
                 # pages nor a reservation — dropping one here would
                 # steal another slot's
                 return 0
-            self._free.extend(reversed(pages))
+            for page in reversed(pages):
+                refs = self._refs.get(page, 0)
+                if refs <= 1:
+                    self._refs.pop(page, None)
+                    self._free.append(page)
+                    n_freed += 1
+                else:
+                    self._refs[page] = refs - 1
             # the slot's live reservation is worst-case pages minus the
             # pages it actually claimed (admit + extend both decrement)
             unused = max(0, self.pages_for(worst_case_tokens) - len(pages))
             self._reserved = max(0, self._reserved - unused)
         self._gauge()
-        return len(pages)
+        return n_freed
 
     def pages_of(self, slot):
         with self._lock:
@@ -171,9 +294,32 @@ class PagePool:
             metrics.gauge("generation.kv_bytes_used").set(
                 used * self.page_bytes)
 
+    def assert_no_leaks(self):
+        """Drain-time invariant check (tests, tools/generate_smoke.py,
+        tools/control_smoke.py): every page back on the free list, no
+        dangling refcounts, no slot ownership, reservation fully
+        drained. Raises AssertionError with the offending accounting
+        otherwise; returns self so calls chain."""
+        with self._lock:
+            used = self.capacity - len(self._free)
+            if used or self._refs or self._owned or self._reserved:
+                raise AssertionError(
+                    "PagePool leak after drain: %d pages allocated, "
+                    "refcounts %r, owned %r, reserved %d"
+                    % (used, dict(self._refs), dict(self._owned),
+                       self._reserved))
+            if sorted(self._free) != list(range(1, self.pool_pages)):
+                raise AssertionError(
+                    "PagePool free list corrupt: %r" % sorted(self._free))
+        return self
+
     def get_stats(self):
         with self._lock:
             used = self.capacity - len(self._free)
+            shared = sum(1 for r in self._refs.values() if r > 1)
+            # every reference beyond a page's first is a page some other
+            # reader did NOT have to allocate+prefill — the sharing win
+            extra_refs = sum(r - 1 for r in self._refs.values())
             return {"page_size": self.page_size,
                     "capacity": self.capacity,
                     "free": len(self._free),
@@ -185,4 +331,8 @@ class PagePool:
                     "kv_bytes_used": used * self.page_bytes,
                     "kv_bytes_peak": self._peak * self.page_bytes,
                     "kv_bytes_capacity": self.capacity * self.page_bytes,
+                    "pages_shared": shared,
+                    "cow_copies": self._cow_copies,
+                    "shared_admits": self._shared_admits,
+                    "bytes_saved_shared": extra_refs * self.page_bytes,
                     "slots": {s: len(p) for s, p in self._owned.items()}}

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Fast CPU smoke of the serving control plane (tier-1 CI; ISSUE 14).
+
+Concurrent mixed-SLO-class traffic over a shared system prompt against
+a prefix-cached continuous-batching Generator, verifying:
+
+1. prefix-cache hit rate > 0 and prefill tokens were actually skipped
+   (the shared system prompt prefills once),
+2. cache-hit outputs are token-identical to a cold (cache-less)
+   generator's for the same requests,
+3. per-class FIFO order holds: within one SLO class, requests are
+   admitted in submit order,
+4. no priority inversion: with both classes queued behind a full slot
+   set, every queued interactive request is admitted before every
+   queued batch request — yet aging still bounds batch starvation,
+5. queue-expired requests shed with DeadlineExceeded BEFORE prefill,
+6. the jit compile count stays flat under mixed hit/miss/class traffic
+   (prefill ladder + ONE decode program, prefix length is data),
+7. zero leaked pages AND zero dangling refcounts after drain with COW
+   sharing active (PagePool.assert_no_leaks).
+
+Prints a one-line JSON summary (optionally written to argv[1]); any
+violation raises, failing the CI step.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(out_path=None):
+    import jax
+
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability import metrics as M
+    from mxnet_tpu.parallel.transformer import TransformerParallel
+    from mxnet_tpu.serving.generation import (DeadlineExceeded,
+                                              GenerationConfig, Generator,
+                                              SamplingParams, SLOClass)
+
+    obs.set_enabled(True)
+    obs.reset_metrics()
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    model = TransformerParallel(mesh, vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, n_experts=2)
+    params = model.init(seed=0)
+    cfg = dict(page_size=8, max_batch=2, max_seq=64,
+               prefill_buckets=(16, 32, 64))
+    rng = np.random.RandomState(0)
+    system_prompt = [int(t) for t in rng.randint(1, 64, size=24)]
+
+    requests = []
+    for i in range(12):
+        tail = [int(t) for t in rng.randint(1, 64, size=1 + i % 9)]
+        sp = SamplingParams(max_new_tokens=2 + i % 4)
+        slo = ("interactive", "batch", "standard")[i % 3]
+        requests.append((system_prompt + tail, sp, slo))
+
+    # --- cold reference: no cache, same prompts ------------------------
+    cold = Generator(model, params, GenerationConfig(**cfg))
+    reference = [cold.generate(p, sp, timeout=300)
+                 for p, sp, _ in requests]
+    cold.stop()
+    cold.pool.assert_no_leaks()
+
+    # --- control-plane generator ---------------------------------------
+    gen = Generator(model, params, GenerationConfig(
+        prefix_cache=True, slo_aging_ms=200, **cfg))
+    warmed = gen.warmup()
+    assert warmed == len(cfg["prefill_buckets"]) + 1, warmed
+    compiles_after_warmup = M.get_value("jit.compile_count", 0)
+
+    t0 = time.perf_counter()
+    # seed the cache: one request completes and inserts the shared
+    # prefix on eviction
+    first = gen.generate(*requests[0][:2], timeout=300)
+    assert first == reference[0], (first, reference[0])
+
+    handles = [(i, gen.submit(p, sp, slo=slo))
+               for i, (p, sp, slo) in enumerate(requests[1:], start=1)]
+    results = {i: h.result(timeout=300) for i, h in handles}
+    wall = time.perf_counter() - t0
+    mismatches = [i for i, got in results.items()
+                  if got != reference[i]]
+    assert not mismatches, (
+        "cache-hit decode diverged from the cold path on %s" % mismatches)
+
+    cache_stats = gen.prefix_cache.get_stats()
+    assert cache_stats["hits"] > 0, cache_stats
+    skipped = int(M.get_value("generation.prefill_tokens_skipped", 0))
+    assert skipped > 0, "no prefill tokens skipped despite cache hits"
+
+    compiles_after_traffic = M.get_value("jit.compile_count", 0)
+    assert compiles_after_traffic == compiles_after_warmup, (
+        "compile count climbed under mixed hit/miss/class traffic: "
+        "%d -> %d" % (compiles_after_warmup, compiles_after_traffic))
+
+    # --- SLO ordering: per-class FIFO + no priority inversion ----------
+    # saturate both slots with long decodes, then queue batch-first and
+    # interactive-second; admission must run every interactive request
+    # before every batch one, FIFO within each class
+    admit_order = []
+    orig_prefill = gen._prefill
+
+    def spying_prefill(slot, ent, worst):
+        admit_order.append(ent.prompt[-1])
+        return orig_prefill(slot, ent, worst)
+
+    gen._prefill = spying_prefill
+    blockers = [gen.submit(system_prompt,
+                           SamplingParams(max_new_tokens=30))
+               for _ in range(2)]
+    time.sleep(0.1)  # both slots busy
+    batch_hs = [gen.submit(system_prompt + [60 + i],
+                           SamplingParams(max_new_tokens=2), slo="batch")
+                for i in range(2)]
+    inter_hs = [gen.submit(system_prompt + [50 + i],
+                           SamplingParams(max_new_tokens=2),
+                           slo="interactive")
+                for i in range(2)]
+    for h in blockers + batch_hs + inter_hs:
+        h.result(timeout=300)
+    gen._prefill = orig_prefill
+    queued = [t for t in admit_order if t in (50, 51, 60, 61)]
+    assert queued[:2] == [50, 51], (
+        "interactive requests did not preempt queue order (FIFO within "
+        "class also required): %s" % queued)
+    assert sorted(queued[2:]) == [60, 61] and queued[2:] == [60, 61], (
+        "batch class lost FIFO order or starved: %s" % queued)
+
+    # --- aging bounds starvation: a long-waiting batch request must
+    # eventually outrank fresh interactive arrivals (aging_ms=200)
+    aged = SLOClass("batch-aged", priority=-10)
+    now = time.monotonic()
+    from mxnet_tpu.serving.control import ClassQueue
+
+    class _E:
+        def __init__(self, slo, t_submit):
+            self.slo, self.t_submit, self.deadline = slo, t_submit, None
+    q = ClassQueue(aging_ms=200)
+    old = _E(aged, now - 5.0)           # waited 5 s -> +25 tiers
+    q.push(old)
+    q.push(_E(SLOClass("interactive", 10), now))
+    assert q.select(now) is old, "aging failed to bound starvation"
+
+    # --- queue-deadline shedding BEFORE prefill ------------------------
+    tight = SLOClass("tight", priority=0, deadline_ms=5)
+    stuck = [gen.submit(system_prompt, SamplingParams(max_new_tokens=38))
+             for _ in range(2)]            # occupy both slots
+    doomed = gen.submit(system_prompt + [9], SamplingParams(
+        max_new_tokens=2), slo=tight)
+    expired = False
+    try:
+        doomed.result(timeout=300)
+    except DeadlineExceeded:
+        expired = True
+    assert expired, "queue-expired request was served instead of shed"
+    for h in stuck:
+        h.result(timeout=300)
+
+    # --- drain: zero leaked pages, zero dangling refcounts -------------
+    gen.stop(drain=True)
+    gen.pool.assert_no_leaks()
+    pool = gen.pool.get_stats()
+    assert pool["cow_copies"] >= 0 and pool["used"] == 0, pool
+
+    summary = {
+        "requests": len(requests) + 8,
+        "prefix_hits": cache_stats["hits"],
+        "prefix_hit_rate": round(cache_stats["hit_rate"], 3),
+        "prefill_tokens_skipped": skipped,
+        "cow_copies": pool["cow_copies"],
+        "deadline_expired": int(
+            M.get_value("generation.deadline_expired", 0)),
+        "compiles_after_warmup": int(compiles_after_warmup),
+        "compiles_after_traffic": int(compiles_after_traffic),
+        "leaked_pages": pool["used"],
+        "wall_s": round(wall, 3),
+    }
+    print(json.dumps(summary))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=2)
+    return summary
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

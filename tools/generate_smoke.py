@@ -101,6 +101,10 @@ def main(out_path=None):
     gen.stop(drain=True)
     leaked = gen.pool.pages_used()
     assert leaked == 0, "leaked %d KV pages after drain" % leaked
+    # the refcount-aware invariant check (ISSUE 14): free list whole,
+    # zero dangling refcounts, zero slot ownership, reservation drained
+    gen.pool.assert_no_leaks()
+    seq_gen.pool.assert_no_leaks()
     pool = gen.pool.get_stats()
 
     summary = {

@@ -336,9 +336,14 @@ def request_timelines(events):
         t0 = min(float(e["ts"]) for e in req_evs)
         t1 = max(float(e["ts"]) + float(e["dur"]) for e in req_evs)
         ttft = None
+        prefix_hit = None  # control-plane engines annotate every span
         for ev in req_evs:
             _, k, phase = ev["name"].split(".", 2)
             kind = kind or k
+            if prefix_hit is None:
+                ph = (ev.get("args") or {}).get("prefix_hit")
+                if ph is not None:
+                    prefix_hit = bool(ph)
             dur_ms = float(ev["dur"]) / 1e3
             phases[phase] = phases.get(phase, 0.0) + dur_ms
             spans.append({"phase": phase,
@@ -362,6 +367,7 @@ def request_timelines(events):
             "queue_ms": round(phases.get("queue", 0.0), 4),
             "ttft_ms": None if ttft is None else round(ttft, 4),
             "itl_ms": [round(v, 4) for v in itl],
+            "prefix_hit": prefix_hit,
         })
     out.sort(key=lambda r: -r["total_ms"])
     return out
@@ -376,13 +382,28 @@ def request_summary(timelines):
     rows = []
     for kind in sorted(by_kind):
         reqs = by_kind[kind]
+        annotated = [r for r in reqs if r.get("prefix_hit") is not None]
+        hits = [r for r in annotated if r["prefix_hit"]]
         row = {"kind": kind, "count": len(reqs),
-               "slowest": reqs[0]["trace_id"]}
+               "slowest": reqs[0]["trace_id"],
+               # prefix-cache column (serving control plane): None when
+               # the engine ran without the cache
+               "prefix_hits": len(hits) if annotated else None,
+               "prefix_annotated": len(annotated),
+               "prefix_hit_rate": (round(len(hits) / len(annotated), 4)
+                                   if annotated else None)}
         for label, vals in (
                 ("total", [r["total_ms"] for r in reqs]),
                 ("queue", [r["queue_ms"] for r in reqs]),
                 ("ttft", [r["ttft_ms"] for r in reqs
                           if r["ttft_ms"] is not None]),
+                # TTFT split by prefix-cache hit/miss — the cache's
+                # effect measured in the existing tooling
+                ("ttft_hit", [r["ttft_ms"] for r in hits
+                              if r["ttft_ms"] is not None]),
+                ("ttft_miss", [r["ttft_ms"] for r in annotated
+                               if not r["prefix_hit"]
+                               and r["ttft_ms"] is not None]),
                 ("itl", [v for r in reqs for v in r["itl_ms"]])):
             vals = sorted(vals)
             for q in (50, 90, 99):
@@ -404,16 +425,40 @@ def format_requests(timelines, path, k_spans=40):
     rows = request_summary(timelines)
     lines = ["# request latency attribution — %s (%d requests)"
              % (path, len(timelines)),
-             "%-11s %6s %10s %10s %10s %10s %10s %10s %10s"
-             % ("kind", "count", "total_p50", "total_p99", "queue_p99",
-                "ttft_p50", "ttft_p99", "itl_p50", "itl_p99")]
+             "%-11s %6s %6s %10s %10s %10s %10s %10s %10s %10s"
+             % ("kind", "count", "hits", "total_p50", "total_p99",
+                "queue_p99", "ttft_p50", "ttft_p99", "itl_p50",
+                "itl_p99")]
     fmt = lambda v: "-" if v is None else "%.2f" % v  # noqa: E731
     for r in rows:
-        lines.append("%-11s %6d %10s %10s %10s %10s %10s %10s %10s"
-                     % (r["kind"], r["count"], fmt(r["total_p50_ms"]),
+        lines.append("%-11s %6d %6s %10s %10s %10s %10s %10s %10s %10s"
+                     % (r["kind"], r["count"],
+                        "-" if r["prefix_hits"] is None
+                        else "%d" % r["prefix_hits"],
+                        fmt(r["total_p50_ms"]),
                         fmt(r["total_p99_ms"]), fmt(r["queue_p99_ms"]),
                         fmt(r["ttft_p50_ms"]), fmt(r["ttft_p99_ms"]),
                         fmt(r["itl_p50_ms"]), fmt(r["itl_p99_ms"])))
+    if any(r["prefix_hits"] is not None for r in rows):
+        lines.append("")
+        lines.append("# TTFT by prefix-cache hit/miss (serving control "
+                     "plane)")
+        lines.append("%-11s %6s %6s %10s %10s %10s %10s"
+                     % ("kind", "arm", "count", "ttft_p50", "ttft_p90",
+                        "ttft_p99", "ttft_max"))
+        for r in rows:
+            if r["prefix_hits"] is None:
+                continue
+            for arm, n in (("hit", r["prefix_hits"]),
+                           ("miss",
+                            r["prefix_annotated"] - r["prefix_hits"])):
+                lines.append(
+                    "%-11s %6s %6d %10s %10s %10s %10s"
+                    % (r["kind"], arm, n,
+                       fmt(r["ttft_%s_p50_ms" % arm]),
+                       fmt(r["ttft_%s_p90_ms" % arm]),
+                       fmt(r["ttft_%s_p99_ms" % arm]),
+                       fmt(r["ttft_%s_max_ms" % arm])))
     slow = timelines[0]
     lines.append("")
     lines.append("# slowest request: %s (%s, %.3f ms total)"
