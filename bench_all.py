@@ -1736,6 +1736,256 @@ def bench_graph_passes():
     return results
 
 
+def bench_fusion():
+    """--fusion: fused-vs-unfused step time + the learned cost model's
+    ranking-quality gate (ISSUE 15).
+
+    **Regions** — the bench resnet-style model (predict; bn_fold feeds
+    the conv+relu+residual chains) and a transformer block (train step;
+    FC/batch_dot chains) run under ``default`` vs ``default,-fuse``.
+    CPU-stable hard gates: fused region count > 0 on both, analytic
+    interior-bytes saved > 0, and numeric parity between the arms.
+    Wall-clock ratios are recorded (CPU QUICK they are informational;
+    the on-chip MFU delta lands in BENCH_LEDGER.jsonl next bench pass).
+
+    **Learned ranking** — measured ``fusion.blocks`` sweeps at several
+    shape buckets populate the sample dataset; training computes the
+    held-out-group Spearman of the learned ranking vs the analytic
+    roofline's.  Hard gate: the degradation CONTRACT — when the holdout
+    gate passes, the next search ranks "learned" AND its holdout
+    Spearman >= the analytic baseline; when it fails, the next search
+    provably ranks "analytic" (never worse than the roofline either
+    way, docs/autotune.md)."""
+    import time as _time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune, graph_pass
+    from mxnet_tpu.autotune import learned
+    from mxnet_tpu.autotune import search as _search
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.models import get_resnet
+
+    rng = np.random.RandomState(0)
+    layers, size, bs = (18, 32, 4) if QUICK else (50, 224, 16)
+    steps = 10 if QUICK else 50
+
+    def fuse_report():
+        for rep in reversed(graph_pass.recent_reports()):
+            if "fuse" in rep:
+                return rep["fuse"]
+        return {"regions": [], "saved_bytes": 0}
+
+    # ---- resnet predict arm ------------------------------------------
+    x = rng.rand(bs, 3, size, size).astype(np.float32)
+
+    def build_resnet(spec):
+        graph_pass.set_passes(spec)
+        try:
+            sym = get_resnet(num_classes=1000, num_layers=layers,
+                             image_shape=(3, size, size))
+            mod = mx.mod.Module(sym, context=mx.gpu()
+                                if mx.context.num_gpus() else mx.cpu())
+            mod.bind(data_shapes=[("data", x.shape)], for_training=False)
+            mod.init_params(mx.init.Xavier())
+            return mod
+        finally:
+            graph_pass.set_passes(None)
+
+    def run_predict(mod):
+        it = lambda: NDArrayIter(x, None, batch_size=bs)  # noqa: E731
+        out = mod.predict(it()).asnumpy()  # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            mod.predict(it())
+        return (_time.perf_counter() - t0) / steps, out
+
+    base = build_resnet("default,-fuse")
+    base_s, base_out = run_predict(base)
+    graph_pass.reset_stats()
+    fused = build_resnet("default")
+    # parity must compare the SAME parameters, not two Xavier draws
+    arg_p, aux_p = base.get_params()
+    fused.set_params(arg_p, aux_p)
+    fused_s, fused_out = run_predict(fused)
+    resnet_fuse = fuse_report()
+    np.testing.assert_allclose(fused_out, base_out, rtol=1e-4, atol=1e-5)
+
+    # ---- transformer-block train arm ---------------------------------
+    T, D = (16, 32) if QUICK else (64, 128)
+    tb = 8
+
+    def tblock():
+        data = mx.sym.var("data")
+        q = mx.sym.FullyConnected(data, num_hidden=D, flatten=False,
+                                  name="q")
+        k = mx.sym.FullyConnected(data, num_hidden=D, flatten=False,
+                                  name="k")
+        v = mx.sym.FullyConnected(data, num_hidden=D, flatten=False,
+                                  name="v")
+        scores = mx.sym.batch_dot(q, mx.sym.transpose(k, axes=(0, 2, 1)))
+        attn = mx.sym.softmax(scores / float(np.sqrt(D)), axis=-1)
+        ctxv = mx.sym.batch_dot(attn, v)
+        out = mx.sym.FullyConnected(ctxv + data, num_hidden=D,
+                                    flatten=False, name="proj")
+        flat = mx.sym.Flatten(out)
+        return mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(flat, num_hidden=16, name="head"),
+            name="softmax")
+
+    tx = rng.rand(tb, T, D).astype(np.float32)
+    ty = (np.arange(tb) % 16).astype(np.float32)
+
+    def train_wall(spec):
+        graph_pass.set_passes(spec)
+        try:
+            mod = mx.mod.Module(tblock(), context=mx.cpu())
+            mod.bind(data_shapes=[("data", tx.shape)],
+                     label_shapes=[("softmax_label", ty.shape)],
+                     for_training=True)
+            mod.init_params(mx.init.Uniform(0.05))
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.01})
+            batch = mx.io.DataBatch(data=[mx.nd.array(tx)],
+                                    label=[mx.nd.array(ty)])
+            for _ in range(2):  # compile + warm
+                mod.forward_backward(batch)
+                mod.update()
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                mod.forward_backward(batch)
+                mod.update()
+            mx.nd.waitall()
+            return (_time.perf_counter() - t0) / steps
+        finally:
+            graph_pass.set_passes(None)
+
+    tb_base_s = train_wall("default,-fuse")
+    graph_pass.reset_stats()
+    tb_fused_s = train_wall("default")
+    tblock_fuse = fuse_report()
+
+    # ---- learned ranking-quality gate --------------------------------
+    # the whole phase runs against a SCRATCH tuning cache (the
+    # bench_autotune gate discipline): the contract probe below drives
+    # the real search with a constant fake measurer, and neither its
+    # fabricated timing nor a bench-trained model file may ever leak
+    # into the user's persistent cache/samples/model
+    import tempfile
+
+    scratch = tempfile.mkdtemp(prefix="mxfusion_gate_")
+    prev_cache = os.environ.get("MXNET_TUNE_CACHE")
+    prev_model = os.environ.get("MXNET_COST_MODEL_PATH")
+    os.environ["MXNET_TUNE_CACHE"] = os.path.join(scratch, "tuning.json")
+    os.environ.pop("MXNET_COST_MODEL_PATH", None)
+    autotune.cache.reset()
+    learned.reset()
+    try:
+        sweeps = [(128, 128, 256), (256, 128, 256), (128, 256, 512)] \
+            if QUICK else [(128, 128, 256), (256, 128, 256),
+                           (128, 256, 512), (512, 256, 512),
+                           (256, 512, 1024)]
+        for (m, n, k) in sweeps:
+            autotune.tune_fused_matmul(m, n, k,
+                                       trials=4 if QUICK else None,
+                                       repeats=2)
+        model = learned.train(min_samples=4)
+        meta = dict(model.meta) if model is not None else {}
+        gate_ok = bool(meta.get("gate_ok"))
+        # the degradation contract, witnessed on a real search
+        res = _search.search(
+            autotune.get_tunable("fusion.blocks"),
+            # the measured value is irrelevant here — only which RANKER
+            # the search consulted is under test
+            lambda c: 1e-3,
+            ctx={"M": 64, "N": 64, "K": 128, "dtype_bytes": 4},
+            cfg=_search.SearchConfig(trials=1))
+        n_samples = learned.sample_count()
+    finally:
+        if prev_cache is None:
+            os.environ.pop("MXNET_TUNE_CACHE", None)
+        else:
+            os.environ["MXNET_TUNE_CACHE"] = prev_cache
+        if prev_model is not None:
+            os.environ["MXNET_COST_MODEL_PATH"] = prev_model
+        autotune.cache.reset()
+        learned.reset()
+    expected = "learned" if gate_ok else "analytic"
+    if res.ranker != expected:
+        raise SystemExit(
+            "bench_all --fusion: ranking contract broken — gate_ok=%s "
+            "but search ranked %r" % (gate_ok, res.ranker))
+    if gate_ok and meta.get("spearman_analytic") is not None and \
+            meta["spearman_learned"] < meta["spearman_analytic"] - 1e-9:
+        raise SystemExit(
+            "bench_all --fusion: gate passed with learned Spearman %.3f "
+            "< analytic %.3f" % (meta["spearman_learned"],
+                                 meta["spearman_analytic"]))
+
+    results = {
+        "protocol": "resnet%d %dx%d bs%d predict + transformer block "
+                    "T%d D%d bs%d train, %d timed iters" % (
+                        layers, size, size, bs, T, D, tb, steps),
+        "resnet_predict": {
+            "unfused_ms": round(base_s * 1e3, 2),
+            "fused_ms": round(fused_s * 1e3, 2),
+            "speedup": round(base_s / fused_s, 3),
+            "fused_regions": len(resnet_fuse["regions"]),
+            "interior_bytes_saved": resnet_fuse["saved_bytes"],
+        },
+        "transformer_train": {
+            "unfused_ms": round(tb_base_s * 1e3, 2),
+            "fused_ms": round(tb_fused_s * 1e3, 2),
+            "speedup": round(tb_base_s / tb_fused_s, 3),
+            "fused_regions": len(tblock_fuse["regions"]),
+            "interior_bytes_saved": tblock_fuse["saved_bytes"],
+        },
+        "cost_model": {
+            "samples": n_samples,
+            "holdout_groups": meta.get("n_holdout_groups"),
+            "spearman_learned": meta.get("spearman_learned"),
+            "spearman_analytic": meta.get("spearman_analytic"),
+            "gate_ok": gate_ok,
+            "search_ranker": res.ranker,
+        },
+        "quick": QUICK,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_ALL.json")
+    try:
+        with open(out_path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["fusion"] = results
+    tmp = out_path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(tmp, out_path)
+    print(json.dumps({"fusion": results}))
+    for arm in ("resnet_predict", "transformer_train"):
+        if results[arm]["fused_regions"] < 1:
+            raise SystemExit("bench_all --fusion: %s carved no regions"
+                             % arm)
+        if results[arm]["interior_bytes_saved"] <= 0:
+            raise SystemExit("bench_all --fusion: %s saved no interior "
+                             "bytes" % arm)
+    print("[bench_all] fusion: resnet %.2f -> %.2f ms (%.3fx, %d regions)"
+          ", tblock train %.2f -> %.2f ms (%.3fx, %d regions), learned "
+          "gate_ok=%s ranker=%s"
+          % (results["resnet_predict"]["unfused_ms"],
+             results["resnet_predict"]["fused_ms"],
+             results["resnet_predict"]["speedup"],
+             results["resnet_predict"]["fused_regions"],
+             results["transformer_train"]["unfused_ms"],
+             results["transformer_train"]["fused_ms"],
+             results["transformer_train"]["speedup"],
+             results["transformer_train"]["fused_regions"],
+             gate_ok, res.ranker), file=sys.stderr)
+    return results
+
+
 def bench_quantize():
     """--quantize: int8 end-to-end numbers (ISSUE 11), two halves.
 
@@ -2453,6 +2703,12 @@ if __name__ == "__main__":
         # pipeline (node-count reduction is a hard gate; latency is
         # recorded); merges a "graph_passes" section into BENCH_ALL.json
         bench_graph_passes()
+    elif "--fusion" in sys.argv[1:]:
+        # fused-vs-unfused step time (regions > 0, interior bytes
+        # saved, parity are the CPU-stable gates) + the learned cost
+        # model's ranking-quality/degradation contract — merges a
+        # "fusion" section into BENCH_ALL.json (docs/fusion.md)
+        bench_fusion()
     elif "--quantize" in sys.argv[1:]:
         # int8 PTQ predict (throughput + top-1 agreement gate) and
         # int8 paged-KV decode (HBM-bytes-per-token halved vs bf16 is

@@ -21,19 +21,20 @@ measure; ``1`` additionally search on a miss at shape-local call sites
 (outside any jax trace); ``-1`` bypass lookups entirely (A/B baseline).
 Quick start: docs/autotune.md.
 """
-from . import cache, cost_model, registry, search
+from . import cache, cost_model, learned, registry, search
 from .cache import (cache_path, device_fingerprint, lookup, lookup_entry,
                     record, reload, reset, reset_stats, scrub_stale, stats)
 from .registry import declare, get as get_tunable, names as tunable_names
 from .search import SearchConfig, SearchResult, median_time, tune_and_record
 
-__all__ = ["cache", "registry", "cost_model", "search",
+__all__ = ["cache", "registry", "cost_model", "learned", "search",
            "cache_path", "device_fingerprint", "lookup", "lookup_entry",
            "lookup_or_tune", "record", "reload", "reset", "reset_stats",
            "scrub_stale", "stats", "declare", "get_tunable",
            "tunable_names", "SearchConfig", "SearchResult", "median_time",
            "tune_and_record", "mode", "enabled",
-           "tune_flash_attention", "tune_serving_buckets", "tune_layout",
+           "tune_flash_attention", "tune_fused_matmul",
+           "tune_serving_buckets", "tune_layout",
            "tune_remat", "tune_generation", "tune_generation_kv",
            "tune_quantize_layers", "tune_input_pipeline", "tune_control",
            "flash_shape_key"]
@@ -176,6 +177,40 @@ declare(
         "memory).")
 
 
+# fusion-region kernel blocks (ISSUE 15): consulted by
+# parallel/fused.py at trace time (explicit call arg > tuning cache
+# under the pow2 shape-bucket key > MXNET_FUSION_BLOCK_* flags),
+# measured by tuners.tune_fused_matmul. Declared here at package import
+# — the graph.layout precedent — because the consuming kernel module
+# loads lazily with the graph executor.
+def _fusion_default(ctx):
+    from ..config import get_flag
+
+    return {"block_m": get_flag("MXNET_FUSION_BLOCK_M"),
+            "block_n": get_flag("MXNET_FUSION_BLOCK_N"),
+            "block_k": get_flag("MXNET_FUSION_BLOCK_K")}
+
+
+def _fusion_space(ctx):
+    M = int(ctx.get("M", 1024))
+    N = int(ctx.get("N", 1024))
+    K = int(ctx.get("K", 1024))
+    dims = lambda top: tuple(b for b in (64, 128, 256, 512, 1024)  # noqa: E731
+                             if b <= max(64, top)) or (64,)
+    return {"block_m": dims(M), "block_n": dims(N), "block_k": dims(K)}
+
+
+declare(
+    "fusion.blocks",
+    space=_fusion_space,
+    default=_fusion_default,
+    cost=cost_model.fused_matmul_cost,
+    doc="Fused matmul+epilogue kernel tile bounds (parallel/fused.py): "
+        "output-row/col blocks and contraction depth, VMEM-pruned by "
+        "cost_model.fused_matmul_cost, keyed per pow2 (M, N, K) shape "
+        "bucket.")
+
+
 def mode():
     """MXNET_TUNE: -1 bypass, 0 consult-only (default), 1 search on
     miss."""
@@ -233,7 +268,8 @@ def __getattr__(name):
     # first use keeps `import mxnet_tpu` free of the heavy path.
     # (importlib, not `from . import`: the latter probes this very
     # __getattr__ through hasattr and recurses)
-    if name in ("tune_flash_attention", "tune_serving_buckets",
+    if name in ("tune_flash_attention", "tune_fused_matmul",
+                "tune_serving_buckets",
                 "tune_layout", "tune_remat", "tune_generation",
                 "tune_generation_kv", "tune_quantize_layers",
                 "tune_input_pipeline", "tune_control",

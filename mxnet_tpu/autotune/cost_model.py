@@ -17,7 +17,7 @@ __all__ = ["MEASURED_MATMUL_TF", "MEASURED_HBM_GBPS", "SPEC_MATMUL_TF",
            "VMEM_BYTES", "CEILINGS", "ridge_intensity",
            "roofline_seconds", "flash_fwd_cost", "flash_bwd_cost",
            "flash_vmem_bytes", "ladder_cost", "expected_padding",
-           "pow2_at_least"]
+           "fused_vmem_bytes", "fused_matmul_cost", "pow2_at_least"]
 
 
 def pow2_at_least(n):
@@ -125,6 +125,42 @@ def flash_bwd_cost(candidate, ctx):
     """Estimated seconds of the two tiled backward passes."""
     return _flash_cost(ctx, int(candidate["block_q"]),
                        int(candidate["block_k"]), backward=True)
+
+
+# --------------------------------------------------- fused matmul regions
+def fused_vmem_bytes(bm, bn, bk, dtype_bytes):
+    """Live VMEM of one fused-matmul grid step: input tiles
+    double-buffered by the pipeline, one fp32 accumulator, a small
+    allowance for epilogue vectors/residual tiles."""
+    db = dtype_bytes
+    tiles = bm * bk * db + bk * bn * db      # x, w
+    out = bm * bn * db                       # writeback tile
+    acc = bm * bn * 4                        # fp32 accumulator (scratch)
+    epilogue = bm * bn * db + bn * 4         # residual tile + one vector
+    return 2 * (tiles + out + epilogue) + acc
+
+
+def fused_matmul_cost(candidate, ctx):
+    """Estimated seconds of one fused (M, K) x (K, N) region at this
+    block triple; inf when the tiles overflow VMEM.  The traffic model
+    charges exterior bytes only — the whole point of the fusion — plus
+    the x re-stream across n blocks and the w re-stream across m blocks
+    (the blocked-matmul reality the block sizes trade against)."""
+    M = int(ctx.get("M", 1024))
+    N = int(ctx.get("N", 1024))
+    K = int(ctx.get("K", 1024))
+    db = int(ctx.get("dtype_bytes", 4))
+    bm = min(int(candidate["block_m"]), M)
+    bn = min(int(candidate["block_n"]), N)
+    bk = min(int(candidate["block_k"]), K)
+    if fused_vmem_bytes(bm, bn, bk, db) > _VMEM_BUDGET:
+        return math.inf
+    n_m, n_n, n_k = -(-M // bm), -(-N // bn), -(-K // bk)
+    steps = n_m * n_n * n_k
+    flops = 2 * M * N * K
+    # x streams once per n-block column, w once per m-block row
+    traffic = (M * K * n_n + K * N * n_m + M * N) * db
+    return roofline_seconds(flops, traffic) + steps * _GRID_STEP_S
 
 
 # ----------------------------------------------------------- bucket ladders

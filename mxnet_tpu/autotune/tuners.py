@@ -27,7 +27,7 @@ from ..base import MXNetError
 from . import cache, registry
 from .search import SearchConfig, median_time, search
 
-__all__ = ["flash_shape_key", "tune_flash_attention",
+__all__ = ["flash_shape_key", "tune_flash_attention", "tune_fused_matmul",
            "serving_replay_measurer", "tune_serving_buckets",
            "tune_layout", "tune_remat", "tune_generation",
            "tune_generation_kv", "tune_quantize_layers", "tune_control",
@@ -118,6 +118,62 @@ def tune_flash_attention(T, D=64, B=1, H=4, dtype="bfloat16", causal=True,
                      ms=res_b.best_s * 1e3, trials=res_b.measured)
         out["flash_attention.bwd"] = res_b.best
     return out
+
+
+def tune_fused_matmul(M, N, K, dtype="float32", epilogue=("bias",
+                                                          ("act", "relu")),
+                      wt=True, interpret=None, trials=None, repeats=3):
+    """Measured search over the fused matmul+epilogue kernel's block
+    bounds at one (M, N, K) shape (parallel/fused.py); records a
+    ``fusion.blocks`` entry under the pow2 shape-bucket key and returns
+    the winning value dict.  ``interpret=None`` auto-detects (interpret
+    mode off-TPU, the flash-attention tuner convention).
+
+    The default epilogue — bias + relu — is the modal carved region;
+    block choice is dominated by the matmul tiling, not the epilogue
+    arithmetic, so one sweep serves every region at the shape bucket.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.fused import fused_matmul, fused_shape_key
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    dt = jnp.dtype(dtype)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(M, K), dt)
+    w = jnp.asarray(rng.randn(N, K) if wt else rng.randn(K, N), dt)
+    extras = []
+    steps = tuple(tuple(s) if isinstance(s, (list, tuple)) else (s,)
+                  for s in epilogue)
+    for s in steps:
+        if s[0] in ("bias", "vmul", "vadd"):
+            extras.append(jnp.asarray(rng.randn(N), dt))
+        elif s[0] == "res":
+            extras.append(jnp.asarray(rng.randn(M, N), dt))
+    key = fused_shape_key(M, N, K)
+    ctx = {"M": int(M), "N": int(N), "K": int(K),
+           "dtype_bytes": dt.itemsize}
+    cfg = SearchConfig(trials=trials, repeats=repeats, warmup=1)
+
+    def measure(c):
+        fn = jax.jit(lambda x, w, *e: fused_matmul(  # graftlint: disable=G002 — one fresh program per measured candidate is the point of the sweep
+            x, w, extras=e, epilogue=steps, wt=wt,
+            block_m=int(c["block_m"]), block_n=int(c["block_n"]),
+            block_k=int(c["block_k"]), interpret=interpret))
+        out = fn(x, w, *extras)
+        if out is None:
+            raise MXNetError("fused_matmul: candidate %r has no tiling "
+                             "at (%d, %d, %d)" % (c, M, N, K))
+        return median_time(lambda: jax.block_until_ready(fn(x, w, *extras)),
+                           repeats=cfg.repeats, warmup=cfg.warmup)
+
+    res = search(registry.get("fusion.blocks"), measure, ctx=ctx, cfg=cfg)
+    cache.record("fusion.blocks", key, res.best, dtype=str(dt),
+                 ms=res.best_s * 1e3, trials=res.measured,
+                 extra={"ranker": res.ranker})
+    return res.best
 
 
 def model_key(symbol):
@@ -729,6 +785,15 @@ def auto_tune(op, key, ctx):
     Only the MISSING entries are searched: an existing (possibly
     shipped, on-chip-measured) fwd or bwd entry is reused as-is, never
     re-measured or overwritten by an opportunistic local sweep."""
+    if op == "fusion.blocks":
+        # shape-local like flash blocks: the region's (M, N, K) rides
+        # in the consult context (parallel/fused.py resolve_blocks)
+        if not all(k in ctx for k in ("M", "N", "K")):
+            return None
+        db = int(ctx.get("dtype_bytes", 4))
+        dtype = {2: "bfloat16", 4: "float32"}.get(db, "float32")
+        return tune_fused_matmul(int(ctx["M"]), int(ctx["N"]),
+                                 int(ctx["K"]), dtype=dtype)
     if op not in ("flash_attention.fwd", "flash_attention.bwd"):
         return None
     dtype = ctx.get("dtype", "bfloat16")

@@ -201,14 +201,61 @@ Flags currently honored:
     docs/graph_passes.md): ``default`` runs the numerically exact
     passes — inference loss-head simplification + dead-node pruning,
     BatchNorm→conv/FC folding, the autotuner-consulting layout rewrite,
-    and constant folding of frozen-parameter subgraphs; ``all``
-    additionally enables the opt-in bf16 ``amp`` rewrite (fp32 islands
-    for softmax/norm/loss); ``off`` disables the layer; ``-<pass>``
-    drops one pass; ``layout=NHWC`` forces the layout target. Grammar
-    in docs/graph_passes.md. String-valued and read by graph_pass
-    straight from the environment (runtime override:
-    ``graph_pass.set_passes``) — like MXNET_HEALTH, NOT routed through
-    the integer get_flag machinery.
+    the ``fuse`` fusion-region pass (docs/fusion.md), and constant
+    folding of frozen-parameter subgraphs; ``all`` additionally enables
+    the opt-in bf16 ``amp`` rewrite (fp32 islands for
+    softmax/norm/loss); ``off`` disables the layer; ``-<pass>`` drops
+    one pass (``-fuse`` is the unfused A/B arm bench_all.py --fusion
+    measures); ``layout=NHWC`` forces the layout target. Grammar in
+    docs/graph_passes.md. String-valued and read by graph_pass straight
+    from the environment (runtime override: ``graph_pass.set_passes``)
+    — like MXNET_HEALTH, NOT routed through the integer get_flag
+    machinery.
+
+``MXNET_FUSION_BLOCK_M`` / ``MXNET_FUSION_BLOCK_N`` /
+``MXNET_FUSION_BLOCK_K`` (defaults 128 / 128 / 512)
+    Block-bound defaults of the fused matmul + epilogue Pallas kernels
+    (parallel/fused.py): tile upper bounds for the output rows/cols and
+    the contraction depth.  A tuned ``fusion.blocks`` cache entry for
+    the shape bucket wins (docs/autotune.md); largest divisors at or
+    below the bounds are what actually run.
+
+``MXNET_FUSION_KERNEL`` (default 1)
+    Lower eligible fused regions through the Pallas kernel family on
+    TPU. 0 = always use the reference composition (the region node
+    still fuses graph-side — one program region, exterior-bytes
+    accounting — but XLA owns the lowering).
+
+``MXNET_FUSION_INTERPRET`` (default 0)
+    Force the Pallas fused-kernel path in interpret mode on any
+    backend — the CPU test/CI lever (tools/fuse_smoke.py exercises the
+    real kernel path with it).
+
+``MXNET_FUSION_MIN_BYTES`` (default 0)
+    Minimum analytic interior-bytes saving (the ``2 x interior output
+    bytes`` candidate formula) for a region to be carved; smaller
+    matches are reported as rejected with ``below_min_bytes``.
+
+``MXNET_COST_MODEL`` (default 1)
+    Learned cost model for the autotuner's candidate ranking
+    (autotune/learned.py, docs/autotune.md): 1 = record every measured
+    search sample beside the tuning cache, train the feature-hashed
+    regressor, and let it re-rank candidates when its held-out Spearman
+    beats the analytic roofline's (it degrades to the analytic ranking
+    otherwise — never below it); 0 = analytic ranking only, no sample
+    recording.
+
+``MXNET_COST_MODEL_MIN_SAMPLES`` (default 48)
+    Measured samples required before the first training run; below it
+    the ranking stays analytic.
+
+``MXNET_COST_MODEL_RETRAIN`` (default 32)
+    New samples accumulated since the last training run that trigger an
+    automatic retrain (at search time, outside any trace).
+
+``MXNET_COST_MODEL_PATH`` (default ``<tuning cache>.model.json``)
+    Persisted model file (weights + holdout-gate metadata), loaded by a
+    warm process with zero re-training. String-valued, env-only.
 
 ``MXNET_TUNE`` (default 0)
     Autotuner mode (autotune/, docs/autotune.md): ``0`` consults the
@@ -414,6 +461,15 @@ _DEFAULTS = {
     "MXNET_SERVING_PIPELINE": 2,
     "MXNET_TUNE": 0,
     "MXNET_TUNE_TRIALS": 12,
+    "MXNET_FUSION_BLOCK_M": 128,
+    "MXNET_FUSION_BLOCK_N": 128,
+    "MXNET_FUSION_BLOCK_K": 512,
+    "MXNET_FUSION_KERNEL": 1,
+    "MXNET_FUSION_INTERPRET": 0,
+    "MXNET_FUSION_MIN_BYTES": 0,
+    "MXNET_COST_MODEL": 1,
+    "MXNET_COST_MODEL_MIN_SAMPLES": 48,
+    "MXNET_COST_MODEL_RETRAIN": 32,
     "MXNET_GEN_PAGE_SIZE": 16,
     "MXNET_GEN_DECODE_BLOCKS": 128,
     "MXNET_GEN_MAX_BATCH": 8,

@@ -885,6 +885,29 @@ class Executor:
         aux_d = {n: self.aux_dict[n]._data for n in prog.aux_names}
         return prog.perf_cost(arg_d, aux_d, train=is_train)
 
+    def fused_regions(self):
+        """Fusion-region summaries of the compiled inference program —
+        ``[{name, base_op, members}]`` per ``_FusedRegion`` node the
+        fuse pass carved at bind (graph_pass/fuse.py, docs/fusion.md).
+        Empty when the pass is off or nothing matched; the program-
+        level twin of the pass report, readable without a flight-
+        recorder dump (tests, tools/fuse_smoke.py)."""
+        import json as _json
+
+        out = []
+        for node in self._prog.topo:
+            if node.op != "_FusedRegion":
+                continue
+            attrs = node.parsed_attrs()
+            try:
+                members = _json.loads(
+                    node.user_attrs.get("__fused_members__", "[]"))
+            except ValueError:
+                members = []
+            out.append({"name": node.name, "base_op": attrs.base_op,
+                        "members": members})
+        return out
+
     def named_health_arrays(self):
         """``(kind, name, NDArray)`` triples for the health layer: every
         output and every gradient buffer this executor exposes."""

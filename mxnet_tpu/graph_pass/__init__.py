@@ -35,7 +35,7 @@ import threading
 import time
 
 from ..symbol.symbol import Symbol
-from . import core, passes, quantize
+from . import core, fuse, passes, quantize
 from .core import (DEFAULT_PASSES, INFERENCE_ONLY, PIPELINE_ORDER,
                    PassConfig, PassContext, clone_entries, topo_from)
 from .passes import eval_fold_exprs
@@ -54,6 +54,7 @@ _PASS_FNS = {
     "quantize": quantize.run_quantize,
     "layout": passes.run_layout,
     "amp": passes.run_amp,
+    "fuse": fuse.run_fuse,
     "fold": passes.run_fold,
 }
 
@@ -214,6 +215,15 @@ class OptimizedGraph:
                     "ops_eligible": d.get("ops_eligible", 0),
                     "skipped": dict(d.get("skipped", {})),
                     "table": d.get("table")}
+            # fusion adoption rides at the top level for the same
+            # reason: perf_report's adoption column joins the perf
+            # layer's candidate list against this rejection map
+            if rep["pass"] == "fuse" and "detail" in rep:
+                d = rep["detail"]
+                out["fuse"] = {
+                    "regions": [dict(r) for r in d.get("regions", ())],
+                    "rejected": dict(d.get("rejected", {})),
+                    "saved_bytes": d.get("saved_bytes", 0)}
         return out
 
 
@@ -256,6 +266,7 @@ def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
     from ..observability import metrics
 
     quant = ctx.pass_extras.get("quantize") or {}
+    fused = ctx.pass_extras.get("fuse") or {}
     with _lock:
         _stats["pipeline_runs"] += 1
         if changed:
@@ -268,6 +279,9 @@ def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
             # counter must track genuine per-op skips only
             _stats["quantize_skipped"] += len(
                 [n for n in quant.get("skipped", {}) if n != "*"])
+        if fused:
+            _stats["fused_regions"] += len(fused.get("regions", ()))
+            _stats["fused_saved_bytes"] += fused.get("saved_bytes", 0)
     if metrics.enabled():
         metrics.counter("graph_pass.pipeline_runs").inc()
         if changed:
@@ -280,6 +294,11 @@ def optimize(symbol, for_training=False, frozen=(), arg_shapes=None,
         if quant.get("ops_quantized"):
             metrics.counter("graph_pass.quantized_ops").inc(
                 quant["ops_quantized"])
+        if fused.get("regions"):
+            metrics.counter("graph_pass.fused_regions").inc(
+                len(fused["regions"]))
+            metrics.counter("graph_pass.fused_saved_bytes").inc(
+                fused.get("saved_bytes", 0))
     return opt
 
 

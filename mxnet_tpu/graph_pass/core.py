@@ -17,11 +17,15 @@ from ..symbol.symbol import Symbol, _Node
 
 # canonical execution order — the env grammar toggles membership, never
 # order (quantize runs after bn_fold so folded convs quantize as one
-# unit and before layout so calibration entry names still resolve; fold
-# runs LAST so it materializes the small parameter expressions
-# bn_fold/layout/amp/quantize leave behind: scale vectors, transposed
-# weights, pre-cast bf16 params, int8 weight tensors)
-PIPELINE_ORDER = ("prune", "bn_fold", "quantize", "layout", "amp", "fold")
+# unit and before layout so calibration entry names still resolve; fuse
+# runs after amp so the carved regions see the final dtype/layout of
+# every chain — the int8 islands quantize leaves behind and the casts
+# amp inserts are epilogue steps, not barriers; fold runs LAST so it
+# materializes the small parameter expressions bn_fold/layout/amp/
+# quantize/fuse leave behind: scale vectors, transposed weights,
+# pre-cast bf16 params, int8 weight tensors)
+PIPELINE_ORDER = ("prune", "bn_fold", "quantize", "layout", "amp", "fuse",
+                  "fold")
 
 # passes that change inference-only semantics (loss-head simplification,
 # folding running stats into weights, int8 rewrite) never run on a
@@ -30,8 +34,10 @@ INFERENCE_ONLY = frozenset({"prune", "bn_fold", "quantize"})
 
 # the numerically exact default; amp (a deliberate precision change) is
 # opt-in per the parity discipline, layout only acts on a tuned
-# graph.layout cache entry so it defaults on
-DEFAULT_PASSES = ("prune", "bn_fold", "layout", "fold")
+# graph.layout cache entry so it defaults on; fuse defaults on — its
+# fallback lowering replays the exact unfused op sequence and the
+# Pallas kernel keeps fp32 accumulation (docs/fusion.md tolerances)
+DEFAULT_PASSES = ("prune", "bn_fold", "layout", "fuse", "fold")
 
 _OFF_TOKENS = frozenset({"off", "none", "0", ""})
 

@@ -1,0 +1,214 @@
+"""The ``fuse`` pass: carve matmul/conv + epilogue chains into fusion
+regions (ISSUE 15; ROADMAP open item 3).
+
+PR 13's roofline attribution produces the work list — per-program
+FUSION CANDIDATES: maximal bandwidth-bound op runs ranked by the
+interior bytes a fusion would save (``observability.perf.
+fusion_candidates``).  This pass eats that list: a single-consumer
+chain rooted at a Convolution / FullyConnected / dot / batch_dot and
+continuing through epilogue-shaped ops — activation, scalar scale,
+bias/rescale vectors, residual elemwise add, dtype cast — collapses
+into ONE ``_FusedRegion`` node (ops/fused.py) that lowers to the
+Pallas fused matmul + epilogue kernel family (parallel/fused.py) with
+an exact unfused-composition fallback.
+
+Region scoring uses the SAME formula as the perf layer's candidate
+ranking — ``2 x interior output bytes``, each interior tensor written
+to and re-read from HBM today — so the pass provably consumes its own
+work list: once a region is fused, the roofline table stops charging
+its interior traffic (``perf.node_cost`` charges a ``_FusedRegion``
+exterior bytes only) and the candidate list shows only the remaining
+headroom (tools/perf_report.py fusion adoption).
+
+Runs on BOTH training and inference binds (the kernel's backward is a
+reference-recompute ``custom_vjp``); BN blocks a chain on training
+binds (bn_fold is inference-only) — conv+BN+relu training fusion is
+future work the rejection report names.  Grammar, numerics and
+tolerances: docs/fusion.md.
+"""
+from __future__ import annotations
+
+import json
+
+from ..ops.fused import EPILOGUE_ACTS
+from .core import (apply_entry_map, consumers_of, make_node,
+                   num_outputs_of, topo_from)
+
+__all__ = ["run_fuse", "FUSE_BASES"]
+
+#: region roots — the MXU-bound contractions (the same family the amp
+#: allow-list and the quantize pass target)
+FUSE_BASES = frozenset({"Convolution", "FullyConnected", "dot",
+                        "batch_dot"})
+
+_BARE_ACTS = frozenset({"relu", "sigmoid", "tanh"})
+_SCALAR_OPS = frozenset({"_mul_scalar", "_div_scalar", "_plus_scalar",
+                         "_minus_scalar", "_rminus_scalar"})
+_RES_OPS = frozenset({"elemwise_add", "elemwise_mul"})
+_VEC_OPS = frozenset({"broadcast_add", "broadcast_mul"})
+
+# nominal per-element bytes of the scoring formula (the perf layer
+# re-derives saved bytes at the program's real dtype width)
+_SCORE_DTYPE_BYTES = 4
+
+
+def _classify(ctx, consumer, slot, cur_entry):
+    """One epilogue step dict for ``consumer`` eating ``cur_entry`` at
+    input ``slot``, or (None, reason)."""
+    canon = consumer.opdef().name
+    attrs = consumer.parsed_attrs()
+    if canon == "Activation":
+        if attrs.act_type not in EPILOGUE_ACTS:
+            return None, "act_type:%s" % attrs.act_type
+        return {"op": "Activation", "kind": "act",
+                "act": attrs.act_type}, None
+    if canon in _BARE_ACTS:
+        return {"op": canon, "kind": "act", "act": canon}, None
+    if canon in _SCALAR_OPS:
+        return {"op": canon, "kind": "scalar",
+                "scalar": float(attrs.scalar)}, None
+    if canon == "Cast":
+        return {"op": "Cast", "kind": "cast",
+                "dtype": str(attrs.dtype)}, None
+    if canon in _RES_OPS:
+        return {"op": canon, "kind": "res", "slot": int(slot)}, None
+    if canon in _VEC_OPS:
+        oshape = ctx.shape_of(consumer.inputs[1 - slot])
+        cshape = ctx.shape_of(cur_entry)
+        if oshape is None or cshape is None:
+            return None, "no_shape"
+        # the other operand must broadcast INTO the chain's shape: an
+        # EXPANDING broadcast (a chain dim of 1 against a larger
+        # operand dim) changes the region's output shape, which the
+        # fused node's shape inference reports as the base output —
+        # reject rather than mis-infer
+        if len(oshape) != len(cshape) or any(
+                o != c and o != 1 for o, c in zip(oshape, cshape)):
+            return None, "expanding_broadcast"
+        if tuple(oshape) == tuple(cshape):
+            bshape = "full"
+        elif (all(d == 1 for d in oshape[:-1])
+              and oshape[-1] == cshape[-1]):
+            bshape = "lastdim"
+        else:
+            bshape = "other"
+        return {"op": canon, "kind": "vec", "slot": int(slot),
+                "bshape": bshape}, None
+    return None, "op:%s" % canon
+
+
+def _depends_on(entry, region_ids):
+    """True when ``entry``'s subgraph reaches any region member — an
+    extra input that would close a cycle through the fused node."""
+    for n in topo_from([entry]):
+        if id(n) in region_ids:
+            return True
+    return False
+
+
+def _walk_chain(ctx, base, cons, out_set, claimed):
+    """Absorb the longest epilogue chain hanging off ``base``.  Returns
+    (steps, extras, members, reason): empty steps + a reason when no
+    chain exists."""
+    steps, extras, members = [], [], [base]
+    region_ids = {id(base)}
+    cur = base
+    reason = None
+    while True:
+        if (id(cur), 0) in out_set:
+            reason = reason or "graph_output"
+            break
+        consumers = cons.get(id(cur), ())
+        if len(consumers) != 1:
+            reason = reason or ("multi_consumer" if len(consumers) > 1
+                                else "dead")
+            break
+        consumer, slot = consumers[0]
+        if id(consumer) in claimed:
+            reason = reason or "claimed_consumer"
+            break
+        if num_outputs_of(consumer) != 1:
+            reason = reason or "multi_output_consumer"
+            break
+        step, why = _classify(ctx, consumer, slot, (cur, 0))
+        if step is None:
+            reason = reason or why
+            break
+        if step["kind"] in ("res", "vec"):
+            other = consumer.inputs[1 - slot]
+            if _depends_on(other, region_ids):
+                reason = reason or "extra_input_cycle"
+                break
+            extras.append(other)
+        steps.append(step)
+        members.append(consumer)
+        region_ids.add(id(consumer))
+        cur = consumer
+    return steps, extras, members, reason
+
+
+def run_fuse(ctx):
+    """The fuse pass (see module docstring).  Emits a region/rejection
+    report through ``ctx.pass_extras['fuse']`` for the graph_pass
+    provider and the perf_report fusion-adoption column."""
+    from ..config import get_flag
+
+    detail = {"regions": [], "rejected": {}, "saved_bytes": 0}
+    ctx.pass_extras["fuse"] = detail
+    min_bytes = max(0, get_flag("MXNET_FUSION_MIN_BYTES"))
+    cons = consumers_of(ctx.outputs)
+    out_set = {(id(n), i) for n, i in ctx.outputs}
+    claimed = set()
+    entry_map = {}
+    count = 0
+    for node in topo_from(ctx.outputs):
+        if node.is_variable or id(node) in claimed:
+            continue
+        canon = node.opdef().name
+        if canon not in FUSE_BASES or num_outputs_of(node) != 1:
+            continue
+        steps, extras, members, reason = _walk_chain(
+            ctx, node, cons, out_set, claimed)
+        if not steps:
+            detail["rejected"][node.name] = reason or "no_epilogue"
+            continue
+        tail = members[-1]
+        out_shape = ctx.shape_of((tail, 0))
+        if out_shape is None:
+            detail["rejected"][node.name] = "no_shape"
+            continue
+        out_elems = 1
+        for d in out_shape:
+            out_elems *= int(d)
+        # the perf-layer candidate formula: every interior output is
+        # written to and re-read from HBM unfused — 2 x out_bytes per
+        # interior tensor (all region interiors share the out shape;
+        # epilogue steps are shape-preserving)
+        saved = 2 * len(steps) * out_elems * _SCORE_DTYPE_BYTES
+        if saved < min_bytes:
+            detail["rejected"][node.name] = "below_min_bytes:%d" % saved
+            continue
+        fused = make_node(
+            "_FusedRegion", tail.name,
+            list(node.inputs) + extras,
+            base_op=canon,
+            base_attrs=json.dumps(dict(node.attrs), sort_keys=True),
+            epilogue=json.dumps(steps, sort_keys=True),
+            n_base=len(node.inputs))
+        fused.user_attrs["__fused_members__"] = json.dumps(
+            [m.name for m in members])
+        fused.user_attrs["__fused_ops__"] = json.dumps(
+            [m.opdef().name for m in members])
+        entry_map[(id(tail), 0)] = (fused, 0)
+        claimed.update(id(m) for m in members)
+        detail["regions"].append({
+            "name": tail.name, "base": node.name, "base_op": canon,
+            "ops": [m.opdef().name for m in members],
+            "members": [m.name for m in members],
+            "saved_bytes": saved})
+        detail["saved_bytes"] += saved
+        count += 1
+    if entry_map:
+        ctx.outputs = apply_entry_map(ctx.outputs, entry_map)
+        ctx.invalidate_shapes()
+    return count

@@ -151,6 +151,13 @@ def node_cost(op, attrs, in_shapes, out_shapes, dtype_bytes=4):
     * dot / batch_dot — ``2 * contract_dim * out_elems``.
     * elemwise — ``ELEMWISE_FLOPS[op] * out_elems`` (default 1);
       movement ops 0; Pooling counts per input element.
+    * ``_FusedRegion`` — base-op FLOPs plus each epilogue step's
+      elemwise FLOPs, but EXTERIOR bytes only: once a region IS fused
+      its interior tensors stay in VMEM, so the pre-fusion double-count
+      (the fusion saving) stops being charged — the MFU denominator and
+      roofline table tell the truth post-fusion
+      (``2 * steps * out_bytes`` saved exactly, pinned by
+      tests/test_fusion.py).
     * bytes — every input read once + every output written once at
       ``dtype_bytes`` each (pre-fusion accounting: a producer's output
       and its consumer's read both count, which is exactly the traffic
@@ -161,6 +168,34 @@ def node_cost(op, attrs, in_shapes, out_shapes, dtype_bytes=4):
     in_elems = sum(_prod(s) for s in in_shapes)
     out_elems = sum(_prod(s) for s in out_shapes)
     nbytes = (in_elems + out_elems) * int(dtype_bytes)
+
+    if op == "_FusedRegion":
+        import json as _json
+
+        from ..ops.registry import get_op as _get_op
+
+        base_op = attrs.get("base_op", "FullyConnected")
+        try:
+            base = _get_op(base_op)
+            battrs = dict(base.parse_attrs(
+                _json.loads(attrs.get("base_attrs", "{}")))._d)
+            steps = _json.loads(attrs.get("epilogue", "[]"))
+        except Exception:
+            return ELEMWISE_FLOPS.get(op, 1) * out_elems, nbytes
+        n_base = int(attrs.get("n_base", 2))
+        base_flops, _ = node_cost(base_op, battrs, in_shapes[:n_base],
+                                  out_shapes, dtype_bytes=dtype_bytes)
+        flops = base_flops
+        for step in steps:
+            sop = step.get("op")
+            if sop in MOVEMENT_OPS:
+                continue
+            flops += ELEMWISE_FLOPS.get(sop, 1) * out_elems
+        # exterior traffic only: base inputs + epilogue extras read
+        # once, the final output written once — the interior producer/
+        # consumer round trips are gone, which is the saving the fuse
+        # pass claimed (graph_pass/fuse.py region scoring)
+        return flops, nbytes
 
     if op in MOVEMENT_OPS:
         return 0, nbytes
@@ -258,6 +293,8 @@ def program_cost(symbol, topo, var_shapes, dtype_bytes=4, train=False,
 
     ridge = cm.ridge_intensity()
     rows = []
+    fused_regions = []
+    fused_saved = 0
     total_flops = total_bytes = 0
     for node in topo:
         if node.is_variable:
@@ -272,7 +309,7 @@ def program_cost(symbol, topo, var_shapes, dtype_bytes=4, train=False,
         total_flops += flops
         total_bytes += nbytes
         out_elems = sum(_prod(s) for s in node_outs if s is not None)
-        rows.append({
+        row = {
             "name": node.name, "op": node.op,
             "flops": flops, "bytes": nbytes,
             "out_bytes": out_elems * int(dtype_bytes),
@@ -280,7 +317,29 @@ def program_cost(symbol, topo, var_shapes, dtype_bytes=4, train=False,
             "bound": ("compute" if nbytes and flops / nbytes >= ridge
                       else "bandwidth"),
             "roofline_s": cm.roofline_seconds(flops, nbytes),
-        })
+        }
+        if node.op == "_FusedRegion":
+            # interior accounting: every epilogue step's input was a
+            # producer-write + consumer-read pair pre-fusion — exactly
+            # 2 * out_bytes per step (region interiors share the output
+            # shape); the saving the pre-fusion tables double-counted
+            # and the fused program no longer pays
+            try:
+                import json as _json
+
+                n_steps = len(_json.loads(attrs.get("epilogue", "[]")))
+                members = _json.loads(
+                    node.user_attrs.get("__fused_members__", "[]"))
+            except Exception:
+                n_steps, members = 0, []
+            saved = 2 * n_steps * row["out_bytes"]
+            row["fused"] = True
+            row["members"] = members
+            row["interior_saved_bytes"] = saved
+            fused_saved += saved
+            fused_regions.append({"name": node.name, "members": members,
+                                  "saved_bytes": saved})
+        rows.append(row)
     if train:
         total_flops *= TRAIN_FLOPS_MULT
         total_bytes *= TRAIN_BYTES_MULT
@@ -297,6 +356,8 @@ def program_cost(symbol, topo, var_shapes, dtype_bytes=4, train=False,
         "ridge_intensity": ridge,
         "ops": rows,
         "fusion_candidates": fusion_candidates(rows),
+        "fused_regions": fused_regions,
+        "fused_saved_bytes": fused_saved,
     }
 
 
@@ -307,11 +368,15 @@ def fusion_candidates(rows, k=8):
     is written to and re-read from HBM today (``2 * out_bytes``), and
     would stay in registers/VMEM fused.  Ranked by saved bytes
     descending: the top entries are where a fusion-region pass (ROADMAP
-    item 3) buys the most."""
+    item 3) buys the most.  ``_FusedRegion`` rows never join a run —
+    the fuse pass already consumed them, so the list shows only the
+    REMAINING headroom (tools/perf_report.py renders it as the adoption
+    column)."""
     out = []
     run = []
     for row in rows + [None]:
         if row is not None and row["bound"] == "bandwidth" \
+                and not row.get("fused") \
                 and (row["flops"] or row["bytes"]):
             run.append(row)
             continue
@@ -392,6 +457,9 @@ def note_program_run(cost, device_s, host_s, replicas=1):
                 "ops_top": [dict(r) for r in ops],
                 "fusion_candidates": [dict(c)
                                       for c in cost["fusion_candidates"]],
+                "fused_regions": [dict(r)
+                                  for r in cost.get("fused_regions", ())],
+                "fused_saved_bytes": cost.get("fused_saved_bytes", 0),
                 "runs": 0, "warmup_runs": 0, "replicas": int(replicas),
                 "device_ms_last": None, "device_ms_best": None,
                 "device_ms_ema": None, "host_ms_ema": None,

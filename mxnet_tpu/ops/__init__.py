@@ -24,4 +24,5 @@ from . import random_ops
 from . import rnn
 from . import contrib
 from . import legacy_ops
+from . import fused
 from .. import operator as _operator  # noqa: F401  (registers Custom)

@@ -1,0 +1,388 @@
+"""The ``_FusedRegion`` operator — execution side of the fusion-region
+pass (graph_pass/fuse.py, ISSUE 15).
+
+One node stands in for a carved matmul/conv + epilogue chain.  Its
+attrs carry the whole region: the base op name + its original string
+attrs (re-parsed through the base opdef, so param semantics can never
+drift), and the epilogue as a JSON step list (act / scalar / cast /
+vec / res — the grammar in docs/fusion.md).  Extra epilogue operands
+(residual tensors, per-channel rescale vectors, the int8 island's fp32
+bias) ride as additional node inputs after the base op's own.
+
+Lowering, decided statically at trace time:
+
+* **Pallas fused kernel** (parallel/fused.py) when the base is a
+  float matmul-shaped op on TPU (or under ``MXNET_FUSION_INTERPRET``):
+  FullyConnected, 2-d ``dot``, ``batch_dot`` and 1x1 stride-1 NHWC
+  Convolution — fp32 VMEM accumulation, epilogue before the HBM
+  writeback.  The backward is ``jax.custom_vjp`` over the reference
+  composition (recompute — the flash-attention escape-hatch shape).
+* **Reference composition** otherwise (general convolutions, int8
+  islands whose exact int32 accumulation XLA owns, shapes with no
+  usable tiling, non-TPU backends): the SAME registry ops the unfused
+  graph would run, applied in the same order inside this one node —
+  numerically identical to the unfused subgraph by construction, and
+  the mid-trace-safe fallback the pass contract requires.
+"""
+from __future__ import annotations
+
+import json
+
+from ..base import MXNetError
+from .param import Int, Str
+from .registry import get_op, register_op
+
+__all__ = ["EPILOGUE_ACTS", "fused_region_parts"]
+
+# activation kinds the fuse pass may carve (kernel + reference agree;
+# parallel/fused.py _ACTS is the kernel-side twin, asserted in tests)
+EPILOGUE_ACTS = ("relu", "sigmoid", "tanh", "softrelu", "softsign")
+
+_FLOATS = ("float32", "bfloat16", "float16")
+
+
+def fused_region_parts(attrs):
+    """(base opdef, parsed base attrs, epilogue step list, n_base) from a
+    ``_FusedRegion`` node's parsed attrs — shared by execution, shape
+    and dtype inference, and the perf accounting walk."""
+    base = get_op(attrs.base_op)
+    battrs = base.parse_attrs(json.loads(attrs.base_attrs))
+    steps = json.loads(attrs.epilogue)
+    return base, battrs, steps, int(attrs.n_base)
+
+
+def _extra_steps(steps):
+    return [s for s in steps if s["kind"] in ("vec", "res")]
+
+
+def _apply_reference(base, battrs, steps, base_inputs, extras):
+    """The unfused subgraph, replayed through the SAME registry ops in
+    the same order — the parity contract of the pass."""
+    out = base.apply(battrs, base_inputs)[0][0]
+    ei = 0
+    for step in steps:
+        kind = step["kind"]
+        if kind == "act":
+            op = get_op(step["op"])
+            kw = {"act_type": step["act"]} if step["op"] == "Activation" \
+                else {}
+            out = op.apply(op.parse_attrs(kw), [out])[0][0]
+        elif kind == "scalar":
+            op = get_op(step["op"])
+            out = op.apply(op.parse_attrs({"scalar": step["scalar"]}),
+                           [out])[0][0]
+        elif kind == "cast":
+            op = get_op("Cast")
+            out = op.apply(op.parse_attrs({"dtype": step["dtype"]}),
+                           [out])[0][0]
+        elif kind in ("vec", "res"):
+            op = get_op(step["op"])
+            other = extras[ei]
+            ei += 1
+            ins = [out, other] if step.get("slot", 0) == 0 else [other, out]
+            out = op.apply(op.parse_attrs({}), ins)[0][0]
+        else:
+            raise MXNetError("fused region: unknown epilogue step %r"
+                             % (step,))
+    return out
+
+
+def _kernel_epilogue(steps, out_ndim):
+    """Translate graph steps into the kernel's static epilogue tuples,
+    or None when a step has no kernel form."""
+    from ..parallel import fused as F
+
+    out = []
+    for step in steps:
+        kind = step["kind"]
+        if kind == "act":
+            if not F.supported_act(step["act"]):
+                return None
+            out.append(("act", step["act"]))
+        elif kind == "scalar":
+            out.append(("scalar", step["op"], float(step["scalar"])))
+        elif kind == "cast":
+            if step["dtype"] not in _FLOATS:
+                return None
+            out.append(("cast", step["dtype"]))
+        elif kind == "res":
+            if step["op"] not in ("elemwise_add", "elemwise_mul"):
+                return None
+            out.append(("res", step["op"]))
+        elif kind == "vec":
+            if step.get("bshape") == "full":
+                if step["op"] == "broadcast_add":
+                    out.append(("res", "elemwise_add"))
+                elif step["op"] == "broadcast_mul":
+                    out.append(("res", "elemwise_mul"))
+                else:
+                    return None
+            elif step.get("bshape") == "lastdim" and \
+                    step["op"] == "broadcast_add":
+                out.append(("vadd",))
+            elif step.get("bshape") == "lastdim" and \
+                    step["op"] == "broadcast_mul":
+                out.append(("vmul",))
+            else:
+                # a channel vector on a non-last axis (NCHW conv) has no
+                # kernel form — the reference composition handles it
+                return None
+        else:
+            return None
+    return tuple(out)
+
+
+def _kernel_matmul_form(base, battrs, steps, base_inputs, extras,
+                        out_shape):
+    """(x2d, w, wt, kernel_extras, extra_epilogue_prefix, reshape_back)
+    for the dense 2-d kernel, or None when this base has no matmul
+    form.  The base op's own bias becomes a leading ("bias",) step."""
+    name = base.name
+    prefix = []
+    if name == "FullyConnected":
+        data, weight = base_inputs[0], base_inputs[1]
+        x = data.reshape(data.shape[0], -1) if battrs.flatten else \
+            data.reshape(-1, data.shape[-1])
+        if not battrs.no_bias:
+            prefix.append(("bias",))
+            extras = [base_inputs[2]] + list(extras)
+        return x, weight, True, extras, prefix, tuple(out_shape)
+    if name == "dot":
+        if battrs.get("transpose_a") or battrs.get("transpose_b"):
+            return None
+        x, w = base_inputs[0], base_inputs[1]
+        if x.ndim != 2 or w.ndim != 2:
+            return None
+        return x, w, False, list(extras), prefix, tuple(out_shape)
+    if name == "Convolution":
+        layout = battrs.layout or ""
+        if (tuple(battrs.kernel) != (1, 1) or not layout.endswith("C")
+                or tuple(battrs.stride or (1, 1)) != (1, 1)
+                or tuple(battrs.pad or (0, 0)) != (0, 0)
+                or int(battrs.num_group or 1) != 1
+                or bool(battrs.get("dilate") and
+                        tuple(battrs.dilate) != (1, 1))):
+            return None
+        data, weight = base_inputs[0], base_inputs[1]
+        if data.ndim != 4:
+            return None
+        N, H, W, C = data.shape
+        x = data.reshape(N * H * W, C)
+        w = weight.reshape(C, int(battrs.num_filter))  # HWIO, 1x1
+        if not battrs.no_bias:
+            prefix.append(("bias",))
+            extras = [base_inputs[2]] + list(extras)
+        return x, w, False, extras, prefix, tuple(out_shape)
+    return None
+
+
+def _try_kernel(base, battrs, steps, base_inputs, extras, out_aval,
+                interpret):
+    """The Pallas lowering, or None (caller composes the reference)."""
+    from ..parallel import fused as F
+
+    if any(str(t.dtype) not in _FLOATS
+           for t in list(base_inputs) + list(extras)):
+        return None
+    kern_steps = _kernel_epilogue(steps, len(out_aval.shape))
+    if kern_steps is None:
+        return None
+    name = base.name
+    if name == "batch_dot":
+        if battrs.get("transpose_a") or battrs.get("transpose_b"):
+            return None
+        x, w = base_inputs[0], base_inputs[1]
+        if x.ndim != 3 or w.ndim != 3:
+            return None
+        B, M, _ = x.shape
+        N = w.shape[2]
+        res = [e.reshape(B, M, N) for e in extras]
+        return F.fused_batch_matmul(x, w, extras=res, epilogue=kern_steps,
+                                    out_dtype=out_aval.dtype,
+                                    interpret=interpret)
+    form = _kernel_matmul_form(base, battrs, steps, base_inputs, extras,
+                               out_aval.shape)
+    if form is None:
+        return None
+    x, w, wt, kextras, prefix, out_shape = form
+    M = x.shape[0]
+    N = w.shape[0] if wt else w.shape[1]
+    shaped = []
+    for step, arr in zip(list(prefix) + list(
+            _kernel_extra_tuples(kern_steps)), kextras):
+        if step[0] == "res":
+            shaped.append(arr.reshape(M, N))
+        else:
+            shaped.append(arr.reshape(-1))
+    out = F.fused_matmul(x, w, extras=shaped,
+                         epilogue=tuple(prefix) + kern_steps, wt=wt,
+                         out_dtype=out_aval.dtype, interpret=interpret)
+    if out is None:
+        return None
+    return out.reshape(out_shape)
+
+
+def _kernel_extra_tuples(kern_steps):
+    return [s for s in kern_steps if s[0] in ("bias", "vmul", "vadd",
+                                              "res")]
+
+
+def _use_kernel():
+    import jax
+
+    from ..config import get_flag
+
+    if get_flag("MXNET_FUSION_INTERPRET"):
+        return True, True
+    if not get_flag("MXNET_FUSION_KERNEL"):
+        return False, False
+    return jax.default_backend() == "tpu", False
+
+
+def _fused_region(attrs, *inputs):
+    import jax
+
+    base, battrs, steps, n_base = fused_region_parts(attrs)
+    base_inputs = list(inputs[:n_base])
+    extras = list(inputs[n_base:])
+    use_kernel, interpret = _use_kernel()
+
+    def reference(*ins):
+        return _apply_reference(base, battrs, steps, list(ins[:n_base]),
+                                list(ins[n_base:]))
+
+    if not use_kernel:
+        return reference(*inputs)
+    out_aval = jax.eval_shape(reference, *inputs)
+
+    def kernel_or_ref(*ins):
+        ka = _try_kernel(base, battrs, steps, list(ins[:n_base]),
+                         list(ins[n_base:]), out_aval, interpret)
+        return ka if ka is not None else reference(*ins)
+
+    # eligibility probe under eval_shape: the decision (shapes, dtypes,
+    # tiling) is static, and probing ABSTRACTLY keeps the pallas_call
+    # out of any surrounding autodiff trace — only the custom_vjp call
+    # below ever executes it (its backward is the reference recompute)
+    try:
+        probed = jax.eval_shape(
+            lambda *ins: _try_kernel(base, battrs, steps,
+                                     list(ins[:n_base]),
+                                     list(ins[n_base:]), out_aval,
+                                     interpret), *inputs)
+        has_kernel = probed is not None
+    except Exception:
+        has_kernel = False
+    if not has_kernel:
+        # no kernel form at this shape/dtype — the mid-trace-safe
+        # fallback: lower the unfused composition (flash attention's
+        # prime-T rule applied to fusion regions)
+        return reference(*inputs)
+
+    # Pallas forward, reference-recompute backward: the custom_vjp keeps
+    # training binds differentiable without a hand-written backward per
+    # epilogue combination (the residuals are just the region inputs)
+    @jax.custom_vjp
+    def f(*ins):
+        return kernel_or_ref(*ins)
+
+    def fwd(*ins):
+        return kernel_or_ref(*ins), ins
+
+    def bwd(res, g):
+        _, vjp = jax.vjp(reference, *res)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f(*inputs)
+
+
+def _fused_num_inputs(attrs):
+    steps = json.loads(attrs.epilogue)
+    return int(attrs.n_base) + len(_extra_steps(steps))
+
+
+def _fused_input_names(attrs):
+    base = get_op(attrs.base_op)
+    battrs = base.parse_attrs(json.loads(attrs.base_attrs))
+    names = base.get_input_names(battrs)
+    steps = json.loads(attrs.epilogue)
+    return names + ["fused_extra%d" % i
+                    for i in range(len(_extra_steps(steps)))]
+
+
+def _fused_infer_shape(attrs, in_shapes, aux_shapes):
+    base, battrs, steps, n_base = fused_region_parts(attrs)
+    res = base.run_infer_shape(battrs, in_shapes[:n_base], [])
+    if res is None:
+        return None
+    base_in, outs = list(res[0]), list(res[1])
+    out = outs[0]
+    extras = []
+    for i, step in enumerate(_extra_steps(steps)):
+        given = in_shapes[n_base + i] if n_base + i < len(in_shapes) \
+            else None
+        same_shape = step["kind"] == "res" or step.get("bshape") == "full"
+        if given is None:
+            extras.append(tuple(out) if out is not None and same_shape
+                          else None)
+        elif same_shape and out is not None and len(given) == len(out):
+            # the _bcast_infer partial-dim discipline: an unknown (0)
+            # extra dim backfills from the region output — the backward
+            # shape flow RNN begin-state zeros ride through residual/
+            # h2h-add chains
+            extras.append(tuple(o if g == 0 else g
+                                for g, o in zip(given, out)))
+        else:
+            extras.append(tuple(given))
+    return (base_in + extras, [out], aux_shapes)
+
+
+def _fused_infer_backward(attrs, out_shapes, in_shapes):
+    """Backward shape flow through the region: epilogue steps preserve
+    shape, so the region output IS the base output — delegate to the
+    base op's backward rule (FullyConnected assigns batch from the
+    output; RNN begin-state zeros depend on this flow reaching through
+    fused FC+activation chains) and backfill same-shape extras."""
+    base, battrs, steps, n_base = fused_region_parts(attrs)
+    out = list(in_shapes)
+    if base.infer_backward is not None:
+        back = base.infer_backward(battrs, list(out_shapes),
+                                   list(in_shapes[:n_base]))
+        if back is not None:
+            out[:n_base] = list(back)[:n_base]
+    o = out_shapes[0] if out_shapes else None
+    for i, step in enumerate(_extra_steps(steps)):
+        j = n_base + i
+        if j < len(out) and out[j] is None and o is not None and (
+                step["kind"] == "res" or step.get("bshape") == "full"):
+            out[j] = tuple(o)
+    if out == list(in_shapes):
+        return None
+    return out
+
+
+def _fused_infer_dtype(attrs, in_dtypes, aux_dtypes):
+    base, battrs, steps, n_base = fused_region_parts(attrs)
+    res = base.run_infer_dtype(battrs, in_dtypes[:n_base], [])
+    d = res[1][0] if res is not None else (in_dtypes[0] or "float32")
+    for step in steps:
+        if step["kind"] == "cast":
+            d = step["dtype"]
+    return (list(in_dtypes), [d], list(aux_dtypes))
+
+
+register_op(
+    "_FusedRegion", _fused_region,
+    params={"base_op": Str(), "base_attrs": Str(default="{}"),
+            "epilogue": Str(default="[]"), "n_base": Int(default=2)},
+    num_inputs=_fused_num_inputs,
+    input_names=_fused_input_names,
+    infer_shape=_fused_infer_shape,
+    infer_backward=_fused_infer_backward,
+    infer_dtype=_fused_infer_dtype,
+    visible=False,
+    doc="Fusion-region node (graph_pass/fuse.py): base matmul/conv + "
+        "epilogue chain lowered to a Pallas fused kernel "
+        "(parallel/fused.py) with an unfused reference-composition "
+        "fallback.  Never user-constructed; docs/fusion.md.")

@@ -201,15 +201,31 @@ def test_node_count_reduction_and_label_pruned():
     assert len(opt.fold_exprs) > 0
 
 
+def _conv_layouts(prog):
+    """Layouts of every Convolution in a compiled program, INCLUDING
+    convs living inside ``_FusedRegion`` nodes (the fuse pass runs
+    after layout, so rewritten convs normally arrive here fused)."""
+    import json as _json
+
+    out = []
+    for n in prog.topo:
+        if n.op == "Convolution":
+            out.append(n.parsed_attrs().layout)
+        elif n.op == "_FusedRegion":
+            attrs = n.parsed_attrs()
+            if attrs.base_op == "Convolution":
+                out.append(_json.loads(attrs.base_attrs).get("layout"))
+    return out
+
+
 def test_layout_rewrite_forced_nhwc():
     builder = ZOO["bn_heavy"]
     _sym, args, auxs, x = _materialize(builder)
     _m0, ref = _predict(builder, "off", args, auxs, x)
     m1, opt_out = _predict(builder, "default,layout=NHWC", args, auxs, x)
     np.testing.assert_allclose(opt_out, ref, rtol=1e-5, atol=1e-6)
-    convs = [n for n in m1._exec_group.execs[0]._prog.topo
-             if n.op == "Convolution"]
-    assert convs and all(n.parsed_attrs().layout == "NHWC" for n in convs)
+    layouts = _conv_layouts(m1._exec_group.execs[0]._prog)
+    assert layouts and all(l == "NHWC" for l in layouts)
 
 
 def test_layout_consults_autotuner_cache(own_tune_cache):
@@ -222,10 +238,8 @@ def test_layout_consults_autotuner_cache(own_tune_cache):
     _sym, args, auxs, x = _materialize(builder)
     _m0, ref = _predict(builder, "off", args, auxs, x)
     m1, out = _predict(builder, "default", args, auxs, x)
-    convs = [n for n in m1._exec_group.execs[0]._prog.topo
-             if n.op == "Convolution"]
-    assert convs and all(n.parsed_attrs().layout == "NHWC"
-                         for n in convs)
+    layouts = _conv_layouts(m1._exec_group.execs[0]._prog)
+    assert layouts and all(l == "NHWC" for l in layouts)
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 

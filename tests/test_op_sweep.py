@@ -168,6 +168,8 @@ SKIP = {
     "SequenceMask": "tests/test_rnn.py",
     "SequenceReverse": "tests/test_rnn.py",
     "RNN": "tests/test_rnn.py fused RNN suite",
+    "_FusedRegion": "tests/test_fusion.py (pass-generated fusion-region "
+                    "node, never user-constructed)",
     "Custom": "tests/test_custom_op.py",
     "ctc_loss": "tests/test_loss.py ctc",
     "contrib_ctc_loss": "alias, tests/test_loss.py",

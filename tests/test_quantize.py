@@ -125,8 +125,11 @@ def test_calibration_determinism_and_roundtrip(tmp_path):
     t2 = graph_pass.calibrate(mod, batches)
     assert len(t1) > 3 and t1.batches == 3
     assert t1.fingerprint() == t2.fingerprint()
-    # node outputs AND the data input are both observed
-    assert "data" in t1.ranges() and "c0_output" in t1.ranges()
+    # node outputs AND the data input are both observed; under the
+    # default pipeline the fuse pass leaves only region TAIL entries
+    # visible (act0 is the c0+relu region's tail) — exactly the entries
+    # a later quantize rewrite resolves against (docs/fusion.md)
+    assert "data" in t1.ranges() and "act0_output" in t1.ranges()
     path = str(tmp_path / "table.json")
     t1.save(path)
     t3 = CalibrationTable.load(path)
@@ -280,6 +283,45 @@ def test_bn_fold_then_quantize_composition():
     opt_ops = {n.opdef().name for n in exe._opt.symbol.topo_nodes()
                if not n.is_variable}
     assert "BatchNorm" not in opt_ops
+
+
+def test_quantize_fuse_epilogue_composition():
+    """ISSUE 15 satellite: an int8 island's per-channel rescale + fp32
+    bias (+ relu when present) folds into the fused-region epilogue
+    instead of trailing as separate dequant nodes — same arithmetic,
+    one node, and top-1 rides the existing agreement bars."""
+    import json as _json
+
+    sym, dshape = _conv_net()
+    args, auxs, x = _materialize(sym, dshape, head="fc_weight")
+    mod = _bind(sym, "default,-fuse", dshape, args, auxs)
+    table = graph_pass.calibrate(mod, [x])
+    ref = _predict(mod, x)
+    graph_pass.set_calibration_table(table)
+    q_unfused = _bind(sym, "default,quantize,-fuse", dshape, args, auxs)
+    out_unfused = _predict(q_unfused, x)
+    q_fused = _bind(sym, "default,quantize", dshape, args, auxs)
+    out_fused = _predict(q_fused, x)
+    # fused-vs-unfused int8 is the SAME graph arithmetic regrouped:
+    # exact, not just argmax-agreeing
+    np.testing.assert_allclose(out_fused, out_unfused, rtol=1e-5,
+                               atol=1e-6)
+    agreement = (ref.argmax(1) == out_fused.argmax(1)).mean()
+    assert agreement >= 0.99, agreement
+    exe = q_fused._exec_group.execs[0]
+    regions = exe.fused_regions()
+    assert regions
+    # at least one region carries the island epilogue: the f32 cast +
+    # per-channel rescale + bias chain lives INSIDE a fused node...
+    island = [r for r in regions if "Cast" in _json.dumps(r["members"])
+              or any(m.endswith("_f32") for m in r["members"])]
+    assert island, regions
+    # ...and no dequant broadcast_mul/broadcast_add trails a quantized
+    # contraction as a separate node (softmax head aside, the epilogue
+    # was consumed)
+    topo_ops = [n.opdef().name for n in exe._prog.topo]
+    fused_count = topo_ops.count("_FusedRegion")
+    assert fused_count == len(regions) >= 3
 
 
 def test_compile_count_flat_across_rebinds(telemetry):

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""CPU-fast fusion-region + learned-cost-model smoke (tier-1 CI guard,
+docs/fusion.md).
+
+End-to-end in seconds on CPU, the way production uses the layer:
+
+1. **regions carved** — the default pipeline must fuse >= 1 region on
+   BOTH a resnet-toy (conv + relu + residual-add chains after bn_fold)
+   and a transformer block (batch_dot + scalar/residual chains), with
+   the analytic interior-bytes saving > 0,
+2. **numeric parity** — fused predictions match the unfused pipeline
+   (``default,-fuse``) at fp32 tolerances, on the reference-composition
+   path AND on the real Pallas kernel path (MXNET_FUSION_INTERPRET=1),
+3. **flat re-bind cost** — reshaping to an already-seen batch shape
+   re-runs neither the pass pipeline nor XLA compilation,
+4. **cost model lifecycle** — a measured ``fusion.blocks`` sweep
+   records samples, training persists the model + holdout-gate verdict,
+   and a SECOND PROCESS warm-loads it with zero re-training (the
+   tuning-cache acceptance bar applied to the model file); the search
+   ranking provably degrades to analytic when the gate fails.
+
+Prints a one-line JSON summary (optionally written to argv[1]); any
+violation raises, failing the CI step.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_WORKDIR = tempfile.mkdtemp(prefix="fuse_smoke_")
+# FORCE scratch paths (not setdefault): the smoke appends synthetic
+# training rows, overwrites the model file, and finally re-saves it
+# with gate_ok=False (the degrade witness) — none of which may ever
+# touch a user's real cache/samples/model (the bench_fusion scratch
+# discipline); the warm-load subprocess inherits the scratch env
+os.environ["MXNET_TUNE_CACHE"] = os.path.join(_WORKDIR, "tuning.json")
+os.environ["MXNET_COST_MODEL_PATH"] = os.path.join(_WORKDIR,
+                                                   "cost_model.json")
+os.environ["MXNET_TUNE_FINGERPRINT"] = "fuse_smoke"
+os.environ.setdefault("MXNET_COST_MODEL_MIN_SAMPLES", "6")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autotune, graph_pass  # noqa: E402
+from mxnet_tpu.autotune import learned  # noqa: E402
+from mxnet_tpu.config import set_flag  # noqa: E402
+from mxnet_tpu.io import NDArrayIter  # noqa: E402
+from mxnet_tpu.observability import metrics as M  # noqa: E402
+from mxnet_tpu.observability import set_enabled  # noqa: E402
+
+
+def _resnet_toy():
+    from mxnet_tpu.models import get_resnet
+
+    sym = get_resnet(num_classes=10, num_layers=8, image_shape=(3, 16, 16))
+    return sym, (2, 3, 16, 16)
+
+
+def _transformer_block():
+    T, D = 8, 16
+    data = mx.sym.var("data")
+    q = mx.sym.FullyConnected(data, num_hidden=D, flatten=False, name="q")
+    k = mx.sym.FullyConnected(data, num_hidden=D, flatten=False, name="k")
+    v = mx.sym.FullyConnected(data, num_hidden=D, flatten=False, name="v")
+    scores = mx.sym.batch_dot(q, mx.sym.transpose(k, axes=(0, 2, 1)))
+    attn = mx.sym.softmax(scores / float(np.sqrt(D)), axis=-1)
+    ctx = mx.sym.batch_dot(attn, v)
+    out = mx.sym.FullyConnected(ctx + data, num_hidden=D, flatten=False,
+                                name="proj")
+    flat = mx.sym.Flatten(out)
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(flat, num_hidden=4, name="head"),
+        name="softmax"), (4, T, D)
+
+
+def _materialize(builder, seed=7):
+    sym, dshape = builder()
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=dshape)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n != "data" and not n.endswith("label")}
+    auxs = {n: mx.nd.array(rng.uniform(0.5, 1.5, s).astype(np.float32))
+            for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    x = rng.uniform(0, 1, dshape).astype(np.float32)
+    return sym, dshape, args, auxs, x
+
+
+def _predict(builder, spec, args, auxs, x, dshape, interpret=0):
+    graph_pass.set_passes(spec)
+    set_flag("MXNET_FUSION_INTERPRET", interpret)
+    try:
+        sym, _ = builder()
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[("data", dshape)], for_training=False)
+        mod.init_params(mx.init.Uniform(0.1))
+        mod.set_params(args, auxs)
+        out = mod.predict(NDArrayIter(x, None, batch_size=x.shape[0]))
+        return mod, out.asnumpy()
+    finally:
+        set_flag("MXNET_FUSION_INTERPRET", None)
+        graph_pass.set_passes(None)
+
+
+def _fuse_summary():
+    for rep in reversed(graph_pass.recent_reports()):
+        if "fuse" in rep:
+            return rep["fuse"]
+    return {"regions": [], "saved_bytes": 0}
+
+
+def check_regions_and_parity():
+    out = {}
+    for name, builder in (("resnet_toy", _resnet_toy),
+                          ("transformer_block", _transformer_block)):
+        _sym, dshape, args, auxs, x = _materialize(builder)
+        _m0, ref = _predict(builder, "default,-fuse", args, auxs, x, dshape)  # graftlint: disable=G001 — 2-model smoke comparison, host fetch is the point
+        graph_pass.reset_stats()
+        _m1, fused = _predict(builder, "default", args, auxs, x, dshape)  # graftlint: disable=G001 — 2-model smoke comparison, host fetch is the point
+        summary = _fuse_summary()
+        n_regions = len(summary["regions"])
+        saved = summary["saved_bytes"]
+        if n_regions < 1:
+            raise AssertionError("%s: no fused regions carved" % name)
+        if saved <= 0:
+            raise AssertionError("%s: no interior bytes saved" % name)
+        np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg="%s fused-vs-unfused" % name)
+        # the real Pallas kernel path (interpret mode on CPU)
+        _m2, kern = _predict(builder, "default", args, auxs, x, dshape,  # graftlint: disable=G001 — 2-model smoke comparison, host fetch is the point
+                             interpret=1)
+        np.testing.assert_allclose(kern, ref, rtol=2e-4, atol=1e-5,
+                                   err_msg="%s kernel-vs-unfused" % name)
+        out[name] = {"regions": n_regions, "saved_bytes": saved}
+    return out
+
+
+def check_rebind_flat():
+    set_enabled(True)
+    try:
+        builder = _transformer_block
+        _sym, dshape, args, auxs, x = _materialize(builder)
+        graph_pass.set_passes("default")
+        try:
+            sym, _ = builder()
+            mod = mx.mod.Module(sym, context=mx.cpu())
+            mod.bind(data_shapes=[("data", dshape)], for_training=False)
+            mod.init_params(mx.init.Uniform(0.1))
+            mod.set_params(args, auxs)
+            mod.predict(NDArrayIter(x, None, batch_size=x.shape[0]))
+            runs0 = graph_pass.stats()["pipeline_runs"]
+            small = x[:2]
+            for _ in range(2):
+                mod.reshape([("data", small.shape)])
+                mod.predict(NDArrayIter(small, None, batch_size=2))
+                mod.reshape([("data", x.shape)])
+                mod.predict(NDArrayIter(x, None, batch_size=x.shape[0]))
+            assert graph_pass.stats()["pipeline_runs"] == runs0, \
+                "re-binds re-ran the pass pipeline under fuse"
+            c1 = M.get_value("jit.compile_count", 0)
+            mod.reshape([("data", small.shape)])
+            mod.predict(NDArrayIter(small, None, batch_size=2))
+            c2 = M.get_value("jit.compile_count", 0)
+            assert c2 == c1, "a shape seen before recompiled (fused)"
+        finally:
+            graph_pass.set_passes(None)
+    finally:
+        set_enabled(False)
+    return {"compile_flat": True}
+
+
+def check_cost_model():
+    # a real measured sweep over the fused kernel (interpret mode) —
+    # every timing is a training sample
+    autotune.tune_fused_matmul(128, 128, 256, trials=6, repeats=2)
+    n_samples = learned.sample_count()
+    assert n_samples >= 5, ("sweep recorded too few samples: %d"
+                            % n_samples)
+    # widen the dataset across enough search GROUPS that the holdout
+    # split is genuine (one real sweep is a single group — the gate
+    # rightly refuses to pass on in-sample evidence): deterministic
+    # synthetic searches whose measured time is learnable and whose
+    # analytic cost ranks backward
+    rows = []
+    for g in range(8):
+        for i in range(8):
+            a = 2 ** (i % 4)
+            rows.append({"op": "fusesmoke.knob", "candidate": {"a": a},
+                         "ctx": {"M": 64 * (g + 1)},
+                         "s": 1e-3 * (abs(a - 4) + 1) * (1 + 0.05 * g),
+                         "analytic_s": 1e-3 / a})
+    learned.append_samples(rows)
+    model = learned.train(min_samples=4)
+    assert model is not None, "training did not run"
+    meta = dict(model.meta)
+    assert not meta.get("in_sample"), "holdout split was degenerate"
+    assert meta.get("n_holdout_groups", 0) >= 1
+    assert os.path.exists(learned.model_path()), "model not persisted"
+
+    # second process: warm-load, ZERO re-training, and the ranking
+    # honors the persisted gate verdict
+    code = (
+        "import os, sys, json\n"
+        "sys.path.insert(0, %r)\n"
+        "from mxnet_tpu.autotune import learned\n"
+        "m = learned.load()\n"
+        "assert m is not None, 'warm process failed to load the model'\n"
+        "st = learned.stats()\n"
+        "assert st['trainings'] == 0, 'warm process re-trained'\n"
+        "rm = learned.ranking_model()\n"
+        "gate = bool(m.meta.get('gate_ok'))\n"
+        "assert (rm is not None) == gate, 'ranking ignored the gate'\n"
+        "print(json.dumps({'warm_gate_ok': gate,\n"
+        "                  'warm_trainings': st['trainings']}))\n"
+        % _REPO)
+    env = dict(os.environ)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    if res.returncode != 0:
+        raise AssertionError("warm-load subprocess failed:\n%s\n%s"
+                             % (res.stdout, res.stderr))
+    warm = json.loads(res.stdout.strip().splitlines()[-1])
+
+    # degrade witness: force the gate off, the next search must rank
+    # analytically
+    model.meta["gate_ok"] = False
+    model.save()
+    learned.reset()
+    assert learned.ranking_model() is None, \
+        "gate-failed model still served for ranking"
+    return {"samples": n_samples,
+            "spearman_learned": meta.get("spearman_learned"),
+            "spearman_analytic": meta.get("spearman_analytic"),
+            "gate_ok": meta.get("gate_ok"), **warm}
+
+
+def main(out_path=None):
+    summary = {}
+    summary["parity"] = check_regions_and_parity()
+    summary["rebind"] = check_rebind_flat()
+    summary["cost_model"] = check_cost_model()
+    summary["ok"] = True
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))

@@ -148,6 +148,86 @@ def format_roofline(section, path, k_ops=12):
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------- fusion
+def load_graph_pass_section(spec):
+    """The ``graph_pass`` provider section (fuse-pass region/rejection
+    reports) from a flight-recorder dump, or {} when the source carries
+    none (ledger rows, raw perf summaries)."""
+    path = spec.rpartition(":")[0] if (not os.path.exists(spec)
+                                       and ":" in spec) else spec
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if isinstance(payload, dict) and "providers" in payload:
+        return (payload.get("providers") or {}).get("graph_pass") or {}
+    if isinstance(payload, dict):
+        return payload.get("graph_pass") or {}
+    return {}
+
+
+def fusion_adoption(section, gp_section=None):
+    """Per-program fusion adoption: regions the fuse pass carved
+    (name, members, analytic bytes saved) plus the REMAINING roofline
+    candidates annotated with why they are still unfused — the pass's
+    own rejection reasons when a graph_pass provider section is
+    available.  The report shows headroom, not a re-listing of regions
+    the pass already consumed (those no longer appear as candidates at
+    all — perf.fusion_candidates excludes fused rows)."""
+    rejected = {}
+    for rep in (gp_section or {}).get("recent", ()):
+        fuse = rep.get("fuse") or {}
+        rejected.update(fuse.get("rejected") or {})
+    out = []
+    for prog in section.get("programs", []):
+        regions = prog.get("fused_regions") or []
+        remaining = []
+        for c in prog.get("fusion_candidates") or []:
+            reason = None
+            for op_name in c.get("ops", ()):
+                if op_name in rejected:
+                    reason = rejected[op_name]
+                    break
+            remaining.append({
+                "ops": list(c.get("ops", ())),
+                "saved_bytes": c.get("saved_bytes", 0),
+                "status": ("unfused: %s" % reason if reason
+                           else "unfused (outside region grammar or pass "
+                                "off)")})
+        out.append({"graph": prog.get("graph"), "mode": prog.get("mode"),
+                    "fused_regions": regions,
+                    "fused_saved_bytes": prog.get("fused_saved_bytes", 0),
+                    "remaining": remaining})
+    return out
+
+
+def format_fusion(section, path, gp_section=None):
+    rows = fusion_adoption(section, gp_section)
+    if not rows:
+        return "(no perf program attribution in %s — was MXNET_PERF on " \
+               "and a fit running?)" % path
+    lines = ["# fusion adoption — %s (fused regions vs remaining "
+             "candidates)" % path]
+    for prog in rows:
+        lines.append("%s/%s: %d fused region(s), %.1f KB interior "
+                     "traffic saved/run"
+                     % (prog["graph"], prog["mode"],
+                        len(prog["fused_regions"]),
+                        prog["fused_saved_bytes"] / 1024.0))
+        for r in prog["fused_regions"]:
+            lines.append("  FUSED    [%s] saves %.1f KB"
+                         % (" -> ".join(r.get("members", ())),
+                            r.get("saved_bytes", 0) / 1024.0))
+        for c in prog["remaining"]:
+            lines.append("  headroom [%s] %.1f KB — %s"
+                         % (" -> ".join(c["ops"]),
+                            c["saved_bytes"] / 1024.0, c["status"]))
+        if not prog["fused_regions"] and not prog["remaining"]:
+            lines.append("  (nothing bandwidth-bound to fuse)")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------- waterfall
 def waterfall_rows(section):
     rows = section.get("waterfalls")
@@ -307,6 +387,9 @@ def main(argv=None):
                          "candidates only")
     ap.add_argument("--waterfall", action="store_true",
                     help="per-step waterfall table only")
+    ap.add_argument("--fusion", action="store_true",
+                    help="fusion adoption: fused regions vs remaining "
+                         "candidates with the pass's rejection reasons")
     ap.add_argument("--ledger", nargs="?", const="BENCH_LEDGER.jsonl",
                     metavar="PATH",
                     help="ledger trajectory report + regression verdict "
@@ -345,13 +428,28 @@ def main(argv=None):
                  "--compare)")
     section = load_perf_section(args.source)
     if args.json:
-        print(json.dumps(section, indent=1))
+        if args.fusion:
+            print(json.dumps(fusion_adoption(
+                section, load_graph_pass_section(args.source)), indent=1))
+        else:
+            print(json.dumps(section, indent=1))
+        return 0
+    if args.fusion:
+        print(format_fusion(section, args.source,
+                            load_graph_pass_section(args.source)))
         return 0
     parts = []
     if args.roofline or not args.waterfall:
         parts.append(format_roofline(section, args.source))
     if args.waterfall or not args.roofline:
         parts.append(format_waterfall(section, args.source))
+    # the adoption section joins the default (no-flag) report only when
+    # the source actually carries program attribution — --roofline and
+    # --waterfall keep printing exactly the one table they promise
+    if not args.roofline and not args.waterfall \
+            and section.get("programs"):
+        parts.append(format_fusion(section, args.source,
+                                   load_graph_pass_section(args.source)))
     print("\n\n".join(parts))
     return 0
 
