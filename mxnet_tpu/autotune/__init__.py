@@ -36,8 +36,8 @@ __all__ = ["cache", "registry", "cost_model", "learned", "search",
            "tune_flash_attention", "tune_fused_matmul",
            "tune_serving_buckets", "tune_layout",
            "tune_remat", "tune_generation", "tune_generation_kv",
-           "tune_quantize_layers", "tune_input_pipeline", "tune_control",
-           "flash_shape_key"]
+           "tune_generation_spec", "tune_quantize_layers",
+           "tune_input_pipeline", "tune_control", "flash_shape_key"]
 
 
 # the layout knob has no single in-package call site (models take
@@ -115,6 +115,17 @@ declare(
         "recurrence. tune_generation_kv arbitrates the candidates "
         "against a measured token-agreement budget vs the model-dtype "
         "decode.")
+declare(
+    "generation.spec_k",
+    space={"spec_k": (0, 1, 2, 4, 8)},
+    default=_flag_default("spec_k", "MXNET_GEN_SPEC_K"),
+    doc="Speculation depth of the generation engine (ISSUE 16): draft "
+        "tokens proposed per slot per step, all verified in ONE batched "
+        "program (0 = speculation off). Larger k amortizes more "
+        "scheduler iterations per verify call but wastes verify width "
+        "when acceptance is low — workload-dependent, so "
+        "tune_generation_spec measures it through the live-generator "
+        "replay measurer.")
 # serving-control-plane knobs (ISSUE 14): consulted by the generation
 # engine at construction (explicit GenerationConfig arg > tuning cache
 # > MXNET_GEN_* flag), measured by tuners.tune_control. Declared here
@@ -271,7 +282,8 @@ def __getattr__(name):
     if name in ("tune_flash_attention", "tune_fused_matmul",
                 "tune_serving_buckets",
                 "tune_layout", "tune_remat", "tune_generation",
-                "tune_generation_kv", "tune_quantize_layers",
+                "tune_generation_kv", "tune_generation_spec",
+                "tune_quantize_layers",
                 "tune_input_pipeline", "tune_control",
                 "control_replay_measurer", "pipeline_replay_measurer",
                 "generation_replay_measurer", "flash_shape_key", "tuners"):

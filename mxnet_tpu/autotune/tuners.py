@@ -30,7 +30,8 @@ from .search import SearchConfig, median_time, search
 __all__ = ["flash_shape_key", "tune_flash_attention", "tune_fused_matmul",
            "serving_replay_measurer", "tune_serving_buckets",
            "tune_layout", "tune_remat", "tune_generation",
-           "tune_generation_kv", "tune_quantize_layers", "tune_control",
+           "tune_generation_kv", "tune_generation_spec",
+           "tune_quantize_layers", "tune_control",
            "generation_replay_measurer", "control_replay_measurer",
            "pipeline_replay_measurer", "tune_input_pipeline", "auto_tune"]
 
@@ -347,6 +348,49 @@ def tune_generation(model, params, prompts=None, max_new=8, max_batch=4,
                  ms=res_b.best_s * 1e3, trials=res_b.measured)
     out["generation.decode_blocks"] = res_b.best
     return out
+
+
+def tune_generation_spec(model, params, prompts=None, max_new=16,
+                         max_batch=4, max_seq=128, trials=None,
+                         measure=None):
+    """Measured search over ``generation.spec_k`` (speculation depth,
+    ISSUE 16) for one checkpoint + slot geometry: each candidate k
+    (including 0 = off, so speculation must BEAT the plain decode loop
+    to win) serves a prompt sample on a live generator through the
+    shared replay measurer; wall time decides. The default sample is
+    deliberately repetition-heavy — cyclic token patterns the n-gram
+    prompt-lookup proposer can actually hit — because spec_k's payoff
+    is workload-dependent in a way the geometry knobs are not: pass
+    real prompts for production numbers. Records the winner under
+    ``generation_tune_key`` so a plain ``Generator(model, params)``
+    construction picks it up (explicit config > this cache entry >
+    MXNET_GEN_SPEC_K). Returns ``{"generation.spec_k": value dict}``.
+
+    ``measure`` (tests/smoke) replaces the live-generator measurer:
+    ``measure(candidate) -> seconds``.
+    """
+    from ..serving.generation.engine import generation_tune_key
+
+    if prompts is None:
+        vocab = int(model.cfg["vocab"])
+        rng = np.random.RandomState(0)
+        top = max(1, max_seq - max_new)
+        prompts = []
+        for n, period in ((12, 3), (17, 2), (24, 4), (31, 5)):
+            pat = [int(t) for t in rng.randint(1, vocab, size=period)]
+            reps = min(n, top) // period + 1
+            prompts.append((pat * reps)[:min(n, top)])
+    prompts = [[int(t) for t in p] for p in prompts]
+    key = generation_tune_key(model, max_batch, max_seq)
+    cfg = SearchConfig(trials=trials, repeats=2, warmup=1)
+    mk = measure if measure is not None else generation_replay_measurer(
+        model, params, prompts, max_new=max_new, max_batch=max_batch,
+        max_seq=max_seq, repeats=cfg.repeats, warmup=cfg.warmup)
+    res = search(registry.get("generation.spec_k"), mk,
+                 ctx={"max_seq": max_seq}, cfg=cfg)
+    cache.record("generation.spec_k", key, res.best,
+                 ms=res.best_s * 1e3, trials=res.measured)
+    return {"generation.spec_k": res.best}
 
 
 def control_replay_measurer(model, params, prompts=None, shared_prefix=32,

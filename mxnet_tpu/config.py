@@ -189,6 +189,22 @@ Flags currently honored:
     String-valued, env-only — like MXNET_HEALTH, NOT routed through the
     integer get_flag machinery.
 
+``MXNET_GEN_SPEC_K`` (default 0 = off)
+    Speculation depth of the generation engine (docs/generation.md):
+    each scheduler iteration proposes this many draft tokens per slot
+    and verifies all k+1 positions in ONE compiled batched-verify
+    program, committing 1..k+1 tokens per step — token-exact vs
+    non-speculative decode. 0 keeps the plain q-length-1 decode path
+    bit-for-bit. Resolution: explicit ``GenerationConfig(spec_k=...)``
+    > ``generation.spec_k`` tuning-cache entry
+    (``autotune.tune_generation_spec``) > this flag.
+
+``MXNET_GEN_SPEC_NGRAM`` (default 2)
+    N-gram length of the model-free prompt-lookup draft proposer (used
+    when no draft model is passed): drafts continue the most recent
+    earlier occurrence of the sequence's final n-gram in its own
+    prompt + generated history.
+
 ``MXNET_QUANT_TABLE`` (default unset)
     Calibration-table JSON path the ``quantize`` graph pass resolves
     when no table is attached explicitly (``quantize=<path>`` in
@@ -481,6 +497,8 @@ _DEFAULTS = {
     "MXNET_GEN_PREFIX_CACHE": 0,
     "MXNET_GEN_PREFIX_PAGES": 0,
     "MXNET_GEN_SLO_AGING_MS": 500,
+    "MXNET_GEN_SPEC_K": 0,
+    "MXNET_GEN_SPEC_NGRAM": 2,
     "MXNET_RETRY_MAX": 3,
     "MXNET_RETRY_BASE_MS": 10,
     "MXNET_RETRY_MAX_MS": 2000,

@@ -35,7 +35,8 @@ from ..autotune import cost_model as _tune_cost
 from ..autotune.registry import declare as _declare_tunable
 from ..config import get_flag
 
-__all__ = ["flash_attention", "paged_decode_attention"]
+__all__ = ["flash_attention", "paged_decode_attention",
+           "paged_verify_attention"]
 
 
 def _block_space(ctx):
@@ -551,6 +552,85 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     m0 = jnp.full((S, H), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((S, H), jnp.float32)
     a0 = jnp.zeros((S, H, d), jnp.float32)
+    if n_blocks == 1:
+        _, l, acc = body(0, (m0, l0, a0))
+    else:
+        _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None, block_tokens=None,
+                           k_scale=None, v_scale=None):
+    """Multi-query attention against a paged KV cache — the batched-verify
+    step of speculative decoding (serving/generation/, docs/generation.md).
+
+    ``q``: (S, Q, H, d) — Q = k+1 candidate positions per sequence slot
+    (the last committed token plus k draft tokens), verified in ONE
+    program instead of Q sequential decode calls. ``k_pages``/
+    ``v_pages``/``page_table``/``k_scale``/``v_scale`` are exactly the
+    decode-path pool arguments. ``lengths``: (S,) int32 — the committed
+    cache length per slot BEFORE this step's candidates; query ``qi``
+    attends positions ``< lengths[s] + 1 + qi`` (its own just-scattered
+    key plus every earlier candidate), the causal discipline that makes
+    the verify logits bit-compatible with Q sequential decode steps.
+
+    Same streaming online-softmax recurrence as
+    :func:`paged_decode_attention` (blocks of whole pages bounded by
+    ``block_tokens``), with the score tile carrying a Q axis: still
+    fixed-shape, still one compiled program for every batch composition
+    and accept pattern — slots past their per-step span point at the
+    trash page and are masked here by ``lengths``, never contributing.
+    Kept a separate function (not a Q==1 special case folded into the
+    decode kernel) so the decode program's numerics and jit signature
+    are untouched.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S, Q, H, d = q.shape
+    page = k_pages.shape[1]
+    n_pages = page_table.shape[1]
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+    want = max(1, int(block_tokens or n_pages * page) // page)
+    bp = 1
+    for cand in range(min(want, n_pages), 0, -1):
+        if n_pages % cand == 0:
+            bp = cand
+            break
+    n_blocks = n_pages // bp
+    blk = bp * page
+
+    qf = q.astype(jnp.float32) * scale
+    # per-(slot, query) causal limit: committed length + own position + 1
+    limits = (lengths.astype(jnp.int32)[:, None]
+              + jax.lax.iota(jnp.int32, Q)[None, :] + 1)       # (S, Q)
+
+    def body(i, carry):
+        m, l, acc = carry
+        tab = jax.lax.dynamic_slice_in_dim(page_table, i * bp, bp, axis=1)
+        kb = k_pages[tab].reshape(S, blk, H, d).astype(jnp.float32)
+        vb = v_pages[tab].reshape(S, blk, H, d).astype(jnp.float32)
+        if k_scale is not None:
+            kb = kb * k_scale[tab].reshape(S, blk, H)[..., None]
+        if v_scale is not None:
+            vb = vb * v_scale[tab].reshape(S, blk, H)[..., None]
+        s = jnp.einsum("sqhd,sthd->sqht", qf, kb)        # (S, Q, H, blk)
+        pos = i * blk + jax.lax.iota(jnp.int32, blk)
+        live = pos[None, None, :] < limits[:, :, None]   # (S, Q, blk)
+        s = jnp.where(live[:, :, None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("sqht,sthd->sqhd", p, vb)
+        return m_new, l, acc
+
+    m0 = jnp.full((S, Q, H), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((S, Q, H), jnp.float32)
+    a0 = jnp.zeros((S, Q, H, d), jnp.float32)
     if n_blocks == 1:
         _, l, acc = body(0, (m0, l0, a0))
     else:

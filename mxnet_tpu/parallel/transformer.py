@@ -219,6 +219,37 @@ class TransformerParallel:
             x = x + self._moe_ffn(params, p, x[:, None, :])[:, 0]
         return (_rms_norm(x) @ params["out_w"]).astype(jnp.float32)
 
+    def verify_forward(self, params, tokens, attend):
+        """Batched-verify layer stack for speculative decoding: Q = k+1
+        candidate positions per slot in ONE forward (a short-prefill
+        shape, not Q sequential decode calls — docs/generation.md).
+
+        ``tokens``: (S, Q) int32 — each slot's last committed token
+        followed by its k draft candidates; ``attend(li, q, k_new,
+        v_new) -> (S, Q, H, hd)`` — the caller-owned hook (all arrays in
+        cache storage layout (S, Q, H, hd)): the generation engine
+        scatters all Q keys/values into its paged pool optimistically
+        and runs :func:`~.flash_attention.paged_verify_attention`, whose
+        per-query causal limit reproduces Q sequential decode steps.
+        The weight math is the same shared ``_qkv``/``_moe_ffn``/norm
+        implementation as every other path (this model has no positional
+        encoding, so candidate positions need no offset). Returns fp32
+        logits (S, Q, V).
+        """
+        import jax.numpy as jnp
+
+        c = self.cfg
+        S, Q = tokens.shape
+        d = c["d_model"]
+        x = params["embed"][tokens]  # (S, Q, d)
+        for li in range(c["n_layers"]):
+            p = "l%d_" % li
+            q, k, v = self._qkv(params, p, _rms_norm(x))  # (S, Q, H, hd)
+            att = attend(li, q, k, v)                     # (S, Q, H, hd)
+            x = x + att.reshape(S, Q, d) @ params[p + "wo"]
+            x = x + self._moe_ffn(params, p, x)
+        return (_rms_norm(x) @ params["out_w"]).astype(jnp.float32)
+
     def loss_fn(self, params, tokens, targets):
         import jax
         import jax.numpy as jnp

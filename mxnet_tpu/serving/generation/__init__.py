@@ -4,15 +4,19 @@ seeded sampling — checkpoint in, token streams out, with compile count
 bounded by the prefill bucket ladder plus ONE decode program. The
 serving control plane (ISSUE 14; docs/serving_control.md) layers a
 radix-tree prefix cache (COW-shared KV pages) and SLO-class weighted
-admission on top."""
+admission on top. Speculative decoding (ISSUE 16) adds a draft
+proposer (n-gram prompt-lookup or a small draft model) and ONE
+batched-verify program with lossless accept/rollback."""
 from ..control import PrefixCache, SLOClass
 from .engine import (DeadlineExceeded, GenerationConfig, GenerationHandle,
                      Generator, QueueFullError, ServerClosedError,
                      default_prefill_ladder)
 from .kv_cache import PagePool
-from .sampling import SamplingParams, sample_tokens
+from .sampling import SamplingParams, sample_tokens, verify_tokens
+from .speculative import NgramProposer, ngram_propose
 
 __all__ = ["Generator", "GenerationConfig", "GenerationHandle",
            "SamplingParams", "PagePool", "PrefixCache", "SLOClass",
-           "sample_tokens", "default_prefill_ladder", "QueueFullError",
+           "sample_tokens", "verify_tokens", "ngram_propose",
+           "NgramProposer", "default_prefill_ladder", "QueueFullError",
            "ServerClosedError", "DeadlineExceeded"]

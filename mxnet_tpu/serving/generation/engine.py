@@ -50,7 +50,8 @@ from ..control import PrefixCache, SLOClass, resolve_class
 from ..control.slo import ClassQueue
 from ..engine import QueueFullError, ServerClosedError
 from .kv_cache import PagePool
-from .sampling import SamplingParams, sample_tokens
+from .sampling import SamplingParams, sample_tokens, verify_tokens
+from .speculative import ngram_propose
 
 # chaos-testable injection point (resilience/faults.py): a raise here
 # is contained by the scheduler — the slots in the faulted step fail,
@@ -119,7 +120,8 @@ class GenerationConfig:
                  max_seq=None, pool_pages=None, prefill_buckets=None,
                  max_queue=None, backpressure=None, submit_timeout_ms=None,
                  amp=None, kv_dtype=None, prefix_cache=None,
-                 prefix_pages=None, slo_aging_ms=None, deadline_ms=None):
+                 prefix_pages=None, slo_aging_ms=None, deadline_ms=None,
+                 spec_k=None, spec_ngram=None):
         import os
 
         # None = follow the graph-pass layer (amp in MXNET_GRAPH_PASSES);
@@ -183,6 +185,17 @@ class GenerationConfig:
         # expired-in-queue requests fail DeadlineExceeded BEFORE prefill
         self.deadline_ms = (float(get_flag("MXNET_GEN_DEADLINE_MS"))
                             if deadline_ms is None else float(deadline_ms))
+        # ---- speculative decoding (ISSUE 16) ----
+        # spec_k: draft tokens proposed per slot per step; 0 = off (the
+        # PR 7 decode path bit-for-bit). None = resolve in Generator:
+        # explicit > generation.spec_k tuning cache > MXNET_GEN_SPEC_K
+        self.spec_k = None if spec_k is None else int(spec_k)
+        self.spec_ngram = (int(get_flag("MXNET_GEN_SPEC_NGRAM"))
+                           if spec_ngram is None else int(spec_ngram))
+        if self.spec_k is not None and self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 = speculation off)")
+        if self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
         if self.deadline_ms < 0:
             raise ValueError("deadline_ms must be >= 0 (0 = no deadline)")
         if self.prefix_pages is not None and self.prefix_pages < 0:
@@ -329,9 +342,20 @@ class Generator:
     dict. Unset ``page_size``/``decode_blocks`` resolve through the
     autotuner (``generation.*`` tuning-cache entries recorded by
     ``autotune.tune_generation``), then the ``MXNET_GEN_*`` flags.
+
+    **Speculative decoding** (docs/generation.md): with ``spec_k > 0``
+    each scheduler iteration proposes k draft tokens per slot and
+    verifies all k+1 positions in ONE compiled batched-verify program —
+    token-exact vs non-speculative decode (``sampling.verify_tokens``).
+    Passing ``draft_model``/``draft_params`` (a smaller
+    TransformerParallel checkpoint with the SAME vocab) selects the
+    draft-model proposer; otherwise the model-free n-gram/prompt-lookup
+    proposer runs. ``spec_k == 0`` (the default) keeps the PR 7 decode
+    path bit-for-bit.
     """
 
-    def __init__(self, model, params, config=None, start=True):
+    def __init__(self, model, params, config=None, start=True,
+                 draft_model=None, draft_params=None):
         import jax
 
         self._model = model
@@ -346,6 +370,28 @@ class Generator:
         self.decode_blocks = self._resolve(
             "generation.decode_blocks", "decode_blocks", cfg.decode_blocks,
             "MXNET_GEN_DECODE_BLOCKS")
+        # ---- speculative decoding (ISSUE 16) --------------------------
+        # consult order: explicit config > generation.spec_k tuning-cache
+        # entry > MXNET_GEN_SPEC_K (corrupt cache entries degrade to the
+        # flag); k = 0 keeps the non-speculative decode path bit-for-bit
+        self.spec_k = self._resolve("generation.spec_k", "spec_k",
+                                    cfg.spec_k, "MXNET_GEN_SPEC_K",
+                                    minimum=0)
+        self.spec_ngram = int(cfg.spec_ngram)
+        self._draft_model = draft_model
+        if draft_model is not None:
+            if draft_params is None:
+                raise ValueError("draft_model requires draft_params")
+            if int(draft_model.cfg["vocab"]) != int(c["vocab"]):
+                raise ValueError(
+                    "draft model vocab %d != target vocab %d — draft "
+                    "proposals must be target token ids"
+                    % (draft_model.cfg["vocab"], c["vocab"]))
+        self.spec_mode = ("off" if self.spec_k == 0
+                          else "draft" if draft_model is not None
+                          else "ngram")
+        self._spec_draft = self.spec_mode == "draft"
+        self._draft_params = draft_params if self._spec_draft else None
         # mixed-precision policy for the prefill/decode program builds:
         # the graph-pass layer's amp rewrite, applied functionally (the
         # model is jax functions, not a symbol graph) — params cast to
@@ -364,6 +410,8 @@ class Generator:
             # would stream fp32 from HBM each step and deliver none of
             # the bandwidth win on the HBM-bound decode path
             self._params = self._amp_params(params)
+            if self._draft_params is not None:
+                self._draft_params = self._amp_params(self._draft_params)
             graph_pass.note_program(
                 "generation", amp=True,
                 dtype=str(np.dtype(model.dtype).name),
@@ -423,6 +471,25 @@ class Generator:
         self._pool_shape = (L, pool_pages, self.page_size, H, hd)
         self._scale_shape = (L, pool_pages, self.page_size, H)
         self._pool_dtype = pool_dt
+        # draft-model KV planes ride in the SAME donated pools pytree
+        # ("dk"/"dv", same page geometry): COW page copies, trash-page
+        # masking, donation and _recover_pools apply to the draft cache
+        # for free, and target + draft K/V for a page's positions always
+        # travel together (prefix sharing stays consistent). Draft pages
+        # are never quantized — the draft is already the small model.
+        if self._spec_draft:
+            dc = draft_model.cfg
+            self._draft_pool_shape = (
+                dc["n_layers"], pool_pages, self.page_size,
+                dc["n_heads"], dc["d_model"] // dc["n_heads"])
+            self._draft_pool_dtype = np.dtype(draft_model.dtype)
+            # accounted separately from bytes_per_token (the TARGET-
+            # cache byte model behind kv_bytes_used); get_stats surfaces
+            self.draft_bytes_per_token = (
+                2 * dc["n_layers"] * dc["d_model"]
+                * self._draft_pool_dtype.itemsize)
+        else:
+            self.draft_bytes_per_token = 0
         self._device = list(model.mesh.devices.flat)[0]
         self._pools = self._fresh_pools()  # guarded-by: self._pages_lock
         if self._quant_kv:
@@ -465,6 +532,14 @@ class Generator:
         self._decode_jit = jax.jit(self._decode_step, donate_argnums=donate)
         self._prefill_jit = jax.jit(self._prefill_step,
                                     donate_argnums=donate)
+        # speculative programs: ONE batched verify (+ ONE draft decode
+        # in draft mode) — the whole compile-count delta of speculation
+        self._verify_jit = (jax.jit(self._verify_step,
+                                    donate_argnums=donate)
+                            if self.spec_k else None)
+        self._draft_jit = (jax.jit(self._draft_decode_step,
+                                   donate_argnums=donate)
+                           if self._spec_draft else None)
 
         self._thread = None
         self._life = threading.Lock()  # serializes start()/stop()
@@ -503,6 +578,11 @@ class Generator:
         if self._quant_kv:
             pools["ks"] = np.zeros(self._scale_shape, np.float32)
             pools["vs"] = np.zeros(self._scale_shape, np.float32)
+        if self._spec_draft:
+            pools["dk"] = np.zeros(self._draft_pool_shape,
+                                   self._draft_pool_dtype)
+            pools["dv"] = np.zeros(self._draft_pool_shape,
+                                   self._draft_pool_dtype)
         return jax.device_put(pools, self._device)
 
     def _recover_pools(self, err):
@@ -584,7 +664,8 @@ class Generator:
             pools["v"] = pools["v"].at[:, dest, off].set(v_new.astype(dt))
         return pools
 
-    def _suffix_attend(self, pools, page_row, prefix_len):
+    def _suffix_attend(self, pools, page_row, prefix_len,
+                       kname="k", vname="v", quant=None):
         """Attention hook for the control plane's suffix prefill: each
         suffix query attends the cached prefix — gathered from the paged
         pool through this slot's page row, masked to ``prefix_len`` —
@@ -599,16 +680,21 @@ class Generator:
         masked prefix-region gather/scores (~bucket x max_seq extra per
         layer), which is why the cache is opt-in — no-sharing
         workloads keep the lean cold program (docs/serving_control.md
-        "Miss-path cost")."""
+        "Miss-path cost").
+
+        ``kname``/``vname``/``quant`` select which page planes the hook
+        reads: the defaults are the target cache; the speculative
+        draft-model prefill passes ``"dk"``/``"dv"``, ``quant=False``
+        (draft pages are never quantized)."""
         import jax.numpy as jnp
 
         max_ctx = self._max_pages * self.page_size
-        quant = self._quant_kv
+        quant = self._quant_kv if quant is None else bool(quant)
 
         def attend(li, q, k, v):
             T, hd = q.shape[2], q.shape[3]
-            kp = pools["k"][li][page_row].reshape(max_ctx, -1, hd)
-            vp = pools["v"][li][page_row].reshape(max_ctx, -1, hd)
+            kp = pools[kname][li][page_row].reshape(max_ctx, -1, hd)
+            vp = pools[vname][li][page_row].reshape(max_ctx, -1, hd)
             kp = kp.astype(jnp.float32)
             vp = vp.astype(jnp.float32)
             if quant:
@@ -632,8 +718,8 @@ class Generator:
                 # the fresh suffix K/V through the pages' storage dtype
                 # so warm- and cold-cache runs see identical values.
                 # A no-op when pool dtype == model dtype.
-                k = k.astype(pools["k"].dtype)
-                v = v.astype(pools["v"].dtype)
+                k = k.astype(pools[kname].dtype)
+                v = v.astype(pools[vname].dtype)
             scale = float(1.0 / np.sqrt(hd))
             qf = q.astype(jnp.float32) * scale
             sp = jnp.einsum("bhqd,khd->bhqk", qf, kp)
@@ -657,7 +743,8 @@ class Generator:
         return attend
 
     def _prefill_step(self, params, pools, tokens, length, prefix_len,
-                      page_row, cow_src, cow_dst, key, temp, top_k):
+                      page_row, cow_src, cow_dst, key, temp, top_k,
+                      draft_params):
         """ONE compiled program per prompt bucket: causal forward over
         the (suffix) tokens, K/V scattered into the paged cache, first
         token sampled. ``tokens``: (1, bucket) int32; ``page_row``:
@@ -671,7 +758,14 @@ class Generator:
         last shared page before the one write that may land in it (the
         page-aligned full-prefix-hit case; 0 -> 0 is a trash-page
         no-op). Prefix length, like batch composition, is DATA — the
-        compile count stays ``len(prefill_buckets) + 1``."""
+        compile count stays ``len(prefill_buckets) + 1``.
+
+        In draft-model speculation mode the draft's prefill is FUSED
+        into this same program (``draft_params`` non-None): the draft
+        forward scatters its K/V into the ``dk``/``dv`` planes at the
+        same page coordinates, so the per-bucket compile count never
+        grows. ``draft_params`` is None (an empty pytree, not a traced
+        value) in every other mode."""
         import jax.numpy as jnp
 
         bucket = tokens.shape[1]
@@ -694,6 +788,19 @@ class Generator:
                          0)
         off = pos % self.page_size
         pools = self._scatter_kv(pools, dest, off, ks[:, 0], vs[:, 0])
+        if self._spec_draft:
+            d_attend = (self._suffix_attend(pools, page_row, prefix_len,
+                                            kname="dk", vname="dv",
+                                            quant=False)
+                        if self._use_prefix else None)
+            _, dks, dvs = self._draft_model.prefill_forward(
+                draft_params, tokens, attend=d_attend)
+            ddt = pools["dk"].dtype
+            pools = dict(pools)
+            pools["dk"] = pools["dk"].at[:, dest, off].set(
+                dks[:, 0].astype(ddt))
+            pools["dv"] = pools["dv"].at[:, dest, off].set(
+                dvs[:, 0].astype(ddt))
         last = logits[0, length - 1]
         tok, new_key = sample_tokens(last[None], key[None], temp[None],
                                      top_k[None])
@@ -748,6 +855,111 @@ class Generator:
         new_keys = jnp.where(active[:, None], new_keys, keys)
         return state, toks, new_keys
 
+    def _draft_decode_step(self, draft_params, pools, page_table,
+                           seq_len, active, token):
+        """THE draft-decode program (draft-model speculation mode): one
+        greedy step of the draft model against its ``dk``/``dv`` page
+        planes — the existing paged decode path at draft scale. Called
+        k times per scheduler iteration with ``seq_len + j`` (the draft
+        cache advancing through the candidate positions); masked slots
+        scatter to the trash page. Greedy on purpose: proposals are
+        hints the verify step checks, so draft sampling noise would only
+        lower acceptance, never change outputs. Compile count: 1."""
+        import jax.numpy as jnp
+
+        from ...parallel.flash_attention import paged_decode_attention
+
+        S = self._cfg.max_batch
+        page = self.page_size
+        rows = jnp.arange(S)
+        pidx = jnp.minimum(seq_len // page, self._max_pages - 1)
+        off = seq_len % page
+        dest = jnp.where(active, page_table[rows, pidx], 0)
+        state = dict(pools)
+
+        def attend(li, q, k_new, v_new):
+            dt = state["dk"].dtype
+            state["dk"] = state["dk"].at[li, dest, off].set(
+                k_new.astype(dt))
+            state["dv"] = state["dv"].at[li, dest, off].set(
+                v_new.astype(dt))
+            return paged_decode_attention(
+                q, state["dk"][li], state["dv"][li], page_table,
+                seq_len + 1, block_tokens=self.decode_blocks)
+
+        logits = self._draft_model.decode_forward(draft_params, token,
+                                                  attend)
+        nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        return state, nxt.astype(jnp.int32)
+
+    def _verify_step(self, params, pools, page_table, seq_len, active,
+                     last_token, draft, span, temp, top_k, keys):
+        """THE batched-verify program of speculative decoding: all k+1
+        candidate positions of every slot in ONE fixed-shape forward —
+        a short-prefill shape (q-length k+1), not k sequential decodes.
+
+        Position 0 is the slot's last committed token (exactly what the
+        decode step would feed), positions 1..k its draft candidates.
+        All k+1 K/V are scattered into the pages OPTIMISTICALLY —
+        positions at or past ``span`` (the per-slot emission budget:
+        min(k+1, remaining max_new)) land on the trash page, so writes
+        never outrun the admission-time page reservation. Rejected
+        positions need no device-side rollback: every attention path
+        masks by committed length, so stale tail K/V is invisible until
+        overwritten — only the host-side page accounting rolls back
+        (``PagePool.shrink`` in ``_spec_once``). Acceptance itself is
+        ``sampling.verify_tokens`` (token-exact sample-and-match).
+        Fixed shapes throughout: batch composition, spans and accept
+        patterns are DATA. Compile count: 1."""
+        import jax.numpy as jnp
+
+        from ...parallel.flash_attention import paged_verify_attention
+
+        S = self._cfg.max_batch
+        Q = self.spec_k + 1
+        page = self.page_size
+        tokens = jnp.concatenate([last_token[:, None], draft], axis=1)
+        rows = jnp.arange(S)[:, None]
+        pos = seq_len[:, None] + jnp.arange(Q, dtype=jnp.int32)[None, :]
+        pidx = pos // page
+        ok = (active[:, None]
+              & (jnp.arange(Q, dtype=jnp.int32)[None, :] < span[:, None])
+              & (pidx < self._max_pages))
+        dest = jnp.where(
+            ok, page_table[rows, jnp.minimum(pidx, self._max_pages - 1)],
+            0)
+        off = pos % page
+        state = dict(pools)
+        quant = self._quant_kv
+
+        def attend(li, q, k_new, v_new):
+            # (S, Q) scatter coordinates; masked positions collide on
+            # the trash page, active ones are disjoint by construction
+            if quant:
+                kq, ksc = _quantize_kv(k_new)
+                vq, vsc = _quantize_kv(v_new)
+                state["k"] = state["k"].at[li, dest, off].set(kq)
+                state["v"] = state["v"].at[li, dest, off].set(vq)
+                state["ks"] = state["ks"].at[li, dest, off].set(ksc)
+                state["vs"] = state["vs"].at[li, dest, off].set(vsc)
+            else:
+                dt = state["k"].dtype
+                state["k"] = state["k"].at[li, dest, off].set(
+                    k_new.astype(dt))
+                state["v"] = state["v"].at[li, dest, off].set(
+                    v_new.astype(dt))
+            return paged_verify_attention(
+                q, state["k"][li], state["v"][li], page_table, seq_len,
+                block_tokens=self.decode_blocks,
+                k_scale=state["ks"][li] if quant else None,
+                v_scale=state["vs"][li] if quant else None)
+
+        logits = self._model.verify_forward(params, tokens, attend)
+        logits = logits.astype(jnp.float32)  # fp32 sampling island
+        out, n_emit, new_keys = verify_tokens(logits, draft, span,
+                                              active, keys, temp, top_k)
+        return state, out, n_emit, new_keys
+
     def warmup(self):
         """Compile every prefill bucket plus the decode program against
         the trash page, so the first request never pays a compile.
@@ -773,7 +985,8 @@ class Generator:
                     np.zeros((1, bucket), np.int32), np.int32(1),
                     np.int32(0), np.zeros(self._max_pages, np.int32),
                     np.int32(0), np.int32(0),
-                    np.zeros(2, np.uint32), np.float32(0), np.int32(0))
+                    np.zeros(2, np.uint32), np.float32(0), np.int32(0),
+                    self._draft_params)
                 jax.block_until_ready(tok)
                 self._pools = pools
                 n += 1
@@ -785,7 +998,32 @@ class Generator:
                 np.zeros(S, np.int32), np.zeros((S, 2), np.uint32))
             jax.block_until_ready(toks)
             self._pools = pools
-        return n + 1
+            n += 1
+            if self._verify_jit is not None:
+                # the speculative programs: ONE verify (+ ONE draft
+                # decode in draft mode) — warmed all-inactive like the
+                # decode program, writes land only on the trash page
+                pools, out, _, _ = self._verify_jit(
+                    self._params, self._pools,
+                    np.zeros((S, self._max_pages), np.int32),
+                    np.zeros(S, np.int32), np.zeros(S, bool),
+                    np.zeros(S, np.int32),
+                    np.zeros((S, self.spec_k), np.int32),
+                    np.zeros(S, np.int32), np.zeros(S, np.float32),
+                    np.zeros(S, np.int32), np.zeros((S, 2), np.uint32))
+                jax.block_until_ready(out)
+                self._pools = pools
+                n += 1
+            if self._draft_jit is not None:
+                pools, nxt = self._draft_jit(
+                    self._draft_params, self._pools,
+                    np.zeros((S, self._max_pages), np.int32),
+                    np.zeros(S, np.int32), np.zeros(S, bool),
+                    np.zeros(S, np.int32))
+                jax.block_until_ready(nxt)
+                self._pools = pools
+                n += 1
+        return n
 
     # ----------------------------------------------------------- lifecycle
     def start(self):
@@ -810,7 +1048,16 @@ class Generator:
         ``timeout`` (seconds) bounds the drain: a wedged decode step
         used to hang ``stop`` forever — past the timeout every still-
         pending request fails with :class:`ServerClosedError` and
-        ``stop`` returns (the daemon scheduler exits if it unwedges)."""
+        ``stop`` returns (the daemon scheduler exits if it unwedges).
+
+        Speculative traffic keeps the drain contract exact: a stop
+        racing an in-flight batched-verify step finalizes every token
+        that step accepted (``_spec_once`` commits per-slot bursts
+        atomically before the loop re-reads stop state), so no caller
+        ever sees a half-accepted sequence; rejected-position pages are
+        returned on the same step (``PagePool.shrink``), and an abort
+        (``drain=False``) frees all speculative extensions through the
+        normal eviction release."""
         with self._cond:
             self._stop = True
             self._abort = not drain
@@ -997,7 +1244,13 @@ class Generator:
             self._admit_pending()
             if self._n_active:
                 try:
-                    self._decode_once()
+                    # spec_k > 0 swaps the q-length-1 decode iteration
+                    # for propose + batched verify; k = 0 keeps the
+                    # non-speculative path bit-for-bit
+                    if self.spec_k:
+                        self._spec_once()
+                    else:
+                        self._decode_once()
                 except Exception as err:
                     # contain the fault to the slots in the faulted
                     # step: fail those requests, free their pages, keep
@@ -1179,7 +1432,8 @@ class Generator:
                 self._params, self._pools, tokens,
                 np.int32(len(suffix)), np.int32(suffix_start), row,
                 np.int32(cow_src), np.int32(cow_dst), key,
-                np.float32(sp.temperature), np.int32(sp.top_k))
+                np.float32(sp.temperature), np.int32(sp.top_k),
+                self._draft_params)
             self._pools = pools
         # the ONE host sync of admission: the prompt's first token (this
         # is also the time-to-first-token mark)
@@ -1317,6 +1571,169 @@ class Generator:
         metrics.histogram("generation.decode_step_ms").observe(
             (time.monotonic() - t0) * 1e3)
 
+    def _propose(self, spans):
+        """The draft phase of one speculative iteration: k candidate
+        tokens per slot. n-gram mode is pure host numpy (prompt-lookup
+        over each sequence's own history); draft-model mode chains k
+        calls of THE draft-decode program, advancing the draft's page
+        planes through the candidate positions. Returns (S, k) int32."""
+        k = self.spec_k
+        S = self._cfg.max_batch
+        drafts = np.zeros((S, k), np.int32)
+        if not self._spec_draft:
+            for slot, seq in enumerate(self._slots):
+                if seq is None:
+                    continue
+                drafts[slot] = ngram_propose(seq.prompt + seq.tokens, k,
+                                             self.spec_ngram)
+            return drafts
+        toks = self._last_token
+        with self._pages_lock:
+            pools = self._pools
+            for j in range(k):
+                act = self._active & (j < spans)
+                pools, nxt = self._draft_jit(
+                    self._draft_params, pools, self._page_table,
+                    self._seq_len + np.int32(j), act, toks)
+                # ONE bounded fetch per draft position (k small ints
+                # per slot): the proposal feeds back as the next
+                # draft-step input AS NUMPY, keeping every chained call
+                # on the warmed compile key (a committed device array
+                # here would carry a different sharding and retrace)
+                drafts[:, j] = np.asarray(nxt)  # graftlint: disable=G001 — draft-phase token fetch, bounded by spec_k
+                toks = drafts[:, j]
+            self._pools = pools
+        return drafts
+
+    def _spec_once(self):
+        """One speculative iteration of the continuous-batching loop:
+        extend pages to cover the worst-case span, propose k drafts per
+        slot, run THE batched-verify program once, then commit each
+        slot's 1..span accepted+sampled tokens — rolling back the page
+        bookkeeping for rejected positions (``PagePool.shrink``; the
+        stale device K/V is masked by committed lengths, so rollback is
+        host-side accounting only).
+
+        Emission is per-slot ATOMIC: every token the verify step
+        accepted for a slot is pushed before the loop re-examines stop/
+        abort state, so ``stop(drain=True)`` racing an in-flight verify
+        finalizes accepted tokens and never delivers a half-accepted
+        sequence (the drain contract; regression-tested next to the
+        PR 8 stop-timeout tests)."""
+        from ...observability import metrics
+
+        t0 = time.monotonic()
+        _faults.inject("generation.decode_step")
+        k = self.spec_k
+        S = self._cfg.max_batch
+        # per-slot emission budget: min(k+1, remaining max_new) >= 1 —
+        # caps in-program scatters at the admission page reservation and
+        # emission at the request's token budget
+        spans = np.zeros(S, np.int32)
+        for slot, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            span = min(k + 1, seq.worst - int(self._seq_len[slot]))
+            spans[slot] = span
+            need = self.pool.pages_for(int(self._seq_len[slot]) + span)
+            owned = self.pool.pages_of(slot)
+            while len(owned) < need:  # extend-on-decode, span-deep
+                self._page_table[slot, len(owned)] = self.pool.extend(slot)
+                owned = self.pool.pages_of(slot)
+        t_draft = time.monotonic()
+        drafts = self._propose(spans)
+        t_verify = time.monotonic()
+        with self._pages_lock:
+            pools, out_toks, n_emit, nkeys = self._verify_jit(
+                self._params, self._pools, self._page_table,
+                self._seq_len, self._active, self._last_token, drafts,
+                spans, self._temp, self._top_k, self._keys)
+            self._pools = pools
+        n_active = int(self._active.sum())
+        # the speculative loop's one bounded host fetch per step:
+        # S x (k+1) int32 tokens + S accept counts + S keys
+        out = np.asarray(out_toks)  # graftlint: disable=G001 — per-step token fetch IS the product of the decode loop
+        accepted = np.asarray(n_emit)  # graftlint: disable=G001 — rides the same per-step fetch boundary
+        self._keys = np.array(nkeys, np.uint32)  # copy: jax views are read-only
+        t_tok = time.monotonic()
+        itl_hist = metrics.histogram(
+            "generation.itl_ms",
+            help="inter-token latency (consecutive sampled tokens of "
+                 "one request)")
+        rate_hist = metrics.histogram(
+            "generation.spec_accept_rate",
+            help="per-step draft acceptance rate (accepted / proposed, "
+                 "slots with a nonzero proposal budget)")
+        tpv_hist = metrics.histogram(
+            "generation.spec_tokens_per_verify",
+            help="tokens committed per slot per batched-verify call "
+                 "(1 = no draft survived, k+1 = all accepted + bonus)")
+        emitted_total = proposed_total = accepted_total = 0
+        for slot, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            m = max(1, int(accepted[slot]))
+            toks = [int(t) for t in out[slot, :m]]
+            self._seq_len[slot] += m
+            self._last_token[slot] = toks[-1]
+            proposed = max(0, int(spans[slot]) - 1)
+            proposed_total += proposed
+            accepted_total += m - 1
+            tpv_hist.observe(m)
+            if proposed:
+                rate_hist.observe((m - 1) / proposed)
+            # the m tokens left ONE program together: each is charged an
+            # equal share of the step gap (normalized inter-token
+            # latency, comparable with the non-speculative itl_ms)
+            gap_ms = ((t_tok - seq.t_last) * 1e3 / m
+                      if seq.t_last is not None else None)
+            for tok in toks:
+                if self._slots[slot] is None:
+                    break  # EOS / max-tokens evicted the slot mid-burst
+                seq.trace.event("decode")
+                if gap_ms is not None:
+                    itl_hist.observe(gap_ms)
+                emitted_total += 1
+                self._emit(slot, tok)
+            if self._slots[slot] is not None:
+                seq.t_last = t_tok
+                if m < int(spans[slot]):
+                    # rejection rollback: return the tail pages only
+                    # speculated-over positions needed; device K/V there
+                    # is stale-but-masked until the pages are reissued
+                    if self.pool.shrink(slot, int(self._seq_len[slot])):
+                        n_own = len(self.pool.pages_of(slot))
+                        self._page_table[slot, n_own:] = 0
+        with self._lock:
+            self._stats["decode_steps"] += 1
+            self._stats["spec_steps"] += 1
+            self._stats["tokens"] += emitted_total
+            self._stats["spec_proposed"] += proposed_total
+            self._stats["spec_accepted"] += accepted_total
+            self._stats["spec_draft_ms"] += (t_verify - t_draft) * 1e3
+            self._stats["spec_verify_ms"] += (t_tok - t_verify) * 1e3
+        metrics.counter(
+            "generation.spec_proposed",
+            help="draft tokens proposed to the batched-verify step"
+        ).inc(proposed_total)
+        metrics.counter(
+            "generation.spec_accepted",
+            help="draft tokens accepted by the batched-verify step"
+        ).inc(accepted_total)
+        metrics.counter("generation.tokens_generated").inc(emitted_total)
+        metrics.histogram(
+            "generation.spec_draft_ms",
+            help="draft-proposal phase per speculative step").observe(
+            (t_verify - t_draft) * 1e3)
+        metrics.histogram(
+            "generation.spec_verify_ms",
+            help="batched-verify phase per speculative step").observe(
+            (t_tok - t_verify) * 1e3)
+        metrics.gauge("generation.decode_batch_occupancy").set(
+            100.0 * n_active / self._cfg.max_batch)
+        metrics.histogram("generation.decode_step_ms").observe(
+            (time.monotonic() - t0) * 1e3)
+
     # --------------------------------------------------------------- stats
     def get_stats(self):
         """Operational snapshot conforming to the shared engine-stats
@@ -1332,6 +1749,24 @@ class Generator:
         with self._lock:
             counters = dict(self._stats)
         pool = self.pool.get_stats()
+        # speculation acceptance accounting (ISSUE 16) — the decode
+        # waterfall (PR 13) reads draft_ms/verify_ms to attribute draft
+        # vs verify time inside the decode phase
+        spec_prop = counters.get("spec_proposed", 0)
+        spec_acc = counters.get("spec_accepted", 0)
+        speculative = {
+            "mode": self.spec_mode,
+            "k": self.spec_k,
+            "ngram": self.spec_ngram,
+            "steps": counters.get("spec_steps", 0),
+            "proposed": spec_prop,
+            "accepted": spec_acc,
+            "accept_rate": (round(spec_acc / spec_prop, 4)
+                            if spec_prop else None),
+            "draft_ms": round(counters.get("spec_draft_ms", 0.0), 3),
+            "verify_ms": round(counters.get("spec_verify_ms", 0.0), 3),
+            "draft_bytes_per_token": self.draft_bytes_per_token,
+        }
         control = {
             "slo": {"aging_ms": self._aging_ms,
                     "deadline_ms": float(self._cfg.deadline_ms),
@@ -1368,6 +1803,8 @@ class Generator:
                 "prefix_cache": self._use_prefix,
                 "slo_aging_ms": self._aging_ms,
                 "deadline_ms": float(self._cfg.deadline_ms),
+                "spec_k": self.spec_k,
+                "spec_mode": self.spec_mode,
             },
             resilience={
                 "decode_faults": counters.get("decode_faults", 0),
@@ -1385,6 +1822,7 @@ class Generator:
                 "kv_dtype": self.kv_dtype,
                 "prefill_buckets": list(self._cfg.prefill_buckets),
                 "pool": pool,
+                "speculative": speculative,
             })
 
     def kv_read_bytes_per_token(self, ctx_len):

@@ -196,6 +196,42 @@ class PagePool:
         self._gauge()
         return page
 
+    def shrink(self, slot, n_tokens):
+        """Rollback-on-rejection: return ``slot``'s trailing pages not
+        needed to cover ``n_tokens`` committed positions (speculative
+        decoding scattered K/V for up to k draft tokens optimistically;
+        a rejection leaves the tail pages holding only stale data that
+        the ``lengths`` masking already hides — docs/generation.md).
+
+        Freed pages go back to the free list and their admission
+        reservation is restored (``_reserved`` += 1 each): the slot may
+        still need them to reach its worst case, and restoring the
+        reservation keeps :meth:`release`'s ``pages_for(worst) -
+        len(pages)`` accounting exact. Trailing pages past a slot's
+        committed length are always extend-claimed, never prefix-shared,
+        so each carries exactly one reference; a shared tail page is an
+        accounting bug and raises. Returns the number of pages freed."""
+        n_freed = 0
+        with self._lock:
+            if slot not in self._owned:
+                raise ValueError("slot %d owns no pages" % slot)
+            pages = self._owned[slot]
+            keep = self.pages_for(n_tokens)
+            while len(pages) > keep:
+                page = pages[-1]
+                if self._refs.get(page, 0) != 1:
+                    raise ValueError(
+                        "speculative tail page %d has refcount %d, "
+                        "expected 1" % (page, self._refs.get(page, 0)))
+                pages.pop()
+                del self._refs[page]
+                self._free.append(page)
+                self._reserved += 1
+                n_freed += 1
+        if n_freed:
+            self._gauge()
+        return n_freed
+
     def cow(self, slot, index):
         """Copy-on-write: privatize the shared page at ``index`` of
         ``slot``'s page list before a write lands in it. Returns

@@ -11,7 +11,7 @@ test in tests/test_generation.py pins this down).
 """
 from __future__ import annotations
 
-__all__ = ["SamplingParams", "sample_tokens"]
+__all__ = ["SamplingParams", "sample_tokens", "verify_tokens"]
 
 
 class SamplingParams:
@@ -72,3 +72,50 @@ def sample_tokens(logits, keys, temperature, top_k):
 
     return jax.vmap(one)(logits, keys, temperature,
                          top_k.astype(jnp.int32))
+
+
+def verify_tokens(logits, draft, span, active, keys, temperature, top_k):
+    """Lossless accept/sample over one batched-verify result — the
+    sample-and-match scheme that keeps speculative decoding token-exact.
+
+    ``logits``: (S, Q, V) fp32 verify logits, Q = k+1 (position 0 is the
+    last committed token, positions 1..k the draft candidates);
+    ``draft``: (S, k) int32 proposed tokens; ``span``: (S,) int32 — how
+    many positions this slot may emit this step (1..Q; caps both the
+    max_new budget and page reservation); ``active``: (S,) bool;
+    ``keys``/``temperature``/``top_k``: the per-slot sampling state of
+    :func:`sample_tokens`.
+
+    At each position the TARGET's token is sampled with exactly the
+    sampling rule (and key schedule) sequential decode would use; a
+    draft position is accepted iff the draft token EQUALS that sample,
+    and the first mismatch emits the sample itself (all-accept emits the
+    bonus sample from the final position). Keys therefore advance once
+    per emitted token and never for speculated-but-rejected positions —
+    the emitted stream is bit-identical to non-speculative decode for
+    greedy AND seeded temperature sampling, not merely
+    distribution-equal.
+
+    Returns ``(tokens (S, Q) int32, n_emit (S,) int32, new_keys)``:
+    ``tokens[s, :n_emit[s]]`` are the emitted tokens (later positions
+    -1), ``n_emit`` in 1..span for active slots, 0 for inactive.
+    """
+    import jax.numpy as jnp
+
+    S, Q = logits.shape[0], logits.shape[1]
+    live = active
+    cur = keys
+    n_emit = jnp.zeros((S,), jnp.int32)
+    out = []
+    # unrolled over Q (small, static): position i emits iff every earlier
+    # draft matched its sample and the span budget allows it
+    for i in range(Q):
+        tok_i, nxt = sample_tokens(logits[:, i], cur, temperature, top_k)
+        emit = live & (i < span)
+        out.append(jnp.where(emit, tok_i, -1))
+        cur = jnp.where(emit[:, None], nxt, cur)
+        n_emit = n_emit + emit.astype(jnp.int32)
+        if i < Q - 1:
+            live = live & emit & (draft[:, i] == tok_i)
+    new_keys = jnp.where(active[:, None], cur, keys)
+    return jnp.stack(out, axis=1), n_emit, new_keys

@@ -1,0 +1,506 @@
+"""Speculative decoding (ISSUE 16): lossless draft-verify-accept on the
+continuous-batching generator.
+
+Covers the proposers (n-gram prompt-lookup units), the batched-verify
+attention kernel against a per-position decode reference, PagePool
+rollback accounting (``shrink``), token-EXACT parity vs non-speculative
+decode for greedy (fp32 AND bf16), draft-model mode, and seeded
+temperature (batch-composition independent), flat compile counts
+(prefill ladder + decode + verify [+ draft decode]), zero page leaks
+across rejection rollback / EOS eviction mid-burst / abort, the
+``stop(drain=True)`` finalize contract, the ``generation.spec_k``
+autotune knob (consult order + measured tuner), and telemetry.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import autotune, observability as obs
+from mxnet_tpu.config import set_flag
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.parallel.flash_attention import (paged_decode_attention,
+                                                paged_verify_attention)
+from mxnet_tpu.parallel.transformer import TransformerParallel
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.serving.generation import (GenerationConfig, Generator,
+                                          NgramProposer, PagePool,
+                                          SamplingParams, ngram_propose)
+
+
+@pytest.fixture
+def telemetry():
+    obs.set_enabled(True)
+    obs.reset_metrics()
+    yield
+    obs.reset_metrics()
+    obs.set_enabled(False)
+
+
+@pytest.fixture
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _model(dtype=np.float32, **cfg):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    kw = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+              n_experts=2, dtype=dtype)
+    kw.update(cfg)
+    model = TransformerParallel(mesh, **kw)
+    return model, model.init(seed=0)
+
+
+def _draft(dtype=np.float32):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]).reshape(1),
+                             ("dp",))
+    model = TransformerParallel(mesh, vocab=64, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, n_experts=2,
+                                dtype=dtype)
+    return model, model.init(seed=7)
+
+
+def _generator(model, params, start=True, **cfg_kwargs):
+    kw = dict(page_size=8, max_batch=4, max_seq=64,
+              prefill_buckets=(16, 32, 64))
+    draft = {k: cfg_kwargs.pop(k) for k in ("draft_model", "draft_params")
+             if k in cfg_kwargs}
+    kw.update(cfg_kwargs)
+    return Generator(model, params, GenerationConfig(**kw), start=start,
+                     **draft)
+
+
+def _recompute_tokens(model, params, prompt, n):
+    """Greedy full-recompute oracle (same as test_generation)."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits, _, _ = model.prefill_forward(
+            params, np.asarray([toks], np.int32))
+        nxt = int(np.argmax(np.asarray(logits)[0, len(toks) - 1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _mixed_requests(n=10, seed=0, vocab=64):
+    """Mixed greedy + seeded-temperature requests with a repetitive bias
+    (cyclic prompts) so the n-gram proposer gets real acceptances."""
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for i in range(n):
+        period = int(rng.randint(2, 5))
+        reps = int(rng.randint(2, 8))
+        pat = rng.randint(1, vocab, size=period)
+        prompt = [int(t) for t in np.tile(pat, reps)][:48]
+        n_new = int(rng.randint(2, 12))
+        sp = (SamplingParams(max_new_tokens=n_new) if i % 3
+              else SamplingParams(max_new_tokens=n_new, temperature=0.7,
+                                  top_k=8, seed=200 + i))
+        reqs.append((prompt, sp))
+    return reqs
+
+
+def _reference(model, params, requests, **cfg_kwargs):
+    gen = _generator(model, params, **cfg_kwargs)
+    try:
+        return [gen.generate(p, sp, timeout=300) for p, sp in requests]
+    finally:
+        gen.stop()
+
+
+# ------------------------------------------------------ n-gram proposer
+def test_ngram_propose_lookup_hit():
+    # final 2-gram (1, 2) recurs at the start; its continuation follows
+    out = ngram_propose([1, 2, 3, 4, 1, 2], k=3, ngram=2)
+    assert out.dtype == np.int32
+    assert list(out) == [3, 4, 1]
+
+
+def test_ngram_propose_most_recent_match_wins():
+    # (1, 2) occurs twice before the tail — the later continuation (9)
+    # is proposed, not the earlier one (3)
+    out = ngram_propose([1, 2, 3, 1, 2, 9, 1, 2], k=1, ngram=2)
+    assert list(out) == [9]
+
+
+def test_ngram_propose_short_continuation_pads_with_last():
+    # match at j=0, continuation [6, 4, 5] is shorter than k=4: the
+    # remainder repeats the last history token
+    out = ngram_propose([4, 5, 6, 4, 5], k=4, ngram=2)
+    assert list(out) == [6, 4, 5, 5]
+
+
+def test_ngram_propose_no_match_repeats_last_token():
+    out = ngram_propose([1, 2, 3], k=2, ngram=2)
+    assert list(out) == [3, 3]
+
+
+def test_ngram_propose_edge_cases():
+    assert ngram_propose([1, 2, 3], k=0).size == 0
+    assert list(ngram_propose([], k=3)) == [0, 0, 0]
+    # history shorter than ngram+1: no window to match, repeat-pad
+    assert list(ngram_propose([5], k=2, ngram=3)) == [5, 5]
+
+
+def test_ngram_proposer_wrapper_validates():
+    prop = NgramProposer(3, ngram=2)
+    assert list(prop([1, 2, 3, 4, 1, 2])) == [3, 4, 1]
+    with pytest.raises(ValueError):
+        NgramProposer(2, ngram=0)
+
+
+# --------------------------------------------- batched verify attention
+def test_paged_verify_attention_matches_per_position_decode():
+    # verify position qi attends history + the qi previous in-flight
+    # speculative tokens: identical to a decode step at length L+qi+1
+    rng = np.random.RandomState(0)
+    S, Q, H, d, page, n_pages, pool = 3, 4, 2, 8, 4, 6, 32
+    k_pages = jnp.asarray(rng.randn(pool, page, H, d), jnp.float32)
+    v_pages = jnp.asarray(rng.randn(pool, page, H, d), jnp.float32)
+    table = jnp.asarray(rng.choice(np.arange(1, pool), (S, n_pages),
+                                   replace=False).reshape(S, n_pages))
+    q = jnp.asarray(rng.randn(S, Q, H, d), jnp.float32)
+    lengths = jnp.asarray([1, 7, 16], jnp.int32)
+
+    for blocks in (None, 4, 8):
+        out = np.asarray(paged_verify_attention(
+            q, k_pages, v_pages, table, lengths, block_tokens=blocks))
+        assert out.shape == (S, Q, H, d)
+        for qi in range(Q):
+            ref = np.asarray(paged_decode_attention(
+                q[:, qi], k_pages, v_pages, table, lengths + qi + 1,
+                block_tokens=blocks))
+            np.testing.assert_allclose(out[:, qi], ref, atol=1e-5,
+                                       err_msg="blocks=%r qi=%d"
+                                               % (blocks, qi))
+
+
+def test_paged_verify_attention_zero_history_is_finite():
+    k = jnp.zeros((4, 4, 2, 8), jnp.float32)
+    table = jnp.zeros((2, 2), jnp.int32)
+    out = np.asarray(paged_verify_attention(
+        jnp.ones((2, 3, 2, 8), jnp.float32), k, k, table,
+        jnp.asarray([0, 2], jnp.int32)))
+    assert np.isfinite(out).all()
+
+
+# --------------------------------------------------- rollback accounting
+def test_page_pool_shrink_restores_reservation():
+    pool = PagePool(pool_pages=16, page_size=4)
+    pool.admit(0, 6, 20)          # 2 pages now, 5 worst -> 3 reserved
+    assert len(pool.pages_of(0)) == 2
+    assert pool.get_stats()["reserved"] == 3
+    pool.extend(0)
+    pool.extend(0)                # optimistic speculative extension
+    assert len(pool.pages_of(0)) == 4
+    assert pool.get_stats()["reserved"] == 1
+    # rejection rolled the slot back to 7 committed tokens (2 pages)
+    freed = pool.shrink(0, 7)
+    assert freed == 2
+    assert len(pool.pages_of(0)) == 2
+    assert pool.get_stats()["reserved"] == 3
+    for p in pool.pages_of(0):
+        assert pool.refcount(p) == 1
+    # shrink to a length already covered is a no-op
+    assert pool.shrink(0, 8) == 0
+    pool.release(0, 20)
+    pool.assert_no_leaks()
+
+
+def test_page_pool_shrink_refuses_shared_tail_page():
+    pool = PagePool(pool_pages=8, page_size=4)
+    pool.admit(0, 4, 12)
+    pool.extend(0)
+    shared = pool.pages_of(0)[-1]
+    pool.incref(shared)           # e.g. a prefix-cache hold
+    with pytest.raises(ValueError):
+        pool.shrink(0, 4)
+    pool.decref(shared)
+    assert pool.shrink(0, 4) == 1
+    pool.release(0, 12)
+    pool.assert_no_leaks()
+
+
+# ------------------------------------------------------- lossless parity
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+def test_speculative_greedy_token_exact(dtype):
+    model, params = _model(dtype=dtype)
+    requests = [(p, sp) for p, sp in _mixed_requests(8, seed=1)
+                if sp.temperature == 0.0]
+    reference = _reference(model, params, requests)
+    gen = _generator(model, params, spec_k=3)
+    try:
+        got = [gen.generate(p, sp, timeout=300) for p, sp in requests]
+    finally:
+        gen.stop(drain=True)
+    assert got == reference
+    if dtype is np.float32:
+        # and both match the full-recompute greedy oracle
+        p, sp = requests[0]
+        assert got[0] == _recompute_tokens(model, params, p,
+                                           sp.max_new_tokens)
+    gen.pool.assert_no_leaks()
+
+
+def test_speculative_draft_model_token_exact():
+    model, params = _model()
+    dmodel, dparams = _draft()
+    requests = _mixed_requests(6, seed=2)
+    reference = _reference(model, params, requests)
+    gen = _generator(model, params, spec_k=2, draft_model=dmodel,
+                     draft_params=dparams)
+    try:
+        assert gen.spec_mode == "draft"
+        got = [gen.generate(p, sp, timeout=300) for p, sp in requests]
+    finally:
+        gen.stop(drain=True)
+    assert got == reference
+    gen.pool.assert_no_leaks()
+
+
+def test_speculative_temperature_batch_composition_independent():
+    # a seeded temperature request yields the SAME tokens solo on the
+    # speculative engine, concurrent with other traffic on it, and on
+    # the non-speculative engine: acceptance patterns (and therefore
+    # which program sampled each token) never leak into the stream
+    model, params = _model()
+    prompt = [3, 9, 3, 9, 3, 9, 3, 9, 5]
+    sp = SamplingParams(max_new_tokens=10, temperature=0.8, top_k=8,
+                        seed=42)
+    [ref] = _reference(model, params, [(prompt, sp)])
+
+    gen = _generator(model, params, spec_k=3)
+    try:
+        solo = gen.generate(prompt, sp, timeout=300)
+        noise = [gen.submit(p, s) for p, s in _mixed_requests(3, seed=3)]
+        h = gen.submit(prompt, sp)
+        concurrent = h.result(timeout=120)
+        for n in noise:
+            n.result(timeout=120)
+    finally:
+        gen.stop(drain=True)
+    assert solo == ref
+    assert concurrent == ref
+    gen.pool.assert_no_leaks()
+
+
+# ------------------------------------------------- compile-count discipline
+def test_speculative_compile_count_flat_ngram(telemetry):
+    model, params = _model()
+    gen = _generator(model, params, spec_k=3)
+    try:
+        # prefill ladder + decode + ONE batched verify
+        assert gen.warmup() == len(gen._cfg.prefill_buckets) + 2
+        before = M.get_value("jit.compile_count", 0)
+        for p, sp in _mixed_requests(6, seed=4):
+            gen.generate(p, sp, timeout=300)
+        assert M.get_value("jit.compile_count", 0) == before
+    finally:
+        gen.stop(drain=True)
+
+
+def test_speculative_compile_count_flat_draft(telemetry):
+    model, params = _model()
+    dmodel, dparams = _draft()
+    gen = _generator(model, params, spec_k=2, draft_model=dmodel,
+                     draft_params=dparams)
+    try:
+        # + ONE draft-decode program; draft prefill is fused into the
+        # per-bucket prefill programs (no extra ladder)
+        assert gen.warmup() == len(gen._cfg.prefill_buckets) + 3
+        before = M.get_value("jit.compile_count", 0)
+        for p, sp in _mixed_requests(4, seed=5):
+            gen.generate(p, sp, timeout=300)
+        assert M.get_value("jit.compile_count", 0) == before
+    finally:
+        gen.stop(drain=True)
+
+
+# -------------------------------------------------------- page hygiene
+def test_speculative_rejection_rollback_leaks_nothing():
+    # adversarial geometry: tiny pages so speculative bursts straddle
+    # page boundaries and rejections force real shrinks
+    model, params = _model()
+    gen = _generator(model, params, spec_k=3, page_size=4)
+    rng = np.random.RandomState(6)
+    try:
+        handles = []
+        for i in range(10):
+            plen = int(rng.randint(1, 40))
+            prompt = [int(t) for t in rng.randint(1, 64, size=plen)]
+            n_new = int(rng.randint(1, min(12, 64 - plen)))
+            handles.append(gen.submit(
+                prompt, SamplingParams(max_new_tokens=n_new)))
+        for h in handles:
+            h.result(timeout=120)
+        stats = gen.get_stats()["speculative"]
+        assert stats["steps"] > 0 and stats["proposed"] > 0
+    finally:
+        gen.stop(drain=True)
+    assert gen.pool.pages_used() == 0
+    gen.pool.assert_no_leaks()
+
+
+def test_speculative_eos_mid_burst_token_exact():
+    # an EOS landing inside an accepted speculative burst must evict at
+    # exactly the same token as sequential decode (no trailing emits)
+    model, params = _model()
+    prompt = [7, 11, 7, 11, 7, 11]
+    greedy = _recompute_tokens(model, params, prompt, 8)
+    eos = greedy[3]
+    sp = SamplingParams(max_new_tokens=8, eos_id=eos)
+    [ref] = _reference(model, params, [(prompt, sp)])
+    assert eos in ref and len(ref) < 8
+
+    gen = _generator(model, params, spec_k=3)
+    try:
+        got = gen.generate(prompt, sp, timeout=300)
+    finally:
+        gen.stop(drain=True)
+    assert got == ref
+    assert gen.pool.pages_used() == 0
+    gen.pool.assert_no_leaks()
+
+
+def test_speculative_abort_mid_step_leaks_nothing(_clean_faults):
+    # wedge the speculative step, then hard-stop: every optimistic page
+    # extension must come back through the eviction release path
+    faults.configure("generation.decode_step:delay=3000", seed=0)
+    model, params = _model()
+    gen = _generator(model, params, spec_k=3)
+    h = gen.submit([1, 2, 1, 2, 1, 2], SamplingParams(max_new_tokens=8))
+    time.sleep(0.2)                    # let the scheduler wedge
+    gen.stop(drain=False)
+    with pytest.raises(Exception):
+        h.result(timeout=5)
+    assert gen.pool.pages_used() == 0
+    gen.pool.assert_no_leaks()
+
+
+def test_speculative_stop_drain_finalizes_inflight(telemetry):
+    # stop(drain=True) racing in-flight speculative verify steps must
+    # finalize every accepted token (results complete, token-exact) and
+    # free rejected-token pages on the way out (ISSUE 16 small fix)
+    model, params = _model()
+    requests = _mixed_requests(8, seed=7)
+    reference = _reference(model, params, requests)
+    gen = _generator(model, params, spec_k=3)
+    handles = [gen.submit(p, sp) for p, sp in requests]
+    gen.stop(drain=True)               # immediately, mid-traffic
+    got = [h.result(timeout=60) for h in handles]
+    assert got == reference
+    assert gen.pool.pages_used() == 0
+    gen.pool.assert_no_leaks()
+
+
+# --------------------------------------------------------------- autotune
+def test_spec_k_knob_resolution_explicit_beats_cache_beats_flag():
+    from mxnet_tpu.serving.generation.engine import generation_tune_key
+
+    model, params = _model()
+    key = generation_tune_key(model, 4, 64)
+    autotune.record("generation.spec_k", key, {"spec_k": 2})
+    try:
+        gen = _generator(model, params, start=False)
+        assert gen.spec_k == 2 and gen.spec_mode == "ngram"
+        gen2 = _generator(model, params, start=False, spec_k=1)
+        assert gen2.spec_k == 1
+        # corrupt entry degrades to the flag default, never a crash
+        autotune.record("generation.spec_k", key, {"spec_k": "gibberish"})
+        set_flag("MXNET_GEN_SPEC_K", 4)
+        gen3 = _generator(model, params, start=False)
+        assert gen3.spec_k == 4
+        set_flag("MXNET_GEN_SPEC_K", None)
+        gen4 = _generator(model, params, start=False)
+        assert gen4.spec_k == 0 and gen4.spec_mode == "off"
+    finally:
+        set_flag("MXNET_GEN_SPEC_K", None)
+        # the tuning cache persists records to the (test-run-scoped)
+        # cache FILE; reset() only drops the in-memory view, so leave a
+        # benign default-off entry behind for later tests
+        autotune.record("generation.spec_k", key, {"spec_k": 0})
+        autotune.reset()
+
+
+def test_tune_generation_spec_records_and_is_consulted():
+    from mxnet_tpu.serving.generation.engine import generation_tune_key
+    model, params = _model()
+    calls = []
+
+    def stub_measure(c):
+        calls.append(dict(c))
+        return 0.001 if c.get("spec_k") == 2 else 0.002
+
+    out = autotune.tune_generation_spec(model, params, max_batch=4,
+                                        max_seq=64, measure=stub_measure,
+                                        trials=8)
+    try:
+        assert out["generation.spec_k"]["spec_k"] == 2
+        assert calls, "stub measurer never consulted"
+        gen = _generator(model, params, start=False)
+        assert gen.spec_k == 2
+    finally:
+        autotune.record("generation.spec_k",
+                        generation_tune_key(model, 4, 64), {"spec_k": 0})
+        autotune.reset()
+
+
+# -------------------------------------------------------------- telemetry
+def test_speculative_telemetry_and_stats(telemetry, tmp_path):
+    model, params = _model()
+    gen = _generator(model, params, spec_k=3)
+    try:
+        for p, sp in _mixed_requests(5, seed=8):
+            gen.generate(p, sp, timeout=300)
+        proposed = M.get_value("generation.spec_proposed", 0)
+        accepted = M.get_value("generation.spec_accepted", 0)
+        assert proposed > 0 and 0 <= accepted <= proposed
+
+        stats = gen.get_stats()
+        spec = stats["speculative"]
+        assert spec["mode"] == "ngram" and spec["k"] == 3
+        assert spec["steps"] > 0
+        assert spec["proposed"] == proposed
+        assert spec["accepted"] == accepted
+        assert spec["accept_rate"] == pytest.approx(
+            accepted / proposed, abs=1e-3)
+        assert spec["draft_ms"] >= 0 and spec["verify_ms"] >= 0
+        assert stats["config"]["spec_k"] == 3
+        assert stats["config"]["spec_mode"] == "ngram"
+
+        # phase histograms observed once per speculative iteration; the
+        # acceptance histograms once per (step, slot with proposals)
+        steps = spec["steps"]
+        assert M.get_value("generation.spec_draft_ms", 0) == steps
+        assert M.get_value("generation.spec_verify_ms", 0) == steps
+        assert 0 < M.get_value("generation.spec_accept_rate", 0) <= \
+            steps * gen._cfg.max_batch
+        assert M.get_value("generation.spec_tokens_per_verify", 0) > 0
+
+        # the "generation" flight-recorder provider carries acceptance
+        dump = obs.flight_recorder.dump(
+            "test", path=str(tmp_path / "dump.json"))
+        with open(dump) as f:
+            payload = json.load(f)
+        section = payload["providers"]["generation"]
+        views = section.get("generators", [section])
+        assert any(v.get("speculative", {}).get("proposed") == proposed
+                   for v in views), views
+    finally:
+        gen.stop(drain=True)
+
+
+def test_nonspeculative_engine_reports_mode_off():
+    model, params = _model()
+    gen = _generator(model, params, start=False)
+    spec = gen.get_stats()["speculative"]
+    assert spec["mode"] == "off" and spec["k"] == 0
+    assert spec["accept_rate"] is None

@@ -8,6 +8,14 @@ prompts (continuous batching is numerically transparent), (2) the jit
 compile count stays flat after warmup — prefill ladder + ONE decode
 program is the whole compile-key set, (3) the page pool drains to zero
 leaked pages after stop(drain=True), (4) seeded sampling reproduces.
+
+A second arm repeats the concurrent mixed traffic (greedy AND seeded
+temperature requests) on a SPECULATIVE engine (n-gram prompt-lookup
+proposer, ISSUE 16): token parity against the same sequential
+reference proves losslessness, the compile count stays flat at
+prefill ladder + decode + ONE verify program, and the pool again
+drains leak-free across accept/rollback/evict traffic.
+
 Prints a one-line JSON summary (optionally written to argv[1]); any
 violation raises, failing the CI step.
 """
@@ -107,6 +115,54 @@ def main(out_path=None):
     seq_gen.pool.assert_no_leaks()
     pool = gen.pool.get_stats()
 
+    # --- speculative arm (ISSUE 16): n-gram proposer, mixed traffic ----
+    # the same mixed greedy/temperature request set through a
+    # speculative engine: token parity proves losslessness, the compile
+    # count stays flat at buckets + decode + ONE verify program, and
+    # accept/rollback/evict traffic leaves zero leaked pages
+    spec_gen = Generator(model, params,
+                         GenerationConfig(spec_k=3, **cfg))
+    spec_warmed = spec_gen.warmup()
+    assert spec_warmed == len(cfg["prefill_buckets"]) + 2, spec_warmed
+    spec_compiles0 = M.get_value("jit.compile_count", 0)
+
+    spec_results = [None] * len(requests)
+    spec_errors = []
+
+    def spec_worker(indices):
+        try:
+            handles = [(i, spec_gen.submit(*requests[i]))
+                       for i in indices]
+            for i, h in handles:
+                spec_results[i] = h.result(timeout=120)
+        except Exception as err:
+            spec_errors.append(repr(err))
+
+    threads = [threading.Thread(target=spec_worker,
+                                args=(range(t, len(requests), 3),))
+               for t in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not spec_errors, spec_errors
+    spec_mismatches = [
+        i for i, (got, ref) in enumerate(zip(spec_results, reference))
+        if got != ref]
+    assert not spec_mismatches, (
+        "speculative decode diverged from sequential decode on requests "
+        "%s" % spec_mismatches)
+    spec_compiles = M.get_value("jit.compile_count", 0)
+    assert spec_compiles == spec_compiles0, (
+        "compile count climbed under speculative traffic: %d -> %d"
+        % (spec_compiles0, spec_compiles))
+    spec_stats = spec_gen.get_stats()["speculative"]
+    spec_gen.stop(drain=True)
+    spec_leaked = spec_gen.pool.pages_used()
+    assert spec_leaked == 0, (
+        "leaked %d KV pages after speculative drain" % spec_leaked)
+    spec_gen.pool.assert_no_leaks()
+
     summary = {
         "requests": len(requests),
         "tokens_generated": int(
@@ -116,6 +172,14 @@ def main(out_path=None):
         "peak_kv_pages": pool["peak_used"],
         "leaked_pages": leaked,
         "wall_s": round(wall, 3),
+        "speculative": {
+            "spec_k": 3,
+            "accept_rate": spec_stats["accept_rate"],
+            "proposed": spec_stats["proposed"],
+            "accepted": spec_stats["accepted"],
+            "verify_steps": spec_stats["steps"],
+            "leaked_pages": spec_leaked,
+        },
     }
     print(json.dumps(summary))
     if out_path:
