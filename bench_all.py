@@ -24,7 +24,9 @@ Standalone gates/modes: --lint-clean (graftlint vs baseline),
 --health-overhead (warn-mode <=2%/step), --resilience-overhead
 (faults-disabled injection points + deadline checks <1%/request;
 docs/resilience.md), --obs-overhead (request tracing <1%/request,
-on and sampled-out; docs/observability.md), --perf-overhead (roofline
+on and sampled-out; docs/observability.md), --ts-overhead (time-series
+sampler + fleet scrape duty cycle <1% of interval; docs/observability.md),
+--perf-overhead (roofline
 attribution + step waterfall <1%/step on stable quantities;
 docs/perf_observability.md), --autotune (tuned-vs-default on the
 autotuner's knob families + the warm-cache <1%/step gate;
@@ -1512,6 +1514,98 @@ def bench_obs_overhead(threshold_pct=None):
     return result
 
 
+def bench_ts_overhead(threshold_pct=None):
+    """--ts-overhead: gate the time-series plane's background cost
+    (ISSUE 17) on stable quantities. Wall-clock A/B of sampler-on vs
+    sampler-off serving runs measures scheduler noise larger than the
+    effect (the obs/resilience gate lesson), so the hard gate is on
+    DUTY CYCLES: the measured cost of one ``sample_once()`` pass
+    (pre-sample hooks -> registry snapshot -> ring appends) over a
+    representative registry, and of one fleet ``scrape_once()``
+    (parse + reassemble + merge-append of a full exposition body),
+    each as a percentage of its own sampling interval — the fraction
+    of one core the background thread occupies. Fails above
+    ``threshold_pct`` (default 1%, env MXNET_TS_GATE_PCT)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import metrics as M
+    from mxnet_tpu.observability import timeseries as TS
+    from mxnet_tpu.observability.fleet import FleetAggregator
+
+    if threshold_pct is None:
+        threshold_pct = float(os.environ.get("MXNET_TS_GATE_PCT", "1.0"))
+
+    mx.observability.set_enabled(True)
+    M.reset_metrics()
+    # a registry bigger than any smoke leaves behind: a serving worker's
+    # instrument population with room to spare
+    for i in range(40):
+        M.counter("bench.req", labels={"code": str(i % 8),
+                                       "route": "r%d" % (i % 5)}).inc(i)
+    for i in range(20):
+        M.gauge("bench.depth", labels={"shard": str(i)}).set(float(i))
+    for i in range(12):
+        h = M.histogram("bench.lat", labels={"engine": "e%d" % i},
+                        buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+        for v in (0.5, 3.0, 17.0, 200.0):
+            h.observe(v)
+    series = len(M.all_instruments())
+
+    interval_s = 1.0   # the MXNET_OBS_TS_INTERVAL_MS default
+    n = 50 if QUICK else 200
+    sampler = TS.TimeSeriesSampler(interval_ms=interval_s * 1e3,
+                                   retain=600, clock=lambda: 0.0)
+    best_sample = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            sampler.sample_once(now=float(i))
+        best_sample = min(best_sample, (time.perf_counter() - t0) / n)
+
+    # fleet side: parse + merge one full worker exposition per scrape
+    # (the text is pre-rendered — a real scrape's render happens on the
+    # WORKER; fetch latency is network, not CPU duty)
+    text = M.dump_metrics()
+    agg = FleetAggregator({"w0": "u"}, interval_ms=interval_s * 1e3,
+                          stale_after=3, dead_after=10,
+                          clock=lambda: 0.0, fetch=lambda url: text,
+                          retain=600)
+    best_scrape = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            agg.scrape_once(now=float(i))
+        best_scrape = min(best_scrape, (time.perf_counter() - t0) / n)
+    M.reset_metrics()
+
+    duty_sample = 100.0 * best_sample / interval_s
+    duty_scrape = 100.0 * best_scrape / interval_s
+    result = {
+        "registry_series": series,
+        "sample_once_us": round(best_sample * 1e6, 1),
+        "scrape_once_us": round(best_scrape * 1e6, 1),
+        "interval_ms": interval_s * 1e3,
+        "duty_pct_sampler": round(duty_sample, 4),
+        "duty_pct_fleet_scrape": round(duty_scrape, 4),
+        "threshold_pct": threshold_pct,
+        "protocol": ("min-of-3 mean cost over %d sample_once()/"
+                     "scrape_once() passes against a %d-instrument "
+                     "registry, as %% of the 1s default interval"
+                     % (n, series)),
+    }
+    print("[bench_all] ts overhead: %s" % json.dumps(result),
+          file=sys.stderr)
+    if duty_sample > threshold_pct or duty_scrape > threshold_pct:
+        raise SystemExit(
+            "bench_all --ts-overhead: sampler duty %.3f%% / fleet scrape "
+            "duty %.3f%% of the sampling interval (gate %.2f%% on BOTH) "
+            "— the time-series plane must stay cheap enough to leave on"
+            % (duty_sample, duty_scrape, threshold_pct))
+    print("[bench_all] ts-overhead gate passed (sampler %.4f%% / scrape "
+          "%.4f%% <= %.2f%%)" % (duty_sample, duty_scrape, threshold_pct),
+          file=sys.stderr)
+    return result
+
+
 def bench_autotune(gate_pct=None):
     """--autotune: drive the search-based autotuner (ISSUE 6) over its
     three knob families and record tuned-vs-default numbers, so the perf
@@ -2899,6 +2993,11 @@ if __name__ == "__main__":
         # standalone gate: request tracing (on AND sampled-out) must
         # cost < 1% of a serving request (docs/observability.md)
         bench_obs_overhead()
+    elif "--ts-overhead" in sys.argv[1:]:
+        # standalone gate: the time-series sampler and the fleet scrape
+        # loop must each occupy < 1% of their sampling interval
+        # (docs/observability.md)
+        bench_ts_overhead()
     elif "--perf-overhead" in sys.argv[1:]:
         # standalone gate: the roofline-attribution layer (fenced split,
         # memoized cost accounting, waterfall records) must cost < 1% of

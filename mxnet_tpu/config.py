@@ -417,6 +417,51 @@ Flags currently honored:
     env-only — like MXNET_PROFILER_MODE, NOT routed through the integer
     get_flag machinery (unset must mean "off", not port 0).
 
+``MXNET_OBS_TS_INTERVAL_MS`` (default 1000)
+    Sampling period of the time-series plane
+    (observability/timeseries.py): a background daemon thread snapshots
+    the metrics registry into per-instrument bounded rings every this
+    many milliseconds, powering the ``/varz?window=`` trailing-window
+    queries (counter rates, gauge avg/min/max, bucket-delta histogram
+    quantiles) and the ``timeseries`` flight-recorder provider. Started
+    with the exposition plane (or ``timeseries.start_sampler()``).
+    0 = no sampler (and /varz explains why). Per-sample cost is one
+    locked registry walk, gated < 1% duty cycle by ``bench_all.py
+    --ts-overhead``.
+
+``MXNET_OBS_TS_RETAIN`` (default 600)
+    Ring depth of the time-series sampler, in samples per instrument —
+    at the default 1 s interval, 10 minutes of look-back. Bounds host
+    memory: older samples are evicted, so windows wider than
+    interval×retain silently see a shorter baseline.
+
+``MXNET_OBS_FLEET_INTERVAL_MS`` (default 1000)
+    Scrape period of the FleetAggregator (observability/fleet.py):
+    every worker ``/metrics`` endpoint is fetched, parsed (promparse)
+    and merged into fleet-level series with per-worker labels each
+    interval.
+
+``MXNET_OBS_FLEET_STALE_SCRAPES`` (default 3)
+    Consecutive failed scrapes before a worker is marked ``stale``
+    (still merged from history, flagged in ``fleet_status()``).
+
+``MXNET_OBS_FLEET_DEAD_SCRAPES`` (default 10)
+    Consecutive failed scrapes before a worker is marked ``dead``: its
+    series stop being appended (they go stale in windowed queries
+    rather than flat-lining at the last value) and the autoscaler can
+    count it out of availability.
+
+``MXNET_AUTOSCALE_MIN`` (default 1) / ``MXNET_AUTOSCALE_MAX`` (default 8)
+    Clamp bounds for ``AutoscalePolicy`` decisions
+    (serving/control/autoscale.py): the replica count proposed to
+    ``InferenceServer.resize_replicas`` always lands in
+    [MIN, MAX], whatever the burn rates say.
+
+``MXNET_AUTOSCALE_COOLDOWN_MS`` (default 30000)
+    Minimum spacing between autoscale *actions*. Scale-downs also
+    require the low-load condition to hold over the whole trailing
+    window (hysteresis) so flapping input cannot oscillate the fleet.
+
 ``MXNET_PERF`` (default 1)
     Roofline attribution layer (observability/perf.py): analytic
     FLOPs/HBM-bytes accounting per compiled program, achieved-vs-
@@ -507,6 +552,14 @@ _DEFAULTS = {
     "MXNET_SERVING_COOLDOWN_MS": 1000,
     "MXNET_OBS_TRACE_SAMPLE": 1,
     "MXNET_OBS_RESERVOIR": 32,
+    "MXNET_OBS_TS_INTERVAL_MS": 1000,
+    "MXNET_OBS_TS_RETAIN": 600,
+    "MXNET_OBS_FLEET_INTERVAL_MS": 1000,
+    "MXNET_OBS_FLEET_STALE_SCRAPES": 3,
+    "MXNET_OBS_FLEET_DEAD_SCRAPES": 10,
+    "MXNET_AUTOSCALE_MIN": 1,
+    "MXNET_AUTOSCALE_MAX": 8,
+    "MXNET_AUTOSCALE_COOLDOWN_MS": 30000,
     "MXNET_PERF": 1,
     "MXNET_PERF_RING": 64,
     "MXNET_PROFILER_RING": 200000,

@@ -95,6 +95,43 @@ class KVStoreServer:
         self._barrier_waiters = []  # guarded-by: self._lock
         self._barrier_gen = 0
         self._stop = threading.Event()
+        self._register_heartbeat_series()
+
+    def _register_heartbeat_series(self):
+        """Export per-rank heartbeat AGES as gauges refreshed at
+        observation time (a timeseries pre-sample hook, also run on
+        every /metrics scrape): "rank 3 is 40 s behind" becomes a
+        queryable fleet series instead of a crash-time artifact in a
+        BarrierTimeoutError. Ages grow while a rank stays silent, which
+        is exactly why a write-time gauge (set on heartbeat arrival)
+        would freeze near zero for a dead rank."""
+        import weakref
+
+        from .observability import metrics as _metrics
+        from .observability import timeseries as _ts
+
+        hook = "kvstore.heartbeats.%s" % self.address
+        ref = weakref.ref(self)
+
+        def _refresh():
+            srv = ref()
+            if srv is None or srv._stop.is_set():
+                _ts.unregister_pre_sample(hook)
+                _metrics.unregister("kvstore.worker_heartbeat_age_s")
+                return
+            now = time.time()
+            with srv._lock:
+                ages = {rank: now - ts
+                        for rank, ts in srv._last_seen.items()}
+            for rank, age in ages.items():
+                _metrics.gauge(
+                    "kvstore.worker_heartbeat_age_s",
+                    labels={"rank": rank},
+                    help="seconds since this worker rank last contacted "
+                         "the PS shard").set(round(age, 3))
+
+        self._hb_hook = hook
+        _ts.register_pre_sample(hook, _refresh)
 
     # --- command handlers -------------------------------------------------
     def _handle(self, msg, conn_state):
@@ -383,6 +420,13 @@ class KVStoreServer:
 
     def stop(self):
         self._handle(("stop",), {})
+        from .observability import metrics as _metrics
+        from .observability import timeseries as _ts
+
+        _ts.unregister_pre_sample(self._hb_hook)
+        # stopped shard: its rank-age gauges leave /metrics rather than
+        # freezing at their last values
+        _metrics.unregister("kvstore.worker_heartbeat_age_s")
 
 
 class _NumpyUpdater:

@@ -35,7 +35,27 @@ Three pillars, one import:
 * :mod:`.exposition` — opt-in stdlib HTTP plane
   (``MXNET_OBS_HTTP_PORT``): ``/metrics`` (Prometheus text),
   ``/statusz`` (live engine/provider JSON), ``/healthz``, ``/tracez``
-  (tail request-trace exemplars).
+  (tail request-trace exemplars), ``/varz?window=`` (trailing-window
+  rates/quantiles).
+* :mod:`.promparse` — the scrape side of the exposition contract: the
+  ONE Prometheus text-format parser (round-trip-tested against
+  :func:`dump_metrics`) that the fleet aggregator, obs_smoke and the
+  compliance tests share.
+* :mod:`.timeseries` — the time-series plane (ISSUE 17): a background
+  sampler snapshots the registry into bounded per-instrument rings
+  (``MXNET_OBS_TS_*``), with windowed queries — counter ``rate()``,
+  gauge avg/min/max, bucket-delta histogram quantiles ("p99 over the
+  last minute", not since boot) — behind ``/varz`` and the
+  ``timeseries`` flight-recorder provider.
+* :mod:`.fleet` — :class:`~.fleet.FleetAggregator`: scrape N workers'
+  ``/metrics``, merge into fleet-level series with per-worker labels
+  (histograms bit-exactly, rates reset-safely), mark workers
+  stale/dead on missed scrapes; per-rank kvstore heartbeat ages ride
+  along as queryable series.
+* :mod:`.slo_monitor` — SLO objectives (latency-threshold,
+  availability) evaluated as multi-window burn rates with hysteresis —
+  the alert layer the autoscaler (serving/control/autoscale.py) acts
+  on.
 
 See docs/observability.md for the metrics catalog, the "where did my
 step time go" workflow (profiler dump → tools/trace_report.py), the
@@ -52,6 +72,10 @@ from . import request_trace
 from . import stats_schema
 from . import exposition
 from . import perf
+from . import promparse
+from . import timeseries
+from . import fleet
+from . import slo_monitor
 from .metrics import (counter, gauge, histogram, dump_metrics,
                       reset_metrics, set_enabled, enabled)
 from .tracing import trace_span, device_scope
@@ -61,6 +85,7 @@ from .request_trace import RequestTrace
 
 __all__ = ["metrics", "instruments", "tracing", "health", "flight_recorder",
            "request_trace", "stats_schema", "exposition", "perf",
+           "promparse", "timeseries", "fleet", "slo_monitor",
            "counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "set_enabled", "enabled", "trace_span", "device_scope",
            "sample_memory", "record_step", "retrace_causes",

@@ -18,6 +18,10 @@ registry). A serving fleet needs the pull side: Prometheus scraping
   the signal; readiness belongs to the engines' own admission control).
 * ``GET /tracez`` — recent + slowest request-trace exemplars
   (request_trace.tracez): full per-phase span timelines for the tail.
+* ``GET /varz?window=60`` — trailing-window JSON from the time-series
+  sampler (timeseries.varz): counter rates, gauge avg/min/max, and
+  bucket-delta histogram quantiles over the requested window seconds —
+  the "last minute", where /metrics is "since boot".
 
 Enable it by environment — ``MXNET_OBS_HTTP_PORT=9100`` (0 picks an
 ephemeral port) before importing mxnet_tpu — or programmatically with
@@ -113,9 +117,15 @@ def _make_handler():
         server_version = "mxnet-tpu-obs/1"
 
         def do_GET(self):  # noqa: N802 - http.server API
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/") or "/"
             try:
                 if path == "/metrics":
+                    # refresh derived gauges (heartbeat ages etc.) at
+                    # scrape time — they grow while their writers stay
+                    # silent, so write-time values would freeze
+                    from . import timeseries
+                    timeseries._run_pre_sample_hooks()
                     body = metrics.dump_metrics().encode()
                     ctype = metrics.PROM_CONTENT_TYPE
                 elif path in ("/", "/statusz"):
@@ -127,11 +137,20 @@ def _make_handler():
                 elif path == "/tracez":
                     body, ctype = (_json_bytes(request_trace.tracez()),
                                    "application/json; charset=utf-8")
+                elif path == "/varz":
+                    from . import timeseries
+                    window = 60.0
+                    for part in query.split("&"):
+                        k, _, v = part.partition("=")
+                        if k == "window" and v:
+                            window = max(0.001, float(v))
+                    body, ctype = (_json_bytes(timeseries.varz(window)),
+                                   "application/json; charset=utf-8")
                 else:
                     body = _json_bytes(
                         {"error": "unknown path %r" % path,
                          "paths": ["/metrics", "/statusz", "/healthz",
-                                   "/tracez"]})
+                                   "/tracez", "/varz"]})
                     self._reply(404, body, "application/json; charset=utf-8")
                     return
             except Exception as err:  # read-only plane: report, never die
@@ -187,8 +206,12 @@ def start_http(port=None, host=None):
         thread.start()
         _server, _thread = server, thread
         bound = server.server_address[1]
+    # /varz needs a running sampler; MXNET_OBS_TS_INTERVAL_MS=0 opts out
+    from . import timeseries
+
+    timeseries.start_sampler()
     _log.info("observability HTTP plane on http://%s:%d "
-              "(/metrics /statusz /healthz /tracez)", host, bound)
+              "(/metrics /statusz /healthz /tracez /varz)", host, bound)
     return bound
 
 
@@ -203,6 +226,10 @@ def stop_http():
         server.server_close()
     if thread is not None:
         thread.join(timeout=5)
+    if server is not None:
+        from . import timeseries
+
+        timeseries.stop_sampler()
 
 
 def http_port():

@@ -40,7 +40,8 @@ import threading
 
 __all__ = ["counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "enabled", "set_enabled", "get_value", "all_instruments",
-           "PROM_CONTENT_TYPE"]
+           "snapshot_values", "unregister", "unregister_on_collect",
+           "percentile", "bucket_quantile", "PROM_CONTENT_TYPE"]
 
 # the content type a compliant scrape endpoint must declare for this
 # text format (exposition.py's /metrics sends it)
@@ -232,6 +233,18 @@ class Histogram:
         """Observations dropped into the +Inf bucket for being NaN/Inf."""
         return self._nonfinite
 
+    def quantile(self, q):
+        """Estimated ``q``-quantile (q in [0, 1]) of everything observed
+        since boot, from the bucket counts — the shared estimator the
+        time-series plane, ``trace_report``, and ``stats_schema`` all
+        use (see :func:`bucket_quantile` for the interpolation rule).
+        Windowed ("trailing 60 s, not since boot") quantiles live in
+        :mod:`.timeseries`, computed from bucket DELTAS between two
+        snapshots with the same function."""
+        with _lock:
+            counts = list(self._counts)
+        return bucket_quantile(self.buckets, counts, q)
+
     def _reset(self):
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -405,6 +418,122 @@ def all_instruments():
     thread registers a new instrument mid-copy (graftlint G004 finding)."""
     with _lock:
         return dict(_registry)
+
+
+def snapshot_values():
+    """Locked point-in-time snapshot for the time-series sampler
+    (:mod:`.timeseries`): a list of ``(name, labels, kind, buckets,
+    payload)`` rows, one per registered instrument. ``payload`` is the
+    scalar value for counters/gauges and ``(cumulative bucket counts
+    including +Inf, sum, count)`` for histograms; ``buckets`` is the
+    finite upper-bound ladder (None for scalars).
+
+    Taken under the SAME lock as the mutators, exactly like
+    :func:`dump_metrics`: a histogram snapshot must never pair a sum
+    with a count that misses its observation — windowed quantiles are
+    bucket DELTAS between two of these snapshots, so a torn snapshot
+    would poison two windows, not one."""
+    out = []
+    with _lock:
+        for inst in _registry.values():
+            if isinstance(inst, Histogram):
+                cum, running = [], 0
+                for c in inst._counts:
+                    running += c
+                    cum.append(running)
+                out.append((inst.name, inst.labels, inst.kind,
+                            inst.buckets, (tuple(cum), inst._sum,
+                                           inst._count)))
+            else:
+                out.append((inst.name, inst.labels, inst.kind, None,
+                            inst._value))
+    return out
+
+
+def unregister(name, labels=None):
+    """Remove one child (``labels`` given) or a whole metric family
+    (``labels=None``) from the registry; returns how many instruments
+    were removed.
+
+    This exists for OWNED gauges: a gauge written by an engine object
+    freezes at its last value when the object stops — ``/metrics``
+    then reports a queue depth for a server that no longer exists.
+    Engines call this from their stop path (and via
+    :func:`unregister_on_collect` as a GC safety net) so a dead
+    owner's gauges disappear from the scrape instead of lying. A later
+    write simply re-creates the instrument."""
+    with _lock:
+        if labels is None:
+            doomed = [k for k, inst in _registry.items()
+                      if inst.name == name]
+        else:
+            key = _key(name, labels)
+            doomed = [key] if key in _registry else []
+        for k in doomed:
+            del _registry[k]
+    return len(doomed)
+
+
+def unregister_on_collect(owner, names):
+    """Arm a ``weakref.finalize`` that unregisters every family in
+    ``names`` when ``owner`` is garbage-collected — the WeakSet-provider
+    discipline: an engine that is dropped without a clean ``stop()``
+    must not leave frozen gauges behind. Idempotent with the explicit
+    stop-path :func:`unregister` (removing a missing family is a
+    no-op). Returns the finalizer (tests call it directly)."""
+    import weakref
+
+    names = tuple(names)
+    return weakref.finalize(
+        owner, lambda: [unregister(n) for n in names])
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ASCENDING-sorted sequence of raw
+    values (``q`` in 0-100) — the shared estimator for exact-sample
+    paths (``trace_report --requests``); bucketed data goes through
+    :func:`bucket_quantile` instead."""
+    if not sorted_vals:
+        return 0.0
+    if q <= 0:
+        return sorted_vals[0]
+    rank = max(1, math.ceil(q / 100.0 * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def bucket_quantile(uppers, counts, q):
+    """Estimated ``q``-quantile (q in [0, 1]) from histogram buckets.
+
+    ``uppers``: ascending finite bucket upper bounds; ``counts``:
+    per-bucket (NON-cumulative) counts with ``len(uppers) + 1`` entries,
+    the last being the +Inf overflow bucket. Callers holding cumulative
+    snapshots (``snapshot_values`` payloads, scraped ``_bucket`` lines)
+    difference them first — which is also how windowed quantiles fall
+    out: the delta of two cumulative snapshots IS the window's counts.
+
+    The Prometheus ``histogram_quantile`` rule: find the bucket the
+    rank lands in, interpolate linearly inside it (lower bound 0 for
+    the first bucket); a rank in the +Inf bucket returns the highest
+    finite bound — the estimator never invents a value beyond the
+    ladder. Returns 0.0 for an empty histogram."""
+    if len(counts) != len(uppers) + 1:
+        raise ValueError(
+            "bucket_quantile: %d counts for %d finite buckets (want "
+            "len(uppers) + 1, last = +Inf overflow)"
+            % (len(counts), len(uppers)))
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts[:-1]):
+        cum += c
+        if rank <= cum and c > 0:
+            lo = uppers[i - 1] if i > 0 else min(0.0, uppers[0])
+            frac = (rank - (cum - c)) / c
+            return lo + (uppers[i] - lo) * frac
+    return float(uppers[-1]) if uppers else 0.0
 
 
 def reset_metrics():

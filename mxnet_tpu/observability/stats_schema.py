@@ -110,14 +110,40 @@ def validate(stats):
     return stats
 
 
+def _latency_brief(engine):
+    """p50/p99/mean of the engine's completed-request latency from the
+    live ``request.total_ms{engine=...}`` histogram, via the registry's
+    shared bucket estimator (``Histogram.quantile`` — the same math the
+    time-series plane and trace_report use). None when telemetry is off
+    or no request completed yet."""
+    from . import metrics
+
+    for inst in metrics.all_instruments().values():
+        # instrument labels are the canonical ((key, value), ...) tuple
+        if (inst.name == "request.total_ms"
+                and isinstance(inst, metrics.Histogram)
+                and dict(inst.labels or ()).get("engine") == engine
+                and inst.count > 0):
+            return {"count": inst.count,
+                    "mean_ms": round(inst.mean, 3),
+                    "p50_ms": round(inst.quantile(0.50), 3),
+                    "p99_ms": round(inst.quantile(0.99), 3)}
+    return None
+
+
 def summarize(stats):
     """The compact /statusz engine row: shared core + the capacity and
-    resilience dicts (already small), plus the control-plane section
-    when the engine carries one — none of the legacy flat keys."""
+    resilience dicts (already small), a since-boot latency brief from
+    the registry's shared quantile estimator, plus the control-plane
+    section when the engine carries one — none of the legacy flat
+    keys."""
     validate(stats)
     out = {k: stats[k] for k in ("engine", "queue_depth", "requests",
                                  "completed", "rejected", "running",
                                  "stopped", "capacity", "resilience")}
+    latency = _latency_brief(stats["engine"])
+    if latency is not None:
+        out["latency"] = latency
     if "control" in stats:
         out["control"] = stats["control"]
     return out

@@ -3,9 +3,16 @@ radix-tree prefix cache sharing KV pages copy-on-write across requests
 with a common prompt prefix, plus SLO-class (deadline + priority tier)
 weighted admission with aging — layered over the generation engine's
 PagePool and continuous-batching scheduler. The path to disaggregated
-prefill/decode serving (ROADMAP item 5) runs through this machinery."""
+prefill/decode serving (ROADMAP item 5) runs through this machinery.
+
+ISSUE 17 adds the closed loop: :class:`AutoscalePolicy` /
+:class:`Autoscaler` (autoscale.py) turn the observability plane's
+time-series view (queue-depth windows, replica gauges, SLO burn-rate
+alerts) into live ``InferenceServer.resize_replicas`` calls."""
+from .autoscale import Autoscaler, AutoscalePolicy, ScaleDecision
 from .prefix_cache import PrefixCache
 from .slo import BUILTIN_CLASSES, ClassQueue, SLOClass, resolve_class
 
 __all__ = ["PrefixCache", "SLOClass", "ClassQueue", "resolve_class",
-           "BUILTIN_CLASSES"]
+           "BUILTIN_CLASSES", "AutoscalePolicy", "Autoscaler",
+           "ScaleDecision"]

@@ -209,6 +209,12 @@ _InFlight = collections.namedtuple(
 # (same discipline as kvstore._live_stores)
 _live_servers = weakref.WeakSet()
 
+# gauges owned by an InferenceServer: deleted from the registry when
+# the owner stops or is collected so /metrics never exposes a dead
+# server's last values as live readings
+_SERVER_GAUGES = ("serving.queue_depth", "serving.replicas_configured",
+                  "serving.replicas_available")
+
 
 def _servers_state():
     views = []
@@ -361,12 +367,22 @@ class InferenceServer:
         # tuning key pinned to the ORIGINAL fingerprint so exec.remat/
         # serving entries tuned under any pass config keep resolving
         self._prog = _GraphProgram(opt_symbol, tuning_key=base_key)
+        # post-fold host params are retained so resize_replicas can
+        # stage parameters onto replicas added after construction —
+        # numerically identical to the originals by construction
+        self._host_args = host_args
+        self._host_aux = host_aux
         self._replica_args = [jax.device_put(host_args, dev)
                               for dev in self._devices]
         self._replica_aux = [jax.device_put(host_aux, dev)
                              for dev in self._devices]
+        # replica SLOTS are append-only (indices stay stable for
+        # in-flight batches and breaker bookkeeping); membership in the
+        # round-robin set is this set, mutated live by resize_replicas
+        self._device_pool = list(self._devices)
 
         self._lock = threading.Lock()
+        self._active = set(range(len(self._devices)))  # guarded-by: self._lock
         self._stats = collections.Counter()   # guarded-by: self._lock
         self._programs = set()  # (replica, bucket) pairs dispatched  # guarded-by: self._lock
         self._bucket_extras = {}  # (replica, bucket) -> (extra args, aux)  # guarded-by: self._lock
@@ -389,9 +405,13 @@ class InferenceServer:
         self._thread = None
         self._life = threading.Lock()  # serializes start()/stop()
         _live_servers.add(self)
-        from ..observability import flight_recorder
+        from ..observability import flight_recorder, metrics
 
         flight_recorder.register_provider("serving", _servers_state)
+        self._update_replica_gauges()
+        # a collected (not stopped) server must not leave its gauges
+        # frozen at their last value in /metrics forever
+        metrics.unregister_on_collect(self, _SERVER_GAUGES)
         if start:
             self.start()
 
@@ -490,6 +510,7 @@ class InferenceServer:
                                             name="mxnet-serving-dispatch",
                                             daemon=True)
             self._thread.start()
+        self._update_replica_gauges()  # restart after stop() re-creates
         return self
 
     def stop(self, drain=True, timeout=None):
@@ -518,6 +539,13 @@ class InferenceServer:
                 # by running the dispatch loop inline — with _stop set
                 # it flushes (or abort-fails) the queue and returns
                 self._dispatch_loop()
+        # a stopped server's gauges must disappear from /metrics, not
+        # freeze at their final values (start() re-creates them on the
+        # next write)
+        from ..observability import metrics
+
+        for name in _SERVER_GAUGES:
+            metrics.unregister(name)
         return self
 
     def _abandon_drain(self, timeout):
@@ -560,7 +588,7 @@ class InferenceServer:
         import jax
 
         n = 0
-        for rep in (range(len(self._devices)) if replicas is None
+        for rep in (self.active_replicas() if replicas is None
                     else replicas):
             for bucket in self._cfg.buckets:
                 outs = self._run_bucket(rep, bucket, self._zero_batch(bucket))
@@ -572,6 +600,105 @@ class InferenceServer:
         return [np.zeros((bucket,) + s,
                          dtype=self._arg_dtypes.get(n, np.float32))
                 for n, s in zip(self._data_names, self._row_shapes)]
+
+    # ---------------------------------------------------------- resizing
+    def active_replicas(self):
+        """Sorted indices of replicas currently in the round-robin set."""
+        with self._lock:
+            return sorted(self._active)
+
+    def _update_replica_gauges(self):
+        from ..observability import metrics
+
+        with self._lock:
+            configured = len(self._active)
+            available = len(self._active - set(self._quarantined))
+        metrics.gauge("serving.replicas_configured").set(configured)
+        metrics.gauge("serving.replicas_available").set(available)
+
+    def resize_replicas(self, n):
+        """Set the number of serving replicas to ``n``, live — the
+        autoscaler's actuator (serving/control/autoscale.py), callable
+        mid-traffic.
+
+        Replica SLOTS are append-only so indices stay stable for
+        in-flight batches: a scale-down *deactivates* slots (quarantined
+        ones first, then highest index — params freed, bucket bindings
+        dropped, membership removed from round-robin) and a scale-up
+        first *reactivates* vacant slots (one pytree ``device_put`` of
+        the retained post-fold host params — numerically identical to
+        construction) before appending new slots on pool devices, round-
+        robin over the pool (two replicas per device is legal and how a
+        single-device test exercises the path). Admission, the queue and
+        the in-flight window are untouched: FIFO completion order is
+        preserved across a resize by construction. A dispatcher racing a
+        just-deactivated replica gets the normal quarantine-and-retry
+        path; the next pick sees the new membership.
+
+        Returns ``{"replicas", "added", "removed"}``.
+        """
+        import jax
+
+        from ..observability import metrics
+
+        n = int(n)
+        if n < 1:
+            raise ValueError("resize_replicas(%d): need at least one "
+                             "replica" % n)
+        with self._lock:
+            active = sorted(self._active)
+            quarantined = set(self._quarantined)
+        added, removed = [], []
+        if n < len(active):
+            # victims: quarantined first (already out of rotation),
+            # then highest index (newest capacity first)
+            ordered = sorted(active,
+                             key=lambda r: (r in quarantined, r),
+                             reverse=True)
+            removed = sorted(ordered[:len(active) - n])
+            with self._lock:
+                for rep in removed:
+                    self._active.discard(rep)
+                    self._quarantined.pop(rep, None)
+                    for key in [k for k in self._bucket_extras
+                                if k[0] == rep]:
+                        del self._bucket_extras[key]
+                self._stats["scale_downs"] += 1
+            for rep in removed:
+                # free the replica's params; slot index stays reserved
+                self._replica_args[rep] = None
+                self._replica_aux[rep] = None
+        elif n > len(active):
+            need = n - len(active)
+            with self._lock:
+                vacant = [i for i in range(len(self._devices))
+                          if i not in self._active]
+            for i in vacant[:need]:
+                dev = self._devices[i]
+                self._replica_args[i] = jax.device_put(self._host_args,
+                                                       dev)
+                self._replica_aux[i] = jax.device_put(self._host_aux, dev)
+                added.append(i)
+            while len(added) < need:
+                idx = len(self._devices)
+                dev = self._device_pool[idx % len(self._device_pool)]
+                self._devices.append(dev)
+                self._replica_args.append(
+                    jax.device_put(self._host_args, dev))
+                self._replica_aux.append(
+                    jax.device_put(self._host_aux, dev))
+                added.append(idx)
+            with self._lock:
+                self._active.update(added)
+                self._stats["scale_ups"] += 1
+        if added or removed:
+            self._update_replica_gauges()
+            metrics.counter("serving.resizes").inc()
+            with self._cond:
+                self._cond.notify_all()  # new capacity: wake the loop
+        with self._lock:
+            count = len(self._active)
+        return {"replicas": count, "added": added, "removed": removed}
 
     # ------------------------------------------------------------- submit
     def submit(self, data):
@@ -851,14 +978,19 @@ class InferenceServer:
 
     # ------------------------------------------------- replica failover
     def _pick_replica(self):
-        """Next replica in round-robin order, skipping quarantined ones;
-        None when every replica is quarantined."""
-        n = len(self._devices)
+        """Next ACTIVE replica in round-robin order, skipping
+        quarantined ones; None when nothing is dispatchable. ``_rr`` is
+        a dispatcher-thread-only cursor into the sorted active set, so
+        resize_replicas changing membership between batches just reshapes
+        the rotation."""
         with self._lock:
+            active = sorted(self._active)
             quarantined = set(self._quarantined)
-        for _ in range(n):
-            rep = self._rr
-            self._rr = (self._rr + 1) % n
+        if not active:
+            return None
+        for _ in range(len(active)):
+            rep = active[self._rr % len(active)]
+            self._rr += 1
             if rep not in quarantined:
                 return rep
         return None
@@ -868,9 +1000,14 @@ class InferenceServer:
         from ..observability import metrics
 
         with self._lock:
+            if rep not in self._active:
+                # raced a scale-down: the replica is already out of
+                # rotation, nothing to quarantine
+                return
             self._quarantined[rep] = (time.monotonic()
                                       + self._cfg.cooldown_ms / 1e3)
             self._stats["quarantines"] += 1
+        self._update_replica_gauges()
         metrics.counter("serving.replica_quarantined").inc()
         import logging
 
@@ -889,7 +1026,7 @@ class InferenceServer:
         now = time.monotonic()
         with self._lock:
             due = [rep for rep, until in self._quarantined.items()
-                   if now >= until]
+                   if now >= until and rep in self._active]
         for rep in due:
             probe_bucket = self._cfg.buckets[0]
             try:
@@ -904,6 +1041,7 @@ class InferenceServer:
             with self._lock:
                 self._quarantined.pop(rep, None)
                 self._stats["readmitted"] += 1
+            self._update_replica_gauges()
             metrics.counter("serving.replica_readmitted").inc()
 
     def _retry_batch(self, ent):
@@ -1014,7 +1152,7 @@ class InferenceServer:
         now = time.monotonic()
         with self._lock:
             quarantined = dict(self._quarantined)
-        n = len(self._devices)
+            n = len(self._active)
         if not quarantined:
             state = "closed"
         elif len(quarantined) >= n:
@@ -1043,6 +1181,8 @@ class InferenceServer:
         with self._lock:
             counters = dict(self._stats)
             quarantined = sorted(self._quarantined)
+            replicas = len(self._active)
+            slots = len(self._devices)
         return _schema.engine_stats(
             "serving", counters,
             queue_depth=depth,
@@ -1050,7 +1190,8 @@ class InferenceServer:
             running=self.running, stopped=stopped,
             capacity={
                 "buckets": list(self._cfg.buckets),
-                "replicas": len(self._devices),
+                "replicas": replicas,
+                "replica_slots": slots,
                 "inflight": len(self._inflight),
                 "pipeline_depth": self._cfg.pipeline_depth,
                 "queue_limit_rows": self._cfg.max_queue_rows,
@@ -1077,7 +1218,7 @@ class InferenceServer:
                 "staged_batches": self._inflight.pushed,
                 "staging_wait_s": round(self._inflight.wait_s, 6),
                 "buckets": list(self._cfg.buckets),
-                "replicas": len(self._devices),
+                "replicas": replicas,
                 "quarantined_replicas": quarantined,
                 "deadline_ms": self._cfg.deadline_ms,
                 "max_wait_ms": self._cfg.max_wait_ms,

@@ -310,6 +310,14 @@ _Pending = collections.namedtuple(
 # provider walks them (same discipline as serving._live_servers)
 _live_generators = weakref.WeakSet()
 
+# gauges owned by a Generator (the KV gauges belong to its PagePool,
+# which dies with it): removed from the registry when the owner stops
+# or is collected so /metrics never serves a dead engine's last values
+_GENERATOR_GAUGES = ("generation.slo_queue_depth",
+                     "generation.decode_batch_occupancy",
+                     "generation.kv_pages_used",
+                     "generation.kv_bytes_used")
+
 
 def _generators_state():
     views = []
@@ -544,9 +552,12 @@ class Generator:
         self._thread = None
         self._life = threading.Lock()  # serializes start()/stop()
         _live_generators.add(self)
-        from ...observability import flight_recorder
+        from ...observability import flight_recorder, metrics
 
         flight_recorder.register_provider("generation", _generators_state)
+        # a collected (not stopped) generator must not leave its gauges
+        # frozen at their last values in /metrics
+        metrics.unregister_on_collect(self, _GENERATOR_GAUGES)
         if start:
             self.start()
 
@@ -1076,6 +1087,12 @@ class Generator:
                 # cache's page references so a drained pool reports
                 # zero pages (assert_no_leaks holds after stop)
                 self.prefix_cache.clear()
+        # stopped engine: its gauges leave /metrics instead of freezing
+        # at their last values (start() re-creates them on next write)
+        from ...observability import metrics
+
+        for name in _GENERATOR_GAUGES:
+            metrics.unregister(name)
         return self
 
     def _abandon_drain(self, timeout):

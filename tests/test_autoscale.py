@@ -1,0 +1,407 @@
+"""SLO burn-rate alerts + metrics-driven autoscaling (ISSUE 17): burn
+math against hand-computed values, the alert latch's hysteresis, the
+policy decision table, cooldown/anti-flap discipline, live
+``resize_replicas`` semantics, and the full closed loop — fault-injected
+replica loss → burn-rate alert → scale-up → recovery → scale-down —
+under a fake clock."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.observability import slo_monitor as SLO
+from mxnet_tpu.observability import timeseries as TS
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.serving.control import AutoscalePolicy, Autoscaler
+
+
+@pytest.fixture
+def telemetry():
+    mx.observability.set_enabled(True)
+    mx.observability.reset_metrics()
+    yield
+    faults.configure(None)
+    mx.observability.reset_metrics()
+    mx.observability.set_enabled(False)
+
+
+def _hist(store, name, t, cum, total, s):
+    """Append one histogram snapshot: buckets (50, 200)."""
+    store.append(name, (), "histogram", (50.0, 200.0), (cum, s, total), t)
+
+
+# ------------------------------------------------------------ burn math
+def test_fraction_within_hand_computed():
+    win = {"count": 20, "buckets": (50.0, 200.0),
+           "counts": [10, 5, 5], "sum": 0.0}
+    # threshold at a bucket bound: everything in buckets strictly below
+    assert SLO._fraction_within(win, 50.0) == pytest.approx(0.5)
+    # threshold mid-bucket: linear interpolation inside (50, 200]
+    # 10 fast + 5 * (125-50)/(200-50) = 12.5 of 20
+    assert SLO._fraction_within(win, 125.0) == pytest.approx(0.625)
+    # +Inf observations are over-threshold at ANY finite threshold
+    assert SLO._fraction_within(win, 10_000.0) == pytest.approx(0.75)
+    # empty window: vacuously within (burn 0, not a false alarm)
+    assert SLO._fraction_within({"count": 0, "buckets": (50.0,),
+                                 "counts": [0, 0], "sum": 0.0}, 1) == 1.0
+
+
+def test_latency_objective_burn(telemetry):
+    store = TS.SeriesStore(100)
+    _hist(store, "ttft", 0.0, (0, 0, 0), 0, 0.0)
+    # 20 requests in the window, 10 over the 50ms threshold
+    _hist(store, "ttft", 60.0, (10, 10, 20), 20, 1000.0)
+    obj = SLO.LatencyObjective("ttft", "ttft", threshold=50.0, q=0.95)
+    # bad fraction 0.5 against a 5% budget -> burn 10
+    assert obj.burn(store, 60.0, now=60.0) == pytest.approx(10.0)
+    # empty window -> 0.0, never a divide-by-zero alarm
+    assert obj.burn(store, 10.0, now=200.0) == 0.0
+    with pytest.raises(ValueError):
+        SLO.LatencyObjective("x", "m", 50.0, q=1.0)
+
+
+def test_availability_objective_burn():
+    store = TS.SeriesStore(100)
+    for t, total, errs in [(0.0, 0.0, 0.0), (60.0, 1000.0, 5.0)]:
+        store.append("req", (), "counter", None, total, t)
+        store.append("err", (), "counter", None, errs, t)
+    obj = SLO.AvailabilityObjective("avail", "err", "req", target=0.999)
+    # 0.5% errors against a 0.1% budget -> burn 5
+    assert obj.burn(store, 60.0, now=60.0) == pytest.approx(5.0)
+    # no traffic -> burn 0
+    assert obj.burn(store, 10.0, now=300.0) == 0.0
+
+
+def test_burn_alert_latch_and_hysteresis(telemetry):
+    store = TS.SeriesStore(2000)
+    obj = SLO.LatencyObjective("ttft", "ttft", threshold=50.0, q=0.95)
+    alert = SLO.BurnRateAlert(obj, short_s=60.0, long_s=600.0,
+                              on_threshold=2.0, off_threshold=1.0)
+    _hist(store, "ttft", 0.0, (0, 0, 0), 0, 0.0)
+    assert alert.evaluate(store, 0.0)["firing"] is False
+
+    # sustained badness: 20 obs, half slow -> burn 10 on BOTH windows
+    _hist(store, "ttft", 60.0, (10, 10, 20), 20, 1000.0)
+    row = alert.evaluate(store, 60.0)
+    assert row["firing"] is True
+    assert row["burn_short"] == pytest.approx(10.0)
+
+    # burn dips into the hysteresis band (off < burn < on): stays FIRING
+    # short window (540, 600]: +40 obs, 3 slow -> bad 0.075 -> burn 1.5
+    _hist(store, "ttft", 600.0, (47, 13, 60), 60, 2000.0)
+    row = alert.evaluate(store, 600.0)
+    assert 1.0 < row["burn_short"] < 2.0
+    assert row["firing"] is True           # latched
+    assert row["firing_for_s"] == pytest.approx(540.0)
+
+    # clean window: short burn < off -> clears
+    _hist(store, "ttft", 700.0, (87, 13, 100), 100, 2400.0)
+    row = alert.evaluate(store, 700.0)
+    assert row["burn_short"] < 1.0 and row["firing"] is False
+
+    mon = SLO.SLOMonitor(store, [alert])
+    assert mon.any_firing() is False and mon.firing_names() == []
+
+    with pytest.raises(ValueError):
+        SLO.BurnRateAlert(obj, on_threshold=1.0, off_threshold=2.0)
+
+
+# ------------------------------------------------------- decision table
+class _StubMonitor:
+    def __init__(self):
+        self.firing = []
+
+    def evaluate(self, now):
+        return []
+
+    def firing_names(self):
+        return list(self.firing)
+
+
+def _series(queue=None, configured=None, available=None, now=60.0):
+    s = TS.SeriesStore(100)
+    for t, v in queue or []:
+        s.append("serving.queue_depth", (), "gauge", None, v, t)
+    for t, v in configured or []:
+        s.append("serving.replicas_configured", (), "gauge", None, v, t)
+    for t, v in available or []:
+        s.append("serving.replicas_available", (), "gauge", None, v, t)
+    return s
+
+
+def test_policy_no_telemetry_holds():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=8)
+    d = pol.decide(_series(), now=60.0)
+    assert d.action == "hold" and "no replica telemetry" in d.reason
+
+
+def test_policy_queue_high_scales_up_and_clamps():
+    pol = AutoscalePolicy(queue_high=64, queue_low=4, window_s=30,
+                          min_replicas=1, max_replicas=4)
+    s = _series(queue=[(40.0, 100.0), (50.0, 120.0)],
+                configured=[(50.0, 2.0)], available=[(50.0, 2.0)])
+    d = pol.decide(s, now=60.0)
+    assert (d.replicas, d.action) == (3, "up")
+    assert "high-water" in d.reason
+    # already at max: proposal clamps, never exceeds
+    s = _series(queue=[(50.0, 120.0)], configured=[(50.0, 4.0)],
+                available=[(50.0, 4.0)])
+    assert pol.decide(s, now=60.0).replicas == 4
+
+
+def test_policy_scale_down_needs_whole_window_and_settling():
+    pol = AutoscalePolicy(queue_high=64, queue_low=4, window_s=30,
+                          min_replicas=1, max_replicas=8)
+    quiet = [(35.0, 1.0), (45.0, 0.0), (55.0, 2.0)]
+    s = _series(queue=quiet, configured=[(55.0, 3.0)],
+                available=[(55.0, 3.0)])
+    d = pol.decide(s, now=60.0)
+    assert (d.replicas, d.action) == (2, "down")
+    # one spike inside the window vetoes the down (max, not avg)
+    spiky = quiet + [(50.0, 9.0)]
+    s = _series(queue=spiky, configured=[(55.0, 3.0)],
+                available=[(55.0, 3.0)])
+    assert pol.decide(s, now=60.0).action == "hold"
+    # not settled: a recent action blocks the down
+    s = _series(queue=quiet, configured=[(55.0, 3.0)],
+                available=[(55.0, 3.0)])
+    assert pol.decide(s, now=60.0, last_action_t=40.0).action == "hold"
+    # at the floor: nothing to remove
+    s = _series(queue=quiet, configured=[(55.0, 1.0)],
+                available=[(55.0, 1.0)])
+    assert pol.decide(s, now=60.0).action == "hold"
+
+
+def test_policy_replica_loss_with_slo_firing_wins():
+    mon = _StubMonitor()
+    pol = AutoscalePolicy(queue_high=64, queue_low=4, window_s=30,
+                          min_replicas=1, max_replicas=8,
+                          slo_monitor=mon)
+    s = _series(queue=[(55.0, 8.0)], configured=[(55.0, 3.0)],
+                available=[(55.0, 1.0)])
+    # lost replicas alone (no SLO impact): capacity is still keeping up
+    assert pol.decide(s, now=60.0).action == "hold"
+    mon.firing = ["ttft"]
+    d = pol.decide(s, now=60.0)
+    assert (d.replicas, d.action) == (4, "up")
+    assert "replicas lost (1/3 available)" in d.reason
+    # firing without replica loss: plain SLO scale-up (rule 2)
+    s = _series(queue=[(55.0, 8.0)], configured=[(55.0, 3.0)],
+                available=[(55.0, 3.0)])
+    assert "SLO burn firing" in pol.decide(s, now=60.0).reason
+
+    with pytest.raises(ValueError):
+        AutoscalePolicy(queue_high=4, queue_low=64)
+
+
+# ------------------------------------------------- cooldown / anti-flap
+def test_cooldown_bounds_action_rate(telemetry):
+    pol = AutoscalePolicy(queue_high=10, queue_low=1, window_s=30,
+                          min_replicas=1, max_replicas=8)
+    s = TS.SeriesStore(1000)
+    resized = []
+    clk = [0.0]
+    scaler = Autoscaler(pol, s, resized.append, cooldown_ms=60_000,
+                        clock=lambda: clk[0])
+    for t in (10.0, 20.0, 30.0):
+        s.append("serving.queue_depth", (), "gauge", None, 50.0, t)
+        s.append("serving.replicas_configured", (), "gauge", None,
+                 2.0 + len(resized), t)
+    d = scaler.step(now=30.0)
+    assert d.applied and resized == [3]
+    # still hot 10s later: decision recomputed, action GATED
+    s.append("serving.queue_depth", (), "gauge", None, 50.0, 40.0)
+    s.append("serving.replicas_configured", (), "gauge", None, 3.0, 40.0)
+    d = scaler.step(now=40.0)
+    assert d.action == "up" and not d.applied
+    assert "cooldown" in d.reason and resized == [3]
+    # cooldown elapses: the next hot tick acts again
+    s.append("serving.queue_depth", (), "gauge", None, 50.0, 95.0)
+    s.append("serving.replicas_configured", (), "gauge", None, 3.0, 95.0)
+    d = scaler.step(now=95.0)
+    assert d.applied and resized == [3, 4]
+    assert scaler.state()["decisions"] == 3
+
+
+def test_flapping_queue_causes_zero_actions(telemetry):
+    """A square wave INSIDE the hysteresis band (above low-water, below
+    high-water) must produce no scale actions at all."""
+    pol = AutoscalePolicy(queue_high=64, queue_low=4, window_s=30,
+                          min_replicas=1, max_replicas=8)
+    s = TS.SeriesStore(1000)
+    resized = []
+    scaler = Autoscaler(pol, s, resized.append, cooldown_ms=1)
+    for i in range(40):
+        t = float(i * 10)
+        s.append("serving.queue_depth", (), "gauge", None,
+                 40.0 if i % 2 else 8.0, t)
+        s.append("serving.replicas_configured", (), "gauge", None, 2.0, t)
+        s.append("serving.replicas_available", (), "gauge", None, 2.0, t)
+        d = scaler.step(now=t)
+        assert d.action == "hold", d
+    assert resized == []
+
+
+# ------------------------------------------------------ live closed loop
+def _serving_setup():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 6).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    args = {"fc_weight": mx.nd.array(w), "fc_bias": mx.nd.array(b)}
+
+    def ref(x):
+        logits = x @ w.T + b
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc"),
+        name="softmax")
+    return net, args, ref
+
+
+def test_resize_preserves_fifo_and_parity(telemetry):
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    net, args, ref = _serving_setup()
+    srv = InferenceServer(
+        net, args, data_shapes=[("data", (1, 6))],
+        config=ServingConfig(buckets=(1, 2, 4), max_wait_ms=1))
+    try:
+        rng = np.random.RandomState(5)
+        xs = [rng.rand(1 + i % 3, 6).astype(np.float32) for i in range(8)]
+        order, futs = [], []
+        for i, x in enumerate(xs):
+            f = srv.submit(x)
+            f.add_done_callback(lambda _f, _i=i: order.append(_i))
+            futs.append(f)
+        # grow mid-traffic, then shrink back while more arrives
+        out = srv.resize_replicas(3)
+        assert out["replicas"] == 3 and len(out["added"]) == 2
+        for i, x in enumerate(xs, start=len(xs)):
+            f = srv.submit(x)
+            f.add_done_callback(lambda _f, _i=i: order.append(_i))
+            futs.append(f)
+        for x, f in zip(xs + xs, futs):
+            np.testing.assert_allclose(f.result(timeout=60), ref(x),
+                                       atol=1e-4)
+        assert order == sorted(order)            # FIFO across the resize
+        out = srv.resize_replicas(1)
+        assert out["replicas"] == 1 and len(out["removed"]) == 2
+        # replicas 2,3 are deactivated slots, not shifted indices
+        stats = srv.get_stats()
+        assert stats["capacity"]["replicas"] == 1
+        assert stats["capacity"]["replica_slots"] == 3
+        # post-shrink traffic still numerically exact
+        x = np.full((2, 6), 0.25, np.float32)
+        np.testing.assert_allclose(srv.submit(x).result(timeout=60),
+                                   ref(x), atol=1e-4)
+        with pytest.raises(ValueError):
+            srv.resize_replicas(0)
+    finally:
+        srv.stop()
+
+
+def test_closed_loop_fault_to_scaleup_to_recovery(telemetry):
+    """The acceptance scenario: kill a replica under traffic (PR 8 fault
+    injection opens its breaker), the availability gauge drops, the SLO
+    burn fires, the policy flips to scale-up and the autoscaler resizes
+    the LIVE server; after recovery the quiet queue scales back down —
+    all on a fake clock."""
+    import jax
+
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    net, args, ref = _serving_setup()
+    # replica 1's first executions die -> quarantined; cooldown is long
+    # enough that it STAYS quarantined for the scale-up phase
+    faults.configure("serving.replica_execute[1]:raise@calls=1-2", seed=0)
+    devices = (jax.devices() * 2)[:2]
+    srv = InferenceServer(
+        net, args, data_shapes=[("data", (1, 6))], devices=devices,
+        config=ServingConfig(buckets=(1, 2, 4), max_wait_ms=1,
+                             cooldown_ms=120_000))
+    clk = [0.0]
+    sampler = TS.TimeSeriesSampler(interval_ms=1000, retain=2000,
+                                   clock=lambda: clk[0])
+    ttft = M.histogram("slo.ttft_ms", buckets=(50, 200))
+    obj = SLO.LatencyObjective("ttft", "slo.ttft_ms", threshold=50.0,
+                               q=0.95)
+    mon = SLO.SLOMonitor(sampler.store, [SLO.BurnRateAlert(
+        obj, short_s=60.0, long_s=600.0,
+        on_threshold=2.0, off_threshold=1.0)])
+    pol = AutoscalePolicy(queue_high=64, queue_low=4, window_s=30,
+                          min_replicas=1, max_replicas=4,
+                          slo_monitor=mon)
+    scaler = Autoscaler.for_server(pol, sampler.store, srv,
+                                   cooldown_ms=10_000,
+                                   clock=lambda: clk[0])
+    try:
+        sampler.sample_once()                      # t=0 baseline
+        # traffic rides through the fault: retried on replica 0
+        xs = [np.random.RandomState(i).rand(1 + i % 3, 6)
+              .astype(np.float32) for i in range(8)]
+        futs = [srv.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_allclose(f.result(timeout=60), ref(x),
+                                       atol=1e-4)
+        assert srv.get_stats()["quarantines"] >= 1
+        assert len(srv.get_stats()["quarantined_replicas"]) == 1
+
+        # ...and the users felt it: TTFT blows the 50ms objective
+        for _ in range(20):
+            ttft.observe(500.0)
+        clk[0] = 60.0
+        sampler.sample_once()
+        g = sampler.gauge_window("serving.replicas_available", 30,
+                                 now=60.0)
+        assert g["last"] == 1.0                    # breaker open on 1/2
+
+        d = scaler.step(now=60.0)
+        assert d.applied and d.action == "up" and d.replicas == 3
+        assert "replicas lost (1/2 available)" in d.reason
+        assert srv.get_stats()["capacity"]["replicas"] == 3
+        # the new replica serves correctly immediately
+        x = np.full((2, 6), 0.5, np.float32)
+        np.testing.assert_allclose(srv.submit(x).result(timeout=60),
+                                   ref(x), atol=1e-4)
+
+        # -------- recovery: fast again, alert clears, queue is quiet
+        faults.configure(None)
+        for _ in range(200):
+            ttft.observe(5.0)
+        clk[0] = 700.0
+        sampler.sample_once()
+        d = scaler.step(now=700.0)
+        assert d.action == "down" and d.applied and d.replicas == 2
+        assert srv.get_stats()["capacity"]["replicas"] == 2
+        # immediately after: not settled -> no down-spiral
+        clk[0] = 701.0
+        sampler.sample_once()
+        assert scaler.step(now=701.0).action == "hold"
+    finally:
+        scaler.stop()
+        sampler.stop()
+        srv.stop()
+
+
+def test_autoscaler_thread_lifecycle(telemetry):
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2)
+    s = TS.SeriesStore(10)
+    scaler = Autoscaler(pol, s, lambda n: None, cooldown_ms=1,
+                        interval_s=0.005)
+    scaler.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not scaler.history and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert scaler.history                 # ticked at least once
+        assert scaler.running
+    finally:
+        scaler.stop()
+    assert not scaler.running
+    assert threading.active_count() < 50      # no thread leak

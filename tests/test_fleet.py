@@ -1,0 +1,252 @@
+"""FleetAggregator (ISSUE 17): scrape → parse → merge, with the
+edge cases that break naive fleet merges — worker restarts (counter
+resets), heterogeneous label sets, scrapes racing registry mutation,
+and dead workers' series going stale rather than flat."""
+import threading
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.observability import fleet
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.observability import promparse
+
+
+@pytest.fixture
+def telemetry():
+    mx.observability.set_enabled(True)
+    mx.observability.reset_metrics()
+    yield
+    mx.observability.reset_metrics()
+    mx.observability.set_enabled(False)
+
+
+class FakeFleet:
+    """url -> exposition text, mutable between scrapes; raising entries
+    simulate a down worker."""
+
+    def __init__(self, texts):
+        self.texts = dict(texts)
+
+    def __call__(self, url):
+        body = self.texts[url]
+        if isinstance(body, Exception):
+            raise body
+        return body
+
+
+def _render(build):
+    """Render a registry state to exposition text, then reset."""
+    M.reset_metrics()
+    build()
+    text = M.dump_metrics()
+    M.reset_metrics()
+    return text
+
+
+def _agg(fetch, workers=("a", "b"), **kw):
+    clock = {"t": 0.0}
+    kw.setdefault("interval_ms", 1000)
+    kw.setdefault("stale_after", 2)
+    kw.setdefault("dead_after", 4)
+    kw.setdefault("retain", 64)
+    agg = fleet.FleetAggregator({w: "http://%s/metrics" % w
+                                 for w in workers},
+                                clock=lambda: clock["t"], fetch=fetch,
+                                **kw)
+    return agg, clock
+
+
+def test_merge_is_bit_exact_per_worker_sum(telemetry):
+    def worker_a():
+        h = M.histogram("w.lat", buckets=(1, 2, 4))
+        for v in (0.5, 1.5, 3.0, 9.0):
+            h.observe(v)
+        M.counter("w.req").inc(10)
+
+    def worker_b():
+        h = M.histogram("w.lat", buckets=(1, 2, 4))
+        for v in (0.2, 0.9, 5.0):
+            h.observe(v)
+        M.counter("w.req").inc(20)
+
+    fetch = FakeFleet({"http://a/metrics": _render(worker_a),
+                       "http://b/metrics": _render(worker_b)})
+    agg, clock = _agg(fetch)
+    agg.scrape_once()
+    clock["t"] = 10.0
+    agg.scrape_once()
+
+    win = agg.hist_window("mxnet_w_lat", 60, now=10.0)
+    # per-worker bucket counts sum EXACTLY: [2+1 fast, 1, 1+0, 1+1 inf]
+    assert win["counts"] == [3, 1, 1, 2]
+    assert win["count"] == 7
+    assert win["sum"] == pytest.approx(0.5 + 1.5 + 3.0 + 9.0
+                                       + 0.2 + 0.9 + 5.0)
+    # pinned to one worker: that worker's counts alone
+    # (a's four observations land one per bucket: 0.5|1.5|3.0|9.0->+Inf)
+    wa = agg.hist_window("mxnet_w_lat", 60,
+                         labels=(("worker", "a"),), now=10.0)
+    assert wa["count"] == 4 and wa["counts"] == [1, 1, 1, 1]
+    assert wa["sum"] == pytest.approx(14.0)
+
+
+def test_worker_restart_counter_reset_rate_never_negative(telemetry):
+    def before():
+        M.counter("w.req").inc(1000)
+
+    def after_restart():
+        M.counter("w.req").inc(3)
+
+    texts = {"http://a/metrics": _render(before),
+             "http://b/metrics": _render(before)}
+    fetch = FakeFleet(texts)
+    agg, clock = _agg(fetch)
+    agg.scrape_once()
+    # worker b restarts: counter falls 1000 -> 3
+    fetch.texts["http://b/metrics"] = _render(after_restart)
+    clock["t"] = 10.0
+    agg.scrape_once()
+    rate = agg.rate("mxnet_w_req", 60, now=10.0)
+    assert rate >= 0.0
+    # reset semantics: b contributes its post-restart value (3) / 10s
+    assert rate == pytest.approx(0.3)
+
+
+def test_two_workers_different_label_sets(telemetry):
+    def worker_a_boot():
+        M.counter("w.cls", labels={"slo": "premium"}).inc(0)
+        M.counter("w.cls", labels={"slo": "batch"}).inc(0)
+
+    def worker_a():
+        M.counter("w.cls", labels={"slo": "premium"}).inc(5)
+        M.counter("w.cls", labels={"slo": "batch"}).inc(7)
+
+    def worker_b_boot():
+        M.counter("w.cls", labels={"slo": "premium"}).inc(0)
+
+    def worker_b():
+        M.counter("w.cls", labels={"slo": "premium"}).inc(11)
+        # b never saw batch traffic — no such child
+
+    fetch = FakeFleet({"http://a/metrics": _render(worker_a_boot),
+                       "http://b/metrics": _render(worker_b_boot)})
+    agg, clock = _agg(fetch)
+    agg.scrape_once()
+    # traffic arrives between scrapes
+    fetch.texts["http://a/metrics"] = _render(worker_a)
+    fetch.texts["http://b/metrics"] = _render(worker_b)
+    clock["t"] = 10.0
+    agg.scrape_once()
+    # per-class fleet totals keep their labels distinct per worker
+    prem_a = agg.store.increase(
+        "mxnet_w_cls", 60,
+        labels=(("slo", "premium"), ("worker", "a")), now=10.0)
+    prem_b = agg.store.increase(
+        "mxnet_w_cls", 60,
+        labels=(("slo", "premium"), ("worker", "b")), now=10.0)
+    assert (prem_a, prem_b) == (5.0, 11.0)
+    # family-wide merge sums across BOTH label shapes
+    assert agg.store.increase("mxnet_w_cls", 60, now=10.0) == 23.0
+
+
+def test_scrape_racing_registry_mutation(telemetry):
+    """A scrape rendered WHILE another thread mutates the registry must
+    parse cleanly and merge consistently (dump_metrics renders under the
+    registry lock; the parser rejects torn lines loudly)."""
+    stop = threading.Event()
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            M.counter("race.req", labels={"k": str(i % 5)}).inc()
+            M.histogram("race.lat", buckets=(1, 10)).observe(i % 12)
+            i += 1
+
+    threads = [threading.Thread(target=mutate) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        fetch = lambda url: M.dump_metrics()  # noqa: E731
+        agg = fleet.FleetAggregator({"a": "u"}, interval_ms=1000,
+                                    stale_after=2, dead_after=4,
+                                    clock=lambda: 0.0, fetch=fetch,
+                                    retain=64)
+        for i in range(50):
+            assert agg.scrape_once(now=float(i)) == {"a": "ok"}
+        # cumulative bucket counts must be internally consistent:
+        # count == +Inf bucket of every appended sample
+        win = agg.hist_window("mxnet_race_lat", 1000, now=49.0)
+        assert win["count"] == sum(win["counts"])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+
+
+def test_dead_worker_series_stale_not_flat(telemetry):
+    def worker():
+        M.gauge("w.depth").set(42.0)
+        M.counter("w.req").inc(100)
+
+    text = _render(worker)
+    fetch = FakeFleet({"http://a/metrics": text, "http://b/metrics": text})
+    agg, clock = _agg(fetch, stale_after=2, dead_after=4)
+    for i in range(3):
+        clock["t"] = i * 10.0
+        assert agg.scrape_once()["b"] == "ok"
+
+    # b dies (SIGKILL: connection refused)
+    fetch.texts["http://b/metrics"] = ConnectionRefusedError("down")
+    statuses = []
+    for i in range(3, 9):
+        clock["t"] = i * 10.0
+        statuses.append(agg.scrape_once()["b"])
+    # ok(fail1) -> stale(fail2..3) -> dead(fail4+)
+    assert statuses[0] == "ok"          # first miss: not yet stale
+    assert "stale" in statuses
+    assert statuses[-1] == "dead"
+    assert agg.alive_workers() == ["a"]
+
+    # the dead worker's gauge goes STALE in recent windows — not a flat
+    # 42 forever
+    g = agg.gauge_window("w.depth_does_not_exist", 20, now=clock["t"])
+    assert g["n"] == 0
+    gb = agg.gauge_window("mxnet_w_depth", 20,
+                          labels=(("worker", "b"),), now=clock["t"])
+    assert gb["n"] == 0 and gb["last"] is None
+    # while availability (worker_up) reads 0 — present AND down beats
+    # absent for alerting
+    up = agg.gauge_window("fleet.worker_up", 20,
+                          labels=(("worker", "b"),), now=clock["t"])
+    assert up["n"] > 0 and up["max"] == 0.0
+    # worker table carries the failure streak + last error
+    row = agg.worker_status()["b"]
+    assert row["status"] == "dead"
+    assert row["consecutive_failures"] >= 4
+    assert "ConnectionRefusedError" in row["last_error"]
+
+
+def test_fleet_status_brief(telemetry):
+    def worker_boot():
+        M.counter("w.req").inc(0)
+
+    def worker():
+        M.counter("w.req").inc(5)
+
+    fetch = FakeFleet({"http://a/metrics": _render(worker_boot)})
+    agg, clock = _agg(fetch, workers=("a",))
+    agg.scrape_once()
+    fetch.texts["http://a/metrics"] = _render(worker)
+    clock["t"] = 10.0
+    agg.scrape_once()
+    brief = agg.fleet_status(window_s=60.0)
+    assert brief["workers"]["a"]["status"] == "ok"
+    assert brief["scrapes"] == 2
+    key = 'mxnet_w_req{worker="a"}'
+    assert brief["series"][key]["increase"] == 5.0
+
+
+def test_parser_rejects_garbage():
+    with pytest.raises(ValueError):
+        promparse.parse_text("mxnet_x{k=\"v\"} not_a_number")

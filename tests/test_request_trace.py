@@ -17,6 +17,7 @@ from mxnet_tpu import profiler
 from mxnet_tpu.config import set_flag
 from mxnet_tpu.observability import exposition
 from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.observability import promparse
 from mxnet_tpu.observability import request_trace as RT
 from mxnet_tpu.observability import stats_schema
 
@@ -286,55 +287,12 @@ def test_generation_trace_end_to_end(telemetry, fresh_reservoir):
 
 
 # --------------------------------------------- exposition compliance
+# the parser under test IS the package's (observability/promparse.py —
+# promoted from this file): the round-trip below now certifies the same
+# code the FleetAggregator and obs_smoke scrape with
 def _parse_prom(text):
-    """Minimal text-format parser: families {name: kind}, samples
-    {name: {label_body: float}}, help {name: text} — with label-value
-    unescaping, so the round-trip test can verify escaping."""
-    types, helps, samples = {}, {}, {}
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        if line.startswith("# TYPE "):
-            _, _, name, kind = line.split(None, 3)
-            types[name] = kind.strip()
-            continue
-        if line.startswith("# HELP "):
-            _, _, name, txt = line.split(None, 3)
-            helps[name] = (txt.replace("\\n", "\n")
-                           .replace("\\\\", "\\"))
-            continue
-        if line.startswith("#"):
-            continue
-        if "{" in line:
-            name, rest = line.split("{", 1)
-            body, value = rest.rsplit("}", 1)
-            labels = {}
-            i = 0
-            while i < len(body):
-                eq = body.index("=", i)
-                key = body[i:eq]
-                assert body[eq + 1] == '"'
-                j = eq + 2
-                val = []
-                while body[j] != '"':
-                    if body[j] == "\\":
-                        nxt = body[j + 1]
-                        val.append({"\\": "\\", '"': '"',
-                                    "n": "\n"}[nxt])
-                        j += 2
-                    else:
-                        val.append(body[j])
-                        j += 1
-                labels[key] = "".join(val)
-                i = j + 1
-                if i < len(body) and body[i] == ",":
-                    i += 1
-            key = tuple(sorted(labels.items()))
-        else:
-            name, value = line.rsplit(None, 1)
-            key = ()
-        samples.setdefault(name.strip(), {})[key] = float(value)
-    return types, helps, samples
+    parsed = promparse.parse_text(text)
+    return parsed.types, parsed.helps, parsed.samples
 
 
 def test_prometheus_exposition_round_trip(telemetry):

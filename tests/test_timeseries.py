@@ -1,0 +1,287 @@
+"""Time-series plane (ISSUE 17): window algebra against hand-computed
+values, the sampler under a fake clock, /varz over HTTP, and the
+gauge-staleness regression (a stopped engine's gauges leave /metrics)."""
+import json
+import math
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.observability import exposition
+from mxnet_tpu.observability import metrics as M
+from mxnet_tpu.observability import timeseries as TS
+
+
+@pytest.fixture
+def telemetry():
+    mx.observability.set_enabled(True)
+    mx.observability.reset_metrics()
+    yield
+    mx.observability.reset_metrics()
+    mx.observability.set_enabled(False)
+
+
+# ------------------------------------------------ shared quantile math
+def test_bucket_quantile_hand_computed():
+    uppers = (1.0, 2.0, 4.0)
+    # 10 obs in (0,1], 10 in (1,2], 0 in (2,4], 0 overflow
+    counts = [10, 10, 0, 0]
+    # p50: rank 10 lands exactly at the first bucket's upper bound
+    assert M.bucket_quantile(uppers, counts, 0.50) == 1.0
+    # p75: rank 15 -> 5/10 into (1,2] -> 1.5
+    assert M.bucket_quantile(uppers, counts, 0.75) == 1.5
+    # p100 == top finite bound of the last populated bucket
+    assert M.bucket_quantile(uppers, counts, 1.0) == 2.0
+    # overflow rank clamps to the highest finite bound
+    assert M.bucket_quantile(uppers, [0, 0, 0, 5], 0.99) == 4.0
+    # empty histogram
+    assert M.bucket_quantile(uppers, [0, 0, 0, 0], 0.5) == 0.0
+    with pytest.raises(ValueError):
+        M.bucket_quantile(uppers, [1, 2], 0.5)
+
+
+def test_histogram_quantile_matches_bucket_math(telemetry):
+    h = M.histogram("q.lat", buckets=(1, 2, 4))
+    for v in [0.5] * 10 + [1.5] * 10:
+        h.observe(v)
+    assert h.quantile(0.50) == 1.0
+    assert h.quantile(0.75) == 1.5
+
+
+def test_percentile_nearest_rank():
+    vals = [1.0, 2.0, 3.0, 4.0]
+    assert M.percentile(vals, 50) == 2.0
+    assert M.percentile(vals, 99) == 4.0
+    assert M.percentile(vals, 0) == 1.0
+    assert M.percentile([], 50) == 0.0
+
+
+# ------------------------------------------------------- window algebra
+def test_counter_rate_hand_computed():
+    s = TS.SeriesStore(100)
+    # samples: t=0 -> 0, t=30 -> 30, t=60 -> 90
+    s.append("c", (), "counter", None, 0.0, 0.0)
+    s.append("c", (), "counter", None, 30.0, 30.0)
+    s.append("c", (), "counter", None, 90.0, 60.0)
+    # 60s window at now=60: baseline t=0 (value 0), increase 90/60s
+    assert s.rate("c", 60, now=60.0) == pytest.approx(1.5)
+    # 30s window at now=60: baseline t=30 (value 30), increase 60/30s
+    assert s.rate("c", 30, now=60.0) == pytest.approx(2.0)
+    assert s.increase("c", 30, now=60.0) == pytest.approx(60.0)
+    # window with a single sample and no baseline: no rate
+    assert s.rate("c", 5, now=2.0) == 0.0
+
+
+def test_counter_reset_never_negative():
+    s = TS.SeriesStore(100)
+    s.append("c", (), "counter", None, 1000.0, 0.0)
+    s.append("c", (), "counter", None, 7.0, 30.0)  # worker restarted
+    # Prometheus reset rule: post-reset value IS the increase
+    assert s.increase("c", 60, now=30.0) == pytest.approx(7.0)
+    assert s.rate("c", 60, now=30.0) >= 0.0
+
+
+def test_gauge_window_stats_and_staleness():
+    s = TS.SeriesStore(100)
+    for t, v in [(0, 5.0), (10, 1.0), (20, 9.0)]:
+        s.append("g", (), "gauge", None, v, float(t))
+    g = s.gauge_window("g", 15, now=20.0)
+    assert g == {"avg": 5.0, "min": 1.0, "max": 9.0, "last": 9.0, "n": 2}
+    # a series with no samples inside the window is STALE (n=0), not
+    # frozen at its last value
+    stale = s.gauge_window("g", 5, now=100.0)
+    assert stale["n"] == 0 and stale["last"] is None
+
+
+def test_hist_window_is_bucket_delta_not_since_boot():
+    s = TS.SeriesStore(100)
+    up = (1.0, 2.0, 4.0)
+    # boot -> t=0: 100 fast observations (all in first bucket)
+    s.append("h", (), "histogram", up, ((100, 100, 100, 100), 50.0, 100),
+             0.0)
+    # t=0 -> t=60: 10 more, all in (2,4] (slow regime)
+    s.append("h", (), "histogram", up, ((100, 100, 110, 110), 80.0, 110),
+             60.0)
+    win = s.hist_window("h", 60, now=60.0)
+    assert win["counts"] == [0, 0, 10, 0] and win["count"] == 10
+    assert win["sum"] == pytest.approx(30.0)
+    # windowed p50 sees ONLY the slow regime; since-boot would say <= 1
+    assert s.quantile("h", 0.5, 60, now=60.0) == 3.0
+    # since-boot view via a window reaching before the first sample
+    assert s.quantile("h", 0.5, 1000, now=60.0) < 1.0
+
+
+def test_hist_window_reset_falls_back_to_post_restart():
+    s = TS.SeriesStore(100)
+    up = (1.0, 2.0)
+    s.append("h", (), "histogram", up, ((50, 50, 50), 25.0, 50), 0.0)
+    s.append("h", (), "histogram", up, ((3, 3, 3), 1.5, 3), 30.0)  # reset
+    win = s.hist_window("h", 60, now=30.0)
+    assert win["count"] == 3 and win["counts"] == [3, 0, 0]
+
+
+# ------------------------------------------------------------- sampler
+def test_sampler_fake_clock_varz_hand_computed(telemetry):
+    clock_t = [0.0]
+    sampler = TS.TimeSeriesSampler(interval_ms=1000, retain=50,
+                                   clock=lambda: clock_t[0])
+    c = M.counter("ts.req")
+    h = M.histogram("ts.lat", buckets=(10, 100))
+    g = M.gauge("ts.depth")
+
+    c.inc(0)
+    h.observe(5.0)
+    g.set(2.0)
+    sampler.sample_once()
+
+    clock_t[0] = 60.0
+    c.inc(120)
+    for _ in range(9):
+        h.observe(5.0)
+    h.observe(50.0)
+    g.set(4.0)
+    sampler.sample_once()
+
+    v = sampler.varz(window_s=60.0, now=60.0)
+    series = v["series"]
+    # counter: 120 increase over exactly 60s
+    assert series["ts.req"]["rate_per_s"] == pytest.approx(2.0)
+    assert series["ts.req"]["increase"] == pytest.approx(120.0)
+    # gauge: only the t=60 sample is inside (60-window is (0, 60])...
+    assert series["ts.depth"]["last"] == 4.0
+    # histogram: window delta = 10 obs, 9 fast 1 slow ->
+    # p50 rank 5 of 10 -> 5/9 into (0,10]
+    assert series["ts.lat"]["count"] == 10
+    assert series["ts.lat"]["p50"] == pytest.approx(10.0 * 5 / 9)
+    # p99 rank 9.9 lands 0.9 into the (10,100] bucket (obs #10):
+    # 10 + 0.9 * 90 = 91
+    assert series["ts.lat"]["p99"] == pytest.approx(91.0)
+    # and the numbers match the store queried directly (same code path
+    # /varz serves)
+    assert sampler.quantile("ts.lat", 0.5, 60, now=60.0) == \
+        series["ts.lat"]["p50"]
+
+
+def test_pre_sample_hooks_run_and_isolate_failures(telemetry):
+    calls = []
+    TS.register_pre_sample("t.good", lambda: calls.append(1))
+    TS.register_pre_sample("t.bad", lambda: 1 / 0)
+    try:
+        sampler = TS.TimeSeriesSampler(interval_ms=1000, retain=10,
+                                       clock=lambda: 0.0)
+        sampler.sample_once()  # the bad hook must not break the pass
+        assert calls == [1]
+    finally:
+        TS.unregister_pre_sample("t.good")
+        TS.unregister_pre_sample("t.bad")
+
+
+def test_sampler_thread_lifecycle(telemetry):
+    sampler = TS.TimeSeriesSampler(interval_ms=5, retain=10)
+    M.counter("ts.alive").inc()
+    sampler.start()
+    try:
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while sampler.samples == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sampler.samples > 0
+    finally:
+        sampler.stop()
+    assert not sampler.running
+
+
+# ----------------------------------------------------- /varz endpoint
+def _get(port, path):
+    resp = urllib.request.urlopen(
+        "http://127.0.0.1:%d%s" % (port, path), timeout=10)
+    return resp.status, resp.read()
+
+
+def test_varz_endpoint(telemetry):
+    port = exposition.start_http(0)
+    try:
+        sampler = TS.get_sampler()
+        assert sampler is not None, "start_http must start the sampler"
+        M.counter("varz.hits").inc(5)
+        sampler.sample_once()
+        status, body = _get(port, "/varz?window=60")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["window_s"] == 60.0
+        assert "varz.hits" in payload["series"]
+        # unknown paths now advertise /varz
+        try:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/nope" % port, timeout=10)
+        except urllib.error.HTTPError as err:
+            assert "/varz" in err.read().decode()
+    finally:
+        exposition.stop_http()
+    assert TS.get_sampler() is None, "stop_http must stop the sampler"
+
+
+def test_varz_without_sampler_explains():
+    TS.stop_sampler()
+    payload = TS.varz(60)
+    assert "error" in payload
+
+
+# ------------------------------------- gauge staleness (regression)
+def _mlp_server(**cfg):
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.softmax(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"))
+    rng = np.random.RandomState(0)
+    params = {"fc_weight": rng.randn(4, 6).astype(np.float32),
+              "fc_bias": np.zeros(4, np.float32)}
+    cfg.setdefault("buckets", (4,))
+    cfg.setdefault("max_wait_ms", 1)
+    return InferenceServer(net, params, data_shapes=[("data", (1, 6))],
+                           config=ServingConfig(**cfg))
+
+
+def test_stopped_server_gauges_leave_metrics(telemetry):
+    server = _mlp_server()
+    server.predict(np.ones((2, 6), np.float32), timeout=60)
+    dump = M.dump_metrics()
+    assert "mxnet_serving_queue_depth" in dump
+    assert "mxnet_serving_replicas_configured" in dump
+    server.stop()
+    dump = M.dump_metrics()
+    # the regression: these froze at their last value forever
+    assert "mxnet_serving_queue_depth" not in dump
+    assert "mxnet_serving_replicas_configured" not in dump
+    assert "mxnet_serving_replicas_available" not in dump
+    # counters SURVIVE a stop — only owner-scoped gauges are pruned
+    assert "mxnet_serving_requests" in dump
+
+
+def test_unregister_on_collect(telemetry):
+    class Owner:
+        pass
+
+    owner = Owner()
+    M.gauge("collect.me").set(1.0)
+    M.unregister_on_collect(owner, ("collect.me",))
+    assert "mxnet_collect_me" in M.dump_metrics()
+    del owner
+    import gc
+
+    gc.collect()
+    assert "mxnet_collect_me" not in M.dump_metrics()
+
+
+def test_unregister_single_child(telemetry):
+    M.gauge("multi.g", labels={"k": "a"}).set(1)
+    M.gauge("multi.g", labels={"k": "b"}).set(2)
+    assert M.unregister("multi.g", labels={"k": "a"}) == 1
+    dump = M.dump_metrics()
+    assert 'k="a"' not in dump and 'k="b"' in dump
+    assert M.unregister("multi.g") == 1
+    assert "multi_g" not in M.dump_metrics()

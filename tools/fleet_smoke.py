@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Fast CPU smoke of the fleet telemetry plane (tier-1 CI guard,
+ISSUE 17).
+
+Two REAL worker processes (each: a small InferenceServer under traffic
++ the stdlib exposition plane on an ephemeral port), one
+FleetAggregator scraping them over actual HTTP. The smoke verifies the
+cross-worker story end to end:
+
+1. **Bit-exact merge** — the fleet-merged request-latency histogram's
+   per-bucket window counts equal the elementwise sum of the per-worker
+   windows (same instant, same window), the merged counter increase
+   equals the sum of per-worker increases, and a fleet p99 is
+   computable from the merged buckets.
+2. **Death detection** — SIGKILL one worker: its status walks
+   ok → stale → dead within the configured missed-scrape thresholds,
+   its gauge series go STALE (``n == 0``) in recent windows instead of
+   flat-lining, and its ``fleet.worker_up`` series reads 0.
+3. **Decision flip** — an AutoscalePolicy reading the scraped fleet
+   series holds while both workers are up and flips to ``up`` once the
+   kill shows up in the availability window (the alert layer's
+   hysteresis keeps the pre-kill decision a clean hold, not a flap).
+4. **Clean shutdown** — aggregator and worker teardown leave no
+   observability threads behind.
+
+Usage: ``python tools/fleet_smoke.py [summary.json]`` (parent mode);
+``--worker <portfile>`` is the internal child entry point.
+
+Prints a one-line JSON summary (optionally written to argv[1]); any
+violation raises, failing the CI step.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------- worker
+def worker_main(portfile):
+    """Child process: serve traffic forever, export /metrics."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import exposition
+    from mxnet_tpu.serving import InferenceServer, ServingConfig
+
+    mx.observability.set_enabled(True)
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 6).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc"),
+        name="softmax")
+    srv = InferenceServer(
+        net, {"fc_weight": mx.nd.array(w), "fc_bias": mx.nd.array(b)},
+        data_shapes=[("data", (1, 6))],
+        config=ServingConfig(buckets=(1, 2, 4), max_wait_ms=1))
+    port = exposition.start_http(0)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    # atomic portfile write: the parent polls for this file
+    tmp = portfile + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"port": port, "pid": os.getpid()}, f)
+    os.rename(tmp, portfile)
+
+    x = rng.rand(2, 6).astype(np.float32)
+    while not stop.is_set():
+        srv.submit(x).result(timeout=60)
+        stop.wait(0.01)
+    srv.stop()
+    exposition.stop_http()
+
+
+# --------------------------------------------------------------- parent
+def _require(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+class _WorkerUpMonitor:
+    """SLO-monitor-shaped adapter: fires while any worker's ``up``
+    series saw a 0 inside the trailing window — present-and-down, the
+    signal a dead worker leaves that its (stale) own gauges cannot."""
+
+    def __init__(self, agg, window_s=3.0):
+        self.agg = agg
+        self.window_s = window_s
+
+    def evaluate(self, now):
+        return []
+
+    def firing_names(self):
+        win = self.agg.gauge_window("fleet.worker_up", self.window_s)
+        if win["n"] and win["min"] == 0.0:
+            return ["fleet.worker_up"]
+        return []
+
+
+def _spawn_worker(tmpdir, idx):
+    portfile = os.path.join(tmpdir, "worker%d.port" % idx)
+    env = dict(os.environ, MXNET_TELEMETRY="1")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", portfile],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    return proc, portfile
+
+
+def _wait_portfile(proc, portfile, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError("worker exited rc=%d before binding"
+                                 % proc.returncode)
+        if os.path.exists(portfile):
+            with open(portfile) as f:
+                return json.load(f)
+        time.sleep(0.05)
+    raise AssertionError("worker portfile never appeared: %s" % portfile)
+
+
+HIST = "mxnet_request_total_ms"
+REQS = "mxnet_serving_requests"
+
+
+def main(out_path=None):
+    from mxnet_tpu.observability.fleet import FleetAggregator
+    from mxnet_tpu.serving.control import AutoscalePolicy
+
+    tmpdir = tempfile.mkdtemp(prefix="fleet_smoke_")
+    procs = []
+    summary = {}
+    agg = None
+    try:
+        workers = {}
+        for i in range(2):
+            proc, portfile = _spawn_worker(tmpdir, i)
+            procs.append(proc)
+            workers["w%d" % i] = (proc, portfile)
+        urls = {}
+        for name, (proc, portfile) in workers.items():
+            info = _wait_portfile(proc, portfile)
+            urls[name] = "http://127.0.0.1:%d/metrics" % info["port"]
+
+        agg = FleetAggregator(urls, interval_ms=200, stale_after=2,
+                              dead_after=4, retain=600)
+        # let traffic accumulate across a few scrapes
+        for _ in range(6):
+            statuses = agg.scrape_once()
+            time.sleep(0.25)
+        _require(statuses == {"w0": "ok", "w1": "ok"},
+                 "expected both workers ok, got %r" % (statuses,))
+
+        # ---- 1. bit-exact merge (one instant, one window) -------------
+        now = agg.now()
+        win = 30.0
+        merged = agg.hist_window(HIST, win, now=now)
+        _require(merged["count"] > 0, "no fleet latency samples merged")
+        per = [agg.hist_window(HIST, win,
+                               labels=(("engine", "serving"),
+                                       ("worker", name)), now=now)
+               for name in ("w0", "w1")]
+        _require(all(p["count"] > 0 for p in per),
+                 "a worker contributed no latency samples: %r" % (per,))
+        summed = [a + b for a, b in zip(per[0]["counts"], per[1]["counts"])]
+        _require(merged["counts"] == summed,
+                 "fleet merge not bit-exact: %r != %r"
+                 % (merged["counts"], summed))
+        _require(merged["count"] == per[0]["count"] + per[1]["count"]
+                 and merged["sum"] == per[0]["sum"] + per[1]["sum"],
+                 "fleet sum/count drifted from per-worker sums")
+        p99 = agg.quantile(HIST, 0.99, win, now=now)
+        _require(p99 is not None and p99 > 0.0,
+                 "fleet p99 not computable: %r" % (p99,))
+        req_merged = agg.store.increase(REQS, win, now=now)
+        req_per = sum(agg.store.increase(
+            REQS, win, labels=(("worker", n),), now=now)
+            for n in ("w0", "w1"))
+        _require(req_merged == req_per,
+                 "fleet counter increase %r != per-worker sum %r"
+                 % (req_merged, req_per))
+
+        # ---- 3a. decision while healthy: clean hold -------------------
+        mon = _WorkerUpMonitor(agg, window_s=2.0)
+        pol = AutoscalePolicy(
+            queue_high=64, queue_low=0, window_s=2.0,
+            min_replicas=1, max_replicas=4, slo_monitor=mon,
+            queue_metric="mxnet_serving_queue_depth",
+            configured_metric="mxnet_serving_replicas_configured",
+            available_metric="mxnet_serving_replicas_available")
+        before = pol.decide(agg, agg.now())
+        _require(before.action == "hold",
+                 "healthy fleet must hold, got %r" % (before,))
+
+        # ---- 2. SIGKILL w1: ok -> stale -> dead -----------------------
+        w1_proc = workers["w1"][0]
+        w1_proc.kill()
+        w1_proc.wait(30)
+        seen = []
+        for i in range(8):          # dead_after=4 misses, with margin
+            time.sleep(0.1)
+            seen.append(agg.scrape_once()["w1"])
+            if seen[-1] == "dead":
+                break
+        _require(seen[-1] == "dead",
+                 "worker never marked dead; statuses %r" % (seen,))
+        _require("stale" in seen,
+                 "status must pass through stale, got %r" % (seen,))
+        _require(agg.alive_workers() == ["w0"],
+                 "alive set wrong: %r" % (agg.alive_workers(),))
+        dead_scrapes = len(seen)
+
+        # its own gauges are STALE in a recent window, not flat
+        now = agg.now()
+        stale = agg.gauge_window("mxnet_serving_queue_depth", 0.5,
+                                 labels=(("worker", "w1"),), now=now)
+        _require(stale["n"] == 0 and stale["last"] is None,
+                 "dead worker's gauge flat-lined: %r" % (stale,))
+        up = agg.gauge_window("fleet.worker_up", 2.0,
+                              labels=(("worker", "w1"),), now=now)
+        _require(up["n"] > 0 and up["last"] == 0.0 and up["min"] == 0.0,
+                 "worker_up must read 0 for the dead worker: %r" % (up,))
+
+        # ---- 3b. decision after the kill: flips to up -----------------
+        after = pol.decide(agg, agg.now())
+        _require(after.action == "up",
+                 "dead worker must flip the decision to up, got %r"
+                 % (after,))
+        _require("fleet.worker_up" in after.reason,
+                 "reason must name the firing alert: %r" % (after.reason,))
+
+        # ---- 4. teardown leaves no observability threads --------------
+        agg.start()                  # exercise the background loop too
+        time.sleep(0.3)
+        _require(agg.running, "aggregator thread failed to start")
+        agg.stop()
+        _require(not agg.running, "aggregator thread failed to stop")
+        for name, (proc, _) in workers.items():
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(30)
+        leftovers = [t.name for t in threading.enumerate()
+                     if t.name.startswith("mxnet-")]
+        _require(not leftovers, "leaked threads: %r" % (leftovers,))
+
+        summary = {
+            "workers": 2,
+            "scrapes": agg.scrapes,
+            "merged_latency_count": merged["count"],
+            "fleet_p99_ms": round(p99, 3),
+            "requests_merged": req_merged,
+            "dead_detected_after_scrapes": dead_scrapes,
+            "decision_before": before.action,
+            "decision_after": after.action,
+            "ok": True,
+        }
+    finally:
+        if agg is not None:
+            agg.stop()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(30)
+
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        worker_main(sys.argv[2])
+    else:
+        main(sys.argv[1] if len(sys.argv) > 1 else None)

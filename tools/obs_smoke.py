@@ -13,8 +13,8 @@ session records, then the smoke verifies the whole observability story:
    latency EXACTLY, and the trace total matches the caller's measured
    wall time within tolerance.
 2. **Exposition plane** — the stdlib HTTP server answers ``/metrics``
-   (valid Prometheus text, spec content type, verified by a minimal
-   text-format parser), ``/statusz`` (schema-conforming engine rows:
+   (valid Prometheus text, spec content type, verified by the package's
+   own scrape parser promparse), ``/statusz`` (schema-conforming engine rows:
    queue depth, KV pages/bytes, circuit-breaker state, graph-pass
    provenance sections), ``/healthz``, and ``/tracez``.
 3. **Bounded buffers** — the profiler ring reports zero drops at smoke
@@ -37,29 +37,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _parse_prom(text):
-    """Minimal Prometheus text-format parser: {name: {label_str: value}}
-    plus the # TYPE map. Raises on malformed sample lines."""
-    samples, types = {}, {}
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        if line.startswith("# TYPE "):
-            _, _, name, kind = line.split(None, 3)
-            types[name] = kind
-            continue
-        if line.startswith("#"):
-            continue
-        # name{labels} value | name value
-        if "{" in line:
-            name, rest = line.split("{", 1)
-            labels, value = rest.rsplit("}", 1)
-        else:
-            name, value = line.rsplit(None, 1)
-            labels = ""
-        value = value.strip()
-        float(value)  # malformed sample -> ValueError
-        samples.setdefault(name, {})[labels] = float(value)
-    return samples, types
+    """The package's own scrape parser (observability/promparse.py —
+    the same code the FleetAggregator merges with; raises on malformed
+    sample lines): {name: {label_tuple: value}} plus the # TYPE map."""
+    from mxnet_tpu.observability import promparse
+
+    parsed = promparse.parse_text(text)
+    return parsed.samples, parsed.types
 
 
 def _get(port, path):
@@ -160,13 +144,13 @@ def main(out_path=None):
     assert status == 200, status
     assert ctype == M.PROM_CONTENT_TYPE, ctype
     samples, types = _parse_prom(body.decode())
-    assert samples["mxnet_serving_requests"][""] >= 31, samples.get(
+    assert samples["mxnet_serving_requests"][()] >= 31, samples.get(
         "mxnet_serving_requests")
     assert types.get("mxnet_request_total_ms") == "histogram", types
     # cumulative bucket monotonicity on a labeled histogram family
     srv_buckets = [(lbl, v) for lbl, v in
                    samples["mxnet_request_total_ms_bucket"].items()
-                   if 'engine="serving"' in lbl]
+                   if dict(lbl).get("engine") == "serving"]
     assert srv_buckets, "no serving request histogram children"
 
     status, ctype, body = _get(port, "/statusz")

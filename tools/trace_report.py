@@ -275,12 +275,14 @@ def format_graph_pass(rows, path):
 
 # ------------------------------------------------------ request tracing
 def _percentile(sorted_vals, q):
-    """Nearest-rank percentile of an ASCENDING-sorted list (q in 0-100)."""
+    """Nearest-rank percentile of an ASCENDING-sorted list (q in 0-100)
+    — the registry's shared estimator (metrics.percentile), so this
+    report and the time-series plane agree on what a p99 is."""
     if not sorted_vals:
         return None
-    idx = max(0, min(len(sorted_vals) - 1,
-                     int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
+    from mxnet_tpu.observability.metrics import percentile
+
+    return percentile(sorted_vals, q)
 
 
 def request_timelines(events):
