@@ -2915,26 +2915,42 @@ def bench_perf_overhead(threshold_pct=None):
 
 
 def assert_lint_clean():
-    """--lint-clean: graftlint must exit 0 against the committed baseline.
+    """--lint-clean: graftlint must exit 0 against the committed baseline
+    AND finish inside a wall-time budget.
 
     Bench artifacts are the repo's perf claims; refusing to bench a tree
     with NEW static-analysis violations (hidden host syncs, retrace
-    hazards — exactly what corrupts bench numbers) keeps the baseline
-    from silently rotting. Pure assertion: exits 0 on a clean tree."""
+    hazards, lock cycles — exactly what corrupts bench numbers) keeps
+    the baseline from silently rotting. The wall gate
+    (``MXNET_LINT_BUDGET_S``, default 30s) keeps the lint itself
+    seconds-fast as the package grows — the whole-program lock/call
+    graph phase is the part that scales, and ``--jobs`` keeps the
+    per-file rule phase flat. Pure assertion: exits 0 on a clean tree."""
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
+    budget_s = float(os.environ.get("MXNET_LINT_BUDGET_S", "30"))
+    jobs = os.cpu_count() or 1
+    t0 = time.perf_counter()
     rc = subprocess.call(
-        [sys.executable, "-m", "tools.graftlint", "mxnet_tpu",
+        [sys.executable, "-m", "tools.graftlint", "mxnet_tpu", "tools",
+         "--disable", "G003:tools/", "--jobs", str(min(jobs, 8)),
          "--baseline", os.path.join("tools", "graftlint", "baseline.json")],
         cwd=here)
+    wall = time.perf_counter() - t0
     if rc != 0:
         raise SystemExit(
             "bench_all --lint-clean: graftlint found NEW violations "
             "(rc %d); fix them or baseline with a justification "
             "(docs/static_analysis.md)" % rc)
-    print("[bench_all] graftlint clean against committed baseline",
-          file=sys.stderr)
+    if wall > budget_s:
+        raise SystemExit(
+            "bench_all --lint-clean: graftlint took %.1fs (> %.0fs "
+            "budget, MXNET_LINT_BUDGET_S) — the analyzer must stay "
+            "seconds-fast; profile the new rule or raise --jobs"
+            % (wall, budget_s))
+    print("[bench_all] graftlint clean against committed baseline "
+          "(%.1fs, budget %.0fs)" % (wall, budget_s), file=sys.stderr)
 
 
 def main(out_path=None, skip=(), quiet=False, telemetry=False):

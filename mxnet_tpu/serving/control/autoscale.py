@@ -178,7 +178,7 @@ class Autoscaler:
         self.last_decision = None
         self.history = collections.deque(maxlen=64)
         self._stop_ev = threading.Event()
-        self._thread = None
+        self._thread = None  # guarded-by: self._life
         self._life = threading.Lock()
 
     @classmethod

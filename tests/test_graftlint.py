@@ -14,6 +14,7 @@ from tools.graftlint import build_report
 from tools.graftlint import core as glcore
 from tools.graftlint.callgraph import CallGraph
 from tools.graftlint.cli import main as gl_main
+from tools.graftlint.lockgraph import LockGraph, classify_blocking
 
 
 def run(tmp_path, source, name="mod.py", select=None):
@@ -393,6 +394,702 @@ def write(k, v):
     assert vs == []
 
 
+# --- G005 lock ordering ---------------------------------------------------
+
+def test_g005_abba_cycle(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def forward():
+    with _a:
+        with _b:
+            return 1
+
+
+def backward():
+    with _b:
+        with _a:
+            return 2
+""")
+    g5 = [v for v in vs if v.rule == "G005"]
+    assert len(g5) == 2  # one finding per conflicting edge
+    assert all("potential deadlock" in v.message for v in g5)
+    assert {v.scope for v in g5} == {"forward", "backward"}
+
+
+def test_g005_consistent_order_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def one():
+    with _a:
+        with _b:
+            return 1
+
+
+def two():
+    with _a:
+        with _b:
+            return 2
+""")
+    assert [v for v in vs if v.rule == "G005"] == []
+
+
+def test_g005_call_mediated_cycle(tmp_path):
+    # f holds _a and calls helper() which takes _b; g nests the opposite
+    # order lexically — the cycle only exists through the call graph
+    vs = run(tmp_path, """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def helper():
+    with _b:
+        return 1
+
+
+def f():
+    with _a:
+        return helper()
+
+
+def g():
+    with _b:
+        with _a:
+            return 2
+""")
+    g5 = [v for v in vs if v.rule == "G005"]
+    assert any("via" in v.message and "helper" in v.message for v in g5), \
+        [v.message for v in g5]
+
+
+def test_g005_nonreentrant_reacquire(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+_lock = threading.Lock()
+
+
+def inner():
+    with _lock:
+        return 1
+
+
+def outer():
+    with _lock:
+        return inner()
+""")
+    g5 = [v for v in vs if v.rule == "G005"]
+    assert len(g5) == 1 and "self-deadlock" in g5[0].message
+
+
+def test_g005_rlock_reentry_clean(tmp_path):
+    # the autotune cache idiom: an RLock re-entered through a call chain
+    vs = run(tmp_path, """
+import threading
+
+_lock = threading.RLock()
+
+
+def inner():
+    with _lock:
+        return 1
+
+
+def outer():
+    with _lock:
+        return inner()
+""")
+    assert [v for v in vs if v.rule == "G005"] == []
+
+
+def test_g005_wait_with_second_lock_held(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._life = threading.Lock()
+        self._cond = threading.Condition()
+
+    def collect(self):
+        with self._life:
+            with self._cond:
+                self._cond.wait()
+""")
+    g5 = [v for v in vs if v.rule == "G005"]
+    assert len(g5) == 1
+    assert "releases only its own lock" in g5[0].message
+    assert "_life" in g5[0].message
+
+
+def test_g005_wait_with_callers_lock_held(tmp_path):
+    # the serving-engine shape: stop() holds _life and calls the drain
+    # loop, which waits on _cond — the second lock comes from the CALLER
+    vs = run(tmp_path, """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._life = threading.Lock()
+        self._cond = threading.Condition()
+
+    def _drain(self):
+        with self._cond:
+            self._cond.wait()
+
+    def stop(self):
+        with self._life:
+            self._drain()
+""")
+    g5 = [v for v in vs if v.rule == "G005"]
+    assert len(g5) == 1 and "held by a caller" in g5[0].message
+
+
+def test_g005_lone_wait_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def take(self):
+        with self._cond:
+            self._cond.wait()
+""")
+    assert [v for v in vs if v.rule == "G005"] == []
+
+
+# --- G006 blocking under lock ---------------------------------------------
+
+def test_g006_sleep_under_lock(tmp_path):
+    vs = run(tmp_path, """
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def tick():
+    with _lock:
+        time.sleep(1)
+""")
+    g6 = [v for v in vs if v.rule == "G006"]
+    assert len(g6) == 1 and "time.sleep()" in g6[0].message
+
+
+def test_g006_timeoutless_get_join(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+_lock = threading.Lock()
+
+
+def drain(q, t):
+    with _lock:
+        item = q.get()
+        t.join()
+    return item
+""")
+    g6 = [v for v in vs if v.rule == "G006"]
+    assert len(g6) == 2
+    assert any(".get() without timeout" in v.message for v in g6)
+    assert any(".join() without timeout" in v.message for v in g6)
+
+
+def test_g006_bounded_calls_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+_lock = threading.Lock()
+
+
+def drain(q, t, ev):
+    with _lock:
+        item = q.get(timeout=1.0)
+        t.join(5)
+        ev.wait(0.1)
+    return item
+""")
+    assert [v for v in vs if v.rule == "G006"] == []
+
+
+def test_g006_transitive_blocking(tmp_path):
+    # the lock holder never blocks lexically — it calls through two
+    # helpers to a socket recv
+    vs = run(tmp_path, """
+import threading
+
+_lock = threading.Lock()
+
+
+def read_frame(sock):
+    return sock.recv(4096)
+
+
+def read_msg(sock):
+    return read_frame(sock)
+
+
+def pull(sock):
+    with _lock:
+        return read_msg(sock)
+""")
+    g6 = [v for v in vs if v.rule == "G006"]
+    assert len(g6) == 1
+    assert "read_msg" in g6[0].message and "socket .recv()" in g6[0].message
+    assert "reached via" in g6[0].message
+
+
+def test_g006_wait_on_held_condition_exempt(tmp_path):
+    # cond.wait releases the lock being held — the scheduler idiom is
+    # NOT blocking-under-lock (a second lock would be G005's finding)
+    vs = run(tmp_path, """
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def take(self):
+        with self._cond:
+            self._cond.wait()
+""")
+    assert [v for v in vs if v.rule == "G006"] == []
+
+
+def test_g006_sleep_outside_lock_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def tick():
+    with _lock:
+        n = 1
+    time.sleep(n)
+""")
+    assert [v for v in vs if v.rule == "G006"] == []
+
+
+def test_classify_blocking_table():
+    import ast as _ast
+
+    def call(src):
+        return _ast.parse(src, mode="eval").body
+
+    assert classify_blocking(call("time.sleep(1)")) == "time.sleep()"
+    assert classify_blocking(call("sock.accept()")) == "socket .accept()"
+    assert "timeout" in classify_blocking(call("urlopen(u)"))
+    assert classify_blocking(call("urlopen(u, timeout=5)")) is None
+    assert classify_blocking(call("fut.result()")) is not None
+    assert classify_blocking(call("fut.result(timeout=2)")) is None
+    assert classify_blocking(call("q.get(block=True)")) is not None
+    assert classify_blocking(call("q.get(block=False)")) is None
+    assert classify_blocking(call("os.path.join(a, b)")) is None
+
+
+# --- G007 thread/resource lifecycle ---------------------------------------
+
+def test_g007_undaemonized_unjoined_thread(tmp_path):
+    # the exposition-server idiom minus the daemon flag
+    vs = run(tmp_path, """
+import threading
+
+
+def start_http(handler):
+    t = threading.Thread(target=handler, name="metrics-http")
+    t.start()
+    return t
+""")
+    g7 = [v for v in vs if v.rule == "G007"]
+    assert len(g7) == 1 and "daemon=True" in g7[0].message
+
+
+def test_g007_daemon_thread_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+
+def start_http(handler):
+    t = threading.Thread(target=handler, daemon=True)
+    t.start()
+    return t
+""")
+    assert [v for v in vs if v.rule == "G007"] == []
+
+
+def test_g007_locally_joined_thread_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+
+def run_workers(fn):
+    ts = [threading.Thread(target=fn) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(600)
+""")
+    assert [v for v in vs if v.rule == "G007"] == []
+
+
+def test_g007_attr_thread_joined_in_stop_clean(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+
+class Sampler:
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join(5)
+""")
+    assert [v for v in vs if v.rule == "G007"] == []
+
+
+def test_g007_attr_thread_never_joined(tmp_path):
+    vs = run(tmp_path, """
+import threading
+
+
+class Sampler:
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+""")
+    g7 = [v for v in vs if v.rule == "G007"]
+    assert len(g7) == 1 and g7[0].scope == "Sampler.start"
+
+
+def test_g007_pool_without_shutdown(tmp_path):
+    vs = run(tmp_path, """
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Decoder:
+    def start(self):
+        self._pool = ThreadPoolExecutor(4)
+""")
+    g7 = [v for v in vs if v.rule == "G007"]
+    assert len(g7) == 1 and "shutdown" in g7[0].message
+
+
+def test_g007_pool_lifecycles_clean(tmp_path):
+    vs = run(tmp_path, """
+from concurrent.futures import ThreadPoolExecutor
+
+
+def mapper(fn, xs):
+    with ThreadPoolExecutor(4) as pool:
+        return list(pool.map(fn, xs))
+
+
+class Decoder:
+    def start(self):
+        self._pool = ThreadPoolExecutor(4)
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+""")
+    assert [v for v in vs if v.rule == "G007"] == []
+
+
+def test_g007_server_without_stop_path(tmp_path):
+    vs = run(tmp_path, """
+from http.server import ThreadingHTTPServer
+
+
+def serve(handler, port):
+    srv = ThreadingHTTPServer(("", port), handler)
+    srv.serve_forever()
+""")
+    g7 = [v for v in vs if v.rule == "G007"]
+    assert len(g7) == 1 and "stop path" in g7[0].message
+
+
+def test_g007_server_with_module_stop_clean(tmp_path):
+    vs = run(tmp_path, """
+from http.server import ThreadingHTTPServer
+
+_server = None
+
+
+def serve(handler, port):
+    global _server
+    _server = ThreadingHTTPServer(("", port), handler)
+    _server.serve_forever()
+
+
+def stop():
+    _server.shutdown()
+    _server.server_close()
+""")
+    assert [v for v in vs if v.rule == "G007"] == []
+
+
+# --- suppression layers for the concurrency rules -------------------------
+
+def test_g006_inline_suppression(tmp_path):
+    vs = run(tmp_path, """
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def tick():
+    with _lock:
+        time.sleep(1)  # graftlint: disable=G006 — bounded by test budget
+""")
+    assert [v for v in vs if v.rule == "G006"] == []
+
+
+def test_g005_file_level_suppression(tmp_path):
+    vs = run(tmp_path, """\
+# graftlint: disable-file=G005
+
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def forward():
+    with _a:
+        with _b:
+            return 1
+
+
+def backward():
+    with _b:
+        with _a:
+            return 2
+""")
+    assert [v for v in vs if v.rule == "G005"] == []
+
+
+# --- lock graph internals -------------------------------------------------
+
+def lockgraph_over(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    sf = glcore.SourceFile(str(p))
+    graph = CallGraph()
+    graph.add_file(sf)
+    graph.finalize()
+    return sf, graph, LockGraph().build([sf], graph)
+
+
+def test_lockgraph_canonicalization(tmp_path):
+    sf, graph, lg = lockgraph_over(tmp_path, """
+import threading
+
+_reg_lock = threading.Lock()
+
+
+class Store:
+    def __init__(self, n):
+        self._lock = threading.RLock()
+        self._locks = [threading.Lock() for _ in range(n)]
+
+    def get(self, shard):
+        with self._lock:
+            with self._locks[shard]:
+                return shard
+
+
+def local_scope():
+    lock = threading.Lock()
+    with lock:
+        return 1
+""")
+    # module lock: declared, canonical id is path::name
+    assert any(c.endswith("::_reg_lock") for c in lg.module_locks.values())
+    # class lock: one id per class attribute, kind recorded
+    cls_ids = [c for c in lg.class_locks.values()
+               if c.endswith("Store._lock")]
+    assert len(cls_ids) == 1 and lg.lock_kinds[cls_ids[0]] == "RLock"
+    # subscript acquisition canonicalizes to the [] family
+    fams = [c for _, c, _, _ in lg.acquire_sites if c.endswith("[]")]
+    assert fams and fams[0].endswith("Store._locks[]")
+    # function-local lock is scoped by qualname, not merged module-wide
+    locals_ = [c for _, c, _, _ in lg.acquire_sites if "local_scope" in c]
+    assert locals_ and locals_[0].endswith("local_scope::lock")
+
+
+def test_lockgraph_family_reentry_not_self_deadlock(tmp_path):
+    # two members of a lock family are distinct runtime objects: nesting
+    # them is neither a self-deadlock nor an order edge
+    _, _, lg = lockgraph_over(tmp_path, """
+import threading
+
+
+class Store:
+    def __init__(self, n):
+        self._locks = [threading.Lock() for _ in range(n)]
+
+    def move(self, a, b):
+        with self._locks[a]:
+            with self._locks[b]:
+                return a
+""")
+    assert lg.self_deadlocks == []
+    assert not any(a == b for (a, b) in lg.edges)
+
+
+def test_lockgraph_held_into_propagation(tmp_path):
+    _, graph, lg = lockgraph_over(tmp_path, """
+import threading
+
+_lock = threading.Lock()
+
+
+def leaf():
+    return 1
+
+
+def mid():
+    return leaf()
+
+
+def entry():
+    with _lock:
+        return mid()
+""")
+    by_name = {fi.name: fi for fi in graph.functions}
+    # _lock is held into mid (called under it) and transitively into leaf
+    assert any(c.endswith("::_lock") for c in lg.held_into[by_name["mid"]])
+    assert any(c.endswith("::_lock") for c in lg.held_into[by_name["leaf"]])
+    assert lg.held_into[by_name["entry"]] == set()
+
+
+def test_lockgraph_blocking_closure_chain(tmp_path):
+    _, graph, lg = lockgraph_over(tmp_path, """
+def leaf(sock):
+    return sock.recv(1024)
+
+
+def mid(sock):
+    return leaf(sock)
+
+
+def top(sock):
+    return mid(sock)
+""")
+    by_name = {fi.name: fi for fi in graph.functions}
+    assert lg.blocking[by_name["leaf"]][0] == "socket .recv()"
+    assert lg.blocking[by_name["top"]][0] == "socket .recv()"
+    chain = lg.blocking_chain(by_name["top"])
+    assert [q.split("::")[-1] for q in chain] == ["top", "mid", "leaf"]
+
+
+def test_lockgraph_edges_and_cycles(tmp_path):
+    _, _, lg = lockgraph_over(tmp_path, """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def fwd():
+    with _a:
+        with _b:
+            return 1
+
+
+def rev():
+    with _b:
+        with _a:
+            return 2
+""")
+    pairs = {(a.rsplit("::", 1)[1], b.rsplit("::", 1)[1])
+             for (a, b) in lg.edges}
+    assert {("_a", "_b"), ("_b", "_a")} <= pairs
+    assert len(lg.cycle_edges) == 2
+    assert all("_a" in cyc and "_b" in cyc
+               for *_x, cyc in lg.cycle_edges)
+
+
+# --- parallel rule phase ---------------------------------------------------
+
+def test_jobs_parallel_matches_serial(tmp_path):
+    sources = {
+        "sync.py": "def drain(bs):\n"
+                   "    out = []\n"
+                   "    for b in bs:\n"
+                   "        out.append(b.asnumpy())\n"
+                   "    return out\n",
+        "order.py": "import threading\n"
+                    "_a = threading.Lock()\n"
+                    "_b = threading.Lock()\n"
+                    "def f():\n"
+                    "    with _a:\n"
+                    "        with _b:\n"
+                    "            return 1\n"
+                    "def g():\n"
+                    "    with _b:\n"
+                    "        with _a:\n"
+                    "            return 2\n",
+        "sleepy.py": "import threading\nimport time\n"
+                     "_lock = threading.Lock()\n"
+                     "def tick():\n"
+                     "    with _lock:\n"
+                     "        time.sleep(1)\n",
+        "leaky.py": "import threading\n"
+                    "def go(fn):\n"
+                    "    threading.Thread(target=fn).start()\n",
+    }
+    for name, src in sources.items():
+        (tmp_path / name).write_text(src)
+    serial, errs1, _ = build_report([str(tmp_path)], jobs=1)
+    parallel, errs2, _ = build_report([str(tmp_path)], jobs=2)
+    assert not errs1 and not errs2
+    assert sorted(v.fingerprint for v in serial) \
+        == sorted(v.fingerprint for v in parallel)
+    assert {v.rule for v in serial} \
+        >= {"G001", "G005", "G006", "G007"}
+
+
+def test_disable_rule_under_path_prefix(tmp_path):
+    pkg = tmp_path / "pkg"
+    tools = tmp_path / "toolbox"
+    pkg.mkdir()
+    tools.mkdir()
+    src = ("import threading\nimport time\n"
+           "_lock = threading.Lock()\n"
+           "def tick():\n"
+           "    with _lock:\n"
+           "        time.sleep(1)\n")
+    (pkg / "a.py").write_text(src)
+    (tools / "b.py").write_text(src)
+    everywhere, _, _ = build_report([str(tmp_path)], root=str(tmp_path))
+    assert len([v for v in everywhere if v.rule == "G006"]) == 2
+    scoped, _, _ = build_report([str(tmp_path)], root=str(tmp_path),
+                                disable=["G006:toolbox/"])
+    g6 = [v for v in scoped if v.rule == "G006"]
+    assert len(g6) == 1 and g6[0].path.startswith("pkg/")
+
+
 # --- suppression + baseline ----------------------------------------------
 
 def test_inline_suppression(tmp_path):
@@ -506,7 +1203,7 @@ def test_stale_baseline_entries_reported(tmp_path):
 def test_committed_tree_is_lint_clean(monkeypatch):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     monkeypatch.chdir(repo)  # fingerprints are repo-relative
-    rc = gl_main(["mxnet_tpu",
+    rc = gl_main(["mxnet_tpu", "tools", "--disable", "G003:tools/",
                   "--baseline", "tools/graftlint/baseline.json", "-q"])
     assert rc == 0, "graftlint found NEW violations; fix them or baseline " \
                     "with --write-baseline and a justification"

@@ -13,6 +13,15 @@ Rules (see docs/static_analysis.md for the full catalog):
   global/attribute mutation inside traced code.
 * **G004 lock discipline** — state annotated ``# guarded-by: <lock>``
   mutated (or copy/iterated) outside a ``with <lock>:`` block.
+* **G005 lock order** — cycles in the whole-program lock-acquisition
+  graph (with-nesting propagated through the call graph) and
+  ``Condition.wait()`` reached while a second lock is held.
+* **G006 blocking under lock** — ``time.sleep``/socket/``urlopen``/
+  timeout-less ``result``/``get``/``join``/``wait`` (or any function
+  transitively reaching one) inside a ``with lock:`` body.
+* **G007 thread/resource lifecycle** — threads without ``daemon=True``
+  or a reachable ``join()``, pools without ``shutdown()``, servers
+  without a stop path.
 
 Silence a single line with ``# graftlint: disable=G00x``; accept
 pre-existing findings via ``tools/graftlint/baseline.json`` (every entry

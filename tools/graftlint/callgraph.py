@@ -60,6 +60,16 @@ _STDLIB_METHOD_NAMES = frozenset({
     "submit", "shutdown", "add_done_callback", "set_result",
     "set_exception", "put_nowait", "get_nowait", "acquire", "release",
     "notify", "notify_all", "extend",
+    # json/pickle/marshal module functions: a pickle.dump(...) must not
+    # bind to some package def that happens to be called "dump"
+    "dump", "dumps", "load", "loads",
+    # list/dict/set/deque mutators: ``out.append(x)`` must not bind to
+    # the one class in the package with an ``append`` method (that edge
+    # once made every list-building loop look like it took
+    # SeriesStore.append's lock)
+    "append", "appendleft", "pop", "popleft", "popitem", "add",
+    "remove", "discard", "insert", "clear", "update", "setdefault",
+    "sort", "reverse",
 })
 
 
@@ -249,6 +259,22 @@ class CallGraph:
             return cands[0]
         return None
 
+    def resolved_edges(self, fi):
+        """fi's resolved callees, computed once (the fixpoints below
+        would otherwise re-resolve every call on every pass)."""
+        cache = getattr(self, "_edge_cache", None)
+        if cache is None:
+            cache = self._edge_cache = {}
+        edges = cache.get(fi)
+        if edges is None:
+            edges = []
+            for name, kind in fi.calls:
+                target = self.resolve(fi, name, kind)
+                if target is not None:
+                    edges.append(target)
+            cache[fi] = edges
+        return edges
+
     # --- reachability -----------------------------------------------------
     def traced_set(self):
         """All functions reachable from traced entries (entries included),
@@ -261,9 +287,8 @@ class CallGraph:
         self.traced_via = {fi: None for fi in work}  # child -> caller
         while work:
             fi = work.pop()
-            for name, kind in fi.calls:
-                target = self.resolve(fi, name, kind)
-                if target is not None and target not in traced:
+            for target in self.resolved_edges(fi):
+                if target not in traced:
                     traced.add(target)
                     self.traced_via[target] = fi
                     work.append(target)
@@ -306,8 +331,7 @@ class CallGraph:
             for fi in self.functions:
                 if fi in syncing:
                     continue
-                for name, kind in fi.calls:
-                    target = self.resolve(fi, name, kind)
+                for target in self.resolved_edges(fi):
                     if target in syncing:
                         syncing.add(fi)
                         changed = True

@@ -26,13 +26,17 @@ from .callgraph import CallGraph
 from .rules import RULES_DOC, run_rules
 
 
-def build_report(paths, select=None, root=None):
+def build_report(paths, select=None, root=None, jobs=1, disable=None):
     """Analyze paths -> (violations, parse_errors, file_count).
 
     Paths are stored relative to ``root`` (default: the current working
     directory) when they live under it, so fingerprints match the
     committed baseline no matter how the target was spelled on the
-    command line."""
+    command line.
+
+    ``disable``: iterable of ``RULE:PATHPREFIX`` pairs dropping a rule
+    under a subtree (the CI lane runs G003 on mxnet_tpu/ but not on
+    tools/ — smoke scripts are host-side by definition)."""
     root = root or os.getcwd()
     files = []
     errors = []
@@ -46,9 +50,14 @@ def build_report(paths, select=None, root=None):
     graph = CallGraph()
     for sf in files:
         graph.add_file(sf)
-    violations = run_rules(files, graph, select=select)
+    violations = run_rules(files, graph, select=select, jobs=jobs)
     violations = core.apply_suppressions(
         violations, {sf.path: sf.lines for sf in files})
+    for spec in (disable or ()):
+        rule, _, prefix = spec.partition(":")
+        violations = [v for v in violations
+                      if not (v.rule == rule.upper()
+                              and v.path.startswith(prefix))]
     core.finalize_fingerprints(violations)
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations, errors, len(files)
@@ -65,6 +74,14 @@ def main(argv=None):
                     help="rewrite the baseline to accept the current state "
                          "(existing justifications are kept)")
     ap.add_argument("--select", help="comma list of rules (default: all)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="fork N workers for the per-file rule phase "
+                         "(parse + call/lock graph stay in the parent; "
+                         "serial where fork is unavailable)")
+    ap.add_argument("--disable", action="append", default=[],
+                    metavar="RULE:PATHPREFIX",
+                    help="drop RULE under PATHPREFIX (repeatable), e.g. "
+                         "--disable G003:tools/")
     ap.add_argument("--all", action="store_true",
                     help="list baselined findings too, not just new ones")
     ap.add_argument("--report", help="write a JSON report to this path")
@@ -104,7 +121,8 @@ def main(argv=None):
               else "no traced function matches %r" % args.why)
         return 0
 
-    violations, errors, n_files = build_report(args.paths, select=select)
+    violations, errors, n_files = build_report(
+        args.paths, select=select, jobs=args.jobs, disable=args.disable)
 
     baseline = core.load_baseline(args.baseline)
     if args.write_baseline:

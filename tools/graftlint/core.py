@@ -27,6 +27,9 @@ RULES = {
     "G002": "retrace hazard: data-dependent branch / per-value compile",
     "G003": "side effect inside traced code",
     "G004": "lock discipline: guarded state touched outside its lock",
+    "G005": "lock order: acquisition cycle / wait with a second lock held",
+    "G006": "blocking call (sleep/socket/timeout-less wait) under a lock",
+    "G007": "thread/pool/server without daemon flag or reachable stop",
 }
 
 _DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")

@@ -1,0 +1,495 @@
+"""graftlint lock graph: whole-program lock-order + blocking analysis.
+
+The concurrency rules (G005/G006) need three whole-program facts the
+per-file rules cannot see:
+
+1. **the lock-acquisition graph** — an edge A -> B for every place the
+   package acquires lock B while (lexically or through a resolved call
+   chain) already holding lock A. A cycle in that graph is a potential
+   deadlock: two threads entering the cycle from different edges block
+   each other forever.
+2. **held-set propagation** — which locks can be held when a function is
+   *entered* (union over its resolved callers of the locks lexically
+   held at the call site, plus what the callers themselves were entered
+   with). This is how ``Condition.wait()`` buried two calls below a
+   ``with self._lock:`` still gets flagged.
+3. **the blocking closure** — the G001 sync-closure discipline applied
+   to unbounded blocking: a function whose body contains a
+   ``time.sleep``/socket op/``urlopen``/timeout-less
+   ``.result()``/``.get()``/``.join()``/``.wait()`` call, propagated
+   through every resolvable caller.
+
+Lock identity is name-based and deliberately conservative, like the
+call graph it builds on:
+
+* a ``with <expr>:`` item counts as a lock acquisition when <expr> is a
+  *declared* lock (``X = threading.Lock()`` at module scope,
+  ``self._x = threading.Lock()/RLock()/Condition()`` in a class), a
+  ``# guarded-by:`` lock source, or an identifier matching the package
+  lock-naming convention (``_lock``/``_*_lock``/``_locks``/``_cond``/
+  ``_mutex``/``_life``/``_guard``);
+* canonical ids keep instances of the same class attribute together
+  (``path::Class._lock``) and keep function-local lock variables apart
+  (``path::fn::lock``) — merging locals across functions is how
+  name-based lock analyses drown in false cycles;
+* ``self._locks[shard]`` canonicalizes to the *family*
+  ``path::Class._locks[]``; families never produce self-deadlock
+  findings (two members are distinct runtime objects).
+
+Ambiguity costs an edge, never a false edge — same contract as
+:mod:`~tools.graftlint.callgraph`.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .callgraph import call_kind, callee_name, own_nodes
+
+# identifiers that name a lock by convention (matched on the final
+# attribute/name component, lowercased)
+_LOCKISH_RE = re.compile(
+    r"(?:^|_)(?:lock|locks|cond|mutex|life|guard)$")
+
+# threading constructors that declare a lock-like object (Event is
+# excluded: waiting on an Event holds nothing)
+_LOCK_CONSTRUCTORS = {
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+    "Semaphore": "Semaphore",
+    "BoundedSemaphore": "Semaphore",
+}
+
+# condition-variable detection for the wait-under-second-lock check
+_CONDISH_RE = re.compile(r"(?:^|_)cond(?:ition)?$")
+
+
+def lockish_name(name):
+    return bool(name) and bool(_LOCKISH_RE.search(name.lower()))
+
+
+def _condish_name(name):
+    return bool(name) and bool(_CONDISH_RE.search(name.lower()))
+
+
+# --- blocking-call classification (G006) ----------------------------------
+
+# attribute calls that block on the network regardless of arguments
+# (boundedness depends on socket timeout state the analyzer can't see;
+# the kvstore wire protocol is built from exactly these)
+_SOCKET_ATTRS = {"accept", "recv", "recvfrom", "recv_into", "sendall",
+                 "connect", "makefile"}
+
+# zero-arg methods that block unboundedly without a timeout
+_TIMEOUTABLE_ATTRS = {"result", "get", "join", "wait", "communicate"}
+
+
+def _has_timeout(call):
+    if call.args:
+        return True  # positional timeout (join(5), wait(0.1), get(True, 5))
+    return any(kw.arg in ("timeout", "block") and not (
+        isinstance(kw.value, ast.Constant) and kw.value.value is True)
+        for kw in call.keywords)
+
+
+def classify_blocking(call):
+    """A short reason string if this Call can block unboundedly, else
+    None. Calls carrying an explicit timeout are bounded and exempt."""
+    func = call.func
+    name = callee_name(call)
+    if isinstance(func, ast.Attribute):
+        try:
+            prefix = ast.unparse(func)
+        except Exception:
+            prefix = ""
+        if prefix.endswith("time.sleep") or prefix == "sleep":
+            return "time.sleep()"
+        if name in _SOCKET_ATTRS:
+            return "socket .%s()" % name
+        if name == "urlopen" and not any(kw.arg == "timeout"
+                                         for kw in call.keywords):
+            return "urlopen() without timeout"
+        if name == "create_connection" and not (
+                len(call.args) > 1
+                or any(kw.arg == "timeout" for kw in call.keywords)):
+            return "socket.create_connection() without timeout"
+        if name in _TIMEOUTABLE_ATTRS and not _has_timeout(call):
+            return ".%s() without timeout" % name
+    elif isinstance(func, ast.Name):
+        if func.id == "sleep":
+            return "time.sleep()"
+        if func.id == "urlopen" and not any(kw.arg == "timeout"
+                                            for kw in call.keywords):
+            return "urlopen() without timeout"
+        if func.id == "input":
+            return "input()"
+    return None
+
+
+class LockGraph:
+    """Whole-program lock facts over a CallGraph's fileset.
+
+    Build with :meth:`build` (after ``graph.finalize()``); then the rule
+    layer reads :attr:`cycle_edges`, :attr:`self_deadlocks`,
+    :attr:`wait_findings`, :attr:`call_sites`, :attr:`blocking` and
+    :attr:`held_into`.
+    """
+
+    def __init__(self):
+        self.lock_kinds = {}       # canon -> Lock|RLock|Condition|...
+        self.module_locks = {}     # (path, name) -> canon
+        self.class_locks = {}      # (path, cls, attr) -> canon
+        self.acquire_sites = []    # (fi, canon, held_tuple, node)
+        self.call_sites = {}       # fi -> [(node, held_tuple)]
+        self.wait_sites = []       # (fi, recv_canon, node, held_tuple)
+        self.acquires_direct = {}  # fi -> set(canon)
+        # derived (computed in build):
+        self.acq_closure = {}      # fi -> set(canon), transitive
+        self.acq_via = {}          # (fi, canon) -> callee FuncInfo
+        self.edges = {}            # (a, b) -> [(fi, node, via_qual)]
+        self.held_into = {}        # fi -> set(canon) held by callers
+        self.held_into_via = {}    # (fi, canon) -> caller FuncInfo
+        self.cycle_edges = []      # (a, b, fi, node, via_qual, cycle_path)
+        self.self_deadlocks = []   # (canon, fi, node)
+        self.blocking = {}         # fi -> (reason, via FuncInfo or None)
+
+    # --- lock declaration & canonicalization ------------------------------
+
+    def _declare_locks(self, sf):
+        """Index declared locks: module-level ``X = threading.Lock()``
+        and ``self._x = threading.Lock()`` inside a class."""
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            kind = _LOCK_CONSTRUCTORS.get(callee_name(node.value))
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    fn = sf.enclosing_function(node)
+                    if fn is None:  # module scope
+                        canon = "%s::%s" % (sf.path, tgt.id)
+                        self.module_locks[(sf.path, tgt.id)] = canon
+                        self.lock_kinds[canon] = kind
+                elif isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    cls = None
+                    for anc in sf.ancestors(node):
+                        if isinstance(anc, ast.ClassDef):
+                            cls = anc.name
+                            break
+                    if cls:
+                        canon = "%s::%s.%s" % (sf.path, cls, tgt.attr)
+                        self.class_locks[(sf.path, cls, tgt.attr)] = canon
+                        self.lock_kinds[canon] = kind
+
+    def canon_expr(self, sf, fi, expr):
+        """Canonical lock id for a with-item / wait-receiver expression,
+        or None if it does not look like a lock."""
+        if isinstance(expr, ast.Name):
+            canon = self.module_locks.get((sf.path, expr.id))
+            if canon:
+                return canon
+            if lockish_name(expr.id):
+                # function-local lock variable: scope the id to the
+                # function so unrelated locals never merge
+                qual = fi.qualname if fi is not None \
+                    else sf.path + "::<module>"
+                return "%s::%s" % (qual, expr.id)
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                cls = fi.cls if fi is not None else None
+                canon = self.class_locks.get((sf.path, cls, expr.attr))
+                if canon:
+                    return canon
+                if lockish_name(expr.attr):
+                    if cls:
+                        return "%s::%s.%s" % (sf.path, cls, expr.attr)
+                    return "%s::self.%s" % (sf.path, expr.attr)
+                return None
+            if lockish_name(expr.attr):
+                try:
+                    return "%s::<%s>" % (sf.path, ast.unparse(expr))
+                except Exception:
+                    return None
+            return None
+        if isinstance(expr, ast.Subscript):
+            base = self.canon_expr(sf, fi, expr.value)
+            return base + "[]" if base else None
+        if isinstance(expr, ast.Call):
+            name = callee_name(expr)
+            if name and lockish_name(name):
+                if call_kind(expr) == "self" and fi is not None and fi.cls:
+                    return "%s::%s.%s()" % (sf.path, fi.cls, name)
+                return "%s::%s()" % (sf.path, name)
+            return None
+        return None
+
+    def display(self, canon):
+        """Short human form of a canonical id for messages."""
+        return canon.split("::", 1)[1] if "::" in canon else canon
+
+    # --- per-function region walk -----------------------------------------
+
+    def _walk_function(self, sf, fi, by_node):
+        calls = self.call_sites.setdefault(fi, [])
+        direct = self.acquires_direct.setdefault(fi, set())
+
+        def visit(node, held):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    visit_children(item.context_expr, held)
+                    canon = self.canon_expr(sf, fi, item.context_expr)
+                    if canon:
+                        self.acquire_sites.append(
+                            (fi, canon, held + tuple(acquired), node))
+                        direct.add(canon)
+                        acquired.append(canon)
+                body_held = held + tuple(acquired)
+                for stmt in node.body:
+                    visit(stmt, body_held)
+                return
+            if isinstance(node, ast.Call):
+                calls.append((node, held))
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr == "wait":
+                        recv = self.canon_expr(sf, fi, node.func.value)
+                        self.wait_sites.append((fi, recv, node, held))
+                    elif node.func.attr == "acquire":
+                        recv = self.canon_expr(sf, fi, node.func.value)
+                        if recv:
+                            direct.add(recv)
+            visit_children(node, held)
+
+        def visit_children(node, held):
+            for child in ast.iter_child_nodes(node):
+                sub = by_node.get(child)
+                if sub is not None and sub is not fi:
+                    continue  # nested def/lambda: its own unit
+                visit(child, held)
+
+        visit_children(fi.node, ())
+
+    # --- build ------------------------------------------------------------
+
+    def build(self, files, graph):
+        graph.finalize()
+        by_path = {sf.path: sf for sf in files}
+        for sf in files:
+            self._declare_locks(sf)
+        for fi in graph.functions:
+            sf = by_path.get(fi.path)
+            if sf is not None:
+                self._walk_function(sf, fi, graph.by_node)
+        # resolve every call site ONCE; the fixpoints below iterate the
+        # cached edges (re-resolving per iteration is what would make
+        # the analyzer scale with iterations * call sites)
+        self._resolved = {}
+        for fi in graph.functions:
+            self._resolved[fi] = list(self._resolve_calls_uncached(
+                graph, fi))
+        self._compute_acq_closure(graph)
+        self._compute_held_into(graph)
+        self._compute_edges(graph)
+        self._find_cycles()
+        self._find_wait_findings()
+        self._compute_blocking(graph)
+        return self
+
+    def _resolve_calls_uncached(self, graph, fi):
+        for node, held in self.call_sites.get(fi, ()):
+            name = callee_name(node)
+            if name is None:
+                continue
+            target = graph.resolve(fi, name, call_kind(node))
+            if target is not None and target is not fi:
+                yield node, held, target
+
+    def _resolved_calls(self, graph, fi):
+        return self._resolved.get(fi, ())
+
+    def _compute_acq_closure(self, graph):
+        acq = {fi: set(s) for fi, s in self.acquires_direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fi in graph.functions:
+                mine = acq.setdefault(fi, set())
+                for _node, _held, target in self._resolved_calls(graph, fi):
+                    for canon in acq.get(target, ()):
+                        if canon not in mine:
+                            mine.add(canon)
+                            self.acq_via[(fi, canon)] = target
+                            changed = True
+        self.acq_closure = acq
+
+    def _compute_held_into(self, graph):
+        held_into = {fi: set() for fi in graph.functions}
+        changed = True
+        while changed:
+            changed = False
+            for fi in graph.functions:
+                carried = held_into[fi]
+                for node, held, target in self._resolved_calls(graph, fi):
+                    incoming = set(held) | carried
+                    tgt = held_into[target]
+                    for canon in incoming:
+                        if canon not in tgt:
+                            tgt.add(canon)
+                            self.held_into_via[(target, canon)] = fi
+                            changed = True
+        self.held_into = held_into
+
+    def _compute_edges(self, graph):
+        def add_edge(a, b, fi, node, via_qual):
+            self.edges.setdefault((a, b), []).append((fi, node, via_qual))
+
+        for fi, canon, held, node in self.acquire_sites:
+            if canon in held:
+                # re-entry of an already-held lock establishes no new
+                # order (its edges were recorded at first acquisition);
+                # for a non-reentrant kind it IS a self-deadlock —
+                # except lock families, whose members are distinct
+                # runtime objects
+                if self.lock_kinds.get(canon) != "RLock" \
+                        and not canon.endswith("[]"):
+                    self.self_deadlocks.append((canon, fi, node))
+                continue
+            for a in held:
+                add_edge(a, canon, fi, node, None)
+        for fi in graph.functions:
+            for node, held, target in self._resolved_calls(graph, fi):
+                if not held:
+                    continue
+                for b in self.acq_closure.get(target, ()):
+                    if b in held:
+                        # call-mediated re-entry: no order edge, but a
+                        # non-reentrant lock re-taken through the callee
+                        # deadlocks just like lexical nesting does
+                        if self.lock_kinds.get(b) != "RLock" \
+                                and not b.endswith("[]"):
+                            self.self_deadlocks.append((b, fi, node))
+                        continue
+                    for a in held:
+                        add_edge(a, b, fi, node, target.qualname)
+
+    def _find_cycles(self):
+        """Tarjan SCCs over the lock digraph; every edge inside a
+        non-trivial SCC participates in a potential deadlock cycle."""
+        succ = {}
+        for (a, b) in self.edges:
+            succ.setdefault(a, set()).add(b)
+            succ.setdefault(b, set())
+        index = {}
+        low = {}
+        on_stack = set()
+        stack = []
+        scc_of = {}
+        counter = [0]
+        sccs = []
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(succ[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(succ[w]))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    sccs.append(comp)
+                    for w in comp:
+                        scc_of[w] = len(sccs) - 1
+
+        for v in sorted(succ):
+            if v not in index:
+                strongconnect(v)
+
+        big = {i for i, comp in enumerate(sccs) if len(comp) > 1}
+        for (a, b), sites in sorted(self.edges.items()):
+            i = scc_of.get(a)
+            if i is None or i not in big or scc_of.get(b) != i:
+                continue
+            cycle = " -> ".join(self.display(c)
+                                for c in sorted(sccs[i]) + [sorted(sccs[i])[0]])
+            for fi, node, via_qual in sites:
+                self.cycle_edges.append((a, b, fi, node, via_qual, cycle))
+
+    def _find_wait_findings(self):
+        self.wait_findings = []
+        for fi, recv, node, held in self.wait_sites:
+            if recv is None:
+                continue
+            # only Condition variables: waiting releases *its own* lock
+            # and nothing else — Event.wait holds no lock to begin with
+            kind = self.lock_kinds.get(recv)
+            if kind != "Condition" and not (
+                    kind is None and _condish_name(recv.rsplit(".", 1)[-1])):
+                continue
+            others = (set(held) | self.held_into.get(fi, set())) - {recv}
+            if others:
+                caller_locks = sorted(others - set(held))
+                self.wait_findings.append(
+                    (fi, recv, node, sorted(set(held) - {recv}),
+                     caller_locks))
+
+    def _compute_blocking(self, graph):
+        blocking = {}
+        for fi in graph.functions:
+            for node, _held in self.call_sites.get(fi, ()):
+                reason = classify_blocking(node)
+                if reason is not None:
+                    blocking[fi] = (reason, None)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for fi in graph.functions:
+                if fi in blocking:
+                    continue
+                for _node, _held, target in self._resolved_calls(graph, fi):
+                    if target in blocking:
+                        blocking[fi] = (blocking[target][0], target)
+                        changed = True
+                        break
+        self.blocking = blocking
+
+    def blocking_chain(self, fi, limit=4):
+        """qualname chain from fi to the direct blocking site."""
+        chain = []
+        cur = fi
+        while cur is not None and len(chain) < limit:
+            chain.append(cur.qualname)
+            cur = self.blocking.get(cur, (None, None))[1]
+        return chain

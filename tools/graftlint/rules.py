@@ -1,4 +1,4 @@
-"""graftlint rules G001-G004.
+"""graftlint rules G001-G007.
 
 Each rule is a function ``(sf, graph, ctx) -> [Violation]`` over one
 parsed :class:`~tools.graftlint.core.SourceFile`, with the cross-file
@@ -9,8 +9,12 @@ patterns) lives in docs/static_analysis.md.
 from __future__ import annotations
 
 import ast
+import os
+import pickle
 import re
+import traceback
 
+from . import lockgraph as _lockgraph
 from .callgraph import (JIT_CONSTRUCTORS, call_kind, callee_name,
                         is_jit_wrapper_call, own_nodes)
 from .core import Violation
@@ -599,6 +603,230 @@ def check_g004(sf, graph, ctx):
     return out
 
 
+# --- G005: lock ordering --------------------------------------------------
+
+def check_g005(sf, graph, ctx):
+    """Deadlock shapes over the whole-program lock graph: acquisition
+    cycles, same-lock re-entry, and Condition.wait with a second lock
+    held (wait releases only the condition's own lock)."""
+    out = []
+    lg = ctx["lockgraph"]
+    for canon, fi, node in lg.self_deadlocks:
+        if fi.path != sf.path:
+            continue
+        scope = fi.qualname.split("::", 1)[1]
+        out.append(_v("G005", sf, node, scope,
+                      "re-acquiring %s while already holding it: "
+                      "self-deadlock on a non-reentrant lock (use RLock "
+                      "or restructure so the inner path takes the lock "
+                      "exactly once)" % lg.display(canon)))
+    for a, b, fi, node, via_qual, cycle in lg.cycle_edges:
+        if fi.path != sf.path:
+            continue
+        scope = fi.qualname.split("::", 1)[1]
+        via = " (via %s)" % via_qual if via_qual else ""
+        out.append(_v("G005", sf, node, scope,
+                      "acquires %s while holding %s%s, but the opposite "
+                      "order exists elsewhere — potential deadlock "
+                      "[cycle: %s]; pick one global order"
+                      % (lg.display(b), lg.display(a), via, cycle)))
+    for fi, recv, node, lexical, from_callers in lg.wait_findings:
+        if fi.path != sf.path:
+            continue
+        scope = fi.qualname.split("::", 1)[1]
+        extras = [lg.display(c) for c in lexical]
+        if from_callers:
+            extras += ["%s (held by a caller)" % lg.display(c)
+                       for c in from_callers]
+        out.append(_v("G005", sf, node, scope,
+                      "%s.wait() releases only its own lock; %s stays "
+                      "held for the whole wait — any thread needing it "
+                      "to notify deadlocks. Drop the outer lock before "
+                      "waiting" % (lg.display(recv), ", ".join(extras))))
+    return out
+
+
+# --- G006: blocking under lock --------------------------------------------
+
+def check_g006(sf, graph, ctx):
+    """Unbounded blocking (sleep/socket/urlopen, timeout-less
+    result/get/join/wait — or any function transitively reaching one)
+    inside a ``with lock:`` body."""
+    out = []
+    lg = ctx["lockgraph"]
+    for fi in graph.functions:
+        if fi.path != sf.path:
+            continue
+        scope = fi.qualname.split("::", 1)[1]
+        for node, held in lg.call_sites.get(fi, ()):
+            if not held:
+                continue
+            lock = lg.display(held[-1])
+            # cond.wait on a lock we hold releases it — the scheduler
+            # idiom; the second-lock hazard is G005's finding
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "wait":
+                recv = lg.canon_expr(sf, fi, node.func.value)
+                if recv is not None and recv in held:
+                    continue
+            reason = _lockgraph.classify_blocking(node)
+            if reason is not None:
+                out.append(_v("G006", sf, node, scope,
+                              "%s while holding %s: every thread needing "
+                              "the lock stalls behind the block; move the "
+                              "blocking call outside the critical section "
+                              "or add a timeout" % (reason, lock)))
+                continue
+            name = callee_name(node)
+            if name is None:
+                continue
+            target = graph.resolve(fi, name, call_kind(node))
+            if target is not None and target in lg.blocking \
+                    and target is not fi:
+                why, _via = lg.blocking[target]
+                chain = lg.blocking_chain(target)
+                out.append(_v("G006", sf, node, scope,
+                              "%s() can block unboundedly (%s, reached "
+                              "via %s) while holding %s; hoist the call "
+                              "out of the critical section"
+                              % (name, why, " -> ".join(chain), lock)))
+    return out
+
+
+# --- G007: thread/resource lifecycle --------------------------------------
+
+_POOL_NAMES = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_SERVER_NAMES = {"HTTPServer", "ThreadingHTTPServer", "TCPServer",
+                 "ThreadingTCPServer"}
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_true(node):
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _has_lifecycle(container, attr_calls, target_name=None):
+    """Does ``container`` (a ClassDef body or function body) contain one
+    of ``attr_calls`` (e.g. join/shutdown), or a ``X.daemon = True``
+    store for ``target_name``?"""
+    for node in ast.walk(container):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            if node.func.attr in attr_calls:
+                if "join" in attr_calls:
+                    recv = node.func.value
+                    if isinstance(recv, (ast.Constant, ast.JoinedStr)) \
+                            or _unparse(recv) in ("os.path", "posixpath",
+                                                  "ntpath", "path"):
+                        continue
+                return True
+        if target_name and isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and tgt.attr == "daemon" \
+                        and _is_true(node.value) \
+                        and target_name in _unparse(tgt.value):
+                    return True
+    return False
+
+
+def _binding(sf, call):
+    """How is this constructor call's result bound?
+    -> ("with", None) | ("attr", name) | ("local", name) | ("none", None)
+    """
+    node = call
+    for anc in sf.ancestors(call):
+        if isinstance(anc, ast.withitem) or (
+                isinstance(anc, (ast.With, ast.AsyncWith))
+                and any(item.context_expr is node for item in anc.items)):
+            return "with", None
+        if isinstance(anc, ast.Assign):
+            for tgt in anc.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    return "attr", tgt.attr
+                if isinstance(tgt, ast.Name):
+                    return "local", tgt.id
+            return "none", None
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            return "none", None
+        node = anc
+    return "none", None
+
+
+def check_g007(sf, graph, ctx):
+    """Every Thread must be daemonized or joined from its owner; every
+    executor pool shut down (or context-managed); every HTTP/TCP server
+    must have a reachable shutdown — so subsystems can't leak threads
+    past drain."""
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        fi, scope = _scope_of(sf, graph, node)
+
+        def owner_scope():
+            """Search scope for lifecycle calls: the enclosing class if
+            the object lands on self, else the enclosing function, else
+            the module."""
+            for anc in sf.ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    return anc
+            return sf.tree
+
+        if name == "Thread" and (
+                isinstance(node.func, ast.Name)
+                or _unparse(node.func).endswith("threading.Thread")):
+            if _is_true(_kwarg(node, "daemon")):
+                continue
+            kind, bound = _binding(sf, node)
+            if kind == "attr":
+                container = owner_scope()
+                if _has_lifecycle(container, {"join"}, bound):
+                    continue
+            else:
+                fn = sf.enclosing_function(node)
+                container = fn if fn is not None else sf.tree
+                if _has_lifecycle(container, {"join"}, bound):
+                    continue
+            out.append(_v("G007", sf, node, scope,
+                          "Thread without daemon=True or a reachable "
+                          ".join(): it outlives stop()/close() and leaks "
+                          "past drain; daemonize it or join it from the "
+                          "owner's lifecycle"))
+        elif name in _POOL_NAMES:
+            kind, bound = _binding(sf, node)
+            if kind == "with":
+                continue
+            container = owner_scope() if kind == "attr" else (
+                sf.enclosing_function(node) or sf.tree)
+            if _has_lifecycle(container, {"shutdown"}, bound):
+                continue
+            out.append(_v("G007", sf, node, scope,
+                          "%s without a reachable .shutdown() (or a "
+                          "`with` block): worker threads leak past "
+                          "close; context-manage the pool or shut it "
+                          "down in the owner's stop/close" % name))
+        elif name in _SERVER_NAMES:
+            if _has_lifecycle(sf.tree, {"shutdown", "server_close"}):
+                continue
+            out.append(_v("G007", sf, node, scope,
+                          "%s without a reachable .shutdown()/"
+                          ".server_close() in this module: serve_forever "
+                          "never exits and the port stays bound; pair "
+                          "every server start with a stop path" % name))
+    return out
+
+
 RULES_DOC = {
     "G001": """G001 host-sync
 A device->host transfer (.asnumpy()/.asscalar()/.item()/.tolist(), or
@@ -633,6 +861,34 @@ preemption points; unlocked iteration throws 'changed size during
 iteration' under a concurrent writer.
 Fix patterns: take the lock; snapshot under the lock and iterate the
 snapshot; keep __init__ free (construction happens-before publication).""",
+    "G005": """G005 lock order
+A whole-program lock-acquisition graph (with-nesting propagated through
+the call graph; locks identified by declarations, guarded-by
+annotations, and the _lock/_cond naming convention) must stay acyclic.
+Flags: opposite acquisition orders of the same two locks (potential
+deadlock), re-acquiring a non-reentrant lock already held, and
+Condition.wait() reached while a SECOND lock is held (wait releases only
+the condition's own lock — the notifier deadlocks on the other one).
+Fix patterns: pick one global lock order and stick to it; drop outer
+locks before waiting; use RLock only when re-entry is by design.""",
+    "G006": """G006 blocking under lock
+A call that can block unboundedly — time.sleep, socket send/recv/accept,
+urlopen, .result()/.get()/.join()/.wait() without a timeout, or any
+function transitively reaching one (the G001 sync-closure discipline
+applied to blocking) — inside a `with lock:` body serializes every
+thread needing that lock behind the block.
+Fix patterns: snapshot state under the lock and do the slow work
+outside; add timeouts; waiting on a condition you hold is exempt (wait
+releases it).""",
+    "G007": """G007 thread/resource lifecycle
+Every Thread(...) must be daemon=True or have a .join() reachable from
+its owner's stop/close lifecycle; every ThreadPoolExecutor/
+ProcessPoolExecutor a .shutdown() (or a `with` block); every
+HTTP/TCP server a shutdown()/server_close() path in its module.
+Otherwise a new subsystem silently leaks threads past drain and hangs
+interpreter exit.
+Fix patterns: daemonize background loops, join from stop() with a
+timeout, context-manage pools.""",
 }
 
 
@@ -641,19 +897,78 @@ ALL_RULES = {
     "G002": check_g002,
     "G003": check_g003,
     "G004": check_g004,
+    "G005": check_g005,
+    "G006": check_g006,
+    "G007": check_g007,
 }
 
 
-def run_rules(files, graph, select=None):
-    """Run all (or selected) rules over parsed files; returns violations
-    without fingerprints/suppressions applied (the driver does that)."""
+def build_context(files, graph):
+    """The shared whole-program facts every rule reads: traced set, sync
+    closure, and the lock graph. Built once (it is the expensive part),
+    then shared across files — and across workers under ``--jobs``."""
     traced = graph.traced_set()
     syncing = graph.sync_closure(direct_sync_funcs(graph))
-    ctx = {"traced": traced, "syncing": syncing}
+    lg = _lockgraph.LockGraph().build(files, graph)
+    return {"traced": traced, "syncing": syncing, "lockgraph": lg}
+
+
+def run_rules(files, graph, select=None, jobs=1, ctx=None):
+    """Run all (or selected) rules over parsed files; returns violations
+    without fingerprints/suppressions applied (the driver does that).
+
+    ``jobs > 1`` forks that many workers AFTER the parse/graph/context
+    phase, so children inherit the ASTs copy-on-write and each runs the
+    per-file rule phase over a shard. Falls back to serial where fork is
+    unavailable."""
+    if ctx is None:
+        ctx = build_context(files, graph)
     rules = {k: v for k, v in ALL_RULES.items()
              if select is None or k in select}
+
+    def run_shard(shard):
+        out = []
+        for sf in shard:
+            for check in rules.values():
+                out.extend(check(sf, graph, ctx))
+        return out
+
+    jobs = min(int(jobs or 1), len(files))
+    if jobs <= 1 or not hasattr(os, "fork"):
+        return run_shard(files)
+
+    shards = [files[i::jobs] for i in range(jobs)]
+    children = []
+    for shard in shards:
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child
+            os.close(r)
+            status = 1
+            try:
+                with os.fdopen(w, "wb") as f:
+                    try:
+                        pickle.dump(("ok", run_shard(shard)), f)
+                        status = 0
+                    except Exception:
+                        pickle.dump(("err", traceback.format_exc()), f)
+            finally:
+                os._exit(status)
+        os.close(w)
+        children.append((pid, r))
     out = []
-    for sf in files:
-        for check in rules.values():
-            out.extend(check(sf, graph, ctx))
+    failures = []
+    for pid, r in children:
+        with os.fdopen(r, "rb") as f:
+            try:
+                tag, payload = pickle.load(f)
+            except Exception:
+                tag, payload = "err", "worker %d died without a report" % pid
+        os.waitpid(pid, 0)
+        if tag == "ok":
+            out.extend(payload)
+        else:
+            failures.append(payload)
+    if failures:
+        raise RuntimeError("graftlint worker failed:\n" + "\n".join(failures))
     return out

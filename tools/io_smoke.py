@@ -54,8 +54,8 @@ def collect(it, epochs=2):
         if e:
             it.reset()
         for b in it:
-            out.append((b.data[0].asnumpy().copy(),
-                        b.label[0].asnumpy().copy(), int(b.pad or 0)))
+            out.append((b.data[0].asnumpy().copy(),  # graftlint: disable=G001 — smoke verifies batch CONTENTS on host
+                        b.label[0].asnumpy().copy(), int(b.pad or 0)))  # graftlint: disable=G001 — same: host-side verification
     return out
 
 
