@@ -462,6 +462,37 @@ Flags currently honored:
     require the low-load condition to hold over the whole trailing
     window (hysteresis) so flapping input cannot oscillate the fleet.
 
+``MXNET_DIST_SENTINEL`` (default ``off``)
+    Cross-rank divergence sentinel policy (``off`` / ``warn`` /
+    ``raise``, observability/dist_trace.py): when a distributed kvstore
+    is constructed with the policy on, every fit step ships a tiny
+    fingerprint (grad-norm + param-checksum + loss, lifted from the
+    health plane's verdict — requires ``MXNET_HEALTH`` active, costs
+    zero extra device syncs) to kvstore shard 0, which compares it
+    across ranks and flags desync: ``warn`` logs + flight-records it,
+    ``raise`` raises ``DistDivergenceError`` before the next checkpoint
+    can absorb the corruption. String-valued and env-only — like
+    MXNET_HEALTH, NOT routed through the integer get_flag machinery.
+
+``MXNET_DIST_SENTINEL_TOL`` (default 1e-5)
+    Relative tolerance for cross-rank fingerprint agreement: fields
+    disagree when ``|a-b| > tol * max(1, |a|, |b|)``. Float-valued and
+    env-only. Bit-exact data-parallel replicas can run tight; loosen it
+    for genuinely asynchronous training (dist_async ranks see different
+    weights by design — step skew is the signal there, not norm drift).
+
+``MXNET_DIST_SENTINEL_SKEW`` (default 2)
+    Max step-index spread between ranks before the sentinel flags a
+    skew desync (a wedged or restarted rank falls behind its peers even
+    when every individual fingerprint looks healthy).
+
+``MXNET_DIST_ROUNDS`` (default 128)
+    History bound (rounds) of the kvstore server's straggler
+    attribution ring (dist_trace.RoundTracker): completed sync rounds
+    keep per-rank arrival lateness for the last N rounds; the
+    cumulative ranking and the ``kvstore.rank_lateness_ms{rank=}``
+    histograms are unaffected by the bound.
+
 ``MXNET_PERF`` (default 1)
     Roofline attribution layer (observability/perf.py): analytic
     FLOPs/HBM-bytes accounting per compiled program, achieved-vs-
@@ -554,6 +585,8 @@ _DEFAULTS = {
     "MXNET_OBS_RESERVOIR": 32,
     "MXNET_OBS_TS_INTERVAL_MS": 1000,
     "MXNET_OBS_TS_RETAIN": 600,
+    "MXNET_DIST_SENTINEL_SKEW": 2,
+    "MXNET_DIST_ROUNDS": 128,
     "MXNET_OBS_FLEET_INTERVAL_MS": 1000,
     "MXNET_OBS_FLEET_STALE_SCRAPES": 3,
     "MXNET_OBS_FLEET_DEAD_SCRAPES": 10,

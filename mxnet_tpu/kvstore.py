@@ -134,6 +134,14 @@ class KVStore:
         self._dist = kv_type.startswith("dist")
         if self._dist:
             _ensure_distributed()
+            # stamp this process's rank onto the perf waterfall ring:
+            # the fleet step timeline (observability/dist_trace.py)
+            # aligns workers' rows by (rank, step)
+            import jax
+
+            from .observability import dist_trace
+
+            dist_trace.set_rank(jax.process_index())
         self._register_health_provider()
 
     def _register_health_provider(self):
@@ -630,6 +638,18 @@ class KVStoreDistAsync(KVStore):
         self._push_lock = threading.Lock()
         self._push_stats = {}  # guarded-by: self._push_lock
         self._register_health_provider()
+        from .observability import dist_trace
+
+        dist_trace.set_rank(self._rank)
+        self._sentinel_armed = False
+        if dist_trace.sentinel_policy() != "off":
+            # every rank's per-step fingerprint must meet on ONE
+            # comparator: shard 0 hosts the SentinelTracker, and the
+            # verdict rides back on the reply (no extra round trip)
+            client = self._client
+            dist_trace.arm_sentinel(
+                lambda fp: client.call0(("sentinel", fp)))
+            self._sentinel_armed = True
 
     def push_staleness(self):
         """Worker-side view plus every server shard's per-key push
@@ -888,6 +908,10 @@ class KVStoreDistAsync(KVStore):
             self._client.shard_call(i, ("load_states", blob))
 
     def close(self):
+        if self._sentinel_armed:
+            from .observability import dist_trace
+
+            dist_trace.disarm_sentinel()
         if self._own_server is not None:
             self._own_server.stop()
         self._client.close()

@@ -95,7 +95,20 @@ class KVStoreServer:
         self._barrier_waiters = []  # guarded-by: self._lock
         self._barrier_gen = 0
         self._stop = threading.Event()
+        # straggler attribution + divergence sentinels (dist_trace):
+        # per-rank arrival bookkeeping for every sync push/barrier round
+        # and cross-rank fingerprint comparison, published through the
+        # metrics registry and the `dist` flight-recorder section
+        from .observability import dist_trace as _dist
+
+        self._dist_rounds = _dist.RoundTracker()
+        self._dist_sentinel = _dist.SentinelTracker()
+        # best guess at the fleet size for push rounds (barriers declare
+        # theirs explicitly): launcher env, grown by barrier sightings
+        self._declared_workers = int(
+            os.environ.get("MXTPU_NUM_WORKERS", "0") or 0)  # guarded-by: self._lock
         self._register_heartbeat_series()
+        self._register_dist_section()
 
     def _register_heartbeat_series(self):
         """Export per-rank heartbeat AGES as gauges refreshed at
@@ -133,6 +146,51 @@ class KVStoreServer:
         self._hb_hook = hook
         _ts.register_pre_sample(hook, _refresh)
 
+    def _register_dist_section(self):
+        """Contribute this shard's straggler/sentinel summaries to the
+        `dist` flight-recorder provider (and thus /statusz), keyed by
+        shard address.  Weakref like the heartbeat hook: returning None
+        once the server is gone makes dist_trace drop the entry."""
+        import weakref
+
+        from .observability import dist_trace as _dist
+
+        ref = weakref.ref(self)
+
+        def _section():
+            srv = ref()
+            if srv is None or srv._stop.is_set():
+                return None
+            return srv._dist_summary()
+
+        _dist.register_server(self.address, _section)
+
+    def _dist_summary(self):
+        return {"rounds": self._dist_rounds.summary(),
+                "sentinel": self._dist_sentinel.summary()}
+
+    def _note_round(self, op, msg, rank):
+        """Record this rank's arrival at its sync round
+        (dist_trace.RoundTracker): push rounds are keyed by kvstore key
+        (each worker pushes each key once per cycle), barrier rounds by
+        the current generation.  The generation is read under the shard
+        lock but a racing release can still stamp a late arrival onto
+        the next generation's key — worst case that round is finalized
+        as incomplete by the tracker's wrap detection; attribution is
+        best-effort by design and never publishes from partial data."""
+        with self._lock:
+            if op == "barrier":
+                declared = int(msg[1])
+                if declared > self._declared_workers:
+                    self._declared_workers = declared
+                kind, key, expected = ("barrier", self._barrier_gen,
+                                       declared)
+            else:
+                kind, key = "push", msg[1]
+                expected = max(self._declared_workers,
+                               len(self._last_seen))
+        self._dist_rounds.note(kind, key, rank, expected)
+
     # --- command handlers -------------------------------------------------
     def _handle(self, msg, conn_state):
         op = msg[0]
@@ -164,8 +222,16 @@ class KVStoreServer:
         if "rank" in conn_state:
             with self._lock:
                 self._last_seen[conn_state["rank"]] = now
+            if op in ("push", "push_2bit", "barrier"):
+                self._note_round(op, msg, conn_state["rank"])
         if op == "heartbeat":
             return ("ok",)
+        if op == "sentinel":
+            # per-step divergence fingerprint: compare across ranks and
+            # ship the verdict back on the reply (dist_trace)
+            return ("ok", self._dist_sentinel.note(msg[1]))
+        if op == "dist":
+            return ("ok", self._dist_summary())
         if op == "bye":
             # explicit deregistration on clean shutdown; a crashed worker
             # never sends this, so its stale _last_seen entry ages past
@@ -420,6 +486,7 @@ class KVStoreServer:
 
     def stop(self):
         self._handle(("stop",), {})
+        from .observability import dist_trace as _dist
         from .observability import metrics as _metrics
         from .observability import timeseries as _ts
 
@@ -427,6 +494,10 @@ class KVStoreServer:
         # stopped shard: its rank-age gauges leave /metrics rather than
         # freezing at their last values
         _metrics.unregister("kvstore.worker_heartbeat_age_s")
+        # same for the straggler/sentinel families and the dist section
+        self._dist_rounds.unpublish()
+        self._dist_sentinel.unpublish()
+        _dist.unregister_server(self.address)
 
 
 class _NumpyUpdater:

@@ -20,6 +20,7 @@ from ..context import cpu
 from ..initializer import Uniform
 from ..observability import (flight_recorder, health, perf, record_step,
                              trace_span)
+from ..observability import dist_trace as _dist
 
 _PARAM_KINDS = ("arg", "aux")
 _WEIGHT_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
@@ -342,6 +343,13 @@ class BaseModule:
                         verdict = self._health_check(
                             time.perf_counter() - step_started)
                         skip_update = verdict is not None and verdict.skip
+                        if verdict is not None and _dist.sentinel_armed():
+                            # divergence sentinel: ship this step's
+                            # grad-norm/param-checksum fingerprint (the
+                            # health plane already fetched it — zero
+                            # extra device sync) for cross-rank
+                            # comparison on the kvstore server
+                            _dist.sentinel_note_verdict(verdict)
                     if not skip_update:
                         with trace_span("update", "module"):
                             self.update()

@@ -56,6 +56,13 @@ Three pillars, one import:
   availability) evaluated as multi-window burn rates with hysteresis —
   the alert layer the autoscaler (serving/control/autoscale.py) acts
   on.
+* :mod:`.dist_trace` — cross-rank training observability (ISSUE 19):
+  rank-stamped step waterfalls merged into one fleet timeline with a
+  per-segment critical path, kvstore-server straggler attribution
+  (``kvstore.rank_lateness_ms{rank=}`` + last-arriver ranking), and
+  per-step divergence sentinels (``MXNET_DIST_SENTINEL=warn|raise``)
+  comparing grad-norm/param-checksum fingerprints across ranks
+  server-side (render with ``tools/dist_report.py``).
 
 See docs/observability.md for the metrics catalog, the "where did my
 step time go" workflow (profiler dump → tools/trace_report.py), the
@@ -76,6 +83,7 @@ from . import promparse
 from . import timeseries
 from . import fleet
 from . import slo_monitor
+from . import dist_trace
 from .metrics import (counter, gauge, histogram, dump_metrics,
                       reset_metrics, set_enabled, enabled)
 from .tracing import trace_span, device_scope
@@ -85,7 +93,7 @@ from .request_trace import RequestTrace
 
 __all__ = ["metrics", "instruments", "tracing", "health", "flight_recorder",
            "request_trace", "stats_schema", "exposition", "perf",
-           "promparse", "timeseries", "fleet", "slo_monitor",
+           "promparse", "timeseries", "fleet", "slo_monitor", "dist_trace",
            "counter", "gauge", "histogram", "dump_metrics", "reset_metrics",
            "set_enabled", "enabled", "trace_span", "device_scope",
            "sample_memory", "record_step", "retrace_causes",

@@ -1,0 +1,598 @@
+"""Cross-rank distributed-training observability.
+
+Three planes, one module (docs/observability.md "Distributed
+training"):
+
+* **Fleet step timeline** — every per-step waterfall record
+  (``perf.step_end``) is stamped with this process's *rank*, exported
+  through the exposition plane (``/statusz`` ``providers.dist`` and the
+  ``training`` section), and ``merge_steps`` aligns N workers' rings by
+  step index into one fleet timeline with a per-segment critical path:
+  which rank was slowest on data/device/kvstore/host, per step
+  (``merge_steps``) and cumulatively (``critical_path``).  The merge is
+  tolerant of restarted ranks (duplicate ``(rank, step)`` keeps the
+  newest record) and of ranks missing steps (rows carry ``n_ranks``).
+
+* **Straggler attribution** — ``RoundTracker`` gives the kvstore server
+  per-rank arrival bookkeeping for each sync round (one round per key
+  per push cycle, one per barrier generation).  A completed round
+  publishes ``kvstore.rank_lateness_ms{rank=}`` histograms (lateness =
+  arrival minus the round's FIRST arrival, so the pacesetter reads 0)
+  and a ``kvstore.round_last_arriver_total{rank=}`` counter; the
+  ``summary()`` ranking makes "rank 2 cost the fleet 180 ms/step" a
+  query.  This extends the PR 8 barrier dead-node diagnostics, which
+  only speak at timeout, to every healthy round.
+
+* **Divergence sentinels** — a tiny per-step fingerprint (grad-norm +
+  param-checksum + loss, lifted from the health plane's already-fetched
+  verdict: no extra device sync) is shipped to kvstore shard 0 as one
+  extra RPC per step and compared across ranks by ``SentinelTracker``:
+  relative-tolerance disagreement on any field, or step skew beyond
+  ``MXNET_DIST_SENTINEL_SKEW``, flags a desync via metrics, the ``dist``
+  flight-recorder section, and the ``MXNET_DIST_SENTINEL=warn|raise``
+  policy — catching silent cross-rank corruption before it poisons a
+  checkpoint.
+
+Layering: this module imports ``perf`` (to read the waterfall ring);
+``perf`` only reaches back through a lazy function-level import to stamp
+the rank, so there is no import cycle and the single-process cost is one
+cached int read per step.  Everything here is NOOP-cheap when no
+distributed store ever armed it.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import math
+import os
+import threading
+import time
+import urllib.request
+
+from . import flight_recorder, metrics, perf
+
+# the four waterfall segments, in the order they occur inside a step
+SEGMENTS = ("data_wait_s", "device_s", "kvstore_s", "host_s")
+
+_SENTINEL_POLICIES = ("off", "warn", "raise")
+
+_lock = threading.Lock()
+_rank = None            # cached rank; lazy default from MXTPU_WORKER_ID
+_transport = None       # sentinel send callable: fp -> verdict | None
+_last_verdict = None    # last sentinel verdict seen by THIS rank
+_desyncs_seen = 0       # client-side count of not-ok verdicts
+_provider_armed = False
+_server_sections = {}   # server address -> zero-arg section callable
+
+
+class DistDivergenceError(RuntimeError):
+    """Cross-rank desync under ``MXNET_DIST_SENTINEL=raise``."""
+
+
+# ------------------------------------------------------------- rank
+def set_rank(rank):
+    """Pin this process's rank (called by the distributed kvstores at
+    construction).  Arms the ``dist`` flight-recorder provider."""
+    global _rank
+    _rank = int(rank)
+    _arm_provider()
+
+
+def current_rank():
+    """This process's rank: explicit ``set_rank`` wins, else the
+    ``MXTPU_WORKER_ID`` env (cached), else 0."""
+    global _rank
+    r = _rank
+    if r is None:
+        try:
+            r = int(os.environ.get("MXTPU_WORKER_ID", "0") or 0)
+        except ValueError:
+            r = 0
+        _rank = r
+    return r
+
+
+# ---------------------------------------------------- fleet timeline
+def merge_steps(per_rank):
+    """Align per-rank step records by step index into one fleet
+    timeline.
+
+    ``per_rank``: ``{rank: [step records]}`` where each record carries
+    at least ``step`` and the waterfall segments (``perf.waterfalls()``
+    rows or their briefs).  Records without a step index are skipped;
+    a duplicated ``(rank, step)`` keeps the NEWEST record (a restarted
+    rank replays earlier steps — its rerun is the truth).
+
+    Returns a list of rows sorted by step::
+
+        {"step", "n_ranks", "ranks", "wall_s", "stall_s",
+         "critical": {segment: {"rank", "seconds"}},
+         "slowest_rank"}
+
+    ``stall_s`` is the fleet stall for the step (max wall − min wall),
+    chargeable to ``slowest_rank``; ``critical`` names the slowest rank
+    per segment — the fleet can only go as fast as each segment's worst
+    rank on a synchronous step.
+    """
+    by_step = {}
+    for rank, rows in (per_rank or {}).items():
+        rank = int(rank)
+        for rec in rows or ():
+            step = rec.get("step")
+            if step is None:
+                continue
+            by_step.setdefault(int(step), {})[rank] = rec
+    timeline = []
+    for step in sorted(by_step):
+        ranks = by_step[step]
+        walls = {r: float(rec.get("wall_s") or 0.0)
+                 for r, rec in ranks.items()}
+        slowest = max(walls, key=walls.get)
+        row = {
+            "step": step,
+            "n_ranks": len(ranks),
+            "ranks": sorted(ranks),
+            "wall_s": walls[slowest],
+            "stall_s": walls[slowest] - min(walls.values()),
+            "slowest_rank": slowest,
+            "critical": {},
+        }
+        for seg in SEGMENTS:
+            vals = {r: float(rec.get(seg) or 0.0)
+                    for r, rec in ranks.items()}
+            worst = max(vals, key=vals.get)
+            row["critical"][seg] = {"rank": worst,
+                                    "seconds": vals[worst]}
+        timeline.append(row)
+    return timeline
+
+
+def critical_path(timeline):
+    """Cumulative attribution over a merged timeline: per segment, how
+    long each rank spent as the fleet's slowest (seconds + step count,
+    dominant rank first), plus the total fleet stall charged per rank.
+
+    ``ranking`` orders ranks by attributed stall: ``stall_s`` is the sum
+    of (max wall − min wall) over the steps where that rank was slowest,
+    and ``stall_ms_per_step`` spreads it over ALL merged steps — the
+    "rank 2 cost the fleet 180 ms/step" number."""
+    steps = len(timeline)
+    segs = {seg: {} for seg in SEGMENTS}
+    stall = {}
+    for row in timeline:
+        for seg in SEGMENTS:
+            c = row["critical"][seg]
+            agg = segs[seg].setdefault(c["rank"],
+                                       {"seconds": 0.0, "steps": 0})
+            agg["seconds"] += c["seconds"]
+            agg["steps"] += 1
+        agg = stall.setdefault(row["slowest_rank"],
+                               {"stall_s": 0.0, "steps_slowest": 0})
+        agg["stall_s"] += row["stall_s"]
+        agg["steps_slowest"] += 1
+    out = {"steps": steps, "segments": {}, "ranking": []}
+    for seg in SEGMENTS:
+        by_rank = segs[seg]
+        if not by_rank:
+            continue
+        dominant = max(by_rank, key=lambda r: by_rank[r]["seconds"])
+        out["segments"][seg] = {"dominant_rank": dominant,
+                                "by_rank": by_rank}
+    for rank in sorted(stall, key=lambda r: -stall[r]["stall_s"]):
+        agg = stall[rank]
+        out["ranking"].append({
+            "rank": rank,
+            "steps_slowest": agg["steps_slowest"],
+            "stall_s": agg["stall_s"],
+            "stall_ms_per_step": (1e3 * agg["stall_s"] / steps
+                                  if steps else 0.0),
+        })
+    return out
+
+
+def local_steps(n=None):
+    """This process's rank-stamped step briefs (newest last)."""
+    return [perf._waterfall_brief(rec) for rec in perf.waterfalls(n)]
+
+
+# ------------------------------------------- server: round tracking
+def _rounds_capacity():
+    from ..config import get_flag
+    return max(8, get_flag("MXNET_DIST_ROUNDS", 128))
+
+
+class RoundTracker:
+    """Per-rank arrival bookkeeping for the kvstore server's sync
+    rounds.
+
+    A *round* is one cycle of every worker touching the same
+    rendezvous: a push round is keyed by the kvstore key (each worker
+    pushes each key once per step), a barrier round by its generation.
+    ``note()`` records an arrival; when ``expected`` distinct ranks have
+    arrived the round completes and publishes per-rank lateness
+    (arrival − first arrival).  A rank re-arriving while its round is
+    still open means the round will never fill (a peer died or
+    restarted): the stale round is finalized as *incomplete* — nothing
+    is published from partial data — and a fresh round starts from the
+    re-arrival.  History is bounded by ``MXNET_DIST_ROUNDS``."""
+
+    _LATENESS = "kvstore.rank_lateness_ms"
+    _LAST_ARRIVER = "kvstore.round_last_arriver_total"
+
+    def __init__(self, history=None):
+        self._lock = threading.Lock()
+        self._pending = {}          # (kind, key) -> {"t0", "arrivals"}
+        self._recent = collections.deque(
+            maxlen=history or _rounds_capacity())
+        self._totals = {}           # rank -> rounds/lateness aggregates
+        self._rounds = 0
+        self._incomplete = 0
+
+    def note(self, kind, key, rank, expected, now=None):
+        """Record ``rank`` arriving at round ``(kind, key)`` out of
+        ``expected`` workers.  No-op for unknown ranks and 1-worker
+        rounds (nothing to attribute)."""
+        if rank is None or expected < 2:
+            return
+        rank = int(rank)
+        if now is None:
+            now = time.monotonic()
+        rk = (kind, key)
+        with self._lock:
+            cur = self._pending.get(rk)
+            if cur is not None and rank in cur["arrivals"]:
+                self._finalize(rk, cur, complete=False)
+                cur = None
+            if cur is None:
+                cur = {"t0": now, "arrivals": {}}
+                self._pending[rk] = cur
+            cur["arrivals"][rank] = now - cur["t0"]
+            if len(cur["arrivals"]) >= expected:
+                self._finalize(rk, cur, complete=True)
+                del self._pending[rk]
+
+    def _finalize(self, rk, cur, complete):
+        # guarded-by: self._lock (both call sites hold it)
+        self._rounds += 1
+        if not complete:
+            self._incomplete += 1
+            return
+        arrivals = cur["arrivals"]
+        last_rank = max(arrivals, key=arrivals.get)
+        spread = arrivals[last_rank]
+        pub = metrics.enabled()
+        for rank, dt in arrivals.items():
+            agg = self._totals.setdefault(
+                rank, {"rounds": 0, "lateness_s": 0.0,
+                       "last_arrivals": 0})
+            agg["rounds"] += 1
+            agg["lateness_s"] += dt
+            if rank == last_rank:
+                agg["last_arrivals"] += 1
+            if pub:
+                metrics.histogram(
+                    self._LATENESS, labels={"rank": str(rank)},
+                    help="arrival lateness vs the round's first "
+                         "arriver, per completed kvstore sync round"
+                ).observe(dt * 1e3)
+        if pub:
+            metrics.counter(
+                self._LAST_ARRIVER, labels={"rank": str(last_rank)},
+                help="sync rounds this rank arrived last in (the rank "
+                     "the whole fleet waited for)").inc()
+        self._recent.append({
+            "kind": rk[0], "key": rk[1], "last_rank": last_rank,
+            "spread_ms": spread * 1e3,
+            "arrivals_ms": {r: dt * 1e3 for r, dt in arrivals.items()},
+        })
+
+    def summary(self):
+        """Last-arriver ranking + recent rounds (flight recorder /
+        statusz / dist_report).  Ranking is ordered by how often the
+        fleet waited for the rank, then by mean lateness."""
+        with self._lock:
+            totals = {r: dict(a) for r, a in self._totals.items()}
+            recent = list(self._recent)[-8:]
+            rounds, incomplete = self._rounds, self._incomplete
+        ranking = []
+        for rank in sorted(
+                totals,
+                key=lambda r: (-totals[r]["last_arrivals"],
+                               -totals[r]["lateness_s"])):
+            agg = totals[rank]
+            ranking.append({
+                "rank": rank,
+                "rounds": agg["rounds"],
+                "last_arrivals": agg["last_arrivals"],
+                "mean_lateness_ms": (1e3 * agg["lateness_s"]
+                                     / agg["rounds"]),
+            })
+        return {"rounds": rounds, "incomplete": incomplete,
+                "ranking": ranking, "recent": recent}
+
+    def unpublish(self):
+        """Drop this tracker's metric families (server stop)."""
+        metrics.unregister(self._LATENESS)
+        metrics.unregister(self._LAST_ARRIVER)
+
+
+# --------------------------------------------- server: sentinel side
+def _sentinel_tol():
+    try:
+        return float(os.environ.get("MXNET_DIST_SENTINEL_TOL",
+                                    "") or 1e-5)
+    except ValueError:
+        return 1e-5
+
+
+def _sentinel_skew():
+    from ..config import get_flag
+    return get_flag("MXNET_DIST_SENTINEL_SKEW", 2)
+
+
+class SentinelTracker:
+    """Server-side cross-rank fingerprint comparison.
+
+    ``note(fp)`` stores the rank's newest fingerprint
+    (``{"rank", "step", "grad_norm", "param_norm", "loss"}``) and
+    compares it against every peer: same-step fields must agree within
+    the relative tolerance ``MXNET_DIST_SENTINEL_TOL`` (one finite, one
+    non-finite is always a desync; both non-finite is the health
+    plane's problem, not a *divergence*), and step indices must stay
+    within ``MXNET_DIST_SENTINEL_SKEW`` of each other.  Returns the
+    verdict shipped back to the pushing rank."""
+
+    _FIELDS = ("grad_norm", "param_norm", "loss")
+
+    def __init__(self, tol=None, skew=None, log=64):
+        self._lock = threading.Lock()
+        self._latest = {}                       # rank -> fingerprint
+        self._log = collections.deque(maxlen=log)
+        self._tol = _sentinel_tol() if tol is None else float(tol)
+        self._skew = _sentinel_skew() if skew is None else int(skew)
+        self._desyncs = 0
+
+    def _field_desync(self, a, b):
+        if a is None or b is None:
+            return False
+        a, b = float(a), float(b)
+        fa, fb = math.isfinite(a), math.isfinite(b)
+        if not fa and not fb:
+            return False
+        if fa != fb:
+            return True
+        return abs(a - b) > self._tol * max(1.0, abs(a), abs(b))
+
+    def note(self, fp):
+        rank = int(fp.get("rank", -1))
+        step = int(fp.get("step", 0))
+        desync = []
+        with self._lock:
+            self._latest[rank] = dict(fp)
+            for peer, pfp in self._latest.items():
+                if peer == rank:
+                    continue
+                pstep = int(pfp.get("step", 0))
+                if abs(step - pstep) > self._skew:
+                    desync.append({"field": "step", "peer": peer,
+                                   "value": step, "peer_value": pstep})
+                    continue
+                if pstep != step:
+                    continue
+                for field in self._FIELDS:
+                    if self._field_desync(fp.get(field),
+                                          pfp.get(field)):
+                        desync.append({"field": field, "peer": peer,
+                                       "value": fp.get(field),
+                                       "peer_value": pfp.get(field)})
+            if desync:
+                self._desyncs += 1
+                entry = {"step": step, "rank": rank, "desync": desync}
+                self._log.append(entry)
+                if metrics.enabled():
+                    metrics.counter(
+                        "kvstore.sentinel_desync_total",
+                        labels={"rank": str(rank)},
+                        help="per-step fingerprint disagreements this "
+                             "rank was party to (cross-rank divergence)"
+                    ).inc()
+        if desync:
+            return {"ok": False, "step": step, "rank": rank,
+                    "desync": desync}
+        return {"ok": True, "step": step, "rank": rank}
+
+    def summary(self):
+        with self._lock:
+            return {"tol": self._tol, "skew": self._skew,
+                    "desyncs": self._desyncs,
+                    "ranks": {r: dict(fp)
+                              for r, fp in self._latest.items()},
+                    "recent": list(self._log)[-8:]}
+
+    def unpublish(self):
+        metrics.unregister("kvstore.sentinel_desync_total")
+
+
+# --------------------------------------------- client: sentinel side
+def sentinel_policy():
+    """``MXNET_DIST_SENTINEL`` = off (default) | warn | raise."""
+    pol = (os.environ.get("MXNET_DIST_SENTINEL", "") or "off")
+    pol = pol.strip().lower()
+    return pol if pol in _SENTINEL_POLICIES else "off"
+
+
+def arm_sentinel(send):
+    """Install the fingerprint transport (``fp -> verdict``); the
+    distributed kvstores call this at construction with an RPC to
+    shard 0, so every rank's fingerprints meet on one server."""
+    global _transport
+    _transport = send
+    _arm_provider()
+
+
+def disarm_sentinel():
+    global _transport
+    _transport = None
+
+
+def sentinel_armed():
+    return _transport is not None and sentinel_policy() != "off"
+
+
+def sentinel_note(step, grad_norm=None, param_norm=None, loss=None):
+    """Ship this rank's per-step fingerprint and apply the policy to
+    the server's verdict.  One global read when no transport is armed;
+    transport failures are recorded, never raised (a flaky sentinel
+    must not kill a healthy fit)."""
+    global _last_verdict, _desyncs_seen
+    send = _transport
+    if send is None:
+        return None
+    pol = sentinel_policy()
+    if pol == "off":
+        return None
+    fp = {"rank": current_rank(), "step": int(step),
+          "grad_norm": _as_float(grad_norm),
+          "param_norm": _as_float(param_norm),
+          "loss": _as_float(loss)}
+    try:
+        verdict = send(fp)
+    except Exception as exc:  # noqa: BLE001 - observability best-effort
+        flight_recorder.record({"kind": "dist_sentinel_error",
+                                "step": fp["step"], "error": repr(exc)})
+        return None
+    _last_verdict = verdict
+    if isinstance(verdict, dict) and not verdict.get("ok", True):
+        _desyncs_seen += 1
+        msg = ("cross-rank divergence at step %d (rank %d): %s"
+               % (fp["step"], fp["rank"],
+                  json.dumps(verdict.get("desync", []), default=repr)))
+        flight_recorder.record(
+            {"kind": "dist_sentinel", "step": fp["step"],
+             "rank": fp["rank"], "verdict": verdict},
+            anomaly="dist divergence")
+        if pol == "raise":
+            raise DistDivergenceError(msg)
+        logging.warning("MXNET_DIST_SENTINEL: %s", msg)
+    return verdict
+
+
+def sentinel_note_verdict(verdict):
+    """Fingerprint straight off a health ``Verdict`` (the fit loop's
+    call site): the norms were already fetched by the health plane, so
+    this costs zero extra device syncs."""
+    if verdict is None or verdict.step is None:
+        return None
+    return sentinel_note(verdict.step, grad_norm=verdict.grad_norm,
+                         param_norm=verdict.param_norm,
+                         loss=verdict.loss)
+
+
+def _as_float(v):
+    try:
+        return None if v is None else float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+# ------------------------------------------------- provider / statusz
+def register_server(address, section):
+    """A kvstore server contributes its round/sentinel summaries to the
+    ``dist`` section under its address (weakref-style: the callable
+    self-unregisters by returning None once the server is gone)."""
+    with _lock:
+        _server_sections[address] = section
+    _arm_provider()
+
+
+def unregister_server(address):
+    with _lock:
+        _server_sections.pop(address, None)
+
+
+def _arm_provider():
+    global _provider_armed
+    with _lock:
+        if _provider_armed:
+            return
+        _provider_armed = True
+    flight_recorder.register_provider("dist", section)
+
+
+def section():
+    """The ``dist`` flight-recorder / ``/statusz`` provider section:
+    this rank's stamped step ring, its sentinel state, and (when this
+    process hosts kvstore servers) their straggler/sentinel
+    summaries."""
+    out = {"rank": current_rank(), "sentinel_policy": sentinel_policy()}
+    steps = local_steps(16)
+    if steps:
+        out["steps"] = steps
+    if _transport is not None:
+        out["sentinel"] = {"armed": sentinel_armed(),
+                           "desyncs_seen": _desyncs_seen,
+                           "last_verdict": _last_verdict}
+    with _lock:
+        servers = dict(_server_sections)
+    sections = {}
+    for addr, fn in servers.items():
+        try:
+            sec = fn()
+        except Exception as exc:  # noqa: BLE001 - provider best-effort
+            sec = {"error": repr(exc)}
+        if sec is None:
+            unregister_server(addr)
+        else:
+            sections[addr] = sec
+    if sections:
+        out["servers"] = sections
+    return out
+
+
+# ------------------------------------------------- fleet-side helpers
+def statusz_url(url):
+    """Map a worker's scrape url (``.../metrics`` or a bare base) to
+    its ``/statusz``."""
+    if url.endswith("/metrics"):
+        return url[:-len("/metrics")] + "/statusz"
+    return url.rstrip("/") + "/statusz"
+
+
+def fetch_dist_section(url, timeout=5.0, fetch=None):
+    """GET a worker's ``/statusz`` and pull out the ``dist`` provider
+    section (None when the worker doesn't publish one)."""
+    if fetch is None:
+        def fetch(u):
+            with urllib.request.urlopen(u, timeout=timeout) as resp:
+                return resp.read().decode("utf-8", "replace")
+    body = fetch(statusz_url(url))
+    status = json.loads(body)
+    return (status.get("providers") or {}).get("dist")
+
+
+def scrape_fleet_steps(urls, timeout=5.0, fetch=None):
+    """Scrape N workers' ``/statusz`` into ``{rank: [step rows]}``
+    ready for ``merge_steps``.  Unreachable workers are skipped (their
+    absence shows up as ``n_ranks`` < fleet size in the timeline)."""
+    per_rank = {}
+    for url in urls:
+        try:
+            sec = fetch_dist_section(url, timeout=timeout, fetch=fetch)
+        except Exception:  # noqa: BLE001 - scrape best-effort
+            continue
+        if sec and sec.get("steps"):
+            per_rank[int(sec.get("rank", len(per_rank)))] = sec["steps"]
+    return per_rank
+
+
+def reset():
+    """Forget rank, transport, verdicts and server sections (tests)."""
+    global _rank, _transport, _last_verdict, _desyncs_seen
+    with _lock:
+        _rank = None
+        _transport = None
+        _last_verdict = None
+        _desyncs_seen = 0
+        _server_sections.clear()

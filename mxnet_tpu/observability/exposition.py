@@ -91,7 +91,35 @@ def statusz():
         "perf": perf.summary_brief(),
         "engines": rows,
         "providers": sections,
+        "training": _training_section(),
     }
+
+
+def _training_section():
+    """A fit in progress (or recently finished) is scrapeable like a
+    serving worker: its rank, step-waterfall ring tail and health
+    summary ride /statusz (ISSUE 19 satellite).  None when this process
+    never ran a perf-scoped step — serving-only workers stay clean."""
+    from . import dist_trace, flight_recorder, health, perf
+
+    falls = perf.waterfalls(16)
+    if not falls:
+        return None
+    section = {
+        "rank": dist_trace.current_rank(),
+        "steps_recorded": len(falls),
+        "last_step": falls[-1].get("step"),
+        "waterfall": perf._waterfall_brief(falls[-1]),
+        "health_policy": health.policy(),
+        "sentinel_policy": dist_trace.sentinel_policy(),
+    }
+    # the newest per-step health record (grad norms etc.) when the
+    # health plane is recording them
+    for rec in reversed(flight_recorder.snapshot()):
+        if isinstance(rec, dict) and "grad_norm" in rec:
+            section["health"] = rec
+            break
+    return section
 
 
 def healthz():

@@ -232,6 +232,30 @@ class FleetAggregator:
                     if w.status(self.stale_after, self.dead_after)
                     != "dead"]
 
+    def fleet_steps(self):
+        """Scrape every worker's ``/statusz`` ``dist`` section into
+        ``{rank: [rank-stamped step rows]}`` for
+        :func:`dist_trace.merge_steps` — the fleet's notion of a
+        training *step*, where ``scrape_once`` only knows metric
+        families.  Uses the same injectable ``fetch`` as the metric
+        scrapes; unreachable workers are skipped (their absence shows as
+        ``n_ranks`` < fleet size in the merged timeline)."""
+        from . import dist_trace
+
+        with self._lock:
+            urls = [w.url for w in self._workers.values()]
+        return dist_trace.scrape_fleet_steps(urls, fetch=self._fetch)
+
+    def fleet_timeline(self):
+        """The merged fleet step timeline + cumulative critical path
+        (dist_trace) straight off a live scrape: which rank is slowest
+        on data/device/kvstore/host, per step and cumulatively."""
+        from . import dist_trace
+
+        timeline = dist_trace.merge_steps(self.fleet_steps())
+        return {"timeline": timeline,
+                "critical_path": dist_trace.critical_path(timeline)}
+
     def fleet_status(self, window_s=60.0, now=None):
         """The fleet brief: worker table + merged varz over the window
         (flight-recorder / tooling payload)."""

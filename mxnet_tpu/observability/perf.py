@@ -592,6 +592,7 @@ def step_end(step=None):
     cm = _ceilings()
     rec = {
         "step": step,
+        "rank": _dist_rank(),
         "wall_s": wall,
         "data_wait_s": data,
         "device_s": device,
@@ -638,12 +639,22 @@ def last_waterfall():
 
 
 # ----------------------------------------------------------- summaries
+def _dist_rank():
+    # lazy: dist_trace imports perf at module level, so this must not
+    # be a top-level import; sys.modules hit + cached int, ~µs per step
+    from . import dist_trace
+    return dist_trace.current_rank()
+
+
 def _waterfall_brief(rec):
     if rec is None:
         return None
-    return {k: rec[k] for k in ("step", "wall_s", "data_wait_s",
-                                "device_s", "kvstore_s", "host_s",
-                                "mfu_pct", "hbm_util_pct")}
+    brief = {k: rec[k] for k in ("step", "wall_s", "data_wait_s",
+                                 "device_s", "kvstore_s", "host_s",
+                                 "mfu_pct", "hbm_util_pct")}
+    if rec.get("rank") is not None:
+        brief["rank"] = rec["rank"]
+    return brief
 
 
 def summary():

@@ -1,0 +1,342 @@
+"""Distributed-training observability (ISSUE 19): fleet-timeline
+merge/critical-path math, server-side straggler rounds, divergence
+sentinels, and a real 2-worker lateness-attribution run with a per-rank
+``MXNET_FAULTS`` delay rule."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mxnet_tpu.observability import dist_trace, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_dist_state(monkeypatch):
+    monkeypatch.delenv("MXNET_DIST_SENTINEL", raising=False)
+    monkeypatch.delenv("MXNET_DIST_SENTINEL_TOL", raising=False)
+    dist_trace.reset()
+    yield
+    dist_trace.reset()
+
+
+def _row(step, wall, data=0.0, device=0.0, kv=0.0, host=0.0):
+    return {"step": step, "wall_s": wall, "data_wait_s": data,
+            "device_s": device, "kvstore_s": kv, "host_s": host}
+
+
+# ------------------------------------------------------ timeline math
+def test_merge_steps_hand_computed():
+    """3 ranks x 2 steps with known segment times: every merged row's
+    stall, slowest rank, and per-segment critical rank must match the
+    hand calculation."""
+    per_rank = {
+        0: [_row(1, 0.100, data=0.050, device=0.040, host=0.010),
+            _row(2, 0.080, data=0.010, device=0.060, host=0.010)],
+        1: [_row(1, 0.130, data=0.010, device=0.040, kv=0.070,
+                 host=0.010),
+            _row(2, 0.200, data=0.010, device=0.040, kv=0.140,
+                 host=0.010)],
+        2: [_row(1, 0.090, data=0.010, device=0.070, host=0.010),
+            _row(2, 0.085, data=0.010, device=0.065, host=0.010)],
+    }
+    timeline = dist_trace.merge_steps(per_rank)
+    assert [r["step"] for r in timeline] == [1, 2]
+    s1, s2 = timeline
+    assert s1["n_ranks"] == 3 and s1["ranks"] == [0, 1, 2]
+    assert s1["slowest_rank"] == 1
+    assert s1["wall_s"] == pytest.approx(0.130)
+    assert s1["stall_s"] == pytest.approx(0.130 - 0.090)
+    # per-segment critical ranks: data is rank 0's 50ms, device rank
+    # 2's 70ms, kvstore rank 1's 70ms
+    assert s1["critical"]["data_wait_s"] == {"rank": 0,
+                                             "seconds": pytest.approx(0.050)}
+    assert s1["critical"]["device_s"]["rank"] == 2
+    assert s1["critical"]["kvstore_s"] == {"rank": 1,
+                                           "seconds": pytest.approx(0.070)}
+    assert s2["slowest_rank"] == 1
+    assert s2["stall_s"] == pytest.approx(0.200 - 0.080)
+
+    cp = dist_trace.critical_path(timeline)
+    assert cp["steps"] == 2
+    # rank 1 owns the kvstore segment both steps: 70 + 140 ms
+    kv = cp["segments"]["kvstore_s"]
+    assert kv["dominant_rank"] == 1
+    assert kv["by_rank"][1] == {"seconds": pytest.approx(0.210),
+                                "steps": 2}
+    # fleet stall all charged to rank 1: (40 + 120) ms over 2 steps
+    assert cp["ranking"][0]["rank"] == 1
+    assert cp["ranking"][0]["steps_slowest"] == 2
+    assert cp["ranking"][0]["stall_s"] == pytest.approx(0.160)
+    assert cp["ranking"][0]["stall_ms_per_step"] == pytest.approx(80.0)
+
+
+def test_merge_steps_restart_and_gaps():
+    """A restarted rank replays steps (newest record wins), records
+    without a step index are dropped, and a rank missing a step shows
+    up as n_ranks < fleet size rather than poisoning the merge."""
+    per_rank = {
+        0: [_row(1, 0.10), _row(2, 0.10)],
+        # rank 1 restarted: its second step-1 record (0.30 wall) is the
+        # truth; it never reached step 2
+        1: [_row(1, 0.99), {"wall_s": 0.5}, _row(1, 0.30)],
+    }
+    timeline = dist_trace.merge_steps(per_rank)
+    assert [r["step"] for r in timeline] == [1, 2]
+    assert timeline[0]["wall_s"] == pytest.approx(0.30)   # newest, not 0.99
+    assert timeline[0]["slowest_rank"] == 1
+    assert timeline[1]["n_ranks"] == 1 and timeline[1]["ranks"] == [0]
+    assert dist_trace.merge_steps({}) == []
+
+
+# ----------------------------------------------------- round tracking
+def test_round_tracker_names_delayed_rank():
+    """Synthetic arrivals with a fixed 50ms-late rank 2: the ranking
+    must put rank 2 first with mean lateness exactly 50ms, and the
+    lateness histogram must be published while metrics are on."""
+    was = metrics.enabled()
+    metrics.set_enabled(True)
+    tracker = dist_trace.RoundTracker()
+    try:
+        t = 100.0
+        for rnd in range(4):
+            tracker.note("push", "w", 0, 3, now=t)
+            tracker.note("push", "w", 1, 3, now=t + 0.010)
+            tracker.note("push", "w", 2, 3, now=t + 0.050)
+            t += 1.0
+        s = tracker.summary()
+        assert s["rounds"] == 4 and s["incomplete"] == 0
+        assert s["ranking"][0]["rank"] == 2
+        assert s["ranking"][0]["last_arrivals"] == 4
+        assert s["ranking"][0]["mean_lateness_ms"] == pytest.approx(50.0)
+        # first arriver's lateness is 0 by construction
+        by_rank = {r["rank"]: r for r in s["ranking"]}
+        assert by_rank[0]["mean_lateness_ms"] == pytest.approx(0.0)
+        assert by_rank[0]["last_arrivals"] == 0
+        assert s["recent"][-1]["last_rank"] == 2
+        assert s["recent"][-1]["spread_ms"] == pytest.approx(50.0)
+        hist = metrics.get_value("kvstore.rank_lateness_ms",
+                                 labels={"rank": "2"})
+        assert hist is not None
+    finally:
+        tracker.unpublish()
+        metrics.set_enabled(was)
+
+
+def test_round_tracker_restart_tolerance():
+    """A rank re-arriving at a still-open round means a peer died or a
+    worker restarted mid-round: the stale round finalizes as incomplete
+    (publishing nothing) and the re-arrival opens a fresh round."""
+    tracker = dist_trace.RoundTracker()
+    tracker.note("push", "w", 0, 2, now=10.0)
+    # rank 1 never shows; rank 0 pushes again (restarted worker)
+    tracker.note("push", "w", 0, 2, now=11.0)
+    tracker.note("push", "w", 1, 2, now=11.5)       # fresh round completes
+    s = tracker.summary()
+    assert s["rounds"] == 2 and s["incomplete"] == 1
+    # only the COMPLETE round contributed attribution
+    assert {r["rank"]: r["rounds"] for r in s["ranking"]} == {0: 1, 1: 1}
+    assert s["ranking"][0]["rank"] == 1
+    assert s["ranking"][0]["mean_lateness_ms"] == pytest.approx(500.0)
+    # 1-worker rounds and unknown ranks are no-ops, not rounds
+    tracker.note("push", "w", 0, 1, now=12.0)
+    tracker.note("push", "w", None, 2, now=12.0)
+    assert tracker.summary()["rounds"] == 2
+
+
+# --------------------------------------------------------- sentinels
+def test_sentinel_silent_on_bit_exact_ranks():
+    tracker = dist_trace.SentinelTracker(tol=1e-5, skew=2)
+    for step in range(1, 6):
+        for rank in (0, 1, 2):
+            v = tracker.note({"rank": rank, "step": step,
+                              "grad_norm": 1.25, "param_norm": 40.0,
+                              "loss": 0.75})
+            assert v["ok"], v
+    assert tracker.summary()["desyncs"] == 0
+
+
+def test_sentinel_fires_on_one_rank_perturbation():
+    """Identical fingerprints for 3 steps, then rank 1 diverges by 1%
+    in grad_norm: flagged within that very step, exactly once, naming
+    the field; a tiny within-tolerance wobble stays silent."""
+    tracker = dist_trace.SentinelTracker(tol=1e-5, skew=2)
+    for step in range(1, 4):
+        tracker.note({"rank": 0, "step": step, "grad_norm": 2.0,
+                      "param_norm": 10.0, "loss": 0.5})
+        tracker.note({"rank": 1, "step": step, "grad_norm": 2.0,
+                      "param_norm": 10.0, "loss": 0.5})
+    # within tolerance: silent
+    v = tracker.note({"rank": 0, "step": 4, "grad_norm": 2.0,
+                      "param_norm": 10.0, "loss": 0.5})
+    v = tracker.note({"rank": 1, "step": 4,
+                      "grad_norm": 2.0 * (1 + 1e-7),
+                      "param_norm": 10.0, "loss": 0.5})
+    assert v["ok"], v
+    # 1% divergence: fires on the diverged step
+    tracker.note({"rank": 0, "step": 5, "grad_norm": 2.0,
+                  "param_norm": 10.0, "loss": 0.5})
+    v = tracker.note({"rank": 1, "step": 5, "grad_norm": 2.02,
+                      "param_norm": 10.0, "loss": 0.5})
+    assert not v["ok"]
+    assert v["desync"] == [{"field": "grad_norm", "peer": 0,
+                            "value": 2.02, "peer_value": 2.0}]
+    s = tracker.summary()
+    assert s["desyncs"] == 1
+    assert s["recent"][-1]["step"] == 5
+
+
+def test_sentinel_step_skew_and_nonfinite():
+    tracker = dist_trace.SentinelTracker(tol=1e-5, skew=2)
+    tracker.note({"rank": 0, "step": 10, "grad_norm": 1.0})
+    # skew 2 steps: fine (async ranks drift a little)
+    v = tracker.note({"rank": 1, "step": 12, "grad_norm": 1.0})
+    assert v["ok"]
+    # skew 5 steps: a rank fell off the pace entirely
+    v = tracker.note({"rank": 1, "step": 15, "grad_norm": 1.0})
+    assert not v["ok"] and v["desync"][0]["field"] == "step"
+    # one rank NaN while a peer is finite IS a divergence
+    tracker.note({"rank": 0, "step": 20, "grad_norm": 1.0})
+    v = tracker.note({"rank": 1, "step": 20, "grad_norm": float("nan")})
+    assert not v["ok"] and v["desync"][0]["field"] == "grad_norm"
+
+
+def test_sentinel_note_policies(monkeypatch):
+    """Client side: off -> no send; warn -> verdict recorded, no raise;
+    raise -> DistDivergenceError on a desync verdict; transport errors
+    never propagate."""
+    sent = []
+
+    def transport(fp):
+        sent.append(fp)
+        return {"ok": fp["step"] != 13, "step": fp["step"],
+                "rank": fp["rank"], "desync": []}
+
+    dist_trace.set_rank(3)
+    dist_trace.arm_sentinel(transport)
+    assert not dist_trace.sentinel_armed()          # policy off
+    assert dist_trace.sentinel_note(1, grad_norm=1.0) is None
+    assert sent == []
+
+    monkeypatch.setenv("MXNET_DIST_SENTINEL", "warn")
+    assert dist_trace.sentinel_armed()
+    v = dist_trace.sentinel_note(1, grad_norm=1.0, param_norm=2.0,
+                                 loss=0.1)
+    assert v["ok"] and sent[-1] == {"rank": 3, "step": 1,
+                                    "grad_norm": 1.0, "param_norm": 2.0,
+                                    "loss": 0.1}
+    v = dist_trace.sentinel_note(13, grad_norm=1.0)  # warn: no raise
+    assert not v["ok"]
+
+    monkeypatch.setenv("MXNET_DIST_SENTINEL", "raise")
+    with pytest.raises(dist_trace.DistDivergenceError):
+        dist_trace.sentinel_note(13, grad_norm=1.0)
+
+    def broken(fp):
+        raise ConnectionError("shard down")
+
+    dist_trace.arm_sentinel(broken)
+    assert dist_trace.sentinel_note(14, grad_norm=1.0) is None
+
+
+def test_section_carries_steps_servers_and_sentinel(monkeypatch):
+    from mxnet_tpu.observability import perf
+
+    monkeypatch.setenv("MXNET_DIST_SENTINEL", "warn")
+    dist_trace.set_rank(2)
+    dist_trace.arm_sentinel(lambda fp: {"ok": True, "step": fp["step"],
+                                        "rank": fp["rank"]})
+    dist_trace.register_server("host:1", lambda: {"rounds": {}})
+    perf.reset()
+    try:
+        perf.step_begin()
+        perf.note_data_wait(0.001)
+        perf.step_end(step=7)
+        dist_trace.sentinel_note(7, grad_norm=1.0)
+        sec = dist_trace.section()
+        assert sec["rank"] == 2
+        assert sec["sentinel_policy"] == "warn"
+        assert sec["steps"][-1]["step"] == 7
+        assert sec["steps"][-1]["rank"] == 2        # rank-stamped ring
+        assert sec["sentinel"]["armed"]
+        assert sec["sentinel"]["last_verdict"]["ok"]
+        assert sec["servers"] == {"host:1": {"rounds": {}}}
+        # a dead server's section callable self-unregisters
+        dist_trace.register_server("host:2", lambda: None)
+        sec = dist_trace.section()
+        assert "host:2" not in sec.get("servers", {})
+    finally:
+        perf.reset()
+
+
+# ------------------------------------- end-to-end lateness attribution
+_DELAY_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %(repo)r)
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.ones((2, 2)))
+    for _ in range(%(steps)d):
+        kv.push("w", mx.nd.ones((2, 2)))
+        kv.barrier()
+    kv.close()
+    print("DELAY_WORKER_OK", kv.rank)
+""")
+
+
+def test_lateness_attribution_names_delayed_rank():
+    """2 real worker processes against an in-process server; ONLY rank
+    1's environment carries a ``MXNET_FAULTS`` kvstore.push delay rule
+    (fault state is process-global, so per-rank targeting is per-process
+    env).  The server's last-arriver ranking must name rank 1 with mean
+    lateness in the injected ballpark."""
+    from mxnet_tpu.kvstore_server import start_server_thread
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    steps, delay_ms = 4, 50
+    script = _DELAY_WORKER % {"repo": repo, "steps": steps}
+    os.environ["MXTPU_NUM_WORKERS"] = "2"
+    server = start_server_thread()
+    procs = []
+    try:
+        for rank in range(2):
+            env = dict(os.environ,
+                       MXTPU_PS_ADDR=server.address,
+                       MXTPU_WORKER_ID=str(rank),
+                       MXTPU_NUM_WORKERS="2",
+                       JAX_PLATFORMS="cpu")
+            env.pop("MXNET_FAULTS", None)
+            if rank == 1:
+                env["MXNET_FAULTS"] = ("kvstore.push:delay=%d@p=1"
+                                       % delay_ms)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        outs = []
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode())
+            assert p.returncode == 0, "worker %d:\n%s" % (i, outs[-1])
+            assert "DELAY_WORKER_OK" in outs[-1]
+        s = server._dist_rounds.summary()
+        # push + barrier round per step, all complete
+        assert s["rounds"] >= 2 * steps, s
+        assert s["ranking"][0]["rank"] == 1, s
+        assert (s["ranking"][0]["last_arrivals"]
+                >= s["rounds"] - s["incomplete"] - 2), s
+        assert (delay_ms * 0.5
+                <= s["ranking"][0]["mean_lateness_ms"]
+                <= delay_ms * 10), s
+        dist = server._dist_summary()
+        assert dist["rounds"]["ranking"][0]["rank"] == 1
+        assert json.dumps(dist)            # statusz-serializable
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(30)
+        server.stop()
+        os.environ.pop("MXTPU_NUM_WORKERS", None)

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Fast CPU smoke of the distributed-training observability plane
+(tier-1 CI guard, ISSUE 19).
+
+One REAL parameter-server shard in the parent + two REAL worker
+processes over TCP.  Worker 1 carries a seeded ``MXNET_FAULTS``
+``delay=`` rule on ``kvstore.push`` (fault state is process-global, so
+per-rank targeting is per-process env — exactly how a genuinely slow
+host presents).  Each worker runs perf-scoped sync steps
+(push → pull inside the step scope, barrier between steps) against the
+shared shard, ships per-step sentinel fingerprints, and exports its
+rank-stamped waterfall ring through ``/statusz``.  The smoke verifies
+the cross-rank story end to end:
+
+1. **Straggler attribution** — the server's RoundTracker names rank 1
+   as the dominant last-arriver with mean round lateness matching the
+   injected delay within tolerance, and the
+   ``kvstore.rank_lateness_ms{rank="1"}`` histogram carries the
+   observations.
+2. **Fleet timeline** — scraping both workers' ``/statusz`` over HTTP
+   and merging by step index yields a timeline where every step has
+   both ranks and the kvstore critical-path segment belongs to rank 1
+   with roughly the injected delay.
+3. **Divergence sentinel** — the bit-identical steps stay silent; ONE
+   deliberately perturbed fingerprint from rank 1 is flagged within
+   that step (exactly one desync recorded).
+4. **Chrome trace** — tools/dist_report.py renders the merged run into
+   one trace with a track per rank.
+5. **Clean teardown** — workers exit 0 with no leaked ``mxnet-``
+   threads; the parent's shard stops without leaving threads either.
+
+Usage: ``python tools/dist_obs_smoke.py [summary.json]`` (parent mode);
+``--worker <portfile> <rank>`` is the internal child entry point.
+
+Prints a one-line JSON summary (optionally written to argv[1]); any
+violation raises, failing the CI step.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 8
+DELAY_MS = 60
+KEY = "w"
+
+
+# --------------------------------------------------------------- worker
+def worker_main(portfile, rank):
+    """Child process: real dist_async kvstore over TCP, perf-scoped
+    sync steps, sentinel fingerprints, /statusz exposition."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.observability import dist_trace, exposition, perf
+
+    kv = mx.kv.create("dist_async")
+    assert kv.rank == rank, (kv.rank, rank)
+    kv.init(KEY, mx.nd.ones((4, 4)))
+    port = exposition.start_http(0)
+
+    stopfile = portfile + ".stop"
+    tmp = portfile + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"port": port, "pid": os.getpid()}, f)
+    os.rename(tmp, portfile)     # atomic: the parent polls for this
+
+    grad = mx.nd.ones((4, 4))
+    out = mx.nd.zeros((4, 4))
+    for step in range(1, STEPS + 1):
+        perf.step_begin()
+        kv.push(KEY, grad)       # rank 1's MXNET_FAULTS delay fires here
+        kv.pull(KEY, out=out)
+        perf.step_end(step=step)
+        # identical fingerprints across ranks: must stay silent
+        dist_trace.sentinel_note(step, grad_norm=1.0, param_norm=4.0,
+                                 loss=0.5)
+        kv.barrier()             # lockstep: rounds stay aligned
+    # ONE perturbed fingerprint from rank 1: must be flagged within
+    # this step (warn policy logs; the server records the desync)
+    dist_trace.sentinel_note(STEPS + 1,
+                             grad_norm=(5.0 if rank == 1 else 1.0),
+                             param_norm=4.0, loss=0.5)
+    kv.barrier()
+
+    # hold the exposition plane up until the parent has scraped us
+    deadline = time.monotonic() + 120.0
+    while not os.path.exists(stopfile):
+        if time.monotonic() > deadline:
+            raise AssertionError("parent never released worker %d" % rank)
+        time.sleep(0.05)
+    kv.close()
+    exposition.stop_http()
+    leftovers = [t.name for t in threading.enumerate()
+                 if t.name.startswith("mxnet-") and not t.daemon]
+    assert not leftovers, "worker %d leaked threads: %r" % (rank, leftovers)
+    print("DIST_WORKER_OK rank=%d" % rank)
+
+
+# --------------------------------------------------------------- parent
+def _require(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+def _spawn_worker(tmpdir, rank, server_addr):
+    portfile = os.path.join(tmpdir, "worker%d.port" % rank)
+    env = dict(os.environ,
+               MXNET_TELEMETRY="1",
+               MXNET_DIST_SENTINEL="warn",
+               MXTPU_PS_ADDR=server_addr,
+               MXTPU_WORKER_ID=str(rank),
+               MXTPU_NUM_WORKERS="2")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MXNET_FAULTS", None)
+    if rank == 1:
+        # the injected straggler: every push pays DELAY_MS client-side,
+        # so its pushes/barriers arrive late at the shared shard
+        env["MXNET_FAULTS"] = "kvstore.push:delay=%d@p=1" % DELAY_MS
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", portfile,
+         str(rank)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    return proc, portfile
+
+
+def _wait_portfile(proc, portfile, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError("worker exited rc=%d before binding:\n%s"
+                                 % (proc.returncode,
+                                    proc.stdout.read().decode()))
+        if os.path.exists(portfile):
+            with open(portfile) as f:
+                return json.load(f)
+        time.sleep(0.05)
+    raise AssertionError("worker portfile never appeared: %s" % portfile)
+
+
+def main(out_path=None):
+    from mxnet_tpu.observability import dist_trace, metrics
+    from mxnet_tpu.kvstore_server import start_server_thread
+
+    try:
+        import dist_report
+    except ImportError:
+        from tools import dist_report
+
+    metrics.set_enabled(True)
+    os.environ.setdefault("MXTPU_NUM_WORKERS", "2")
+    tmpdir = tempfile.mkdtemp(prefix="dist_obs_smoke_")
+    server = start_server_thread()
+    procs = []
+    summary = {}
+    try:
+        workers = []
+        for rank in range(2):
+            proc, portfile = _spawn_worker(tmpdir, rank, server.address)
+            procs.append(proc)
+            workers.append((rank, proc, portfile))
+        urls = {}
+        for rank, proc, portfile in workers:
+            info = _wait_portfile(proc, portfile)
+            urls[rank] = "http://127.0.0.1:%d/metrics" % info["port"]
+
+        # workers stop stepping once their perturbed fingerprint lands;
+        # poll the shard until both ranks' final barrier round completed
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            rounds = server._dist_rounds.summary()
+            if rounds["rounds"] >= 2 * STEPS + 1:
+                break
+            time.sleep(0.1)
+
+        # ---- 2. fleet timeline over real HTTP scrapes -----------------
+        per_rank = dist_trace.scrape_fleet_steps(urls.values())
+        _require(sorted(per_rank) == [0, 1],
+                 "scrape must yield both ranks, got %r" % sorted(per_rank))
+        timeline = dist_trace.merge_steps(per_rank)
+        _require(len(timeline) == STEPS,
+                 "expected %d merged steps, got %d"
+                 % (STEPS, len(timeline)))
+        _require(all(row["n_ranks"] == 2 for row in timeline),
+                 "every step must carry both ranks: %r" % (timeline,))
+        cp = dist_trace.critical_path(timeline)
+        kv_seg = cp["segments"]["kvstore_s"]
+        _require(kv_seg["dominant_rank"] == 1,
+                 "kvstore critical path must name the delayed rank: %r"
+                 % (kv_seg,))
+        kv_ms_per_step = (1e3 * kv_seg["by_rank"][1]["seconds"]
+                          / max(1, kv_seg["by_rank"][1]["steps"]))
+        _require(DELAY_MS * 0.6 <= kv_ms_per_step <= DELAY_MS * 8,
+                 "kvstore critical segment %.1fms/step vs injected %dms"
+                 % (kv_ms_per_step, DELAY_MS))
+        _require(cp["ranking"] and cp["ranking"][0]["rank"] == 1,
+                 "stall attribution must rank the delayed rank first: %r"
+                 % (cp["ranking"],))
+
+        # ---- 1. server-side straggler attribution ---------------------
+        rounds = server._dist_rounds.summary()
+        _require(rounds["rounds"] >= 2 * STEPS,
+                 "too few completed rounds: %r" % (rounds,))
+        ranking = rounds["ranking"]
+        _require(ranking and ranking[0]["rank"] == 1,
+                 "last-arriver ranking must name rank 1: %r" % (ranking,))
+        _require(ranking[0]["last_arrivals"]
+                 >= rounds["rounds"] - rounds["incomplete"] - 2,
+                 "delayed rank should lose nearly every round: %r"
+                 % (rounds,))
+        lateness = ranking[0]["mean_lateness_ms"]
+        _require(DELAY_MS * 0.5 <= lateness <= DELAY_MS * 8,
+                 "mean lateness %.1fms vs injected %dms"
+                 % (lateness, DELAY_MS))
+        hist = metrics.get_value("kvstore.rank_lateness_ms",
+                                 labels={"rank": "1"})
+        _require(hist is not None,
+                 "kvstore.rank_lateness_ms{rank=1} not published")
+
+        # ---- 3. divergence sentinel -----------------------------------
+        sentinel = server._dist_sentinel.summary()
+        _require(sentinel["desyncs"] == 1,
+                 "exactly the perturbed step must desync, got %r"
+                 % (sentinel,))
+        entry = sentinel["recent"][-1]
+        _require(entry["step"] == STEPS + 1
+                 and any(d["field"] == "grad_norm"
+                         for d in entry["desync"]),
+                 "desync must flag grad_norm at step %d: %r"
+                 % (STEPS + 1, entry))
+
+        # ---- 4. chrome trace has both rank tracks ---------------------
+        trace = dist_report.chrome_trace(per_rank, timeline)
+        pids = {ev["pid"] for ev in trace["traceEvents"]}
+        _require(pids == {0, 1},
+                 "chrome trace must carry both rank tracks: %r" % (pids,))
+        trace_path = os.path.join(tmpdir, "fleet_trace.json")
+        with open(trace_path, "w") as f:
+            json.dump(trace, f)
+
+        # ---- 5. clean teardown ----------------------------------------
+        for rank, proc, portfile in workers:
+            with open(portfile + ".stop", "w") as f:
+                f.write("done")
+        outs = []
+        for rank, proc, portfile in workers:
+            out, _ = proc.communicate(timeout=120)
+            outs.append(out.decode())
+            _require(proc.returncode == 0,
+                     "worker %d failed rc=%d:\n%s"
+                     % (rank, proc.returncode, outs[-1]))
+            _require("DIST_WORKER_OK" in outs[-1],
+                     "worker %d missing OK line:\n%s" % (rank, outs[-1]))
+        server.stop()
+        time.sleep(0.2)
+        leftovers = [t.name for t in threading.enumerate()
+                     if t.name.startswith("mxnet-")]
+        _require(not leftovers, "parent leaked threads: %r" % (leftovers,))
+
+        summary = {
+            "workers": 2,
+            "steps_merged": len(timeline),
+            "rounds": rounds["rounds"],
+            "rounds_incomplete": rounds["incomplete"],
+            "straggler_rank": ranking[0]["rank"],
+            "mean_lateness_ms": round(lateness, 2),
+            "kvstore_critical_ms_per_step": round(kv_ms_per_step, 2),
+            "injected_delay_ms": DELAY_MS,
+            "sentinel_desyncs": sentinel["desyncs"],
+            "chrome_trace": trace_path,
+            "ok": True,
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(30)
+        server.stop()
+
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
+        worker_main(sys.argv[2], int(sys.argv[3]))
+    else:
+        main(sys.argv[1] if len(sys.argv) > 1 else None)

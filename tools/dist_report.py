@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Fleet step-timeline, straggler and divergence reports (ISSUE 19).
+
+Renders what observability/dist_trace.py collects:
+
+* **Merged timeline** — N workers' rank-stamped step waterfalls aligned
+  by step index, with the per-segment critical path (which rank was
+  slowest on data-wait / device / kvstore / host, per step).
+* **Straggler table** — the cumulative critical path plus every kvstore
+  shard's last-arriver ranking and per-rank round lateness
+  (``RoundTracker``): "rank 2 cost the fleet 180 ms/step" as a table
+  row.
+* **Divergence log** — the sentinel desync entries
+  (``SentinelTracker``) across all scraped shards.
+* **Chrome trace** (``--chrome out.json``) — one trace with a track
+  (pid) per rank and per-step flow arrows linking the ranks' step
+  starts, so the fleet's lockstep (or lack of it) is visible in
+  Perfetto next to the single-process profiler dumps.
+
+Inputs (mix freely; each contributes the ranks/servers it knows):
+
+    python tools/dist_report.py rank0_statusz.json rank1_statusz.json
+    python tools/dist_report.py merged.json --chrome fleet_trace.json
+    python tools/dist_report.py --live http://h:p0 http://h:p1
+    python tools/dist_report.py --compare runA.json runB.json
+
+Accepted file shapes: a ``/statusz`` capture or flight-recorder dump
+(the ``providers.dist`` section is extracted), a raw ``dist`` section
+(``{"rank", "steps", ...}``), or a merged run written by ``--save``
+(``{"per_rank": {rank: [...]}, "servers": {...}}``).
+
+``trace_report.py --compare A B --dist`` reuses :func:`compare_dist`
+for per-rank segment deltas and straggler-ranking drift between two
+runs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+from mxnet_tpu.observability import dist_trace  # noqa: E402
+
+SEGMENTS = dist_trace.SEGMENTS
+
+
+# ------------------------------------------------------------ loading
+def _dist_section_of(payload):
+    """The dist section buried in a statusz capture / flight dump, or
+    the payload itself when it already looks like one."""
+    if not isinstance(payload, dict):
+        return None
+    providers = payload.get("providers")
+    if isinstance(providers, dict) and "dist" in providers:
+        return providers["dist"]
+    if "steps" in payload or "servers" in payload or "rank" in payload:
+        return payload
+    return None
+
+
+def load_run(spec):
+    """One *run* = ``{"per_rank": {rank: [step rows]},
+    "servers": {addr: server section}}`` from a file spec (see module
+    docstring) or a live url list is assembled by the caller."""
+    with open(spec) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict) and "per_rank" in payload:
+        per_rank = {int(r): rows
+                    for r, rows in payload["per_rank"].items()}
+        return {"per_rank": per_rank,
+                "servers": payload.get("servers") or {}}
+    run = {"per_rank": {}, "servers": {}}
+    sec = _dist_section_of(payload)
+    if sec is None:
+        raise SystemExit("%s: no dist section found (expected a statusz "
+                         "capture, flight dump, dist section or --save "
+                         "output)" % spec)
+    _merge_section(run, sec)
+    return run
+
+
+def _merge_section(run, sec):
+    steps = sec.get("steps")
+    if steps:
+        run["per_rank"][int(sec.get("rank", len(run["per_rank"])))] = steps
+    for addr, server in (sec.get("servers") or {}).items():
+        run["servers"][addr] = server
+
+
+def collect(specs):
+    """Merge N file specs into one run (each file contributes the ranks
+    and server shards it knows about)."""
+    run = {"per_rank": {}, "servers": {}}
+    for spec in specs:
+        other = load_run(spec)
+        run["per_rank"].update(other["per_rank"])
+        run["servers"].update(other["servers"])
+    return run
+
+
+def collect_live(urls, timeout=5.0):
+    """Scrape live workers' /statusz into a run."""
+    run = {"per_rank": {}, "servers": {}}
+    for url in urls:
+        sec = dist_trace.fetch_dist_section(url, timeout=timeout)
+        if sec:
+            _merge_section(run, sec)
+    return run
+
+
+# ---------------------------------------------------------- rendering
+def _ms(seconds):
+    return "%8.2f" % (seconds * 1e3)
+
+
+def format_timeline(timeline):
+    if not timeline:
+        return "merged timeline: no overlapping steps"
+    lines = ["fleet step timeline (%d steps, critical rank per segment)"
+             % len(timeline),
+             "%6s %6s %9s %9s  %s" % ("step", "ranks", "wall_ms",
+                                      "stall_ms", "critical path")]
+    for row in timeline:
+        crit = "  ".join(
+            "%s:r%d(%sms)" % (seg.replace("_s", ""),
+                              row["critical"][seg]["rank"],
+                              _ms(row["critical"][seg]["seconds"]).strip())
+            for seg in SEGMENTS)
+        lines.append("%6d %6d %s %s  %s"
+                     % (row["step"], row["n_ranks"], _ms(row["wall_s"]),
+                        _ms(row["stall_s"]), crit))
+    return "\n".join(lines)
+
+
+def format_straggler(cp, servers):
+    lines = ["cumulative critical path (%d merged steps)" % cp["steps"]]
+    for seg in SEGMENTS:
+        info = cp["segments"].get(seg)
+        if info is None:
+            continue
+        by_rank = ", ".join(
+            "r%d %.1fms/%dstep" % (r, a["seconds"] * 1e3, a["steps"])
+            for r, a in sorted(info["by_rank"].items()))
+        lines.append("  %-12s dominant=r%d  (%s)"
+                     % (seg, info["dominant_rank"], by_rank))
+    if cp["ranking"]:
+        lines.append("fleet stall attribution (slowest-rank wall):")
+        lines.append("  %4s %14s %10s %14s"
+                     % ("rank", "steps_slowest", "stall_ms",
+                        "stall_ms/step"))
+        for row in cp["ranking"]:
+            lines.append("  %4d %14d %10.2f %14.3f"
+                         % (row["rank"], row["steps_slowest"],
+                            row["stall_s"] * 1e3,
+                            row["stall_ms_per_step"]))
+    for addr, server in sorted((servers or {}).items()):
+        rounds = (server or {}).get("rounds") or {}
+        ranking = rounds.get("ranking") or []
+        if not ranking:
+            continue
+        lines.append("server %s: %d rounds (%d incomplete), "
+                     "last-arriver ranking:"
+                     % (addr, rounds.get("rounds", 0),
+                        rounds.get("incomplete", 0)))
+        lines.append("  %4s %8s %14s %18s"
+                     % ("rank", "rounds", "last_arrivals",
+                        "mean_lateness_ms"))
+        for row in ranking:
+            lines.append("  %4d %8d %14d %18.3f"
+                         % (row["rank"], row["rounds"],
+                            row["last_arrivals"],
+                            row["mean_lateness_ms"]))
+    return "\n".join(lines)
+
+
+def format_divergence(servers):
+    entries = []
+    for addr, server in sorted((servers or {}).items()):
+        sentinel = (server or {}).get("sentinel") or {}
+        for entry in sentinel.get("recent") or []:
+            entries.append((addr, entry))
+    if not entries:
+        return "divergence log: clean (no sentinel desyncs recorded)"
+    lines = ["divergence log (%d recent desyncs):" % len(entries)]
+    for addr, entry in entries:
+        for d in entry.get("desync", []):
+            lines.append(
+                "  step %-5d rank %d vs rank %s  %-10s %r != %r  [%s]"
+                % (entry.get("step", -1), entry.get("rank", -1),
+                   d.get("peer"), d.get("field"), d.get("value"),
+                   d.get("peer_value"), addr))
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------- chrome trace
+def chrome_trace(per_rank, timeline=None):
+    """One chrome://tracing JSON with a track (pid) per rank.
+
+    Step records only carry durations, so the fleet clock is synthetic:
+    step ``s`` starts where the fleet's slowest rank finished step
+    ``s-1`` (lockstep render — exactly the synchronous-training model
+    the critical path assumes).  Per-step flow arrows (``ph: s/f``, the
+    profiler's flow-event machinery) link the lowest rank's step start
+    to every other rank's, making cross-rank alignment scrubbable."""
+    if timeline is None:
+        timeline = dist_trace.merge_steps(per_rank)
+    by_step = {row["step"]: row for row in timeline}
+    events = []
+    for rank in sorted(per_rank):
+        events.append({"ph": "M", "pid": rank, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": "rank %d" % rank}})
+    clock_us = {}          # step -> fleet start (us)
+    t = 0.0
+    for row in timeline:
+        clock_us[row["step"]] = t
+        t += row["wall_s"] * 1e6
+    for rank, rows in sorted(per_rank.items()):
+        for rec in rows:
+            step = rec.get("step")
+            if step is None or step not in clock_us:
+                continue
+            t0 = clock_us[step]
+            anchor = min(by_step[step]["ranks"])
+            if rank == anchor:
+                events.append({"ph": "s", "pid": rank, "tid": 0,
+                               "cat": "dist", "name": "step",
+                               "id": step, "ts": t0})
+            else:
+                events.append({"ph": "f", "pid": rank, "tid": 0,
+                               "cat": "dist", "name": "step",
+                               "id": step, "ts": t0, "bp": "e"})
+            cursor = t0
+            for seg in SEGMENTS:
+                dur = float(rec.get(seg) or 0.0) * 1e6
+                events.append({"ph": "X", "pid": rank, "tid": 0,
+                               "cat": "dist",
+                               "name": seg.replace("_s", ""),
+                               "ts": cursor, "dur": dur,
+                               "args": {"step": step, "rank": rank}})
+                cursor += dur
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------------- compare
+def _run_profile(run):
+    """Per-rank per-segment mean ms + straggler ranking for one run."""
+    per_rank = run["per_rank"]
+    timeline = dist_trace.merge_steps(per_rank)
+    cp = dist_trace.critical_path(timeline)
+    segs = {}
+    for rank, rows in per_rank.items():
+        rows = [r for r in rows if r.get("step") is not None]
+        if not rows:
+            continue
+        segs[rank] = {seg: (1e3 * sum(float(r.get(seg) or 0.0)
+                                      for r in rows) / len(rows))
+                      for seg in SEGMENTS + ("wall_s",)}
+        segs[rank]["steps"] = len(rows)
+    return {"segments_ms": segs,
+            "ranking": [r["rank"] for r in cp["ranking"]],
+            "stall_ms_per_step": {r["rank"]: r["stall_ms_per_step"]
+                                  for r in cp["ranking"]}}
+
+
+def compare_dist(spec_a, spec_b):
+    """Per-rank segment deltas + straggler-ranking drift between two
+    runs (b minus a; positive = b slower).  The hook behind
+    ``trace_report.py --compare A B --dist``."""
+    a, b = _run_profile(load_run(spec_a)), _run_profile(load_run(spec_b))
+    ranks = sorted(set(a["segments_ms"]) & set(b["segments_ms"]))
+    deltas = {}
+    for rank in ranks:
+        deltas[rank] = {
+            seg: {"a_ms": a["segments_ms"][rank][seg],
+                  "b_ms": b["segments_ms"][rank][seg],
+                  "delta_ms": (b["segments_ms"][rank][seg]
+                               - a["segments_ms"][rank][seg])}
+            for seg in SEGMENTS + ("wall_s",)}
+    return {
+        "ranks": ranks,
+        "only_a": sorted(set(a["segments_ms"]) - set(b["segments_ms"])),
+        "only_b": sorted(set(b["segments_ms"]) - set(a["segments_ms"])),
+        "deltas": deltas,
+        "ranking_a": a["ranking"],
+        "ranking_b": b["ranking"],
+        "ranking_drift": a["ranking"] != b["ranking"],
+        "stall_ms_per_step_a": a["stall_ms_per_step"],
+        "stall_ms_per_step_b": b["stall_ms_per_step"],
+    }
+
+
+def format_compare_dist(cmp, spec_a="A", spec_b="B"):
+    lines = ["dist compare — %s vs %s (b−a; positive = b slower)"
+             % (spec_a, spec_b)]
+    for rank in cmp["ranks"]:
+        cells = "  ".join(
+            "%s %+.2fms" % (seg.replace("_s", ""),
+                            cmp["deltas"][rank][seg]["delta_ms"])
+            for seg in SEGMENTS + ("wall_s",))
+        lines.append("  rank %d: %s" % (rank, cells))
+    for key in ("only_a", "only_b"):
+        if cmp[key]:
+            lines.append("  ranks in %s only: %s"
+                         % (key[-1].upper(), cmp[key]))
+    lines.append("  straggler ranking: %s -> %s%s"
+                 % (cmp["ranking_a"], cmp["ranking_b"],
+                    "  (DRIFT)" if cmp["ranking_drift"] else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- CLI
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet step timeline / straggler / divergence "
+                    "report over dist_trace captures")
+    ap.add_argument("sources", nargs="*",
+                    help="statusz captures, flight dumps, dist sections "
+                         "or --save outputs (each contributes the ranks "
+                         "it knows)")
+    ap.add_argument("--live", nargs="+", metavar="URL",
+                    help="scrape live workers' /statusz instead of "
+                         "reading files")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="write the merged per-rank chrome trace here")
+    ap.add_argument("--save", metavar="OUT",
+                    help="write the merged run (per_rank + servers) as "
+                         "JSON for later --compare")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    help="per-rank segment deltas + straggler-ranking "
+                         "drift between two runs")
+    ap.add_argument("--json", action="store_true",
+                    help="emit JSON instead of tables")
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        cmp = compare_dist(*args.compare)
+        print(json.dumps(cmp, indent=1) if args.json
+              else format_compare_dist(cmp, *args.compare))
+        return 0
+    if args.live:
+        run = collect_live(args.live)
+    elif args.sources:
+        run = collect(args.sources)
+    else:
+        ap.error("sources required (or --live URLs / --compare A B)")
+    timeline = dist_trace.merge_steps(run["per_rank"])
+    cp = dist_trace.critical_path(timeline)
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(run, f, indent=1, default=repr)
+        print("saved merged run -> %s" % args.save)
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(run["per_rank"], timeline), f)
+        print("wrote chrome trace -> %s" % args.chrome)
+    if args.json:
+        print(json.dumps({"timeline": timeline, "critical_path": cp,
+                          "servers": run["servers"]},
+                         indent=1, default=repr))
+        return 0
+    print(format_timeline(timeline))
+    print()
+    print(format_straggler(cp, run["servers"]))
+    print()
+    print(format_divergence(run["servers"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,7 +236,7 @@ def main(out_path=None):
 
     summary = {
         "http_port": port,
-        "serving_requests": int(samples["mxnet_serving_requests"][""]),
+        "serving_requests": int(samples["mxnet_serving_requests"][()]),
         "traced_requests": len(timelines),
         "tracez_exemplars": len(exemplars),
         "request_kinds": sorted(tl_kinds),

@@ -615,6 +615,12 @@ def main(argv=None):
                          "sections instead (MFU + waterfall-segment "
                          "delta columns; accepts dumps or "
                          "BENCH_LEDGER.jsonl[:N] rows)")
+    ap.add_argument("--dist", action="store_true",
+                    help="with --compare: diff the two sources' dist "
+                         "sections instead (per-rank waterfall-segment "
+                         "deltas + straggler-ranking drift; accepts "
+                         "statusz captures, flight dumps or "
+                         "tools/dist_report.py --save outputs)")
     ap.add_argument("--graph-passes", metavar="DUMP",
                     help="print the graph_pass provider section of a "
                          "flight-recorder dump (per-program pass summary: "
@@ -643,6 +649,16 @@ def main(argv=None):
             print(perf_report.format_roofline(section, spec))
         if args.waterfall:
             print(perf_report.format_waterfall(section, spec))
+        return 0
+    if args.compare and args.dist:
+        try:
+            import dist_report
+        except ImportError:
+            from tools import dist_report
+
+        cmp = dist_report.compare_dist(*args.compare)
+        print(json.dumps(cmp, indent=1) if args.json
+              else dist_report.format_compare_dist(cmp, *args.compare))
         return 0
     if args.compare and args.perf:
         try:

@@ -70,6 +70,67 @@ def main(rank, nprocs, coordinator, devices_per_proc=4):
     assert np.isfinite(vals).all(), vals
     print("rank %d/%d OK loss=%.6f devices=%d" %
           (rank, nprocs, float(vals[0]), n_global))
+    _dist_obs_exchange(trainer, state, sharded, rank, nprocs)
+
+
+def _dist_obs_exchange(trainer, state, sharded, rank, nprocs,
+                       steps=3):
+    """Exercise the cross-rank observability plane (ISSUE 19) on the
+    fake cluster: each rank runs a few perf-scoped steps (rank-stamped
+    waterfall rows), writes its dist section to a shared directory
+    (``MXTPU_DRYRUN_OUT``, or a coordinator-derived tmp dir), and rank
+    0 merges all ranks' rows into the fleet timeline + critical path —
+    the same files tools/dist_report.py renders."""
+    import glob
+    import json
+    import tempfile
+    import time
+
+    import jax
+
+    from mxnet_tpu.observability import dist_trace, perf
+
+    out_dir = os.environ.get("MXTPU_DRYRUN_OUT") or os.path.join(
+        tempfile.gettempdir(), "mxtpu_dryrun_dist_%d" % nprocs)
+    os.makedirs(out_dir, exist_ok=True)
+    dist_trace.set_rank(rank)
+    for i in range(steps):
+        perf.step_begin()
+        state, outs = trainer.step(state, sharded)
+        jax.block_until_ready(state["params"])
+        perf.step_end(step=i + 1)
+    section = dist_trace.section()
+    path = os.path.join(out_dir, "dist_rank%d.json" % rank)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(section, f, default=repr)
+    os.replace(tmp, path)          # atomic: rank 0 never reads a torn file
+    if rank != 0:
+        return
+    deadline = time.time() + 60.0
+    want = {os.path.join(out_dir, "dist_rank%d.json" % r)
+            for r in range(nprocs)}
+    while time.time() < deadline:
+        if want.issubset(set(glob.glob(
+                os.path.join(out_dir, "dist_rank*.json")))):
+            break
+        time.sleep(0.1)
+    per_rank = {}
+    for path in sorted(want):
+        try:
+            with open(path) as f:
+                sec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        per_rank[int(sec["rank"])] = sec.get("steps") or []
+    timeline = dist_trace.merge_steps(per_rank)
+    cp = dist_trace.critical_path(timeline)
+    assert timeline, "no overlapping steps across %d ranks" % nprocs
+    assert all(row["n_ranks"] == nprocs for row in timeline), timeline
+    print("DIST_TIMELINE_OK steps=%d ranks=%d stall_ms/step=%s" %
+          (len(timeline), nprocs,
+           ["%d:%.2f" % (r["rank"], r["stall_ms_per_step"])
+            for r in cp["ranking"]]))
 
 
 if __name__ == "__main__":
