@@ -126,6 +126,22 @@ declare(
         "when acceptance is low — workload-dependent, so "
         "tune_generation_spec measures it through the live-generator "
         "replay measurer.")
+# distributed-training knob (ISSUE 20): consulted by KVStoreMesh at
+# construction (explicit arg > tuning cache keyed "dp<N>" >
+# MXNET_DIST_BUCKET_BYTES). Small buckets dispatch collectives earlier
+# (more backward overlap) but pay more program launches; large buckets
+# amortize launches but serialize the exchange behind the last key.
+# Declared here at package import — the graph.layout precedent — because
+# kvstore_mesh loads lazily.
+declare(
+    "dist.bucket_bytes",
+    space={"bucket_bytes": (1 << 20, 4 << 20, 16 << 20, 64 << 20)},
+    default=_flag_default("bucket_bytes", "MXNET_DIST_BUCKET_BYTES"),
+    doc="Gradient-bucket size in bytes for the mesh kvstore's fused "
+        "collectives: pushed grads pack into flat per-dtype buckets and "
+        "each bucket's all-reduce / reduce-scatter dispatches the moment "
+        "its keys are present, overlapping the rest of backward "
+        "(docs/distributed.md).")
 # serving-control-plane knobs (ISSUE 14): consulted by the generation
 # engine at construction (explicit GenerationConfig arg > tuning cache
 # > MXNET_GEN_* flag), measured by tuners.tune_control. Declared here

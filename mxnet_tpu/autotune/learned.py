@@ -14,7 +14,10 @@ per candidate.  Free training data, accumulated as it is produced:
   dataset beside the tuning cache (``<cache>.samples``),
 * :func:`ingest_ledger` — BENCH_LEDGER.jsonl program rows (analytic
   flops/bytes vs measured device ms) convert into ``program``-op
-  samples.
+  samples,
+* :func:`ingest_tune_cache` — cache winners carrying a measured ``ms``
+  back-fill as samples (idempotent; ``bench_all.py --ingest-ledger``
+  runs both bulk paths and reports the gate).
 
 The model is a small feature-hashed ridge regressor, pure numpy: hashed
 categorical tokens (op, candidate knobs, log2-bucketed shape context)
@@ -48,7 +51,8 @@ import numpy as np
 from . import cache as _cache
 
 __all__ = ["samples_path", "model_path", "note_samples", "append_samples",
-           "read_samples", "sample_count", "ingest_ledger", "featurize",
+           "read_samples", "sample_count", "ingest_ledger",
+           "ingest_tune_cache", "featurize",
            "CostModel", "train", "load", "ranking_model", "maybe_train",
            "rank_candidates", "spearman", "reset", "stats"]
 
@@ -239,9 +243,20 @@ def ingest_ledger(path):
     stamped with the canonical fingerprint so training includes it —
     foreign-device rows keep their raw device string and are excluded
     by the training-time fingerprint filter (the ledger-verdict
-    same-device comparison discipline)."""
+    same-device comparison discipline).
+
+    Idempotent: a (graph, ts, seconds) already in the dataset is
+    skipped, so bench-time re-ingestion (``bench_all --ingest-ledger``)
+    never duplicates the committed ledger's rows."""
     from ..observability import perf as _perf
 
+    def _ident(row):
+        ctx = row.get("ctx") or {}
+        return (row.get("op"),
+                ctx.get("graph") if isinstance(ctx, dict) else None,
+                row.get("ts"), row.get("s"))
+
+    seen = {_ident(r) for r in read_samples()}
     fp = _cache.device_fingerprint()
     rows = []
     for entry in _perf.read_ledger(path):
@@ -262,6 +277,51 @@ def ingest_ledger(path):
                 "analytic_s": float(roof_ms) * 1e-3,
                 "fingerprint": row_fp,
                 "ts": entry.get("ts")})
+            if _ident(rows[-1]) in seen:
+                rows.pop()
+            else:
+                seen.add(_ident(rows[-1]))
+    append_samples(rows)
+    return len(rows)
+
+
+def ingest_tune_cache():
+    """Convert accumulated ``MXNET_TUNE=1`` cache winners into samples:
+    every cache entry carrying a measured ``ms`` is one (op, winning
+    candidate, shape-key context, seconds) row.  Returns rows appended.
+
+    The cache keeps only the WINNER per search site (the per-candidate
+    log goes through :func:`note_samples` live), so this is the bulk
+    back-fill path for caches tuned before the sample store existed —
+    or tuned by a process running with MXNET_COST_MODEL=0.  Idempotent:
+    a (fingerprint, op, key, ts) already in the dataset is skipped, so
+    bench-time re-ingestion never duplicates rows."""
+    def _ident(row):
+        ctx = row.get("ctx") or {}
+        return (row.get("fingerprint"), row.get("op"),
+                ctx.get("key") if isinstance(ctx, dict) else None,
+                row.get("ts"))
+
+    seen = {_ident(r) for r in read_samples()}
+    rows = []
+    for entry in _cache.entries().values():
+        ms = entry.get("ms")
+        value = entry.get("value")
+        if not ms or ms <= 0 or not isinstance(value, dict):
+            continue
+        row = {
+            "op": entry.get("op"),
+            "candidate": dict(value),
+            "ctx": {"key": entry.get("key"),
+                    "dtype": entry.get("dtype")},
+            "s": float(ms) * 1e-3,
+            "analytic_s": None,
+            "fingerprint": entry.get("fingerprint"),
+            "ts": entry.get("time")}
+        if _ident(row) in seen:
+            continue
+        seen.add(_ident(row))
+        rows.append(row)
     append_samples(rows)
     return len(rows)
 

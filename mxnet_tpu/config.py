@@ -493,6 +493,30 @@ Flags currently honored:
     cumulative ranking and the ``kvstore.rank_lateness_ms{rank=}``
     histograms are unaffected by the bound.
 
+``MXNET_DIST_BUCKET_BYTES`` (default 4194304)
+    Gradient-bucket size of the mesh kvstore (kvstore_mesh.py): pushed
+    gradients pack into flat per-dtype buckets of at most this many
+    bytes, and each bucket's fused all-reduce / reduce-scatter
+    dispatches as soon as its keys are stashed — early buckets' exchange
+    overlaps the rest of backward. Also the declared autotune knob
+    ``dist.bucket_bytes`` (tuning cache beats this flag; an explicit
+    ``KVStoreMesh(bucket_bytes=...)`` beats both).
+
+``MXNET_MESH_ZERO1`` (default 1)
+    ZeRO-1 optimizer-state sharding on the mesh kvstore: the gradient
+    exchange becomes reduce-scatter, each rank updates (and holds
+    optimizer state for) only its 1/N shard, and updated parameter
+    shards all-gather back — per-chip optimizer memory drops ~1/N.
+    0 = plain all-reduce with every rank running the full update.
+    Bit-identical results either way for elementwise optimizers
+    (docs/distributed.md).
+
+``MXNET_MESH_PROCS`` (default 2)
+    Process count of the CPU fake cluster spawned by
+    ``tools/mesh_smoke.py`` and ``bench_all.py --dist-train`` (real
+    deployments size the cluster via the launcher / jax.distributed,
+    not this flag).
+
 ``MXNET_PERF`` (default 1)
     Roofline attribution layer (observability/perf.py): analytic
     FLOPs/HBM-bytes accounting per compiled program, achieved-vs-
@@ -587,6 +611,9 @@ _DEFAULTS = {
     "MXNET_OBS_TS_RETAIN": 600,
     "MXNET_DIST_SENTINEL_SKEW": 2,
     "MXNET_DIST_ROUNDS": 128,
+    "MXNET_DIST_BUCKET_BYTES": 4 << 20,
+    "MXNET_MESH_ZERO1": 1,
+    "MXNET_MESH_PROCS": 2,
     "MXNET_OBS_FLEET_INTERVAL_MS": 1000,
     "MXNET_OBS_FLEET_STALE_SCRAPES": 3,
     "MXNET_OBS_FLEET_DEAD_SCRAPES": 10,

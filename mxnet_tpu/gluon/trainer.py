@@ -167,6 +167,24 @@ class Trainer:
                             self._contexts[0] if self._contexts else None)
                 return
 
+            if (self._update_on_kvstore
+                    and getattr(self._kvstore, "bucketed", False)):
+                # bucketed stores (mesh): stash every gradient before the
+                # first pull so whole buckets dispatch as single fused
+                # collectives overlapping the remaining pushes
+                with trace_span("optimizer_update", "gluon"):
+                    live = [(i, p) for i, p in enumerate(self._params)
+                            if p.grad_req != "null"]
+                    for i, p in live:
+                        self._kvstore.push(p.name, p.list_grad(),
+                                           priority=-i)
+                    for i, p in live:
+                        self._kvstore.pull(p.name, p.list_data(),
+                                           priority=-i)
+                record_step(time.perf_counter() - started,
+                            self._contexts[0] if self._contexts else None)
+                return
+
             with trace_span("optimizer_update", "gluon"):
                 for i, p in enumerate(self._params):
                     if p.grad_req == "null":

@@ -202,16 +202,10 @@ class KVStore:
 
     def _reduce_mesh(self):
         """One-representative-device-per-process mesh for global reduces."""
-        import jax
-        import numpy as np
-        from jax.sharding import Mesh
-
         if getattr(self, "_mesh", None) is None:
-            devs = [None] * jax.process_count()
-            for d in jax.devices():
-                if devs[d.process_index] is None:
-                    devs[d.process_index] = d
-            self._mesh = Mesh(np.array(devs), ("p",))
+            from .parallel.mesh import process_mesh
+
+            self._mesh = process_mesh("p")
             self._psum_progs = {}
         return self._mesh
 
@@ -933,14 +927,21 @@ def create(name="local"):
     jax.distributed (allreduce across worker processes); dist_async talks
     to host-side parameter servers (mxnet_tpu/kvstore_server.py) with the
     optimizer running server-side per push — the reference's asynchronous
-    PS architecture."""
+    PS architecture; mesh is the collectives-backed sharded-training
+    backend (bucketed in-program all-reduce / ZeRO-1 reduce-scatter, zero
+    host RPCs on the step path — mxnet_tpu/kvstore_mesh.py,
+    docs/distributed.md)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     known = ("local", "local_allreduce_cpu", "local_allreduce_device",
              "device", "nccl", "dist_sync", "dist_async", "dist_device_sync",
-             "dist")
+             "dist", "mesh")
     if name not in known:
         raise MXNetError("unknown KVStore type %r" % name)
     if name == "dist_async":
         return KVStoreDistAsync()
+    if name == "mesh":
+        from .kvstore_mesh import KVStoreMesh
+
+        return KVStoreMesh()
     return KVStore(name)

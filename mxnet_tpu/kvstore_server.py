@@ -627,9 +627,15 @@ class PSClient:
         server applying a push and the reply landing means the retry
         re-applies it — inherent to retried non-idempotent RPC, and the
         reference PS protocol's behavior too."""
+        from .observability import counter as _counter
         from .observability import request_trace as _rtrace
         from .resilience import BarrierTimeoutError
         from .resilience import retry as _retry
+
+        # every PS round-trip counts here — the mesh backend's zero-RPC
+        # step-path claim is witnessed by this staying flat
+        # (tools/mesh_smoke.py)
+        _counter("kvstore.rpc").inc()
 
         # an ambient request/step trace rides the wire as a ("traced",
         # id, inner) envelope so the server's handling records under the

@@ -22,15 +22,32 @@ BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
+def _is_mesh(obj):
+    """True when obj is a jax.sharding.Mesh (lazy import — model.py must
+    stay importable before jax is configured)."""
+    try:
+        from jax.sharding import Mesh
+    except Exception:
+        return False
+    return isinstance(obj, Mesh)
+
+
 def _create_kvstore(kvstore, num_device, arg_params):
-    """Create kvstore + decide update_on_kvstore (reference: model.py:91)."""
+    """Create kvstore + decide update_on_kvstore (reference: model.py:91).
+
+    Passing a ``jax.sharding.Mesh`` (or the string "mesh") selects the
+    collectives-backed sharded-training store: even with one local
+    device the gradient exchange must still cross processes in-program,
+    so the single-device "no kvstore" shortcut does not apply."""
     update_on_kvstore = True
     if kvstore is None:
         kv = None
     elif isinstance(kvstore, kvs.KVStore):
         kv = kvstore
+    elif _is_mesh(kvstore):
+        kv = kvs.create("mesh")
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
+        if num_device == 1 and "dist" not in kvstore and kvstore != "mesh":
             # no need for multi-device reduce; update locally
             kv = None
         else:
@@ -66,7 +83,22 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
     """Push grads / pull weights (reference: model.py:142). Priorities are
-    not needed: XLA + async dispatch already overlap the reduces."""
+    not needed: XLA + async dispatch already overlap the reduces.
+
+    Bucketed stores (KVStoreMesh) get ALL pushes before any pull: a
+    bucket's collective dispatches as soon as its keys are stashed, so
+    the early buckets' all-reduce overlaps the later pushes — the
+    interleaved push/pull loop would settle each bucket immediately and
+    forfeit the overlap."""
+    if getattr(kvstore, "bucketed", False):
+        live = [(i, a, g) for i, (a, g) in
+                enumerate(zip(param_arrays, grad_arrays))
+                if g[0] is not None]
+        for index, _arg_list, grad_list in live:
+            kvstore.push(param_names[index], grad_list, priority=-index)
+        for index, arg_list, _grad_list in live:
+            kvstore.pull(param_names[index], arg_list, priority=-index)
+        return
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:

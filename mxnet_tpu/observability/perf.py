@@ -52,7 +52,8 @@ __all__ = ["active", "node_cost", "flash_attention_cost", "program_cost",
            "fusion_candidates", "note_program_run", "program_table",
            "step_begin", "step_end", "step_abandon", "step_active",
            "scope_suspended",
-           "note_data_wait", "note_kv", "waterfalls", "last_waterfall",
+           "note_data_wait", "note_kv", "mark_collective", "waterfalls",
+           "last_waterfall",
            "summary", "summary_brief", "reset",
            "append_ledger", "read_ledger", "ledger_verdict",
            "TRAIN_FLOPS_MULT", "TRAIN_BYTES_MULT", "ELEMWISE_FLOPS",
@@ -575,6 +576,17 @@ def note_kv(seconds):
         scope["kvstore_s"] += seconds
 
 
+def mark_collective():
+    """Tag the current step's kvstore segment as in-device collectives
+    (the mesh backend): the ``kvstore_s`` wall is compiled-program
+    dispatch, not host RPC round-trips — waterfall rows carry
+    ``collective: true`` so dist_report / the fleet timeline render the
+    segment as device-side exchange (docs/perf_observability.md)."""
+    scope = getattr(_tls, "step", None)
+    if scope is not None:
+        scope["collective"] = True
+
+
 def step_end(step=None):
     """Close the scope and record one waterfall row.  The partition is
     exact BY CONSTRUCTION: ``host_s = wall_s - (data_wait_s + device_s +
@@ -609,6 +621,8 @@ def step_end(step=None):
                          / (cm.MEASURED_HBM_GBPS * 1e9)) if wall > 0
                         else None,
     }
+    if scope.get("collective"):
+        rec["collective"] = True
     _arm_provider()
     with _lock:
         if _waterfalls is None:

@@ -6,7 +6,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["make_mesh", "data_parallel_sharding", "replicated_sharding",
-           "replica_devices"]
+           "replica_devices", "process_mesh"]
 
 
 def make_mesh(axes=None, devices=None):
@@ -30,6 +30,21 @@ def make_mesh(axes=None, devices=None):
                          % (total, len(devices)))
     dev_array = np.array(devices[:total]).reshape(sizes)
     return Mesh(dev_array, axis_names=names)
+
+
+def process_mesh(axis="p"):
+    """One-representative-device-per-process Mesh — the wire layout for
+    cross-process collectives (KVStore/KVStoreMesh global reduces): each
+    process contributes its shard of a global array laid out over this
+    axis, and a jitted ``sum(axis=0)`` over it IS the all-reduce."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = [None] * jax.process_count()
+    for d in jax.devices():
+        if devs[d.process_index] is None:
+            devs[d.process_index] = d
+    return Mesh(np.array(devs), (axis,))
 
 
 def replica_devices(mesh=None, axis=None):

@@ -618,6 +618,28 @@ def test_ingest_ledger(own_tune_cache, tmp_path):
     rows = learned.read_samples()
     assert rows[-1]["op"] == "program"
     assert rows[-1]["analytic_s"] == pytest.approx(1e-3)
+    # idempotent: re-ingesting the same ledger appends nothing
+    assert learned.ingest_ledger(ledger) == 0
+    assert len(learned.read_samples()) == len(rows)
+
+
+def test_ingest_tune_cache(own_tune_cache):
+    from mxnet_tpu.autotune import learned
+
+    autotune.cache.record("fusion.blocks", {"M": 64}, {"bm": 128},
+                          dtype="float32", ms=2.5, trials=3)
+    autotune.cache.record("io.prefetch", "bs64", {"depth": 4})  # no ms
+    n0 = learned.sample_count()
+    assert learned.ingest_tune_cache() == 1
+    row = learned.read_samples()[-1]
+    assert row["op"] == "fusion.blocks"
+    assert row["candidate"] == {"bm": 128}
+    assert row["s"] == pytest.approx(2.5e-3)
+    assert row["ctx"]["dtype"] == "float32"
+    assert learned.sample_count() == n0 + 1
+    # idempotent: the same winner never duplicates
+    assert learned.ingest_tune_cache() == 0
+    assert learned.sample_count() == n0 + 1
 
 
 def test_tune_fused_matmul_records(own_tune_cache):

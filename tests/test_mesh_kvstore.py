@@ -1,0 +1,326 @@
+"""Collectives-backed mesh kvstore (ISSUE 20, mxnet_tpu/kvstore_mesh.py).
+
+The parity matrix: single-device vs data-parallel-mesh vs ZeRO-1-sharded
+training on the same seed and data order.  In-process tests cover the
+facade (bucket planning, push/pull math, Module/Trainer integration,
+optimizer-state round-trips) on the one-process degenerate mesh; the
+fake-cluster test (launch_local, the tests/test_dist_kvstore.py pattern)
+runs the real cross-process collectives and asserts
+
+* ZeRO-1 (reduce-scatter + sharded update + all-gather) is BIT-exact vs
+  plain all-reduce — elementwise optimizers make shard boundaries
+  invisible, and the gradient sum is the same program either way;
+* both match a single-device fit of the same global batch to fp32
+  reassociation tolerance (the per-rank partial sums re-order the adds;
+  documented in docs/distributed.md);
+* per-rank optimizer-state bytes under ZeRO-1 sum to the unsharded
+  footprint (~1/N each).
+
+The multi-process resume/kill-restart leg lives in tools/mesh_smoke.py
+(tier-1 CI) — it needs SIGTERM choreography that pytest should not host.
+"""
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore_mesh import KVStoreMesh
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+from launch import launch_local  # noqa: E402
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _fit_params(kvstore, seed=7, num_epoch=2, batch=8, samples=32):
+    np.random.seed(11)
+    mx.random.seed(11)
+    rng = np.random.RandomState(seed)
+    X = rng.rand(samples, 6).astype(np.float32)
+    y = (rng.rand(samples) * 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            initializer=mx.init.Uniform(0.3), kvstore=kvstore)
+    args, _ = mod.get_params()
+    return {k: v.asnumpy().copy() for k, v in args.items()}
+
+
+# ------------------------------------------------------ facade basics
+def test_mesh_create_and_push_pull_sgd():
+    kv = mx.kv.create("mesh")
+    try:
+        assert kv.type == "mesh" and kv.bucketed
+        assert kv.rank == 0 and kv.num_workers == 1
+        opt = mx.optimizer.create("sgd", learning_rate=0.1,
+                                  rescale_grad=1.0)
+        kv.set_optimizer(opt)
+        kv.init("w", mx.nd.ones((3, 2)))
+        kv.push("w", [mx.nd.ones((3, 2)) * 2, mx.nd.ones((3, 2))])
+        out = mx.nd.zeros((3, 2))
+        kv.pull("w", out=out)
+        # local reduce merges the device list (2 + 1), then sgd
+        np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * 3.0,
+                                   rtol=1e-6)
+    finally:
+        kv.close()
+
+
+def test_mesh_auto_selected_from_jax_mesh_instance():
+    from mxnet_tpu.model import _create_kvstore
+    from mxnet_tpu.parallel import make_mesh
+
+    kv, update_on_kvstore = _create_kvstore(
+        make_mesh(), 1, {"w": mx.nd.ones((2, 2))})
+    try:
+        assert isinstance(kv, KVStoreMesh) and update_on_kvstore
+    finally:
+        kv.close()
+    # the plain string still routes through create(), and one device
+    # does NOT short-circuit it to None like "local" would
+    kv2, up2 = _create_kvstore("mesh", 1, {"w": mx.nd.ones((2, 2))})
+    try:
+        assert isinstance(kv2, KVStoreMesh) and up2
+    finally:
+        kv2.close()
+
+
+def test_mesh_bucket_plan_packs_by_dtype_and_bytes():
+    # 6 float32 keys of 40 bytes each against a 100-byte bucket limit:
+    # greedy packing in init order = ceil(6*40/100 capped per bucket)
+    kv = KVStoreMesh(bucket_bytes=100)
+    try:
+        opt = mx.optimizer.create("sgd", learning_rate=0.5,
+                                  rescale_grad=1.0)
+        kv.set_optimizer(opt)
+        for i in range(6):
+            kv.init("k%d" % i, mx.nd.ones((10,)))
+        for i in range(6):
+            kv.push("k%d" % i, mx.nd.ones((10,)) * (i + 1))
+        outs = [mx.nd.zeros((10,)) for _ in range(6)]
+        for i, o in enumerate(outs):
+            kv.pull("k%d" % i, out=o)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o.asnumpy(), 1.0 - 0.5 * (i + 1),
+                                       rtol=1e-6)
+        stats = kv.push_staleness()
+        assert stats["buckets"] == 3 and stats["bucket_bytes"] == 100
+        # second cycle: the seen-key sets are recorded, dispatch goes
+        # eager — same math must come out
+        for i in range(6):
+            kv.push("k%d" % i, mx.nd.zeros((10,)))
+        kv.pull("k0", out=outs[0])
+        np.testing.assert_allclose(outs[0].asnumpy(), 1.0 - 0.5,
+                                   rtol=1e-6)
+    finally:
+        kv.close()
+
+
+def test_mesh_partial_bucket_push_settles():
+    # pulling a key whose bucket is only partially pushed must settle
+    # with just the present keys (first-cycle lazy dispatch)
+    kv = KVStoreMesh(bucket_bytes=1 << 20)   # everything in one bucket
+    try:
+        kv.init("a", mx.nd.zeros((4,)))
+        kv.init("b", mx.nd.zeros((4,)))
+        kv.push("a", mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("a", out=out)                # no updater: pull = merged
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        kv.pull("b", out=out)                # never pushed: initial value
+        np.testing.assert_allclose(out.asnumpy(), 0.0)
+    finally:
+        kv.close()
+
+
+def test_mesh_push_uninitialized_key_raises():
+    kv = mx.kv.create("mesh")
+    try:
+        with pytest.raises(mx.MXNetError):
+            kv.push("nope", mx.nd.ones((2,)))
+    finally:
+        kv.close()
+
+
+# ------------------------------------------- single-process parity legs
+def test_module_fit_mesh_matches_local():
+    # one process, one device: the mesh store must reproduce the local
+    # update path exactly (same optimizer programs, no collective)
+    local = _fit_params("local")
+    mesh = _fit_params("mesh")
+    assert sorted(local) == sorted(mesh)
+    for k in local:
+        assert np.array_equal(local[k], mesh[k]), k
+
+
+def test_trainer_step_mesh_matches_local():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    def run(kvstore):
+        np.random.seed(0)
+        mx.random.seed(0)
+        x = np.random.uniform(-1, 1, (64, 10)).astype(np.float32)
+        w = np.random.uniform(-1, 1, (10,))
+        y = (x @ w > 0).astype(np.float32)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.5, "momentum": 0.9},
+                                kvstore=kvstore)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        for _ in range(5):
+            with mx.autograd.record():
+                loss = loss_fn(net(mx.nd.array(x)), mx.nd.array(y))
+            loss.backward()
+            trainer.step(x.shape[0])
+        # gluon's name_scope counter advances per run: key on the
+        # scope-free suffix so the two runs compare positionally
+        return {p.name.split("_", 1)[1]: p.data().asnumpy().copy()
+                for p in net.collect_params().values()}
+
+    base = run(None)
+    mesh = run("mesh")
+    assert sorted(base) == sorted(mesh)
+    for k in base:
+        np.testing.assert_allclose(base[k], mesh[k], rtol=1e-6, atol=1e-7)
+
+
+def test_mesh_optimizer_state_roundtrip_continues_bit_exact(tmp_path):
+    def run(reload_at=None):
+        kv = mx.kv.create("mesh")
+        try:
+            opt = mx.optimizer.create("sgd", learning_rate=0.1,
+                                      momentum=0.9, rescale_grad=1.0)
+            kv.set_optimizer(opt)
+            kv.init("w", mx.nd.ones((5,)))
+            out = mx.nd.zeros((5,))
+            for step in range(6):
+                if step == reload_at:
+                    f = str(tmp_path / "states")
+                    kv.save_optimizer_states(f)
+                    kv.load_optimizer_states(f)
+                kv.push("w", mx.nd.ones((5,)) * (step + 1))
+                kv.pull("w", out=out)
+            return out.asnumpy().copy()
+        finally:
+            kv.close()
+
+    assert np.array_equal(run(), run(reload_at=3))
+
+
+def test_mesh_save_states_without_optimizer_raises(tmp_path):
+    kv = mx.kv.create("mesh")
+    try:
+        with pytest.raises(mx.MXNetError):
+            kv.save_optimizer_states(str(tmp_path / "s"))
+    finally:
+        kv.close()
+
+
+# ------------------------------------------------- fake-cluster parity
+_WORKER = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import sys
+    sys.path.insert(0, %(repo)r)
+    from mxnet_tpu.kvstore import _ensure_distributed
+    _ensure_distributed()        # before ANY jax computation
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.kvstore_mesh import KVStoreMesh
+
+    rank, nw = int(os.environ["MXTPU_WORKER_ID"]), %(n)d
+    BATCH, STEPS = 8, 4
+
+    def _mlp():
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+        fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    # per-rank shards + the equivalent single-device global batches:
+    # global batch i = concat over ranks of each rank's batch i, so the
+    # summed-gradient x 1/(BATCH*nw) rescale matches exactly
+    rngs = [np.random.RandomState(100 + r) for r in range(nw)]
+    Xr = [rng.rand(STEPS * BATCH, 6).astype(np.float32) for rng in rngs]
+    yr = [(rng.rand(STEPS * BATCH) * 4).astype(np.float32)
+          for rng in rngs]
+    Xg = np.concatenate([np.concatenate([X[i*BATCH:(i+1)*BATCH]
+                                         for X in Xr])
+                         for i in range(STEPS)])
+    yg = np.concatenate([np.concatenate([y[i*BATCH:(i+1)*BATCH]
+                                         for y in yr])
+                         for i in range(STEPS)])
+
+    def fit(kvstore, X, y, batch):
+        np.random.seed(11); mx.random.seed(11)
+        it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),
+                                  ("momentum", 0.9)),
+                initializer=mx.init.Uniform(0.3), kvstore=kvstore)
+        args, _ = mod.get_params()
+        if isinstance(kvstore, KVStoreMesh):
+            kvstore.close()
+        return {k: v.asnumpy().copy() for k, v in args.items()}
+
+    zero1 = fit(KVStoreMesh(zero1=True), Xr[rank], yr[rank], BATCH)
+    plain = fit(KVStoreMesh(zero1=False), Xr[rank], yr[rank], BATCH)
+    for k in zero1:   # ZeRO-1 vs all-reduce: BIT-exact
+        assert np.array_equal(zero1[k], plain[k]), k
+
+    single = fit(None, Xg, yg, BATCH * nw)
+    for k in zero1:   # vs single device: fp32 reassociation tolerance
+        np.testing.assert_allclose(zero1[k], single[k],
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+
+    # ZeRO-1 memory witness: per-rank shard bytes sum to the unsharded
+    # footprint (momentum = one fp32 slot per parameter element)
+    from jax.experimental import multihost_utils
+    kv = KVStoreMesh(zero1=True)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    kv.init("w", mx.nd.ones((64, 4)))
+    kv.push("w", mx.nd.ones((64, 4)))
+    out = mx.nd.zeros((64, 4))
+    kv.pull("w", out=out)
+    mine = kv.optimizer_state_bytes()
+    total = int(np.asarray(multihost_utils.process_allgather(
+        np.array([mine], np.int64))).sum())
+    assert total == 64 * 4 * 4, (mine, total)
+    assert mine <= total // nw + 64, (mine, total)
+    kv.close()
+    print("WORKER_OK", rank)
+""")
+
+
+def test_mesh_parity_matrix_fake_cluster():
+    n = 2
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    script = _WORKER % {"repo": repo, "n": n}
+    procs = launch_local(n, [sys.executable, "-c", script])
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outputs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (i, out)
+        assert "WORKER_OK" in out

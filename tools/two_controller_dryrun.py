@@ -1,16 +1,18 @@
 """Two-controller (multi-host) dryrun worker.
 
-The single-process dryrun in ``__graft_entry__.py`` exercises the sharded
-train step over one controller's mesh; THIS script is one rank of a
-2-process fake cluster (the reference's ``tools/launch.py -n N --launcher
-local`` analog, tests/nightly/dist_sync_kvstore.py): each process owns 4
-virtual CPU devices, ``jax.distributed.initialize`` wires the controllers
-together, and one data-parallel ResNet train step runs over the GLOBAL
-8-device mesh so the cross-process psum path (ICI/DCN collectives on real
-hardware, gloo here) actually executes.
+DOWNGRADED (ISSUE 20): ``tools/mesh_smoke.py`` replaced this as the
+multi-host leg of ``__graft_entry__.dryrun_multichip`` and of CI — it
+drives the Module/kvstore training path users actually run (bucketed
+in-program collectives, ZeRO-1 sharded optimizer state, resume) over
+the same fake-cluster wiring.  This script stays as a standalone,
+lower-level probe of the raw jit-sharded step: one rank of an
+N-process cluster, 4 virtual CPU devices each,
+``jax.distributed.initialize`` wires the controllers together, and one
+data-parallel ResNet train step runs over the GLOBAL 8-device mesh so
+the bare cross-process psum path (ICI/DCN collectives on real
+hardware, gloo here) executes without any kvstore in the loop.
 
-Run by ``__graft_entry__.dryrun_multichip`` via subprocess; also usable
-standalone:
+Standalone usage (spawn one per rank):
 
     python tools/two_controller_dryrun.py <rank> <nprocs> <coordinator>
 """
